@@ -1,0 +1,10 @@
+"""glusterfs_tpu: a TPU-native scale-out storage framework.
+
+Brand-new implementation of the capabilities of the reference distributed
+storage system (GlusterFS, mounted read-only at /root/reference): translator
+graphs over bricks, hash distribution, replication, Reed-Solomon erasure
+coding, self-heal, management plane and client APIs — with all GF(256)
+erasure-coding compute batched onto TPU via JAX/XLA/Pallas.
+"""
+
+__version__ = "0.1.0"
