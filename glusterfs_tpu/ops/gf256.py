@@ -63,8 +63,7 @@ def gf_mul(a, b):
     a = np.asarray(a, dtype=np.int32)
     b = np.asarray(b, dtype=np.int32)
     nz = (a != 0) & (b != 0)
-    idx = np.where(nz, LOG[a] + LOG[b], 0)
-    idx = np.clip(idx, 0, 511)
+    idx = np.where(nz, LOG[a] + LOG[b], 0)  # in [0, 508] when nz
     return np.where(nz, POW[idx], 0).astype(np.uint8)
 
 
@@ -111,6 +110,8 @@ def bitmatrices() -> np.ndarray:
 
 def encode_matrix(k: int, n: int) -> np.ndarray:
     """(n, k) non-systematic Vandermonde: A[i][j] = (i+1)^(k-1-j)."""
+    if k > MAX_FRAGMENTS:
+        raise ValueError(f"at most {MAX_FRAGMENTS} data fragments supported")
     if n > 255:
         raise ValueError("at most 255 fragments representable in GF(256)")
     v = np.arange(1, n + 1, dtype=np.int32)
@@ -123,7 +124,7 @@ def encode_matrix(k: int, n: int) -> np.ndarray:
 
 def invert_matrix(a: np.ndarray) -> np.ndarray:
     """Gauss-Jordan inverse over GF(256)."""
-    a = a.astype(np.int32).copy()
+    a = a.astype(np.int32)
     k = a.shape[0]
     if a.shape != (k, k):
         raise ValueError("square matrix required")

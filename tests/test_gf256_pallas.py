@@ -1,0 +1,32 @@
+"""Pallas kernel parity (interpret mode on CPU; real lowering exercised on TPU
+by bench.py and __graft_entry__)."""
+
+import numpy as np
+import pytest
+
+from glusterfs_tpu.ops import gf256, gf256_pallas
+
+CONFIGS = [(4, 2), (8, 4), (16, 4)]
+
+
+@pytest.mark.parametrize("k,r", CONFIGS)
+@pytest.mark.parametrize("formulation", ["xor", "mxu"])
+def test_encode_parity(k, r, formulation):
+    n = k + r
+    rng = np.random.default_rng(k + r)
+    data = rng.integers(0, 256, k * gf256.CHUNK_SIZE * 3, dtype=np.uint8)
+    expect = gf256.ref_encode(data, k, n)
+    got = gf256_pallas.encode(data, k, n, formulation, interpret=True)
+    assert np.array_equal(got, expect)
+
+
+@pytest.mark.parametrize("k,r", CONFIGS)
+@pytest.mark.parametrize("formulation", ["xor", "mxu"])
+def test_decode_parity(k, r, formulation):
+    n = k + r
+    rng = np.random.default_rng(k * 3 + r)
+    data = rng.integers(0, 256, k * gf256.CHUNK_SIZE * 2, dtype=np.uint8)
+    frags = gf256.ref_encode(data, k, n)
+    rows = list(range(r, r + k))
+    got = gf256_pallas.decode(frags[rows], rows, k, formulation, interpret=True)
+    assert np.array_equal(got, data)
