@@ -1,0 +1,123 @@
+"""Build-on-demand ctypes bindings for the native (C++/AVX) kernels.
+
+The shared library is compiled from ``src/*.cpp`` on first use and cached
+next to the sources keyed by a source hash, mirroring how the reference
+detects and selects its fastest CPU backend at runtime
+(``ec_code_detect``, reference ec-code.c:977-1059) — here the "detection"
+is: does the toolchain exist and does the library build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "gf256_kernels.cpp")
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_BUILD_ERROR: str | None = None
+
+WORD = 64
+BITS = 8
+CHUNK = WORD * BITS
+
+
+def _build() -> str:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_DIR, f"libgf256_{tag}.so")
+    if os.path.exists(so):
+        return so
+    cmd = [
+        "g++", "-O3", "-mavx2", "-funroll-loops", "-fPIC", "-shared",
+        "-std=c++17", _SRC, "-o", so + ".tmp",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(so + ".tmp", so)
+    return so
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB, _BUILD_ERROR
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _BUILD_ERROR is not None:
+            raise RuntimeError(_BUILD_ERROR)
+        try:
+            lib = ctypes.CDLL(_build())
+        except Exception as e:  # toolchain missing, build failure, ...
+            _BUILD_ERROR = f"native kernel build failed: {e}"
+            raise RuntimeError(_BUILD_ERROR) from e
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.gf_apply_bitmatrix.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, u8p, u8p, ctypes.c_size_t]
+        lib.gf_encode.argtypes = [
+            u8p, u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_size_t]
+        lib.gf_decode.argtypes = [u8p, u8p, u8p, ctypes.c_int, ctypes.c_size_t]
+        _LIB = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        _lib()
+        return True
+    except RuntimeError:
+        return False
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+MAX_K = 16  # RowSel.idx capacity in the C++ kernel is 16*8 columns
+
+
+def encode(data: np.ndarray, k: int, n: int, abits: np.ndarray) -> np.ndarray:
+    """Stripe-major bytes (S*k*512) + (n*8, k*8) bitmatrix -> (n, S*512)."""
+    data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
+    if not 1 <= k <= MAX_K:
+        raise ValueError(f"k must be in [1, {MAX_K}]")
+    if data.size % (k * CHUNK):
+        raise ValueError("data length must be a multiple of k*512")
+    s = data.size // (k * CHUNK)
+    abits = np.ascontiguousarray(abits, dtype=np.uint8)
+    out = np.empty((n, s * CHUNK), dtype=np.uint8)
+    _lib().gf_encode(_u8p(data), _u8p(out), _u8p(abits), k, n, s)
+    return out
+
+
+def decode(frags: np.ndarray, k: int, bbits: np.ndarray) -> np.ndarray:
+    """Fragment-major (k, S*512) + (k*8, k*8) bitmatrix -> bytes (S*k*512)."""
+    frags = np.ascontiguousarray(frags, dtype=np.uint8)
+    if not 1 <= k <= MAX_K:
+        raise ValueError(f"k must be in [1, {MAX_K}]")
+    if frags.shape[0] != k or frags.shape[1] % CHUNK:
+        raise ValueError("need (k, S*512) fragments")
+    s = frags.shape[1] // CHUNK
+    bbits = np.ascontiguousarray(bbits, dtype=np.uint8)
+    out = np.empty(s * k * CHUNK, dtype=np.uint8)
+    _lib().gf_decode(_u8p(frags), _u8p(out), _u8p(bbits), k, s)
+    return out
+
+
+def apply_bitmatrix(abits: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """(R, C) bitmatrix applied to plane-major (C, W) bytes -> (R, W)."""
+    abits = np.ascontiguousarray(abits, dtype=np.uint8)
+    x = np.ascontiguousarray(x, dtype=np.uint8)
+    r, c = abits.shape
+    if c > MAX_K * BITS:
+        raise ValueError(f"at most {MAX_K * BITS} input planes supported")
+    if x.shape[0] != c or x.shape[1] % WORD:
+        raise ValueError("x must be (C, W) with W a multiple of 64")
+    out = np.empty((r, x.shape[1]), dtype=np.uint8)
+    _lib().gf_apply_bitmatrix(_u8p(abits), r, c, _u8p(x), _u8p(out),
+                              x.shape[1])
+    return out

@@ -1,0 +1,126 @@
+// Native GF(2^8) erasure-coding kernels (CPU fast path + bench baseline).
+//
+// TPU-native framework's host-side analog of the reference's JIT-emitted
+// AVX/SSE/x64 XOR-chain kernels (reference: xlators/cluster/ec/src/ec-code.c,
+// ec-code-avx.c — behavior only; this is an independent implementation).
+//
+// Layout contract (shared with glusterfs_tpu/ops/gf256.py):
+//   * data is bit-sliced in 512-byte chunks: 8 bit-planes x 64-byte words
+//     (EC_METHOD_CHUNK_SIZE / EC_METHOD_WORD_SIZE, reference ec-method.h:17-29)
+//   * multiplying a chunk by a GF(256) constant == applying an 8x8 GF(2)
+//     bit-matrix to its planes; a full encode is one (N*8, K*8) binary
+//     matrix applied per stripe.
+//
+// Compiled with -O3 -mavx2 -funroll-loops: the fixed 64-byte XOR loops below
+// vectorize to YMM xor/load/store chains, which is the same instruction mix
+// the reference JIT emits.
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int kWord = 64;     // bytes per bit-plane word
+constexpr int kBits = 8;      // GF(2^8)
+constexpr int kChunk = kWord * kBits;  // 512
+
+// XOR-accumulate one 64-byte word: dst ^= src.  Auto-vectorizes to 2x YMM.
+inline void xor_word(uint8_t* __restrict dst, const uint8_t* __restrict src) {
+  for (int b = 0; b < kWord; ++b) dst[b] ^= src[b];
+}
+
+// Per output row, the list of selected input planes (built once per call).
+// cols = k*8 <= 128 (k <= 16 data fragments); rows = n*8 can exceed that
+// (n up to 255), so the row table is heap-allocated.
+struct RowSel {
+  int idx[16 * kBits];
+  int count;
+};
+
+std::vector<RowSel> build_sels(const uint8_t* abits, int rows, int cols) {
+  std::vector<RowSel> sels(rows);
+  for (int i = 0; i < rows; ++i) {
+    sels[i].count = 0;
+    for (int j = 0; j < cols; ++j) {
+      if (abits[i * cols + j]) sels[i].idx[sels[i].count++] = j;
+    }
+  }
+  return sels;
+}
+
+// y_row = XOR of selected 64-byte plane words from x (stride kWord rows).
+inline void apply_row(const RowSel& sel, const uint8_t* __restrict x,
+                      uint8_t* __restrict y) {
+  if (sel.count == 0) {
+    std::memset(y, 0, kWord);
+    return;
+  }
+  std::memcpy(y, x + sel.idx[0] * kWord, kWord);
+  for (int t = 1; t < sel.count; ++t) xor_word(y, x + sel.idx[t] * kWord);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Generic plane-major apply: x is (c, w) bytes, y is (r, w); w a multiple of
+// kWord.  abits is (r, c) in {0,1}.  Each 64-byte column block is independent.
+void gf_apply_bitmatrix(const uint8_t* abits, int r, int c,
+                        const uint8_t* x, uint8_t* y, size_t w) {
+  std::vector<RowSel> sels = build_sels(abits, r, c);
+  for (size_t off = 0; off < w; off += kWord) {
+    // Gather is strided here; encode/decode below use the stripe-contiguous
+    // layout instead.  This entry exists for parity testing vs the JAX path.
+    for (int i = 0; i < r; ++i) {
+      const RowSel& sel = sels[i];
+      uint8_t* dst = y + i * w + off;
+      if (sel.count == 0) {
+        std::memset(dst, 0, kWord);
+        continue;
+      }
+      std::memcpy(dst, x + sel.idx[0] * w + off, kWord);
+      for (int t = 1; t < sel.count; ++t)
+        xor_word(dst, x + sel.idx[t] * w + off);
+    }
+  }
+}
+
+// Encode: data is stripe-major (s, k*8, 64) plane words; abits (n*8, k*8);
+// out is fragment-major (n, s*512) — fragment i chunk for stripe t lands at
+// out + (i*s + t)*512 (matches ec_method_encode's output layout,
+// reference ec-method.c:393-408).
+void gf_encode(const uint8_t* __restrict data, uint8_t* __restrict out,
+               const uint8_t* __restrict abits, int k, int n, size_t s) {
+  const int cols = k * kBits;
+  const int rows = n * kBits;
+  std::vector<RowSel> sels = build_sels(abits, rows, cols);
+  for (size_t t = 0; t < s; ++t) {
+    const uint8_t* x = data + t * (size_t)k * kChunk;
+    for (int f = 0; f < n; ++f) {
+      uint8_t* frag = out + (f * s + t) * (size_t)kChunk;
+      for (int p = 0; p < kBits; ++p)
+        apply_row(sels[f * kBits + p], x, frag + p * kWord);
+    }
+  }
+}
+
+// Decode: frags is fragment-major (k, s*512) (the k surviving fragments in
+// row order matching the decode matrix); bbits (k*8, k*8); out is
+// stripe-major bytes (s*k*512).
+void gf_decode(const uint8_t* __restrict frags, uint8_t* __restrict out,
+               const uint8_t* __restrict bbits, int k, size_t s) {
+  const int cols = k * kBits;
+  std::vector<RowSel> sels = build_sels(bbits, cols, cols);
+  // Gather one stripe's planes into a contiguous scratch (k*8 x 64), apply.
+  uint8_t x[16 * kBits * kWord];
+  for (size_t t = 0; t < s; ++t) {
+    for (int f = 0; f < k; ++f)
+      std::memcpy(x + f * (size_t)kChunk, frags + (f * s + t) * (size_t)kChunk,
+                  kChunk);
+    uint8_t* y = out + t * (size_t)k * kChunk;
+    for (int i = 0; i < cols; ++i) apply_row(sels[i], x, y + i * kWord);
+  }
+}
+
+}  // extern "C"

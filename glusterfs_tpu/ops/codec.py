@@ -1,0 +1,179 @@
+"""Unified erasure codec with runtime backend dispatch.
+
+The analog of the reference's ``disperse.cpu-extensions`` option and
+``ec_code_detect()`` runtime backend selection (reference
+xlators/cluster/ec/src/ec-code.c:59-69, 977-1059): the option values
+``{none, auto, x64, sse, avx}`` become
+
+=============  =================================================
+backend        implementation
+=============  =================================================
+``ref``        pure-NumPy bit-sliced oracle (ops/gf256.py)
+``native``     C++ AVX2 XOR kernels via ctypes (native/)
+``xla``        MXU binary matmul via jitted XLA (ops/gf256_xla.py)
+``xla-xor``    VPU XOR chains via jitted XLA
+``pallas-xor`` Pallas TPU kernel, static XOR chains in VMEM
+``pallas-mxu`` Pallas TPU kernel, in-VMEM unpack + MXU matmul
+``auto``       pallas-xor on TPU, else native, else xla
+=============  =================================================
+
+All backends are byte-exact against ``ref`` (the ``ec-cpu-extensions.t``
+oracle, reproduced by tests/test_codec.py).  Decode matrices are cached per
+surviving-fragment mask exactly like the reference's LRU of inverted
+matrices (ec-method.c:200-245).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from . import gf256
+
+BACKENDS = ("ref", "native", "xla", "xla-xor", "pallas-xor", "pallas-mxu")
+
+
+@functools.cache
+def _tpu_present() -> bool:
+    try:
+        import jax
+
+        return any(d.platform in ("tpu", "axon") for d in jax.devices())
+    except Exception:
+        return False
+
+
+@functools.cache
+def detect(requested: str = "auto") -> str:
+    """Resolve a requested backend name to an available one.
+
+    Mirrors ec_code_detect's fall-forward: an unavailable explicit request
+    raises (the reference logs + falls back; we prefer loud), ``auto`` walks
+    the ladder pallas-xor -> native -> xla.
+    """
+    if requested != "auto":
+        if requested not in BACKENDS:
+            raise ValueError(f"unknown backend {requested!r}; one of {BACKENDS}")
+        if requested == "native":
+            from glusterfs_tpu import native
+
+            if not native.available():
+                raise RuntimeError("native backend unavailable (no toolchain?)")
+        return requested
+    if _tpu_present():
+        return "pallas-xor"
+    from glusterfs_tpu import native
+
+    return "native" if native.available() else "xla"
+
+
+@functools.cache
+def _encode_bits(k: int, n: int) -> np.ndarray:
+    return gf256.expand_bitmatrix(gf256.encode_matrix(k, n))
+
+
+_decode_bits = gf256.decode_bits_cached
+
+
+class Codec:
+    """Erasure codec for a (k data + r redundancy) dispersal.
+
+    ``encode`` takes stripe-aligned bytes (length a multiple of
+    ``stripe_size = k*512``) and returns ``(n, len/k)`` fragments;
+    ``decode`` takes any k fragments + their indices and returns the bytes.
+    Padding/RMW of unaligned user I/O belongs to the EC layer above
+    (cluster/ec), not the codec — same split as ec-method.c vs
+    ec-inode-write.c in the reference.
+    """
+
+    def __init__(self, k: int, r: int, backend: str = "auto"):
+        if k < 1 or r < 0 or k > gf256.MAX_FRAGMENTS:
+            raise ValueError(f"bad k={k}, r={r} (k <= {gf256.MAX_FRAGMENTS})")
+        self.k = k
+        self.r = r
+        self.n = k + r
+        if self.n > 255:
+            raise ValueError("k + r must be <= 255")
+        self.fragment_chunk = gf256.CHUNK_SIZE
+        self.stripe_size = k * gf256.CHUNK_SIZE
+        self.backend = detect(backend)
+
+    # -- encode ------------------------------------------------------------
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
+        if data.size % self.stripe_size:
+            raise ValueError(
+                f"data length {data.size} not a multiple of stripe "
+                f"{self.stripe_size}")
+        b = self.backend
+        if b == "ref":
+            return gf256.ref_encode(data, self.k, self.n)
+        if b == "native":
+            from glusterfs_tpu import native
+
+            return native.encode(data, self.k, self.n,
+                                 _encode_bits(self.k, self.n))
+        if b == "xla":
+            from . import gf256_xla
+
+            return gf256_xla.encode(data, self.k, self.n, "matmul")
+        if b == "xla-xor":
+            from . import gf256_xla
+
+            return gf256_xla.encode(data, self.k, self.n, "xor")
+        from . import gf256_pallas
+
+        form = "xor" if b == "pallas-xor" else "mxu"
+        return gf256_pallas.encode(data, self.k, self.n, form)
+
+    # -- decode ------------------------------------------------------------
+
+    def decode(self, frags: np.ndarray, rows) -> np.ndarray:
+        """Reconstruct from the k fragments ``frags`` with indices ``rows``."""
+        rows = [int(x) for x in rows]
+        if len(rows) != self.k or len(set(rows)) != self.k:
+            raise ValueError(f"need {self.k} distinct fragment indices")
+        if any(x < 0 or x >= self.n for x in rows):
+            raise ValueError("fragment index out of range")
+        frags = np.ascontiguousarray(frags, dtype=np.uint8)
+        b = self.backend
+        if b == "ref":
+            return gf256.ref_decode(frags, rows, self.k)
+        if b == "native":
+            from glusterfs_tpu import native
+
+            return native.decode(frags, self.k,
+                                 _decode_bits(self.k, tuple(rows)))
+        if b in ("xla", "xla-xor"):
+            from . import gf256_xla
+
+            form = "xor" if b == "xla-xor" else "matmul"
+            return gf256_xla.decode(frags, rows, self.k, form)
+        from . import gf256_pallas
+
+        form = "xor" if b == "pallas-xor" else "mxu"
+        return gf256_pallas.decode(frags, rows, self.k, form)
+
+    # -- convenience -------------------------------------------------------
+
+    def pad_length(self, nbytes: int) -> int:
+        """Bytes after zero-padding up to a whole stripe (reference pads
+        the tail stripe with zeros, ec-inode-write.c)."""
+        s = self.stripe_size
+        return (nbytes + s - 1) // s * s
+
+    def encode_padded(self, data: np.ndarray) -> tuple[np.ndarray, int]:
+        """Zero-pad to a stripe multiple and encode; returns (frags, nbytes)."""
+        data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
+        orig = data.size
+        padded = self.pad_length(orig)
+        if padded != orig:
+            data = np.concatenate(
+                [data, np.zeros(padded - orig, dtype=np.uint8)])
+        return self.encode(data), orig
+
+    def decode_padded(self, frags: np.ndarray, rows, nbytes: int) -> np.ndarray:
+        """Decode and trim zero-padding back to ``nbytes``."""
+        return self.decode(frags, rows)[:nbytes]

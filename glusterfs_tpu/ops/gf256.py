@@ -159,6 +159,14 @@ def decode_matrix(k: int, rows: np.ndarray | list[int]) -> np.ndarray:
     return invert_matrix(sub)
 
 
+@functools.lru_cache(maxsize=256)
+def decode_bits_cached(k: int, rows: tuple[int, ...]) -> np.ndarray:
+    """Per-surviving-mask cached decode bit-matrix — the one LRU shared by
+    every backend (the reference keeps an equivalent LRU of inverted
+    matrices keyed by fragment bitmask, ec-method.c:200-245)."""
+    return expand_bitmatrix(decode_matrix(k, list(rows)))
+
+
 def expand_bitmatrix(coeff: np.ndarray) -> np.ndarray:
     """Expand an (R, C) GF(256) coefficient matrix into its (R*8, C*8) GF(2)
     bit-matrix: block (i, j) is BITMAT[coeff[i, j]].

@@ -35,6 +35,23 @@ from . import gf256
 # Lane tile for uint8 is (32, 128); keep W tiles big to amortize grid overhead.
 _TILE_W = 8192
 
+# VMEM working-set budget for the mxu kernel (the int32 matmul output
+# dominates at R rows x 8*tile int32); stay well under the ~16 MiB more
+# conservative TPU VMEM sizes.
+_MXU_VMEM_BUDGET = 8 << 20
+
+
+def _mxu_tile_w(r: int, c: int) -> int:
+    """Largest power-of-two tile (dividing _TILE_W) whose mxu working set
+    fits the VMEM budget: y (r, 8t) i32 + bits (c, 8t) i8 + x (c, t) i32."""
+    t = _TILE_W
+    while t > 512:
+        working = r * 8 * t * 4 + c * 8 * t + c * t * 4 + (r + c) * t
+        if working <= _MXU_VMEM_BUDGET:
+            break
+        t //= 2
+    return t
+
 
 def _xor_kernel_body(sels: tuple[tuple[int, ...], ...]):
     """Build a kernel computing out[r] = XOR of x[j] for j in sels[r]."""
@@ -108,20 +125,22 @@ def _xor_apply_fn(sels: tuple[tuple[int, ...], ...], c: int, interpret: bool):
 def _mxu_apply_fn(r: int, c: int, interpret: bool):
     """(R*8, C*8) bitmatrix (int8), (C*8, W) bytes -> (R*8, W) bytes."""
 
+    tile_w = _mxu_tile_w(r, c)
+
     @jax.jit
     def run(abits, x):
         w = x.shape[1]
-        grid = (w // _TILE_W,)
+        grid = (w // tile_w,)
         return pl.pallas_call(
             _mxu_kernel,
             out_shape=jax.ShapeDtypeStruct((r, w), jnp.uint8),
             grid=grid,
             in_specs=[
                 pl.BlockSpec((r, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((c, _TILE_W), lambda i: (0, i),
+                pl.BlockSpec((c, tile_w), lambda i: (0, i),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec((r, _TILE_W), lambda i: (0, i),
+            out_specs=pl.BlockSpec((r, tile_w), lambda i: (0, i),
                                    memory_space=pltpu.VMEM),
             interpret=interpret,
         )(abits, x)
@@ -143,6 +162,8 @@ def apply_bitmatrix(
 
     W must be a multiple of _TILE_W (callers pad stripes accordingly).
     """
+    if formulation not in ("xor", "mxu"):
+        raise ValueError(f"formulation must be 'xor' or 'mxu', got {formulation!r}")
     r, c = abits.shape
     if x.shape[0] != c:
         raise ValueError(f"plane rows {x.shape[0]} != bitmatrix columns {c}")
@@ -224,9 +245,9 @@ def encode(data, k: int, n: int, formulation: str = "xor",
 def decode(frags, rows, k: int, formulation: str = "xor",
            interpret: bool = False) -> np.ndarray:
     frags = np.ascontiguousarray(frags, dtype=np.uint8)
-    bbits_np = gf256.expand_bitmatrix(gf256.decode_matrix(k, rows))
+    bbits_np = gf256.decode_bits_cached(k, tuple(int(x) for x in rows))
     if formulation == "xor":
         fn = _decode_fn(k, "xor", interpret, tuple(map(tuple, bbits_np)))
         return np.asarray(fn(jnp.asarray(frags)))
-    fn = _decode_fn(k, "matmul", interpret, None)
+    fn = _decode_fn(k, "mxu", interpret, None)
     return np.asarray(fn(jnp.asarray(frags), jnp.asarray(bbits_np, jnp.int8)))

@@ -11,8 +11,11 @@ reference: xlators/cluster/ec/src/ec-method.c:393-433):
   selecting terms by the static bit-matrix (the literal analog of the
   reference's AVX XOR chains, traded for XLA fusion instead of hand JIT).
 
-Both are jitted per input shape; coefficient bit-matrices arrive as traced
-arguments so decode does not retrace per surviving-fragment mask.
+``matmul`` takes the coefficient bit-matrix as a traced argument, so decode
+does not retrace per surviving-fragment mask; ``xor`` bakes the matrix into
+the trace (one compile per mask, like the reference's per-matrix JIT).
+Decode matrices come from the shared per-mask LRU
+(gf256.decode_bits_cached).
 """
 
 from __future__ import annotations
@@ -126,7 +129,7 @@ def decode(
 ) -> np.ndarray:
     """Decode k fragments (k, S*512) with indices `rows` -> original bytes."""
     frags = np.ascontiguousarray(frags, dtype=np.uint8)
-    bbits_np = gf256.expand_bitmatrix(gf256.decode_matrix(k, rows))
+    bbits_np = gf256.decode_bits_cached(k, tuple(int(x) for x in rows))
     if formulation == "xor":
         fn = _decode_fn(k, "xor", tuple(map(tuple, bbits_np)))
         out = fn(jnp.asarray(frags), None)
