@@ -1,0 +1,294 @@
+"""Embeddable client API — the libgfapi analog.
+
+Reference: api/src/glfs.c (glfs_new/init/fini, glfs.c:835,1140) and the
+132 ``glfs_*`` calls in glfs.h.  A :class:`Client` wraps an activated
+layer graph and exposes file operations; :class:`SyncClient` is the
+synchronous facade (the reference's SYNCOP/ucontext machinery,
+syncop.c:263, becomes an event loop on a worker thread).
+
+Path resolution walks components through ``lookup`` with an inode/dentry
+cache (glfs-resolve.c analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import os
+import threading
+from typing import Any
+
+from ..core.fops import FopError
+from ..core.graph import Graph
+from ..core.iatt import Iatt, ROOT_GFID
+from ..core.inode import InodeTable
+from ..core.layer import FdObj, Loc
+
+
+def _norm(path: str) -> str:
+    if not path.startswith("/"):
+        path = "/" + path
+    out = os.path.normpath(path)
+    return "/" if out in (".", "//") else out
+
+
+def _split(path: str) -> tuple[str, str]:
+    path = _norm(path)
+    if path == "/":
+        return "", "/"
+    parent, name = path.rsplit("/", 1)
+    return (parent or "/"), name
+
+
+class File:
+    """An open file (glfs_fd_t analog)."""
+
+    def __init__(self, client: "Client", fd: FdObj, path: str):
+        self._client = client
+        self.fd = fd
+        self.path = path
+        self.closed = False
+
+    async def read(self, size: int, offset: int = 0) -> bytes:
+        return await self._client.graph.top.readv(self.fd, size, offset)
+
+    async def write(self, data: bytes, offset: int = 0) -> int:
+        await self._client.graph.top.writev(self.fd, bytes(data), offset)
+        return len(data)
+
+    async def fstat(self) -> Iatt:
+        return await self._client.graph.top.fstat(self.fd)
+
+    async def fsync(self, datasync: bool = False) -> None:
+        await self._client.graph.top.fsync(self.fd, int(datasync))
+
+    async def ftruncate(self, size: int) -> None:
+        await self._client.graph.top.ftruncate(self.fd, size)
+
+    async def fgetxattr(self, name: str | None = None):
+        return await self._client.graph.top.fgetxattr(self.fd, name)
+
+    async def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            await self._client.graph.top.flush(self.fd)
+            release = getattr(self._client.graph.top, "release", None)
+            if release is not None:
+                await release(self.fd)
+
+
+class Client:
+    """Async client over an activated graph (glfs_t analog)."""
+
+    def __init__(self, graph: Graph):
+        self.graph = graph
+        self.itable = InodeTable()
+        self.mounted = False
+
+    async def mount(self) -> None:
+        if not self.graph.active:
+            await self.graph.activate()
+        self.mounted = True
+
+    async def unmount(self) -> None:
+        if self.graph.active:
+            await self.graph.fini()
+        self.mounted = False
+
+    # -- resolution --------------------------------------------------------
+
+    async def resolve(self, path: str) -> Loc:
+        """Walk path components via lookup, populating the dentry cache."""
+        path = _norm(path)
+        parent_gfid = ROOT_GFID
+        if path == "/":
+            return Loc("/", gfid=ROOT_GFID, name="/")
+        comps = path.lstrip("/").split("/")
+        cur = ""
+        gfid = ROOT_GFID
+        for comp in comps:
+            parent_gfid = gfid
+            cur = f"{cur}/{comp}"
+            ino = self.itable.find_dentry(parent_gfid, comp)
+            if ino is not None:
+                gfid = ino.gfid
+                continue
+            ia, _ = await self.graph.top.lookup(Loc(cur, parent=parent_gfid))
+            self.itable.link(parent_gfid, comp, ia.gfid, ia.ia_type, ia)
+            gfid = ia.gfid
+        return Loc(path, gfid=gfid, parent=parent_gfid)
+
+    async def _parent_loc(self, path: str) -> Loc:
+        """Loc for a path that may not exist yet (parent must resolve)."""
+        parent, name = _split(path)
+        if not parent:
+            raise FopError(errno.EINVAL, "cannot operate on /")
+        ploc = await self.resolve(parent)
+        return Loc(_norm(path), parent=ploc.gfid, name=name)
+
+    # -- namespace ops -----------------------------------------------------
+
+    async def stat(self, path: str) -> Iatt:
+        loc = await self.resolve(path)
+        return await self.graph.top.stat(loc)
+
+    async def lookup(self, path: str) -> Iatt:
+        loc = await self._parent_loc(path) if path != "/" else Loc("/")
+        ia, _ = await self.graph.top.lookup(loc)
+        return ia
+
+    async def exists(self, path: str) -> bool:
+        try:
+            await self.resolve(path)
+            return True
+        except FopError as e:
+            if e.err in (errno.ENOENT, errno.ESTALE):
+                return False
+            raise
+
+    async def mkdir(self, path: str, mode: int = 0o755) -> Iatt:
+        loc = await self._parent_loc(path)
+        return await self.graph.top.mkdir(loc, mode)
+
+    async def unlink(self, path: str) -> None:
+        loc = await self.resolve(path)
+        await self.graph.top.unlink(loc)
+        self.itable.unlink(loc.parent, loc.name)
+
+    async def rmdir(self, path: str) -> None:
+        loc = await self.resolve(path)
+        await self.graph.top.rmdir(loc)
+        self.itable.unlink(loc.parent, loc.name)
+
+    async def rename(self, old: str, new: str) -> None:
+        oldloc = await self.resolve(old)
+        newloc = await self._parent_loc(new)
+        await self.graph.top.rename(oldloc, newloc)
+        self.itable.unlink(oldloc.parent, oldloc.name)
+
+    async def symlink(self, target: str, path: str) -> Iatt:
+        loc = await self._parent_loc(path)
+        return await self.graph.top.symlink(target, loc)
+
+    async def readlink(self, path: str) -> str:
+        loc = await self.resolve(path)
+        return await self.graph.top.readlink(loc)
+
+    async def link(self, old: str, new: str) -> Iatt:
+        oldloc = await self.resolve(old)
+        newloc = await self._parent_loc(new)
+        return await self.graph.top.link(oldloc, newloc)
+
+    async def listdir(self, path: str = "/") -> list[str]:
+        loc = await self.resolve(path)
+        fd = await self.graph.top.opendir(loc)
+        entries = await self.graph.top.readdir(fd, 0, 0)
+        return [name for name, _ in entries]
+
+    async def listdir_with_stat(self, path: str = "/"):
+        loc = await self.resolve(path)
+        fd = await self.graph.top.opendir(loc)
+        return await self.graph.top.readdirp(fd, 0, 0)
+
+    async def truncate(self, path: str, size: int) -> Iatt:
+        loc = await self.resolve(path)
+        return await self.graph.top.truncate(loc, size)
+
+    async def statvfs(self, path: str = "/") -> dict:
+        loc = await self.resolve(path)
+        return await self.graph.top.statfs(loc)
+
+    async def getxattr(self, path: str, name: str | None = None):
+        loc = await self.resolve(path)
+        return await self.graph.top.getxattr(loc, name)
+
+    async def setxattr(self, path: str, xattrs: dict) -> None:
+        loc = await self.resolve(path)
+        await self.graph.top.setxattr(loc, xattrs)
+
+    async def setattr(self, path: str, attrs: dict) -> Iatt:
+        loc = await self.resolve(path)
+        return await self.graph.top.setattr(loc, attrs)
+
+    # -- file ops ------------------------------------------------------------
+
+    async def create(self, path: str, flags: int = os.O_RDWR,
+                     mode: int = 0o644) -> File:
+        loc = await self._parent_loc(path)
+        fd, ia = await self.graph.top.create(loc, flags, mode)
+        self.itable.link(loc.parent, loc.name, ia.gfid, ia.ia_type, ia)
+        return File(self, fd, loc.path)
+
+    async def open(self, path: str, flags: int = os.O_RDWR) -> File:
+        loc = await self.resolve(path)
+        fd = await self.graph.top.open(loc, flags)
+        return File(self, fd, loc.path)
+
+    async def write_file(self, path: str, data: bytes) -> int:
+        """Convenience: create/overwrite a file with data."""
+        if await self.exists(path):
+            await self.truncate(path, 0)
+            f = await self.open(path)
+        else:
+            f = await self.create(path)
+        try:
+            return await f.write(data, 0)
+        finally:
+            await f.close()
+
+    async def read_file(self, path: str) -> bytes:
+        ia = await self.stat(path)
+        f = await self.open(path, os.O_RDONLY)
+        try:
+            return await f.read(ia.size, 0)
+        finally:
+            await f.close()
+
+    # -- introspection -------------------------------------------------------
+
+    def statedump(self) -> dict:
+        d = self.graph.statedump()
+        d["itable"] = self.itable.dump()
+        return d
+
+
+class SyncClient:
+    """Synchronous facade: runs the async client on a private loop thread
+    (the reference's syncop/synctask analog, syncop.c:263,602)."""
+
+    def __init__(self, graph: Graph):
+        self._client = Client(graph)
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self, coro) -> Any:
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result()
+
+    def __getattr__(self, name: str):
+        target = getattr(self._client, name)
+        if asyncio.iscoroutinefunction(target):
+            def call(*a, **kw):
+                result = self._run(target(*a, **kw))
+                return _SyncFile(self, result) if isinstance(result, File) \
+                    else result
+            return call
+        return target
+
+    def close(self) -> None:
+        self._run(self._client.unmount())
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+
+class _SyncFile:
+    def __init__(self, owner: SyncClient, f: File):
+        self._owner = owner
+        self._f = f
+
+    def __getattr__(self, name: str):
+        target = getattr(self._f, name)
+        if asyncio.iscoroutinefunction(target):
+            return lambda *a, **kw: self._owner._run(target(*a, **kw))
+        return target

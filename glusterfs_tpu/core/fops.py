@@ -1,0 +1,97 @@
+"""File-operation model: the framework's fop vocabulary.
+
+The reference defines 59 fops as an enum (reference
+libglusterfs/src/glusterfs/glusterfs-fops.h:17-76) and every xlator
+implements a subset of them via a fops vtable (xlator.h:545).  Here the
+vocabulary is the same, but the mechanism is idiomatic Python: each fop is
+an async method on :class:`glusterfs_tpu.core.layer.Layer`, winding is an
+``await`` into the child, unwinding is the return (or raised
+:class:`FopError`).
+"""
+
+from __future__ import annotations
+
+import enum
+import errno as _errno
+
+
+class Fop(enum.Enum):
+    """Fop vocabulary (reference glusterfs-fops.h:17-76, same set minus the
+    compound/getspec RPC-internal entries)."""
+
+    STAT = "stat"
+    READLINK = "readlink"
+    MKNOD = "mknod"
+    MKDIR = "mkdir"
+    UNLINK = "unlink"
+    RMDIR = "rmdir"
+    SYMLINK = "symlink"
+    RENAME = "rename"
+    LINK = "link"
+    TRUNCATE = "truncate"
+    OPEN = "open"
+    READV = "readv"
+    WRITEV = "writev"
+    STATFS = "statfs"
+    FLUSH = "flush"
+    FSYNC = "fsync"
+    SETXATTR = "setxattr"
+    GETXATTR = "getxattr"
+    REMOVEXATTR = "removexattr"
+    OPENDIR = "opendir"
+    FSYNCDIR = "fsyncdir"
+    ACCESS = "access"
+    CREATE = "create"
+    FTRUNCATE = "ftruncate"
+    FSTAT = "fstat"
+    LK = "lk"
+    LOOKUP = "lookup"
+    READDIR = "readdir"
+    INODELK = "inodelk"
+    FINODELK = "finodelk"
+    ENTRYLK = "entrylk"
+    FENTRYLK = "fentrylk"
+    XATTROP = "xattrop"
+    FXATTROP = "fxattrop"
+    FGETXATTR = "fgetxattr"
+    FSETXATTR = "fsetxattr"
+    RCHECKSUM = "rchecksum"
+    SETATTR = "setattr"
+    FSETATTR = "fsetattr"
+    READDIRP = "readdirp"
+    FREMOVEXATTR = "fremovexattr"
+    FALLOCATE = "fallocate"
+    DISCARD = "discard"
+    ZEROFILL = "zerofill"
+    IPC = "ipc"
+    SEEK = "seek"
+    LEASE = "lease"
+    GETACTIVELK = "getactivelk"
+    SETACTIVELK = "setactivelk"
+    PUT = "put"
+    ICREATE = "icreate"
+    NAMELINK = "namelink"
+    COPY_FILE_RANGE = "copy_file_range"
+
+
+#: Fops that modify data or metadata (drive version/dirty accounting in the
+#: EC/AFR transaction engines; reference ec-common.h fop classification).
+WRITE_FOPS = frozenset({
+    Fop.MKNOD, Fop.MKDIR, Fop.UNLINK, Fop.RMDIR, Fop.SYMLINK, Fop.RENAME,
+    Fop.LINK, Fop.TRUNCATE, Fop.WRITEV, Fop.SETXATTR, Fop.REMOVEXATTR,
+    Fop.CREATE, Fop.FTRUNCATE, Fop.XATTROP, Fop.FXATTROP, Fop.FSETXATTR,
+    Fop.SETATTR, Fop.FSETATTR, Fop.FREMOVEXATTR, Fop.FALLOCATE, Fop.DISCARD,
+    Fop.ZEROFILL, Fop.PUT, Fop.ICREATE, Fop.NAMELINK, Fop.COPY_FILE_RANGE,
+})
+
+
+class FopError(OSError):
+    """A fop failure carrying a POSIX errno (the reference's op_errno;
+    unwinding with op_ret=-1 maps to raising this)."""
+
+    def __init__(self, err: int, msg: str = ""):
+        super().__init__(err, msg or _errno.errorcode.get(err, str(err)))
+        self.err = err
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"FopError({_errno.errorcode.get(self.err, self.err)})"

@@ -34,12 +34,13 @@ def _build() -> str:
     so = os.path.join(_DIR, f"libgf256_{tag}.so")
     if os.path.exists(so):
         return so
+    tmp = f"{so}.{os.getpid()}.tmp"  # pid-unique: concurrent builds race
     cmd = [
         "g++", "-O3", "-mavx2", "-funroll-loops", "-fPIC", "-shared",
-        "-std=c++17", _SRC, "-o", so + ".tmp",
+        "-std=c++17", _SRC, "-o", tmp,
     ]
     subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(so + ".tmp", so)
+    os.replace(tmp, so)
     return so
 
 

@@ -1,0 +1,578 @@
+"""storage/posix — the brick: maps fops to a local filesystem directory.
+
+Reference: xlators/storage/posix (posix-inode-fd-ops.c:1999 posix_writev,
+posix-helpers.c:1352 GFID handle store).  Same responsibilities here:
+
+* every object gets a GFID at creation; the handle store
+  ``.glusterfs_tpu/gfid/<hex>`` maps GFID -> current relative path (the
+  reference uses a ``.glusterfs/xx/yy/gfid`` hardlink farm; a text pointer
+  is equivalent for a single-writer brick process and keeps heal/debug
+  simple).
+* xattrs (the version/dirty/size accounting written by EC/AFR) live in a
+  sidecar JSON per GFID under ``.glusterfs_tpu/xattr/`` — independent of
+  host-FS xattr support, atomically replaced on update.
+* ``xattrop`` implements the atomic read-modify-write arithmetic the
+  cluster layers' transactions depend on (reference posix xattrop).
+
+Fops run under the layer's asyncio context; filesystem calls are blocking
+but local (the io-threads analog can wrap this layer with a thread pool).
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import struct
+import zlib
+
+from ..core.fops import FopError
+from ..core.iatt import IAType, Iatt, ROOT_GFID, gfid_new
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+from ..core import gflog
+
+log = gflog.get_logger("posix")
+
+META_DIR = ".glusterfs_tpu"
+
+
+def _fop_errno(e: OSError) -> FopError:
+    return FopError(e.errno or errno.EIO, str(e))
+
+
+@register("storage/posix")
+class PosixLayer(Layer):
+    """Bottom-of-brick storage layer."""
+
+    OPTIONS = (
+        Option("directory", "path", description="brick root directory"),
+        Option("o-direct", "bool", default="off"),
+        Option("update-link-count-parent", "bool", default="off"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        root = self.opts.get("directory")
+        if not root:
+            raise ValueError(f"{self.name}: option directory is required")
+        self.root = os.path.abspath(root)
+        self._gfid_dir = os.path.join(self.root, META_DIR, "gfid")
+        self._xattr_dir = os.path.join(self.root, META_DIR, "xattr")
+
+    async def init(self):
+        os.makedirs(self.root, exist_ok=True)
+        os.makedirs(self._gfid_dir, exist_ok=True)
+        os.makedirs(self._xattr_dir, exist_ok=True)
+        # root of the brick always has the fixed ROOT_GFID
+        if not os.path.exists(self._gfid_path(ROOT_GFID)):
+            self._gfid_set(ROOT_GFID, "/")
+        await super().init()
+
+    # -- path / gfid helpers ----------------------------------------------
+
+    def _abs(self, path: str) -> str:
+        rel = path.lstrip("/")
+        if rel.split("/", 1)[0] == META_DIR:
+            raise FopError(errno.EPERM, "reserved namespace")
+        out = os.path.normpath(os.path.join(self.root, rel))
+        if not (out == self.root or out.startswith(self.root + os.sep)):
+            raise FopError(errno.EPERM, f"path escapes brick: {path}")
+        return out
+
+    def _gfid_path(self, gfid: bytes) -> str:
+        return os.path.join(self._gfid_dir, gfid.hex())
+
+    def _gfid_set(self, gfid: bytes, relpath: str) -> None:
+        tmp = self._gfid_path(gfid) + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(relpath)
+        os.replace(tmp, self._gfid_path(gfid))
+
+    def _gfid_resolve(self, gfid: bytes) -> str:
+        """GFID -> volume-relative path ('/a/b')."""
+        try:
+            with open(self._gfid_path(gfid)) as f:
+                return f.read()
+        except FileNotFoundError:
+            raise FopError(errno.ESTALE, f"no such gfid {gfid.hex()}") from None
+
+    def _gfid_del(self, gfid: bytes) -> None:
+        try:
+            os.unlink(self._gfid_path(gfid))
+        except FileNotFoundError:
+            pass
+        try:
+            os.unlink(os.path.join(self._xattr_dir, gfid.hex() + ".json"))
+        except FileNotFoundError:
+            pass
+
+    def _gfid_of(self, path: str) -> bytes | None:
+        """Read the per-object gfid marker (sidecar next to xattr store)."""
+        try:
+            st = os.lstat(self._abs(path))
+        except OSError as e:
+            raise _fop_errno(e)
+        key = f"{st.st_dev}:{st.st_ino}"
+        p = os.path.join(self._xattr_dir, "ino-" + key)
+        try:
+            with open(p, "rb") as f:
+                return f.read(16)
+        except FileNotFoundError:
+            return None
+
+    def _gfid_bind(self, path: str, gfid: bytes) -> None:
+        try:
+            st = os.lstat(self._abs(path))
+        except OSError as e:
+            raise _fop_errno(e)
+        key = f"{st.st_dev}:{st.st_ino}"
+        p = os.path.join(self._xattr_dir, "ino-" + key)
+        with open(p + ".tmp", "wb") as f:
+            f.write(gfid)
+        os.replace(p + ".tmp", p)
+        self._gfid_set(gfid, path if path.startswith("/") else "/" + path)
+
+    def _require_gfid(self, path: str) -> bytes:
+        g = self._gfid_of(path)
+        if g is None:  # legacy object: heal a fresh gfid (posix_gfid_set)
+            g = gfid_new() if path not in ("/", "") else ROOT_GFID
+            self._gfid_bind(path, g)
+        return g
+
+    def _loc_path(self, loc: Loc) -> str:
+        if loc.path:
+            return loc.path
+        if loc.gfid:
+            return self._gfid_resolve(loc.gfid)
+        raise FopError(errno.EINVAL, "loc has neither path nor gfid")
+
+    def _iatt(self, path: str, *, follow: bool = False) -> Iatt:
+        try:
+            st = os.stat(self._abs(path)) if follow else os.lstat(self._abs(path))
+        except OSError as e:
+            raise _fop_errno(e)
+        return Iatt.from_stat(st, self._require_gfid(path))
+
+    # -- xattr sidecar -----------------------------------------------------
+
+    def _xattr_path(self, gfid: bytes) -> str:
+        return os.path.join(self._xattr_dir, gfid.hex() + ".json")
+
+    def _xattr_load(self, gfid: bytes) -> dict[str, str]:
+        try:
+            with open(self._xattr_path(gfid)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {}
+
+    def _xattr_store(self, gfid: bytes, xattrs: dict[str, str]) -> None:
+        p = self._xattr_path(gfid)
+        with open(p + ".tmp", "w") as f:
+            json.dump(xattrs, f)
+        os.replace(p + ".tmp", p)
+
+    # -- namespace fops ----------------------------------------------------
+
+    async def lookup(self, loc: Loc, xdata: dict | None = None):
+        path = self._loc_path(loc)
+        ia = self._iatt(path)
+        return ia, {}
+
+    async def stat(self, loc: Loc, xdata: dict | None = None):
+        return self._iatt(self._loc_path(loc))
+
+    async def fstat(self, fd: FdObj, xdata: dict | None = None):
+        return self._iatt(self._gfid_resolve(fd.gfid))
+
+    async def mkdir(self, loc: Loc, mode: int = 0o755,
+                    xdata: dict | None = None):
+        path = self._loc_path(loc)
+        try:
+            os.mkdir(self._abs(path), mode)
+        except OSError as e:
+            raise _fop_errno(e)
+        gfid = (xdata or {}).get("gfid-req") or gfid_new()
+        self._gfid_bind(path, gfid)
+        return self._iatt(path)
+
+    async def mknod(self, loc: Loc, mode: int = 0o644, rdev: int = 0,
+                    xdata: dict | None = None):
+        path = self._loc_path(loc)
+        try:
+            # regular files only (block/char nodes are out of scope)
+            fdno = os.open(self._abs(path),
+                           os.O_CREAT | os.O_EXCL | os.O_WRONLY, mode)
+            os.close(fdno)
+        except OSError as e:
+            raise _fop_errno(e)
+        gfid = (xdata or {}).get("gfid-req") or gfid_new()
+        self._gfid_bind(path, gfid)
+        return self._iatt(path)
+
+    async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
+                     xdata: dict | None = None):
+        path = self._loc_path(loc)
+        try:
+            fdno = os.open(self._abs(path),
+                           flags | os.O_CREAT | os.O_RDWR, mode)
+        except OSError as e:
+            raise _fop_errno(e)
+        gfid = (xdata or {}).get("gfid-req") or gfid_new()
+        self._gfid_bind(path, gfid)
+        fd = FdObj(gfid, flags, path=path)
+        fd.ctx_set(self, fdno)
+        return fd, self._iatt(path)
+
+    async def symlink(self, target: str, loc: Loc, xdata: dict | None = None):
+        path = self._loc_path(loc)
+        try:
+            os.symlink(target, self._abs(path))
+        except OSError as e:
+            raise _fop_errno(e)
+        gfid = (xdata or {}).get("gfid-req") or gfid_new()
+        self._gfid_bind(path, gfid)
+        return self._iatt(path)
+
+    async def readlink(self, loc: Loc, xdata: dict | None = None):
+        try:
+            return os.readlink(self._abs(self._loc_path(loc)))
+        except OSError as e:
+            raise _fop_errno(e)
+
+    async def link(self, oldloc: Loc, newloc: Loc, xdata: dict | None = None):
+        oldp, newp = self._loc_path(oldloc), self._loc_path(newloc)
+        try:
+            os.link(self._abs(oldp), self._abs(newp))
+        except OSError as e:
+            raise _fop_errno(e)
+        return self._iatt(newp)
+
+    async def unlink(self, loc: Loc, xdata: dict | None = None):
+        path = self._loc_path(loc)
+        gfid = self._gfid_of(path)
+        try:
+            os.unlink(self._abs(path))
+        except OSError as e:
+            raise _fop_errno(e)
+        if gfid is not None:
+            self._gfid_del(gfid)
+        return {}
+
+    async def rmdir(self, loc: Loc, flags: int = 0, xdata: dict | None = None):
+        path = self._loc_path(loc)
+        gfid = self._gfid_of(path)
+        try:
+            os.rmdir(self._abs(path))
+        except OSError as e:
+            raise _fop_errno(e)
+        if gfid is not None:
+            self._gfid_del(gfid)
+        return {}
+
+    async def rename(self, oldloc: Loc, newloc: Loc, xdata: dict | None = None):
+        oldp, newp = self._loc_path(oldloc), self._loc_path(newloc)
+        gfid = self._gfid_of(oldp)
+        try:
+            os.replace(self._abs(oldp), self._abs(newp))
+        except OSError as e:
+            raise _fop_errno(e)
+        if gfid is not None:
+            self._gfid_set(gfid, newp if newp.startswith("/") else "/" + newp)
+        return self._iatt(newp)
+
+    # -- fd fops -----------------------------------------------------------
+
+    async def open(self, loc: Loc, flags: int = os.O_RDWR,
+                   xdata: dict | None = None):
+        path = self._loc_path(loc)
+        try:
+            fdno = os.open(self._abs(path), flags & ~os.O_CREAT)
+        except OSError as e:
+            raise _fop_errno(e)
+        fd = FdObj(self._require_gfid(path), flags, path=path)
+        fd.ctx_set(self, fdno)
+        return fd
+
+    async def opendir(self, loc: Loc, xdata: dict | None = None):
+        path = self._loc_path(loc)
+        if not os.path.isdir(self._abs(path)):
+            raise FopError(errno.ENOTDIR, path)
+        fd = FdObj(self._require_gfid(path), path=path)
+        fd.ctx_set(self, None)  # directory fds need no OS handle
+        return fd
+
+    def _os_fd(self, fd: FdObj) -> int:
+        fdno = fd.ctx_get(self)
+        if fdno is None:
+            # anonymous fd: open on demand (reference anonymous fds)
+            path = self._gfid_resolve(fd.gfid)
+            try:
+                fdno = os.open(self._abs(path), os.O_RDWR)
+            except OSError as e:
+                raise _fop_errno(e)
+            fd.ctx_set(self, fdno)
+        return fdno
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        try:
+            return os.pread(self._os_fd(fd), size, offset)
+        except OSError as e:
+            raise _fop_errno(e)
+
+    async def writev(self, fd: FdObj, data: bytes, offset: int,
+                     xdata: dict | None = None):
+        try:
+            os.pwrite(self._os_fd(fd), data, offset)
+        except OSError as e:
+            raise _fop_errno(e)
+        return self._iatt(self._gfid_resolve(fd.gfid))
+
+    async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
+        path = self._loc_path(loc)
+        try:
+            os.truncate(self._abs(path), size)
+        except OSError as e:
+            raise _fop_errno(e)
+        return self._iatt(path)
+
+    async def ftruncate(self, fd: FdObj, size: int, xdata: dict | None = None):
+        try:
+            os.ftruncate(self._os_fd(fd), size)
+        except OSError as e:
+            raise _fop_errno(e)
+        return self._iatt(self._gfid_resolve(fd.gfid))
+
+    async def flush(self, fd: FdObj, xdata: dict | None = None):
+        return {}
+
+    async def fsync(self, fd: FdObj, datasync: int = 0,
+                    xdata: dict | None = None):
+        try:
+            fdno = fd.ctx_get(self)
+            if fdno is not None:
+                if datasync:
+                    os.fdatasync(fdno)
+                else:
+                    os.fsync(fdno)
+        except OSError as e:
+            raise _fop_errno(e)
+        return {}
+
+    async def fsyncdir(self, fd: FdObj, datasync: int = 0,
+                       xdata: dict | None = None):
+        return {}
+
+    async def release(self, fd: FdObj) -> None:
+        """Close the OS handle (not a wire fop; called by fd tables)."""
+        fdno = fd.ctx_del(self)
+        if fdno is not None:
+            try:
+                os.close(fdno)
+            except OSError:
+                pass
+
+    async def readdir(self, fd: FdObj, size: int = 0, offset: int = 0,
+                      xdata: dict | None = None):
+        path = self._gfid_resolve(fd.gfid)
+        try:
+            names = sorted(os.listdir(self._abs(path)))
+        except OSError as e:
+            raise _fop_errno(e)
+        names = [n for n in names if n != META_DIR]
+        return [(n, None) for n in names[offset:]]
+
+    async def readdirp(self, fd: FdObj, size: int = 0, offset: int = 0,
+                       xdata: dict | None = None):
+        path = self._gfid_resolve(fd.gfid)
+        entries = await self.readdir(fd, size, offset, xdata)
+        out = []
+        for name, _ in entries:
+            child = path.rstrip("/") + "/" + name
+            try:
+                out.append((name, self._iatt(child)))
+            except FopError:
+                continue
+        return out
+
+    # -- attrs / xattrs ----------------------------------------------------
+
+    async def setattr(self, loc: Loc, attrs: dict, valid: int = 0,
+                      xdata: dict | None = None):
+        path = self._loc_path(loc)
+        ap = self._abs(path)
+        try:
+            if "mode" in attrs:
+                os.chmod(ap, attrs["mode"])
+            if "uid" in attrs or "gid" in attrs:
+                os.chown(ap, attrs.get("uid", -1), attrs.get("gid", -1))
+            if "atime" in attrs or "mtime" in attrs:
+                st = os.stat(ap)
+                os.utime(ap, (attrs.get("atime", st.st_atime),
+                              attrs.get("mtime", st.st_mtime)))
+        except OSError as e:
+            raise _fop_errno(e)
+        return self._iatt(path)
+
+    async def fsetattr(self, fd: FdObj, attrs: dict, valid: int = 0,
+                       xdata: dict | None = None):
+        return await self.setattr(Loc(self._gfid_resolve(fd.gfid)),
+                                  attrs, valid, xdata)
+
+    async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
+                       xdata: dict | None = None):
+        """Values are bytes on the wire (str accepted, stored utf-8)."""
+        gfid = self._require_gfid(self._loc_path(loc))
+        cur = self._xattr_load(gfid)
+        for k, v in xattrs.items():
+            cur[k] = (v if isinstance(v, bytes) else str(v).encode()).hex()
+        self._xattr_store(gfid, cur)
+        return {}
+
+    async def fsetxattr(self, fd: FdObj, xattrs: dict, flags: int = 0,
+                        xdata: dict | None = None):
+        return await self.setxattr(Loc("", gfid=fd.gfid), xattrs, flags, xdata)
+
+    async def getxattr(self, loc: Loc, name: str | None = None,
+                       xdata: dict | None = None):
+        """Returns {name: bytes}."""
+        gfid = self._require_gfid(self._loc_path(loc))
+        cur = self._xattr_load(gfid)
+        if name is None:
+            return {k: bytes.fromhex(v) for k, v in cur.items()}
+        if name not in cur:
+            raise FopError(errno.ENODATA, name)
+        return {name: bytes.fromhex(cur[name])}
+
+    async def fgetxattr(self, fd: FdObj, name: str | None = None,
+                        xdata: dict | None = None):
+        return await self.getxattr(Loc("", gfid=fd.gfid), name, xdata)
+
+    async def removexattr(self, loc: Loc, name: str,
+                          xdata: dict | None = None):
+        gfid = self._require_gfid(self._loc_path(loc))
+        cur = self._xattr_load(gfid)
+        if name not in cur:
+            raise FopError(errno.ENODATA, name)
+        del cur[name]
+        self._xattr_store(gfid, cur)
+        return {}
+
+    async def fremovexattr(self, fd: FdObj, name: str,
+                           xdata: dict | None = None):
+        return await self.removexattr(Loc("", gfid=fd.gfid), name, xdata)
+
+    async def xattrop(self, loc: Loc, op: str, xattrs: dict,
+                      xdata: dict | None = None):
+        """Atomic arithmetic on xattr values (reference posix xattrop):
+        op 'add64' adds int64s element-wise; 'set' stores.  Returns the
+        resulting values — the EC/AFR version counters ride on this."""
+        gfid = self._require_gfid(self._loc_path(loc))
+        cur = self._xattr_load(gfid)
+        out: dict[str, bytes] = {}
+        for key, val in xattrs.items():
+            if op == "add64":
+                old = bytes.fromhex(cur.get(key, "")) if key in cur else b""
+                n = max(len(old), len(val)) // 8
+                olds = list(struct.unpack(f">{n}q", old.ljust(n * 8, b"\0")))
+                adds = struct.unpack(f">{n}q", val.ljust(n * 8, b"\0"))
+                news = [a + b for a, b in zip(olds, adds)]
+                res = struct.pack(f">{n}q", *news)
+            elif op == "set":
+                res = val
+            else:
+                raise FopError(errno.EINVAL, f"xattrop op {op!r}")
+            cur[key] = res.hex()
+            out[key] = res
+        self._xattr_store(gfid, cur)
+        return out
+
+    async def fxattrop(self, fd: FdObj, op: str, xattrs: dict,
+                       xdata: dict | None = None):
+        return await self.xattrop(Loc("", gfid=fd.gfid), op, xattrs, xdata)
+
+    # -- misc --------------------------------------------------------------
+
+    async def access(self, loc: Loc, mask: int = 0, xdata: dict | None = None):
+        if not os.access(self._abs(self._loc_path(loc)), mask):
+            raise FopError(errno.EACCES, self._loc_path(loc))
+        return {}
+
+    async def statfs(self, loc: Loc, xdata: dict | None = None):
+        try:
+            sv = os.statvfs(self.root)
+        except OSError as e:
+            raise _fop_errno(e)
+        return {"bsize": sv.f_bsize, "blocks": sv.f_blocks,
+                "bfree": sv.f_bfree, "bavail": sv.f_bavail,
+                "files": sv.f_files, "ffree": sv.f_ffree}
+
+    async def seek(self, fd: FdObj, offset: int, what: str = "data",
+                   xdata: dict | None = None):
+        whence = os.SEEK_DATA if what == "data" else os.SEEK_HOLE
+        try:
+            return os.lseek(self._os_fd(fd), offset, whence)
+        except OSError as e:
+            raise _fop_errno(e)
+
+    async def fallocate(self, fd: FdObj, mode: int, offset: int, length: int,
+                        xdata: dict | None = None):
+        try:
+            os.posix_fallocate(self._os_fd(fd), offset, length)
+        except OSError as e:
+            raise _fop_errno(e)
+        return self._iatt(self._gfid_resolve(fd.gfid))
+
+    async def discard(self, fd: FdObj, offset: int, length: int,
+                      xdata: dict | None = None):
+        # punch a hole by zeroing (portable)
+        return await self.zerofill(fd, offset, length, xdata)
+
+    async def zerofill(self, fd: FdObj, offset: int, length: int,
+                       xdata: dict | None = None):
+        try:
+            os.pwrite(self._os_fd(fd), b"\0" * length, offset)
+        except OSError as e:
+            raise _fop_errno(e)
+        return self._iatt(self._gfid_resolve(fd.gfid))
+
+    async def rchecksum(self, fd: FdObj, offset: int, length: int,
+                        xdata: dict | None = None):
+        """(weak, strong) checksums of a byte range (reference
+        libglusterfs checksum.c rchecksum: adler32 weak + strong hash)."""
+        data = await self.readv(fd, length, offset)
+        import hashlib
+
+        return zlib.adler32(data), hashlib.md5(data).digest()
+
+    async def ipc(self, op: int = 0, xdata: dict | None = None):
+        return {}
+
+    async def icreate(self, loc: Loc, mode: int = 0o644,
+                      xdata: dict | None = None):
+        return await self.mknod(loc, mode, 0, xdata)
+
+    async def put(self, loc: Loc, data: bytes, flags: int = 0,
+                  mode: int = 0o644, xattrs: dict | None = None,
+                  xdata: dict | None = None):
+        fd, ia = await self.create(loc, flags, mode, xdata)
+        try:
+            await self.writev(fd, data, 0)
+            if xattrs:
+                await self.setxattr(loc, xattrs)
+            return self._iatt(self._loc_path(loc))
+        finally:
+            await self.release(fd)
+
+    async def copy_file_range(self, fd_in: FdObj, off_in: int, fd_out: FdObj,
+                              off_out: int, length: int,
+                              xdata: dict | None = None):
+        data = await self.readv(fd_in, length, off_in)
+        await self.writev(fd_out, data, off_out)
+        return len(data)
+
+    def dump_private(self) -> dict:
+        return {"root": self.root,
+                "gfids": len(os.listdir(self._gfid_dir))
+                if os.path.isdir(self._gfid_dir) else 0}

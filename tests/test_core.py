@@ -1,0 +1,166 @@
+"""Runtime spine unit tests: options, volfile DSL, graph lifecycle, layer
+stats, inode table (reference analogs: options.c validators, graph.y
+grammar, graph.c init order, xlator stats, inode.c)."""
+
+import asyncio
+
+import pytest
+
+from glusterfs_tpu.core import graph as graph_mod
+from glusterfs_tpu.core.fops import Fop, FopError
+from glusterfs_tpu.core.iatt import IAType, ROOT_GFID, gfid_new
+from glusterfs_tpu.core.inode import InodeTable
+from glusterfs_tpu.core.layer import Event, Layer, register
+from glusterfs_tpu.core.options import (Option, OptionError, parse_bool,
+                                        parse_size, parse_time,
+                                        validate_options)
+
+
+# -- options ---------------------------------------------------------------
+
+def test_option_parsing():
+    assert parse_bool("on") and parse_bool("TRUE") and not parse_bool("off")
+    with pytest.raises(OptionError):
+        parse_bool("maybe")
+    assert parse_size("64KB") == 65536
+    assert parse_size("1M") == 1 << 20
+    assert parse_size(512) == 512
+    assert parse_time("500ms") == 0.5
+    assert parse_time("2min") == 120.0
+
+
+def test_option_table_validation():
+    table = (
+        Option("redundancy", "int", default=2, min=1, max=3),
+        Option("cpu-extensions", "enum", default="auto",
+               values=("auto", "ref", "tpu")),
+        Option("cache-size", "size", default="32MB"),
+    )
+    out = validate_options(table, {"redundancy": "3"})
+    assert out["redundancy"] == 3
+    assert out["cache-size"] == 32 << 20
+    with pytest.raises(OptionError):
+        validate_options(table, {"redundancy": "9"})
+    with pytest.raises(OptionError):
+        validate_options(table, {"cpu-extensions": "avx"})
+    with pytest.raises(OptionError):
+        validate_options(table, {"bogus": 1}, strict=True)
+
+
+# -- volfile ---------------------------------------------------------------
+
+VOLFILE = """
+# client graph for test volume
+volume test-posix
+    type storage/posix
+    option directory {d}
+end-volume
+
+volume test-top
+    type debug/passthrough
+    subvolumes test-posix
+end-volume
+"""
+
+
+@register("debug/passthrough")
+class Passthrough(Layer):
+    """No-op layer for graph tests."""
+
+
+def test_volfile_parse_roundtrip():
+    specs = graph_mod.parse_volfile(VOLFILE.format(d="/tmp/x"))
+    assert [s.name for s in specs] == ["test-posix", "test-top"]
+    assert specs[0].type_name == "storage/posix"
+    assert specs[0].options["directory"] == "/tmp/x"
+    assert specs[1].subvolumes == ["test-posix"]
+    text = graph_mod.emit_volfile(specs)
+    again = graph_mod.parse_volfile(text)
+    assert again == specs
+
+
+def test_volfile_errors():
+    with pytest.raises(graph_mod.VolfileError):
+        graph_mod.parse_volfile("volume a\ntype t\n")  # missing end-volume
+    with pytest.raises(graph_mod.VolfileError):
+        graph_mod.parse_volfile("type x\n")  # outside block
+    with pytest.raises(graph_mod.VolfileError):
+        graph_mod.Graph.construct(
+            "volume a\ntype debug/passthrough\nsubvolumes nope\nend-volume\n")
+
+
+def test_graph_construct_and_lifecycle(tmp_path):
+    g = graph_mod.Graph.construct(VOLFILE.format(d=tmp_path / "brick"))
+    assert g.top.name == "test-top"
+    assert g.by_name["test-posix"].children == []
+    asyncio.run(g.activate())
+    assert g.active
+    assert all(l.initialized for l in g.by_name.values())
+    d = g.statedump()
+    assert d["top"] == "test-top"
+    assert d["layers"]["test-posix"]["type"] == "storage/posix"
+    asyncio.run(g.fini())
+    assert not g.active
+
+
+def test_layer_default_passthrough_and_stats(tmp_path):
+    g = graph_mod.Graph.construct(VOLFILE.format(d=tmp_path / "brick"))
+    asyncio.run(g.activate())
+    from glusterfs_tpu.core.layer import Loc
+
+    ia, _ = asyncio.run(g.top.lookup(Loc("/")))
+    assert ia.gfid == ROOT_GFID
+    # default passthrough recorded stats on both layers
+    assert g.top.stats["lookup"].count == 1
+    assert g.by_name["test-posix"].stats["lookup"].count == 1
+    with pytest.raises(FopError):
+        asyncio.run(g.top.lookup(Loc("/missing")))
+    assert g.top.stats["lookup"].errors == 1
+
+
+def test_notify_propagates_up(tmp_path):
+    events = []
+
+    @register("debug/event-sink")
+    class Sink(Layer):
+        def notify(self, event, source=None, data=None):
+            events.append((event, source.name if source else None))
+
+    vf = VOLFILE.format(d=tmp_path / "brick") + """
+volume sink
+    type debug/event-sink
+    subvolumes test-top
+end-volume
+"""
+    g = graph_mod.Graph.construct(vf)
+    g.by_name["test-posix"].notify(Event.CHILD_DOWN)
+    # each hop re-sources the event: the sink hears it from its child
+    assert (Event.CHILD_DOWN, "test-top") in events
+
+
+# -- inode table -----------------------------------------------------------
+
+def test_inode_table():
+    t = InodeTable(lru_limit=2)
+    g1, g2, g3 = gfid_new(), gfid_new(), gfid_new()
+    t.link(ROOT_GFID, "a", g1, IAType.REG)
+    t.link(ROOT_GFID, "b", g2, IAType.DIR)
+    assert t.find_dentry(ROOT_GFID, "a").gfid == g1
+    assert t.get(g2).is_dir()
+    # forget drops to LRU; over-limit purges oldest
+    t.link(ROOT_GFID, "c", g3, IAType.REG)
+    for g in (g1, g2, g3):
+        t.forget(g)
+    assert t.get(g1) is None  # evicted (lru_limit=2)
+    assert t.get(g3) is not None
+    t.unlink(ROOT_GFID, "b")
+    assert t.find_dentry(ROOT_GFID, "b") is None
+    # root never purged
+    t.invalidate(ROOT_GFID)
+    assert t.root is t.get(ROOT_GFID)
+
+
+def test_fop_enum_complete():
+    # the reference's 59-fop vocabulary minus RPC-internal entries
+    assert len(Fop) >= 50
+    assert Fop.WRITEV.value == "writev"

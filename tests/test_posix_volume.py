@@ -1,0 +1,117 @@
+"""End-to-end single-brick volume: client API over storage/posix — the
+minimum vertical slice (SURVEY.md §7 phase 0.4).  Mirrors the style of the
+reference's tests/basic/ `.t` flow: create volume, mount, fop matrix,
+introspect (reference tests/basic/ec/ec.t:27-60 fop matrix idea)."""
+
+import asyncio
+import os
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client, SyncClient
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+
+VOLFILE = """
+volume brick0
+    type storage/posix
+    option directory {d}
+end-volume
+"""
+
+
+@pytest.fixture
+def client(tmp_path):
+    c = SyncClient(Graph.construct(VOLFILE.format(d=tmp_path / "brick0")))
+    c.mount()
+    yield c
+    c.close()
+
+
+def test_file_roundtrip(client):
+    f = client.create("/hello.txt")
+    assert f.write(b"hello tpu world", 0) == 15
+    f.close()
+    assert client.read_file("/hello.txt") == b"hello tpu world"
+    ia = client.stat("/hello.txt")
+    assert ia.size == 15
+    assert not ia.is_dir()
+
+
+def test_fop_matrix(client):
+    # mkdir / nested create / listdir / rename / link / symlink / unlink
+    client.mkdir("/d1")
+    client.mkdir("/d1/d2")
+    client.write_file("/d1/d2/f", b"x" * 1000)
+    assert client.listdir("/d1") == ["d2"]
+    assert client.listdir("/d1/d2") == ["f"]
+    client.rename("/d1/d2/f", "/d1/f2")
+    assert client.read_file("/d1/f2") == b"x" * 1000
+    client.link("/d1/f2", "/d1/hard")
+    assert client.stat("/d1/hard").size == 1000
+    client.symlink("f2", "/d1/sym")
+    assert client.readlink("/d1/sym") == "f2"
+    client.truncate("/d1/f2", 10)
+    assert client.stat("/d1/f2").size == 10
+    client.unlink("/d1/hard")
+    client.unlink("/d1/sym")
+    client.unlink("/d1/f2")
+    client.rmdir("/d1/d2")
+    client.rmdir("/d1")
+    assert client.listdir("/") == []
+
+
+def test_xattr_and_xattrop(client):
+    client.write_file("/f", b"data")
+    client.setxattr("/f", {"user.color": "blue"})
+    assert client.getxattr("/f", "user.color") == {"user.color": b"blue"}
+    with pytest.raises(FopError):
+        client.getxattr("/f", "user.nope")
+
+
+def test_overwrite_and_partial_io(client):
+    client.write_file("/f", b"A" * 100)
+    f = client.open("/f")
+    f.write(b"BB", 50)
+    assert f.read(4, 49) == b"ABBA"
+    f.close()
+    ia = client.stat("/f")
+    assert ia.size == 100
+
+
+def test_errors(client):
+    with pytest.raises(FopError):
+        client.stat("/nope")
+    with pytest.raises(FopError):
+        client.open("/nope")
+    client.mkdir("/d")
+    with pytest.raises(FopError):
+        client.mkdir("/d")  # EEXIST
+
+
+def test_statedump_introspection(client):
+    client.write_file("/f", b"hi")
+    d = client.statedump()
+    assert d["layers"]["brick0"]["type"] == "storage/posix"
+    assert d["layers"]["brick0"]["stats"]["writev"]["count"] >= 1
+    assert d["itable"]["inodes"] >= 1
+
+
+def test_statvfs(client):
+    sv = client.statvfs("/")
+    assert sv["bsize"] > 0 and sv["blocks"] > 0
+
+
+def test_async_client(tmp_path):
+    async def run():
+        g = Graph.construct(VOLFILE.format(d=tmp_path / "b"))
+        c = Client(g)
+        await c.mount()
+        f = await c.create("/a")
+        await f.write(b"abc", 0)
+        await f.close()
+        out = await c.read_file("/a")
+        await c.unmount()
+        return out
+
+    assert asyncio.run(run()) == b"abc"
