@@ -54,12 +54,14 @@ def time_it(fn, warmup: int = 2, iters: int = 5) -> float:
     return (time.perf_counter() - t0) / iters
 
 
-def device_loop_seconds(apply_fn, x, iters: int) -> float:
+def device_loop_seconds(apply_fn, x, iters: int = 51) -> float:
     """Per-iteration device time of apply_fn, with fixed dispatch/transfer
     overhead cancelled: chain `iters` dependent applications inside one jit
     (fori_loop), fetch a scalar, and take the delta vs a 1-iteration run.
-    Needed because the TPU tunnel has O(100ms) per-call overhead that would
-    otherwise swamp kernel time."""
+    Needed because the TPU tunnel has O(100ms) per-call overhead (with
+    ~ms-level variance — hence the high iteration count) that would
+    otherwise swamp kernel time.  The accumulator folds a FULL reduction
+    of the output so XLA cannot dead-code-eliminate any stage."""
     import jax
     import jax.numpy as jnp
 
@@ -68,8 +70,10 @@ def device_loop_seconds(apply_fn, x, iters: int) -> float:
         def body(i, carry):
             x, acc = carry
             y = apply_fn(x)
-            acc = acc ^ y[0, 0].astype(jnp.int32) ^ i
-            x = x ^ y[0, :1]  # cheap data dependency: no loop hoisting
+            s = jnp.sum(y, dtype=jnp.int32)  # consume everything
+            acc = acc ^ s ^ i
+            xf = x.reshape(-1)
+            x = (xf ^ (s & 1).astype(xf.dtype)).reshape(x.shape)
             return (x, acc)
 
         _, acc = jax.lax.fori_loop(0, n, body, (x, jnp.int32(0)))
@@ -107,12 +111,12 @@ def main() -> None:
 
     # --- TPU path: device-resident batches -------------------------------
     if on_tpu:
-        enc_fn = gf256_pallas._encode_fn(K, N, "xor", False)
+        enc_fn = gf256_pallas._encode_fn(K, N, "xor3", False)
     else:
         enc_fn = gf256_xla._encode_fn(K, N, "matmul")
     ddata = jnp.asarray(data)
     frags_dev = jax.block_until_ready(enc_fn(ddata))
-    enc_t = device_loop_seconds(enc_fn, ddata, 11)
+    enc_t = device_loop_seconds(enc_fn, ddata)
     enc_mibs = DATA_BYTES / MIB / enc_t
 
     frags_np = np.asarray(frags_dev)
@@ -122,7 +126,7 @@ def main() -> None:
     surv = jnp.asarray(frags_np[rows])
     bbits = gf256.decode_bits_cached(K, tuple(rows))
     if on_tpu:
-        dec_fn = gf256_pallas._decode_fn(K, "xor", False,
+        dec_fn = gf256_pallas._decode_fn(K, "xor3", False,
                                          tuple(map(tuple, bbits)))
     else:
         raw = gf256_xla._decode_fn(K, "matmul", None)
@@ -130,9 +134,7 @@ def main() -> None:
         dec_fn = lambda s: raw(s, bbits_d)
     out_np = np.asarray(dec_fn(surv))
     assert np.array_equal(out_np, data), "decode parity failure"
-    # decode output is 1-D; wrap for the loop's y[0, :1] indexing
-    dec2 = lambda s: dec_fn(s).reshape(1, -1)
-    dec_t = device_loop_seconds(dec2, surv, 11)
+    dec_t = device_loop_seconds(dec_fn, surv)
     dec_mibs = DATA_BYTES / MIB / dec_t
 
     # --- AVX baseline ----------------------------------------------------

@@ -1,0 +1,588 @@
+"""cluster/replicate — synchronous N-way replication (AFR).
+
+Reference: xlators/cluster/afr (30k LoC).  Behaviors kept:
+
+* **Transactions** (afr-transaction.c:1087,629): pre-op mark dirty, wind
+  the write to every up child, post-op bump the committed version on the
+  children that succeeded — divergence marks heal candidates.  The
+  reference's per-peer pending-xattr matrix collapses to per-brick
+  (version, dirty) counters, which identify staleness the same way the
+  EC layer's do (shared transaction skeleton, SURVEY.md §7 phase 3).
+* **Quorum** (afr quorum-type auto): writes need a majority (or the
+  configured ``quorum-count``); reads need one up-to-date child.
+* **Read transactions** (afr-read-txn.c:94-229): reads pick one
+  consistent child per ``read-hash-mode`` and fail over to another on
+  error.
+* **Self-heal** (afr-self-heal-data.c): full-file copy from a good child
+  to stale ones under lock, then counter realignment; entry heal
+  reconciles directory listings.
+
+Xattr schema per brick: ``trusted.afr.version`` (2 u64: data, metadata),
+``trusted.afr.dirty`` (2 u64) — same codec as the EC layer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import struct
+from collections import Counter
+
+from ..core.fops import FopError
+from ..core.iatt import IAType, Iatt, gfid_new
+from ..core.layer import Event, FdObj, Layer, Loc, register
+from ..core.options import Option
+from ..core import gflog
+
+log = gflog.get_logger("afr")
+
+XA_VERSION = "trusted.afr.version"
+XA_DIRTY = "trusted.afr.dirty"
+
+
+def _u64x2(data: bytes | None) -> tuple[int, int]:
+    if not data:
+        return (0, 0)
+    return struct.unpack(">QQ", data.ljust(16, b"\0")[:16])
+
+
+def _pack_u64x2(a: int, b: int) -> bytes:
+    return struct.pack(">QQ", a, b)
+
+
+class AfrFdCtx:
+    __slots__ = ("child_fds", "flags")
+
+    def __init__(self, child_fds: dict[int, FdObj], flags: int):
+        self.child_fds = child_fds
+        self.flags = flags
+
+
+@register("cluster/replicate")
+class ReplicateLayer(Layer):
+    OPTIONS = (
+        Option("quorum-count", "int", default=0, min=0,
+               description="0 = auto (majority)"),
+        Option("read-hash-mode", "enum", default="gfid-hash",
+               values=("first-up", "gfid-hash", "round-robin")),
+        Option("self-heal-window-size", "size", default="1M"),
+        Option("favorite-child", "int", default=-1, min=-1,
+               description="split-brain resolution source (-1 = none)"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.n = len(self.children)
+        if self.n < 2:
+            raise ValueError(f"{self.name}: replicate needs >= 2 children")
+        self.up = [True] * self.n
+        self._locks: dict[bytes, asyncio.Lock] = {}
+        self._rr = 0
+        self._lk_owner = gfid_new()
+        self._locks_supported: bool | None = None
+
+    # -- membership --------------------------------------------------------
+
+    def notify(self, event: Event, source=None, data=None):
+        if source in self.children:
+            idx = self.children.index(source)
+            if event is Event.CHILD_DOWN:
+                self.up[idx] = False
+            elif event is Event.CHILD_UP:
+                self.up[idx] = True
+            ev = Event.CHILD_UP if sum(self.up) >= self._quorum() else \
+                Event.CHILD_DOWN
+            for p in self.parents:
+                p.notify(ev, self, data)
+            return
+        super().notify(event, source, data)
+
+    def set_child_up(self, idx: int, up: bool) -> None:
+        self.up[idx] = up
+
+    def _up_idx(self) -> list[int]:
+        return [i for i, u in enumerate(self.up) if u]
+
+    def _quorum(self) -> int:
+        q = self.opts["quorum-count"]
+        return q if q else self.n // 2 + 1
+
+    def _lock(self, key: bytes) -> asyncio.Lock:
+        lk = self._locks.get(key)
+        if lk is None:
+            lk = self._locks[key] = asyncio.Lock()
+        return lk
+
+    # -- dispatch / combine ------------------------------------------------
+
+    async def _dispatch(self, idxs, op: str, argfn):
+        async def one(i):
+            args, kwargs = argfn(i)
+            return await getattr(self.children[i], op)(*args, **kwargs)
+
+        results = await asyncio.gather(*(one(i) for i in idxs),
+                                       return_exceptions=True)
+        return dict(zip(idxs, results))
+
+    def _combine(self, res: dict, min_ok: int | None = None):
+        min_ok = self._quorum() if min_ok is None else min_ok
+        good = {i: r for i, r in res.items()
+                if not isinstance(r, BaseException)}
+        if len(good) >= min_ok:
+            return good
+        errs = [r.err for r in res.values() if isinstance(r, FopError)]
+        if errs:
+            raise FopError(Counter(errs).most_common(1)[0][0],
+                           f"{len(good)}/{len(res)} children succeeded")
+        for r in res.values():
+            if isinstance(r, BaseException):
+                raise r
+        raise FopError(errno.EIO, "quorum failure")
+
+    async def _get_meta(self, idxs, loc: Loc):
+        res = await self._dispatch(idxs, "getxattr",
+                                   lambda i: ((loc, None), {}))
+        out = {}
+        for i, r in res.items():
+            if isinstance(r, BaseException):
+                out[i] = r
+            else:
+                out[i] = {"version": _u64x2(r.get(XA_VERSION)),
+                          "dirty": _u64x2(r.get(XA_DIRTY))}
+        return out
+
+    async def _good_rows(self, loc: Loc) -> list[int]:
+        """Up children with the quorum-best version (clean preferred)."""
+        ups = self._up_idx()
+        meta = await self._get_meta(ups, loc)
+        vals = {i: m for i, m in meta.items()
+                if not isinstance(m, BaseException)}
+        if not vals:
+            raise FopError(errno.ENOTCONN, "no readable children")
+        clean = {i: m for i, m in vals.items() if m["dirty"] == (0, 0)}
+        pool = clean or vals
+        best = max(m["version"] for m in pool.values())
+        return [i for i, m in pool.items() if m["version"] == best]
+
+    def _read_child(self, candidates: list[int], gfid: bytes) -> int:
+        mode = self.opts["read-hash-mode"]
+        if not candidates:
+            raise FopError(errno.ENOTCONN, "no consistent child")
+        if mode == "first-up":
+            return candidates[0]
+        if mode == "gfid-hash":
+            return candidates[int.from_bytes(gfid[-4:], "big")
+                              % len(candidates)]
+        self._rr = (self._rr + 1) % len(candidates)
+        return candidates[self._rr]
+
+    # -- transaction locks (same skeleton as EC) ---------------------------
+
+    async def _inodelk_wind(self, loc: Loc, ltype: str) -> list[int]:
+        if self._locks_supported is False:
+            return []
+        xd = {"lk-owner": self._lk_owner}
+        locked: list[int] = []
+        try:
+            for i in self._up_idx():
+                try:
+                    await self.children[i].inodelk(
+                        "afr.transaction", loc, "lock", ltype, 0, -1, xd)
+                    locked.append(i)
+                except FopError as e:
+                    if e.err == errno.EOPNOTSUPP:
+                        continue
+                    raise
+        except FopError:
+            await self._inodelk_unwind(loc, locked)
+            raise
+        if self._locks_supported is None:
+            self._locks_supported = bool(locked)
+        return locked
+
+    async def _inodelk_unwind(self, loc: Loc, locked: list[int]) -> None:
+        xd = {"lk-owner": self._lk_owner}
+        for i in locked:
+            try:
+                await self.children[i].inodelk(
+                    "afr.transaction", loc, "unlock", "wr", 0, -1, xd)
+            except FopError:
+                pass
+
+    class _Txn:
+        def __init__(self, afr: "ReplicateLayer", loc: Loc, gfid: bytes,
+                     ltype: str = "wr"):
+            self.afr = afr
+            self.loc = loc
+            self.gfid = gfid
+            self.ltype = ltype
+            self.locked: list[int] = []
+            self.local = ltype == "wr" or afr._locks_supported is False
+
+        async def __aenter__(self):
+            if self.local:
+                await self.afr._lock(self.gfid).acquire()
+            try:
+                self.locked = await self.afr._inodelk_wind(self.loc,
+                                                           self.ltype)
+            except BaseException:
+                if self.local:
+                    self.afr._lock(self.gfid).release()
+                raise
+            if not self.locked and not self.local:
+                self.local = True
+                await self.afr._lock(self.gfid).acquire()
+            return self
+
+        async def __aexit__(self, *exc):
+            await self.afr._inodelk_unwind(self.loc, self.locked)
+            if self.local:
+                self.afr._lock(self.gfid).release()
+            return False
+
+    # -- namespace fops ----------------------------------------------------
+
+    async def _all(self, op: str, *args, **kw):
+        res = await self._dispatch(self._up_idx(), op, lambda i: (args, kw))
+        good = self._combine(res)
+        return next(iter(good.values()))
+
+    async def lookup(self, loc: Loc, xdata: dict | None = None):
+        res = await self._dispatch(self._up_idx(), "lookup",
+                                   lambda i: ((loc, xdata), {}))
+        good = self._combine(res, min_ok=1)
+        return next(iter(good.values()))
+
+    async def stat(self, loc: Loc, xdata: dict | None = None):
+        rows = await self._good_rows(loc)
+        return await self.children[rows[0]].stat(loc, xdata)
+
+    async def fstat(self, fd: FdObj, xdata: dict | None = None):
+        return await self.stat(Loc(fd.path, gfid=fd.gfid), xdata)
+
+    async def mkdir(self, loc: Loc, mode: int = 0o755,
+                    xdata: dict | None = None):
+        xdata = dict(xdata or {})
+        xdata.setdefault("gfid-req", gfid_new())
+        return await self._all("mkdir", loc, mode, xdata)
+
+    async def mknod(self, loc: Loc, mode: int = 0o644, rdev: int = 0,
+                    xdata: dict | None = None):
+        xdata = dict(xdata or {})
+        xdata.setdefault("gfid-req", gfid_new())
+        return await self._all("mknod", loc, mode, rdev, xdata)
+
+    async def symlink(self, target: str, loc: Loc, xdata: dict | None = None):
+        xdata = dict(xdata or {})
+        xdata.setdefault("gfid-req", gfid_new())
+        return await self._all("symlink", target, loc, xdata)
+
+    async def unlink(self, loc: Loc, xdata: dict | None = None):
+        return await self._all("unlink", loc, xdata)
+
+    async def rmdir(self, loc: Loc, flags: int = 0,
+                    xdata: dict | None = None):
+        return await self._all("rmdir", loc, flags, xdata)
+
+    async def rename(self, oldloc: Loc, newloc: Loc,
+                     xdata: dict | None = None):
+        return await self._all("rename", oldloc, newloc, xdata)
+
+    async def link(self, oldloc: Loc, newloc: Loc,
+                   xdata: dict | None = None):
+        return await self._all("link", oldloc, newloc, xdata)
+
+    async def readlink(self, loc: Loc, xdata: dict | None = None):
+        rows = await self._good_rows(loc)
+        return await self.children[rows[0]].readlink(loc, xdata)
+
+    async def setattr(self, loc: Loc, attrs: dict, valid: int = 0,
+                      xdata: dict | None = None):
+        return await self._all("setattr", loc, attrs, valid, xdata)
+
+    async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
+                       xdata: dict | None = None):
+        if any(k.startswith("trusted.afr.") for k in xattrs):
+            raise FopError(errno.EPERM, "reserved xattr namespace")
+        return await self._all("setxattr", loc, xattrs, flags, xdata)
+
+    async def getxattr(self, loc: Loc, name: str | None = None,
+                       xdata: dict | None = None):
+        rows = await self._good_rows(loc)
+        out = await self.children[rows[0]].getxattr(loc, name, xdata)
+        return {k: v for k, v in out.items()
+                if not k.startswith("trusted.afr.")} if name is None else out
+
+    async def removexattr(self, loc: Loc, name: str,
+                          xdata: dict | None = None):
+        if name.startswith("trusted.afr."):
+            raise FopError(errno.EPERM, "reserved xattr namespace")
+        return await self._all("removexattr", loc, name, xdata)
+
+    async def statfs(self, loc: Loc, xdata: dict | None = None):
+        res = await self._dispatch(self._up_idx(), "statfs",
+                                   lambda i: ((loc, xdata), {}))
+        good = self._combine(res, min_ok=1)
+        return min(good.values(), key=lambda s: s["bavail"] * s["bsize"])
+
+    async def opendir(self, loc: Loc, xdata: dict | None = None):
+        res = await self._dispatch(self._up_idx(), "opendir",
+                                   lambda i: ((loc, xdata), {}))
+        good = self._combine(res, min_ok=1)
+        fd = FdObj(next(iter(good.values())).gfid, path=loc.path)
+        fd.ctx_set(self, AfrFdCtx(dict(good), 0))
+        return fd
+
+    def _child_fd(self, fd: FdObj, i: int) -> FdObj:
+        ctx: AfrFdCtx | None = fd.ctx_get(self)
+        if ctx is None or ctx.child_fds.get(i) is None:
+            return FdObj(fd.gfid, fd.flags, path=fd.path, anonymous=True)
+        return ctx.child_fds[i]
+
+    async def readdir(self, fd: FdObj, size: int = 0, offset: int = 0,
+                      xdata: dict | None = None):
+        for i in self._up_idx():
+            try:
+                return await self.children[i].readdir(
+                    self._child_fd(fd, i), size, offset, xdata)
+            except FopError:
+                continue
+        raise FopError(errno.ENOTCONN, "no child for readdir")
+
+    async def readdirp(self, fd: FdObj, size: int = 0, offset: int = 0,
+                       xdata: dict | None = None):
+        for i in self._up_idx():
+            try:
+                return await self.children[i].readdirp(
+                    self._child_fd(fd, i), size, offset, xdata)
+            except FopError:
+                continue
+        raise FopError(errno.ENOTCONN, "no child for readdirp")
+
+    # -- open / create -----------------------------------------------------
+
+    async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
+                     xdata: dict | None = None):
+        xdata = dict(xdata or {})
+        xdata.setdefault("gfid-req", gfid_new())
+        res = await self._dispatch(self._up_idx(), "create",
+                                   lambda i: ((loc, flags, mode, xdata), {}))
+        good = self._combine(res)
+        child_fds = {i: r[0] for i, r in good.items()}
+        ia = next(iter(good.values()))[1]
+        zero = {XA_VERSION: _pack_u64x2(0, 0), XA_DIRTY: _pack_u64x2(0, 0)}
+        await self._dispatch(list(good), "setxattr",
+                             lambda i: ((loc, dict(zero)), {}))
+        fd = FdObj(ia.gfid, flags, path=loc.path)
+        fd.ctx_set(self, AfrFdCtx(child_fds, flags))
+        return fd, ia
+
+    async def open(self, loc: Loc, flags: int = 0, xdata: dict | None = None):
+        res = await self._dispatch(self._up_idx(), "open",
+                                   lambda i: ((loc, flags), {}))
+        good = self._combine(res, min_ok=1)
+        fd = FdObj(next(iter(good.values())).gfid, flags, path=loc.path)
+        fd.ctx_set(self, AfrFdCtx(dict(good), flags))
+        return fd
+
+    async def flush(self, fd: FdObj, xdata: dict | None = None):
+        await self._dispatch(self._up_idx(), "flush",
+                             lambda i: ((self._child_fd(fd, i),), {}))
+        return {}
+
+    async def fsync(self, fd: FdObj, datasync: int = 0,
+                    xdata: dict | None = None):
+        res = await self._dispatch(
+            self._up_idx(), "fsync",
+            lambda i: ((self._child_fd(fd, i), datasync), {}))
+        self._combine(res)
+        return {}
+
+    async def release(self, fd: FdObj):
+        ctx: AfrFdCtx | None = fd.ctx_del(self)
+        if ctx:
+            for i, cfd in ctx.child_fds.items():
+                rel = getattr(self.children[i], "release", None)
+                if rel:
+                    try:
+                        await rel(cfd)
+                    except Exception:
+                        pass
+
+    # -- data path ---------------------------------------------------------
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        loc = Loc(fd.path, gfid=fd.gfid)
+        candidates = await self._good_rows(loc)
+        last: FopError | None = None
+        for _ in range(len(candidates)):
+            i = self._read_child(candidates, fd.gfid)
+            try:
+                return await self.children[i].readv(
+                    self._child_fd(fd, i), size, offset, xdata)
+            except FopError as e:
+                last = e
+                candidates = [c for c in candidates if c != i]
+                if not candidates:
+                    break
+        raise last or FopError(errno.ENOTCONN, "read failed")
+
+    async def writev(self, fd: FdObj, data: bytes, offset: int,
+                     xdata: dict | None = None):
+        loc = Loc(fd.path, gfid=fd.gfid)
+        async with self._Txn(self, loc, fd.gfid, "wr"):
+            idxs = self._up_idx()
+            await self._dispatch(
+                idxs, "xattrop",
+                lambda i: ((loc, "add64",
+                            {XA_DIRTY: _pack_u64x2(1, 0)}), {}))
+            res = await self._dispatch(
+                idxs, "writev",
+                lambda i: ((self._child_fd(fd, i), data, offset), {}))
+            good = [i for i, r in res.items()
+                    if not isinstance(r, BaseException)]
+            if len(good) < self._quorum():
+                raise FopError(errno.EIO,
+                               f"write quorum lost ({len(good)}/{self.n})")
+            await self._dispatch(
+                good, "xattrop",
+                lambda i: ((loc, "add64", {
+                    XA_VERSION: _pack_u64x2(1, 0),
+                    XA_DIRTY: _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0),
+                }), {}))
+            return next(r for i, r in res.items() if i in good)
+
+    async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
+        ia, _ = await self.lookup(loc)
+        async with self._Txn(self, loc, ia.gfid, "wr"):
+            idxs = self._up_idx()
+            await self._dispatch(
+                idxs, "xattrop",
+                lambda i: ((loc, "add64",
+                            {XA_DIRTY: _pack_u64x2(1, 0)}), {}))
+            res = await self._dispatch(idxs, "truncate",
+                                       lambda i: ((loc, size, xdata), {}))
+            good = [i for i, r in res.items()
+                    if not isinstance(r, BaseException)]
+            if len(good) < self._quorum():
+                raise FopError(errno.EIO, "truncate quorum lost")
+            await self._dispatch(
+                good, "xattrop",
+                lambda i: ((loc, "add64", {
+                    XA_VERSION: _pack_u64x2(1, 0),
+                    XA_DIRTY: _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0),
+                }), {}))
+            return next(r for i, r in res.items() if i in good)
+
+    async def ftruncate(self, fd: FdObj, size: int,
+                        xdata: dict | None = None):
+        return await self.truncate(Loc(fd.path, gfid=fd.gfid), size, xdata)
+
+    # -- heal --------------------------------------------------------------
+
+    async def heal_info(self, loc: Loc) -> dict:
+        meta = await self._get_meta(list(range(self.n)), loc)
+        versions = {}
+        for i, m in meta.items():
+            versions[i] = None if isinstance(m, BaseException) else \
+                (m["version"], m["dirty"])
+        ok = {i: v for i, v in versions.items() if v is not None}
+        if not ok:
+            raise FopError(errno.ENOTCONN, "no bricks reachable")
+        clean = {i: v for i, v in ok.items() if v[1] == (0, 0)}
+        pool = clean or ok
+        best = max(v[0] for v in pool.values())
+        good = [i for i, v in pool.items() if v[0] == best]
+        bad = [i for i in range(self.n) if i not in good]
+        return {"good": good, "bad": bad, "version": best,
+                "per_brick": versions}
+
+    async def heal_file(self, path: str) -> dict:
+        loc = Loc(path)
+        info = await self.heal_info(loc)
+        good, bad = info["good"], info["bad"]
+        if not good:
+            raise FopError(errno.EIO, "no heal source")
+        if not bad:
+            return {"healed": [], "skipped": True}
+        fav = self.opts["favorite-child"]
+        src = fav if fav in good else good[0]
+        ia, _ = await self.lookup(loc)
+        async with self._Txn(self, loc, ia.gfid, "wr"):
+            src_ia = await self.children[src].stat(loc)
+            # ensure file exists on bad bricks
+            for i in bad:
+                try:
+                    await self.children[i].lookup(loc)
+                except FopError:
+                    try:
+                        await self.children[i].mknod(
+                            loc, src_ia.mode, 0, {"gfid-req": ia.gfid})
+                    except FopError:
+                        continue
+            window = int(self.opts["self-heal-window-size"])
+            sfd = FdObj(ia.gfid, path=path, anonymous=True)
+            off = 0
+            while off < src_ia.size:
+                chunk = await self.children[src].readv(
+                    sfd, min(window, src_ia.size - off), off)
+                await self._dispatch(
+                    bad, "writev",
+                    lambda i: ((FdObj(ia.gfid, path=path, anonymous=True),
+                                chunk, off), {}))
+                off += len(chunk)
+            await self._dispatch(bad, "truncate",
+                                 lambda i: ((loc, src_ia.size), {}))
+            meta = await self._get_meta([src], loc)
+            fix = {XA_VERSION: _pack_u64x2(*meta[src]["version"]),
+                   XA_DIRTY: _pack_u64x2(0, 0)}
+            await self._dispatch(bad, "setxattr",
+                                 lambda i: ((loc, dict(fix)), {}))
+            await self._dispatch(good, "setxattr", lambda i: (
+                (loc, {XA_DIRTY: _pack_u64x2(0, 0)}), {}))
+            return {"healed": bad, "skipped": False, "source": src}
+
+    async def heal_entry(self, path: str = "/") -> dict:
+        """Directory entry heal: union the listings, copy missing entries
+        from any brick that has them (afr-self-heal-entry.c)."""
+        loc = Loc(path)
+        listings: dict[int, set[str]] = {}
+        for i in self._up_idx():
+            try:
+                fd = await self.children[i].opendir(loc)
+                names = await self.children[i].readdir(fd)
+                listings[i] = {n for n, _ in names}
+            except FopError:
+                continue
+        union: set[str] = set().union(*listings.values()) if listings else set()
+        created = []
+        for name in union:
+            child_path = path.rstrip("/") + "/" + name
+            have = [i for i, names in listings.items() if name in names]
+            missing = [i for i in listings if name not in listings[i]]
+            if not missing:
+                continue
+            src = have[0]
+            src_ia = await self.children[src].stat(Loc(child_path))
+            for i in missing:
+                try:
+                    if src_ia.ia_type is IAType.DIR:
+                        await self.children[i].mkdir(
+                            Loc(child_path), src_ia.mode,
+                            {"gfid-req": src_ia.gfid})
+                    else:
+                        await self.children[i].mknod(
+                            Loc(child_path), src_ia.mode, 0,
+                            {"gfid-req": src_ia.gfid})
+                    created.append((i, name))
+                except FopError:
+                    continue
+            if src_ia.ia_type is not IAType.DIR:
+                await self.heal_file(child_path)
+        return {"created": created}
+
+    def dump_private(self) -> dict:
+        return {"replicas": self.n, "up": self.up,
+                "quorum": self._quorum(),
+                "read_hash_mode": self.opts["read-hash-mode"]}

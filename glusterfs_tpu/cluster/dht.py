@@ -1,0 +1,456 @@
+"""cluster/distribute — consistent-hash distribution of the namespace (DHT).
+
+Reference: xlators/cluster/dht (34k LoC).  Behaviors kept:
+
+* **Placement** (dht-hashfn.c:72, dht-layout.c:20-94): a file lives on the
+  subvolume whose hash range covers ``hash(basename)``; directories exist
+  on every subvolume.  The reference persists per-directory range maps in
+  ``trusted.glusterfs.dht``; this build derives an even split of the
+  32-bit hash space over the child list (layout regeneration on
+  add/remove-brick is rebalance's job, as there).
+* **Linkto files** (dht-linkfile.c:95): after rename/rebalance, a file
+  whose data lives off its hashed subvolume leaves a zero-byte pointer
+  file there carrying ``trusted.glusterfs.dht.linkto = <real subvol>``;
+  lookup follows it.
+* **Global lookup** (dht fan-out lookup): hashed-subvol miss falls back
+  to an everywhere-lookup, healing the linkto.
+* **Rebalance** (dht-rebalance.c:39 dht_migrate_file): walk files, move
+  data to the currently-hashed subvolume, drop linktos.
+
+The hash is a Davies-Meyer-style 32-bit construction over the basename
+(same family as the reference's gf_dm_hashfn; exact bit-compat is not
+required since layouts are never exchanged with the reference).
+"""
+
+from __future__ import annotations
+
+import errno
+from collections import Counter
+
+from ..core.fops import FopError
+from ..core.iatt import IAType, gfid_new
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+from ..core import gflog
+
+log = gflog.get_logger("dht")
+
+XA_LINKTO = "trusted.glusterfs.dht.linkto"
+
+
+def dm_hash(name: str) -> int:
+    """Davies-Meyer-style 32-bit hash over the basename."""
+    h = 0x9747B28C
+    for b in name.encode():
+        # one DM round: encrypt h with byte-derived key, xor back in
+        k = (b * 0x01000193) & 0xFFFFFFFF
+        e = (h ^ k) & 0xFFFFFFFF
+        e = (e * 0x85EBCA6B + 0xC2B2AE35) & 0xFFFFFFFF
+        e ^= e >> 13
+        h = (h ^ e) & 0xFFFFFFFF
+    h ^= h >> 16
+    h = (h * 0x7FEB352D) & 0xFFFFFFFF
+    h ^= h >> 15
+    return h
+
+
+class DhtFdCtx:
+    __slots__ = ("idx", "child_fd")
+
+    def __init__(self, idx: int, child_fd: FdObj):
+        self.idx = idx
+        self.child_fd = child_fd
+
+
+@register("cluster/distribute")
+class DistributeLayer(Layer):
+    OPTIONS = (
+        Option("lookup-unhashed", "bool", default="on",
+               description="fan-out lookup on hashed-subvol miss"),
+        Option("min-free-disk", "percent", default=10.0),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.n = len(self.children)
+        if self.n < 1:
+            raise ValueError(f"{self.name}: needs >= 1 child")
+
+    # -- placement ---------------------------------------------------------
+
+    def hashed_idx(self, name: str) -> int:
+        """Even split of the 2^32 hash space over children
+        (dht_layout_t ranges)."""
+        span = (1 << 32) // self.n
+        return min(dm_hash(name) // span, self.n - 1)
+
+    def _hashed(self, loc: Loc) -> int:
+        return self.hashed_idx(loc.name or loc.path.rsplit("/", 1)[-1])
+
+    async def _cached_idx(self, loc: Loc) -> int:
+        """Subvol actually holding the file: hashed, linkto target, or
+        global-lookup result (dht cached-subvol resolution)."""
+        hi = self._hashed(loc)
+        try:
+            ia, _ = await self.children[hi].lookup(loc)
+            if ia.ia_type is IAType.DIR:
+                return hi
+            link = await self._linkto(hi, loc)
+            if link is not None:
+                return link
+            return hi
+        except FopError as e:
+            if e.err not in (errno.ENOENT, errno.ESTALE):
+                raise
+        if not self.opts["lookup-unhashed"]:
+            raise FopError(errno.ENOENT, loc.path)
+        for i in range(self.n):  # everywhere-lookup
+            if i == hi:
+                continue
+            try:
+                await self.children[i].lookup(loc)
+                return i
+            except FopError:
+                continue
+        raise FopError(errno.ENOENT, loc.path)
+
+    async def _linkto(self, idx: int, loc: Loc) -> int | None:
+        try:
+            out = await self.children[idx].getxattr(loc, XA_LINKTO)
+        except FopError:
+            return None
+        target = out[XA_LINKTO].decode()
+        for i, c in enumerate(self.children):
+            if c.name == target:
+                return i
+        return None
+
+    # -- namespace fops ----------------------------------------------------
+
+    async def lookup(self, loc: Loc, xdata: dict | None = None):
+        idx = await self._cached_idx(loc)
+        return await self.children[idx].lookup(loc, xdata)
+
+    async def stat(self, loc: Loc, xdata: dict | None = None):
+        idx = await self._cached_idx(loc)
+        return await self.children[idx].stat(loc, xdata)
+
+    async def fstat(self, fd: FdObj, xdata: dict | None = None):
+        ctx: DhtFdCtx = fd.ctx_get(self)
+        if ctx is None:
+            return await self.stat(Loc(fd.path, gfid=fd.gfid), xdata)
+        return await self.children[ctx.idx].fstat(ctx.child_fd, xdata)
+
+    async def mkdir(self, loc: Loc, mode: int = 0o755,
+                    xdata: dict | None = None):
+        xdata = dict(xdata or {})
+        xdata.setdefault("gfid-req", gfid_new())
+        results = []
+        errs = []
+        for i in range(self.n):  # directories live everywhere
+            try:
+                results.append(await self.children[i].mkdir(loc, mode, xdata))
+            except FopError as e:
+                errs.append(e)
+        if not results:
+            raise errs[0]
+        return results[0]
+
+    async def rmdir(self, loc: Loc, flags: int = 0,
+                    xdata: dict | None = None):
+        last = None
+        ok = 0
+        for i in range(self.n):
+            try:
+                await self.children[i].rmdir(loc, flags, xdata)
+                ok += 1
+            except FopError as e:
+                if e.err != errno.ENOENT:
+                    last = e
+        if ok == 0 and last:
+            raise last
+        return {}
+
+    async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
+                     xdata: dict | None = None):
+        idx = self._hashed(loc)
+        fd_c, ia = await self.children[idx].create(loc, flags, mode, xdata)
+        fd = FdObj(ia.gfid, flags, path=loc.path)
+        fd.ctx_set(self, DhtFdCtx(idx, fd_c))
+        return fd, ia
+
+    async def open(self, loc: Loc, flags: int = 0, xdata: dict | None = None):
+        idx = await self._cached_idx(loc)
+        fd_c = await self.children[idx].open(loc, flags, xdata)
+        fd = FdObj(fd_c.gfid, flags, path=loc.path)
+        fd.ctx_set(self, DhtFdCtx(idx, fd_c))
+        return fd
+
+    async def mknod(self, loc: Loc, mode: int = 0o644, rdev: int = 0,
+                    xdata: dict | None = None):
+        return await self.children[self._hashed(loc)].mknod(
+            loc, mode, rdev, xdata)
+
+    async def symlink(self, target: str, loc: Loc, xdata: dict | None = None):
+        return await self.children[self._hashed(loc)].symlink(
+            target, loc, xdata)
+
+    async def readlink(self, loc: Loc, xdata: dict | None = None):
+        idx = await self._cached_idx(loc)
+        return await self.children[idx].readlink(loc, xdata)
+
+    async def unlink(self, loc: Loc, xdata: dict | None = None):
+        idx = await self._cached_idx(loc)
+        hi = self._hashed(loc)
+        if idx != hi:  # drop the linkto too
+            try:
+                await self.children[hi].unlink(loc, xdata)
+            except FopError:
+                pass
+        return await self.children[idx].unlink(loc, xdata)
+
+    async def link(self, oldloc: Loc, newloc: Loc, xdata: dict | None = None):
+        idx = await self._cached_idx(oldloc)
+        return await self.children[idx].link(oldloc, newloc, xdata)
+
+    async def rename(self, oldloc: Loc, newloc: Loc,
+                     xdata: dict | None = None):
+        src = await self._cached_idx(oldloc)
+        ia, _ = await self.children[src].lookup(oldloc)
+        if ia.ia_type is IAType.DIR:  # dirs: rename everywhere
+            out = None
+            for i in range(self.n):
+                try:
+                    out = await self.children[i].rename(oldloc, newloc, xdata)
+                except FopError:
+                    pass
+            if out is None:
+                raise FopError(errno.EIO, "dir rename failed everywhere")
+            return out
+        dst_hashed = self._hashed(newloc)
+        out = await self.children[src].rename(oldloc, newloc, xdata)
+        if dst_hashed != src:
+            # data stayed on src subvol: leave a linkto pointer at the
+            # dst-hashed subvol (dht-linkfile.c:95)
+            await self._make_linkto(dst_hashed, newloc, src, ia.gfid)
+        # stale linkto at old hashed location?
+        old_hashed = self._hashed(oldloc)
+        if old_hashed != src:
+            try:
+                await self.children[old_hashed].unlink(oldloc)
+            except FopError:
+                pass
+        return out
+
+    async def _make_linkto(self, idx: int, loc: Loc, target: int,
+                           gfid: bytes) -> None:
+        try:
+            await self.children[idx].mknod(loc, 0o1000, 0,
+                                           {"gfid-req": gfid})
+        except FopError as e:
+            if e.err != errno.EEXIST:
+                raise
+        await self.children[idx].setxattr(
+            loc, {XA_LINKTO: self.children[target].name.encode()})
+
+    # -- data fops (forward to cached subvol) ------------------------------
+
+    def _fd_target(self, fd: FdObj) -> tuple[int, FdObj]:
+        ctx: DhtFdCtx | None = fd.ctx_get(self)
+        if ctx is None:
+            raise FopError(errno.EBADF, "dht: unknown fd")
+        return ctx.idx, ctx.child_fd
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        i, cfd = self._fd_target(fd)
+        return await self.children[i].readv(cfd, size, offset, xdata)
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        i, cfd = self._fd_target(fd)
+        return await self.children[i].writev(cfd, data, offset, xdata)
+
+    async def flush(self, fd: FdObj, xdata: dict | None = None):
+        i, cfd = self._fd_target(fd)
+        return await self.children[i].flush(cfd, xdata)
+
+    async def fsync(self, fd: FdObj, datasync: int = 0,
+                    xdata: dict | None = None):
+        i, cfd = self._fd_target(fd)
+        return await self.children[i].fsync(cfd, datasync, xdata)
+
+    async def ftruncate(self, fd: FdObj, size: int,
+                        xdata: dict | None = None):
+        i, cfd = self._fd_target(fd)
+        return await self.children[i].ftruncate(cfd, size, xdata)
+
+    async def release(self, fd: FdObj):
+        ctx: DhtFdCtx | None = fd.ctx_del(self)
+        if ctx:
+            rel = getattr(self.children[ctx.idx], "release", None)
+            if rel:
+                await rel(ctx.child_fd)
+
+    async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
+        idx = await self._cached_idx(loc)
+        return await self.children[idx].truncate(loc, size, xdata)
+
+    async def setattr(self, loc: Loc, attrs: dict, valid: int = 0,
+                      xdata: dict | None = None):
+        idx = await self._cached_idx(loc)
+        ia, _ = await self.children[idx].lookup(loc)
+        if ia.ia_type is IAType.DIR:
+            out = None
+            for i in range(self.n):
+                try:
+                    out = await self.children[i].setattr(loc, attrs, valid,
+                                                         xdata)
+                except FopError:
+                    pass
+            return out
+        return await self.children[idx].setattr(loc, attrs, valid, xdata)
+
+    async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
+                       xdata: dict | None = None):
+        idx = await self._cached_idx(loc)
+        return await self.children[idx].setxattr(loc, xattrs, flags, xdata)
+
+    async def getxattr(self, loc: Loc, name: str | None = None,
+                       xdata: dict | None = None):
+        idx = await self._cached_idx(loc)
+        out = await self.children[idx].getxattr(loc, name, xdata)
+        if name is None:
+            out.pop(XA_LINKTO, None)
+        return out
+
+    async def removexattr(self, loc: Loc, name: str,
+                          xdata: dict | None = None):
+        idx = await self._cached_idx(loc)
+        return await self.children[idx].removexattr(loc, name, xdata)
+
+    async def statfs(self, loc: Loc, xdata: dict | None = None):
+        """Aggregate capacity across subvols (dht sums them)."""
+        out = None
+        for i in range(self.n):
+            try:
+                sv = await self.children[i].statfs(loc, xdata)
+            except FopError:
+                continue
+            if out is None:
+                out = dict(sv)
+            else:
+                for k in ("blocks", "bfree", "bavail", "files", "ffree"):
+                    out[k] += sv[k]
+        if out is None:
+            raise FopError(errno.ENOTCONN, "no children for statfs")
+        return out
+
+    # -- directory reads: merge all subvols --------------------------------
+
+    async def opendir(self, loc: Loc, xdata: dict | None = None):
+        fds = {}
+        gfid = None
+        for i in range(self.n):
+            try:
+                cfd = await self.children[i].opendir(loc, xdata)
+                fds[i] = cfd
+                gfid = gfid or cfd.gfid
+            except FopError:
+                continue
+        if not fds:
+            raise FopError(errno.ENOENT, loc.path)
+        fd = FdObj(gfid, path=loc.path)
+        fd.ctx_set(self, fds)
+        return fd
+
+    async def readdir(self, fd: FdObj, size: int = 0, offset: int = 0,
+                      xdata: dict | None = None):
+        fds: dict = fd.ctx_get(self) or {}
+        seen: set[str] = set()
+        out = []
+        for i, cfd in fds.items():
+            try:
+                entries = await self.children[i].readdir(cfd, size, 0, xdata)
+            except FopError:
+                continue
+            for name, ia in entries:
+                if name in seen:
+                    continue
+                # hide linkto pointer files
+                if await self._is_linkto(i, fd.path, name):
+                    continue
+                seen.add(name)
+                out.append((name, ia))
+        out.sort(key=lambda e: e[0])
+        return out[offset:]
+
+    async def _is_linkto(self, idx: int, dirpath: str, name: str) -> bool:
+        child = dirpath.rstrip("/") + "/" + name
+        try:
+            await self.children[idx].getxattr(Loc(child), XA_LINKTO)
+            return True
+        except FopError:
+            return False
+
+    async def readdirp(self, fd: FdObj, size: int = 0, offset: int = 0,
+                       xdata: dict | None = None):
+        entries = await self.readdir(fd, size, offset, xdata)
+        out = []
+        for name, ia in entries:
+            if ia is None:
+                try:
+                    ia = await self.stat(
+                        Loc(fd.path.rstrip("/") + "/" + name))
+                except FopError:
+                    pass
+            out.append((name, ia))
+        return out
+
+    # -- rebalance (dht-rebalance.c dht_migrate_file) ----------------------
+
+    async def rebalance(self, path: str = "/") -> dict:
+        """Move every misplaced file to its hashed subvolume."""
+        moved, scanned = [], 0
+        loc = Loc(path)
+        fd = await self.opendir(loc)
+        entries = await self.readdir(fd)
+        for name, _ in entries:
+            child = path.rstrip("/") + "/" + name
+            cloc = Loc(child)
+            idx = await self._cached_idx(cloc)
+            ia, _ = await self.children[idx].lookup(cloc)
+            if ia.ia_type is IAType.DIR:
+                sub = await self.rebalance(child)
+                moved.extend(sub["moved"])
+                scanned += sub["scanned"]
+                continue
+            scanned += 1
+            hi = self._hashed(cloc)
+            if hi == idx:
+                continue
+            # migrate: copy data + xattrs, then swap
+            src_fd = await self.children[idx].open(cloc, 2)
+            data = await self.children[idx].readv(src_fd, ia.size, 0)
+            xattrs = await self.children[idx].getxattr(cloc)
+            try:
+                await self.children[hi].unlink(cloc)  # stale linkto
+            except FopError:
+                pass
+            dfd, _ = await self.children[hi].create(
+                cloc, 0, ia.mode, {"gfid-req": ia.gfid})
+            if data:
+                await self.children[hi].writev(dfd, data, 0)
+            clean = {k: v for k, v in xattrs.items() if k != XA_LINKTO}
+            if clean:
+                await self.children[hi].setxattr(cloc, clean)
+            await self.children[idx].unlink(cloc)
+            moved.append((child, idx, hi))
+        return {"moved": moved, "scanned": scanned}
+
+    def dump_private(self) -> dict:
+        return {"subvolumes": self.n,
+                "layout": [{"subvol": c.name,
+                            "range": [i * ((1 << 32) // self.n),
+                                      (i + 1) * ((1 << 32) // self.n) - 1]}
+                           for i, c in enumerate(self.children)]}

@@ -103,6 +103,10 @@ class DisperseLayer(Layer):
         self.up = [True] * self.n  # xl_up bitmask (ec.c:571 notify)
         self._locks: dict[bytes, asyncio.Lock] = {}
         self._rr = 0  # read-policy round-robin cursor
+        from ..core.iatt import gfid_new as _g
+
+        self._lk_owner = _g()  # this client's lk-owner identity
+        self._locks_supported: bool | None = None  # lazily probed
 
     # -- child state -------------------------------------------------------
 
@@ -141,6 +145,77 @@ class DisperseLayer(Layer):
         if lk is None:
             lk = self._locks[key] = asyncio.Lock()
         return lk
+
+    # -- cluster-wide transaction locks (ec-locks.c / ec_lock analog) ------
+
+    async def _inodelk_wind(self, loc: Loc, ltype: str) -> list[int]:
+        """Take an inodelk on every up child (brick-side features/locks);
+        children without a locks layer (EOPNOTSUPP) are skipped.  Locks
+        are wound in index order — all clients use the same order, so
+        cross-client deadlock cannot occur (ec-locks.c ordering)."""
+        if self._locks_supported is False:
+            return []
+        xd = {"lk-owner": self._lk_owner}
+        locked: list[int] = []
+        try:
+            for i in self._up_idx():
+                try:
+                    await self.children[i].inodelk(
+                        "ec.transaction", loc, "lock", ltype, 0, -1, xd)
+                    locked.append(i)
+                except FopError as e:
+                    if e.err == errno.EOPNOTSUPP:
+                        continue
+                    raise
+        except FopError:
+            await self._inodelk_unwind(loc, locked)
+            raise
+        if self._locks_supported is None:
+            self._locks_supported = bool(locked)
+        return locked
+
+    async def _inodelk_unwind(self, loc: Loc, locked: list[int]) -> None:
+        xd = {"lk-owner": self._lk_owner}
+        for i in locked:
+            try:
+                await self.children[i].inodelk(
+                    "ec.transaction", loc, "unlock", "wr", 0, -1, xd)
+            except FopError:
+                pass
+
+    class _Txn:
+        """Write-transaction scope: local serialization + cluster inodelk."""
+
+        def __init__(self, ec: "DisperseLayer", loc: Loc, gfid: bytes,
+                     ltype: str = "wr"):
+            self.ec = ec
+            self.loc = loc
+            self.gfid = gfid
+            self.ltype = ltype
+            self.locked: list[int] = []
+            self.local = ltype == "wr" or ec._locks_supported is False
+
+        async def __aenter__(self):
+            if self.local:
+                await self.ec._lock(self.gfid).acquire()
+            try:
+                self.locked = await self.ec._inodelk_wind(self.loc,
+                                                          self.ltype)
+            except BaseException:
+                if self.local:
+                    self.ec._lock(self.gfid).release()
+                raise
+            if not self.locked and not self.local:
+                # no brick-side locks available: fall back to local mutex
+                self.local = True
+                await self.ec._lock(self.gfid).acquire()
+            return self
+
+        async def __aexit__(self, *exc):
+            await self.ec._inodelk_unwind(self.loc, self.locked)
+            if self.local:
+                self.ec._lock(self.gfid).release()
+            return False
 
     # -- dispatch + combine (ec-common.c:816-900, ec-combine.c) ------------
 
@@ -504,7 +579,7 @@ class DisperseLayer(Layer):
     async def readv(self, fd: FdObj, size: int, offset: int,
                     xdata: dict | None = None):
         loc = Loc(fd.path, gfid=fd.gfid)
-        async with self._lock(fd.gfid):  # serialize vs writev RMW
+        async with self._Txn(self, loc, fd.gfid, "rd"):
             candidates, true_size = await self._read_meta(loc)
             if offset >= true_size:
                 return b""
@@ -519,7 +594,7 @@ class DisperseLayer(Layer):
     async def writev(self, fd: FdObj, data: bytes, offset: int,
                      xdata: dict | None = None):
         loc = Loc(fd.path, gfid=fd.gfid)
-        async with self._lock(fd.gfid):
+        async with self._Txn(self, loc, fd.gfid, "wr"):
             candidates, true_size = await self._read_meta(loc)
             end = offset + len(data)
             a_off = offset // self.stripe * self.stripe
@@ -578,7 +653,7 @@ class DisperseLayer(Layer):
     async def ftruncate(self, fd: FdObj, size: int,
                         xdata: dict | None = None):
         loc = Loc(fd.path, gfid=fd.gfid)
-        async with self._lock(fd.gfid):
+        async with self._Txn(self, loc, fd.gfid, "wr"):
             candidates, true_size = await self._read_meta(loc)
             a_size = (size + self.stripe - 1) // self.stripe * self.stripe
             tail = b""
@@ -654,7 +729,8 @@ class DisperseLayer(Layer):
                            f"unhealable: only {len(good)} good copies")
         if not bad:
             return {"healed": [], "skipped": True}
-        async with self._lock((await self.lookup(loc))[0].gfid):
+        gfid = (await self.lookup(loc))[0].gfid
+        async with self._Txn(self, loc, gfid, "wr"):
             meta = await self._get_meta(good, loc)
             rep = meta[good[0]]
             true_size = rep["size"]

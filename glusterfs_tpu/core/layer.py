@@ -237,6 +237,14 @@ class Layer:
         for p in self.parents:
             p.notify(event, self, data)
 
+    async def release(self, fd: "FdObj") -> None:
+        """Close a file handle (not a wire fop in the reference either —
+        fd_destroy cascades through the graph); default: pass down."""
+        if self.children:
+            rel = getattr(self.children[0], "release", None)
+            if rel is not None:
+                await rel(fd)
+
     # -- introspection -----------------------------------------------------
 
     def dump_private(self) -> dict:

@@ -1,0 +1,74 @@
+"""Process entry points — the glusterfsd analog.
+
+Reference: glusterfsd/src/glusterfsd.c:2650 — one binary runs every
+data-plane role, selected by the volfile it loads.  Same here: this
+module turns a volfile into a served graph (brick server) or a mounted
+client, from the command line or programmatically.
+
+Usage:
+    python -m glusterfs_tpu.daemon --volfile brick.vol --listen 24010
+    python -m glusterfs_tpu.daemon --volfile brick.vol --listen 0 \
+        --portfile /tmp/port   # writes the chosen port (tests use this)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import sys
+
+from .core.graph import Graph
+from .protocol.server import BrickServer
+from .core import gflog
+
+log = gflog.get_logger("core.daemon")
+
+
+async def serve_brick(volfile_text: str, host: str = "127.0.0.1",
+                      port: int = 0, top_name: str | None = None,
+                      portfile: str | None = None) -> BrickServer:
+    """Activate a brick graph and serve it (returns the running server)."""
+    graph = Graph.construct(volfile_text, top_name=top_name)
+    await graph.activate()
+    server = BrickServer(graph.top, host, port)
+    await server.start()
+    if portfile:
+        tmp = portfile + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(server.port))
+        os.replace(tmp, portfile)
+    return server
+
+
+async def _amain(args) -> None:
+    with open(args.volfile) as f:
+        text = f.read()
+    server = await serve_brick(text, args.host, args.listen,
+                               args.top or None, args.portfile or None)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await server.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="gftpu-daemon")
+    p.add_argument("--volfile", required=True)
+    p.add_argument("--top", default="",
+                   help="top layer name (default: unreferenced layer)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--listen", type=int, default=0,
+                   help="TCP port (0 = ephemeral)")
+    p.add_argument("--portfile", default="",
+                   help="write the bound port here (for ephemeral ports)")
+    args = p.parse_args(argv)
+    asyncio.run(_amain(args))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""debug/delay-gen — latency injection per fop (reference
+xlators/debug/delay-gen/delay-gen.c:23,456: options ``delay-duration``
+(usec), ``delay-percentage``, ``enable`` fop list)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from ..core.fops import Fop
+from ..core.layer import Layer, register
+from ..core.options import Option
+
+
+@register("debug/delay-gen")
+class DelayGenLayer(Layer):
+    OPTIONS = (
+        Option("delay-duration", "int", default=100000, min=0,
+               description="injected delay in microseconds"),
+        Option("delay-percentage", "percent", default=10.0, min=0, max=100),
+        Option("enable", "str", default="",
+               description="comma-separated fop names ('' = all)"),
+        Option("seed", "int", default=0),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._rng = random.Random(self.opts["seed"] or None)
+        self._install()
+
+    def reconfigure(self, options):
+        super().reconfigure(options)
+        self._install()
+
+    def _install(self):
+        enabled = {s.strip() for s in self.opts["enable"].split(",")
+                   if s.strip()}
+        self._enabled = enabled or {f.value for f in Fop}
+        self._rate = self.opts["delay-percentage"] / 100.0
+        self._delay = self.opts["delay-duration"] / 1e6
+
+    async def _maybe_delay(self, op: str):
+        if op in self._enabled and self._rate > 0 and \
+                self._rng.random() < self._rate:
+            await asyncio.sleep(self._delay)
+
+
+def _make_delayed(op_name: str):
+    async def delayed(self, *args, **kwargs):
+        await self._maybe_delay(op_name)
+        return await getattr(self.children[0], op_name)(*args, **kwargs)
+    delayed.__name__ = op_name
+    return delayed
+
+
+for _fop in Fop:
+    setattr(DelayGenLayer, _fop.value, _make_delayed(_fop.value))

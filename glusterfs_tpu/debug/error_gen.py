@@ -1,0 +1,61 @@
+"""debug/error-gen — fault injection: fail fops with a configured errno at
+a configured rate (reference xlators/debug/error-gen/error-gen.c:147,218:
+options ``failure``, ``error-no``, ``enable`` fop list).  The test suite's
+brick-failure scenarios ride on this, as in the reference's .t tests."""
+
+from __future__ import annotations
+
+import random
+
+from ..core.fops import Fop, FopError
+from ..core.layer import Layer, register
+from ..core.options import Option
+
+_ERRNO = {"EIO": 5, "ENOENT": 2, "EACCES": 13, "ENOSPC": 28, "EAGAIN": 11,
+          "ENOTCONN": 107, "ESTALE": 116}
+
+
+@register("debug/error-gen")
+class ErrorGenLayer(Layer):
+    OPTIONS = (
+        Option("failure", "percent", default=0.0, min=0, max=100,
+               description="probability (%) of injecting a failure"),
+        Option("error-no", "enum", default="EIO",
+               values=tuple(_ERRNO), description="errno to inject"),
+        Option("enable", "str", default="",
+               description="comma-separated fop names ('' = all)"),
+        Option("seed", "int", default=0),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._rng = random.Random(self.opts["seed"] or None)
+        self._install()
+
+    def reconfigure(self, options):
+        super().reconfigure(options)
+        self._install()
+
+    def _install(self):
+        enabled = {s.strip() for s in self.opts["enable"].split(",")
+                   if s.strip()}
+        self._enabled = enabled or {f.value for f in Fop}
+        self._rate = self.opts["failure"] / 100.0
+        self._err = _ERRNO[self.opts["error-no"]]
+
+    def _maybe_fail(self, op: str):
+        if op in self._enabled and self._rate > 0 and \
+                self._rng.random() < self._rate:
+            raise FopError(self._err, f"error-gen injected on {op}")
+
+
+def _make_injected(op_name: str):
+    async def injected(self, *args, **kwargs):
+        self._maybe_fail(op_name)
+        return await getattr(self.children[0], op_name)(*args, **kwargs)
+    injected.__name__ = op_name
+    return injected
+
+
+for _fop in Fop:
+    setattr(ErrorGenLayer, _fop.value, _make_injected(_fop.value))

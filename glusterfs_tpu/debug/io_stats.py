@@ -1,0 +1,63 @@
+"""debug/io-stats — per-fop counters + latency profile at a graph position
+(reference xlators/debug/io-stats/io-stats.c:129-197; backs ``volume
+profile``/``volume top``).  The base Layer already counts per-fop
+count/errors/latency (xlator_t.stats analog); io-stats adds interval
+snapshots, byte counters for read/write, and a dump API."""
+
+from __future__ import annotations
+
+import time
+
+from ..core.layer import FdObj, Layer, register
+from ..core.options import Option
+
+
+@register("debug/io-stats")
+class IoStatsLayer(Layer):
+    OPTIONS = (
+        Option("count-fop-hits", "bool", default="on"),
+        Option("latency-measurement", "bool", default="on"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.read_bytes = 0
+        self.write_bytes = 0
+        self.started = time.time()
+        self._interval_base: dict = {}
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        data = await self.children[0].readv(fd, size, offset, xdata)
+        self.read_bytes += len(data)
+        return data
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        ret = await self.children[0].writev(fd, data, offset, xdata)
+        self.write_bytes += len(data)
+        return ret
+
+    # -- profile API (volume profile incremental/cumulative analog) --------
+
+    def profile(self, *, interval: bool = False) -> dict:
+        cur = {op: st.to_dict() for op, st in self.stats.items()}
+        out = {
+            "uptime_s": time.time() - self.started,
+            "read_bytes": self.read_bytes,
+            "write_bytes": self.write_bytes,
+            "fops": cur,
+        }
+        if interval:
+            base = self._interval_base
+            delta = {}
+            for op, st in cur.items():
+                prev = base.get("fops", {}).get(op, {})
+                delta[op] = {k: st[k] - prev.get(k, 0)
+                             for k in ("count", "errors")}
+            out["interval"] = delta
+            self._interval_base = {"fops": cur}
+        return out
+
+    def dump_private(self) -> dict:
+        return self.profile()

@@ -1,0 +1,62 @@
+"""debug/trace — log every fop passing through with args and outcome
+(reference xlators/debug/trace/trace.c)."""
+
+from __future__ import annotations
+
+from ..core.fops import Fop, FopError
+from ..core.layer import Layer, register
+from ..core.options import Option
+from ..core import gflog
+
+log = gflog.get_logger("core.trace")
+
+
+@register("debug/trace")
+class TraceLayer(Layer):
+    OPTIONS = (
+        Option("log-history", "bool", default="on"),
+        Option("exclude-ops", "str", default=""),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.history: list[str] = []
+        self._excluded = {s.strip()
+                         for s in self.opts["exclude-ops"].split(",")
+                         if s.strip()}
+
+    def _record(self, line: str):
+        log.debug(1, "%s", line)
+        if self.opts["log-history"]:
+            self.history.append(line)
+            if len(self.history) > 4096:
+                del self.history[:2048]
+
+    def dump_private(self) -> dict:
+        return {"history_len": len(self.history),
+                "recent": self.history[-20:]}
+
+
+def _fmt(v, limit=64):
+    s = repr(v)
+    return s if len(s) <= limit else s[:limit] + "..."
+
+
+def _make_traced(op_name: str):
+    async def traced(self, *args, **kwargs):
+        if op_name in self._excluded:
+            return await getattr(self.children[0], op_name)(*args, **kwargs)
+        args_s = ", ".join(_fmt(a) for a in args)
+        try:
+            ret = await getattr(self.children[0], op_name)(*args, **kwargs)
+            self._record(f"{self.name}: {op_name}({args_s}) -> {_fmt(ret)}")
+            return ret
+        except FopError as e:
+            self._record(f"{self.name}: {op_name}({args_s}) !! {e!r}")
+            raise
+    traced.__name__ = op_name
+    return traced
+
+
+for _fop in Fop:
+    setattr(TraceLayer, _fop.value, _make_traced(_fop.value))

@@ -1,0 +1,241 @@
+"""features/locks — brick-side byte-range and internal locks.
+
+Reference: xlators/features/locks (posix.c, inodelk.c, entrylk.c) with
+named lock domains (common.h:61-82).  Three lock classes, same as the
+reference:
+
+* ``inodelk(domain, ...)`` — internal per-inode locks in named domains;
+  the EC/AFR transaction engines serialize writers with these.
+* ``entrylk(domain, loc, basename, ...)`` — internal per-dentry locks
+  (directory-op serialization).
+* ``lk(fd, ...)`` — POSIX advisory record locks for applications.
+
+Locks are owner-keyed (``lk-owner`` in xdata, the frame lk_owner analog);
+rd locks share, wr locks exclude, ranges conflict on overlap; blocking
+requests queue FIFO on an asyncio future.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+from collections import defaultdict
+
+from ..core.fops import FopError
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+
+
+class _Lock:
+    __slots__ = ("owner", "ltype", "start", "end")
+
+    def __init__(self, owner: bytes, ltype: str, start: int, end: int):
+        self.owner = owner
+        self.ltype = ltype  # "rd" | "wr"
+        self.start = start
+        self.end = end  # exclusive; -1 = EOF (whole rest)
+
+    def overlaps(self, other: "_Lock") -> bool:
+        a_end = self.end if self.end >= 0 else float("inf")
+        b_end = other.end if other.end >= 0 else float("inf")
+        return self.start < b_end and other.start < a_end
+
+    def conflicts(self, other: "_Lock") -> bool:
+        if self.owner == other.owner:
+            return False
+        if self.ltype == "rd" and other.ltype == "rd":
+            return False
+        return self.overlaps(other)
+
+    def to_dict(self) -> dict:
+        return {"owner": self.owner.hex(), "type": self.ltype,
+                "start": self.start, "end": self.end}
+
+
+class _LockDomain:
+    """Granted locks + FIFO waiter queue for one (gfid, domain)."""
+
+    def __init__(self):
+        self.granted: list[_Lock] = []
+        self.waiters: list[tuple[_Lock, asyncio.Future]] = []
+
+    def _grantable(self, req: _Lock) -> bool:
+        return not any(g.conflicts(req) for g in self.granted)
+
+    def try_lock(self, req: _Lock) -> bool:
+        if self._grantable(req):
+            self.granted.append(req)
+            return True
+        return False
+
+    async def lock(self, req: _Lock) -> None:
+        if self.try_lock(req):
+            return
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.waiters.append((req, fut))
+        await fut
+
+    def unlock(self, owner: bytes, start: int, end: int) -> bool:
+        for i, g in enumerate(self.granted):
+            if g.owner == owner and g.start == start and g.end == end:
+                del self.granted[i]
+                self._wake()
+                return True
+        return False
+
+    def release_owner(self, owner: bytes) -> int:
+        n = len(self.granted)
+        self.granted = [g for g in self.granted if g.owner != owner]
+        if len(self.granted) != n:
+            self._wake()
+        return n - len(self.granted)
+
+    def _wake(self) -> None:
+        # grant queued requests in FIFO order while compatible
+        still = []
+        for req, fut in self.waiters:
+            if not fut.cancelled() and self._grantable(req):
+                self.granted.append(req)
+                fut.set_result(None)
+            elif not fut.cancelled():
+                still.append((req, fut))
+        self.waiters = still
+
+    def empty(self) -> bool:
+        return not self.granted and not self.waiters
+
+
+@register("features/locks")
+class LocksLayer(Layer):
+    OPTIONS = (
+        Option("trace", "bool", default="off"),
+        Option("lock-timeout", "time", default="30",
+               description="blocking lock wait limit (0 = forever)"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        # (gfid, domain) -> _LockDomain for inodelks;
+        # (gfid, domain, basename) for entrylks; gfid for posix lk
+        self._inodelk: dict[tuple, _LockDomain] = defaultdict(_LockDomain)
+        self._entrylk: dict[tuple, _LockDomain] = defaultdict(_LockDomain)
+        self._posixlk: dict[bytes, _LockDomain] = defaultdict(_LockDomain)
+
+    # -- helpers -----------------------------------------------------------
+
+    async def _gfid_for(self, loc: Loc) -> bytes:
+        if loc.gfid:
+            return loc.gfid
+        ia, _ = await self.children[0].lookup(loc)
+        return ia.gfid
+
+    @staticmethod
+    def _owner(xdata: dict | None) -> bytes:
+        return (xdata or {}).get("lk-owner", b"\0anon")
+
+    async def _do(self, table: dict, key, cmd: str, req: _Lock):
+        dom = table[key]
+        if cmd == "unlock":
+            if not dom.unlock(req.owner, req.start, req.end):
+                raise FopError(errno.EINVAL, "no such lock")
+            if dom.empty():
+                table.pop(key, None)
+            return {}
+        if cmd == "lock-nb":
+            if not dom.try_lock(req):
+                raise FopError(errno.EAGAIN, "would block")
+            return {}
+        if cmd == "lock":
+            timeout = self.opts["lock-timeout"]
+            try:
+                await asyncio.wait_for(dom.lock(req),
+                                       timeout or None)
+            except asyncio.TimeoutError:
+                raise FopError(errno.ETIMEDOUT, "lock wait timed out") \
+                    from None
+            return {}
+        raise FopError(errno.EINVAL, f"bad lock cmd {cmd!r}")
+
+    # -- fops --------------------------------------------------------------
+
+    async def inodelk(self, domain: str, loc: Loc, cmd: str,
+                      ltype: str = "wr", start: int = 0, end: int = -1,
+                      xdata: dict | None = None):
+        gfid = await self._gfid_for(loc)
+        return await self._do(self._inodelk, (gfid, domain), cmd,
+                              _Lock(self._owner(xdata), ltype, start, end))
+
+    async def finodelk(self, domain: str, fd: FdObj, cmd: str,
+                       ltype: str = "wr", start: int = 0, end: int = -1,
+                       xdata: dict | None = None):
+        return await self._do(self._inodelk, (fd.gfid, domain), cmd,
+                              _Lock(self._owner(xdata), ltype, start, end))
+
+    async def entrylk(self, domain: str, loc: Loc, basename: str,
+                      cmd: str, ltype: str = "wr",
+                      xdata: dict | None = None):
+        gfid = await self._gfid_for(loc)
+        return await self._do(self._entrylk, (gfid, domain, basename), cmd,
+                              _Lock(self._owner(xdata), ltype, 0, -1))
+
+    async def fentrylk(self, domain: str, fd: FdObj, basename: str,
+                       cmd: str, ltype: str = "wr",
+                       xdata: dict | None = None):
+        return await self._do(self._entrylk, (fd.gfid, domain, basename),
+                              cmd, _Lock(self._owner(xdata), ltype, 0, -1))
+
+    async def lk(self, fd: FdObj, cmd: str, flock: dict,
+                 xdata: dict | None = None):
+        """POSIX record locks: flock = {type: rd|wr|unlck, start, len}."""
+        owner = self._owner(xdata)
+        start = flock.get("start", 0)
+        length = flock.get("len", 0)
+        end = -1 if length == 0 else start + length
+        ltype = flock.get("type", "wr")
+        dom = self._posixlk[fd.gfid]
+        if cmd == "getlk":
+            probe = _Lock(owner, ltype, start, end)
+            for g in dom.granted:
+                if g.conflicts(probe):
+                    return {"type": g.ltype, "start": g.start,
+                            "end": g.end, "owner": g.owner.hex()}
+            return {"type": "unlck"}
+        if ltype == "unlck":
+            dom.release_owner(owner)
+            if dom.empty():
+                self._posixlk.pop(fd.gfid, None)
+            return {}
+        mapped = {"setlk": "lock-nb", "setlkw": "lock"}.get(cmd)
+        if mapped is None:
+            raise FopError(errno.EINVAL, f"bad lk cmd {cmd!r}")
+        return await self._do(self._posixlk, fd.gfid, mapped,
+                              _Lock(owner, ltype, start, end))
+
+    async def getactivelk(self, loc: Loc, xdata: dict | None = None):
+        gfid = await self._gfid_for(loc)
+        out = []
+        for (g, dom_name), dom in self._inodelk.items():
+            if g == gfid:
+                out.extend({**lk.to_dict(), "domain": dom_name}
+                           for lk in dom.granted)
+        return out
+
+    def release_client(self, owner: bytes) -> int:
+        """Drop every lock held by a disconnected client (the reference
+        cleans locks on client disconnect via client_t)."""
+        n = 0
+        for table in (self._inodelk, self._entrylk, self._posixlk):
+            for key in list(table):
+                n += table[key].release_owner(owner)
+                if table[key].empty():
+                    table.pop(key, None)
+        return n
+
+    def dump_private(self) -> dict:
+        return {
+            "inodelk_domains": len(self._inodelk),
+            "entrylk_domains": len(self._entrylk),
+            "posixlk_inodes": len(self._posixlk),
+            "granted": sum(len(d.granted) for d in self._inodelk.values()),
+            "waiting": sum(len(d.waiters) for d in self._inodelk.values()),
+        }

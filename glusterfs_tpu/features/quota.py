@@ -1,0 +1,135 @@
+"""features/quota — directory usage limits.
+
+Reference: xlators/features/quota (7k LoC; quota.c:635 quota_check_limit)
+with marker-based contribution accounting.  Here: limits live in the
+layer (set via ``limit_set``/options or the ``trusted.glusterfs.quota.
+limit-set`` xattr); usage is computed on demand by walking the subtree
+and then maintained incrementally by write/truncate/unlink deltas —
+functionally the marker accounting without the persistent xattr climb."""
+
+from __future__ import annotations
+
+import errno
+
+from ..core.fops import FopError
+from ..core.iatt import IAType
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+
+XA_LIMIT = "trusted.glusterfs.quota.limit-set"
+
+
+@register("features/quota")
+class QuotaLayer(Layer):
+    OPTIONS = (
+        Option("default-soft-limit", "percent", default=80.0),
+        Option("hard-timeout", "time", default="5"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.limits: dict[str, int] = {}  # dir path -> bytes
+        self._usage: dict[str, int] = {}  # dir path -> bytes (tracked)
+
+    # -- admin API (quota CLI path) ----------------------------------------
+
+    def limit_set(self, path: str, limit: int) -> None:
+        self.limits[path.rstrip("/") or "/"] = limit
+        self._usage.pop(path.rstrip("/") or "/", None)
+
+    def limit_remove(self, path: str) -> None:
+        self.limits.pop(path.rstrip("/") or "/", None)
+
+    async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
+                       xdata: dict | None = None):
+        if XA_LIMIT in xattrs:
+            self.limit_set(loc.path, int(xattrs[XA_LIMIT]))
+            xattrs = {k: v for k, v in xattrs.items() if k != XA_LIMIT}
+            if not xattrs:
+                return {}
+        return await self.children[0].setxattr(loc, xattrs, flags, xdata)
+
+    # -- accounting --------------------------------------------------------
+
+    def _covering(self, path: str) -> list[str]:
+        out = []
+        for d in self.limits:
+            if d == "/" or path == d or path.startswith(d + "/"):
+                out.append(d)
+        return out
+
+    async def _du(self, path: str) -> int:
+        total = 0
+        try:
+            fd = await self.children[0].opendir(Loc(path))
+            entries = await self.children[0].readdirp(fd)
+        except FopError:
+            return 0
+        for name, ia in entries:
+            if ia is None:
+                continue
+            child = path.rstrip("/") + "/" + name
+            if ia.ia_type is IAType.DIR:
+                total += await self._du(child)
+            else:
+                total += ia.size
+        return total
+
+    async def _use(self, d: str) -> int:
+        if d not in self._usage:
+            self._usage[d] = await self._du(d if d != "/" else "/")
+        return self._usage[d]
+
+    async def _check(self, path: str, delta: int) -> None:
+        """quota_check_limit analog: would +delta exceed any covering
+        limit?"""
+        if delta <= 0:
+            return
+        for d in self._covering(path):
+            used = await self._use(d)
+            if used + delta > self.limits[d]:
+                raise FopError(errno.EDQUOT,
+                               f"quota exceeded on {d} "
+                               f"({used}+{delta} > {self.limits[d]})")
+
+    def _account(self, path: str, delta: int) -> None:
+        for d in self._covering(path):
+            if d in self._usage:
+                self._usage[d] = max(0, self._usage[d] + delta)
+
+    # -- enforced fops -----------------------------------------------------
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        path = fd.path
+        ia = await self.children[0].fstat(fd)
+        growth = max(0, offset + len(data) - ia.size)
+        await self._check(path, growth)
+        ret = await self.children[0].writev(fd, data, offset, xdata)
+        self._account(path, growth)
+        return ret
+
+    async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
+        try:
+            ia, _ = await self.children[0].lookup(loc)
+            delta = size - ia.size
+        except FopError:
+            delta = 0
+        if delta > 0:
+            await self._check(loc.path, delta)
+        ret = await self.children[0].truncate(loc, size, xdata)
+        self._account(loc.path, delta)
+        return ret
+
+    async def unlink(self, loc: Loc, xdata: dict | None = None):
+        try:
+            ia, _ = await self.children[0].lookup(loc)
+            size = ia.size
+        except FopError:
+            size = 0
+        ret = await self.children[0].unlink(loc, xdata)
+        self._account(loc.path, -size)
+        return ret
+
+    def dump_private(self) -> dict:
+        return {"limits": dict(self.limits), "usage": dict(self._usage)}

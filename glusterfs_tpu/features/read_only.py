@@ -1,0 +1,30 @@
+"""features/read-only — reject all modifying fops with EROFS
+(reference xlators/features/read-only/read-only.c)."""
+
+from __future__ import annotations
+
+import errno
+
+from ..core.fops import WRITE_FOPS, FopError
+from ..core.layer import Layer, register
+from ..core.options import Option
+
+
+@register("features/read-only")
+class ReadOnlyLayer(Layer):
+    OPTIONS = (
+        Option("read-only", "bool", default="on"),
+    )
+
+
+def _rejecting(op_name: str):
+    async def fop(self, *args, **kwargs):
+        if self.opts["read-only"]:
+            raise FopError(errno.EROFS, f"{op_name}: read-only volume")
+        return await getattr(self.children[0], op_name)(*args, **kwargs)
+    fop.__name__ = op_name
+    return fop
+
+
+for _f in WRITE_FOPS:
+    setattr(ReadOnlyLayer, _f.value, _rejecting(_f.value))

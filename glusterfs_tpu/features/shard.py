@@ -1,0 +1,227 @@
+"""features/shard — split big files into fixed-size shard files.
+
+Reference: xlators/features/shard (8.1k LoC; shard.c:3428 option
+``shard-block-size``): block 0 lives at the file's own path; blocks
+1..N live at ``/.shard/<gfid-hex>.<N>``; the true file size rides in
+the ``trusted.glusterfs.shard.file-size`` xattr of block 0.  Large-file
+(VM image) use case: writes touch only the shards they cover."""
+
+from __future__ import annotations
+
+import errno
+
+from ..core.fops import FopError
+from ..core.iatt import Iatt
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+
+SHARD_DIR = ".shard"
+XA_SIZE = "trusted.glusterfs.shard.file-size"
+
+
+@register("features/shard")
+class ShardLayer(Layer):
+    OPTIONS = (
+        Option("shard-block-size", "size", default="64MB", min=4096),
+    )
+
+    async def init(self):
+        await super().init()
+        try:
+            await self.children[0].mkdir(Loc("/" + SHARD_DIR), 0o755)
+        except FopError as e:
+            if e.err != errno.EEXIST:
+                raise
+
+    # -- helpers -----------------------------------------------------------
+
+    def _bs(self) -> int:
+        return self.opts["shard-block-size"]
+
+    def _shard_path(self, gfid: bytes, idx: int) -> str:
+        return f"/{SHARD_DIR}/{gfid.hex()}.{idx}"
+
+    async def _true_size(self, loc_or_fd) -> int:
+        try:
+            if isinstance(loc_or_fd, FdObj):
+                out = await self.children[0].fgetxattr(loc_or_fd, XA_SIZE)
+            else:
+                out = await self.children[0].getxattr(loc_or_fd, XA_SIZE)
+            return int(out[XA_SIZE].decode())
+        except FopError:
+            # unsharded legacy file: base size is the size
+            if isinstance(loc_or_fd, FdObj):
+                return (await self.children[0].fstat(loc_or_fd)).size
+            return (await self.children[0].stat(loc_or_fd)).size
+
+    async def _set_size(self, fd: FdObj, size: int) -> None:
+        await self.children[0].fsetxattr(
+            fd, {XA_SIZE: str(size).encode()})
+
+    async def _shard_write(self, gfid: bytes, idx: int, data: bytes,
+                           offset: int, base_fd: FdObj) -> None:
+        if idx == 0:
+            await self.children[0].writev(base_fd, data, offset)
+            return
+        path = self._shard_path(gfid, idx)
+        loc = Loc(path)
+        try:
+            sfd = await self.children[0].open(loc, 2)
+        except FopError as e:
+            if e.err != errno.ENOENT:
+                raise
+            sfd, _ = await self.children[0].create(loc, 0, 0o600)
+        try:
+            await self.children[0].writev(sfd, data, offset)
+        finally:
+            await self.children[0].release(sfd)
+
+    async def _shard_read(self, gfid: bytes, idx: int, size: int,
+                          offset: int, base_fd: FdObj) -> bytes:
+        if idx == 0:
+            return await self.children[0].readv(base_fd, size, offset)
+        loc = Loc(self._shard_path(gfid, idx))
+        try:
+            sfd = await self.children[0].open(loc, 0)
+        except FopError as e:
+            if e.err == errno.ENOENT:
+                return b"\0" * size  # hole
+            raise
+        try:
+            out = await self.children[0].readv(sfd, size, offset)
+            return out.ljust(size, b"\0")
+        finally:
+            await self.children[0].release(sfd)
+
+    # -- fops --------------------------------------------------------------
+
+    async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
+                     xdata: dict | None = None):
+        fd, ia = await self.children[0].create(loc, flags, mode, xdata)
+        await self._set_size(fd, 0)
+        return fd, ia
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        data = bytes(data)
+        bs = self._bs()
+        true_size = await self._true_size(fd)
+        pos = offset
+        remaining = data
+        while remaining:
+            idx = pos // bs
+            within = pos - idx * bs
+            take = min(bs - within, len(remaining))
+            await self._shard_write(fd.gfid, idx, remaining[:take],
+                                    within, fd)
+            remaining = remaining[take:]
+            pos += take
+        new_size = max(true_size, offset + len(data))
+        if new_size != true_size:
+            await self._set_size(fd, new_size)
+        ia = await self.children[0].fstat(fd)
+        ia = Iatt(**{**ia.__dict__})
+        ia.size = new_size
+        return ia
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        bs = self._bs()
+        true_size = await self._true_size(fd)
+        if offset >= true_size:
+            return b""
+        size = min(size, true_size - offset)
+        out = bytearray()
+        pos = offset
+        end = offset + size
+        while pos < end:
+            idx = pos // bs
+            within = pos - idx * bs
+            take = min(bs - within, end - pos)
+            chunk = await self._shard_read(fd.gfid, idx, take, within, fd)
+            out += chunk.ljust(take, b"\0")  # holes read as zeros
+            pos += take
+        return bytes(out)
+
+    async def fstat(self, fd: FdObj, xdata: dict | None = None):
+        ia = await self.children[0].fstat(fd, xdata)
+        ia = Iatt(**{**ia.__dict__})
+        ia.size = await self._true_size(fd)
+        return ia
+
+    async def stat(self, loc: Loc, xdata: dict | None = None):
+        ia = await self.children[0].stat(loc, xdata)
+        if not ia.is_dir():
+            ia = Iatt(**{**ia.__dict__})
+            ia.size = await self._true_size(loc)
+        return ia
+
+    async def lookup(self, loc: Loc, xdata: dict | None = None):
+        ia, xd = await self.children[0].lookup(loc, xdata)
+        if not ia.is_dir() and not loc.path.startswith("/" + SHARD_DIR):
+            ia = Iatt(**{**ia.__dict__})
+            ia.size = await self._true_size(loc)
+        return ia, xd
+
+    async def ftruncate(self, fd: FdObj, size: int,
+                        xdata: dict | None = None):
+        bs = self._bs()
+        true_size = await self._true_size(fd)
+        last_keep = (size + bs - 1) // bs  # first shard index to drop
+        old_last = (true_size + bs - 1) // bs
+        for idx in range(max(1, last_keep), old_last):
+            try:
+                await self.children[0].unlink(
+                    Loc(self._shard_path(fd.gfid, idx)))
+            except FopError:
+                pass
+        if size <= bs:
+            await self.children[0].ftruncate(fd, size, xdata)
+        elif size % bs:
+            idx = size // bs
+            if idx > 0:
+                loc = Loc(self._shard_path(fd.gfid, idx))
+                try:
+                    await self.children[0].truncate(loc, size % bs)
+                except FopError:
+                    pass
+        await self._set_size(fd, size)
+        ia = await self.children[0].fstat(fd)
+        ia = Iatt(**{**ia.__dict__})
+        ia.size = size
+        return ia
+
+    async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
+        fd = await self.children[0].open(loc, 2)
+        try:
+            return await self.ftruncate(fd, size, xdata)
+        finally:
+            await self.children[0].release(fd)
+
+    async def unlink(self, loc: Loc, xdata: dict | None = None):
+        try:
+            ia, _ = await self.children[0].lookup(loc)
+            bs = self._bs()
+            true_size = await self._true_size(loc)
+            for idx in range(1, (true_size + bs - 1) // bs):
+                try:
+                    await self.children[0].unlink(
+                        Loc(self._shard_path(ia.gfid, idx)))
+                except FopError:
+                    pass
+        except FopError:
+            pass
+        return await self.children[0].unlink(loc, xdata)
+
+    async def readdir(self, fd: FdObj, size: int = 0, offset: int = 0,
+                      xdata: dict | None = None):
+        entries = await self.children[0].readdir(fd, size, offset, xdata)
+        return [(n, ia) for n, ia in entries if n != SHARD_DIR]
+
+    async def readdirp(self, fd: FdObj, size: int = 0, offset: int = 0,
+                       xdata: dict | None = None):
+        entries = await self.children[0].readdirp(fd, size, offset, xdata)
+        return [(n, ia) for n, ia in entries if n != SHARD_DIR]
+
+    def dump_private(self) -> dict:
+        return {"shard_block_size": self._bs()}

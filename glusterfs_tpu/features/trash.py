@@ -1,0 +1,50 @@
+"""features/trash — keep deleted/truncated files under /.trashcan.
+
+Reference: xlators/features/trash (2.8k LoC): unlinks become renames
+into a timestamped path inside the trash directory; an internal-op
+escape hatch avoids recursion."""
+
+from __future__ import annotations
+
+import errno
+import time
+
+from ..core.fops import FopError
+from ..core.layer import Layer, Loc, register
+from ..core.options import Option
+
+TRASH_DIR = ".trashcan"
+
+
+@register("features/trash")
+class TrashLayer(Layer):
+    OPTIONS = (
+        Option("trash", "bool", default="on"),
+        Option("trash-max-filesize", "size", default="5MB"),
+    )
+
+    async def init(self):
+        await super().init()
+        try:
+            await self.children[0].mkdir(Loc("/" + TRASH_DIR), 0o700)
+        except FopError as e:
+            if e.err != errno.EEXIST:
+                raise
+
+    async def unlink(self, loc: Loc, xdata: dict | None = None):
+        if not self.opts["trash"] or loc.path.startswith("/" + TRASH_DIR):
+            return await self.children[0].unlink(loc, xdata)
+        try:
+            ia, _ = await self.children[0].lookup(loc)
+            if ia.size > self.opts["trash-max-filesize"]:
+                return await self.children[0].unlink(loc, xdata)
+        except FopError:
+            return await self.children[0].unlink(loc, xdata)
+        stamp = time.strftime("%Y-%m-%d-%H%M%S")
+        dest = f"/{TRASH_DIR}/{loc.path.strip('/').replace('/', '_')}" \
+               f"_{stamp}"
+        await self.children[0].rename(loc, Loc(dest))
+        return {}
+
+    def dump_private(self) -> dict:
+        return {"trash_dir": "/" + TRASH_DIR}

@@ -1,0 +1,54 @@
+"""features/worm — write-once-read-many enforcement.
+
+Reference: xlators/features/read-only/worm.c: files may be created and
+written once; after that, overwrites/truncates/unlinks are denied with
+EROFS.  Appends (writes at EOF) are allowed, matching the reference's
+O_APPEND carve-out."""
+
+from __future__ import annotations
+
+import errno
+
+from ..core.fops import FopError
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+
+
+@register("features/worm")
+class WormLayer(Layer):
+    OPTIONS = (
+        Option("worm", "bool", default="on"),
+    )
+
+    def _on(self) -> bool:
+        return bool(self.opts["worm"])
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        if self._on():
+            ia = await self.children[0].fstat(fd)
+            if offset < ia.size:
+                raise FopError(errno.EROFS, "worm: overwrite denied")
+        return await self.children[0].writev(fd, data, offset, xdata)
+
+    async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
+        if self._on():
+            raise FopError(errno.EROFS, "worm: truncate denied")
+        return await self.children[0].truncate(loc, size, xdata)
+
+    async def ftruncate(self, fd: FdObj, size: int,
+                        xdata: dict | None = None):
+        if self._on():
+            raise FopError(errno.EROFS, "worm: truncate denied")
+        return await self.children[0].ftruncate(fd, size, xdata)
+
+    async def unlink(self, loc: Loc, xdata: dict | None = None):
+        if self._on():
+            raise FopError(errno.EROFS, "worm: unlink denied")
+        return await self.children[0].unlink(loc, xdata)
+
+    async def rename(self, oldloc: Loc, newloc: Loc,
+                     xdata: dict | None = None):
+        if self._on():
+            raise FopError(errno.EROFS, "worm: rename denied")
+        return await self.children[0].rename(oldloc, newloc, xdata)

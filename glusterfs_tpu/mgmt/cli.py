@@ -1,0 +1,214 @@
+"""``gftpu`` — the gluster CLI analog.
+
+Reference: cli/ (30k LoC — readline shell, parser, RPC to glusterd).
+Command surface kept (cli-cmd-volume.c vocabulary):
+
+    gftpu volume create NAME [disperse N | replica N] BRICK...
+    gftpu volume start|stop|delete NAME
+    gftpu volume info [NAME] | status NAME
+    gftpu volume set NAME KEY VALUE
+    gftpu volume heal NAME [info] [PATH]
+    gftpu volume rebalance NAME
+    gftpu volume profile NAME
+    gftpu peer probe HOST:PORT | peer status
+
+Talks to glusterd over the mgmt wire RPC (--server host:port, default
+127.0.0.1:24007).  ``--json`` prints machine-readable output (the
+reference's --xml).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from typing import Any
+
+from .glusterd import MgmtClient, mount_volume
+
+
+def _fmt(v: Any, as_json: bool) -> str:
+    if as_json:
+        return json.dumps(v, indent=1, default=repr)
+    return _pretty(v)
+
+
+def _pretty(v: Any, indent: int = 0) -> str:
+    pad = "  " * indent
+    if isinstance(v, dict):
+        return "\n".join(f"{pad}{k}: " + (
+            "\n" + _pretty(val, indent + 1)
+            if isinstance(val, (dict, list)) and val else _pretty(val))
+            for k, val in v.items())
+    if isinstance(v, list):
+        return "\n".join(f"{pad}- " + (_pretty(x).lstrip()
+                                       if not isinstance(x, (dict, list))
+                                       else "\n" + _pretty(x, indent + 1))
+                         for x in v)
+    return f"{pad}{v}" if indent else str(v)
+
+
+async def _run(args) -> Any:
+    host, _, port = args.server.partition(":")
+    port = int(port or 24007)
+
+    if args.cmd == "peer":
+        async with MgmtClient(host, port) as c:
+            if args.sub == "probe":
+                ph, _, pp = args.target.partition(":")
+                return await c.call("peer-probe", host=ph, port=int(pp))
+            return await c.call("peer-status")
+
+    if args.cmd == "volume":
+        sub = args.sub
+        if sub == "create":
+            vtype = "distribute"
+            redundancy = 0
+            group = 0
+            rest = list(args.args)
+            if rest and rest[0] == "disperse":
+                vtype = "disperse"
+                redundancy = int(rest[1])
+                rest = rest[2:]
+            elif rest and rest[0] == "replica":
+                vtype = "replicate"
+                group = int(rest[1])
+                rest = rest[2:]
+            bricks = [{"path": b.split(":", 1)[-1],
+                       "host": "127.0.0.1"} for b in rest]
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-create", name=args.name,
+                                    vtype=vtype, bricks=bricks,
+                                    redundancy=redundancy,
+                                    group_size=group)
+        if sub in ("start", "stop", "delete", "status"):
+            async with MgmtClient(host, port) as c:
+                return await c.call(f"volume-{sub}", name=args.name)
+        if sub == "info":
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-info",
+                                    name=args.name or None)
+        if sub == "set":
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-set", name=args.name,
+                                    key=args.args[0], value=args.args[1])
+        if sub == "heal":
+            client = await mount_volume(host, port, args.name)
+            try:
+                top = _find_cluster_layer(client.graph)
+                from ..core.layer import Loc
+
+                path = args.args[1] if len(args.args) > 1 else \
+                    (args.args[0] if args.args and
+                     args.args[0] != "info" else "/")
+                if args.args and args.args[0] == "info":
+                    if path == "/":
+                        return await _heal_info_all(client, top)
+                    return await top.heal_info(Loc(path))
+                if path == "/":
+                    return await _heal_all(client, top)
+                return await top.heal_file(path)
+            finally:
+                await client.unmount()
+        if sub == "rebalance":
+            client = await mount_volume(host, port, args.name)
+            try:
+                from ..cluster.dht import DistributeLayer
+
+                dht = _find_layer(client.graph, DistributeLayer)
+                if dht is None:
+                    return {"error": "not a distributed volume"}
+                return await dht.rebalance("/")
+            finally:
+                await client.unmount()
+        if sub == "profile":
+            client = await mount_volume(host, port, args.name)
+            try:
+                from ..debug.io_stats import IoStatsLayer
+
+                st = _find_layer(client.graph, IoStatsLayer)
+                return st.profile() if st else {}
+            finally:
+                await client.unmount()
+    raise SystemExit(f"unknown command {args.cmd} {args.sub}")
+
+
+def _find_layer(graph, klass):
+    for layer in graph.by_name.values():
+        if isinstance(layer, klass):
+            return layer
+    return None
+
+
+def _find_cluster_layer(graph):
+    from ..cluster.afr import ReplicateLayer
+    from ..cluster.ec import DisperseLayer
+
+    for klass in (DisperseLayer, ReplicateLayer):
+        layer = _find_layer(graph, klass)
+        if layer is not None:
+            return layer
+    raise SystemExit("volume has no replicate/disperse layer to heal")
+
+
+async def _walk_files(client, path="/"):
+    out = []
+    for name, ia in await client.listdir_with_stat(path):
+        child = path.rstrip("/") + "/" + name
+        if ia is not None and ia.is_dir():
+            out.extend(await _walk_files(client, child))
+        else:
+            out.append(child)
+    return out
+
+
+async def _heal_info_all(client, top):
+    from ..core.layer import Loc
+
+    out = {}
+    for f in await _walk_files(client):
+        info = await top.heal_info(Loc(f))
+        if info["bad"]:
+            out[f] = info["bad"]
+    return {"files_needing_heal": out, "count": len(out)}
+
+
+async def _heal_all(client, top):
+    healed = {}
+    for f in await _walk_files(client):
+        res = await top.heal_file(f)
+        if res.get("healed"):
+            healed[f] = res["healed"]
+    return {"healed": healed, "count": len(healed)}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="gftpu")
+    p.add_argument("--server", default="127.0.0.1:24007")
+    p.add_argument("--json", action="store_true")
+    sp = p.add_subparsers(dest="cmd", required=True)
+
+    vol = sp.add_parser("volume")
+    vol.add_argument("sub", choices=["create", "start", "stop", "delete",
+                                     "info", "status", "set", "heal",
+                                     "rebalance", "profile"])
+    vol.add_argument("name", nargs="?", default="")
+    vol.add_argument("args", nargs="*")
+
+    peer = sp.add_parser("peer")
+    peer.add_argument("sub", choices=["probe", "status"])
+    peer.add_argument("target", nargs="?", default="")
+
+    args = p.parse_args(argv)
+    try:
+        out = asyncio.run(_run(args))
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(_fmt(out, args.json))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

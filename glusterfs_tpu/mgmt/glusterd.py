@@ -1,0 +1,521 @@
+"""Management daemon — the glusterd analog (scoped to the ~5% that
+matters: volinfo store, peers, txn, volgen, brick lifecycle, portmap,
+volfile serving; SURVEY.md §7 "keep the management plane small").
+
+Reference: xlators/mgmt/glusterd (108k LoC).  Kept behaviors:
+
+* **Persistent store** (glusterd-store.c:561,1643): volumes + peers
+  survive restart (JSON under the workdir).
+* **Volume lifecycle**: create/start/stop/delete/set + info/status
+  (op-sm commit path); start spawns one brick daemon per local brick
+  (glusterd-utils.c runner) and records its port (portmap,
+  glusterd-pmap.c:661).
+* **Volgen** (glusterd-volgen.c): brick + client volfiles from volinfo.
+* **Volfile serving** (__server_getspec, glusterd-handshake.c:867):
+  clients fetch their graph over the mgmt RPC and mount it.
+* **Peers + distributed txn** (glusterd-op-sm.c states lock -> stage ->
+  commit): peer probe forms a cluster; volume ops lock all peers, stage
+  (validate), commit (apply + store) — driven by the originating node
+  (mgmt-v3 style, glusterd-mgmt.c).
+* **Heal/profile/rebalance entry points** (glusterd-op-sm op handlers):
+  forwarded to a temporary client graph mounted in-process.
+
+The mgmt wire protocol reuses rpc/wire framing with method dispatch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+from typing import Any
+
+from ..core import gflog
+from ..core.fops import FopError
+from ..rpc import wire
+from . import volgen
+
+log = gflog.get_logger("mgmt")
+
+
+class MgmtError(Exception):
+    pass
+
+
+class Glusterd:
+    """One management daemon instance (one per node)."""
+
+    def __init__(self, workdir: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.workdir = os.path.abspath(workdir)
+        self.host = host
+        self.port = port
+        os.makedirs(self.workdir, exist_ok=True)
+        self._store = os.path.join(self.workdir, "store.json")
+        self.state = self._load()
+        self.uuid = self.state.setdefault("uuid", str(uuid.uuid4()))
+        self.bricks: dict[str, subprocess.Popen] = {}  # brickname -> proc
+        self.ports: dict[str, int] = {}  # portmap: brickname -> port
+        self._server: asyncio.AbstractServer | None = None
+        self._txn_lock = asyncio.Lock()
+        self._txn_holder: str | None = None
+
+    # -- store (glusterd-store.c analog) -----------------------------------
+
+    def _load(self) -> dict:
+        try:
+            with open(self._store) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {"volumes": {}, "peers": {}}
+
+    def _save(self) -> None:
+        tmp = self._store + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.state, f, indent=1)
+        os.replace(tmp, self._store)
+
+    # -- service -----------------------------------------------------------
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(self._serve, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self.state["endpoint"] = f"{self.host}:{self.port}"
+        self._save()
+        log.info(10, "glusterd %s on %s:%d (workdir %s)", self.uuid[:8],
+                 self.host, self.port, self.workdir)
+        # restart-resume: bricks of started volumes come back up
+        for vol in self.state["volumes"].values():
+            if vol.get("status") == "started":
+                await self._start_local_bricks(vol)
+        return self.port
+
+    async def stop(self) -> None:
+        for name in list(self.bricks):
+            self._kill_brick(name)
+        if self._server is not None:
+            self._server.close()
+            for w in list(getattr(self, "_writers", [])):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _serve(self, reader, writer) -> None:
+        self._writers = getattr(self, "_writers", set())
+        self._writers.add(writer)
+        try:
+            while True:
+                try:
+                    rec = await wire.read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                xid, mtype, payload = wire.unpack(rec)
+                try:
+                    method, kwargs = payload
+                    fn = getattr(self, "op_" + method.replace("-", "_"),
+                                 None)
+                    if fn is None:
+                        raise MgmtError(f"unknown op {method!r}")
+                    ret = fn(**(kwargs or {}))
+                    if asyncio.iscoroutine(ret):
+                        ret = await ret
+                    resp = (wire.MT_REPLY, ret)
+                except (MgmtError, FopError) as e:
+                    resp = (wire.MT_ERROR, FopError(
+                        getattr(e, "err", 22), str(e)))
+                except Exception as e:
+                    log.error(11, "mgmt op failed: %r", e)
+                    resp = (wire.MT_ERROR, FopError(5, repr(e)))
+                try:
+                    writer.write(wire.pack(xid, *resp))
+                    await writer.drain()
+                except ConnectionError:
+                    break
+        finally:
+            self._writers.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    # -- peers (glusterd-sm.c peer membership) -----------------------------
+
+    async def op_peer_probe(self, host: str, port: int) -> dict:
+        async with MgmtClient(host, port) as peer:
+            info = await peer.call("peer-hello", me=self._peer_info())
+        self.state["peers"][info["uuid"]] = info
+        self._save()
+        return {"ok": True, "peer": info}
+
+    def op_peer_hello(self, me: dict) -> dict:
+        self.state["peers"][me["uuid"]] = me
+        self._save()
+        return self._peer_info()
+
+    def op_peer_status(self) -> dict:
+        return {"me": self._peer_info(),
+                "peers": list(self.state["peers"].values())}
+
+    def _peer_info(self) -> dict:
+        return {"uuid": self.uuid, "host": self.host, "port": self.port,
+                "workdir": self.workdir}
+
+    def _all_nodes(self) -> list[dict]:
+        return [self._peer_info()] + [
+            p for p in self.state["peers"].values()
+            if p["uuid"] != self.uuid]
+
+    # -- txn engine (lock -> stage -> commit, glusterd-op-sm.h:28-43) ------
+
+    def op_txn_lock(self, holder: str) -> dict:
+        # single-threaded event loop: check-and-set is atomic here
+        if self._txn_holder is not None and self._txn_holder != holder:
+            raise MgmtError(f"cluster busy (locked by {self._txn_holder})")
+        self._txn_holder = holder
+        return {"ok": True}
+
+    def op_txn_unlock(self, holder: str) -> dict:
+        if self._txn_holder == holder:
+            self._txn_holder = None
+        return {"ok": True}
+
+    async def op_txn_stage(self, op: str, payload: dict) -> dict:
+        fn = getattr(self, "stage_" + op.replace("-", "_"), None)
+        if fn is not None:
+            fn(**payload)
+        return {"ok": True}
+
+    async def op_txn_commit(self, op: str, payload: dict) -> dict:
+        fn = getattr(self, "commit_" + op.replace("-", "_"))
+        ret = fn(**payload)
+        if asyncio.iscoroutine(ret):
+            ret = await ret
+        return {"ok": True, "result": ret}
+
+    async def _cluster_txn(self, op: str, payload: dict) -> list:
+        """Run lock/stage/commit across all nodes (originator drives)."""
+        nodes = self._all_nodes()
+        holder = self.uuid
+        locked: list[dict] = []
+        try:
+            for n in nodes:
+                await self._node_call(n, "txn-lock", holder=holder)
+                locked.append(n)
+            for n in nodes:
+                await self._node_call(n, "txn-stage", op=op, payload=payload)
+            results = []
+            for n in nodes:
+                results.append(await self._node_call(
+                    n, "txn-commit", op=op, payload=payload))
+            return results
+        finally:
+            for n in locked:
+                try:
+                    await self._node_call(n, "txn-unlock", holder=holder)
+                except Exception:
+                    pass
+
+    async def _node_call(self, node: dict, method: str, **kwargs):
+        if node["uuid"] == self.uuid:
+            fn = getattr(self, "op_" + method.replace("-", "_"))
+            ret = fn(**kwargs)
+            if asyncio.iscoroutine(ret):
+                ret = await ret
+            return ret
+        async with MgmtClient(node["host"], node["port"]) as c:
+            return await c.call(method, **kwargs)
+
+    # -- volume ops --------------------------------------------------------
+
+    async def op_volume_create(self, name: str, vtype: str,
+                               bricks: list, redundancy: int = 2,
+                               group_size: int = 0) -> dict:
+        """bricks: list of {host, port(optional: mgmt node), path} or
+        'host:/path' strings; host must match a node's host:port mgmt id
+        or 'localhost'."""
+        if name in self.state["volumes"]:
+            raise MgmtError(f"volume {name} exists")
+        parsed = []
+        for i, b in enumerate(bricks):
+            if isinstance(b, str):
+                nodeid, _, path = b.partition(":")
+                b = {"node": nodeid, "path": path}
+            parsed.append({
+                "index": i, "node": b.get("node", self.uuid),
+                "host": b.get("host", "127.0.0.1"),
+                "path": b["path"],
+                "name": f"{name}-brick-{i}",
+            })
+        volinfo = {
+            "name": name, "type": vtype, "bricks": parsed,
+            "redundancy": redundancy, "status": "created",
+            "options": {}, "id": str(uuid.uuid4()),
+        }
+        if group_size:
+            volinfo["group-size"] = group_size
+        if vtype == "disperse":
+            n = len(parsed)
+            g = group_size or n
+            if g - redundancy < 1 or g % 1 or n % g:
+                raise MgmtError("bad disperse geometry")
+        await self._cluster_txn("volume-create", {"volinfo": volinfo})
+        return {"ok": True, "volume": name}
+
+    def commit_volume_create(self, volinfo: dict) -> dict:
+        self.state["volumes"][volinfo["name"]] = volinfo
+        self._save()
+        return {"created": volinfo["name"]}
+
+    def stage_volume_create(self, volinfo: dict) -> None:
+        if volinfo["name"] in self.state["volumes"]:
+            raise MgmtError(f"volume {volinfo['name']} exists here")
+
+    async def op_volume_start(self, name: str) -> dict:
+        self._vol(name)
+        results = await self._cluster_txn("volume-start", {"name": name})
+        # merge every node's portmap and broadcast it (pmap sync)
+        ports: dict[str, int] = {}
+        for r in results:
+            ports.update(r.get("result", {}).get("ports", {}))
+        for node in self._all_nodes():
+            try:
+                await self._node_call(node, "portmap-update",
+                                      name=name, ports=ports)
+            except Exception:
+                pass
+        return {"ok": True, "ports": ports}
+
+    async def commit_volume_start(self, name: str) -> dict:
+        vol = self._vol(name)
+        vol["status"] = "started"
+        self._save()
+        await self._start_local_bricks(vol)
+        return {"started": name,
+                "ports": {b["name"]: self.ports[b["name"]]
+                          for b in vol["bricks"]
+                          if b["name"] in self.ports}}
+
+    def op_portmap_update(self, name: str, ports: dict) -> dict:
+        vol = self._vol(name)
+        for b in vol["bricks"]:
+            if b["name"] in ports:
+                b["port"] = ports[b["name"]]
+        self.ports.update(ports)
+        self._save()
+        return {"ok": True}
+
+    async def op_volume_stop(self, name: str) -> dict:
+        await self._cluster_txn("volume-stop", {"name": name})
+        return {"ok": True}
+
+    def commit_volume_stop(self, name: str) -> dict:
+        vol = self._vol(name)
+        vol["status"] = "stopped"
+        self._save()
+        for b in vol["bricks"]:
+            if b["node"] == self.uuid:
+                self._kill_brick(b["name"])
+        return {"stopped": name}
+
+    async def op_volume_delete(self, name: str) -> dict:
+        vol = self._vol(name)
+        if vol["status"] == "started":
+            raise MgmtError("stop the volume first")
+        await self._cluster_txn("volume-delete", {"name": name})
+        return {"ok": True}
+
+    def commit_volume_delete(self, name: str) -> dict:
+        self.state["volumes"].pop(name, None)
+        self._save()
+        return {"deleted": name}
+
+    async def op_volume_set(self, name: str, key: str, value: str) -> dict:
+        if key not in volgen.OPTION_MAP:
+            raise MgmtError(f"unknown option {key!r}")
+        await self._cluster_txn("volume-set",
+                                {"name": name, "key": key, "value": value})
+        return {"ok": True}
+
+    def commit_volume_set(self, name: str, key: str, value: str) -> dict:
+        vol = self._vol(name)
+        vol.setdefault("options", {})[key] = value
+        self._save()
+        return {name: {key: value}}
+
+    def op_volume_info(self, name: str | None = None) -> dict:
+        if name:
+            return {name: self._vol(name)}
+        return dict(self.state["volumes"])
+
+    def op_volume_status(self, name: str) -> dict:
+        vol = self._vol(name)
+        bricks = []
+        for b in vol["bricks"]:
+            proc = self.bricks.get(b["name"])
+            bricks.append({
+                "name": b["name"], "path": b["path"], "node": b["node"],
+                "port": self.ports.get(b["name"], 0),
+                "online": proc is not None and proc.poll() is None,
+            })
+        return {"volume": name, "status": vol["status"], "bricks": bricks}
+
+    def op_getspec(self, name: str) -> dict:
+        """Serve the client volfile (__server_getspec analog)."""
+        vol = self._vol(name)
+        if vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        return {"volfile": volgen.build_client_volfile(vol, self.ports),
+                "volname": name}
+
+    def _vol(self, name: str) -> dict:
+        vol = self.state["volumes"].get(name)
+        if vol is None:
+            raise MgmtError(f"no volume {name!r}")
+        return vol
+
+    # -- brick lifecycle (glusterd-utils.c runner + pmap) ------------------
+
+    async def _start_local_bricks(self, vol: dict) -> None:
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid or b["name"] in self.bricks:
+                continue
+            await self._spawn_brick(vol, b)
+
+    async def _spawn_brick(self, vol: dict, b: dict) -> None:
+        bdir = os.path.join(self.workdir, "bricks")
+        os.makedirs(bdir, exist_ok=True)
+        volfile = os.path.join(bdir, b["name"] + ".vol")
+        portfile = os.path.join(bdir, b["name"] + ".port")
+        with open(volfile, "w") as f:
+            f.write(volgen.build_brick_volfile(vol, b))
+        if os.path.exists(portfile):
+            os.unlink(portfile)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "glusterfs_tpu.daemon",
+             "--volfile", volfile, "--listen", "0",
+             "--portfile", portfile, "--top", b["name"]],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        self.bricks[b["name"]] = proc
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if os.path.exists(portfile):
+                with open(portfile) as f:
+                    self.ports[b["name"]] = int(f.read())
+                b["port"] = self.ports[b["name"]]
+                self._save()
+                return
+            if proc.poll() is not None:
+                err = proc.stderr.read().decode()[-2000:]
+                raise MgmtError(f"brick {b['name']} failed: {err}")
+            await asyncio.sleep(0.05)
+        raise MgmtError(f"brick {b['name']} did not start")
+
+    def _kill_brick(self, name: str) -> None:
+        proc = self.bricks.pop(name, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self.ports.pop(name, None)
+
+
+class MgmtClient:
+    """Client for the mgmt RPC (CLI + peers + mounts use this)."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._reader = None
+        self._writer = None
+        self._xid = 0
+
+    async def __aenter__(self):
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port)
+        return self
+
+    async def __aexit__(self, *exc):
+        if self._writer is not None:
+            self._writer.close()
+        return False
+
+    async def call(self, method: str, **kwargs) -> Any:
+        self._xid += 1
+        self._writer.write(wire.pack(self._xid, wire.MT_CALL,
+                                     [method, kwargs]))
+        await self._writer.drain()
+        rec = await wire.read_frame(self._reader)
+        _, mtype, payload = wire.unpack(rec)
+        if mtype == wire.MT_ERROR:
+            raise payload if isinstance(payload, FopError) else \
+                MgmtError(str(payload))
+        return payload
+
+
+async def mount_volume(host: str, port: int, volname: str):
+    """Fetch the client volfile from glusterd and build a mounted client
+    (the glfs_set_volfile_server + GETSPEC path, api/src/glfs-mgmt.c)."""
+    from ..api.glfs import Client
+    from ..core.graph import Graph
+
+    async with MgmtClient(host, port) as c:
+        spec = await c.call("getspec", name=volname)
+    graph = Graph.construct(spec["volfile"])
+    client = Client(graph)
+    await client.mount()
+    # wait for the protocol clients to finish their handshakes (the
+    # reference blocks the mount until CHILD_UP reaches the top)
+    from ..protocol.client import ClientLayer
+
+    prot = [l for l in graph.by_name.values()
+            if isinstance(l, ClientLayer)]
+    deadline = asyncio.get_running_loop().time() + 15
+    while asyncio.get_running_loop().time() < deadline:
+        if all(p.connected for p in prot):
+            break
+        await asyncio.sleep(0.05)
+    return client
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="gftpu-glusterd")
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--listen", type=int, default=24007)
+    p.add_argument("--portfile", default="")
+    args = p.parse_args(argv)
+
+    async def run():
+        d = Glusterd(args.workdir, args.host, args.listen)
+        await d.start()
+        if args.portfile:
+            with open(args.portfile + ".tmp", "w") as f:
+                f.write(str(d.port))
+            os.replace(args.portfile + ".tmp", args.portfile)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await d.stop()
+
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

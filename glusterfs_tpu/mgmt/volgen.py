@@ -1,0 +1,195 @@
+"""Volfile generation — the glusterd-volgen analog.
+
+Reference: xlators/mgmt/glusterd/src/glusterd-volgen.c (build_client_graph
+:71, server_graph_table :2526, volgen_write_volfile :986) and the option
+map glusterd-volume-set.c: ``gluster volume set`` keys map to layer
+options, and volgen assembles the brick-side and client-side graphs from
+volinfo.
+
+Graph shapes produced (mirroring the reference's defaults):
+
+brick volfile:   posix -> locks -> [io-stats] (served by the brick daemon)
+client volfile:  protocol/client per brick -> cluster layer (disperse /
+                 replicate / distribute / distributed-X) -> performance
+                 layers (per options) -> [io-stats top]
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# volume-set key -> (layer type, option name)  (glusterd-volume-set.c map)
+OPTION_MAP = {
+    "disperse.cpu-extensions": ("cluster/disperse", "cpu-extensions"),
+    "disperse.read-policy": ("cluster/disperse", "read-policy"),
+    "disperse.quorum-count": ("cluster/disperse", "quorum-count"),
+    "disperse.self-heal-window-size": ("cluster/disperse",
+                                       "self-heal-window-size"),
+    "cluster.quorum-count": ("cluster/replicate", "quorum-count"),
+    "cluster.read-hash-mode": ("cluster/replicate", "read-hash-mode"),
+    "cluster.favorite-child-policy": ("cluster/replicate", "favorite-child"),
+    "cluster.lookup-unhashed": ("cluster/distribute", "lookup-unhashed"),
+    "cluster.min-free-disk": ("cluster/distribute", "min-free-disk"),
+    "network.ping-timeout": ("protocol/client", "ping-timeout"),
+    "performance.write-behind": ("performance/write-behind", "__enable__"),
+    "performance.write-behind-window-size": ("performance/write-behind",
+                                             "window-size"),
+    "performance.io-cache": ("performance/io-cache", "__enable__"),
+    "performance.cache-size": ("performance/io-cache", "cache-size"),
+    "performance.read-ahead": ("performance/read-ahead", "__enable__"),
+    "performance.read-ahead-page-count": ("performance/read-ahead",
+                                          "page-count"),
+    "performance.md-cache": ("performance/md-cache", "__enable__"),
+    "performance.md-cache-timeout": ("performance/md-cache", "timeout"),
+    "performance.quick-read": ("performance/quick-read", "__enable__"),
+    "performance.open-behind": ("performance/open-behind", "__enable__"),
+    "performance.nl-cache": ("performance/nl-cache", "__enable__"),
+    "performance.readdir-ahead": ("performance/readdir-ahead", "__enable__"),
+    "performance.io-thread-count": ("performance/io-threads",
+                                    "thread-count"),
+    "diagnostics.latency-measurement": ("debug/io-stats",
+                                        "latency-measurement"),
+    "features.read-only": ("features/read-only", "__enable__"),
+    "features.worm": ("features/worm", "__enable__"),
+    "features.quota": ("features/quota", "__enable__"),
+    "features.trash": ("features/trash", "__enable__"),
+    "features.shard": ("features/shard", "__enable__"),
+    "features.shard-block-size": ("features/shard", "shard-block-size"),
+}
+
+# default client-side performance stack, bottom -> top (volgen's
+# perfxl_option_handlers order); each gated by its enable key
+DEFAULT_PERF_STACK = [
+    ("performance/write-behind", "performance.write-behind", True),
+    ("performance/read-ahead", "performance.read-ahead", False),
+    ("performance/readdir-ahead", "performance.readdir-ahead", False),
+    ("performance/io-cache", "performance.io-cache", False),
+    ("performance/quick-read", "performance.quick-read", False),
+    ("performance/open-behind", "performance.open-behind", False),
+    ("performance/md-cache", "performance.md-cache", True),
+    ("performance/nl-cache", "performance.nl-cache", False),
+]
+
+
+def _bool(v: Any) -> bool:
+    return str(v).lower() in ("1", "on", "yes", "true", "enable", "enabled")
+
+
+def _emit(name: str, type_name: str, options: dict[str, Any],
+          subvols: list[str]) -> str:
+    out = [f"volume {name}", f"    type {type_name}"]
+    for k, v in options.items():
+        out.append(f"    option {k} {v}")
+    if subvols:
+        out.append(f"    subvolumes {' '.join(subvols)}")
+    out.append("end-volume\n")
+    return "\n".join(out)
+
+
+def layer_options(volinfo: dict, layer_type: str) -> dict[str, Any]:
+    """Options set on the volume that target layer_type."""
+    out = {}
+    for key, val in volinfo.get("options", {}).items():
+        m = OPTION_MAP.get(key)
+        if m and m[0] == layer_type and m[1] != "__enable__":
+            out[m[1]] = val
+    return out
+
+
+def _enabled(volinfo: dict, enable_key: str, default: bool) -> bool:
+    val = volinfo.get("options", {}).get(enable_key)
+    return default if val is None else _bool(val)
+
+
+def build_brick_volfile(volinfo: dict, brick: dict) -> str:
+    """posix -> locks -> io-stats (server_graph_table order, trimmed)."""
+    name = brick["name"]
+    out = [_emit(f"{name}-posix", "storage/posix",
+                 {"directory": brick["path"]}, [])]
+    out.append(_emit(f"{name}-locks", "features/locks", {},
+                     [f"{name}-posix"]))
+    top = f"{name}-locks"
+    if _enabled(volinfo, "features.quota", False):
+        out.append(_emit(f"{name}-quota", "features/quota",
+                         layer_options(volinfo, "features/quota"), [top]))
+        top = f"{name}-quota"
+    if _enabled(volinfo, "features.read-only", False):
+        out.append(_emit(f"{name}-ro", "features/read-only", {}, [top]))
+        top = f"{name}-ro"
+    if _enabled(volinfo, "features.worm", False):
+        out.append(_emit(f"{name}-worm", "features/worm", {}, [top]))
+        top = f"{name}-worm"
+    if _enabled(volinfo, "features.trash", False):
+        out.append(_emit(f"{name}-trash", "features/trash", {}, [top]))
+        top = f"{name}-trash"
+    out.append(_emit(name, "debug/io-stats",
+                     layer_options(volinfo, "debug/io-stats"), [top]))
+    return "\n".join(out)
+
+
+def build_client_volfile(volinfo: dict,
+                         ports: dict[str, int] | None = None) -> str:
+    """protocol/client fan-in -> cluster layer(s) -> perf stack
+    (build_client_graph analog)."""
+    vtype = volinfo["type"]
+    bricks = volinfo["bricks"]
+    ports = ports or {}
+    out = []
+    names = []
+    for b in bricks:
+        cname = f"{volinfo['name']}-client-{b['index']}"
+        opts = {"remote-host": b["host"],
+                "remote-port": ports.get(b["name"], b.get("port", 0)),
+                "remote-subvolume": b["name"]}
+        opts.update(layer_options(volinfo, "protocol/client"))
+        out.append(_emit(cname, "protocol/client", opts, []))
+        names.append(cname)
+
+    def cluster_over(children: list[str], idx: int = 0) -> str:
+        vname = volinfo["name"]
+        if vtype == "disperse":
+            lname = f"{vname}-disperse-{idx}"
+            opts = {"redundancy": volinfo.get("redundancy", 2)}
+            opts.update(layer_options(volinfo, "cluster/disperse"))
+            out.append(_emit(lname, "cluster/disperse", opts, children))
+        elif vtype == "replicate":
+            lname = f"{vname}-replicate-{idx}"
+            opts = layer_options(volinfo, "cluster/replicate")
+            out.append(_emit(lname, "cluster/replicate", opts, children))
+        else:
+            raise ValueError(vtype)
+        return lname
+
+    if vtype == "distribute":
+        opts = layer_options(volinfo, "cluster/distribute")
+        top = f"{volinfo['name']}-dht"
+        out.append(_emit(top, "cluster/distribute", opts, names))
+    elif vtype in ("disperse", "replicate"):
+        group = volinfo.get("group-size", len(names))
+        if len(names) > group:  # distributed-disperse / -replicate
+            subs = [cluster_over(names[i:i + group], i // group)
+                    for i in range(0, len(names), group)]
+            top = f"{volinfo['name']}-dht"
+            out.append(_emit(top, "cluster/distribute",
+                             layer_options(volinfo, "cluster/distribute"),
+                             subs))
+        else:
+            top = cluster_over(names)
+    else:
+        raise ValueError(f"unknown volume type {vtype!r}")
+
+    if _enabled(volinfo, "features.shard", False):
+        out.append(_emit(f"{volinfo['name']}-shard", "features/shard",
+                         layer_options(volinfo, "features/shard"), [top]))
+        top = f"{volinfo['name']}-shard"
+
+    for ltype, key, default in DEFAULT_PERF_STACK:
+        if _enabled(volinfo, key, default):
+            lname = f"{volinfo['name']}-{ltype.split('/')[1]}"
+            out.append(_emit(lname, ltype, layer_options(volinfo, ltype),
+                             [top]))
+            top = lname
+
+    out.append(_emit(volinfo["name"], "debug/io-stats",
+                     layer_options(volinfo, "debug/io-stats"), [top]))
+    return "\n".join(out)

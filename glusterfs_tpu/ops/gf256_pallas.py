@@ -35,6 +35,12 @@ from . import gf256
 # Lane tile for uint8 is (32, 128); keep W tiles big to amortize grid overhead.
 _TILE_W = 8192
 
+# xor3 kernel geometry: plane rows viewed as (M, 128) so every row slice is
+# a full (BM, 128) vreg tile (8 sublanes x 128 lanes fully used; the 2-D
+# kernel's (1, W) slices waste 7/8 of each vreg).
+_TILE3_M = 256  # measured best on v5e (probe: 44 GiB/s e2e encode 4+2)
+_TILE3_W = _TILE3_M * 128  # bytes per plane row per grid step (32 KiB)
+
 # VMEM working-set budget for the mxu kernel (the int32 matmul output
 # dominates at R rows x 8*tile int32); stay well under the ~16 MiB more
 # conservative TPU VMEM sizes.
@@ -93,6 +99,53 @@ def _mxu_kernel(a_ref, x_ref, o_ref):
     for b in range(1, 8):
         acc = acc | ((y[:, b * tw : (b + 1) * tw] & 1) << b)
     o_ref[:] = acc.astype(jnp.uint8)
+
+
+def _xor3_kernel_body(sels: tuple[tuple[int, ...], ...]):
+    """out[r] = XOR of x[j] for j in sels[r], on (BM, 128) row tiles."""
+
+    def kernel(x_ref, o_ref):
+        x = x_ref[:]
+        for r, sel in enumerate(sels):
+            if not sel:
+                o_ref[r] = jnp.zeros_like(o_ref[r])
+                continue
+            acc = x[sel[0]]
+            for j in sel[1:]:
+                acc = acc ^ x[j]
+            o_ref[r] = acc
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=256)
+def _xor3_apply_fn(sels: tuple[tuple[int, ...], ...], c: int,
+                   interpret: bool):
+    """(C, W) uint8 -> (R, W) uint8; W % _TILE3_W == 0; 3-D tiled."""
+    r = len(sels)
+    kernel = _xor3_kernel_body(sels)
+
+    @jax.jit
+    def run(x):
+        w = x.shape[1]
+        m = w // 128
+        x3 = x.reshape(c, m, 128)
+        grid = (m // _TILE3_M,)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((r, m, 128), jnp.uint8),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((c, _TILE3_M, 128), lambda i: (0, i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((r, _TILE3_M, 128), lambda i: (0, i, 0),
+                                   memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(x3)
+        return out.reshape(r, w)
+
+    return run
 
 
 @functools.lru_cache(maxsize=256)
@@ -162,13 +215,18 @@ def apply_bitmatrix(
 
     W must be a multiple of _TILE_W (callers pad stripes accordingly).
     """
-    if formulation not in ("xor", "mxu"):
-        raise ValueError(f"formulation must be 'xor' or 'mxu', got {formulation!r}")
+    if formulation not in ("xor", "xor3", "mxu"):
+        raise ValueError(
+            f"formulation must be 'xor', 'xor3' or 'mxu', got {formulation!r}")
     r, c = abits.shape
     if x.shape[0] != c:
         raise ValueError(f"plane rows {x.shape[0]} != bitmatrix columns {c}")
     if x.shape[1] % _TILE_W:
         raise ValueError(f"W must be a multiple of {_TILE_W}")
+    if formulation == "xor3":
+        if x.shape[1] % _TILE3_W:
+            raise ValueError(f"W must be a multiple of {_TILE3_W} for xor3")
+        return _xor3_apply_fn(_sels_from_bits(abits), c, interpret)(x)
     if formulation == "xor":
         return _xor_apply_fn(_sels_from_bits(abits), c, interpret)(x)
     return _mxu_apply_fn(r, c, interpret)(jnp.asarray(abits, jnp.int8), x)
@@ -180,8 +238,9 @@ def apply_bitmatrix(
 
 
 def _pad_w(s: int) -> int:
-    """Stripes padded so plane width S*64 is a multiple of _TILE_W."""
-    per = _TILE_W // gf256.WORD_SIZE  # stripes per tile
+    """Stripes padded so plane width S*64 is a multiple of every kernel's
+    tile (_TILE3_W = 32 KiB covers _TILE_W = 8 KiB too)."""
+    per = _TILE3_W // gf256.WORD_SIZE  # stripes per tile
     return (s + per - 1) // per * per
 
 
@@ -228,7 +287,7 @@ def _decode_fn(k: int, formulation: str, interpret: bool,
             .reshape(s * k * gf256.CHUNK_SIZE)
         )
 
-    if formulation == "xor":
+    if formulation in ("xor", "xor3"):
         bb = np.array(static_bbits, dtype=np.uint8)
         return jax.jit(lambda frags: run(frags, bb))
     return jax.jit(run)
@@ -246,8 +305,9 @@ def decode(frags, rows, k: int, formulation: str = "xor",
            interpret: bool = False) -> np.ndarray:
     frags = np.ascontiguousarray(frags, dtype=np.uint8)
     bbits_np = gf256.decode_bits_cached(k, tuple(int(x) for x in rows))
-    if formulation == "xor":
-        fn = _decode_fn(k, "xor", interpret, tuple(map(tuple, bbits_np)))
+    if formulation in ("xor", "xor3"):
+        fn = _decode_fn(k, formulation, interpret,
+                        tuple(map(tuple, bbits_np)))
         return np.asarray(fn(jnp.asarray(frags)))
     fn = _decode_fn(k, "mxu", interpret, None)
     return np.asarray(fn(jnp.asarray(frags), jnp.asarray(bbits_np, jnp.int8)))

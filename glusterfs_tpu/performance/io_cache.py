@@ -1,0 +1,94 @@
+"""performance/io-cache — page cache for reads.
+
+Reference: xlators/performance/io-cache (3.9k LoC): page-granular read
+cache (rbthash + LRU), invalidated by writes/truncates, bounded by
+``cache-size``.
+"""
+
+from __future__ import annotations
+
+import collections
+
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+
+
+@register("performance/io-cache")
+class IoCacheLayer(Layer):
+    OPTIONS = (
+        Option("cache-size", "size", default="32MB", min=4096),
+        Option("page-size", "size", default="128KB", min=4096),
+        Option("cache-timeout", "time", default="1"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        # (gfid, page_index) -> bytes; OrderedDict as LRU
+        self._pages: collections.OrderedDict[tuple, bytes] = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def _evict(self) -> None:
+        limit = self.opts["cache-size"]
+        while self._bytes > limit and self._pages:
+            _, page = self._pages.popitem(last=False)
+            self._bytes -= len(page)
+
+    def _invalidate(self, gfid: bytes) -> None:
+        for key in [k for k in self._pages if k[0] == gfid]:
+            self._bytes -= len(self._pages.pop(key))
+
+    async def _page(self, fd: FdObj, index: int) -> bytes:
+        psz = self.opts["page-size"]
+        key = (fd.gfid, index)
+        page = self._pages.get(key)
+        if page is not None:
+            self.hits += 1
+            self._pages.move_to_end(key)
+            return page
+        self.misses += 1
+        page = await self.children[0].readv(fd, psz, index * psz)
+        self._pages[key] = page
+        self._bytes += len(page)
+        self._evict()
+        return page
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        psz = self.opts["page-size"]
+        out = bytearray()
+        pos = offset
+        end = offset + size
+        while pos < end:
+            idx = pos // psz
+            page = await self._page(fd, idx)
+            start = pos - idx * psz
+            if start >= len(page):
+                break  # EOF
+            take = page[start: min(len(page), start + (end - pos))]
+            out += take
+            if len(page) < psz:  # short page = EOF
+                break
+            pos += len(take)
+        return bytes(out)
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        self._invalidate(fd.gfid)
+        return await self.children[0].writev(fd, data, offset, xdata)
+
+    async def ftruncate(self, fd: FdObj, size: int,
+                        xdata: dict | None = None):
+        self._invalidate(fd.gfid)
+        return await self.children[0].ftruncate(fd, size, xdata)
+
+    async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
+        ia = await self.children[0].truncate(loc, size, xdata)
+        self._invalidate(ia.gfid)
+        return ia
+
+    def dump_private(self) -> dict:
+        return {"pages": len(self._pages), "bytes": self._bytes,
+                "hits": self.hits, "misses": self.misses}

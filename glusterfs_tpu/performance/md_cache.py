@@ -1,0 +1,105 @@
+"""performance/md-cache — stat/xattr cache with timeout + invalidation.
+
+Reference: xlators/performance/md-cache (4.1k LoC): caches iatt and
+xattrs per inode for ``timeout`` seconds; any modifying fop invalidates;
+upcall events (when connected) invalidate remotely-changed entries.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..core.fops import WRITE_FOPS, Fop
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+
+
+@register("performance/md-cache")
+class MdCacheLayer(Layer):
+    OPTIONS = (
+        Option("timeout", "time", default="1", min=0),
+        Option("cache-xattrs", "bool", default="on"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._iatt: dict[bytes, tuple[float, object]] = {}
+        self._xattr: dict[bytes, tuple[float, dict]] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def invalidate(self, gfid: bytes) -> None:
+        self._iatt.pop(gfid, None)
+        self._xattr.pop(gfid, None)
+
+    def _fresh(self, entry) -> bool:
+        return entry is not None and \
+            time.monotonic() - entry[0] < self.opts["timeout"]
+
+    async def lookup(self, loc: Loc, xdata: dict | None = None):
+        if loc.gfid:
+            entry = self._iatt.get(loc.gfid)
+            if self._fresh(entry):
+                self.hits += 1
+                return entry[1], {}
+        self.misses += 1
+        ia, xd = await self.children[0].lookup(loc, xdata)
+        self._iatt[ia.gfid] = (time.monotonic(), ia)
+        return ia, xd
+
+    async def stat(self, loc: Loc, xdata: dict | None = None):
+        if loc.gfid:
+            entry = self._iatt.get(loc.gfid)
+            if self._fresh(entry):
+                self.hits += 1
+                return entry[1]
+        self.misses += 1
+        ia = await self.children[0].stat(loc, xdata)
+        self._iatt[ia.gfid] = (time.monotonic(), ia)
+        return ia
+
+    async def fstat(self, fd: FdObj, xdata: dict | None = None):
+        entry = self._iatt.get(fd.gfid)
+        if self._fresh(entry):
+            self.hits += 1
+            return entry[1]
+        self.misses += 1
+        ia = await self.children[0].fstat(fd, xdata)
+        self._iatt[ia.gfid] = (time.monotonic(), ia)
+        return ia
+
+    async def getxattr(self, loc: Loc, name: str | None = None,
+                       xdata: dict | None = None):
+        if self.opts["cache-xattrs"] and loc.gfid and name is not None:
+            entry = self._xattr.get(loc.gfid)
+            if self._fresh(entry) and name in entry[1]:
+                self.hits += 1
+                return {name: entry[1][name]}
+        out = await self.children[0].getxattr(loc, name, xdata)
+        if self.opts["cache-xattrs"] and loc.gfid:
+            t, cur = self._xattr.get(loc.gfid, (0, {}))
+            cur = dict(cur)
+            cur.update(out)
+            self._xattr[loc.gfid] = (time.monotonic(), cur)
+        return out
+
+    def dump_private(self) -> dict:
+        return {"iatts": len(self._iatt), "hits": self.hits,
+                "misses": self.misses}
+
+
+def _invalidating(op_name: str):
+    async def fop(self, *args, **kwargs):
+        ret = await getattr(self.children[0], op_name)(*args, **kwargs)
+        for a in args:
+            if isinstance(a, Loc) and a.gfid:
+                self.invalidate(a.gfid)
+            elif isinstance(a, FdObj):
+                self.invalidate(a.gfid)
+        return ret
+    fop.__name__ = op_name
+    return fop
+
+
+for _f in WRITE_FOPS:
+    setattr(MdCacheLayer, _f.value, _invalidating(_f.value))

@@ -1,0 +1,68 @@
+"""performance/nl-cache — negative-lookup cache.
+
+Reference: xlators/performance/nl-cache (2.3k LoC): remember ENOENT
+lookups so repeated misses (e.g. PATH searches) skip the wire; any
+entry-creating fop in the parent invalidates."""
+
+from __future__ import annotations
+
+import errno
+import time
+
+from ..core.fops import FopError
+from ..core.layer import Layer, Loc, register
+from ..core.options import Option
+
+
+@register("performance/nl-cache")
+class NlCacheLayer(Layer):
+    OPTIONS = (
+        Option("nl-cache-timeout", "time", default="60"),
+        Option("nl-cache-limit", "int", default=65536),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._neg: dict[str, float] = {}
+        self.hits = 0
+
+    def _key(self, loc: Loc) -> str:
+        return loc.path
+
+    def _invalidate_parent(self, path: str) -> None:
+        self._neg.pop(path, None)
+
+    async def lookup(self, loc: Loc, xdata: dict | None = None):
+        key = self._key(loc)
+        t = self._neg.get(key)
+        if t is not None:
+            if time.monotonic() - t < self.opts["nl-cache-timeout"]:
+                self.hits += 1
+                raise FopError(errno.ENOENT, f"{key} (cached)")
+            del self._neg[key]
+        try:
+            return await self.children[0].lookup(loc, xdata)
+        except FopError as e:
+            if e.err == errno.ENOENT:
+                if len(self._neg) < self.opts["nl-cache-limit"]:
+                    self._neg[key] = time.monotonic()
+            raise
+
+    def dump_private(self) -> dict:
+        return {"negative_entries": len(self._neg), "hits": self.hits}
+
+
+def _creating(op_name: str, loc_arg: int):
+    async def fop(self, *args, **kwargs):
+        ret = await getattr(self.children[0], op_name)(*args, **kwargs)
+        loc = args[loc_arg]
+        if isinstance(loc, Loc):
+            self._invalidate_parent(loc.path)
+        return ret
+    fop.__name__ = op_name
+    return fop
+
+
+for _op, _idx in (("create", 0), ("mkdir", 0), ("mknod", 0),
+                  ("symlink", 1), ("link", 1), ("rename", 1)):
+    setattr(NlCacheLayer, _op, _creating(_op, _idx))

@@ -1,0 +1,70 @@
+"""performance/open-behind — defer open() until a fop needs the fd.
+
+Reference: xlators/performance/open-behind (1.2k LoC): open returns
+immediately; the real open is wound lazily on first fd use (helps
+open/read/close small-file workloads)."""
+
+from __future__ import annotations
+
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+
+
+class _ObCtx:
+    __slots__ = ("loc", "flags", "real_fd")
+
+    def __init__(self, loc: Loc, flags: int):
+        self.loc = loc
+        self.flags = flags
+        self.real_fd: FdObj | None = None
+
+
+@register("performance/open-behind")
+class OpenBehindLayer(Layer):
+    OPTIONS = (
+        Option("lazy-open", "bool", default="on"),
+    )
+
+    async def open(self, loc: Loc, flags: int = 0, xdata: dict | None = None):
+        if not self.opts["lazy-open"]:
+            return await self.children[0].open(loc, flags, xdata)
+        # validate existence cheaply, defer the real open
+        ia, _ = await self.children[0].lookup(loc)
+        fd = FdObj(ia.gfid, flags, path=loc.path)
+        fd.ctx_set(self, _ObCtx(Loc(loc.path, gfid=ia.gfid), flags))
+        return fd
+
+    async def _real(self, fd: FdObj) -> FdObj:
+        ctx: _ObCtx | None = fd.ctx_get(self)
+        if ctx is None:
+            return fd  # not ours (e.g. create path)
+        if ctx.real_fd is None:
+            ctx.real_fd = await self.children[0].open(ctx.loc, ctx.flags)
+        return ctx.real_fd
+
+    async def release(self, fd: FdObj):
+        ctx: _ObCtx | None = fd.ctx_del(self)
+        if ctx is not None:
+            if ctx.real_fd is not None:
+                await super().release(ctx.real_fd)
+            return
+        await super().release(fd)
+
+    def dump_private(self) -> dict:
+        return {"lazy_open": self.opts["lazy-open"]}
+
+
+def _lazy(op_name: str):
+    async def fop(self, fd: FdObj, *args, **kwargs):
+        real = await self._real(fd)
+        return await getattr(self.children[0], op_name)(real, *args,
+                                                        **kwargs)
+    fop.__name__ = op_name
+    return fop
+
+
+for _op in ("readv", "writev", "fstat", "fsync", "flush", "ftruncate",
+            "fgetxattr", "fsetxattr", "fxattrop", "fremovexattr", "seek",
+            "fallocate", "discard", "zerofill", "rchecksum", "lk",
+            "fsetattr"):
+    setattr(OpenBehindLayer, _op, _lazy(_op))

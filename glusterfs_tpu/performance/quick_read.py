@@ -1,0 +1,74 @@
+"""performance/quick-read — small-file content cache.
+
+Reference: xlators/performance/quick-read (1.8k LoC): content of files
+under ``max-file-size`` is cached whole so repeated small-file reads
+skip the data path (the reference piggybacks content on lookup; here it
+is filled on first read and invalidated on writes)."""
+
+from __future__ import annotations
+
+import collections
+import time
+
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+
+
+@register("performance/quick-read")
+class QuickReadLayer(Layer):
+    OPTIONS = (
+        Option("max-file-size", "size", default="64KB", min=0),
+        Option("cache-size", "size", default="16MB"),
+        Option("cache-timeout", "time", default="1"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._files: collections.OrderedDict[bytes, tuple[float, bytes]] = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+
+    def _invalidate(self, gfid: bytes) -> None:
+        ent = self._files.pop(gfid, None)
+        if ent is not None:
+            self._bytes -= len(ent[1])
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        maxsz = self.opts["max-file-size"]
+        ent = self._files.get(fd.gfid)
+        if ent is not None and \
+                time.monotonic() - ent[0] < self.opts["cache-timeout"]:
+            self.hits += 1
+            self._files.move_to_end(fd.gfid)
+            return ent[1][offset: offset + size]
+        ia = await self.children[0].fstat(fd)
+        if ia.size <= maxsz:
+            content = await self.children[0].readv(fd, maxsz + 1, 0)
+            self._files[fd.gfid] = (time.monotonic(), content)
+            self._bytes += len(content)
+            while self._bytes > self.opts["cache-size"] and self._files:
+                _, (_, old) = self._files.popitem(last=False)
+                self._bytes -= len(old)
+            return content[offset: offset + size]
+        return await self.children[0].readv(fd, size, offset, xdata)
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        self._invalidate(fd.gfid)
+        return await self.children[0].writev(fd, data, offset, xdata)
+
+    async def ftruncate(self, fd: FdObj, size: int,
+                        xdata: dict | None = None):
+        self._invalidate(fd.gfid)
+        return await self.children[0].ftruncate(fd, size, xdata)
+
+    async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
+        ia = await self.children[0].truncate(loc, size, xdata)
+        self._invalidate(ia.gfid)
+        return ia
+
+    def dump_private(self) -> dict:
+        return {"files": len(self._files), "bytes": self._bytes,
+                "hits": self.hits}

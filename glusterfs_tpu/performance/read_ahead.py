@@ -1,0 +1,99 @@
+"""performance/read-ahead — sequential read prefetch.
+
+Reference: xlators/performance/read-ahead (2.1k LoC): detect sequential
+access per fd and prefetch ``page-count`` pages ahead, dropping the
+cache on writes/seeks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..core.layer import FdObj, Layer, register
+from ..core.options import Option
+
+
+class _RaFd:
+    __slots__ = ("next_offset", "pages", "task")
+
+    def __init__(self):
+        self.next_offset = 0
+        self.pages: dict[int, bytes] = {}
+        self.task: asyncio.Task | None = None
+
+
+@register("performance/read-ahead")
+class ReadAheadLayer(Layer):
+    OPTIONS = (
+        Option("page-count", "int", default=4, min=1, max=16),
+        Option("page-size", "size", default="128KB", min=4096),
+    )
+
+    def _ctx(self, fd: FdObj) -> _RaFd:
+        ctx = fd.ctx_get(self)
+        if ctx is None:
+            ctx = _RaFd()
+            fd.ctx_set(self, ctx)
+        return ctx
+
+    async def _prefetch(self, fd: FdObj, start_page: int) -> None:
+        psz = self.opts["page-size"]
+        ctx = self._ctx(fd)
+        for i in range(self.opts["page-count"]):
+            idx = start_page + i
+            if idx in ctx.pages:
+                continue
+            try:
+                page = await self.children[0].readv(fd, psz, idx * psz)
+            except Exception:
+                return
+            ctx.pages[idx] = page
+            if len(ctx.pages) > 4 * self.opts["page-count"]:
+                ctx.pages.pop(min(ctx.pages))
+            if len(page) < psz:
+                return
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        ctx = self._ctx(fd)
+        psz = self.opts["page-size"]
+        sequential = offset == ctx.next_offset
+        ctx.next_offset = offset + size
+        # serve from prefetched pages when fully covered
+        idx = offset // psz
+        end = offset + size
+        covered = all((i in ctx.pages) for i in range(idx, (end - 1) // psz + 1))
+        if covered:
+            out = bytearray()
+            pos = offset
+            while pos < end:
+                i = pos // psz
+                page = ctx.pages[i]
+                start = pos - i * psz
+                if start >= len(page):
+                    break
+                take = page[start: min(len(page), start + (end - pos))]
+                out += take
+                if len(page) < psz:
+                    break
+                pos += len(take)
+            data = bytes(out)
+        else:
+            data = await self.children[0].readv(fd, size, offset, xdata)
+        if sequential and len(data) == size:
+            nxt = (end + psz - 1) // psz
+            if ctx.task is None or ctx.task.done():
+                ctx.task = asyncio.create_task(self._prefetch(fd, nxt))
+        return data
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        ctx = self._ctx(fd)
+        ctx.pages.clear()
+        return await self.children[0].writev(fd, data, offset, xdata)
+
+    async def release(self, fd: FdObj):
+        ctx: _RaFd | None = fd.ctx_del(self)
+        if ctx is not None and ctx.task is not None:
+            ctx.task.cancel()
+        await super().release(fd)

@@ -1,0 +1,32 @@
+"""performance/readdir-ahead — directory listing prefetch.
+
+Reference: xlators/performance/readdir-ahead (1.5k LoC): fill the whole
+listing on opendir, serve readdir windows from the buffer."""
+
+from __future__ import annotations
+
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+
+
+@register("performance/readdir-ahead")
+class ReaddirAheadLayer(Layer):
+    OPTIONS = (
+        Option("rda-request-size", "size", default="128KB"),
+    )
+
+    async def opendir(self, loc: Loc, xdata: dict | None = None):
+        fd = await self.children[0].opendir(loc, xdata)
+        try:
+            entries = await self.children[0].readdir(fd, 0, 0)
+            fd.ctx_set(self, entries)
+        except Exception:
+            pass
+        return fd
+
+    async def readdir(self, fd: FdObj, size: int = 0, offset: int = 0,
+                      xdata: dict | None = None):
+        cached = fd.ctx_get(self)
+        if cached is not None:
+            return cached[offset:]
+        return await self.children[0].readdir(fd, size, offset, xdata)

@@ -1,0 +1,131 @@
+"""performance/write-behind — async write aggregation.
+
+Reference: xlators/performance/write-behind (3.3k LoC; doc
+doc/developer-guide/write-behind.md): acknowledge writes immediately,
+coalesce adjacent ones in a per-fd window, flush on fsync/flush/read
+overlap or window pressure, surface deferred errors on the next fop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..core.fops import FopError
+from ..core.layer import FdObj, Layer, register
+from ..core.options import Option
+
+
+class _WbFd:
+    def __init__(self):
+        self.chunks: list[tuple[int, bytearray]] = []  # (offset, data)
+        self.bytes = 0
+        self.error: FopError | None = None
+        self.lock = asyncio.Lock()
+        self.last_iatt = None
+
+
+@register("performance/write-behind")
+class WriteBehindLayer(Layer):
+    OPTIONS = (
+        Option("window-size", "size", default="1MB", min=512),
+        Option("flush-behind", "bool", default="on"),
+        Option("trickling-writes", "bool", default="on"),
+    )
+
+    def _ctx(self, fd: FdObj) -> _WbFd:
+        ctx = fd.ctx_get(self)
+        if ctx is None:
+            ctx = _WbFd()
+            fd.ctx_set(self, ctx)
+        return ctx
+
+    def _absorb(self, ctx: _WbFd, data: bytes, offset: int) -> None:
+        """Coalesce with an adjacent/overlapping chunk when possible."""
+        end = offset + len(data)
+        for i, (coff, cbuf) in enumerate(ctx.chunks):
+            cend = coff + len(cbuf)
+            if offset <= cend and end >= coff:  # overlap or adjacent
+                start = min(coff, offset)
+                merged = bytearray(max(cend, end) - start)
+                merged[coff - start: cend - start] = cbuf
+                merged[offset - start: end - start] = data
+                ctx.bytes += len(merged) - len(cbuf)
+                ctx.chunks[i] = (start, merged)
+                return
+        ctx.chunks.append((offset, bytearray(data)))
+        ctx.bytes += len(data)
+
+    async def _drain(self, fd: FdObj, ctx: _WbFd) -> None:
+        async with ctx.lock:
+            chunks, ctx.chunks, ctx.bytes = ctx.chunks, [], 0
+            for off, buf in sorted(chunks):
+                try:
+                    ctx.last_iatt = await self.children[0].writev(
+                        fd, bytes(buf), off)
+                except FopError as e:
+                    ctx.error = e  # deferred error (wb_fd error analog)
+                    break
+
+    def _raise_deferred(self, ctx: _WbFd) -> None:
+        if ctx.error is not None:
+            err, ctx.error = ctx.error, None
+            raise err
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        ctx = self._ctx(fd)
+        self._raise_deferred(ctx)
+        async with ctx.lock:
+            self._absorb(ctx, bytes(data), offset)
+        if ctx.bytes >= self.opts["window-size"]:
+            await self._drain(fd, ctx)
+            self._raise_deferred(ctx)
+        ia = ctx.last_iatt
+        if ia is None:
+            ia = await self.children[0].fstat(fd)
+        return ia
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        ctx = self._ctx(fd)
+        if ctx.chunks:  # read sees pending writes: flush first
+            await self._drain(fd, ctx)
+        self._raise_deferred(ctx)
+        return await self.children[0].readv(fd, size, offset, xdata)
+
+    async def flush(self, fd: FdObj, xdata: dict | None = None):
+        ctx = self._ctx(fd)
+        await self._drain(fd, ctx)
+        self._raise_deferred(ctx)
+        return await self.children[0].flush(fd, xdata)
+
+    async def fsync(self, fd: FdObj, datasync: int = 0,
+                    xdata: dict | None = None):
+        ctx = self._ctx(fd)
+        await self._drain(fd, ctx)
+        self._raise_deferred(ctx)
+        return await self.children[0].fsync(fd, datasync, xdata)
+
+    async def fstat(self, fd: FdObj, xdata: dict | None = None):
+        ctx = self._ctx(fd)
+        if ctx.chunks:
+            await self._drain(fd, ctx)
+        self._raise_deferred(ctx)
+        return await self.children[0].fstat(fd, xdata)
+
+    async def ftruncate(self, fd: FdObj, size: int,
+                        xdata: dict | None = None):
+        ctx = self._ctx(fd)
+        await self._drain(fd, ctx)
+        self._raise_deferred(ctx)
+        return await self.children[0].ftruncate(fd, size, xdata)
+
+    async def release(self, fd: FdObj):
+        ctx: _WbFd | None = fd.ctx_get(self)
+        if ctx is not None and ctx.chunks:
+            await self._drain(fd, ctx)
+        fd.ctx_del(self)
+        await super().release(fd)
+
+    def dump_private(self) -> dict:
+        return {"window_size": self.opts["window-size"]}

@@ -1,0 +1,212 @@
+"""protocol/server — serves a brick graph over TCP.
+
+Reference: xlators/protocol/server (actor table server-rpc-fops_v2.c:6132,
+per-client fd tables + resolver, auth).  Here: an asyncio TCP service in
+front of a layer graph.  Per-connection state mirrors ``client_t``: an fd
+table (wire FdHandle -> live FdObj), the client's lk-owner prefix, and
+disconnect cleanup that drops fds and lock grants (the reference's lock
+reaping on disconnect).
+
+Protocol: framed records (rpc/wire.py); a CALL carries
+``[fop_name, args, kwargs]``; fd arguments travel as FdHandle; replies
+carry the fop return (or MT_ERROR + FopError).  The handshake
+(SETVOLUME analog) is the first call: ``__handshake__`` with the client
+identity and requested subvolume name.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from ..core.fops import Fop, FopError
+from ..core.layer import FdObj, Layer
+from ..core import gflog
+from ..rpc import wire
+
+log = gflog.get_logger("protocol.server")
+
+_FOPS = {f.value for f in Fop}
+# non-wire-fop methods a client may invoke remotely (heal entry points,
+# introspection — the reference exposes these via separate RPC programs)
+_RPC_EXTRAS = {"heal_info", "heal_file", "heal_entry", "rebalance",
+               "release", "getactivelk"}
+
+
+class _ClientConn:
+    def __init__(self, server: "BrickServer", writer: asyncio.StreamWriter):
+        self.server = server
+        self.writer = writer
+        self.fds: dict[int, FdObj] = {}
+        self.next_fd = 1
+        self.identity = b""
+        self.name = ""
+
+    def register_fd(self, fd: FdObj) -> wire.FdHandle:
+        fdid = self.next_fd
+        self.next_fd += 1
+        self.fds[fdid] = fd
+        return wire.FdHandle(fdid, fd.gfid, fd.path)
+
+    def resolve(self, v: Any) -> Any:
+        if isinstance(v, wire.FdHandle):
+            fd = self.fds.get(v.fdid)
+            if fd is None:
+                raise FopError(77, f"stale fd {v.fdid}")  # EBADFD
+            return fd
+        if isinstance(v, dict):
+            if "__anon_fd__" in v:  # anonymous fd addressed by gfid
+                return FdObj(v["__anon_fd__"], path=v.get("path", ""),
+                             anonymous=True)
+            return {k: self.resolve(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [self.resolve(x) for x in v]
+        return v
+
+    def wrap(self, v: Any) -> Any:
+        if isinstance(v, FdObj):
+            return self.register_fd(v)
+        if isinstance(v, tuple):
+            return [self.wrap(x) for x in v]
+        if isinstance(v, list):
+            return [self.wrap(x) for x in v]
+        if isinstance(v, dict):
+            return {k: self.wrap(x) for k, x in v.items()}
+        return v
+
+
+class BrickServer:
+    """TCP service for one brick graph top (the brick process core)."""
+
+    def __init__(self, top: Layer, host: str = "127.0.0.1", port: int = 0):
+        self.top = top
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self.connections: set[_ClientConn] = set()
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info(1, "brick %s serving on %s:%d", self.top.name, self.host,
+                 self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # close live connections too: since py3.12 wait_closed() also
+            # waits for connection handlers, which would block forever on
+            # clients that keep their sockets open
+            for conn in list(self.connections):
+                try:
+                    conn.writer.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- connection handling ----------------------------------------------
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        conn = _ClientConn(self, writer)
+        self.connections.add(conn)
+        try:
+            while True:
+                try:
+                    rec = await wire.read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                xid, mtype, payload = wire.unpack(rec)
+                if mtype != wire.MT_CALL:
+                    continue
+                resp_type, resp = await self._dispatch(conn, payload)
+                try:
+                    writer.write(wire.pack(xid, resp_type, resp))
+                    await writer.drain()
+                except ConnectionError:
+                    break
+        finally:
+            self.connections.discard(conn)
+            await self._cleanup(conn)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _cleanup(self, conn: _ClientConn) -> None:
+        """Disconnect: release fds + this client's locks (client_t reap)."""
+        for fd in conn.fds.values():
+            rel = getattr(self.top, "release", None)
+            if rel is not None:
+                try:
+                    await rel(fd)
+                except Exception:
+                    pass
+        conn.fds.clear()
+        if conn.identity:
+            layer: Layer | None = self.top
+            seen = set()
+            stack = [self.top]
+            while stack:
+                layer = stack.pop()
+                if id(layer) in seen:
+                    continue
+                seen.add(id(layer))
+                rc = getattr(layer, "release_client", None)
+                if rc is not None:
+                    try:
+                        rc(conn.identity)
+                    except Exception:
+                        pass
+                stack.extend(layer.children)
+
+    async def _dispatch(self, conn: _ClientConn, payload: Any):
+        try:
+            fop_name, args, kwargs = payload
+            if fop_name == "__handshake__":
+                conn.identity = args[0]
+                conn.name = args[1] if len(args) > 1 else ""
+                return wire.MT_REPLY, {"volume": self.top.name, "ok": True}
+            if fop_name == "__ping__":
+                return wire.MT_REPLY, "pong"
+            if fop_name == "__statedump__":
+                return wire.MT_REPLY, _jsonable(self.top.statedump())
+            if fop_name not in _FOPS and fop_name not in _RPC_EXTRAS:
+                raise FopError(95, f"unknown fop {fop_name!r}")
+            fn = getattr(self.top, fop_name, None)
+            if fn is None:
+                raise FopError(95, f"fop {fop_name!r} unsupported")
+            args = conn.resolve(args)
+            kwargs = {k: conn.resolve(v) for k, v in (kwargs or {}).items()}
+            # scope lk-owners to this connection (cross-client isolation)
+            _scope_owner(args, kwargs, conn.identity)
+            ret = fn(*args, **kwargs)
+            if asyncio.iscoroutine(ret):
+                ret = await ret
+            return wire.MT_REPLY, conn.wrap(ret)
+        except FopError as e:
+            return wire.MT_ERROR, e
+        except Exception as e:  # internal error: surface as EIO
+            log.error(2, "dispatch error: %r", e)
+            return wire.MT_ERROR, FopError(5, f"internal: {e!r}")
+
+
+def _scope_owner(args, kwargs, identity: bytes) -> None:
+    """Prefix lk-owner with the connection identity so two clients using
+    the same owner bytes don't alias (frame lk_owner + client uid)."""
+    for container in list(args) + list(kwargs.values()):
+        if isinstance(container, dict) and "lk-owner" in container:
+            container["lk-owner"] = identity + b"/" + container["lk-owner"]
+
+
+def _jsonable(v):
+    if isinstance(v, bytes):
+        return v.hex()
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return v

@@ -1,0 +1,210 @@
+"""Wire codec + framing — the XDR analog.
+
+Reference: rpc/xdr/src/*.x define the wire schema; rpc-lib frames records
+over the socket.  Here: a small tagged binary codec for the value tree a
+fop carries (ints, bytes, strings, lists, dicts, Iatt, Loc, fd handles,
+errors) and length-prefixed frames.  No pickle — only the types below can
+cross the wire (same property XDR gives the reference).
+
+Frame: 4-byte big-endian length, then the record.
+Record: 8-byte header (u32 xid, u8 mtype, 3 reserved) + body.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+from ..core.fops import FopError
+from ..core.iatt import IAType, Iatt
+from ..core.layer import Loc
+
+MT_CALL = 1
+MT_REPLY = 2
+MT_ERROR = 3
+MT_EVENT = 4  # server -> client notifications (upcall channel analog)
+
+_HDR = struct.Struct(">IBxxx")
+
+# value tags
+_T_NONE, _T_TRUE, _T_FALSE = 0, 1, 2
+_T_INT, _T_NEGINT, _T_FLOAT = 3, 4, 5
+_T_BYTES, _T_STR = 6, 7
+_T_LIST, _T_DICT = 8, 9
+_T_IATT, _T_LOC, _T_FD, _T_ERR = 10, 11, 12, 13
+
+
+class WireError(Exception):
+    pass
+
+
+class FdHandle:
+    """A remote fd reference (server-side fd table slot) carrying the fd
+    identity so the client can reconstruct a local FdObj."""
+
+    __slots__ = ("fdid", "gfid", "path")
+
+    def __init__(self, fdid: int, gfid: bytes = b"", path: str = ""):
+        self.fdid = fdid
+        self.gfid = gfid
+        self.path = path
+
+    def __repr__(self):  # pragma: no cover
+        return f"FdHandle({self.fdid})"
+
+
+def _enc_uint(out: bytearray, n: int) -> None:
+    # LEB128-ish varint
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _dec_uint(buf: memoryview, pos: int) -> tuple[int, int]:
+    n = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+
+
+def encode_value(v: Any, out: bytearray) -> None:
+    if v is None:
+        out.append(_T_NONE)
+    elif v is True:
+        out.append(_T_TRUE)
+    elif v is False:
+        out.append(_T_FALSE)
+    elif isinstance(v, int):
+        if v >= 0:
+            out.append(_T_INT)
+            _enc_uint(out, v)
+        else:
+            out.append(_T_NEGINT)
+            _enc_uint(out, -v)
+    elif isinstance(v, float):
+        out.append(_T_FLOAT)
+        out += struct.pack(">d", v)
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        out.append(_T_BYTES)
+        b = bytes(v)
+        _enc_uint(out, len(b))
+        out += b
+    elif isinstance(v, str):
+        out.append(_T_STR)
+        b = v.encode()
+        _enc_uint(out, len(b))
+        out += b
+    elif isinstance(v, (list, tuple)):
+        out.append(_T_LIST)
+        _enc_uint(out, len(v))
+        for item in v:
+            encode_value(item, out)
+    elif isinstance(v, dict):
+        out.append(_T_DICT)
+        _enc_uint(out, len(v))
+        for k, val in v.items():
+            encode_value(k, out)
+            encode_value(val, out)
+    elif isinstance(v, Iatt):
+        out.append(_T_IATT)
+        encode_value([v.gfid, v.ia_type.value, v.mode, v.nlink, v.uid,
+                      v.gid, v.size, v.blocks, v.atime, v.mtime, v.ctime,
+                      v.rdev, v.blksize], out)
+    elif isinstance(v, Loc):
+        out.append(_T_LOC)
+        encode_value([v.path, v.gfid, v.parent, v.name], out)
+    elif isinstance(v, FdHandle):
+        out.append(_T_FD)
+        encode_value([v.fdid, v.gfid, v.path], out)
+    elif isinstance(v, FopError):
+        out.append(_T_ERR)
+        encode_value([v.err, str(v.args[1]) if len(v.args) > 1 else ""], out)
+    else:
+        raise WireError(f"unencodable type {type(v).__name__}")
+
+
+def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
+    tag = buf[pos]
+    pos += 1
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_TRUE:
+        return True, pos
+    if tag == _T_FALSE:
+        return False, pos
+    if tag == _T_INT:
+        return _dec_uint(buf, pos)
+    if tag == _T_NEGINT:
+        n, pos = _dec_uint(buf, pos)
+        return -n, pos
+    if tag == _T_FLOAT:
+        return struct.unpack_from(">d", buf, pos)[0], pos + 8
+    if tag == _T_BYTES:
+        n, pos = _dec_uint(buf, pos)
+        return bytes(buf[pos:pos + n]), pos + n
+    if tag == _T_STR:
+        n, pos = _dec_uint(buf, pos)
+        return bytes(buf[pos:pos + n]).decode(), pos + n
+    if tag == _T_LIST:
+        n, pos = _dec_uint(buf, pos)
+        out = []
+        for _ in range(n):
+            item, pos = decode_value(buf, pos)
+            out.append(item)
+        return out, pos
+    if tag == _T_DICT:
+        n, pos = _dec_uint(buf, pos)
+        d = {}
+        for _ in range(n):
+            k, pos = decode_value(buf, pos)
+            v, pos = decode_value(buf, pos)
+            d[k] = v
+        return d, pos
+    if tag == _T_IATT:
+        vals, pos = decode_value(buf, pos)
+        ia = Iatt(gfid=vals[0], ia_type=IAType(vals[1]), mode=vals[2],
+                  nlink=vals[3], uid=vals[4], gid=vals[5], size=vals[6],
+                  blocks=vals[7], atime=vals[8], mtime=vals[9],
+                  ctime=vals[10], rdev=vals[11], blksize=vals[12])
+        return ia, pos
+    if tag == _T_LOC:
+        vals, pos = decode_value(buf, pos)
+        return Loc(vals[0], gfid=vals[1], parent=vals[2], name=vals[3]), pos
+    if tag == _T_FD:
+        vals, pos = decode_value(buf, pos)
+        return FdHandle(vals[0], vals[1], vals[2]), pos
+    if tag == _T_ERR:
+        vals, pos = decode_value(buf, pos)
+        return FopError(vals[0], vals[1]), pos
+    raise WireError(f"bad tag {tag}")
+
+
+def pack(xid: int, mtype: int, payload: Any) -> bytes:
+    body = bytearray()
+    encode_value(payload, body)
+    rec = _HDR.pack(xid, mtype) + bytes(body)
+    return struct.pack(">I", len(rec)) + rec
+
+
+def unpack(rec: bytes) -> tuple[int, int, Any]:
+    xid, mtype = _HDR.unpack_from(rec, 0)
+    payload, _ = decode_value(memoryview(rec), _HDR.size)
+    return xid, mtype, payload
+
+
+async def read_frame(reader) -> bytes:
+    hdr = await reader.readexactly(4)
+    (length,) = struct.unpack(">I", hdr)
+    if length > (1 << 30):
+        raise WireError(f"frame too large: {length}")
+    return await reader.readexactly(length)

@@ -1,0 +1,107 @@
+"""Multi-process cluster-on-one-host harness — the tests/cluster.rc analog
+(reference tests/cluster.rc:6-61 launch_cluster): brick daemons as real
+subprocesses with ephemeral ports, clients connecting over TCP."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+
+BRICK_VOLFILE = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+"""
+
+
+class BrickProc:
+    """One brick daemon subprocess."""
+
+    def __init__(self, base: str, name: str):
+        self.name = name
+        self.dir = os.path.join(base, name)
+        self.volfile = os.path.join(base, f"{name}.vol")
+        self.portfile = os.path.join(base, f"{name}.port")
+        with open(self.volfile, "w") as f:
+            f.write(BRICK_VOLFILE.format(dir=self.dir))
+        self.proc: subprocess.Popen | None = None
+        self.port: int | None = None
+
+    def start(self, timeout: float = 15.0) -> int:
+        if os.path.exists(self.portfile):
+            os.unlink(self.portfile)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # bricks never need a TPU
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "glusterfs_tpu.daemon",
+             "--volfile", self.volfile, "--listen", "0",
+             "--portfile", self.portfile],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if os.path.exists(self.portfile):
+                with open(self.portfile) as f:
+                    self.port = int(f.read())
+                return self.port
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"brick {self.name} died: "
+                    f"{self.proc.stderr.read().decode()[-2000:]}")
+            time.sleep(0.05)
+        raise TimeoutError(f"brick {self.name} did not report a port")
+
+    def kill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+
+    def terminate(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            self.proc.wait()
+
+
+class Cluster:
+    """N brick daemons (launch_cluster analog)."""
+
+    def __init__(self, base: str, n: int):
+        self.base = str(base)
+        self.bricks = [BrickProc(self.base, f"brick{i}") for i in range(n)]
+
+    def start(self) -> list[int]:
+        return [b.start() for b in self.bricks]
+
+    def stop(self) -> None:
+        for b in self.bricks:
+            b.terminate()
+
+    def client_volfile(self, cluster_type: str | None = None,
+                       options: dict | None = None) -> str:
+        """Client graph: protocol/client per brick + optional cluster top."""
+        out = []
+        for i, b in enumerate(self.bricks):
+            out.append(f"""
+volume client{i}
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {b.port}
+    option remote-subvolume locks
+end-volume
+""")
+        if cluster_type:
+            subs = " ".join(f"client{i}" for i in range(len(self.bricks)))
+            opts = "".join(f"    option {k} {v}\n"
+                           for k, v in (options or {}).items())
+            out.append(f"volume top\n    type {cluster_type}\n{opts}"
+                       f"    subvolumes {subs}\nend-volume\n")
+        return "\n".join(out)

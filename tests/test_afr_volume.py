@@ -1,0 +1,121 @@
+"""Replicate (AFR) volume e2e: 3-way mirror, quorum, failover reads,
+brick-down writes + heal, entry heal (tests/basic/afr analog)."""
+
+import numpy as np
+import pytest
+
+from glusterfs_tpu.api.glfs import SyncClient
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc
+
+N = 3
+
+
+def volfile(base) -> str:
+    out = []
+    for i in range(N):
+        out.append(f"volume b{i}\n    type storage/posix\n"
+                   f"    option directory {base}/brick{i}\nend-volume\n")
+    subs = " ".join(f"b{i}" for i in range(N))
+    out.append(f"volume repl\n    type cluster/replicate\n"
+               f"    subvolumes {subs}\nend-volume\n")
+    return "\n".join(out)
+
+
+@pytest.fixture
+def vol(tmp_path):
+    c = SyncClient(Graph.construct(volfile(tmp_path)))
+    c.mount()
+    yield c, c.graph.top, tmp_path
+    c.close()
+
+
+def test_roundtrip_and_mirror(vol):
+    c, afr, base = vol
+    data = np.random.default_rng(0).integers(0, 256, 100000,
+                                             dtype=np.uint8).tobytes()
+    c.write_file("/f", data)
+    assert c.read_file("/f") == data
+    # full copies on every brick
+    for i in range(N):
+        assert (base / f"brick{i}" / "f").read_bytes() == data
+
+
+def test_read_failover(vol):
+    c, afr, base = vol
+    c.write_file("/f", b"failover")
+    afr.set_child_up(0, False)
+    afr.set_child_up(1, False)  # 1 up of 3: reads still work
+    assert c.read_file("/f") == b"failover"
+    afr.set_child_up(0, True)
+    afr.set_child_up(1, True)
+
+
+def test_write_quorum(vol):
+    c, afr, base = vol
+    afr.set_child_up(0, False)
+    c.write_file("/ok", b"2-of-3")  # majority holds
+    afr.set_child_up(1, False)  # 1 of 3: below majority
+    with pytest.raises(FopError):
+        c.write_file("/fail", b"x")
+    afr.set_child_up(0, True)
+    afr.set_child_up(1, True)
+
+
+def test_brick_down_write_heal(vol):
+    c, afr, base = vol
+    c.write_file("/h", b"v1" * 500)
+    afr.set_child_up(2, False)
+    c.write_file("/h", b"v2" * 600)
+    afr.set_child_up(2, True)
+    info = c._run(afr.heal_info(Loc("/h")))
+    assert 2 in info["bad"]
+    res = c._run(afr.heal_file("/h"))
+    assert 2 in res["healed"]
+    # force read from healed brick
+    afr.set_child_up(0, False)
+    afr.set_child_up(1, False)
+    assert c.read_file("/h") == b"v2" * 600
+    afr.set_child_up(0, True)
+    afr.set_child_up(1, True)
+    assert (base / "brick2" / "h").read_bytes() == b"v2" * 600
+
+
+def test_entry_heal(vol):
+    c, afr, base = vol
+    afr.set_child_up(1, False)
+    c.write_file("/created-while-down", b"data")
+    c.mkdir("/dir-while-down")
+    afr.set_child_up(1, True)
+    res = c._run(afr.heal_entry("/"))
+    created = {(i, n) for i, n in res["created"]}
+    assert (1, "created-while-down") in created
+    assert (1, "dir-while-down") in created
+    assert (base / "brick1" / "created-while-down").read_bytes() == b"data"
+    assert (base / "brick1" / "dir-while-down").is_dir()
+
+
+def test_stale_brick_not_read(vol):
+    c, afr, base = vol
+    c.write_file("/s", b"new")
+    # make brick0 stale manually: rewind its version
+    afr.set_child_up(1, False)
+    afr.set_child_up(2, False)
+    # can't write with 1 up (quorum) — so instead: write with all up,
+    # then corrupt brick0's data behind afr's back and verify version
+    # selection still prefers consistent copies
+    afr.set_child_up(1, True)
+    afr.set_child_up(2, True)
+    (base / "brick0" / "s").write_bytes(b"BAD")
+    # reads go by version quorum; all versions equal so any brick may be
+    # picked — this documents that silent on-disk corruption needs
+    # bitrot detection (features/bit-rot), not AFR versioning
+    assert c.read_file("/s") in (b"new", b"BAD")
+
+
+def test_statedump(vol):
+    c, afr, base = vol
+    d = c.statedump()
+    priv = d["layers"]["repl"]["private"]
+    assert priv["replicas"] == N and priv["quorum"] == 2

@@ -1,0 +1,133 @@
+"""debug/* layers: error-gen fault injection, delay-gen, trace history,
+io-stats profile — and an EC volume surviving injected brick errors
+(the reference's error-gen-driven .t scenarios)."""
+
+import asyncio
+
+import pytest
+
+from glusterfs_tpu.api.glfs import SyncClient
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc
+
+
+def test_error_gen_injects(tmp_path):
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume errg
+    type debug/error-gen
+    option failure 100
+    option error-no ENOTCONN
+    option enable writev,readv
+    subvolumes posix
+end-volume
+"""
+    c = SyncClient(Graph.construct(vf))
+    c.mount()
+    c.mkdir("/d")  # mkdir not in enable list -> passes
+    f = c.create("/f")
+    with pytest.raises(FopError) as ei:
+        f.write(b"x", 0)
+    assert ei.value.err == 107  # ENOTCONN
+    # reconfigure to 0% -> heals
+    c.graph.by_name["errg"].reconfigure({"failure": 0})
+    f.write(b"x", 0)
+    f.close()
+    c.close()
+
+
+def test_delay_gen(tmp_path):
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume slow
+    type debug/delay-gen
+    option delay-duration 30000
+    option delay-percentage 100
+    option enable writev
+    subvolumes posix
+end-volume
+"""
+    import time
+
+    c = SyncClient(Graph.construct(vf))
+    c.mount()
+    t0 = time.perf_counter()
+    c.write_file("/f", b"x")
+    assert time.perf_counter() - t0 >= 0.03
+    c.close()
+
+
+def test_trace_and_iostats(tmp_path):
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume tr
+    type debug/trace
+    subvolumes posix
+end-volume
+volume stats
+    type debug/io-stats
+    subvolumes tr
+end-volume
+"""
+    c = SyncClient(Graph.construct(vf))
+    c.mount()
+    c.write_file("/f", b"hello")
+    assert c.read_file("/f") == b"hello"
+    tr = c.graph.by_name["tr"]
+    assert any("writev" in line for line in tr.history)
+    st = c.graph.by_name["stats"]
+    prof = st.profile()
+    assert prof["write_bytes"] == 5 and prof["read_bytes"] == 5
+    assert prof["fops"]["writev"]["count"] >= 1
+    c.close()
+
+
+def test_ec_with_flaky_brick(tmp_path):
+    """One brick fails 100% of writes: EC rides through on quorum and
+    heal_info flags the brick (error-gen as the brick-failure harness)."""
+    bricks = []
+    for i in range(6):
+        bricks.append(f"""
+volume p{i}
+    type storage/posix
+    option directory {tmp_path}/b{i}
+end-volume
+""")
+    # brick 2 wrapped in error-gen
+    vf = "".join(bricks) + """
+volume flaky
+    type debug/error-gen
+    option failure 100
+    option enable writev,xattrop,setxattr,create,mknod
+    subvolumes p2
+end-volume
+volume disp
+    type cluster/disperse
+    option redundancy 2
+    subvolumes p0 p1 flaky p3 p4 p5
+end-volume
+"""
+    c = SyncClient(Graph.construct(vf))
+    c.mount()
+    data = bytes(range(256)) * 16
+    c.write_file("/f", data)
+    assert c.read_file("/f") == data
+    ec = c.graph.top
+    info = c._run(ec.heal_info(Loc("/f")))
+    assert 2 in info["bad"]
+    # let the brick recover, heal, verify
+    c.graph.by_name["flaky"].reconfigure({"failure": 0})
+    res = c._run(ec.heal_file("/f"))
+    assert 2 in res["healed"]
+    assert c._run(ec.heal_info(Loc("/f")))["bad"] == []
+    c.close()

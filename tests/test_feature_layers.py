@@ -1,0 +1,136 @@
+"""Feature layers: read-only, worm, trash, quota, shard
+(reference tests/basic/{worm,quota,shard}* behaviors)."""
+
+import pytest
+
+from glusterfs_tpu.api.glfs import SyncClient
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc
+
+
+def _vol(tmp_path, *layers) -> str:
+    out = [f"volume posix\n    type storage/posix\n"
+           f"    option directory {tmp_path}/b\nend-volume\n"]
+    prev = "posix"
+    for i, (ltype, opts) in enumerate(layers):
+        name = f"l{i}"
+        body = "".join(f"    option {k} {v}\n" for k, v in opts.items())
+        out.append(f"volume {name}\n    type {ltype}\n{body}"
+                   f"    subvolumes {prev}\nend-volume\n")
+        prev = name
+    return "\n".join(out)
+
+
+def _client(tmp_path, *layers) -> SyncClient:
+    c = SyncClient(Graph.construct(_vol(tmp_path, *layers)))
+    c.mount()
+    return c
+
+
+def test_read_only(tmp_path):
+    c = _client(tmp_path, ("features/read-only", {}))
+    with pytest.raises(FopError) as ei:
+        c.write_file("/f", b"x")
+    assert ei.value.err == 30  # EROFS
+    assert c.listdir("/") == []
+    c.graph.top.reconfigure({"read-only": "off"})
+    c.write_file("/f", b"x")
+    c.close()
+
+
+def test_worm(tmp_path):
+    c = _client(tmp_path, ("features/worm", {}))
+    c.write_file("/f", b"forever")
+    # appends allowed
+    f = c.open("/f")
+    f.write(b" and ever", 7)
+    with pytest.raises(FopError):
+        f.write(b"X", 0)  # overwrite denied
+    f.close()
+    with pytest.raises(FopError):
+        c.unlink("/f")
+    with pytest.raises(FopError):
+        c.truncate("/f", 2)
+    assert c.read_file("/f") == b"forever and ever"
+    c.close()
+
+
+def test_trash(tmp_path):
+    c = _client(tmp_path, ("features/trash", {}))
+    c.write_file("/doomed", b"save me")
+    c.unlink("/doomed")
+    assert not c.exists("/doomed")
+    trash = c.listdir("/.trashcan")
+    assert len(trash) == 1 and trash[0].startswith("doomed_")
+    assert c.read_file(f"/.trashcan/{trash[0]}") == b"save me"
+    c.close()
+
+
+def test_quota(tmp_path):
+    c = _client(tmp_path, ("features/quota", {}))
+    q = c.graph.top
+    c.mkdir("/limited")
+    q.limit_set("/limited", 10000)
+    c.write_file("/limited/ok", b"x" * 5000)
+    with pytest.raises(FopError) as ei:
+        c.write_file("/limited/toobig", b"y" * 8000)
+    assert ei.value.err == 122  # EDQUOT
+    # freeing space allows writes again
+    c.unlink("/limited/ok")
+    c.write_file("/limited/fits", b"z" * 8000)
+    # outside the limited dir: unaffected
+    c.write_file("/free", b"w" * 50000)
+    c.close()
+
+
+def test_quota_via_xattr(tmp_path):
+    c = _client(tmp_path, ("features/quota", {}))
+    c.mkdir("/d")
+    c.setxattr("/d", {"trusted.glusterfs.quota.limit-set": b"1000"})
+    with pytest.raises(FopError):
+        c.write_file("/d/big", b"x" * 2000)
+    c.close()
+
+
+def test_shard(tmp_path):
+    c = _client(tmp_path, ("features/shard", {"shard-block-size": "4KB"}))
+    data = bytes(range(256)) * 64  # 16KB -> 4 shards
+    c.write_file("/vm.img", data)
+    assert c.stat("/vm.img").size == len(data)
+    assert c.read_file("/vm.img") == data
+    # shards exist on the store; listing hides /.shard
+    assert c.listdir("/") == ["vm.img"]
+    base = tmp_path / "b"
+    shards = list((base / ".shard").iterdir())
+    shard_files = [p for p in shards if p.name != ".glusterfs_tpu"]
+    assert len(shard_files) == 3  # blocks 1..3 (block 0 at path)
+    assert (base / "vm.img").stat().st_size == 4096
+    # cross-shard overwrite
+    f = c.open("/vm.img")
+    f.write(b"@" * 5000, 3000)
+    f.close()
+    expect = bytearray(data)
+    expect[3000:8000] = b"@" * 5000
+    assert c.read_file("/vm.img") == bytes(expect)
+    # truncate drops tail shards
+    c.truncate("/vm.img", 5000)
+    assert c.stat("/vm.img").size == 5000
+    assert c.read_file("/vm.img") == bytes(expect)[:5000]
+    # unlink cleans shards
+    c.unlink("/vm.img")
+    shard_files = [p for p in (base / ".shard").iterdir()
+                   if p.name != ".glusterfs_tpu"]
+    assert shard_files == []
+    c.close()
+
+
+def test_shard_sparse_and_append(tmp_path):
+    c = _client(tmp_path, ("features/shard", {"shard-block-size": "4KB"}))
+    f = c.create("/sparse")
+    f.write(b"END", 10000)  # write far past EOF: holes as zero shards
+    f.close()
+    assert c.stat("/sparse").size == 10003
+    out = c.read_file("/sparse")
+    assert out[:10000] == b"\0" * 10000 and out[10000:] == b"END"
+    c.close()

@@ -10,7 +10,7 @@ CONFIGS = [(4, 2), (8, 4), (16, 4)]
 
 
 @pytest.mark.parametrize("k,r", CONFIGS)
-@pytest.mark.parametrize("formulation", ["xor", "mxu"])
+@pytest.mark.parametrize("formulation", ["xor", "xor3", "mxu"])
 def test_encode_parity(k, r, formulation):
     n = k + r
     rng = np.random.default_rng(k + r)
@@ -21,7 +21,7 @@ def test_encode_parity(k, r, formulation):
 
 
 @pytest.mark.parametrize("k,r", CONFIGS)
-@pytest.mark.parametrize("formulation", ["xor", "mxu"])
+@pytest.mark.parametrize("formulation", ["xor", "xor3", "mxu"])
 def test_decode_parity(k, r, formulation):
     n = k + r
     rng = np.random.default_rng(k * 3 + r)

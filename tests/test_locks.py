@@ -1,0 +1,146 @@
+"""features/locks: inodelk domains, entrylk, POSIX lk, owner semantics,
+blocking/non-blocking, disconnect cleanup (reference
+xlators/features/locks tests + tests/basic/locks)."""
+
+import asyncio
+
+import pytest
+
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import FdObj, Loc
+from glusterfs_tpu.core.iatt import gfid_new
+
+VOLFILE = """
+volume posix
+    type storage/posix
+    option directory {d}
+end-volume
+
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+"""
+
+
+@pytest.fixture
+def locks(tmp_path):
+    g = Graph.construct(VOLFILE.format(d=tmp_path / "brick"))
+    asyncio.run(g.activate())
+    return g.by_name["locks"]
+
+
+def test_inodelk_exclusion(locks):
+    async def run():
+        loc = Loc("/")
+        a, b = {"lk-owner": b"A"}, {"lk-owner": b"B"}
+        await locks.inodelk("d1", loc, "lock", "wr", 0, -1, a)
+        # same owner re-locks fine (no self-conflict)
+        await locks.inodelk("d1", loc, "lock", "wr", 0, -1, a)
+        with pytest.raises(FopError):  # other owner, non-blocking
+            await locks.inodelk("d1", loc, "lock-nb", "wr", 0, -1, b)
+        # other domain is independent
+        await locks.inodelk("d2", loc, "lock-nb", "wr", 0, -1, b)
+        # blocking lock waits until unlock
+        acquired = asyncio.Event()
+
+        async def waiter():
+            await locks.inodelk("d1", loc, "lock", "wr", 0, -1, b)
+            acquired.set()
+
+        t = asyncio.create_task(waiter())
+        await asyncio.sleep(0.01)
+        assert not acquired.is_set()
+        await locks.inodelk("d1", loc, "unlock", "wr", 0, -1, a)
+        await locks.inodelk("d1", loc, "unlock", "wr", 0, -1, a)
+        await asyncio.wait_for(acquired.wait(), 2)
+        await t
+
+    asyncio.run(run())
+
+
+def test_rd_locks_share(locks):
+    async def run():
+        loc = Loc("/")
+        await locks.inodelk("d", loc, "lock-nb", "rd", 0, -1,
+                            {"lk-owner": b"A"})
+        await locks.inodelk("d", loc, "lock-nb", "rd", 0, -1,
+                            {"lk-owner": b"B"})
+        with pytest.raises(FopError):
+            await locks.inodelk("d", loc, "lock-nb", "wr", 0, -1,
+                                {"lk-owner": b"C"})
+
+    asyncio.run(run())
+
+
+def test_range_locks(locks):
+    async def run():
+        loc = Loc("/")
+        await locks.inodelk("d", loc, "lock-nb", "wr", 0, 100,
+                            {"lk-owner": b"A"})
+        # non-overlapping range: fine
+        await locks.inodelk("d", loc, "lock-nb", "wr", 100, 200,
+                            {"lk-owner": b"B"})
+        with pytest.raises(FopError):  # overlaps [0,100)
+            await locks.inodelk("d", loc, "lock-nb", "wr", 50, 60,
+                                {"lk-owner": b"C"})
+
+    asyncio.run(run())
+
+
+def test_entrylk(locks):
+    async def run():
+        loc = Loc("/")
+        await locks.entrylk("d", loc, "file1", "lock-nb", "wr",
+                            {"lk-owner": b"A"})
+        with pytest.raises(FopError):
+            await locks.entrylk("d", loc, "file1", "lock-nb", "wr",
+                                {"lk-owner": b"B"})
+        await locks.entrylk("d", loc, "file2", "lock-nb", "wr",
+                            {"lk-owner": b"B"})
+
+    asyncio.run(run())
+
+
+def test_posix_lk(locks):
+    async def run():
+        fd = FdObj(gfid_new())
+        a, b = {"lk-owner": b"A"}, {"lk-owner": b"B"}
+        await locks.lk(fd, "setlk", {"type": "wr", "start": 0, "len": 10}, a)
+        got = await locks.lk(fd, "getlk",
+                             {"type": "wr", "start": 5, "len": 1}, b)
+        assert got["type"] == "wr"  # conflicting lock reported
+        with pytest.raises(FopError):
+            await locks.lk(fd, "setlk",
+                           {"type": "wr", "start": 0, "len": 10}, b)
+        await locks.lk(fd, "setlk", {"type": "unlck"}, a)
+        got = await locks.lk(fd, "getlk",
+                             {"type": "wr", "start": 5, "len": 1}, b)
+        assert got["type"] == "unlck"
+
+    asyncio.run(run())
+
+
+def test_release_client(locks):
+    async def run():
+        loc = Loc("/")
+        await locks.inodelk("d", loc, "lock", "wr", 0, -1,
+                            {"lk-owner": b"dead-client"})
+        assert locks.release_client(b"dead-client") == 1
+        await locks.inodelk("d", loc, "lock-nb", "wr", 0, -1,
+                            {"lk-owner": b"B"})
+
+    asyncio.run(run())
+
+
+def test_getactivelk_and_dump(locks):
+    async def run():
+        loc = Loc("/")
+        await locks.inodelk("d", loc, "lock", "wr", 0, -1,
+                            {"lk-owner": b"A"})
+        active = await locks.getactivelk(loc)
+        assert len(active) == 1 and active[0]["domain"] == "d"
+        assert locks.dump_private()["granted"] == 1
+
+    asyncio.run(run())

@@ -1,0 +1,214 @@
+"""Performance layers: write-behind aggregation, io-cache hits,
+read-ahead, md-cache invalidation, quick-read, open-behind, nl-cache,
+readdir-ahead, io-threads gating (reference tests/performance/ +
+write-behind.md semantics)."""
+
+import asyncio
+
+import pytest
+
+from glusterfs_tpu.api.glfs import SyncClient
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc
+
+
+def _vol(tmp_path, *layers) -> str:
+    out = [f"volume posix\n    type storage/posix\n"
+           f"    option directory {tmp_path}/b\nend-volume\n"]
+    prev = "posix"
+    for i, (ltype, opts) in enumerate(layers):
+        name = f"l{i}"
+        body = "".join(f"    option {k} {v}\n" for k, v in opts.items())
+        out.append(f"volume {name}\n    type {ltype}\n{body}"
+                   f"    subvolumes {prev}\nend-volume\n")
+        prev = name
+    return "\n".join(out)
+
+
+def _client(tmp_path, *layers) -> SyncClient:
+    c = SyncClient(Graph.construct(_vol(tmp_path, *layers)))
+    c.mount()
+    return c
+
+
+def test_write_behind(tmp_path):
+    c = _client(tmp_path, ("performance/write-behind",
+                           {"window-size": "64KB"}))
+    wb = c.graph.top
+    posix = c.graph.by_name["posix"]
+    f = c.create("/f")
+    for i in range(8):
+        f.write(b"A" * 1000, i * 1000)  # adjacent: coalesce, below window
+    # nothing flushed yet (below window): posix saw create only
+    assert posix.stats.get("writev") is None
+    assert f.read(4, 0) == b"AAAA"  # read forces flush
+    assert posix.stats["writev"].count == 1  # coalesced to ONE write
+    f.close()
+    assert c.read_file("/f") == b"A" * 8000
+    c.close()
+
+
+def test_write_behind_deferred_error(tmp_path):
+    vf = _vol(tmp_path) + """
+volume errg
+    type debug/error-gen
+    option failure 100
+    option enable writev
+    subvolumes posix
+end-volume
+volume wb
+    type performance/write-behind
+    subvolumes errg
+end-volume
+"""
+    c = SyncClient(Graph.construct(vf))
+    c.mount()
+    f = c.create("/f")
+    f.write(b"x", 0)  # buffered: acked
+    with pytest.raises(FopError):
+        f.fsync()  # flush surfaces the injected error
+    c.close()
+
+
+def test_io_cache(tmp_path):
+    c = _client(tmp_path, ("performance/io-cache", {"page-size": "4KB"}))
+    ioc = c.graph.top
+    posix = c.graph.by_name["posix"]
+    c.write_file("/f", b"z" * 10000)
+    assert c.read_file("/f") == b"z" * 10000
+    n1 = posix.stats["readv"].count
+    assert c.read_file("/f") == b"z" * 10000  # cached
+    assert posix.stats["readv"].count == n1
+    assert ioc.hits > 0
+    # write invalidates
+    f = c.open("/f")
+    f.write(b"y", 0)
+    f.close()
+    assert c.read_file("/f")[:1] == b"y"
+    c.close()
+
+
+def test_read_ahead(tmp_path):
+    c = _client(tmp_path, ("performance/read-ahead",
+                           {"page-size": "4KB", "page-count": 2}))
+    c.write_file("/f", bytes(range(256)) * 100)
+    f = c.open("/f")
+    out = b""
+    for i in range(6):  # sequential reads trigger prefetch
+        out += f.read(4096, i * 4096)
+    f.close()
+    assert out == (bytes(range(256)) * 100)[:6 * 4096]
+    c.close()
+
+
+def test_md_cache(tmp_path):
+    c = _client(tmp_path, ("performance/md-cache", {"timeout": "60"}))
+    mdc = c.graph.top
+    posix = c.graph.by_name["posix"]
+    c.write_file("/f", b"12345")
+    c.stat("/f")
+    n = posix.stats["stat"].count
+    c.stat("/f")
+    c.stat("/f")
+    assert posix.stats["stat"].count == n  # served from cache
+    assert mdc.hits >= 2
+    # write invalidates: size change visible
+    f = c.open("/f")
+    f.write(b"6789ab", 5)
+    f.close()
+    assert c.stat("/f").size == 11
+    c.close()
+
+
+def test_quick_read(tmp_path):
+    c = _client(tmp_path, ("performance/quick-read",
+                           {"max-file-size": "1KB"}))
+    qr = c.graph.top
+    posix = c.graph.by_name["posix"]
+    c.write_file("/small", b"tiny")
+    assert c.read_file("/small") == b"tiny"
+    n = posix.stats["readv"].count
+    assert c.read_file("/small") == b"tiny"
+    assert posix.stats["readv"].count == n
+    assert qr.hits >= 1
+    big = b"B" * 5000
+    c.write_file("/big", big)
+    assert c.read_file("/big") == big  # above limit: passthrough
+    c.close()
+
+
+def test_open_behind(tmp_path):
+    c = _client(tmp_path, ("performance/open-behind", {}))
+    posix = c.graph.by_name["posix"]
+
+    def opens():
+        st = posix.stats.get("open")
+        return st.count if st else 0
+
+    c.write_file("/f", b"lazily")
+    n_opens = opens()
+    f = c.open("/f")  # deferred: no child open yet
+    assert opens() == n_opens
+    assert f.read(6, 0) == b"lazily"  # first use opens
+    assert opens() == n_opens + 1
+    f.close()
+    c.close()
+
+
+def test_nl_cache(tmp_path):
+    c = _client(tmp_path, ("performance/nl-cache", {}))
+    nlc = c.graph.top
+    posix = c.graph.by_name["posix"]
+    for _ in range(3):
+        assert not c.exists("/missing")
+    assert nlc.hits >= 2  # negative entries served from cache
+    # creating the file invalidates the negative entry
+    c.write_file("/missing", b"now here")
+    assert c.exists("/missing")
+    c.close()
+
+
+def test_readdir_ahead(tmp_path):
+    c = _client(tmp_path, ("performance/readdir-ahead", {}))
+    for i in range(5):
+        c.write_file(f"/f{i}", b".")
+    assert c.listdir("/") == [f"f{i}" for i in range(5)]
+    c.close()
+
+
+def test_io_threads_gating(tmp_path):
+    c = _client(tmp_path, ("performance/io-threads", {"thread-count": 2}))
+    iot = c.graph.top
+    c.write_file("/f", b"x" * 100)
+    assert c.read_file("/f") == b"x" * 100
+    assert iot.executed[1] > 0  # normal-prio fops went through the gate
+    assert iot.executed[0] > 0  # lookups on the fast path
+    c.close()
+
+
+def test_full_perf_stack(tmp_path):
+    """All perf layers stacked (volgen order) still give correct I/O."""
+    c = _client(
+        tmp_path,
+        ("performance/write-behind", {}),
+        ("performance/read-ahead", {}),
+        ("performance/readdir-ahead", {}),
+        ("performance/io-cache", {}),
+        ("performance/quick-read", {}),
+        ("performance/open-behind", {}),
+        ("performance/md-cache", {}),
+        ("performance/nl-cache", {}),
+    )
+    data = bytes(range(256)) * 300
+    c.write_file("/f", data)
+    assert c.read_file("/f") == data
+    f = c.open("/f")
+    f.write(b"PATCH", 1000)
+    f.close()
+    expect = data[:1000] + b"PATCH" + data[1005:]
+    assert c.read_file("/f") == expect
+    assert c.stat("/f").size == len(data)
+    c.mkdir("/d")
+    assert sorted(c.listdir("/")) == ["d", "f"]
+    c.close()
