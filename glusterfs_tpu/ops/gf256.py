@@ -230,18 +230,25 @@ def ref_encode(data: np.ndarray, k: int, n: int) -> np.ndarray:
     )
 
 
-def ref_decode(frags: np.ndarray, rows, k: int) -> np.ndarray:
-    """Decode k fragments (k, S*512) given their indices `rows` -> (S*k*512,)."""
+def frags_to_planes(frags: np.ndarray, k: int) -> np.ndarray:
+    """Fragment-major (k, S*512) -> stripe-major plane words (S, k*8, 64)
+    (inverse of ref_encode's output transform)."""
     frags = np.ascontiguousarray(frags, dtype=np.uint8)
     if frags.shape[0] != k:
-        raise ValueError("need exactly k fragments")
+        raise ValueError(f"need exactly {k} fragments, got {frags.shape[0]}")
+    if frags.shape[1] % CHUNK_SIZE:
+        raise ValueError("fragment length must be a multiple of 512")
     s = frags.shape[1] // CHUNK_SIZE
-    bbits = expand_bitmatrix(decode_matrix(k, rows))
-    # fragment-major -> (S, k*8, 64)
-    x = (
+    return (
         frags.reshape(k, s, GF_BITS, WORD_SIZE)
         .transpose(1, 0, 2, 3)
         .reshape(s, k * GF_BITS, WORD_SIZE)
     )
+
+
+def ref_decode(frags: np.ndarray, rows, k: int) -> np.ndarray:
+    """Decode k fragments (k, S*512) given their indices `rows` -> (S*k*512,)."""
+    bbits = expand_bitmatrix(decode_matrix(k, rows))
+    x = frags_to_planes(frags, k)  # (S, k*8, 64)
     y = _xor_matmul_planes(bbits, x)  # (S, k*8, 64)
-    return y.reshape(s * k * CHUNK_SIZE).copy()
+    return y.reshape(x.shape[0] * k * CHUNK_SIZE).copy()

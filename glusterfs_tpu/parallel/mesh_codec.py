@@ -85,7 +85,7 @@ def sharded_step_fn(k: int, r: int, mesh: Mesh):
     def step(batch):
         frags = _apply(abits, batch)              # (B, n*8, 64)
         frags = jnp.transpose(frags, (1, 0, 2))   # (n*8, B, 64) frag-major
-        surv = frags.reshape(n, 8, *frags.shape[1:])[list(rows)]
+        surv = frags.reshape(n, 8, *frags.shape[1:])[np.asarray(rows)]
         surv = surv.reshape(k * 8, *frags.shape[1:])
         surv = jnp.transpose(surv, (1, 0, 2))     # (B, k*8, 64)
         out = _apply(bbits, surv)                 # (B, k*8, 64)
@@ -105,3 +105,41 @@ def run_step(k: int, r: int, batch: np.ndarray, mesh: Mesh | None = None):
     fn = sharded_step_fn(k, r, mesh)
     frags, mism = fn(jnp.asarray(batch))
     return frags, int(mism)
+
+
+@functools.lru_cache(maxsize=256)
+def _decode_fn(k: int, rows: tuple[int, ...], mesh: Mesh):
+    """Jitted degraded decode for one surviving mask, stripes sharded
+    over ``dp`` (the LRU of per-mask jitted decoders mirrors the
+    reference's LRU of inverted matrices, ec-method.c:200-245)."""
+    bbits = jnp.asarray(gf256.decode_bits_cached(k, rows))
+    sharding = NamedSharding(mesh, P("dp", None, None))
+    return jax.jit(
+        lambda x: _apply(bbits, x),
+        in_shardings=sharding, out_shardings=sharding)
+
+
+def sharded_decode(
+    k: int,
+    rows,
+    frags: np.ndarray,
+    mesh: Mesh | None = None,
+) -> np.ndarray:
+    """Decode k surviving fragments (fragment-major, (k, S*512)) into the
+    original (S*k*512,) bytes, sharded over the mesh's ``dp`` axis.
+
+    ``rows`` are the surviving fragment indices (any order-preserving
+    k-subset of 0..n-1) — the ``ec_dispatch_min`` answer set.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    rows = tuple(int(x) for x in rows)
+    x = gf256.frags_to_planes(frags, k)  # (S, k*8, 64), validates shape
+    s = x.shape[0]
+    dp = mesh.devices.shape[0]
+    pad = (-s) % dp  # dp-sharded input must divide evenly; pad + trim
+    if pad:
+        x = np.concatenate(
+            [x, np.zeros((pad, *x.shape[1:]), dtype=np.uint8)], axis=0)
+    y = _decode_fn(k, rows, mesh)(jnp.asarray(x))
+    return np.asarray(y)[:s].reshape(s * k * gf256.CHUNK_SIZE)

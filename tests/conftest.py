@@ -2,7 +2,11 @@
 
 Sharding/collective tests run on a virtual CPU mesh (no multi-chip TPU
 hardware in CI); the driver separately dry-runs the multi-chip path.
-Must be set before jax is imported anywhere.
+
+The XLA_FLAGS env var must be set before jax is imported anywhere; the
+platform choice additionally needs ``jax.config.update`` because the
+tunneled TPU plugin in this image registers itself regardless of the
+``JAX_PLATFORMS`` env var.
 """
 import os
 
@@ -12,3 +16,7 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

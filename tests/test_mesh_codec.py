@@ -1,0 +1,145 @@
+"""Sharded data-plane tests on the 8-device virtual CPU mesh.
+
+The reference's multi-brick fan-out/fan-in (ec_dispatch_all/_min,
+reference xlators/cluster/ec/src/ec-common.c:816-900) maps to a (dp, frag)
+device mesh here; these tests prove the sharded encode/decode is bit-exact
+against the NumPy oracle and that degraded reconstruction works for
+arbitrary surviving-fragment masks while actually sharded over devices.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from glusterfs_tpu.ops import gf256
+from glusterfs_tpu.parallel import mesh_codec
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must provision 8 virtual CPU devices"
+    return mesh_codec.make_mesh(devs[:8])
+
+
+def _batch(rng, dp_mult: int, k: int, stripes_per: int = 2) -> np.ndarray:
+    b = dp_mult * stripes_per
+    return rng.integers(0, 256, (b, k * 8, 64), dtype=np.uint8)
+
+
+def test_mesh_shape(mesh):
+    assert mesh.devices.shape == (4, 2)
+    assert mesh.axis_names == ("dp", "frag")
+
+
+def test_sharded_step_parity_and_layout(mesh):
+    k, r = 4, 2
+    rng = np.random.default_rng(7)
+    batch = _batch(rng, mesh.devices.shape[0], k)
+    frags, mism = mesh_codec.run_step(k, r, batch, mesh)
+    assert mism == 0
+    assert frags.shape == ((k + r) * 8, batch.shape[0], 64)
+    # Bit-exact vs the NumPy oracle, stripe by stripe.
+    s = batch.shape[0]
+    flat = batch.reshape(s * k * gf256.CHUNK_SIZE)
+    want = gf256.ref_encode(flat, k, k + r)  # (n, S*512)
+    got = np.asarray(frags)  # (n*8, B, 64)
+    n = k + r
+    got_frag = (
+        got.reshape(n, 8, s, 64).transpose(0, 2, 1, 3).reshape(n, s * 512)
+    )
+    np.testing.assert_array_equal(got_frag, want)
+
+
+def test_output_sharding_rides_mesh_axes(mesh):
+    """Encode output must actually be laid out (frag, dp) — the
+    scatter-to-bricks placement, not a replicated array."""
+    k, r = 4, 2
+    rng = np.random.default_rng(8)
+    batch = _batch(rng, mesh.devices.shape[0], k)
+    fn = mesh_codec.sharded_step_fn(k, r, mesh)
+    frags, _ = fn(jnp.asarray(batch))
+    spec = frags.sharding.spec
+    assert spec == P("frag", "dp", None)
+    # every device holds a distinct shard (no replication)
+    n_shards = len({(d.index) for d in frags.addressable_shards})
+    assert n_shards == 8
+
+
+@pytest.mark.parametrize("k,r", [(2, 1), (4, 2), (8, 3), (8, 4)])
+def test_degraded_decode_all_masks_sampled(mesh, k, r):
+    """Reconstruct from every (small-config) or sampled (big-config)
+    surviving-k subset; parity vs original must hold for each."""
+    n = k + r
+    rng = np.random.default_rng(100 * k + r)
+    combos = list(itertools.combinations(range(n), k))
+    if len(combos) > 12:
+        sel = rng.choice(len(combos), size=12, replace=False)
+        combos = [combos[i] for i in sel]
+    batch = _batch(rng, mesh.devices.shape[0], k, stripes_per=1)
+    s = batch.shape[0]
+    flat = batch.reshape(s * k * gf256.CHUNK_SIZE)
+    frags = gf256.ref_encode(flat, k, n)
+    for rows in combos:
+        out = mesh_codec.sharded_decode(
+            k, rows, frags[np.asarray(rows)], mesh)
+        np.testing.assert_array_equal(np.asarray(out).ravel(), flat)
+
+
+def test_dp_axis_batch_sharding(mesh):
+    """Input batches shard over dp: each dp row of the mesh holds a
+    disjoint slice of the stripe batch."""
+    k, r = 4, 2
+    rng = np.random.default_rng(9)
+    batch = _batch(rng, mesh.devices.shape[0], k)
+    arr = jax.device_put(
+        jnp.asarray(batch), NamedSharding(mesh, P("dp", None, None)))
+    shard_rows = sorted(
+        sh.index[0].start or 0 for sh in arr.addressable_shards)
+    # 4 dp rows x 2 frag cols; each dp row slice appears twice (replicated
+    # over frag), and the 4 slices are disjoint.
+    assert len(set(shard_rows)) == 4
+    fn = mesh_codec.sharded_step_fn(k, r, mesh)
+    _, mism = fn(arr)
+    assert int(mism) == 0
+
+
+def test_uneven_mask_rows_with_gaps(mesh):
+    """Surviving rows with gaps and out-of-order positions (e.g. brick 0
+    and 3 dead in 4+2) decode correctly."""
+    k, r = 4, 2
+    n = k + r
+    rng = np.random.default_rng(10)
+    batch = _batch(rng, mesh.devices.shape[0], k, stripes_per=1)
+    s = batch.shape[0]
+    flat = batch.reshape(s * k * gf256.CHUNK_SIZE)
+    frags = gf256.ref_encode(flat, k, n)
+    for rows in [(1, 2, 4, 5), (0, 2, 3, 5), (2, 3, 4, 5), (0, 1, 4, 5)]:
+        out = mesh_codec.sharded_decode(
+            k, rows, frags[np.asarray(rows)], mesh)
+        np.testing.assert_array_equal(np.asarray(out).ravel(), flat)
+
+
+def test_single_stripe_decode_pads_to_dp(mesh):
+    """A one-stripe degraded read (the common ec_dispatch_min case) must
+    decode even though 1 doesn't divide the dp axis."""
+    k, r = 4, 2
+    rng = np.random.default_rng(11)
+    flat = rng.integers(0, 256, k * gf256.CHUNK_SIZE, dtype=np.uint8)
+    frags = gf256.ref_encode(flat, k, k + r)
+    rows = (0, 2, 3, 5)
+    out = mesh_codec.sharded_decode(k, rows, frags[np.asarray(rows)], mesh)
+    np.testing.assert_array_equal(out, flat)
+
+
+def test_sharded_decode_rejects_wrong_fragment_count(mesh):
+    k = 4
+    frags = np.zeros((6, 512), dtype=np.uint8)  # all n, not k
+    with pytest.raises(ValueError, match="exactly 4 fragments"):
+        mesh_codec.sharded_decode(k, (0, 1, 2, 3), frags, mesh)
