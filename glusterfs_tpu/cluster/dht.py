@@ -228,7 +228,23 @@ class DistributeLayer(Layer):
                 raise FopError(errno.EIO, "dir rename failed everywhere")
             return out
         dst_hashed = self._hashed(newloc)
+        # POSIX rename overwrites an existing destination.  The rename on
+        # src only replaces a same-subvol dst; a live dst file elsewhere
+        # must be unlinked, or _make_linkto would silently convert it into
+        # a pointer and orphan its data (reference dht_rename unlinks the
+        # dst cached file).  Resolve dst BEFORE the rename (afterwards the
+        # lookup would find the renamed file) but unlink only AFTER it
+        # succeeds — a failed rename must leave dst intact.
+        try:
+            dst_cached = await self._cached_idx(newloc)
+        except FopError:
+            dst_cached = None
         out = await self.children[src].rename(oldloc, newloc, xdata)
+        for i in {dst_cached, dst_hashed} - {None, src}:
+            try:
+                await self.children[i].unlink(newloc)
+            except FopError:
+                pass
         if dst_hashed != src:
             # data stayed on src subvol: leave a linkto pointer at the
             # dst-hashed subvol (dht-linkfile.c:95)

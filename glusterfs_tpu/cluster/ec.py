@@ -148,14 +148,15 @@ class DisperseLayer(Layer):
 
     # -- cluster-wide transaction locks (ec-locks.c / ec_lock analog) ------
 
-    async def _inodelk_wind(self, loc: Loc, ltype: str) -> list[int]:
+    async def _inodelk_wind(self, loc: Loc, ltype: str,
+                            owner: bytes | None = None) -> list[int]:
         """Take an inodelk on every up child (brick-side features/locks);
         children without a locks layer (EOPNOTSUPP) are skipped.  Locks
         are wound in index order — all clients use the same order, so
         cross-client deadlock cannot occur (ec-locks.c ordering)."""
         if self._locks_supported is False:
             return []
-        xd = {"lk-owner": self._lk_owner}
+        xd = {"lk-owner": owner or self._lk_owner}
         locked: list[int] = []
         try:
             for i in self._up_idx():
@@ -168,14 +169,15 @@ class DisperseLayer(Layer):
                         continue
                     raise
         except FopError:
-            await self._inodelk_unwind(loc, locked)
+            await self._inodelk_unwind(loc, locked, owner)
             raise
         if self._locks_supported is None:
             self._locks_supported = bool(locked)
         return locked
 
-    async def _inodelk_unwind(self, loc: Loc, locked: list[int]) -> None:
-        xd = {"lk-owner": self._lk_owner}
+    async def _inodelk_unwind(self, loc: Loc, locked: list[int],
+                              owner: bytes | None = None) -> None:
+        xd = {"lk-owner": owner or self._lk_owner}
         for i in locked:
             try:
                 await self.children[i].inodelk(
@@ -194,13 +196,20 @@ class DisperseLayer(Layer):
             self.ltype = ltype
             self.locked: list[int] = []
             self.local = ltype == "wr" or ec._locks_supported is False
+            # Per-transaction lk-owner (reference frame->root->lk_owner):
+            # with a per-client owner this client's reads would never
+            # conflict with its own in-flight writes brick-side and could
+            # decode a mix of old and new fragments mid-write.
+            from ..core.iatt import gfid_new as _g
+
+            self.owner = _g()
 
         async def __aenter__(self):
             if self.local:
                 await self.ec._lock(self.gfid).acquire()
             try:
-                self.locked = await self.ec._inodelk_wind(self.loc,
-                                                          self.ltype)
+                self.locked = await self.ec._inodelk_wind(
+                    self.loc, self.ltype, self.owner)
             except BaseException:
                 if self.local:
                     self.ec._lock(self.gfid).release()
@@ -212,7 +221,7 @@ class DisperseLayer(Layer):
             return self
 
         async def __aexit__(self, *exc):
-            await self.ec._inodelk_unwind(self.loc, self.locked)
+            await self.ec._inodelk_unwind(self.loc, self.locked, self.owner)
             if self.local:
                 self.ec._lock(self.gfid).release()
             return False

@@ -40,20 +40,27 @@ class WriteBehindLayer(Layer):
         return ctx
 
     def _absorb(self, ctx: _WbFd, data: bytes, offset: int) -> None:
-        """Coalesce with an adjacent/overlapping chunk when possible."""
+        """Coalesce every overlapping/adjacent chunk into one, newest data
+        last.  Merging ALL touching chunks (not just the first) keeps the
+        chunk list disjoint, so drain order can never replay stale bytes
+        over newer ones.  The union is gap-free because each absorbed
+        chunk touches the new write's interval."""
         end = offset + len(data)
-        for i, (coff, cbuf) in enumerate(ctx.chunks):
-            cend = coff + len(cbuf)
-            if offset <= cend and end >= coff:  # overlap or adjacent
-                start = min(coff, offset)
-                merged = bytearray(max(cend, end) - start)
-                merged[coff - start: cend - start] = cbuf
-                merged[offset - start: end - start] = data
-                ctx.bytes += len(merged) - len(cbuf)
-                ctx.chunks[i] = (start, merged)
-                return
-        ctx.chunks.append((offset, bytearray(data)))
-        ctx.bytes += len(data)
+        touching, rest = [], []
+        for coff, cbuf in ctx.chunks:
+            if offset <= coff + len(cbuf) and end >= coff:
+                touching.append((coff, cbuf))
+            else:
+                rest.append((coff, cbuf))
+        start = min([offset] + [c for c, _ in touching])
+        stop = max([end] + [c + len(b) for c, b in touching])
+        merged = bytearray(stop - start)
+        for coff, cbuf in touching:  # disjoint among themselves
+            merged[coff - start: coff - start + len(cbuf)] = cbuf
+        merged[offset - start: end - start] = data
+        rest.append((start, merged))
+        ctx.chunks = rest
+        ctx.bytes = sum(len(b) for _, b in ctx.chunks)
 
     async def _drain(self, fd: FdObj, ctx: _WbFd) -> None:
         async with ctx.lock:

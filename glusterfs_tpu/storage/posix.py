@@ -83,21 +83,37 @@ class PosixLayer(Layer):
     def _gfid_path(self, gfid: bytes) -> str:
         return os.path.join(self._gfid_dir, gfid.hex())
 
-    def _gfid_set(self, gfid: bytes, relpath: str) -> None:
+    def _gfid_set(self, gfid: bytes, relpath: str,
+                  inokey: str | None = None) -> None:
+        """Write the gfid pointer file: line 1 = the dev:ino binding key
+        (so _gfid_del can clean up the ino- sidecar and inode-number
+        reuse can't resurrect a deleted gfid), rest = relpath verbatim
+        (paths may legally contain newlines, so the path goes last)."""
         tmp = self._gfid_path(gfid) + ".tmp"
         with open(tmp, "w") as f:
-            f.write(relpath)
+            f.write((inokey or "") + "\n" + relpath)
         os.replace(tmp, self._gfid_path(gfid))
 
-    def _gfid_resolve(self, gfid: bytes) -> str:
-        """GFID -> volume-relative path ('/a/b')."""
+    def _gfid_read(self, gfid: bytes) -> tuple[str, str]:
+        """-> (inokey, relpath); raises ESTALE when the gfid is unknown."""
         try:
             with open(self._gfid_path(gfid)) as f:
-                return f.read()
+                inokey, _, relpath = f.read().partition("\n")
+            return inokey, relpath
         except FileNotFoundError:
             raise FopError(errno.ESTALE, f"no such gfid {gfid.hex()}") from None
 
+    def _gfid_resolve(self, gfid: bytes) -> str:
+        """GFID -> volume-relative path ('/a/b')."""
+        return self._gfid_read(gfid)[1]
+
     def _gfid_del(self, gfid: bytes) -> None:
+        try:
+            inokey, _ = self._gfid_read(gfid)
+            if inokey:
+                os.unlink(os.path.join(self._xattr_dir, "ino-" + inokey))
+        except (FopError, FileNotFoundError):
+            pass
         try:
             os.unlink(self._gfid_path(gfid))
         except FileNotFoundError:
@@ -131,7 +147,8 @@ class PosixLayer(Layer):
         with open(p + ".tmp", "wb") as f:
             f.write(gfid)
         os.replace(p + ".tmp", p)
-        self._gfid_set(gfid, path if path.startswith("/") else "/" + path)
+        self._gfid_set(gfid, path if path.startswith("/") else "/" + path,
+                       inokey=key)
 
     def _require_gfid(self, path: str) -> bytes:
         g = self._gfid_of(path)
@@ -252,11 +269,20 @@ class PosixLayer(Layer):
         path = self._loc_path(loc)
         gfid = self._gfid_of(path)
         try:
+            nlink = os.lstat(self._abs(path)).st_nlink
             os.unlink(self._abs(path))
         except OSError as e:
             raise _fop_errno(e)
         if gfid is not None:
-            self._gfid_del(gfid)
+            if nlink > 1:
+                # inode survives via another hard link: the gfid (and its
+                # ino->gfid sidecar + xattrs) must stay stable.  The
+                # pointer path may now dangle if it named this link; the
+                # reference's .glusterfs hardlink farm sidesteps this —
+                # path-based fops on the other name re-resolve fine.
+                pass
+            else:
+                self._gfid_del(gfid)
         return {}
 
     async def rmdir(self, loc: Loc, flags: int = 0, xdata: dict | None = None):
@@ -273,12 +299,20 @@ class PosixLayer(Layer):
     async def rename(self, oldloc: Loc, newloc: Loc, xdata: dict | None = None):
         oldp, newp = self._loc_path(oldloc), self._loc_path(newloc)
         gfid = self._gfid_of(oldp)
+        # an overwritten destination's identity dies with it
+        try:
+            dst_gfid = self._gfid_of(newp)
+            dst_nlink = os.lstat(self._abs(newp)).st_nlink
+        except FopError:
+            dst_gfid, dst_nlink = None, 0
         try:
             os.replace(self._abs(oldp), self._abs(newp))
         except OSError as e:
             raise _fop_errno(e)
+        if dst_gfid is not None and dst_gfid != gfid and dst_nlink <= 1:
+            self._gfid_del(dst_gfid)
         if gfid is not None:
-            self._gfid_set(gfid, newp if newp.startswith("/") else "/" + newp)
+            self._gfid_bind(newp, gfid)  # re-records path + dev:ino key
         return self._iatt(newp)
 
     # -- fd fops -----------------------------------------------------------
@@ -324,7 +358,14 @@ class PosixLayer(Layer):
     async def writev(self, fd: FdObj, data: bytes, offset: int,
                      xdata: dict | None = None):
         try:
-            os.pwrite(self._os_fd(fd), data, offset)
+            view = memoryview(data)
+            pos = offset
+            while view:
+                n = os.pwrite(self._os_fd(fd), view, pos)
+                if n <= 0:  # a 0-byte pwrite would loop forever
+                    raise FopError(errno.EIO, "short write")
+                view = view[n:]
+                pos += n
         except OSError as e:
             raise _fop_errno(e)
         return self._iatt(self._gfid_resolve(fd.gfid))

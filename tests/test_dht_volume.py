@@ -131,3 +131,24 @@ def test_dm_hash_stability():
     assert dm_hash("file001") == dm_hash("file001")
     vals = {dm_hash(f"f{i}") for i in range(100)}
     assert len(vals) == 100  # no trivial collisions in small sample
+
+
+def test_rename_over_existing_destination(vol):
+    """Rename onto an existing cross-subvol destination must unlink the
+    old dst file, not convert it into a linkto over live data (advisor
+    round-1 finding; reference dht_rename dst-cached unlink)."""
+    c, dht, base = vol
+    src, dst = "alpha", "beta"
+    if dht.hashed_idx(src) == dht.hashed_idx(dst):
+        dst = "gamma2"
+        assert dht.hashed_idx(src) != dht.hashed_idx(dst)
+    c.write_file(f"/{src}", b"new data")
+    c.write_file(f"/{dst}", b"old destination payload")
+    c.rename(f"/{src}", f"/{dst}")
+    assert c.read_file(f"/{dst}") == b"new data"
+    assert c.stat(f"/{dst}").size == len(b"new data")
+    # exactly one real copy + at most one linkto pointer remain
+    si = dht.hashed_idx(src)
+    assert (base / f"brick{si}" / dst).read_bytes() == b"new data"
+    assert c.listdir("/").count(dst) == 1
+    assert src not in c.listdir("/")

@@ -186,3 +186,55 @@ def test_statedump(vol):
     priv = d["layers"]["disp"]["private"]
     assert priv["fragments"] == K and priv["redundancy"] == R
     assert priv["up_count"] == N
+
+
+def test_read_during_write_sees_whole_version(tmp_path):
+    """A read racing a write on the same gfid must decode a consistent
+    version — never a mix of old and new fragments.  Per-transaction
+    lk-owners make the read's brick inodelk conflict with this client's
+    own in-flight write (advisor r1 finding; reference frame lk_owner)."""
+    import asyncio
+
+    from glusterfs_tpu.api.glfs import Client
+
+    out = []
+    for i in range(N):
+        out.append(f"volume p{i}\n    type storage/posix\n"
+                   f"    option directory {tmp_path}/brick{i}\nend-volume\n")
+        # stagger each brick's writev completion so a racing read lands
+        # while some bricks hold new fragments and others still old ones
+        out.append(f"volume d{i}\n    type debug/delay-gen\n"
+                   f"    option enable writev\n"
+                   f"    option delay-percentage 100\n"
+                   f"    option delay-duration {50000 + i * 100000}\n"
+                   f"    subvolumes p{i}\nend-volume\n")
+        out.append(f"volume b{i}\n    type features/locks\n"
+                   f"    subvolumes d{i}\nend-volume\n")
+    subs = " ".join(f"b{i}" for i in range(N))
+    out.append(f"volume disp\n    type cluster/disperse\n"
+               f"    option redundancy {R}\n"
+               f"    subvolumes {subs}\nend-volume\n")
+    volspec = "\n".join(out)
+
+    vers = [bytes(_rand(2 * STRIPE, seed=s)) for s in range(5)]
+
+    async def run():
+        c = Client(Graph.construct(volspec))
+        await c.mount()
+        await c.write_file("/f", vers[0])
+        fd = await c.open("/f")
+        await fd.read(2 * STRIPE, 0)  # warm the jit paths off the race
+        mixed = 0
+        for rnd in range(1, 5):
+            wtask = asyncio.ensure_future(fd.write(vers[rnd], 0))
+            await asyncio.sleep(0.3)  # inside the 0.05..0.55s brick window
+            got = await fd.read(2 * STRIPE, 0)
+            await wtask
+            if got not in (vers[rnd - 1], vers[rnd]):
+                mixed += 1
+        await fd.close()
+        await c.unmount()
+        return mixed
+
+    # without per-txn lk-owners this measures 3-4 mixed reads out of 4
+    assert asyncio.run(run()) == 0, "read decoded a mix of write versions"

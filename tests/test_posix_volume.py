@@ -115,3 +115,68 @@ def test_async_client(tmp_path):
         return out
 
     assert asyncio.run(run()) == b"abc"
+
+
+def test_unlink_cleans_ino_binding(client, tmp_path):
+    """Deleting a file must drop its dev:ino -> gfid sidecar, or inode
+    reuse resolves a fresh file to the dead gfid (advisor r1 finding)."""
+    client.write_file("/doomed", b"bytes")
+    xattr_dir = tmp_path / "brick0" / ".glusterfs_tpu" / "xattr"
+    before = {p.name for p in xattr_dir.iterdir() if p.name.startswith("ino-")}
+    assert before, "expected an ino- binding after create"
+    client.unlink("/doomed")
+    after = {p.name for p in xattr_dir.iterdir() if p.name.startswith("ino-")}
+    assert after == set() or after < before
+    # a new file must get a FRESH gfid even if the OS reuses the inode
+    client.write_file("/reborn", b"other")
+    assert client.stat("/reborn").size == 5
+    assert client.read_file("/reborn") == b"other"
+
+
+def test_rename_keeps_ino_binding_consistent(client, tmp_path):
+    client.write_file("/a", b"payload")
+    g_before = client.stat("/a").gfid
+    client.rename("/a", "/b")
+    assert client.stat("/b").gfid == g_before  # gfid survives rename
+    client.unlink("/b")
+    xattr_dir = tmp_path / "brick0" / ".glusterfs_tpu" / "xattr"
+    stale = [p.name for p in xattr_dir.iterdir() if p.name.startswith("ino-")]
+    assert stale == []
+
+
+def test_hardlink_unlink_keeps_gfid(client, tmp_path):
+    """Unlinking one of two hard links must not destroy the surviving
+    link's gfid binding (gfid stability across links)."""
+    client.write_file("/a", b"shared")
+    g = client.stat("/a").gfid
+    client.link("/a", "/b")
+    client.unlink("/a")
+    assert client.stat("/b").gfid == g
+    assert client.read_file("/b") == b"shared"
+
+
+def test_rename_over_existing_cleans_dst_identity(client, tmp_path):
+    """rename onto an existing file destroys the dst's gfid + sidecars;
+    only the surviving file's bindings remain."""
+    client.write_file("/src", b"winner")
+    client.write_file("/dst", b"loser")
+    g_src = client.stat("/src").gfid
+    client.rename("/src", "/dst")
+    assert client.stat("/dst").gfid == g_src
+    meta = tmp_path / "brick0" / ".glusterfs_tpu"
+    gfids = [p.name for p in (meta / "gfid").iterdir()
+             if p.name != "0" * 31 + "1"]  # exclude ROOT_GFID
+    inos = [p.name for p in (meta / "xattr").iterdir()
+            if p.name.startswith("ino-")]
+    assert len(gfids) == 1 and len(inos) == 1
+
+
+def test_filename_with_newline(client):
+    """Paths may contain newlines; gfid pointer format must survive."""
+    client.write_file("/a\nb", b"tricky")
+    assert client.read_file("/a\nb") == b"tricky"
+    st = client.stat("/a\nb")
+    f = client.open("/a\nb")
+    assert f.read(6, 0) == b"tricky"  # fd path resolves via gfid pointer
+    f.close()
+    client.unlink("/a\nb")
