@@ -111,7 +111,7 @@ def main() -> None:
 
     # --- TPU path: device-resident batches -------------------------------
     if on_tpu:
-        enc_fn = gf256_pallas._encode_fn(K, N, "xor3", False)
+        enc_fn = gf256_pallas._fused_encode_fn(K, N, False)
     else:
         enc_fn = gf256_xla._encode_fn(K, N, "matmul")
     ddata = jnp.asarray(data)
@@ -126,8 +126,7 @@ def main() -> None:
     surv = jnp.asarray(frags_np[rows])
     bbits = gf256.decode_bits_cached(K, tuple(rows))
     if on_tpu:
-        dec_fn = gf256_pallas._decode_fn(K, "xor3", False,
-                                         tuple(map(tuple, bbits)))
+        dec_fn = gf256_pallas._fused_decode_fn(K, tuple(rows), False)
     else:
         raw = gf256_xla._decode_fn(K, "matmul", None)
         bbits_d = jnp.asarray(bbits)

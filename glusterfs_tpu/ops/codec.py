@@ -125,7 +125,7 @@ class Codec:
             return gf256_xla.encode(data, self.k, self.n, "xor")
         from . import gf256_pallas
 
-        form = "xor3" if b == "pallas-xor" else "mxu"
+        form = "fused" if b == "pallas-xor" else "mxu"
         return gf256_pallas.encode(data, self.k, self.n, form)
 
     # -- decode ------------------------------------------------------------
@@ -153,7 +153,7 @@ class Codec:
             return gf256_xla.decode(frags, rows, self.k, form)
         from . import gf256_pallas
 
-        form = "xor3" if b == "pallas-xor" else "mxu"
+        form = "fused" if b == "pallas-xor" else "mxu"
         return gf256_pallas.decode(frags, rows, self.k, form)
 
     # -- convenience -------------------------------------------------------

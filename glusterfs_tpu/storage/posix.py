@@ -3,11 +3,19 @@
 Reference: xlators/storage/posix (posix-inode-fd-ops.c:1999 posix_writev,
 posix-helpers.c:1352 GFID handle store).  Same responsibilities here:
 
-* every object gets a GFID at creation; the handle store
-  ``.glusterfs_tpu/gfid/<hex>`` maps GFID -> current relative path (the
-  reference uses a ``.glusterfs/xx/yy/gfid`` hardlink farm; a text pointer
-  is equivalent for a single-writer brick process and keeps heal/debug
-  simple).
+* every object gets a GFID at creation.  Identity store, mirroring the
+  reference's ``.glusterfs/xx/yy/gfid`` hardlink farm (posix-handle.h):
+  - ``.glusterfs_tpu/handle/<hex>`` — a HARDLINK to the inode for regular
+    files and symlinks.  fd-based fops resolve through it, so they stay
+    correct when the path changes under them (rename, one of several hard
+    links removed) and the inode cannot be reused while its gfid lives.
+  - ``.glusterfs_tpu/gfid/<hex>`` — a text record: line 1 the dev:ino
+    sidecar key, rest the current path (a best-effort hint for files, the
+    authoritative mapping for directories, which cannot be hardlinked).
+  Renaming a directory updates its own record; records of objects deeper
+  in the tree keep working for files (handles) but directory hints below
+  a renamed directory go stale — the reference's ancestry symlinks solve
+  this; path-based fops (the normal access) are unaffected.
 * xattrs (the version/dirty/size accounting written by EC/AFR) live in a
   sidecar JSON per GFID under ``.glusterfs_tpu/xattr/`` — independent of
   host-FS xattr support, atomically replaced on update.
@@ -59,11 +67,13 @@ class PosixLayer(Layer):
         self.root = os.path.abspath(root)
         self._gfid_dir = os.path.join(self.root, META_DIR, "gfid")
         self._xattr_dir = os.path.join(self.root, META_DIR, "xattr")
+        self._handle_dir = os.path.join(self.root, META_DIR, "handle")
 
     async def init(self):
         os.makedirs(self.root, exist_ok=True)
         os.makedirs(self._gfid_dir, exist_ok=True)
         os.makedirs(self._xattr_dir, exist_ok=True)
+        os.makedirs(self._handle_dir, exist_ok=True)
         # root of the brick always has the fixed ROOT_GFID
         if not os.path.exists(self._gfid_path(ROOT_GFID)):
             self._gfid_set(ROOT_GFID, "/")
@@ -98,14 +108,38 @@ class PosixLayer(Layer):
         """-> (inokey, relpath); raises ESTALE when the gfid is unknown."""
         try:
             with open(self._gfid_path(gfid)) as f:
-                inokey, _, relpath = f.read().partition("\n")
+                inokey, sep, relpath = f.read().partition("\n")
+            if not sep or ":" not in inokey or \
+                    not inokey.replace(":", "").isdigit():
+                # legacy single-line format: the whole record is the path
+                return "", inokey + sep + relpath
             return inokey, relpath
         except FileNotFoundError:
             raise FopError(errno.ESTALE, f"no such gfid {gfid.hex()}") from None
 
     def _gfid_resolve(self, gfid: bytes) -> str:
-        """GFID -> volume-relative path ('/a/b')."""
+        """GFID -> volume-relative path ('/a/b'): the recorded hint.
+        Authoritative for directories; for files prefer _gfid_access."""
         return self._gfid_read(gfid)[1]
+
+    def _handle_path(self, gfid: bytes) -> str:
+        return os.path.join(self._handle_dir, gfid.hex())
+
+    def _gfid_access(self, gfid: bytes) -> str:
+        """GFID -> ABSOLUTE path for I/O.  Regular files/symlinks go via
+        the handle hardlink (immune to rename/unlink of any one name);
+        directories via the recorded path."""
+        hp = self._handle_path(gfid)
+        if os.path.lexists(hp):
+            return hp
+        return self._abs(self._gfid_resolve(gfid))
+
+    def _iatt_gfid(self, gfid: bytes) -> Iatt:
+        try:
+            st = os.lstat(self._gfid_access(gfid))
+        except OSError as e:
+            raise _fop_errno(e)
+        return Iatt.from_stat(st, gfid)
 
     def _gfid_del(self, gfid: bytes) -> None:
         try:
@@ -114,14 +148,12 @@ class PosixLayer(Layer):
                 os.unlink(os.path.join(self._xattr_dir, "ino-" + inokey))
         except (FopError, FileNotFoundError):
             pass
-        try:
-            os.unlink(self._gfid_path(gfid))
-        except FileNotFoundError:
-            pass
-        try:
-            os.unlink(os.path.join(self._xattr_dir, gfid.hex() + ".json"))
-        except FileNotFoundError:
-            pass
+        for p in (self._handle_path(gfid), self._gfid_path(gfid),
+                  os.path.join(self._xattr_dir, gfid.hex() + ".json")):
+            try:
+                os.unlink(p)
+            except FileNotFoundError:
+                pass
 
     def _gfid_of(self, path: str) -> bytes | None:
         """Read the per-object gfid marker (sidecar next to xattr store)."""
@@ -138,8 +170,9 @@ class PosixLayer(Layer):
             return None
 
     def _gfid_bind(self, path: str, gfid: bytes) -> None:
+        ap = self._abs(path)
         try:
-            st = os.lstat(self._abs(path))
+            st = os.lstat(ap)
         except OSError as e:
             raise _fop_errno(e)
         key = f"{st.st_dev}:{st.st_ino}"
@@ -149,6 +182,18 @@ class PosixLayer(Layer):
         os.replace(p + ".tmp", p)
         self._gfid_set(gfid, path if path.startswith("/") else "/" + path,
                        inokey=key)
+        # handle hardlink for anything hardlinkable (reference
+        # posix_handle_hard); directories keep the text record only
+        if not os.path.isdir(ap):
+            hp = self._handle_path(gfid)
+            try:
+                os.link(ap, hp, follow_symlinks=False)
+            except FileExistsError:
+                if not os.path.samestat(st, os.lstat(hp)):
+                    os.unlink(hp)  # stale handle from a recycled gfid
+                    os.link(ap, hp, follow_symlinks=False)
+            except OSError as e:
+                log.warning(2, "handle link failed for %s: %s", path, e)
 
     def _require_gfid(self, path: str) -> bytes:
         g = self._gfid_of(path)
@@ -200,7 +245,7 @@ class PosixLayer(Layer):
         return self._iatt(self._loc_path(loc))
 
     async def fstat(self, fd: FdObj, xdata: dict | None = None):
-        return self._iatt(self._gfid_resolve(fd.gfid))
+        return self._iatt_gfid(fd.gfid)
 
     async def mkdir(self, loc: Loc, mode: int = 0o755,
                     xdata: dict | None = None):
@@ -269,21 +314,24 @@ class PosixLayer(Layer):
         path = self._loc_path(loc)
         gfid = self._gfid_of(path)
         try:
-            nlink = os.lstat(self._abs(path)).st_nlink
             os.unlink(self._abs(path))
         except OSError as e:
             raise _fop_errno(e)
         if gfid is not None:
-            if nlink > 1:
-                # inode survives via another hard link: the gfid (and its
-                # ino->gfid sidecar + xattrs) must stay stable.  The
-                # pointer path may now dangle if it named this link; the
-                # reference's .glusterfs hardlink farm sidesteps this —
-                # path-based fops on the other name re-resolve fine.
-                pass
-            else:
-                self._gfid_del(gfid)
+            self._maybe_reap(gfid)
         return {}
+
+    def _maybe_reap(self, gfid: bytes) -> None:
+        """Drop the identity when no user-visible name remains: the handle
+        hardlink holding nlink==1 means only the handle is left (reference
+        posix janitor semantics)."""
+        hp = self._handle_path(gfid)
+        try:
+            if os.lstat(hp).st_nlink > 1:
+                return  # another hard link still names this inode
+        except FileNotFoundError:
+            pass  # directory or legacy object: no handle
+        self._gfid_del(gfid)
 
     async def rmdir(self, loc: Loc, flags: int = 0, xdata: dict | None = None):
         path = self._loc_path(loc)
@@ -299,20 +347,20 @@ class PosixLayer(Layer):
     async def rename(self, oldloc: Loc, newloc: Loc, xdata: dict | None = None):
         oldp, newp = self._loc_path(oldloc), self._loc_path(newloc)
         gfid = self._gfid_of(oldp)
-        # an overwritten destination's identity dies with it
         try:
             dst_gfid = self._gfid_of(newp)
-            dst_nlink = os.lstat(self._abs(newp)).st_nlink
         except FopError:
-            dst_gfid, dst_nlink = None, 0
+            dst_gfid = None
         try:
             os.replace(self._abs(oldp), self._abs(newp))
         except OSError as e:
             raise _fop_errno(e)
-        if dst_gfid is not None and dst_gfid != gfid and dst_nlink <= 1:
-            self._gfid_del(dst_gfid)
+        if dst_gfid is not None and dst_gfid != gfid:
+            # overwritten destination: identity dies unless another hard
+            # link still names its inode
+            self._maybe_reap(dst_gfid)
         if gfid is not None:
-            self._gfid_bind(newp, gfid)  # re-records path + dev:ino key
+            self._gfid_bind(newp, gfid)  # refresh path hint + dev:ino key
         return self._iatt(newp)
 
     # -- fd fops -----------------------------------------------------------
@@ -339,10 +387,9 @@ class PosixLayer(Layer):
     def _os_fd(self, fd: FdObj) -> int:
         fdno = fd.ctx_get(self)
         if fdno is None:
-            # anonymous fd: open on demand (reference anonymous fds)
-            path = self._gfid_resolve(fd.gfid)
+            # anonymous fd: open on demand via the handle hardlink
             try:
-                fdno = os.open(self._abs(path), os.O_RDWR)
+                fdno = os.open(self._gfid_access(fd.gfid), os.O_RDWR)
             except OSError as e:
                 raise _fop_errno(e)
             fd.ctx_set(self, fdno)
@@ -368,7 +415,7 @@ class PosixLayer(Layer):
                 pos += n
         except OSError as e:
             raise _fop_errno(e)
-        return self._iatt(self._gfid_resolve(fd.gfid))
+        return self._iatt_gfid(fd.gfid)
 
     async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
         path = self._loc_path(loc)
@@ -383,7 +430,7 @@ class PosixLayer(Layer):
             os.ftruncate(self._os_fd(fd), size)
         except OSError as e:
             raise _fop_errno(e)
-        return self._iatt(self._gfid_resolve(fd.gfid))
+        return self._iatt_gfid(fd.gfid)
 
     async def flush(self, fd: FdObj, xdata: dict | None = None):
         return {}
@@ -439,27 +486,33 @@ class PosixLayer(Layer):
 
     # -- attrs / xattrs ----------------------------------------------------
 
+    @staticmethod
+    def _apply_attrs(ap: str, attrs: dict) -> None:
+        if "mode" in attrs:
+            os.chmod(ap, attrs["mode"])
+        if "uid" in attrs or "gid" in attrs:
+            os.chown(ap, attrs.get("uid", -1), attrs.get("gid", -1))
+        if "atime" in attrs or "mtime" in attrs:
+            st = os.stat(ap)
+            os.utime(ap, (attrs.get("atime", st.st_atime),
+                          attrs.get("mtime", st.st_mtime)))
+
     async def setattr(self, loc: Loc, attrs: dict, valid: int = 0,
                       xdata: dict | None = None):
         path = self._loc_path(loc)
-        ap = self._abs(path)
         try:
-            if "mode" in attrs:
-                os.chmod(ap, attrs["mode"])
-            if "uid" in attrs or "gid" in attrs:
-                os.chown(ap, attrs.get("uid", -1), attrs.get("gid", -1))
-            if "atime" in attrs or "mtime" in attrs:
-                st = os.stat(ap)
-                os.utime(ap, (attrs.get("atime", st.st_atime),
-                              attrs.get("mtime", st.st_mtime)))
+            self._apply_attrs(self._abs(path), attrs)
         except OSError as e:
             raise _fop_errno(e)
         return self._iatt(path)
 
     async def fsetattr(self, fd: FdObj, attrs: dict, valid: int = 0,
                        xdata: dict | None = None):
-        return await self.setattr(Loc(self._gfid_resolve(fd.gfid)),
-                                  attrs, valid, xdata)
+        try:
+            self._apply_attrs(self._gfid_access(fd.gfid), attrs)
+        except OSError as e:
+            raise _fop_errno(e)
+        return self._iatt_gfid(fd.gfid)
 
     async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
                        xdata: dict | None = None):
@@ -563,7 +616,7 @@ class PosixLayer(Layer):
             os.posix_fallocate(self._os_fd(fd), offset, length)
         except OSError as e:
             raise _fop_errno(e)
-        return self._iatt(self._gfid_resolve(fd.gfid))
+        return self._iatt_gfid(fd.gfid)
 
     async def discard(self, fd: FdObj, offset: int, length: int,
                       xdata: dict | None = None):
@@ -576,7 +629,7 @@ class PosixLayer(Layer):
             os.pwrite(self._os_fd(fd), b"\0" * length, offset)
         except OSError as e:
             raise _fop_errno(e)
-        return self._iatt(self._gfid_resolve(fd.gfid))
+        return self._iatt_gfid(fd.gfid)
 
     async def rchecksum(self, fd: FdObj, offset: int, length: int,
                         xdata: dict | None = None):

@@ -10,7 +10,7 @@ CONFIGS = [(4, 2), (8, 4), (16, 4)]
 
 
 @pytest.mark.parametrize("k,r", CONFIGS)
-@pytest.mark.parametrize("formulation", ["xor", "xor3", "mxu"])
+@pytest.mark.parametrize("formulation", ["xor", "xor3", "mxu", "fused"])
 def test_encode_parity(k, r, formulation):
     n = k + r
     rng = np.random.default_rng(k + r)
@@ -21,7 +21,7 @@ def test_encode_parity(k, r, formulation):
 
 
 @pytest.mark.parametrize("k,r", CONFIGS)
-@pytest.mark.parametrize("formulation", ["xor", "xor3", "mxu"])
+@pytest.mark.parametrize("formulation", ["xor", "xor3", "mxu", "fused"])
 def test_decode_parity(k, r, formulation):
     n = k + r
     rng = np.random.default_rng(k * 3 + r)
@@ -30,3 +30,32 @@ def test_decode_parity(k, r, formulation):
     rows = list(range(r, r + k))
     got = gf256_pallas.decode(frags[rows], rows, k, formulation, interpret=True)
     assert np.array_equal(got, data)
+
+
+@pytest.mark.parametrize("k,r", [(4, 2), (8, 3)])
+def test_fused_unaligned_stripe_counts(k, r):
+    """Stripe counts that don't divide the kernel tile must pad+trim."""
+    n = k + r
+    for s in (1, 3, 127, 129):
+        rng = np.random.default_rng(s)
+        data = rng.integers(0, 256, k * gf256.CHUNK_SIZE * s, dtype=np.uint8)
+        frags = gf256_pallas.encode(data, k, n, "fused", interpret=True)
+        assert np.array_equal(frags, gf256.ref_encode(data, k, n))
+        rows = list(range(r, r + k))
+        out = gf256_pallas.decode(frags[rows], rows, k, "fused",
+                                  interpret=True)
+        assert np.array_equal(out, data)
+
+
+def test_fused_all_masks_4p2():
+    import itertools
+
+    k, r = 4, 2
+    n = k + r
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, k * gf256.CHUNK_SIZE * 2, dtype=np.uint8)
+    frags = gf256.ref_encode(data, k, n)
+    for rows in itertools.combinations(range(n), k):
+        out = gf256_pallas.decode(frags[np.asarray(rows)], rows, k, "fused",
+                                  interpret=True)
+        assert np.array_equal(out, data), rows

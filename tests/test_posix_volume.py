@@ -180,3 +180,43 @@ def test_filename_with_newline(client):
     assert f.read(6, 0) == b"tricky"  # fd path resolves via gfid pointer
     f.close()
     client.unlink("/a\nb")
+
+
+def test_fd_ops_on_surviving_hardlink(client):
+    """fd-based fops must keep working when the path the fd was opened
+    under disappears (handle hardlink farm, reference posix-handle.h)."""
+    client.write_file("/a", b"0123456789")
+    client.link("/a", "/b")
+    f = client.open("/b")
+    client.unlink("/a")
+    assert f.read(10, 0) == b"0123456789"
+    f.write(b"XX", 0)
+    assert client.stat("/b").size == 10
+    f.close()
+    assert client.read_file("/b") == b"XX23456789"
+
+
+def test_fd_identity_after_rename_over(client):
+    """An fd open on a file that gets renamed over must keep addressing
+    ITS inode (not the replacing file's)."""
+    client.write_file("/src", b"sevenby")
+    client.write_file("/dst", b"ninebytess")
+    client.link("/dst", "/dst2")   # keeps dst's inode alive post-rename
+    f = client.open("/dst")
+    client.rename("/src", "/dst")
+    st = f.fstat()
+    assert st.size == 10           # still the old dst inode
+    f.write(b"ZZ", 0)
+    f.close()
+    assert client.read_file("/dst2") == b"ZZnebytess"  # wrote to old inode
+    assert client.read_file("/dst") == b"sevenby"      # src content intact
+
+
+def test_rename_updates_fd_of_source(client):
+    """An fd open on the rename SOURCE keeps working after the rename."""
+    client.write_file("/x", b"hello")
+    f = client.open("/x")
+    client.rename("/x", "/y")
+    f.write(b"HELLO", 0)
+    f.close()
+    assert client.read_file("/y") == b"HELLO"
