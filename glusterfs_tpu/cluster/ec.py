@@ -85,6 +85,13 @@ class DisperseLayer(Layer):
         Option("quorum-count", "int", default=0, min=0,
                description="extra write quorum (0 = K)"),
         Option("self-heal-window-size", "size", default="1M"),
+        Option("stripe-cache", "bool", default="on",
+               description="coalesce concurrent fop codec work into one "
+                           "device batch per tick (ec.c:286 analog)"),
+        Option("stripe-cache-window", "int", default=300, min=0,
+               description="batching window in microseconds"),
+        Option("stripe-cache-min-batch", "size", default="256KB",
+               description="batches below this run on the CPU ladder"),
     )
 
     def __init__(self, *args, **kw):
@@ -97,8 +104,13 @@ class DisperseLayer(Layer):
                 f"{self.name}: need K>=1, R>=1 (n={self.n}, r={self.r})")
         if self.k > 16:
             raise ValueError(f"{self.name}: K={self.k} exceeds max 16")
-        self.codec = codec_mod.Codec(self.k, self.r,
-                                     self.opts["cpu-extensions"])
+        from ..ops.batch import BatchingCodec
+
+        self.codec = BatchingCodec(
+            self.k, self.r, self.opts["cpu-extensions"],
+            window=self.opts["stripe-cache-window"] / 1e6,
+            min_batch=self.opts["stripe-cache-min-batch"])
+        self._batching = self.opts["stripe-cache"]
         self.stripe = self.k * CHUNK
         self.up = [True] * self.n  # xl_up bitmask (ec.c:571 notify)
         self._locks: dict[bytes, asyncio.Lock] = {}
@@ -581,7 +593,7 @@ class DisperseLayer(Layer):
             for j, i in enumerate(rows_sorted):
                 buf = np.frombuffer(good[i], dtype=np.uint8)
                 frags[j, : buf.size] = buf
-            data = self.codec.decode(frags, rows_sorted)
+            data = await self._codec_decode(frags, rows_sorted)
             return data
         raise last_err or FopError(errno.EIO, "read failed")
 
@@ -623,7 +635,7 @@ class DisperseLayer(Layer):
                         buf[max(0, true_size - a_off): old.size] = 0
             buf[offset - a_off: end - a_off] = np.frombuffer(
                 bytes(data), dtype=np.uint8)
-            frags = self.codec.encode(buf)
+            frags = await self._codec_encode(buf)
             idxs = self._up_idx()
             f_off = a_off // self.k
             new_size = max(true_size, end)
@@ -685,7 +697,7 @@ class DisperseLayer(Layer):
             if len(good) < self._write_quorum():
                 raise FopError(errno.EIO, "truncate quorum lost")
             if tail:
-                frags = self.codec.encode(
+                frags = await self._codec_encode(
                     np.frombuffer(tail, dtype=np.uint8))
                 f_off = (a_size - self.stripe) // self.k
                 await self._dispatch(
@@ -777,8 +789,8 @@ class DisperseLayer(Layer):
                         raise FopError(errno.EIO, "heal source read failed")
                     b = np.frombuffer(r, dtype=np.uint8)
                     frags_in[j, : b.size] = b
-                data = self.codec.decode(frags_in, rows_sorted)
-                frags_out = self.codec.encode(data)
+                data = await self._codec_decode(frags_in, rows_sorted)
+                frags_out = await self._codec_encode(data)
                 await self._dispatch(
                     bad, "writev",
                     lambda i: ((self._child_fd(fd, i),
@@ -797,10 +809,21 @@ class DisperseLayer(Layer):
             return {"healed": healed, "skipped": False,
                     "size": true_size}
 
+    async def _codec_encode(self, buf):
+        if self._batching:
+            return await self.codec.encode_async(buf)
+        return self.codec.encode(buf)
+
+    async def _codec_decode(self, frags, rows):
+        if self._batching:
+            return await self.codec.decode_async(frags, rows)
+        return self.codec.decode(frags, rows)
+
     def dump_private(self) -> dict:
         return {
             "fragments": self.k, "redundancy": self.r,
             "stripe_size": self.stripe,
             "backend": self.codec.backend,
             "up": self.up, "up_count": sum(self.up),
+            "stripe_cache": self.codec.dump_stats(),
         }

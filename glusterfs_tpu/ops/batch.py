@@ -1,0 +1,196 @@
+"""Batching codec: coalesce concurrent fop codec work into one device batch.
+
+The reference amortizes per-write stripe work with a stripe-cache
+(reference xlators/cluster/ec/src/ec.c:286 option ``stripe-cache``); the
+TPU analog — and the north star's "stripe fragments from concurrent fops
+coalesced into HBM-resident batches" — is a batching window:
+
+* concurrent ``encode_async``/``decode_async`` calls within one event-loop
+  tick (plus ``window`` seconds) queue into a pending list;
+* one flush concatenates the queued stripe-aligned payloads and makes ONE
+  kernel launch for the whole batch (encode; decodes group by surviving
+  mask — one launch per mask, same keying as the reference's LRU of
+  inverted matrices);
+* a latency cutoff keeps small/straggler batches off the device: below
+  ``min_batch`` bytes the flush runs on the native/CPU ladder instead, so
+  a lone metadata-sized write never pays a device dispatch.
+
+Correctness leans on fragment-stream concatenation: fragment ``f`` of
+``concat(stripes_a, stripes_b)`` is ``concat(frag_f(a), frag_f(b))`` —
+stripes are independent (ec-method.c:393-408 loops stripes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+
+from . import gf256
+from .codec import Codec
+
+_DEVICE_BACKENDS = ("pallas-xor", "pallas-mxu", "xla", "xla-xor")
+
+
+class BatchingCodec(Codec):
+    """Codec with an async batching window for the served data path.
+
+    The sync ``encode``/``decode`` API stays available (heal tooling,
+    tests); the data path awaits ``encode_async``/``decode_async``.
+
+    Stats: ``launches`` counts device batch launches, ``cpu_launches``
+    counts small-batch fallbacks, ``batched_fops`` total fops served,
+    ``max_batch`` the largest coalesced batch in fops.
+    """
+
+    def __init__(self, k: int, r: int, backend: str = "auto", *,
+                 window: float = 0.0003, min_batch: int = 256 * 1024,
+                 max_batch_bytes: int = 256 << 20):
+        super().__init__(k, r, backend)
+        self.window = window
+        self.min_batch = min_batch
+        self.max_batch_bytes = max_batch_bytes
+        self._enc_q: list[tuple[np.ndarray, asyncio.Future]] = []
+        self._enc_task: asyncio.Task | None = None
+        self._dec_q: dict[tuple[int, ...],
+                          list[tuple[np.ndarray, asyncio.Future]]] = {}
+        self._dec_task: asyncio.Task | None = None
+        self._cpu = None  # lazy small-batch codec
+        self.launches = 0
+        self.cpu_launches = 0
+        self.batched_fops = 0
+        self.max_batch = 0
+
+    # -- stats hooks (count every device launch, sync path included) ------
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        self.launches += 1
+        return super().encode(data)
+
+    def decode(self, frags: np.ndarray, rows) -> np.ndarray:
+        self.launches += 1
+        return super().decode(frags, rows)
+
+    def _small(self) -> Codec:
+        if self._cpu is None:
+            if self.backend in _DEVICE_BACKENDS:
+                try:
+                    self._cpu = Codec(self.k, self.r, "native")
+                except RuntimeError:
+                    self._cpu = Codec(self.k, self.r, "ref")
+            else:
+                self._cpu = self  # already a CPU ladder backend
+        return self._cpu
+
+    # -- encode ------------------------------------------------------------
+
+    async def encode_async(self, data: np.ndarray) -> np.ndarray:
+        """Encode stripe-aligned bytes; coalesced with concurrent calls."""
+        data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
+        if data.size % self.stripe_size:
+            raise ValueError("data length not a multiple of the stripe")
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._enc_q.append((data, fut))
+        if sum(d.size for d, _ in self._enc_q) >= self.max_batch_bytes:
+            self._flush_encodes()
+        elif self._enc_task is None:
+            self._enc_task = asyncio.ensure_future(self._enc_timer())
+        return await fut
+
+    async def _enc_timer(self):
+        await asyncio.sleep(self.window)
+        self._flush_encodes()
+
+    def _flush_encodes(self) -> None:
+        if self._enc_task is not None:
+            self._enc_task.cancel()
+            self._enc_task = None
+        batch, self._enc_q = self._enc_q, []
+        if not batch:
+            return
+        self.batched_fops += len(batch)
+        self.max_batch = max(self.max_batch, len(batch))
+        total = sum(d.size for d, _ in batch)
+        codec: Codec = self
+        if total < self.min_batch and self._small() is not self:
+            codec = self._small()
+            self.cpu_launches += 1
+        try:
+            if len(batch) == 1:
+                frags = codec.encode(batch[0][0])
+                batch[0][1].set_result(frags)
+                return
+            cat = np.concatenate([d for d, _ in batch])
+            frags = codec.encode(cat)  # ONE launch for the whole batch
+            off = 0
+            for d, fut in batch:
+                flen = d.size // self.k
+                if not fut.cancelled():
+                    fut.set_result(frags[:, off:off + flen].copy())
+                off += flen
+        except Exception as e:  # pragma: no cover - propagate to callers
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    # -- decode ------------------------------------------------------------
+
+    async def decode_async(self, frags: np.ndarray, rows) -> np.ndarray:
+        """Decode k fragments; coalesced with concurrent same-mask calls."""
+        rows = tuple(int(x) for x in rows)
+        frags = np.ascontiguousarray(frags, dtype=np.uint8)
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        q = self._dec_q.setdefault(rows, [])
+        q.append((frags, fut))
+        if sum(f.size for f, _ in q) >= self.max_batch_bytes:
+            self._flush_decodes()  # same blow-up guard as the encode path
+        elif self._dec_task is None:
+            self._dec_task = asyncio.ensure_future(self._dec_timer())
+        return await fut
+
+    async def _dec_timer(self):
+        await asyncio.sleep(self.window)
+        self._flush_decodes()
+
+    def _flush_decodes(self) -> None:
+        if self._dec_task is not None:
+            self._dec_task.cancel()
+            self._dec_task = None
+        queues, self._dec_q = self._dec_q, {}
+        for rows, batch in queues.items():
+            self.batched_fops += len(batch)
+            self.max_batch = max(self.max_batch, len(batch))
+            total = sum(f.size for f, _ in batch)
+            codec: Codec = self
+            if total < self.min_batch and self._small() is not self:
+                codec = self._small()
+                self.cpu_launches += 1
+            try:
+                if len(batch) == 1:
+                    batch[0][1].set_result(codec.decode(batch[0][0], rows))
+                    continue
+                cat = np.concatenate([f for f, _ in batch], axis=1)
+                out = codec.decode(cat, rows)  # one launch per mask
+                off = 0
+                for f, fut in batch:
+                    nbytes = f.shape[1] * self.k
+                    if not fut.cancelled():
+                        fut.set_result(out[off:off + nbytes].copy())
+                    off += nbytes
+            except Exception as e:  # pragma: no cover
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    def dump_stats(self) -> dict:
+        return {
+            "backend": self.backend,
+            "launches": self.launches,
+            "cpu_launches": self.cpu_launches,
+            "batched_fops": self.batched_fops,
+            "max_batch": self.max_batch,
+            "window_s": self.window,
+            "min_batch_bytes": self.min_batch,
+        }

@@ -1,0 +1,113 @@
+"""Batching codec (stripe-cache analog): concurrent fop codec work must
+coalesce into one device batch per tick, with a CPU-ladder cutoff for
+small batches (reference ec.c:286 stripe-cache + north-star
+"HBM-resident batches" requirement)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from glusterfs_tpu.ops import gf256
+from glusterfs_tpu.ops.batch import BatchingCodec
+
+K, R = 4, 2
+STRIPE = K * 512
+
+
+def _rand(n, seed):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+def test_concurrent_encodes_one_launch():
+    codec = BatchingCodec(K, R, "xla", window=0.005, min_batch=0)
+
+    async def run():
+        datas = [_rand(STRIPE * (i + 1), i) for i in range(8)]
+        outs = await asyncio.gather(
+            *(codec.encode_async(d) for d in datas))
+        return datas, outs
+
+    datas, outs = asyncio.run(run())
+    assert codec.launches == 1, "8 concurrent encodes must share 1 launch"
+    assert codec.max_batch == 8
+    for d, o in zip(datas, outs):
+        assert np.array_equal(o, gf256.ref_encode(d, K, K + R))
+
+
+def test_concurrent_decodes_group_by_mask():
+    codec = BatchingCodec(K, R, "xla", window=0.005, min_batch=0)
+    rng_rows = [(0, 1, 2, 3), (1, 3, 4, 5), (0, 1, 2, 3)]
+    datas = [_rand(STRIPE * 2, 10 + i) for i in range(3)]
+    frag_sets = [gf256.ref_encode(d, K, K + R) for d in datas]
+
+    async def run():
+        return await asyncio.gather(*(
+            codec.decode_async(fr[np.asarray(rows)], rows)
+            for fr, rows in zip(frag_sets, rng_rows)))
+
+    outs = asyncio.run(run())
+    # two distinct masks -> exactly two launches
+    assert codec.launches == 2
+    for d, o in zip(datas, outs):
+        assert np.array_equal(o, d)
+
+
+def test_small_batch_falls_back_to_cpu_ladder():
+    codec = BatchingCodec(K, R, "xla", window=0.002,
+                          min_batch=1 << 20)  # everything is "small"
+
+    async def run():
+        d = _rand(STRIPE, 3)
+        return d, await codec.encode_async(d)
+
+    d, out = asyncio.run(run())
+    assert codec.launches == 0, "small batch must not hit the device path"
+    assert codec.cpu_launches == 1
+    assert np.array_equal(out, gf256.ref_encode(d, K, K + R))
+
+
+def test_sequential_calls_do_not_starve():
+    codec = BatchingCodec(K, R, "xla", window=0.001, min_batch=0)
+
+    async def run():
+        outs = []
+        for i in range(3):  # strictly sequential: each waits its window
+            d = _rand(STRIPE, 20 + i)
+            outs.append((d, await codec.encode_async(d)))
+        return outs
+
+    for d, o in asyncio.run(run()):
+        assert np.array_equal(o, gf256.ref_encode(d, K, K + R))
+
+
+def test_ec_volume_concurrent_writes_coalesce(tmp_path):
+    """N concurrent client writes on an EC volume must be served by fewer
+    codec launches than fops (the served-data-path coalescing the north
+    star asks for), and every byte must round-trip."""
+    from glusterfs_tpu.api.glfs import Client
+    from glusterfs_tpu.core.graph import Graph
+    from glusterfs_tpu.utils.volspec import ec_volfile
+
+    volspec = ec_volfile(tmp_path, K + R, R, options={
+        "cpu-extensions": "xla", "stripe-cache": "on",
+        "stripe-cache-window": 2000, "stripe-cache-min-batch": 0})
+
+    datas = {f"/f{i}": bytes(_rand(4 * STRIPE, 40 + i)) for i in range(12)}
+
+    async def run():
+        c = Client(Graph.construct(volspec))
+        await c.mount()
+        ec = c.graph.top
+        await asyncio.gather(*(
+            c.write_file(p, d) for p, d in datas.items()))
+        writes_launches = ec.codec.launches
+        reads = await asyncio.gather(*(
+            c.read_file(p) for p in datas))
+        await c.unmount()
+        return writes_launches, ec.codec.launches, reads
+
+    wl, total_l, reads = asyncio.run(run())
+    assert wl < 12, f"12 concurrent writes took {wl} launches (no coalescing)"
+    for (p, d), got in zip(datas.items(), reads):
+        assert got == d, p

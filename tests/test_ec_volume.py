@@ -18,16 +18,9 @@ STRIPE = K * 512
 
 
 def volfile(base) -> str:
-    out = []
-    for i in range(N):
-        out.append(f"volume b{i}\n    type storage/posix\n"
-                   f"    option directory {base}/brick{i}\nend-volume\n")
-    subs = " ".join(f"b{i}" for i in range(N))
-    out.append(f"volume disp\n    type cluster/disperse\n"
-               f"    option redundancy {R}\n"
-               f"    option cpu-extensions auto\n"
-               f"    subvolumes {subs}\nend-volume\n")
-    return "\n".join(out)
+    from glusterfs_tpu.utils.volspec import ec_volfile
+
+    return ec_volfile(base, N, R, options={"cpu-extensions": "auto"})
 
 
 @pytest.fixture
@@ -197,12 +190,14 @@ def test_read_during_write_sees_whole_version(tmp_path):
 
     from glusterfs_tpu.api.glfs import Client
 
+    # per-brick DISTINCT delay durations: hand-built spec (the shared
+    # builder applies identical layers to every brick)
     out = []
     for i in range(N):
-        out.append(f"volume p{i}\n    type storage/posix\n"
-                   f"    option directory {tmp_path}/brick{i}\nend-volume\n")
         # stagger each brick's writev completion so a racing read lands
         # while some bricks hold new fragments and others still old ones
+        out.append(f"volume p{i}\n    type storage/posix\n"
+                   f"    option directory {tmp_path}/brick{i}\nend-volume\n")
         out.append(f"volume d{i}\n    type debug/delay-gen\n"
                    f"    option enable writev\n"
                    f"    option delay-percentage 100\n"
