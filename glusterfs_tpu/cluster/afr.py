@@ -445,12 +445,15 @@ class ReplicateLayer(Layer):
             if len(good) < self._quorum():
                 raise FopError(errno.EIO,
                                f"write quorum lost ({len(good)}/{self.n})")
+            # dirty is only released when every replica took the write;
+            # a partial success keeps the mark (and the brick-side
+            # pending-index entry) for the self-heal daemon
+            # (afr-transaction.c afr_changelog_post_op semantics)
+            post = {XA_VERSION: _pack_u64x2(1, 0)}
+            if len(good) == self.n:
+                post[XA_DIRTY] = _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0)
             await self._dispatch(
-                good, "xattrop",
-                lambda i: ((loc, "add64", {
-                    XA_VERSION: _pack_u64x2(1, 0),
-                    XA_DIRTY: _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0),
-                }), {}))
+                good, "xattrop", lambda i: ((loc, "add64", dict(post)), {}))
             return next(r for i, r in res.items() if i in good)
 
     async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
@@ -467,12 +470,11 @@ class ReplicateLayer(Layer):
                     if not isinstance(r, BaseException)]
             if len(good) < self._quorum():
                 raise FopError(errno.EIO, "truncate quorum lost")
+            post = {XA_VERSION: _pack_u64x2(1, 0)}
+            if len(good) == self.n:
+                post[XA_DIRTY] = _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0)
             await self._dispatch(
-                good, "xattrop",
-                lambda i: ((loc, "add64", {
-                    XA_VERSION: _pack_u64x2(1, 0),
-                    XA_DIRTY: _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0),
-                }), {}))
+                good, "xattrop", lambda i: ((loc, "add64", dict(post)), {}))
             return next(r for i, r in res.items() if i in good)
 
     async def ftruncate(self, fd: FdObj, size: int,
@@ -482,6 +484,13 @@ class ReplicateLayer(Layer):
     # -- heal --------------------------------------------------------------
 
     async def heal_info(self, loc: Loc) -> dict:
+        """Heal direction by committed version, never clean-ness: a brick
+        that slept through the write is spotlessly clean AND stale —
+        electing it as source would heal new data away.  The highest
+        post-op version wins (afr_selfheal_find_direction semantics:
+        pending counters point away from sources); dirty marks on the
+        winners are expected after a partial write and do not disqualify
+        them."""
         meta = await self._get_meta(list(range(self.n)), loc)
         versions = {}
         for i, m in meta.items():
@@ -490,13 +499,12 @@ class ReplicateLayer(Layer):
         ok = {i: v for i, v in versions.items() if v is not None}
         if not ok:
             raise FopError(errno.ENOTCONN, "no bricks reachable")
-        clean = {i: v for i, v in ok.items() if v[1] == (0, 0)}
-        pool = clean or ok
-        best = max(v[0] for v in pool.values())
-        good = [i for i, v in pool.items() if v[0] == best]
+        best = max(v[0] for v in ok.values())
+        good = [i for i, v in ok.items() if v[0] == best]
         bad = [i for i in range(self.n) if i not in good]
+        dirty = any(v[1] != (0, 0) for v in ok.values())
         return {"good": good, "bad": bad, "version": best,
-                "per_brick": versions}
+                "per_brick": versions, "dirty": dirty}
 
     async def heal_file(self, path: str) -> dict:
         loc = Loc(path)
@@ -505,7 +513,19 @@ class ReplicateLayer(Layer):
         if not good:
             raise FopError(errno.EIO, "no heal source")
         if not bad:
-            return {"healed": [], "skipped": True}
+            if not info.get("dirty"):
+                return {"healed": [], "skipped": True}
+            # Dirty with equal versions can hide diverged content (a
+            # quorum-lost write data-lands on some replicas before the
+            # fop fails, with no post-op anywhere).  Re-copy from one
+            # source instead of just unmarking (afr data heal re-runs
+            # whenever dirty is set).
+            fav = self.opts["favorite-child"]
+            src = fav if fav in good else good[0]
+            bad = [i for i in good if i != src]
+            good = [src]
+            if not bad:
+                return {"healed": [], "skipped": True}
         fav = self.opts["favorite-child"]
         src = fav if fav in good else good[0]
         ia, _ = await self.lookup(loc)

@@ -652,11 +652,16 @@ class DisperseLayer(Layer):
                 # leave dirty marks on everything; fail the fop
                 raise FopError(errno.EIO,
                                f"write quorum lost ({len(good)}/{self.n})")
-            # post-op on the good ones: version+1, dirty-1, size
-            await self._xattrop(good, loc, {
-                XA_VERSION: _pack_u64x2(1, 0),
-                XA_DIRTY: _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0),
-            })
+            # post-op on the good ones: version+1, size; dirty is only
+            # released when EVERY brick took the write — a partial
+            # success leaves the dirty mark (and the brick-side pending
+            # index entry) so the self-heal daemon finds the file
+            # (ec-common.c ec_update_info: unset dirty only when
+            # good == all)
+            post = {XA_VERSION: _pack_u64x2(1, 0)}
+            if len(good) == self.n:
+                post[XA_DIRTY] = _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0)
+            await self._xattrop(good, loc, post)
             # xattrop add64 wraps; use set for size
             await self._dispatch(
                 good, "setxattr",
@@ -704,10 +709,10 @@ class DisperseLayer(Layer):
                     good, "writev",
                     lambda i: ((self._child_fd(fd, i),
                                 frags[i].tobytes(), f_off), {}))
-            await self._xattrop(good, loc, {
-                XA_VERSION: _pack_u64x2(1, 0),
-                XA_DIRTY: _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0),
-            })
+            post = {XA_VERSION: _pack_u64x2(1, 0)}
+            if len(good) == self.n:
+                post[XA_DIRTY] = _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0)
+            await self._xattrop(good, loc, post)
             await self._dispatch(
                 good, "setxattr",
                 lambda i: ((loc, {XA_SIZE: struct.pack(">Q", size)}), {}))
@@ -717,7 +722,16 @@ class DisperseLayer(Layer):
     # -- heal (ec-heal.c analog) -------------------------------------------
 
     async def heal_info(self, loc: Loc) -> dict:
-        """Which bricks disagree on version/dirty (heal candidates)."""
+        """Which bricks disagree on version/size (heal candidates).
+
+        Direction logic (reference ec_heal_data_find_direction,
+        ec-heal.c:1658): bricks are grouped by (data version, size); the
+        source group is the one with the HIGHEST version that still has
+        >= K members — never a dirty-but-stale brick that only saw the
+        pre-op.  Dirty flags do not disqualify a source: after a partial
+        write the surviving bricks keep dirty set on purpose (that is
+        what feeds the pending index), yet they hold both the data and
+        the post-op version bump."""
         meta = await self._get_meta(list(range(self.n)), loc)
         versions = {}
         for i, m in meta.items():
@@ -725,19 +739,20 @@ class DisperseLayer(Layer):
                 versions[i] = None
             else:
                 versions[i] = (m["version"], m["size"], m["dirty"])
-        ok_vals = [v for v in versions.values() if v is not None]
-        if not ok_vals:
+        ok = {i: v for i, v in versions.items() if v is not None}
+        if not ok:
             raise FopError(errno.ENOTCONN, "no bricks reachable")
-        best = Counter(
-            (v[0], v[1]) for v in ok_vals if v[2] == (0, 0)).most_common(1)
-        good_vs = best[0][0] if best else max(
-            (v[0], v[1]) for v in ok_vals)
-        good = [i for i, v in versions.items()
-                if v is not None and (v[0], v[1]) == good_vs
-                and v[2] == (0, 0)]
+        groups: dict[tuple, list[int]] = {}
+        for i, v in ok.items():
+            groups.setdefault((v[0], v[1]), []).append(i)
+        viable = [vs for vs, members in groups.items()
+                  if len(members) >= self.k]
+        good_vs = max(viable) if viable else max(groups)
+        good = sorted(groups[good_vs])
         bad = [i for i in range(self.n) if i not in good]
+        dirty = any(v[2] != (0, 0) for v in ok.values())
         return {"good": good, "bad": bad, "version": good_vs,
-                "per_brick": versions}
+                "per_brick": versions, "dirty": dirty}
 
     async def heal_file(self, path: str) -> dict:
         """Full-file re-encode heal: decode from good K, rewrite bad
@@ -749,7 +764,16 @@ class DisperseLayer(Layer):
             raise FopError(errno.EIO,
                            f"unhealable: only {len(good)} good copies")
         if not bad:
-            return {"healed": [], "skipped": True}
+            if not info.get("dirty"):
+                return {"healed": [], "skipped": True}
+            # Dirty with no version skew does NOT mean converged content:
+            # a quorum-lost write leaves a mix of old and new fragments
+            # behind identical version/size xattrs.  Rebuild the
+            # non-source bricks from K sources before releasing dirty —
+            # the reference re-runs data heal whenever dirty is set
+            # (ec_heal_data, ec-heal.c:2048), never just unmarks.
+            bad = good[self.k:]
+            good = good[: self.k]
         gfid = (await self.lookup(loc))[0].gfid
         async with self._Txn(self, loc, gfid, "wr"):
             meta = await self._get_meta(good, loc)

@@ -62,6 +62,7 @@ class Glusterd:
         self.uuid = self.state.setdefault("uuid", str(uuid.uuid4()))
         self.bricks: dict[str, subprocess.Popen] = {}  # brickname -> proc
         self.ports: dict[str, int] = {}  # portmap: brickname -> port
+        self.shd: dict[str, subprocess.Popen] = {}  # volname -> shd proc
         self._server: asyncio.AbstractServer | None = None
         self._txn_lock = asyncio.Lock()
         self._txn_holder: str | None = None
@@ -95,9 +96,12 @@ class Glusterd:
         for vol in self.state["volumes"].values():
             if vol.get("status") == "started":
                 await self._start_local_bricks(vol)
+                self._spawn_shd(vol)
         return self.port
 
     async def stop(self) -> None:
+        for name in list(self.shd):
+            self._kill_shd(name)
         for name in list(self.bricks):
             self._kill_brick(name)
         if self._server is not None:
@@ -300,6 +304,7 @@ class Glusterd:
         vol["status"] = "started"
         self._save()
         await self._start_local_bricks(vol)
+        self._spawn_shd(vol)
         return {"started": name,
                 "ports": {b["name"]: self.ports[b["name"]]
                           for b in vol["bricks"]
@@ -322,6 +327,7 @@ class Glusterd:
         vol = self._vol(name)
         vol["status"] = "stopped"
         self._save()
+        self._kill_shd(name)
         for b in vol["bricks"]:
             if b["node"] == self.uuid:
                 self._kill_brick(b["name"])
@@ -367,7 +373,63 @@ class Glusterd:
                 "port": self.ports.get(b["name"], 0),
                 "online": proc is not None and proc.poll() is None,
             })
-        return {"volume": name, "status": vol["status"], "bricks": bricks}
+        shd = self.shd.get(name)
+        return {"volume": name, "status": vol["status"], "bricks": bricks,
+                "shd": {"online": shd is not None and shd.poll() is None,
+                        "pid": shd.pid if shd is not None else 0}}
+
+    async def op_volume_heal(self, name: str, action: str = "info",
+                             path: str = "") -> dict:
+        """``gluster volume heal <v> [info|full|<path>]`` (glfs-heal.c /
+        glusterd heal op analog): mounts a temporary client graph and
+        drives the index-based heal surface."""
+        vol = self._vol(name)
+        if vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        from . import shd as shd_mod
+
+        client = await mount_volume(self.host, self.port, name)
+        try:
+            if action == "info":
+                return await shd_mod.gather_heal_info(client)
+            if action == "full":
+                return await shd_mod.crawl_once(client)
+            if action == "file":
+                if not path:
+                    raise MgmtError("heal file needs a path")
+                layers = shd_mod._heal_layers(client.graph)
+                if not layers:
+                    raise MgmtError("volume has no heal-capable layer")
+                out = {}
+                for l in layers:
+                    try:
+                        out[l.name] = await l.heal_file(path)
+                    except FopError as e:
+                        out[l.name] = {"error": str(e)}
+                return out
+            raise MgmtError(f"unknown heal action {action!r}")
+        finally:
+            await client.unmount()
+
+    async def op_volume_brick(self, name: str, brick: str,
+                              action: str) -> dict:
+        """Stop / start one local brick daemon (the tests' kill_brick +
+        ``volume start force`` analog); restart reuses the recorded port
+        so connected clients can reconnect."""
+        vol = self._vol(name)
+        b = next((x for x in vol["bricks"] if x["name"] == brick), None)
+        if b is None:
+            raise MgmtError(f"no brick {brick!r} in {name}")
+        if action == "stop":
+            self._kill_brick(brick)
+            return {"stopped": brick}
+        if action == "start":
+            proc = self.bricks.get(brick)
+            if proc is not None and proc.poll() is None:
+                return {"already-running": brick}
+            await self._spawn_brick(vol, b, port=b.get("port"))
+            return {"started": brick, "port": self.ports.get(brick, 0)}
+        raise MgmtError(f"unknown brick action {action!r}")
 
     def op_getspec(self, name: str) -> dict:
         """Serve the client volfile (__server_getspec analog)."""
@@ -391,7 +453,8 @@ class Glusterd:
                 continue
             await self._spawn_brick(vol, b)
 
-    async def _spawn_brick(self, vol: dict, b: dict) -> None:
+    async def _spawn_brick(self, vol: dict, b: dict,
+                           port: int | None = None) -> None:
         bdir = os.path.join(self.workdir, "bricks")
         os.makedirs(bdir, exist_ok=True)
         volfile = os.path.join(bdir, b["name"] + ".vol")
@@ -403,11 +466,13 @@ class Glusterd:
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "glusterfs_tpu.daemon",
-             "--volfile", volfile, "--listen", "0",
-             "--portfile", portfile, "--top", b["name"]],
-            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        logfile = os.path.join(bdir, b["name"] + ".log")
+        with open(logfile, "ab") as logf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "glusterfs_tpu.daemon",
+                 "--volfile", volfile, "--listen", str(port or 0),
+                 "--portfile", portfile, "--top", b["name"]],
+                env=env, stdout=subprocess.DEVNULL, stderr=logf)
         self.bricks[b["name"]] = proc
         deadline = time.time() + 20
         while time.time() < deadline:
@@ -418,7 +483,8 @@ class Glusterd:
                 self._save()
                 return
             if proc.poll() is not None:
-                err = proc.stderr.read().decode()[-2000:]
+                with open(logfile, "rb") as f:
+                    err = f.read().decode(errors="replace")[-2000:]
                 raise MgmtError(f"brick {b['name']} failed: {err}")
             await asyncio.sleep(0.05)
         raise MgmtError(f"brick {b['name']} did not start")
@@ -432,6 +498,40 @@ class Glusterd:
             except subprocess.TimeoutExpired:
                 proc.kill()
         self.ports.pop(name, None)
+
+    # -- self-heal daemon lifecycle (glusterd-shd-svc.c analog) -----------
+
+    def _spawn_shd(self, vol: dict) -> None:
+        """One shd per started heal-capable volume on this node."""
+        if vol["type"] not in ("disperse", "replicate"):
+            return
+        name = vol["name"]
+        proc = self.shd.get(name)
+        if proc is not None and proc.poll() is None:
+            return
+        interval = float(vol.get("options", {}).get(
+            "cluster.heal-timeout", 10))
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        statefile = os.path.join(self.workdir, f"shd-{name}.json")
+        with open(os.path.join(self.workdir, f"shd-{name}.log"),
+                  "ab") as logf:
+            self.shd[name] = subprocess.Popen(
+                [sys.executable, "-m", "glusterfs_tpu.mgmt.shd",
+                 "--glusterd", f"{self.host}:{self.port}",
+                 "--volname", name, "--interval", str(interval),
+                 "--statefile", statefile],
+                env=env, stdout=subprocess.DEVNULL, stderr=logf)
+
+    def _kill_shd(self, name: str) -> None:
+        proc = self.shd.pop(name, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
 
 
 class MgmtClient:

@@ -1,0 +1,211 @@
+"""Self-heal daemon — the glustershd analog.
+
+Reference: glustershd is a glusterfsd process running the client graph
+minus performance layers, with healer threads per subvolume that crawl
+the brick-side pending index and heal by gfid
+(xlators/cluster/ec/src/ec-heald.c:282 ec_shd_index_healer,
+ec-heald.c:390 ec_shd_index_sweep; afr-self-heald.c similarly).
+
+Same split here:
+
+* :func:`crawl_once` — one index sweep over every heal-capable cluster
+  layer in a mounted graph: list each brick's pending gfids through the
+  index layer's virtual xattr, resolve gfid -> path through posix's
+  ``glusterfs_tpu.gfid2path``, call the layer's ``heal_file`` /
+  ``heal_entry``; entries whose gfid no longer resolves anywhere are
+  pruned (the unlinked-while-pending case).
+* :class:`SelfHealDaemon` — the crawl on a ``heal-timeout`` interval.
+* :func:`main` — the process entry glusterd spawns per started volume
+  (one shd per volume here; the reference multiplexes volumes into one
+  shd per node).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import errno
+import json
+import os
+import signal
+import sys
+
+from ..core.fops import FopError
+from ..core.iatt import IAType
+from ..core.layer import Loc
+from ..core import gflog
+from ..features.index import XA_INDEX_LIST, XA_INDEX_PRUNE
+from ..storage.posix import XA_GFID2PATH as GFID2PATH
+
+log = gflog.get_logger("shd")
+
+
+def _heal_layers(graph):
+    """Cluster layers that know how to heal (disperse / replicate)."""
+    return [l for l in graph.by_name.values()
+            if callable(getattr(l, "heal_file", None))
+            and callable(getattr(l, "heal_info", None))]
+
+
+async def list_pending(layer) -> dict[str, list]:
+    """gfid-hex -> [children that have it indexed] for one cluster layer."""
+    pending: dict[str, list] = {}
+    for child in layer.children:
+        try:
+            r = await child.getxattr(Loc("/"), XA_INDEX_LIST)
+            hexes = r[XA_INDEX_LIST].decode().split()
+        except FopError:
+            continue
+        for h in hexes:
+            pending.setdefault(h, []).append(child)
+    return pending
+
+
+async def _resolve(layer, gfid: bytes) -> str | None:
+    for child in layer.children:
+        try:
+            r = await child.getxattr(Loc("", gfid=gfid), GFID2PATH)
+            return r[GFID2PATH].decode()
+        except FopError:
+            continue
+    return None
+
+
+async def crawl_once(client) -> dict:
+    """One full index sweep; returns a heal report."""
+    report = {"healed": [], "skipped": [], "failed": [], "pruned": []}
+    for layer in _heal_layers(client.graph):
+        pending = await list_pending(layer)
+        for hexgfid, holders in pending.items():
+            gfid = bytes.fromhex(hexgfid)
+            path = await _resolve(layer, gfid)
+            if path is None:
+                # object is gone everywhere: stale entry, prune it
+                for child in holders:
+                    try:
+                        await child.setxattr(
+                            Loc("/"), {XA_INDEX_PRUNE: hexgfid.encode()})
+                    except FopError:
+                        pass
+                report["pruned"].append(hexgfid)
+                continue
+            try:
+                ia, _ = await layer.lookup(Loc(path))
+                if ia.ia_type is IAType.DIR and \
+                        callable(getattr(layer, "heal_entry", None)):
+                    await layer.heal_entry(path)
+                    res = {"healed": [], "skipped": False}
+                else:
+                    res = await layer.heal_file(path)
+            except FopError as e:
+                report["failed"].append({"path": path, "error": str(e)})
+                continue
+            key = "skipped" if res.get("skipped") else "healed"
+            report[key].append({"path": path, "gfid": hexgfid,
+                                "bricks": res.get("healed", [])})
+    return report
+
+
+async def gather_heal_info(client) -> dict:
+    """``volume heal <v> info``: pending entries with per-file status
+    (heal info via the index, not a volume walk — glfs-heal.c analog)."""
+    out = []
+    for layer in _heal_layers(client.graph):
+        pending = await list_pending(layer)
+        for hexgfid in pending:
+            gfid = bytes.fromhex(hexgfid)
+            path = await _resolve(layer, gfid)
+            entry = {"gfid": hexgfid, "path": path, "layer": layer.name}
+            if path is not None:
+                try:
+                    info = await layer.heal_info(Loc(path))
+                    entry["bad_bricks"] = info["bad"]
+                    entry["dirty"] = info.get("dirty", False)
+                except FopError as e:
+                    entry["error"] = str(e)
+            out.append(entry)
+    return {"entries": out, "count": len(out)}
+
+
+class SelfHealDaemon:
+    """Periodic index healer over one mounted client graph."""
+
+    def __init__(self, client, interval: float = 10.0):
+        self.client = client
+        self.interval = interval
+        self.sweeps = 0
+        self.last_report: dict = {}
+        self._task: asyncio.Task | None = None
+        self._wake = asyncio.Event()
+
+    async def run(self) -> None:
+        while True:
+            # clear BEFORE the sweep: a poke() that lands mid-sweep must
+            # not be lost — it means damage this sweep may have missed
+            self._wake.clear()
+            try:
+                self.last_report = await crawl_once(self.client)
+            except Exception as e:  # a sweep must never kill the daemon
+                log.error(1, "shd sweep failed: %r", e)
+            self.sweeps += 1
+            try:
+                await asyncio.wait_for(self._wake.wait(), self.interval)
+            except asyncio.TimeoutError:
+                pass
+
+    def poke(self) -> None:
+        """Trigger an immediate sweep (heal <v> full analog)."""
+        self._wake.set()
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+
+async def _amain(args) -> None:
+    from .glusterd import mount_volume
+
+    host, _, port = args.glusterd.rpartition(":")
+    client = None
+    while client is None:
+        try:
+            client = await mount_volume(host, int(port), args.volname)
+        except Exception as e:
+            log.warning(2, "shd mount %s failed (%r), retrying", args.volname, e)
+            await asyncio.sleep(1.0)
+    if args.statefile:
+        with open(args.statefile + ".tmp", "w") as f:
+            json.dump({"pid": os.getpid(), "volume": args.volname}, f)
+        os.replace(args.statefile + ".tmp", args.statefile)
+    shd = SelfHealDaemon(client, args.interval)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    shd.start()
+    await stop.wait()
+    await shd.stop()
+    await client.unmount()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="gftpu-shd")
+    p.add_argument("--glusterd", required=True, help="host:port")
+    p.add_argument("--volname", required=True)
+    p.add_argument("--interval", type=float, default=10.0)
+    p.add_argument("--statefile", default="")
+    args = p.parse_args(argv)
+    asyncio.run(_amain(args))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

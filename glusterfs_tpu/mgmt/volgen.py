@@ -26,6 +26,8 @@ OPTION_MAP = {
     "disperse.self-heal-window-size": ("cluster/disperse",
                                        "self-heal-window-size"),
     "cluster.quorum-count": ("cluster/replicate", "quorum-count"),
+    # consumed by glusterd's shd spawner, not a graph layer
+    "cluster.heal-timeout": ("mgmt/shd", "interval"),
     "cluster.read-hash-mode": ("cluster/replicate", "read-hash-mode"),
     "cluster.favorite-child-policy": ("cluster/replicate", "favorite-child"),
     "cluster.lookup-unhashed": ("cluster/distribute", "lookup-unhashed"),
@@ -109,6 +111,10 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
     out.append(_emit(f"{name}-locks", "features/locks", {},
                      [f"{name}-posix"]))
     top = f"{name}-locks"
+    # pending-heal index on every brick (server_graph_table puts index
+    # above locks; index-base defaults under the posix root)
+    out.append(_emit(f"{name}-index", "features/index", {}, [top]))
+    top = f"{name}-index"
     if _enabled(volinfo, "features.quota", False):
         out.append(_emit(f"{name}-quota", "features/quota",
                          layer_options(volinfo, "features/quota"), [top]))

@@ -44,6 +44,11 @@ log = gflog.get_logger("posix")
 
 META_DIR = ".glusterfs_tpu"
 
+# virtual xattr: resolve a gfid-loc to its recorded volume path
+# (reference glusterfs.gfid2path, posix-inode-fd-ops.c); the shd's
+# gfid -> healable-path step rides on it
+XA_GFID2PATH = "glusterfs_tpu.gfid2path"
+
 
 def _fop_errno(e: OSError) -> FopError:
     return FopError(e.errno or errno.EIO, str(e))
@@ -530,7 +535,15 @@ class PosixLayer(Layer):
 
     async def getxattr(self, loc: Loc, name: str | None = None,
                        xdata: dict | None = None):
-        """Returns {name: bytes}."""
+        """Returns {name: bytes}.  The virtual name
+        ``glusterfs_tpu.gfid2path`` resolves the loc's gfid to its
+        recorded volume path (reference glusterfs.gfid2path virtual
+        xattr, posix-inode-fd-ops.c posix_get_gfid2path) — the self-heal
+        daemon turns indexed gfids into healable paths with it."""
+        if name == XA_GFID2PATH:
+            if not loc.gfid:
+                raise FopError(errno.EINVAL, "gfid2path needs a gfid loc")
+            return {name: self._gfid_resolve(loc.gfid).encode()}
         gfid = self._require_gfid(self._loc_path(loc))
         cur = self._xattr_load(gfid)
         if name is None:

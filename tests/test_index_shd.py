@@ -1,0 +1,285 @@
+"""Pending-heal index + self-heal daemon: degraded writes land in the
+brick-side index, the shd crawl heals them without any manual per-path
+call, and the index drains — the tests/basic/ec/ec-heald + afr
+self-heal-daemon .t analog.  Reference: index.c:392-409 (index_add/del),
+ec-heald.c:282,390 (index sweep)."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from glusterfs_tpu.api.glfs import SyncClient
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc
+from glusterfs_tpu.features.index import XA_INDEX_LIST
+from glusterfs_tpu.mgmt.shd import (SelfHealDaemon, crawl_once,
+                                    gather_heal_info)
+from glusterfs_tpu.utils.volspec import ec_volfile
+
+K, R = 4, 2
+N = K + R
+STRIPE = K * 512
+
+BRICK_LAYERS = [("features/locks", {}), ("features/index", {})]
+
+
+def _rand(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+def _index_dir(base, i):
+    return os.path.join(str(base), f"brick{i}", ".glusterfs_tpu",
+                        "indices", "xattrop")
+
+
+def _index_entries(base, i):
+    d = _index_dir(base, i)
+    return sorted(os.listdir(d)) if os.path.isdir(d) else []
+
+
+@pytest.fixture
+def vol(tmp_path):
+    g = Graph.construct(
+        ec_volfile(tmp_path, N, R, brick_layers=BRICK_LAYERS))
+    c = SyncClient(g)
+    c.mount()
+    yield c, g.top, tmp_path
+    c.close()
+
+
+def test_clean_write_leaves_no_index(vol):
+    c, ec, base = vol
+    c.write_file("/clean", _rand(2 * STRIPE).tobytes())
+    for i in range(N):
+        assert _index_entries(base, i) == []
+
+
+def test_degraded_write_is_indexed_and_shd_heals(vol):
+    c, ec, base = vol
+    data = _rand(3 * STRIPE, seed=1).tobytes()
+    c.write_file("/f", data)
+    ec.set_child_up(1, False)
+    patch = _rand(STRIPE, seed=2).tobytes()
+    f = c.open("/f")
+    f.write(patch, 0)
+    f.close()
+    # surviving bricks keep the dirty mark -> index entry persists
+    gfid = c.stat("/f").gfid
+    for i in (0, 2, 3, 4, 5):
+        assert _index_entries(base, i) == [gfid.hex()], f"brick {i}"
+    # the index is listable through the virtual xattr
+    child = ec.children[0]
+    r = c._run(child.getxattr(Loc("/"), XA_INDEX_LIST))
+    assert r[XA_INDEX_LIST].decode().split() == [gfid.hex()]
+    # heal info (index-driven) sees it
+    info = c._run(gather_heal_info(c._client))
+    assert info["count"] == 1
+    assert info["entries"][0]["path"] == "/f"
+    assert 1 in info["entries"][0]["bad_bricks"]
+    # brick returns; one shd sweep heals it with no manual per-path call
+    ec.set_child_up(1, True)
+    report = c._run(crawl_once(c._client))
+    assert [h["path"] for h in report["healed"]] == ["/f"]
+    # index drained everywhere
+    for i in range(N):
+        assert _index_entries(base, i) == [], f"brick {i}"
+    # the healed brick serves correct data: force reads through it
+    ec.set_child_up(4, False)
+    ec.set_child_up(5, False)
+    assert c.read_file("/f") == patch + data[STRIPE:]
+    ec.set_child_up(4, True)
+    ec.set_child_up(5, True)
+
+
+def test_unlinked_pending_entry_is_pruned(vol):
+    c, ec, base = vol
+    c.write_file("/gone", _rand(STRIPE, seed=3).tobytes())
+    ec.set_child_up(2, False)
+    f = c.open("/gone")
+    f.write(b"x" * 100, 0)
+    f.close()
+    gfid = c.stat("/gone").gfid
+    assert _index_entries(base, 0) == [gfid.hex()]
+    ec.set_child_up(2, True)
+    c.unlink("/gone")
+    report = c._run(crawl_once(c._client))
+    assert gfid.hex() in report["pruned"]
+    for i in range(N):
+        assert _index_entries(base, i) == []
+
+
+def test_shd_daemon_loop_heals(vol):
+    c, ec, base = vol
+    data = _rand(2 * STRIPE, seed=4).tobytes()
+    c.write_file("/loop", data)
+    ec.set_child_up(3, False)
+    f = c.open("/loop")
+    f.write(_rand(STRIPE, seed=5).tobytes(), STRIPE)
+    f.close()
+    ec.set_child_up(3, True)
+
+    async def drive():
+        shd = SelfHealDaemon(c._client, interval=0.1)
+        shd.start()
+        for _ in range(100):
+            if shd.sweeps and not any(
+                    _index_entries(base, i) for i in range(N)):
+                break
+            await asyncio.sleep(0.05)
+        await shd.stop()
+        return shd.sweeps
+
+    sweeps = c._run(drive())
+    assert sweeps >= 1
+    for i in range(N):
+        assert _index_entries(base, i) == []
+    info = c._run(ec.heal_info(Loc("/loop")))
+    assert info["bad"] == [] and not info["dirty"]
+
+
+def test_quorum_lost_write_reconverges_not_just_unmarks(vol):
+    """A quorum-lost write diverges content WITHOUT version skew (data
+    lands on some bricks, no post-op anywhere).  heal must rebuild the
+    stragglers from K sources — merely clearing dirty would freeze the
+    divergence (ec_heal_data re-heals whenever dirty is set)."""
+    c, ec, base = vol
+    data = _rand(4 * STRIPE, seed=6).tobytes()
+    c.write_file("/q", data)
+    # 3 of 6 bricks die -> quorum (K=4) lost -> write fails after data
+    # landed on the 3 survivors, dirty left behind, versions untouched
+    f = c.open("/q")
+    for i in (3, 4, 5):
+        ec.set_child_up(i, False)
+    with pytest.raises(FopError):
+        f.write(_rand(STRIPE, seed=7).tobytes(), 0)
+    for i in (3, 4, 5):
+        ec.set_child_up(i, True)
+    f.close()
+    assert _index_entries(base, 0) != []
+    report = c._run(crawl_once(c._client))
+    assert [h["path"] for h in report["healed"]] == ["/q"]
+    for i in range(N):
+        assert _index_entries(base, i) == [], f"brick {i}"
+    # all bricks now agree: any K decode the same bytes; the region the
+    # failed write never touched still holds the original data
+    seen = set()
+    for drop in ((4, 5), (0, 1)):
+        for i in drop:
+            ec.set_child_up(i, False)
+        got = c.read_file("/q")
+        assert got[STRIPE:] == data[STRIPE:]
+        seen.add(got[:STRIPE])
+        for i in drop:
+            ec.set_child_up(i, True)
+    assert len(seen) == 1, "bricks still diverge after heal"
+
+
+def test_afr_heal_direction_not_fooled_by_clean_stale_brick(tmp_path):
+    """A brick that slept through a write is clean AND stale; the heal
+    source must be the dirty-but-current survivors (VERDICT weak #10 /
+    afr_selfheal_find_direction)."""
+    from glusterfs_tpu.utils.volspec import brick_volumes
+
+    chunks, tops = brick_volumes(tmp_path, 3, BRICK_LAYERS)
+    chunks.append("volume afr\n    type cluster/replicate\n"
+                  f"    subvolumes {' '.join(tops)}\nend-volume\n")
+    g = Graph.construct("\n".join(chunks))
+    c = SyncClient(g)
+    c.mount()
+    try:
+        afr = g.top
+        c.write_file("/d", b"old-contents")
+        afr.set_child_up(2, False)
+        f = c.open("/d")
+        f.write(b"NEW-CONTENTS", 0)
+        f.close()
+        afr.set_child_up(2, True)
+        info = c._run(afr.heal_info(Loc("/d")))
+        assert info["bad"] == [2]          # the stale clean brick
+        assert sorted(info["good"]) == [0, 1]
+        res = c._run(afr.heal_file("/d"))
+        assert res["healed"] == [2]
+        # data on brick 2 is the NEW data
+        assert (tmp_path / "brick2" / "d").read_bytes() == b"NEW-CONTENTS"
+        # index drained
+        for i in range(3):
+            assert _index_entries(tmp_path, i) == []
+    finally:
+        c.close()
+
+
+@pytest.mark.slow
+def test_e2e_brick_death_auto_heal(tmp_path):
+    """Kill a brick under a live managed volume, write degraded, restart
+    the brick: the spawned shd heals the file with no operator call and
+    `volume heal info` drains to empty (VERDICT next-round #4 done
+    criterion)."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient, mount_volume
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                bricks = [{"path": str(tmp_path / f"b{i}")}
+                          for i in range(6)]
+                await c.call("volume-create", name="hv", vtype="disperse",
+                             bricks=bricks, redundancy=2)
+                await c.call("volume-set", name="hv",
+                             key="cluster.heal-timeout", value="1")
+                await c.call("volume-start", name="hv")
+                status = await c.call("volume-status", name="hv")
+                assert status["shd"]["online"]
+
+            client = await mount_volume(d.host, d.port, "hv")
+            try:
+                ec = next(l for l in client.graph.by_name.values()
+                          if l.type_name == "cluster/disperse")
+                for _ in range(150):
+                    if all(ch.connected for ch in ec.children):
+                        break
+                    await asyncio.sleep(0.1)
+                data = os.urandom(3 * 4 * 512)
+                f = await client.create("/auto")
+                await f.write(data, 0)
+                await f.close()
+
+                async with MgmtClient(d.host, d.port) as c:
+                    await c.call("volume-brick", name="hv",
+                                 brick="hv-brick-1", action="stop")
+                # wait for the client to notice the brick is gone
+                for _ in range(100):
+                    if not ec.children[1].connected:
+                        break
+                    await asyncio.sleep(0.1)
+                patch = os.urandom(4 * 512)
+                f = await client.open("/auto")
+                await f.write(patch, 0)
+                await f.close()
+
+                async with MgmtClient(d.host, d.port) as c:
+                    await c.call("volume-brick", name="hv",
+                                 brick="hv-brick-1", action="start")
+                    # shd heals on its own within a few sweep intervals
+                    healed = False
+                    for _ in range(60):
+                        info = await c.call("volume-heal", name="hv",
+                                            action="info")
+                        if info["count"] == 0:
+                            healed = True
+                            break
+                        await asyncio.sleep(0.5)
+                    assert healed, f"heal info never drained: {info}"
+
+                # the data survives a read that must include brick 1
+                assert (await client.read_file("/auto")) == \
+                    patch + data[4 * 512:]
+            finally:
+                await client.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
