@@ -512,6 +512,8 @@ class ReplicateLayer(Layer):
         good, bad = info["good"], info["bad"]
         if not good:
             raise FopError(errno.EIO, "no heal source")
+        fav = self.opts["favorite-child"]
+        src = fav if fav in good else good[0]
         if not bad:
             if not info.get("dirty"):
                 return {"healed": [], "skipped": True}
@@ -520,14 +522,10 @@ class ReplicateLayer(Layer):
             # fop fails, with no post-op anywhere).  Re-copy from one
             # source instead of just unmarking (afr data heal re-runs
             # whenever dirty is set).
-            fav = self.opts["favorite-child"]
-            src = fav if fav in good else good[0]
             bad = [i for i in good if i != src]
             good = [src]
             if not bad:
                 return {"healed": [], "skipped": True}
-        fav = self.opts["favorite-child"]
-        src = fav if fav in good else good[0]
         ia, _ = await self.lookup(loc)
         async with self._Txn(self, loc, ia.gfid, "wr"):
             src_ia = await self.children[src].stat(loc)

@@ -36,7 +36,7 @@ from collections import Counter
 import numpy as np
 
 from ..core.fops import FopError
-from ..core.iatt import IAType, Iatt
+from ..core.iatt import IAType, Iatt, gfid_new
 from ..core.layer import Event, FdObj, Layer, Loc, register
 from ..core.options import Option
 from ..core import gflog
@@ -71,6 +71,31 @@ class ECFdCtx:
         self.flags = flags
 
 
+class _EagerState:
+    """One held eager transaction window (the ec_lock_t analog,
+    ec-common.c:2176 eager-lock reuse + delayed post-op): the cluster
+    inodelk stays held across consecutive fops on the same inode, the
+    (candidates, size) metadata is cached under it, the pre-op dirty
+    mark is set once, and ONE combined version+size+dirty xattrop
+    commits at window close."""
+
+    __slots__ = ("owner", "locked", "pre", "good", "candidates", "size",
+                 "delta", "timer", "opened")
+
+    def __init__(self, owner: bytes, locked: list[int],
+                 candidates: list[int], size: int, good: set[int],
+                 opened: float):
+        self.owner = owner
+        self.locked = locked          # bricks holding our inodelk
+        self.pre: set[int] = set()    # bricks that got the dirty+1 pre-op
+        self.good = good              # bricks that took EVERY write so far
+        self.candidates = candidates  # consistent read rows (cached meta)
+        self.size = size              # current true size (cached meta)
+        self.delta = 0                # pending data-version increments
+        self.timer = None             # deferred-release handle
+        self.opened = opened          # loop time: bounds total hold
+
+
 @register("cluster/disperse")
 class DisperseLayer(Layer):
     OPTIONS = (
@@ -92,6 +117,20 @@ class DisperseLayer(Layer):
                description="batching window in microseconds"),
         Option("stripe-cache-min-batch", "size", default="256KB",
                description="batches below this run on the CPU ladder"),
+        Option("eager-lock", "bool", default="on",
+               description="hold the txn inodelk across consecutive fops "
+                           "on one inode with a delayed combined post-op "
+                           "(disperse.eager-lock, ec-common.c:2176)"),
+        Option("eager-lock-timeout", "time", default="0.2",
+               description="idle window before the eager lock releases "
+                           "(reference post-op-delay semantics)"),
+        Option("eager-lock-max-hold", "time", default="1",
+               description="hard cap on one window's total hold time — "
+                           "bounds how long a continuous writer can "
+                           "starve other clients of the inodelk (the "
+                           "reference yields on contention upcall; "
+                           "brick locks queue FIFO, so the waiting "
+                           "client gets the lock at the cap)"),
     )
 
     def __init__(self, *args, **kw):
@@ -119,6 +158,8 @@ class DisperseLayer(Layer):
 
         self._lk_owner = _g()  # this client's lk-owner identity
         self._locks_supported: bool | None = None  # lazily probed
+        self._eager: dict[bytes, _EagerState] = {}  # gfid -> held window
+        self._bg: set[asyncio.Task] = set()  # strong refs to drain tasks
 
     # -- child state -------------------------------------------------------
 
@@ -219,6 +260,14 @@ class DisperseLayer(Layer):
         async def __aenter__(self):
             if self.local:
                 await self.ec._lock(self.gfid).acquire()
+                # Flush any eager window NOW, while holding the local
+                # lock, before winding our own inodelk: the window holds
+                # a conflicting brick lock whose deferred drain needs
+                # the local lock we hold — waiting on the brick lock
+                # here would deadlock until the lock timeout (and no new
+                # window can open while we hold the local lock).
+                if self.gfid in self.ec._eager:
+                    await self.ec._eager_flush(self.loc, self.gfid)
             try:
                 self.locked = await self.ec._inodelk_wind(
                     self.loc, self.ltype, self.owner)
@@ -237,6 +286,99 @@ class DisperseLayer(Layer):
             if self.local:
                 self.ec._lock(self.gfid).release()
             return False
+
+    # -- eager lock window (ec-common.c:2176 ec_lock_reuse + delayed
+    # post-op ec-common.c:2377) ---------------------------------------------
+
+    async def _eager_begin(self, loc: Loc, gfid: bytes) -> _EagerState:
+        """Open (or join) the eager window.  Caller holds the local gfid
+        lock.  First entry pays the inodelk + metadata fan-out; joiners
+        pay nothing."""
+        st = self._eager.get(gfid)
+        if st is not None:
+            if st.timer is not None:
+                st.timer.cancel()
+                st.timer = None
+            return st
+        owner = gfid_new()
+        locked = await self._inodelk_wind(loc, "wr", owner)
+        try:
+            candidates, size = await self._read_meta(loc)
+        except BaseException:
+            await self._inodelk_unwind(loc, locked, owner)
+            raise
+        st = _EagerState(owner, locked, candidates, size,
+                         set(self._up_idx()),
+                         asyncio.get_running_loop().time())
+        self._eager[gfid] = st
+        return st
+
+    async def _eager_end(self, loc: Loc, gfid: bytes) -> None:
+        """Leave the window: flush now (eager-lock off, or the max-hold
+        cap reached) or arm the deferred release timer.  Caller holds
+        the local gfid lock."""
+        st = self._eager.get(gfid)
+        if st is None:
+            return
+        loop = asyncio.get_running_loop()
+        timeout = self.opts["eager-lock-timeout"] \
+            if self.opts["eager-lock"] else 0
+        if timeout <= 0 or \
+                loop.time() - st.opened >= self.opts["eager-lock-max-hold"]:
+            await self._eager_flush(loc, gfid)
+            return
+        if st.timer is not None:
+            st.timer.cancel()
+        st.timer = loop.call_later(timeout, self._eager_timer_cb, loc, gfid)
+
+    def _eager_timer_cb(self, loc: Loc, gfid: bytes) -> None:
+        """Timer fired: drain in a task we keep a strong reference to
+        (the loop holds pending tasks only weakly — an unreferenced
+        flush task could be garbage-collected mid-flight, leaking the
+        cluster lock)."""
+        t = asyncio.get_event_loop().create_task(
+            self._eager_drain(loc, gfid))
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
+
+    async def _eager_drain(self, loc: Loc, gfid: bytes) -> None:
+        """Take the local lock and flush the window (timer path, and any
+        fop that needs committed counters: fsync/heal/truncate)."""
+        if gfid not in self._eager:
+            return
+        async with self._lock(gfid):
+            await self._eager_flush(loc, gfid)
+
+    async def _eager_flush(self, loc: Loc, gfid: bytes) -> None:
+        """Commit the delayed post-op in ONE mixed xattrop (version
+        add64 + size set + dirty release, atomic on each brick) and drop
+        the cluster lock.  Dirty is released only when every brick took
+        every write in the window.  Caller holds the local gfid lock."""
+        st = self._eager.pop(gfid, None)
+        if st is None:
+            return
+        if st.timer is not None:
+            st.timer.cancel()
+            st.timer = None
+        try:
+            post: dict = {}
+            if st.delta:
+                post[XA_VERSION] = ["add64", _pack_u64x2(st.delta, 0)]
+                post[XA_SIZE] = ["set", struct.pack(">Q", st.size)]
+            if st.pre and st.good == st.pre and len(st.good) == self.n:
+                post[XA_DIRTY] = ["add64",
+                                  _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0)]
+            targets = sorted(st.good & set(self._up_idx()))
+            if post and targets:
+                await self._dispatch(
+                    targets, "xattrop",
+                    lambda i: ((loc, "mixed", dict(post)), {}))
+        finally:
+            await self._inodelk_unwind(loc, st.locked, st.owner)
+
+    async def _eager_drain_fd(self, fd: FdObj) -> None:
+        if fd.gfid in self._eager:
+            await self._eager_drain(Loc(fd.path, gfid=fd.gfid), fd.gfid)
 
     # -- dispatch + combine (ec-common.c:816-900, ec-combine.c) ------------
 
@@ -327,7 +469,11 @@ class DisperseLayer(Layer):
         ia, xd = next(iter(good.values()))
         ia = Iatt(**{**ia.__dict__})
         if ia.ia_type is IAType.REG:
-            ia.size = await self._true_size(loc, list(good))
+            st = self._eager.get(ia.gfid)
+            # an open eager window caches the authoritative size (the
+            # size xattr commit is deferred to window close)
+            ia.size = st.size if st is not None else \
+                await self._true_size(loc, list(good))
         return ia, xd
 
     async def stat(self, loc: Loc, xdata: dict | None = None):
@@ -492,6 +638,7 @@ class DisperseLayer(Layer):
         return fd
 
     async def flush(self, fd: FdObj, xdata: dict | None = None):
+        await self._eager_drain_fd(fd)  # durability point: commit post-op
         idxs = self._up_idx()
         res = await self._dispatch(
             idxs, "flush", lambda i: ((self._child_fd(fd, i),), {}))
@@ -500,6 +647,7 @@ class DisperseLayer(Layer):
 
     async def fsync(self, fd: FdObj, datasync: int = 0,
                     xdata: dict | None = None):
+        await self._eager_drain_fd(fd)  # durability point: commit post-op
         idxs = self._up_idx()
         res = await self._dispatch(
             idxs, "fsync", lambda i: ((self._child_fd(fd, i), datasync), {}))
@@ -507,6 +655,7 @@ class DisperseLayer(Layer):
         return {}
 
     async def release(self, fd: FdObj):
+        await self._eager_drain_fd(fd)
         ctx: ECFdCtx | None = fd.ctx_del(self)
         if ctx:
             for i, cfd in ctx.child_fds.items():
@@ -597,79 +746,101 @@ class DisperseLayer(Layer):
             return data
         raise last_err or FopError(errno.EIO, "read failed")
 
+    async def _readv_window(self, fd: FdObj, size: int, offset: int,
+                            candidates: list[int], true_size: int):
+        if offset >= true_size:
+            return b""
+        size = min(size, true_size - offset)
+        a_off = offset // self.stripe * self.stripe
+        end = offset + size
+        a_end = (end + self.stripe - 1) // self.stripe * self.stripe
+        data = await self._read_aligned(fd, a_off, a_end - a_off,
+                                        list(candidates))
+        return data[offset - a_off: offset - a_off + size].tobytes()
+
     async def readv(self, fd: FdObj, size: int, offset: int,
                     xdata: dict | None = None):
         loc = Loc(fd.path, gfid=fd.gfid)
+        if fd.gfid in self._eager:
+            # this client holds the eager write lock: serve the read
+            # under it from the cached window metadata (serialized with
+            # our own writes by the local gfid lock)
+            async with self._lock(fd.gfid):
+                st = self._eager.get(fd.gfid)
+                if st is not None:
+                    if st.timer is not None:
+                        st.timer.cancel()
+                        st.timer = None
+                    try:
+                        return await self._readv_window(
+                            fd, size, offset, st.candidates, st.size)
+                    finally:
+                        await self._eager_end(loc, fd.gfid)
         async with self._Txn(self, loc, fd.gfid, "rd"):
             candidates, true_size = await self._read_meta(loc)
-            if offset >= true_size:
-                return b""
-            size = min(size, true_size - offset)
-            a_off = offset // self.stripe * self.stripe
-            end = offset + size
-            a_end = (end + self.stripe - 1) // self.stripe * self.stripe
-            data = await self._read_aligned(fd, a_off, a_end - a_off,
-                                            candidates)
-            return data[offset - a_off: offset - a_off + size].tobytes()
+            return await self._readv_window(fd, size, offset, candidates,
+                                            true_size)
 
     async def writev(self, fd: FdObj, data: bytes, offset: int,
                      xdata: dict | None = None):
+        """Write under the eager window: first fop on an inode pays
+        inodelk + metadata + pre-op; followers pay only the fragment
+        write wave; the combined post-op commits at window close
+        (ec-inode-write.c:2141 + ec-common.c:2176,2377)."""
         loc = Loc(fd.path, gfid=fd.gfid)
-        async with self._Txn(self, loc, fd.gfid, "wr"):
-            candidates, true_size = await self._read_meta(loc)
-            end = offset + len(data)
-            a_off = offset // self.stripe * self.stripe
-            a_end = (end + self.stripe - 1) // self.stripe * self.stripe
-            buf = np.zeros(a_end - a_off, dtype=np.uint8)
-            # RMW: pull existing stripes overlapping the aligned region
-            if true_size > a_off and (offset % self.stripe or
-                                      end % self.stripe or
-                                      offset > true_size):
-                have_end = min(a_end, self._frag_len(true_size) * self.k)
-                if have_end > a_off:
-                    old = await self._read_aligned(
-                        fd, a_off, have_end - a_off, candidates)
-                    buf[: old.size] = old
-                    # trim stale bytes beyond true size (padding zeros)
-                    if true_size - a_off < old.size:
-                        buf[max(0, true_size - a_off): old.size] = 0
-            buf[offset - a_off: end - a_off] = np.frombuffer(
-                bytes(data), dtype=np.uint8)
-            frags = await self._codec_encode(buf)
-            idxs = self._up_idx()
-            f_off = a_off // self.k
-            new_size = max(true_size, end)
-            # pre-op: dirty+1 (ec-common.c:2377 analog)
-            await self._xattrop(idxs, loc,
-                                {XA_DIRTY: _pack_u64x2(1, 0)})
-            res = await self._dispatch(
-                idxs, "writev",
-                lambda i: ((self._child_fd(fd, i),
-                            frags[i].tobytes(), f_off), {}))
-            good = [i for i, r in res.items()
-                    if not isinstance(r, BaseException)]
-            if len(good) < self._write_quorum():
-                # leave dirty marks on everything; fail the fop
-                raise FopError(errno.EIO,
-                               f"write quorum lost ({len(good)}/{self.n})")
-            # post-op on the good ones: version+1, size; dirty is only
-            # released when EVERY brick took the write — a partial
-            # success leaves the dirty mark (and the brick-side pending
-            # index entry) so the self-heal daemon finds the file
-            # (ec-common.c ec_update_info: unset dirty only when
-            # good == all)
-            post = {XA_VERSION: _pack_u64x2(1, 0)}
-            if len(good) == self.n:
-                post[XA_DIRTY] = _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0)
-            await self._xattrop(good, loc, post)
-            # xattrop add64 wraps; use set for size
-            await self._dispatch(
-                good, "setxattr",
-                lambda i: ((loc, {XA_SIZE: struct.pack(">Q", new_size)}), {}))
-            ia = next(r for i, r in res.items() if i in good)
-            ia = Iatt(**{**ia.__dict__})
-            ia.size = new_size
-            return ia
+        async with self._lock(fd.gfid):
+            st = await self._eager_begin(loc, fd.gfid)
+            try:
+                true_size = st.size
+                end = offset + len(data)
+                a_off = offset // self.stripe * self.stripe
+                a_end = (end + self.stripe - 1) // self.stripe * self.stripe
+                buf = np.zeros(a_end - a_off, dtype=np.uint8)
+                # RMW: pull existing stripes overlapping the aligned region
+                if true_size > a_off and (offset % self.stripe or
+                                          end % self.stripe or
+                                          offset > true_size):
+                    have_end = min(a_end, self._frag_len(true_size) * self.k)
+                    if have_end > a_off:
+                        old = await self._read_aligned(
+                            fd, a_off, have_end - a_off,
+                            list(st.candidates))
+                        buf[: old.size] = old
+                        # trim stale bytes beyond true size (padding zeros)
+                        if true_size - a_off < old.size:
+                            buf[max(0, true_size - a_off): old.size] = 0
+                buf[offset - a_off: end - a_off] = np.frombuffer(
+                    bytes(data), dtype=np.uint8)
+                frags = await self._codec_encode(buf)
+                if not st.pre:
+                    # pre-op once per window: dirty+1 (ec-common.c:2377)
+                    pre_targets = sorted(st.good)
+                    await self._xattrop(pre_targets, loc,
+                                        {XA_DIRTY: _pack_u64x2(1, 0)})
+                    st.pre = set(pre_targets)
+                f_off = a_off // self.k
+                targets = sorted(st.good & set(self._up_idx()))
+                res = await self._dispatch(
+                    targets, "writev",
+                    lambda i: ((self._child_fd(fd, i),
+                                frags[i].tobytes(), f_off), {}))
+                ok = {i for i, r in res.items()
+                      if not isinstance(r, BaseException)}
+                # a brick that missed ANY write in the window stays out:
+                # it is inconsistent until healed
+                st.good &= ok
+                if len(ok) < self._write_quorum():
+                    raise FopError(errno.EIO,
+                                   f"write quorum lost ({len(ok)}/{self.n})")
+                st.delta += 1
+                st.size = max(true_size, end)
+                st.candidates = sorted(st.good)
+                ia = next(r for i, r in res.items() if i in ok)
+                ia = Iatt(**{**ia.__dict__})
+                ia.size = st.size
+                return ia
+            finally:
+                await self._eager_end(loc, fd.gfid)
 
     async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
         fd = FdObj((await self.lookup(loc))[0].gfid, path=loc.path,
@@ -709,13 +880,15 @@ class DisperseLayer(Layer):
                     good, "writev",
                     lambda i: ((self._child_fd(fd, i),
                                 frags[i].tobytes(), f_off), {}))
-            post = {XA_VERSION: _pack_u64x2(1, 0)}
+            # one atomic mixed xattrop: version +1, size absolute, dirty
+            # released only on full participation
+            post = {XA_VERSION: ["add64", _pack_u64x2(1, 0)],
+                    XA_SIZE: ["set", struct.pack(">Q", size)]}
             if len(good) == self.n:
-                post[XA_DIRTY] = _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0)
-            await self._xattrop(good, loc, post)
+                post[XA_DIRTY] = ["add64",
+                                  _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0)]
             await self._dispatch(
-                good, "setxattr",
-                lambda i: ((loc, {XA_SIZE: struct.pack(">Q", size)}), {}))
+                good, "xattrop", lambda i: ((loc, "mixed", dict(post)), {}))
             ia, _ = await self.lookup(loc)
             return ia
 
@@ -732,6 +905,14 @@ class DisperseLayer(Layer):
         write the surviving bricks keep dirty set on purpose (that is
         what feeds the pending index), yet they hold both the data and
         the post-op version bump."""
+        if self._eager:
+            # judge committed counters, not an open window's deferred ones
+            try:
+                gfid = (await self.lookup(loc))[0].gfid
+                if gfid in self._eager:
+                    await self._eager_drain(Loc(loc.path, gfid=gfid), gfid)
+            except FopError:
+                pass
         meta = await self._get_meta(list(range(self.n)), loc)
         versions = {}
         for i, m in meta.items():
@@ -843,11 +1024,20 @@ class DisperseLayer(Layer):
             return await self.codec.decode_async(frags, rows)
         return self.codec.decode(frags, rows)
 
+    async def fini(self):
+        for gfid in list(self._eager):
+            try:
+                await self._eager_drain(Loc("", gfid=gfid), gfid)
+            except Exception:
+                pass
+        await super().fini()
+
     def dump_private(self) -> dict:
         return {
             "fragments": self.k, "redundancy": self.r,
             "stripe_size": self.stripe,
             "backend": self.codec.backend,
             "up": self.up, "up_count": sum(self.up),
+            "eager_windows": len(self._eager),
             "stripe_cache": self.codec.dump_stats(),
         }

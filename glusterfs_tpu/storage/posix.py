@@ -573,23 +573,30 @@ class PosixLayer(Layer):
     async def xattrop(self, loc: Loc, op: str, xattrs: dict,
                       xdata: dict | None = None):
         """Atomic arithmetic on xattr values (reference posix xattrop):
-        op 'add64' adds int64s element-wise; 'set' stores.  Returns the
-        resulting values — the EC/AFR version counters ride on this."""
+        op 'add64' adds int64s element-wise; 'set' stores; 'mixed' takes
+        per-key ``[op, value]`` pairs so independent counters and
+        absolute values (EC's version + size) commit in ONE atomic store
+        — the reference packs them into a single xattrop dict the same
+        way (ec_update_info).  Returns the resulting values."""
         gfid = self._require_gfid(self._loc_path(loc))
         cur = self._xattr_load(gfid)
         out: dict[str, bytes] = {}
-        for key, val in xattrs.items():
-            if op == "add64":
+        for key, spec in xattrs.items():
+            if op == "mixed":
+                kop, val = spec[0], spec[1]
+            else:
+                kop, val = op, spec
+            if kop == "add64":
                 old = bytes.fromhex(cur.get(key, "")) if key in cur else b""
                 n = max(len(old), len(val)) // 8
                 olds = list(struct.unpack(f">{n}q", old.ljust(n * 8, b"\0")))
                 adds = struct.unpack(f">{n}q", val.ljust(n * 8, b"\0"))
                 news = [a + b for a, b in zip(olds, adds)]
                 res = struct.pack(f">{n}q", *news)
-            elif op == "set":
+            elif kop == "set":
                 res = val
             else:
-                raise FopError(errno.EINVAL, f"xattrop op {op!r}")
+                raise FopError(errno.EINVAL, f"xattrop op {kop!r}")
             cur[key] = res.hex()
             out[key] = res
         self._xattr_store(gfid, cur)
