@@ -84,6 +84,10 @@ class ReplicateLayer(Layer):
     # -- membership --------------------------------------------------------
 
     def notify(self, event: Event, source=None, data=None):
+        if event is Event.UPCALL:
+            for p in self.parents:
+                p.notify(event, self, data)
+            return
         if source in self.children:
             idx = self.children.index(source)
             if event is Event.CHILD_DOWN:

@@ -164,6 +164,12 @@ class DisperseLayer(Layer):
     # -- child state -------------------------------------------------------
 
     def notify(self, event: Event, source=None, data=None):
+        if event is Event.UPCALL:
+            # upcalls pass through untranslated (ec_notify forwards
+            # GF_EVENT_UPCALL to parents as-is)
+            for p in self.parents:
+                p.notify(event, self, data)
+            return
         if source in self.children:
             idx = self.children.index(source)
             if event is Event.CHILD_DOWN:
@@ -820,6 +826,14 @@ class DisperseLayer(Layer):
                     st.pre = set(pre_targets)
                 f_off = a_off // self.k
                 targets = sorted(st.good & set(self._up_idx()))
+                # poison the window across the wave: if this dispatch is
+                # torn off mid-flight (task cancellation), some bricks
+                # hold new fragments with no record of who — an empty
+                # good set makes the flush keep dirty everywhere so the
+                # shd reconverges, instead of releasing it over silently
+                # diverged data
+                prev_good = st.good
+                st.good = set()
                 res = await self._dispatch(
                     targets, "writev",
                     lambda i: ((self._child_fd(fd, i),
@@ -828,7 +842,7 @@ class DisperseLayer(Layer):
                       if not isinstance(r, BaseException)}
                 # a brick that missed ANY write in the window stays out:
                 # it is inconsistent until healed
-                st.good &= ok
+                st.good = prev_good & ok
                 if len(ok) < self._write_quorum():
                     raise FopError(errno.EIO,
                                    f"write quorum lost ({len(ok)}/{self.n})")

@@ -142,6 +142,20 @@ def _make_default(op_name: str) -> Callable:
     return default
 
 
+def walk(root: "Layer"):
+    """Yield ``root`` and every descendant exactly once (cycle-safe DFS)
+    — the graph-traversal primitive behind hook injection (io-threads
+    executor, upcall sink) and per-client cleanup."""
+    stack, seen = [root], set()
+    while stack:
+        layer = stack.pop()
+        if id(layer) in seen:
+            continue
+        seen.add(id(layer))
+        yield layer
+        stack.extend(layer.children)
+
+
 # Registry of layer types: "cluster/disperse" -> class (the dlopen analog,
 # reference xlator_dynload xlator.c:369).
 _REGISTRY: dict[str, type["Layer"]] = {}
@@ -271,5 +285,5 @@ for _fop in Fop:
 
 __all__ = [
     "Layer", "Loc", "FdObj", "Event", "Fop", "FopError", "Iatt",
-    "register", "lookup_type",
+    "register", "lookup_type", "walk",
 ]

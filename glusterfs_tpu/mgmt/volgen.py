@@ -51,6 +51,9 @@ OPTION_MAP = {
                                     "thread-count"),
     "diagnostics.latency-measurement": ("debug/io-stats",
                                         "latency-measurement"),
+    "features.cache-invalidation": ("features/upcall", "__enable__"),
+    "features.cache-invalidation-timeout": ("features/upcall",
+                                            "cache-invalidation-timeout"),
     "features.read-only": ("features/read-only", "__enable__"),
     "features.worm": ("features/worm", "__enable__"),
     "features.quota": ("features/quota", "__enable__"),
@@ -115,6 +118,16 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
     # above locks; index-base defaults under the posix root)
     out.append(_emit(f"{name}-index", "features/index", {}, [top]))
     top = f"{name}-index"
+    if _enabled(volinfo, "features.cache-invalidation", True):
+        out.append(_emit(f"{name}-upcall", "features/upcall",
+                         layer_options(volinfo, "features/upcall"), [top]))
+        top = f"{name}-upcall"
+    # worker threads so blocking disk syscalls never stall the brick's
+    # event engine (server graph always carries io-threads)
+    out.append(_emit(f"{name}-io-threads", "performance/io-threads",
+                     layer_options(volinfo, "performance/io-threads"),
+                     [top]))
+    top = f"{name}-io-threads"
     if _enabled(volinfo, "features.quota", False):
         out.append(_emit(f"{name}-quota", "features/quota",
                          layer_options(volinfo, "features/quota"), [top]))

@@ -10,7 +10,7 @@ from __future__ import annotations
 import time
 
 from ..core.fops import WRITE_FOPS, Fop
-from ..core.layer import FdObj, Layer, Loc, register
+from ..core.layer import Event, FdObj, Layer, Loc, register
 from ..core.options import Option
 
 
@@ -27,10 +27,21 @@ class MdCacheLayer(Layer):
         self._xattr: dict[bytes, tuple[float, dict]] = {}
         self.hits = 0
         self.misses = 0
+        self.invalidations = 0  # upcall-driven (not TTL, not local fop)
 
     def invalidate(self, gfid: bytes) -> None:
         self._iatt.pop(gfid, None)
         self._xattr.pop(gfid, None)
+
+    def notify(self, event: Event, source=None, data=None):
+        """Upcall subscription (mdc_notify + mdc_invalidate analog):
+        a server-pushed invalidation drops the entry immediately instead
+        of waiting out the TTL."""
+        if event is Event.UPCALL and isinstance(data, dict) and \
+                data.get("gfid"):
+            self.invalidations += 1
+            self.invalidate(data["gfid"])
+        super().notify(event, source, data)
 
     def _fresh(self, entry) -> bool:
         return entry is not None and \
@@ -85,7 +96,8 @@ class MdCacheLayer(Layer):
 
     def dump_private(self) -> dict:
         return {"iatts": len(self._iatt), "hits": self.hits,
-                "misses": self.misses}
+                "misses": self.misses,
+                "upcall_invalidations": self.invalidations}
 
 
 def _invalidating(op_name: str):

@@ -125,6 +125,11 @@ class ClientLayer(Layer):
             while True:
                 rec = await wire.read_frame(reader)
                 xid, mtype, payload = wire.unpack(rec)
+                if mtype == wire.MT_EVENT:
+                    # server-pushed upcall (cache invalidation etc.):
+                    # surface as a graph notification for md-cache & co
+                    self.notify(Event.UPCALL, None, payload)
+                    continue
                 fut = self._pending.pop(xid, None)
                 if fut is None or fut.done():
                     continue

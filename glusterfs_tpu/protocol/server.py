@@ -89,9 +89,29 @@ class BrickServer:
         self._server = await asyncio.start_server(
             self._serve, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        # hand the event-push callback to any upcall layer in the graph
+        # (the reference's upcall xlator calls back through rpcsvc the
+        # same way)
+        from ..core.layer import walk
+
+        for layer in walk(self.top):
+            sink = getattr(layer, "set_upcall_sink", None)
+            if sink is not None:
+                sink(self.push_event)
         log.info(1, "brick %s serving on %s:%d", self.top.name, self.host,
                  self.port)
         return self.port
+
+    def push_event(self, targets: list[bytes], payload: dict) -> None:
+        """Send an MT_EVENT frame to each connected client in targets
+        (xid 0: events correlate to no call)."""
+        frame = wire.pack(0, wire.MT_EVENT, payload)
+        for conn in list(self.connections):
+            if conn.identity in targets:
+                try:
+                    conn.writer.write(frame)
+                except Exception:
+                    pass
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -147,21 +167,15 @@ class BrickServer:
                     pass
         conn.fds.clear()
         if conn.identity:
-            layer: Layer | None = self.top
-            seen = set()
-            stack = [self.top]
-            while stack:
-                layer = stack.pop()
-                if id(layer) in seen:
-                    continue
-                seen.add(id(layer))
+            from ..core.layer import walk
+
+            for layer in walk(self.top):
                 rc = getattr(layer, "release_client", None)
                 if rc is not None:
                     try:
                         rc(conn.identity)
                     except Exception:
                         pass
-                stack.extend(layer.children)
 
     async def _dispatch(self, conn: _ClientConn, payload: Any):
         try:
@@ -183,6 +197,8 @@ class BrickServer:
             kwargs = {k: conn.resolve(v) for k, v in (kwargs or {}).items()}
             # scope lk-owners to this connection (cross-client isolation)
             _scope_owner(args, kwargs, conn.identity)
+            # expose the peer identity to brick layers (frame->root->client)
+            wire.CURRENT_CLIENT.set(conn.identity)
             ret = fn(*args, **kwargs)
             if asyncio.iscoroutine(ret):
                 ret = await ret

@@ -24,6 +24,15 @@ MT_REPLY = 2
 MT_ERROR = 3
 MT_EVENT = 4  # server -> client notifications (upcall channel analog)
 
+# The RPC peer identity of the request currently being dispatched
+# (set per-call by protocol/server, read by brick-side layers that need
+# to know WHO is asking — features/upcall's client registry; the
+# reference threads this through frame->root->client).
+import contextvars as _contextvars  # noqa: E402
+
+CURRENT_CLIENT: "_contextvars.ContextVar" = _contextvars.ContextVar(
+    "gftpu_current_client", default=None)
+
 _HDR = struct.Struct(">IBxxx")
 
 # value tags

@@ -28,6 +28,7 @@ but local (the io-threads analog can wrap this layer with a thread pool).
 
 from __future__ import annotations
 
+import asyncio
 import errno
 import json
 import os
@@ -73,6 +74,21 @@ class PosixLayer(Layer):
         self._gfid_dir = os.path.join(self.root, META_DIR, "gfid")
         self._xattr_dir = os.path.join(self.root, META_DIR, "xattr")
         self._handle_dir = os.path.join(self.root, META_DIR, "handle")
+        self._executor = None  # worker pool injected by io-threads
+
+    def set_io_executor(self, executor) -> None:
+        """io-threads hands us its worker pool; data-plane syscalls run
+        there so a slow disk op cannot stall the brick's event loop
+        (io-threads.c:236 iot_worker intent).  Metadata/sidecar fops stay
+        on the loop — their read-modify-write sections rely on its
+        serialization."""
+        self._executor = executor
+
+    async def _io(self, fn, *args):
+        if self._executor is None:
+            return fn(*args)
+        return await asyncio.get_running_loop().run_in_executor(
+            self._executor, fn, *args)
 
     async def init(self):
         os.makedirs(self.root, exist_ok=True)
@@ -402,22 +418,28 @@ class PosixLayer(Layer):
 
     async def readv(self, fd: FdObj, size: int, offset: int,
                     xdata: dict | None = None):
+        fdno = self._os_fd(fd)  # resolve on the loop (may open-on-demand)
         try:
-            return os.pread(self._os_fd(fd), size, offset)
+            return await self._io(os.pread, fdno, size, offset)
         except OSError as e:
             raise _fop_errno(e)
 
     async def writev(self, fd: FdObj, data: bytes, offset: int,
                      xdata: dict | None = None):
-        try:
+        fdno = self._os_fd(fd)
+
+        def work():
             view = memoryview(data)
             pos = offset
             while view:
-                n = os.pwrite(self._os_fd(fd), view, pos)
+                n = os.pwrite(fdno, view, pos)
                 if n <= 0:  # a 0-byte pwrite would loop forever
                     raise FopError(errno.EIO, "short write")
                 view = view[n:]
                 pos += n
+
+        try:
+            await self._io(work)
         except OSError as e:
             raise _fop_errno(e)
         return self._iatt_gfid(fd.gfid)
@@ -425,14 +447,14 @@ class PosixLayer(Layer):
     async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
         path = self._loc_path(loc)
         try:
-            os.truncate(self._abs(path), size)
+            await self._io(os.truncate, self._abs(path), size)
         except OSError as e:
             raise _fop_errno(e)
         return self._iatt(path)
 
     async def ftruncate(self, fd: FdObj, size: int, xdata: dict | None = None):
         try:
-            os.ftruncate(self._os_fd(fd), size)
+            await self._io(os.ftruncate, self._os_fd(fd), size)
         except OSError as e:
             raise _fop_errno(e)
         return self._iatt_gfid(fd.gfid)
@@ -445,10 +467,7 @@ class PosixLayer(Layer):
         try:
             fdno = fd.ctx_get(self)
             if fdno is not None:
-                if datasync:
-                    os.fdatasync(fdno)
-                else:
-                    os.fsync(fdno)
+                await self._io(os.fdatasync if datasync else os.fsync, fdno)
         except OSError as e:
             raise _fop_errno(e)
         return {}
@@ -633,7 +652,8 @@ class PosixLayer(Layer):
     async def fallocate(self, fd: FdObj, mode: int, offset: int, length: int,
                         xdata: dict | None = None):
         try:
-            os.posix_fallocate(self._os_fd(fd), offset, length)
+            await self._io(os.posix_fallocate, self._os_fd(fd), offset,
+                           length)
         except OSError as e:
             raise _fop_errno(e)
         return self._iatt_gfid(fd.gfid)
@@ -646,7 +666,8 @@ class PosixLayer(Layer):
     async def zerofill(self, fd: FdObj, offset: int, length: int,
                        xdata: dict | None = None):
         try:
-            os.pwrite(self._os_fd(fd), b"\0" * length, offset)
+            await self._io(os.pwrite, self._os_fd(fd), b"\0" * length,
+                           offset)
         except OSError as e:
             raise _fop_errno(e)
         return self._iatt_gfid(fd.gfid)

@@ -26,13 +26,14 @@ end-volume
 class BrickProc:
     """One brick daemon subprocess."""
 
-    def __init__(self, base: str, name: str):
+    def __init__(self, base: str, name: str,
+                 volfile_tmpl: str | None = None):
         self.name = name
         self.dir = os.path.join(base, name)
         self.volfile = os.path.join(base, f"{name}.vol")
         self.portfile = os.path.join(base, f"{name}.port")
         with open(self.volfile, "w") as f:
-            f.write(BRICK_VOLFILE.format(dir=self.dir))
+            f.write((volfile_tmpl or BRICK_VOLFILE).format(dir=self.dir))
         self.proc: subprocess.Popen | None = None
         self.port: int | None = None
 

@@ -1,0 +1,121 @@
+"""io-threads worker offload: a blocking disk syscall on one file must
+not stall concurrent fops on another (io-threads.c:236 iot_worker — the
+brick's event engine never runs disk I/O).  VERDICT weak #7 / next-round
+#7 done criterion."""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc
+
+VOLFILE = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+
+volume iot
+    type performance/io-threads
+    option thread-count 8
+    subvolumes posix
+end-volume
+"""
+
+
+def _slow_pread(real, delay, victim_fd):
+    def pread(fdno, size, offset):
+        if fdno == victim_fd:
+            time.sleep(delay)
+        return real(fdno, size, offset)
+    return pread
+
+
+def test_slow_read_does_not_stall_other_fops(tmp_path, monkeypatch):
+    g = Graph.construct(VOLFILE.format(dir=tmp_path / "b"))
+
+    async def run():
+        await g.activate()
+        top = g.top
+        fd_slow, _ = await top.create(Loc("/slow"), 0, 0o644)
+        fd_fast, _ = await top.create(Loc("/fast"), 0, 0o644)
+        await top.writev(fd_slow, b"s" * 1024, 0)
+        await top.writev(fd_fast, b"f" * 1024, 0)
+        victim = fd_slow.ctx_get(g.by_name["posix"])
+        monkeypatch.setattr(os, "pread",
+                            _slow_pread(os.pread, 0.5, victim))
+        t0 = time.monotonic()
+
+        async def slow():
+            return await top.readv(fd_slow, 1024, 0)
+
+        async def fast():
+            # many quick ops racing the stuck disk read
+            out = []
+            for _ in range(5):
+                out.append(await top.readv(fd_fast, 1024, 0))
+                await top.fstat(fd_fast)
+            return out
+
+        s, f = await asyncio.gather(slow(), fast())
+        elapsed = time.monotonic() - t0
+        assert s == b"s" * 1024
+        assert all(x == b"f" * 1024 for x in f)
+        await g.fini()
+        return elapsed
+
+    elapsed = asyncio.run(run())
+    # the 0.5s-stuck read overlaps the fast ops; without offload the
+    # loop would serialize them after it
+    assert elapsed < 0.95, f"fast fops stalled behind slow read ({elapsed:.2f}s)"
+
+
+def test_parallel_blocking_reads_overlap(tmp_path, monkeypatch):
+    """N slow reads on N fds run concurrently on worker threads."""
+    g = Graph.construct(VOLFILE.format(dir=tmp_path / "b"))
+
+    async def run():
+        await g.activate()
+        top = g.top
+        fds = []
+        for i in range(4):
+            fd, _ = await top.create(Loc(f"/f{i}"), 0, 0o644)
+            await top.writev(fd, bytes([i]) * 64, 0)
+            fds.append(fd)
+        real = os.pread
+        monkeypatch.setattr(
+            os, "pread",
+            lambda fdno, size, off: (time.sleep(0.3),
+                                     real(fdno, size, off))[1])
+        t0 = time.monotonic()
+        outs = await asyncio.gather(*(top.readv(fd, 64, 0) for fd in fds))
+        elapsed = time.monotonic() - t0
+        for i, out in enumerate(outs):
+            assert out == bytes([i]) * 64
+        await g.fini()
+        return elapsed
+
+    elapsed = asyncio.run(run())
+    # 4 x 0.3s sequential would be 1.2s; concurrent ~0.3s
+    assert elapsed < 0.75, f"blocking reads serialized ({elapsed:.2f}s)"
+
+
+def test_priority_gates_still_account(tmp_path):
+    g = Graph.construct(VOLFILE.format(dir=tmp_path / "b"))
+
+    async def run():
+        await g.activate()
+        top = g.top
+        fd, _ = await top.create(Loc("/acct"), 0, 0o644)
+        await top.writev(fd, b"x", 0)
+        await top.readv(fd, 1, 0)
+        await top.stat(Loc("/acct"))
+        iot = g.by_name["iot"]
+        assert iot.executed[0] >= 1   # fast class (stat)
+        assert iot.executed[1] >= 3   # normal class (create/writev/readv)
+        await g.fini()
+
+    asyncio.run(run())

@@ -1,0 +1,156 @@
+"""Upcall cache invalidation: the brick tracks which clients touched an
+inode and pushes MT_EVENT invalidations to the OTHERS on mutation;
+md-cache drops its entry without waiting out the TTL — the
+tests/basic/md-cache + upcall-cache-invalidate.t analog.
+Reference: upcall.c:48-207, mdc_invalidate."""
+
+import asyncio
+import time
+
+import pytest
+
+from glusterfs_tpu.api.glfs import SyncClient
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc
+from glusterfs_tpu.rpc.wire import CURRENT_CLIENT
+
+from .harness import BrickProc
+
+UPCALL_BRICK = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+
+volume upcall
+    type features/upcall
+    subvolumes locks
+end-volume
+"""
+
+
+def test_upcall_layer_tracks_and_notifies(tmp_path):
+    """In-process: interest registration + other-client invalidation +
+    originator exclusion (upcall_client_cache_invalidate)."""
+    g = Graph.construct(UPCALL_BRICK.format(dir=tmp_path / "b"))
+    events = []
+    up = g.by_name["upcall"]
+    up.set_upcall_sink(lambda targets, payload:
+                       events.append((sorted(targets), payload)))
+
+    async def run():
+        await g.activate()
+        A, B = b"client-A", b"client-B"
+        CURRENT_CLIENT.set(A)
+        fd, ia = await g.top.create(Loc("/f"), 0, 0o644)
+        await g.top.writev(fd, b"hello", 0)
+        # only A has touched it: no one else to invalidate
+        assert events == []
+        CURRENT_CLIENT.set(B)
+        await g.top.stat(Loc("/f"))          # B registers interest
+        CURRENT_CLIENT.set(A)
+        await g.top.writev(fd, b"world", 0)  # A mutates -> B invalidated
+        assert len(events) == 1
+        targets, payload = events[0]
+        assert targets == [B]
+        assert payload["gfid"] == ia.gfid
+        assert payload["event"] == "cache-invalidation"
+        # B mutates -> A invalidated (A wrote + created: registered)
+        CURRENT_CLIENT.set(B)
+        await g.top.truncate(Loc("/f"), 1)
+        assert sorted(events[-1][0]) == [A]
+        CURRENT_CLIENT.set(None)
+        await g.fini()
+
+    asyncio.run(run())
+
+
+def test_release_client_drops_registrations(tmp_path):
+    g = Graph.construct(UPCALL_BRICK.format(dir=tmp_path / "b"))
+    events = []
+    up = g.by_name["upcall"]
+    up.set_upcall_sink(lambda t, p: events.append(t))
+
+    async def run():
+        await g.activate()
+        CURRENT_CLIENT.set(b"B")
+        await g.top.create(Loc("/x"), 0, 0o644)
+        up.release_client(b"B")              # B disconnected
+        CURRENT_CLIENT.set(b"A")
+        await g.top.truncate(Loc("/x"), 0)
+        assert events == []                  # no stale push to dead B
+        CURRENT_CLIENT.set(None)
+        await g.fini()
+
+    asyncio.run(run())
+
+
+CLIENT_VOLFILE = """
+volume client0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume upcall
+end-volume
+
+volume mdc
+    type performance/md-cache
+    option timeout 3600
+    subvolumes client0
+end-volume
+"""
+
+
+@pytest.mark.slow
+def test_two_clients_invalidate_over_wire(tmp_path):
+    """Client A writes; client B's cached stat invalidates through the
+    pushed MT_EVENT, NOT via TTL (timeout is one hour) — VERDICT
+    next-round #6 done criterion."""
+    brick = BrickProc(str(tmp_path), "brick0", volfile_tmpl=UPCALL_BRICK)
+    port = brick.start()
+    try:
+        ca = SyncClient(Graph.construct(CLIENT_VOLFILE.format(port=port)))
+        cb = SyncClient(Graph.construct(CLIENT_VOLFILE.format(port=port)))
+        ca.mount()
+        cb.mount()
+        try:
+            for c in (ca, cb):
+                deadline = time.time() + 10
+                prot = c.graph.by_name["client0"]
+                while time.time() < deadline and not prot.connected:
+                    time.sleep(0.05)
+                assert prot.connected
+            mdc_b = cb.graph.by_name["mdc"]
+
+            f = ca.create("/shared")
+            f.write(b"v1", 0)
+            f.close()
+
+            # B looks it up and caches the iatt under the gfid
+            ia0 = cb._run(cb.graph.top.lookup(Loc("/shared")))[0]
+            gloc = Loc("/shared", gfid=ia0.gfid)
+            assert cb._run(cb.graph.top.stat(gloc)).size == 2
+            hits0 = mdc_b.hits
+            assert cb._run(cb.graph.top.stat(gloc)).size == 2
+            assert mdc_b.hits == hits0 + 1  # served from cache
+
+            # A extends the file; the push must reach B without TTL
+            f = ca.open("/shared")
+            f.write(b"longer-contents", 0)
+            f.close()
+            deadline = time.time() + 5
+            while time.time() < deadline and mdc_b.invalidations == 0:
+                time.sleep(0.05)
+            assert mdc_b.invalidations >= 1, "no upcall arrived"
+            # B's next stat refetches: sees the new size immediately
+            assert cb._run(cb.graph.top.stat(gloc)).size == 15
+        finally:
+            ca.close()
+            cb.close()
+    finally:
+        brick.kill()
