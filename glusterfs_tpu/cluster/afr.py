@@ -432,27 +432,24 @@ class ReplicateLayer(Layer):
                     break
         raise last or FopError(errno.ENOTCONN, "read failed")
 
-    async def writev(self, fd: FdObj, data: bytes, offset: int,
-                     xdata: dict | None = None):
-        loc = Loc(fd.path, gfid=fd.gfid)
-        async with self._Txn(self, loc, fd.gfid, "wr"):
+    async def _write_txn(self, loc: Loc, gfid: bytes, op: str, argfn):
+        """The replicated write transaction (afr-transaction.c:1087,629):
+        pre-op dirty on all up replicas, dispatch, quorum, post-op
+        version bump on the good ones — dirty is released only when
+        EVERY replica took the write (a partial success keeps the mark,
+        and the brick-side pending-index entry, for the shd)."""
+        async with self._Txn(self, loc, gfid, "wr"):
             idxs = self._up_idx()
             await self._dispatch(
                 idxs, "xattrop",
                 lambda i: ((loc, "add64",
                             {XA_DIRTY: _pack_u64x2(1, 0)}), {}))
-            res = await self._dispatch(
-                idxs, "writev",
-                lambda i: ((self._child_fd(fd, i), data, offset), {}))
+            res = await self._dispatch(idxs, op, argfn)
             good = [i for i, r in res.items()
                     if not isinstance(r, BaseException)]
             if len(good) < self._quorum():
                 raise FopError(errno.EIO,
-                               f"write quorum lost ({len(good)}/{self.n})")
-            # dirty is only released when every replica took the write;
-            # a partial success keeps the mark (and the brick-side
-            # pending-index entry) for the self-heal daemon
-            # (afr-transaction.c afr_changelog_post_op semantics)
+                               f"{op} quorum lost ({len(good)}/{self.n})")
             post = {XA_VERSION: _pack_u64x2(1, 0)}
             if len(good) == self.n:
                 post[XA_DIRTY] = _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0)
@@ -460,30 +457,54 @@ class ReplicateLayer(Layer):
                 good, "xattrop", lambda i: ((loc, "add64", dict(post)), {}))
             return next(r for i, r in res.items() if i in good)
 
+    async def writev(self, fd: FdObj, data: bytes, offset: int,
+                     xdata: dict | None = None):
+        loc = Loc(fd.path, gfid=fd.gfid)
+        return await self._write_txn(
+            loc, fd.gfid, "writev",
+            lambda i: ((self._child_fd(fd, i), data, offset), {}))
+
     async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
         ia, _ = await self.lookup(loc)
-        async with self._Txn(self, loc, ia.gfid, "wr"):
-            idxs = self._up_idx()
-            await self._dispatch(
-                idxs, "xattrop",
-                lambda i: ((loc, "add64",
-                            {XA_DIRTY: _pack_u64x2(1, 0)}), {}))
-            res = await self._dispatch(idxs, "truncate",
-                                       lambda i: ((loc, size, xdata), {}))
-            good = [i for i, r in res.items()
-                    if not isinstance(r, BaseException)]
-            if len(good) < self._quorum():
-                raise FopError(errno.EIO, "truncate quorum lost")
-            post = {XA_VERSION: _pack_u64x2(1, 0)}
-            if len(good) == self.n:
-                post[XA_DIRTY] = _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0)
-            await self._dispatch(
-                good, "xattrop", lambda i: ((loc, "add64", dict(post)), {}))
-            return next(r for i, r in res.items() if i in good)
+        return await self._write_txn(loc, ia.gfid, "truncate",
+                                     lambda i: ((loc, size, xdata), {}))
 
     async def ftruncate(self, fd: FdObj, size: int,
                         xdata: dict | None = None):
         return await self.truncate(Loc(fd.path, gfid=fd.gfid), size, xdata)
+
+    async def fallocate(self, fd: FdObj, mode: int, offset: int,
+                        length: int, xdata: dict | None = None):
+        return await self._write_txn(
+            Loc(fd.path, gfid=fd.gfid), fd.gfid, "fallocate",
+            lambda i: ((self._child_fd(fd, i), mode, offset, length), {}))
+
+    async def discard(self, fd: FdObj, offset: int, length: int,
+                      xdata: dict | None = None):
+        return await self._write_txn(
+            Loc(fd.path, gfid=fd.gfid), fd.gfid, "discard",
+            lambda i: ((self._child_fd(fd, i), offset, length), {}))
+
+    async def zerofill(self, fd: FdObj, offset: int, length: int,
+                       xdata: dict | None = None):
+        return await self._write_txn(
+            Loc(fd.path, gfid=fd.gfid), fd.gfid, "zerofill",
+            lambda i: ((self._child_fd(fd, i), offset, length), {}))
+
+    async def seek(self, fd: FdObj, offset: int, what: str = "data",
+                   xdata: dict | None = None):
+        loc = Loc(fd.path, gfid=fd.gfid)
+        candidates = await self._good_rows(loc)
+        last: FopError | None = None
+        for i in candidates:
+            try:
+                return await self.children[i].seek(
+                    self._child_fd(fd, i), offset, what, xdata)
+            except FopError as e:
+                if e.err == errno.ENXIO:
+                    raise
+                last = e
+        raise last or FopError(errno.ENOTCONN, "no child for seek")
 
     # -- heal --------------------------------------------------------------
 

@@ -787,6 +787,66 @@ class DisperseLayer(Layer):
             return await self._readv_window(fd, size, offset, candidates,
                                             true_size)
 
+    async def _window_op(self, fd: FdObj, loc: Loc, st: _EagerState,
+                         op: str, argfn) -> dict:
+        """One write-class wave through the open eager window: pre-op
+        once per window, poison-across-dispatch (a torn-off wave must
+        never let the flush release dirty over diverged fragments),
+        good-set intersection, quorum, version delta."""
+        if not st.pre:
+            # pre-op once per window: dirty+1 (ec-common.c:2377)
+            pre_targets = sorted(st.good)
+            await self._xattrop(pre_targets, loc,
+                                {XA_DIRTY: _pack_u64x2(1, 0)})
+            st.pre = set(pre_targets)
+        targets = sorted(st.good & set(self._up_idx()))
+        prev_good = st.good
+        st.good = set()
+        res = await self._dispatch(targets, op, argfn)
+        ok = {i for i, r in res.items() if not isinstance(r, BaseException)}
+        # a brick that missed ANY wave in the window stays out: it is
+        # inconsistent until healed
+        st.good = prev_good & ok
+        if len(ok) < self._write_quorum():
+            raise FopError(errno.EIO,
+                           f"{op} quorum lost ({len(ok)}/{self.n})")
+        st.delta += 1
+        st.candidates = sorted(st.good)
+        return {i: r for i, r in res.items() if i in ok}
+
+    async def _writev_in_window(self, fd: FdObj, loc: Loc, st: _EagerState,
+                                data: bytes, offset: int):
+        true_size = st.size
+        end = offset + len(data)
+        a_off = offset // self.stripe * self.stripe
+        a_end = (end + self.stripe - 1) // self.stripe * self.stripe
+        buf = np.zeros(a_end - a_off, dtype=np.uint8)
+        # RMW: pull existing stripes overlapping the aligned region
+        if true_size > a_off and (offset % self.stripe or
+                                  end % self.stripe or
+                                  offset > true_size):
+            have_end = min(a_end, self._frag_len(true_size) * self.k)
+            if have_end > a_off:
+                old = await self._read_aligned(
+                    fd, a_off, have_end - a_off, list(st.candidates))
+                buf[: old.size] = old
+                # trim stale bytes beyond true size (padding zeros)
+                if true_size - a_off < old.size:
+                    buf[max(0, true_size - a_off): old.size] = 0
+        buf[offset - a_off: end - a_off] = np.frombuffer(
+            bytes(data), dtype=np.uint8)
+        frags = await self._codec_encode(buf)
+        f_off = a_off // self.k
+        good = await self._window_op(
+            fd, loc, st, "writev",
+            lambda i: ((self._child_fd(fd, i),
+                        frags[i].tobytes(), f_off), {}))
+        st.size = max(true_size, end)
+        ia = next(iter(good.values()))
+        ia = Iatt(**{**ia.__dict__})
+        ia.size = st.size
+        return ia
+
     async def writev(self, fd: FdObj, data: bytes, offset: int,
                      xdata: dict | None = None):
         """Write under the eager window: first fop on an inode pays
@@ -797,64 +857,135 @@ class DisperseLayer(Layer):
         async with self._lock(fd.gfid):
             st = await self._eager_begin(loc, fd.gfid)
             try:
-                true_size = st.size
-                end = offset + len(data)
-                a_off = offset // self.stripe * self.stripe
-                a_end = (end + self.stripe - 1) // self.stripe * self.stripe
-                buf = np.zeros(a_end - a_off, dtype=np.uint8)
-                # RMW: pull existing stripes overlapping the aligned region
-                if true_size > a_off and (offset % self.stripe or
-                                          end % self.stripe or
-                                          offset > true_size):
-                    have_end = min(a_end, self._frag_len(true_size) * self.k)
-                    if have_end > a_off:
-                        old = await self._read_aligned(
-                            fd, a_off, have_end - a_off,
-                            list(st.candidates))
-                        buf[: old.size] = old
-                        # trim stale bytes beyond true size (padding zeros)
-                        if true_size - a_off < old.size:
-                            buf[max(0, true_size - a_off): old.size] = 0
-                buf[offset - a_off: end - a_off] = np.frombuffer(
-                    bytes(data), dtype=np.uint8)
-                frags = await self._codec_encode(buf)
-                if not st.pre:
-                    # pre-op once per window: dirty+1 (ec-common.c:2377)
-                    pre_targets = sorted(st.good)
-                    await self._xattrop(pre_targets, loc,
-                                        {XA_DIRTY: _pack_u64x2(1, 0)})
-                    st.pre = set(pre_targets)
-                f_off = a_off // self.k
-                targets = sorted(st.good & set(self._up_idx()))
-                # poison the window across the wave: if this dispatch is
-                # torn off mid-flight (task cancellation), some bricks
-                # hold new fragments with no record of who — an empty
-                # good set makes the flush keep dirty everywhere so the
-                # shd reconverges, instead of releasing it over silently
-                # diverged data
-                prev_good = st.good
-                st.good = set()
-                res = await self._dispatch(
-                    targets, "writev",
-                    lambda i: ((self._child_fd(fd, i),
-                                frags[i].tobytes(), f_off), {}))
-                ok = {i for i, r in res.items()
-                      if not isinstance(r, BaseException)}
-                # a brick that missed ANY write in the window stays out:
-                # it is inconsistent until healed
-                st.good = prev_good & ok
-                if len(ok) < self._write_quorum():
-                    raise FopError(errno.EIO,
-                                   f"write quorum lost ({len(ok)}/{self.n})")
-                st.delta += 1
-                st.size = max(true_size, end)
-                st.candidates = sorted(st.good)
-                ia = next(r for i, r in res.items() if i in ok)
-                ia = Iatt(**{**ia.__dict__})
-                ia.size = st.size
-                return ia
+                return await self._writev_in_window(fd, loc, st, data,
+                                                    offset)
             finally:
                 await self._eager_end(loc, fd.gfid)
+
+    # -- allocation-class fops (ec-inode-write.c fallocate/discard/
+    # zerofill; zeros are a fixed point of the linear code: a zero user
+    # stripe encodes to zero fragments, so zero ranges ride the normal
+    # write path and fragment holes stay holes) -------------------------
+
+    async def _zero_in_window(self, fd: FdObj, loc: Loc, st: _EagerState,
+                              offset: int, length: int) -> None:
+        """Zero a user range through the window write path (RMW at the
+        stripe edges), in bounded chunks."""
+        window = max(self.stripe,
+                     int(self.opts["self-heal-window-size"]))
+        while length > 0:
+            n = min(window, length)
+            await self._writev_in_window(fd, loc, st, b"\0" * n, offset)
+            offset += n
+            length -= n
+
+    async def fallocate(self, fd: FdObj, mode: int, offset: int,
+                        length: int, xdata: dict | None = None):
+        """Reserve space; extend the file when FALLOC_FL_KEEP_SIZE (bit
+        0) is not set (ec_fallocate, ec-inode-write.c).  Allocation maps
+        to KEEP_SIZE fragment-range fallocate on every brick (pure
+        allocation: fragment content and sizes never change); the
+        extension region past EOF becomes encoded zeros via the window
+        write path, all under the inode's lock."""
+        loc = Loc(fd.path, gfid=fd.gfid)
+        async with self._lock(fd.gfid):
+            st = await self._eager_begin(loc, fd.gfid)
+            try:
+                end = offset + length
+                f_off = offset // self.stripe * CHUNK
+                f_end = (end + self.stripe - 1) // self.stripe * CHUNK
+                idxs = self._up_idx()
+                res = await self._dispatch(
+                    idxs, "fallocate",
+                    lambda i: ((self._child_fd(fd, i), mode | 1, f_off,
+                                f_end - f_off), {}))
+                self._combine(res, min_ok=self._write_quorum())
+                if not (mode & 1) and end > st.size:
+                    await self._zero_in_window(fd, loc, st, st.size,
+                                               end - st.size)
+            finally:
+                await self._eager_end(loc, fd.gfid)
+        ia, _ = await self.lookup(loc)
+        return ia
+
+    async def discard(self, fd: FdObj, offset: int, length: int,
+                      xdata: dict | None = None):
+        """Punch a hole WITHOUT growing the file (ec_discard): the
+        stripe-aligned interior punches fragment holes brick-side (child
+        discard, O(1) data motion); the unaligned edges re-encode as
+        zeros through the window."""
+        loc = Loc(fd.path, gfid=fd.gfid)
+        async with self._lock(fd.gfid):
+            st = await self._eager_begin(loc, fd.gfid)
+            try:
+                end = min(offset + length, st.size)
+                if end > offset:
+                    a_lo = (offset + self.stripe - 1) \
+                        // self.stripe * self.stripe
+                    a_hi = end // self.stripe * self.stripe
+                    if a_hi > a_lo:
+                        f_off, f_len = a_lo // self.k, (a_hi - a_lo) // self.k
+                        await self._window_op(
+                            fd, loc, st, "discard",
+                            lambda i: ((self._child_fd(fd, i), f_off,
+                                        f_len), {}))
+                    head_end = min(a_lo, end)
+                    if offset < head_end:
+                        await self._zero_in_window(fd, loc, st, offset,
+                                                   head_end - offset)
+                    tail_start = max(a_hi, offset)
+                    if tail_start < end:
+                        await self._zero_in_window(fd, loc, st, tail_start,
+                                                   end - tail_start)
+            finally:
+                await self._eager_end(loc, fd.gfid)
+        ia, _ = await self.lookup(loc)
+        return ia
+
+    async def zerofill(self, fd: FdObj, offset: int, length: int,
+                       xdata: dict | None = None):
+        """Zero the range, extending the file if it ends past EOF
+        (ec_zerofill)."""
+        loc = Loc(fd.path, gfid=fd.gfid)
+        async with self._lock(fd.gfid):
+            st = await self._eager_begin(loc, fd.gfid)
+            try:
+                if length > 0:
+                    await self._zero_in_window(fd, loc, st, offset, length)
+            finally:
+                await self._eager_end(loc, fd.gfid)
+        ia, _ = await self.lookup(loc)
+        return ia
+
+    async def seek(self, fd: FdObj, offset: int, what: str = "data",
+                   xdata: dict | None = None):
+        """SEEK_DATA/SEEK_HOLE over fragments (ec_seek,
+        ec-inode-read.c): ask one consistent brick, scale the fragment
+        offset back to user space at stripe granularity — data/holes in
+        user space land on the same stripes in every fragment because
+        zero stripes encode to zero fragments."""
+        loc = Loc(fd.path, gfid=fd.gfid)
+        async with self._Txn(self, loc, fd.gfid, "rd"):
+            candidates, true_size = await self._read_meta(loc)
+            if offset >= true_size:
+                raise FopError(errno.ENXIO, "offset beyond EOF")
+            f_off = offset // self.stripe * CHUNK
+            last: FopError | None = None
+            for i in self._read_children(candidates, fd.gfid):
+                try:
+                    r = await self.children[i].seek(
+                        self._child_fd(fd, i), f_off, what)
+                except FopError as e:
+                    if e.err == errno.ENXIO:
+                        if what == "data":
+                            raise  # no data at/after offset
+                        return true_size  # implicit hole at EOF
+                    last = e
+                    continue
+                user = r // CHUNK * self.stripe
+                out = max(offset, user)
+                return min(out, true_size)
+            raise last or FopError(errno.ENOTCONN, "no child for seek")
 
     async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
         fd = FdObj((await self.lookup(loc))[0].gfid, path=loc.path,

@@ -63,6 +63,7 @@ class Glusterd:
         self.bricks: dict[str, subprocess.Popen] = {}  # brickname -> proc
         self.ports: dict[str, int] = {}  # portmap: brickname -> port
         self.shd: dict[str, subprocess.Popen] = {}  # volname -> shd proc
+        self.gsync: dict[str, subprocess.Popen] = {}  # volname -> gsyncd
         self._server: asyncio.AbstractServer | None = None
         self._txn_lock = asyncio.Lock()
         self._txn_holder: str | None = None
@@ -92,14 +93,20 @@ class Glusterd:
         self._save()
         log.info(10, "glusterd %s on %s:%d (workdir %s)", self.uuid[:8],
                  self.host, self.port, self.workdir)
-        # restart-resume: bricks of started volumes come back up
+        # restart-resume: bricks/shd/gsyncd of started volumes come back
         for vol in self.state["volumes"].values():
             if vol.get("status") == "started":
                 await self._start_local_bricks(vol)
                 self._spawn_shd(vol)
+                if vol.get("georep", {}).get("status") == "started":
+                    self._spawn_gsync(vol)
         return self.port
 
     async def stop(self) -> None:
+        # daemon shutdown kills workers WITHOUT touching the persisted
+        # session status: a restarted glusterd resumes started sessions
+        for name in list(self.gsync):
+            self._kill_gsync(name)
         for name in list(self.shd):
             self._kill_shd(name)
         for name in list(self.bricks):
@@ -444,6 +451,131 @@ class Glusterd:
         if vol is None:
             raise MgmtError(f"no volume {name!r}")
         return vol
+
+    # -- geo-replication (glusterd-geo-rep.c session mgmt analog) ----------
+    # Session ops run through the cluster txn so every node stores the
+    # link and runs a worker over ITS local bricks' changelogs — a
+    # change landing on a remote node's brick is journaled and replayed
+    # there (workers partition by brick; replay is idempotent so replica
+    # overlap across nodes converges).
+
+    async def op_georep_create(self, name: str, secondary: str) -> dict:
+        """Create a geo-rep link: secondary is 'host:port:volume' of the
+        secondary volume's glusterd."""
+        self._vol(name)
+        host, port, svol = secondary.rsplit(":", 2)
+        if not (host and port.isdigit() and svol):
+            raise MgmtError(f"bad secondary spec {secondary!r} "
+                            f"(want host:port:volume)")
+        await self._cluster_txn("georep-create",
+                                {"name": name, "secondary": secondary})
+        return {"ok": True, "primary": name, "secondary": secondary}
+
+    async def commit_georep_create(self, name: str, secondary: str) -> dict:
+        vol = self._vol(name)
+        vol["georep"] = {"secondary": secondary, "status": "created"}
+        # the journal feeds gsyncd: enable changelog and respawn local
+        # bricks so their graphs pick it up (reference: geo-rep create
+        # force-enables changelog + marker)
+        vol.setdefault("options", {})["changelog.changelog"] = "on"
+        self._save()
+        if vol["status"] == "started":
+            for b in vol["bricks"]:
+                if b["node"] == self.uuid and b["name"] in self.bricks:
+                    port = b.get("port")
+                    self._kill_brick(b["name"])
+                    await self._spawn_brick(vol, b, port=port)
+        return {"created": name}
+
+    async def op_georep_start(self, name: str) -> dict:
+        vol = self._vol(name)
+        if not vol.get("georep"):
+            raise MgmtError(f"no geo-rep session on {name}")
+        if vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        await self._cluster_txn("georep-start", {"name": name})
+        return {"ok": True}
+
+    def commit_georep_start(self, name: str) -> dict:
+        vol = self._vol(name)
+        geo = vol["georep"]
+        geo["status"] = "started"
+        self._save()
+        self._spawn_gsync(vol)
+        return {"started": name}
+
+    def _spawn_gsync(self, vol: dict) -> None:
+        name = vol["name"]
+        geo = vol.get("georep") or {}
+        proc = self.gsync.get(name)
+        if proc is not None and proc.poll() is None:
+            return
+        local = [b for b in vol["bricks"] if b["node"] == self.uuid]
+        if not local:
+            return  # no journals on this node
+        dirs = ",".join(
+            os.path.join(b["path"], ".glusterfs_tpu", "changelog")
+            for b in local)
+        state = os.path.join(self.workdir, f"gsync-{name}.state")
+        statusfile = os.path.join(self.workdir, f"gsync-{name}.json")
+        interval = float(vol.get("options", {}).get(
+            "georep.sync-interval", 3))
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        with open(os.path.join(self.workdir, f"gsync-{name}.log"),
+                  "ab") as logf:
+            self.gsync[name] = subprocess.Popen(
+                [sys.executable, "-m", "glusterfs_tpu.mgmt.gsyncd",
+                 "--primary", f"{self.host}:{self.port}:{name}",
+                 "--secondary", geo["secondary"],
+                 "--changelogs", dirs, "--state", state,
+                 "--interval", str(interval),
+                 "--statusfile", statusfile],
+                env=env, stdout=subprocess.DEVNULL, stderr=logf)
+
+    def _kill_gsync(self, name: str) -> None:
+        proc = self.gsync.pop(name, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    async def op_georep_stop(self, name: str) -> dict:
+        vol = self._vol(name)
+        if not vol.get("georep"):
+            raise MgmtError(f"no geo-rep session on {name}")
+        await self._cluster_txn("georep-stop", {"name": name})
+        return {"ok": True}
+
+    def commit_georep_stop(self, name: str) -> dict:
+        vol = self._vol(name)
+        self._kill_gsync(name)
+        vol["georep"]["status"] = "stopped"
+        self._save()
+        return {"stopped": name}
+
+    def op_georep_status(self, name: str) -> dict:
+        vol = self._vol(name)
+        geo = vol.get("georep")
+        if not geo:
+            return {"sessions": []}
+        proc = self.gsync.get(name)
+        state_path = os.path.join(self.workdir, f"gsync-{name}.state")
+        worker_state = {}
+        try:
+            with open(state_path) as f:
+                worker_state = json.load(f)
+        except (FileNotFoundError, ValueError):
+            pass
+        return {"sessions": [{
+            "primary": name, "secondary": geo["secondary"],
+            "status": geo["status"],
+            "online": proc is not None and proc.poll() is None,
+            "last_ts": worker_state.get("last_ts", 0),
+        }]}
 
     # -- brick lifecycle (glusterd-utils.c runner + pmap) ------------------
 

@@ -1,0 +1,348 @@
+"""Geo-replication worker — the gsyncd analog.
+
+Reference: geo-replication/syncdaemon (primary.py:90-135 crawl/changelog
+consumption, resource.py rsync/tar transport): an asynchronous daemon
+that discovers what changed on the primary volume from the brick
+changelogs and replays it onto a secondary volume, keeping a persisted
+checkpoint so a crashed/restarted worker resumes where it left off.
+
+TPU-build shape: one worker per (primary volume -> secondary volume)
+link.  It tails every primary brick's journal segments by
+(segment, offset) cursor (features/changelog.py), coalesces the batch
+(one data-sync per path — the copy reads the CURRENT primary state
+through the mounted client, so intermediate writes are free), replays
+entry ops in order, and persists cursors only after a fully-applied
+batch — replay is idempotent, so re-applying after a crash converges.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import errno
+import json
+import os
+import signal
+import sys
+
+from ..core.fops import FopError
+from ..core import gflog
+
+log = gflog.get_logger("gsyncd")
+
+COPY_WINDOW = 1 << 20
+
+
+class GeoRepWorker:
+    def __init__(self, primary, secondary, changelog_dirs: list[str],
+                 state_path: str, interval: float = 5.0):
+        self.primary = primary      # mounted Client on the primary vol
+        self.secondary = secondary  # mounted Client on the secondary vol
+        self.dirs = changelog_dirs
+        self.state_path = state_path
+        self.interval = interval
+        self.state = self._load_state()
+        self.synced = 0
+        self.batches = 0
+        self._task: asyncio.Task | None = None
+
+    # -- checkpoint ---------------------------------------------------------
+
+    def _load_state(self) -> dict:
+        try:
+            with open(self.state_path) as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return {"cursors": {}, "last_ts": 0.0}
+
+    def _save_state(self) -> None:
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.state, f)
+        os.replace(tmp, self.state_path)
+
+    # -- journal tailing ----------------------------------------------------
+
+    def _collect_new(self) -> list[dict]:
+        """Read records past each brick's (segment, offset) cursor.
+        Cursors only advance in self.state; the caller persists them
+        after the batch fully applies."""
+        out = []
+        for d in self.dirs:
+            cur = self.state["cursors"].setdefault(d, {})
+            try:
+                segs = sorted(int(n.rsplit(".", 1)[1])
+                              for n in os.listdir(d)
+                              if n.startswith("CHANGELOG."))
+            except OSError:
+                continue
+            for seq in segs:
+                if seq < cur.get("segment", 0):
+                    continue
+                off = cur.get("offset", 0) \
+                    if seq == cur.get("segment", 0) else 0
+                path = os.path.join(d, f"CHANGELOG.{seq}")
+                try:
+                    with open(path) as f:
+                        f.seek(off)
+                        data = f.read()
+                except OSError:
+                    continue
+                # consume only complete lines (a record may be mid-write)
+                complete = data.rfind("\n") + 1
+                for line in data[:complete].splitlines():
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+                cur["segment"] = seq
+                cur["offset"] = off + complete
+        out.sort(key=lambda r: r.get("ts", 0))
+        return out
+
+    # -- replay -------------------------------------------------------------
+
+    async def _copy_file(self, path: str) -> bool:
+        """Sync the CURRENT primary state of path to the secondary."""
+        try:
+            ia = await self.primary.stat(path)
+        except FopError:
+            return False  # vanished since the record; a later E handles it
+        try:
+            f_in = await self.primary.open(path)
+        except FopError:
+            return False
+        try:
+            try:
+                f_out = await self.secondary.create(path)
+            except FopError as e:
+                if e.err != errno.EEXIST:
+                    return False
+                f_out = await self.secondary.open(path, os.O_RDWR)
+            try:
+                off = 0
+                while off < ia.size:
+                    chunk = await f_in.read(
+                        min(COPY_WINDOW, ia.size - off), off)
+                    if not chunk:
+                        break
+                    await f_out.write(chunk, off)
+                    off += len(chunk)
+                await self.secondary.truncate(path, ia.size)
+            finally:
+                await f_out.close()
+        finally:
+            await f_in.close()
+        return True
+
+    async def _ensure_parents(self, path: str) -> None:
+        parts = [p for p in path.strip("/").split("/")[:-1] if p]
+        cur = ""
+        for p in parts:
+            cur += "/" + p
+            try:
+                await self.secondary.mkdir(cur)
+            except FopError:
+                pass
+
+    async def _replay(self, rec: dict) -> None:
+        op, path = rec.get("op", ""), rec.get("path", "")
+        if not path:
+            return
+        try:
+            if op in ("unlink",):
+                try:
+                    await self.secondary.unlink(path)
+                except FopError as e:
+                    if e.err != errno.ENOENT:
+                        raise
+            elif op == "rmdir":
+                try:
+                    await self.secondary.rmdir(path)
+                except FopError as e:
+                    if e.err not in (errno.ENOENT, errno.ENOTEMPTY):
+                        raise
+            elif op == "mkdir":
+                await self._ensure_parents(path)
+                try:
+                    await self.secondary.mkdir(path)
+                except FopError as e:
+                    if e.err != errno.EEXIST:
+                        raise
+            elif op == "rename":
+                dst = rec.get("path2", "")
+                if dst:
+                    await self._ensure_parents(dst)
+                    try:
+                        await self.secondary.rename(path, dst)
+                    except FopError:
+                        # source absent on secondary: materialize dst
+                        await self._copy_file(dst)
+                    try:
+                        await self.secondary.unlink(path)
+                    except FopError:
+                        pass
+            elif op == "link":
+                dst = rec.get("path2", "")
+                if dst:
+                    await self._ensure_parents(dst)
+                    try:
+                        await self.secondary.link(path, dst)
+                    except FopError:
+                        # source missing on secondary: materialize dst
+                        await self._copy_file(dst)
+            elif op == "symlink":
+                try:
+                    target = await self.primary.readlink(path)
+                    await self._ensure_parents(path)
+                    await self.secondary.symlink(target, path)
+                except FopError:
+                    pass
+            elif rec.get("type") in ("D", "E"):
+                # create/write/truncate/...: sync current file state
+                await self._ensure_parents(path)
+                if await self._copy_file(path):
+                    self.synced += 1
+            elif rec.get("type") == "M":
+                try:
+                    ia = await self.primary.stat(path)
+                    await self.secondary.setattr(
+                        path, {"mode": ia.mode & 0o7777})
+                except FopError:
+                    pass
+        except FopError as e:
+            log.warning(1, "replay %s %s failed: %s", op, path, e)
+
+    _SYNC_OPS = {"create", "icreate", "put"}
+
+    @classmethod
+    def _is_sync(cls, r: dict) -> bool:
+        """Records whose replay is 'copy current file state'."""
+        return r.get("type") == "D" or r.get("op") in cls._SYNC_OPS
+
+    @classmethod
+    def _coalesce(cls, recs: list[dict]) -> list[dict]:
+        """One data-sync per path per batch: create + N writev records
+        collapse to the LAST such record (the copy reads the current
+        primary state anyway)."""
+        last: dict[str, int] = {}
+        for i, r in enumerate(recs):
+            if cls._is_sync(r):
+                last[r.get("path", "")] = i
+        return [r for i, r in enumerate(recs)
+                if not cls._is_sync(r) or last.get(r.get("path", "")) == i]
+
+    async def process_once(self) -> int:
+        recs = self._collect_new()
+        if not recs:
+            return 0
+        batch = self._coalesce(recs)
+        for rec in batch:
+            await self._replay(rec)
+        self.state["last_ts"] = recs[-1].get("ts", 0)
+        self.batches += 1
+        self._save_state()
+        self._prune_consumed()
+        return len(batch)
+
+    def _prune_consumed(self) -> None:
+        """Delete journal segments fully behind the persisted cursor —
+        the consumed changelog would otherwise grow without bound (the
+        reference archives processed changelogs the same way)."""
+        for d, cur in self.state["cursors"].items():
+            current = cur.get("segment", 0)
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for n in names:
+                if not n.startswith("CHANGELOG."):
+                    continue
+                try:
+                    seq = int(n.rsplit(".", 1)[1])
+                except ValueError:
+                    continue
+                if seq < current:
+                    try:
+                        os.unlink(os.path.join(d, n))
+                    except OSError:
+                        pass
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.process_once()
+            except Exception as e:  # a bad batch must not kill the link
+                log.error(2, "gsyncd batch failed: %r", e)
+            await asyncio.sleep(self.interval)
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def status(self) -> dict:
+        return {"batches": self.batches, "files_synced": self.synced,
+                "last_ts": self.state.get("last_ts", 0)}
+
+
+def _parse_endpoint(spec: str) -> tuple[str, int, str]:
+    host, port, vol = spec.rsplit(":", 2)
+    return host, int(port), vol
+
+
+async def _amain(args) -> None:
+    from .glusterd import mount_volume
+
+    ph, pp, pv = _parse_endpoint(args.primary)
+    sh, sp, sv = _parse_endpoint(args.secondary)
+    primary = secondary = None
+    while primary is None or secondary is None:
+        try:
+            if primary is None:
+                primary = await mount_volume(ph, pp, pv)
+            if secondary is None:
+                secondary = await mount_volume(sh, sp, sv)
+        except Exception as e:
+            log.warning(3, "gsyncd mount retry: %r", e)
+            await asyncio.sleep(1.0)
+    worker = GeoRepWorker(primary, secondary, args.changelogs.split(","),
+                          args.state, args.interval)
+    if args.statusfile:
+        with open(args.statusfile + ".tmp", "w") as f:
+            json.dump({"pid": os.getpid()}, f)
+        os.replace(args.statusfile + ".tmp", args.statusfile)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    worker.start()
+    await stop.wait()
+    await worker.stop()
+    await primary.unmount()
+    await secondary.unmount()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="gftpu-gsyncd")
+    p.add_argument("--primary", required=True, help="host:port:volume")
+    p.add_argument("--secondary", required=True, help="host:port:volume")
+    p.add_argument("--changelogs", required=True,
+                   help="comma-separated brick changelog dirs")
+    p.add_argument("--state", required=True)
+    p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("--statusfile", default="")
+    args = p.parse_args(argv)
+    asyncio.run(_amain(args))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,10 @@ OPTION_MAP = {
                                     "thread-count"),
     "diagnostics.latency-measurement": ("debug/io-stats",
                                         "latency-measurement"),
+    "changelog.changelog": ("features/changelog", "__enable__"),
+    # consumed by glusterd's gsyncd spawner, not a graph layer
+    "georep.sync-interval": ("mgmt/gsyncd", "interval"),
+    "changelog.rollover-time": ("features/changelog", "rollover-time"),
     "features.cache-invalidation": ("features/upcall", "__enable__"),
     "features.cache-invalidation-timeout": ("features/upcall",
                                             "cache-invalidation-timeout"),
@@ -111,8 +115,15 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
     name = brick["name"]
     out = [_emit(f"{name}-posix", "storage/posix",
                  {"directory": brick["path"]}, [])]
-    out.append(_emit(f"{name}-locks", "features/locks", {},
-                     [f"{name}-posix"]))
+    top = f"{name}-posix"
+    # fop journal directly above posix (server_graph_table order);
+    # geo-rep create enables it (default off: no consumer, no journal)
+    if _enabled(volinfo, "changelog.changelog", False):
+        out.append(_emit(f"{name}-changelog", "features/changelog",
+                         layer_options(volinfo, "features/changelog"),
+                         [top]))
+        top = f"{name}-changelog"
+    out.append(_emit(f"{name}-locks", "features/locks", {}, [top]))
     top = f"{name}-locks"
     # pending-heal index on every brick (server_graph_table puts index
     # above locks; index-base defaults under the posix root)

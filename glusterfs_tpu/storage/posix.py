@@ -55,6 +55,31 @@ def _fop_errno(e: OSError) -> FopError:
     return FopError(e.errno or errno.EIO, str(e))
 
 
+FALLOC_FL_KEEP_SIZE = 0x01
+FALLOC_FL_PUNCH_HOLE = 0x02
+
+try:
+    import ctypes as _ctypes
+
+    _libc = _ctypes.CDLL(None, use_errno=True)
+    _libc_fallocate = _libc.fallocate
+except (OSError, AttributeError):  # non-Linux: posix_fallocate fallback
+    _libc_fallocate = None
+
+
+def _sys_fallocate(fdno: int, mode: int, offset: int, length: int) -> None:
+    """fallocate(2) honoring mode flags (KEEP_SIZE, PUNCH_HOLE)."""
+    if _libc_fallocate is None:
+        if mode:
+            raise OSError(errno.EOPNOTSUPP, "fallocate flags unsupported")
+        os.posix_fallocate(fdno, offset, length)
+        return
+    if _libc_fallocate(_ctypes.c_int(fdno), _ctypes.c_int(mode),
+                       _ctypes.c_long(offset), _ctypes.c_long(length)) != 0:
+        err = _ctypes.get_errno()
+        raise OSError(err, os.strerror(err))
+
+
 @register("storage/posix")
 class PosixLayer(Layer):
     """Bottom-of-brick storage layer."""
@@ -651,8 +676,10 @@ class PosixLayer(Layer):
 
     async def fallocate(self, fd: FdObj, mode: int, offset: int, length: int,
                         xdata: dict | None = None):
+        """fallocate(2) with real mode flags via libc (posix_fallocate
+        ignores FALLOC_FL_KEEP_SIZE and would grow the file)."""
         try:
-            await self._io(os.posix_fallocate, self._os_fd(fd), offset,
+            await self._io(_sys_fallocate, self._os_fd(fd), mode, offset,
                            length)
         except OSError as e:
             raise _fop_errno(e)
@@ -660,8 +687,17 @@ class PosixLayer(Layer):
 
     async def discard(self, fd: FdObj, offset: int, length: int,
                       xdata: dict | None = None):
-        # punch a hole by zeroing (portable)
-        return await self.zerofill(fd, offset, length, xdata)
+        """Punch a hole: FALLOC_FL_PUNCH_HOLE|KEEP_SIZE frees the blocks
+        (posix_discard); falls back to zero-writing where the filesystem
+        cannot punch."""
+        fdno = self._os_fd(fd)
+        try:
+            await self._io(_sys_fallocate, fdno,
+                           FALLOC_FL_PUNCH_HOLE | FALLOC_FL_KEEP_SIZE,
+                           offset, length)
+            return self._iatt_gfid(fd.gfid)
+        except OSError:
+            return await self.zerofill(fd, offset, length, xdata)
 
     async def zerofill(self, fd: FdObj, offset: int, length: int,
                        xdata: dict | None = None):

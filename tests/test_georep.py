@@ -1,0 +1,243 @@
+"""Changelog journal + geo-replication: brick-side fop journal feeds a
+gsyncd-style worker that converges a secondary volume, survives worker
+restart, and checkpoints progress — the tests/00-geo-rep + changelog .t
+analog.  Reference: xlators/features/changelog,
+geo-replication/syncdaemon/primary.py:90-135."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client, SyncClient
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.mgmt.gsyncd import GeoRepWorker
+
+PRIMARY_VOL = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+
+volume changelog
+    type features/changelog
+    option rollover-time 3600
+    subvolumes posix
+end-volume
+"""
+
+SECONDARY_VOL = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+"""
+
+
+def _cl_dir(brick):
+    return os.path.join(str(brick), ".glusterfs_tpu", "changelog")
+
+
+def _records(brick):
+    d = _cl_dir(brick)
+    out = []
+    for n in sorted(os.listdir(d)):
+        with open(os.path.join(d, n)) as f:
+            out += [json.loads(l) for l in f.read().splitlines()]
+    return out
+
+
+def test_changelog_journals_mutations(tmp_path):
+    g = Graph.construct(PRIMARY_VOL.format(dir=tmp_path / "b"))
+    c = SyncClient(g)
+    c.mount()
+    try:
+        c.write_file("/a", b"hello")
+        c.mkdir("/d")
+        c.write_file("/d/x", b"nested")
+        c.rename("/a", "/b")
+        c.unlink("/d/x")
+        c.setxattr("/b", {"user.k": b"v"})
+        recs = _records(tmp_path / "b")
+        ops = [(r["type"], r["op"]) for r in recs]
+        assert ("E", "create") in ops
+        assert ("D", "writev") in ops
+        assert ("E", "mkdir") in ops
+        assert ("E", "rename") in ops
+        assert ("E", "unlink") in ops
+        assert ("M", "setxattr") in ops
+        ren = next(r for r in recs if r["op"] == "rename")
+        assert ren["path"] == "/a" and ren["path2"] == "/b"
+        # internal accounting is never journaled
+        c._run(g.by_name["changelog"].setxattr(
+            __import__("glusterfs_tpu.core.layer",
+                       fromlist=["Loc"]).Loc("/b"),
+            {"trusted.ec.dirty": b"\0" * 16}))
+        assert not any(r["op"] == "setxattr" and "trusted.ec" in str(r)
+                       for r in _records(tmp_path / "b"))
+    finally:
+        c.close()
+
+
+@pytest.fixture
+def pair(tmp_path):
+    """Mounted primary (with changelog) + secondary volumes and a
+    worker factory sharing one checkpoint file."""
+    gp = Graph.construct(PRIMARY_VOL.format(dir=tmp_path / "p"))
+    gs = Graph.construct(SECONDARY_VOL.format(dir=tmp_path / "s"))
+    state = str(tmp_path / "geo.state")
+
+    async def setup():
+        p, s = Client(gp), Client(gs)
+        await p.mount()
+        await s.mount()
+        return p, s
+
+    loop = asyncio.new_event_loop()
+    p, s = loop.run_until_complete(setup())
+
+    def worker():
+        return GeoRepWorker(p, s, [_cl_dir(tmp_path / "p")], state)
+
+    yield loop, p, s, worker
+    loop.run_until_complete(p.unmount())
+    loop.run_until_complete(s.unmount())
+    loop.close()
+
+
+def test_worker_converges_secondary(pair):
+    loop, p, s, worker = pair
+
+    async def run():
+        w = worker()
+        await p.write_file("/f1", b"one")
+        await p.mkdir("/sub")
+        await p.write_file("/sub/f2", b"two" * 1000)
+        await w.process_once()
+        assert await s.read_file("/f1") == b"one"
+        assert await s.read_file("/sub/f2") == b"two" * 1000
+        # mutation + rename + delete converge too
+        await p.write_file("/f1", b"one-v2")
+        await p.rename("/sub/f2", "/f3")
+        await p.unlink("/f1")
+        await w.process_once()
+        assert not await s.exists("/f1")
+        assert await s.read_file("/f3") == b"two" * 1000
+        assert w.status()["batches"] == 2
+
+    loop.run_until_complete(run())
+
+
+def test_worker_restart_resumes_from_checkpoint(pair):
+    loop, p, s, worker = pair
+
+    async def run():
+        w1 = worker()
+        await p.write_file("/a", b"aa")
+        await w1.process_once()
+        assert await s.read_file("/a") == b"aa"
+        done_cursor = dict(w1.state["cursors"])
+        # worker dies; more mutations land; a NEW worker picks up from
+        # the persisted cursor and converges without a full re-scan
+        await p.write_file("/b", b"bb")
+        await p.write_file("/a", b"aa-v2")
+        w2 = worker()
+        assert w2.state["cursors"] == done_cursor
+        n = await w2.process_once()
+        assert n >= 1
+        assert await s.read_file("/a") == b"aa-v2"
+        assert await s.read_file("/b") == b"bb"
+
+    loop.run_until_complete(run())
+
+
+def test_data_coalescing_one_copy_per_path(pair):
+    loop, p, s, worker = pair
+
+    async def run():
+        w = worker()
+        f = await p.create("/hot")
+        for i in range(50):
+            await f.write(bytes([i]) * 64, i * 64)
+        await f.close()
+        before = w.synced
+        await w.process_once()
+        # 50 writev records coalesce to ONE data sync
+        assert w.synced - before == 1
+        got = await s.read_file("/hot")
+        assert got == b"".join(bytes([i]) * 64 for i in range(50))
+
+    loop.run_until_complete(run())
+
+
+@pytest.mark.slow
+def test_e2e_georep_through_glusterd(tmp_path):
+    """Full managed path: two volumes, georep-create/start spawns a
+    gsyncd subprocess, primary mutations converge on the secondary,
+    and the link survives a worker restart (VERDICT next-round #9 done
+    criterion)."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient, mount_volume
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="pri", vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "pb")}],
+                             redundancy=0)
+                await c.call("volume-create", name="sec", vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "sb")}],
+                             redundancy=0)
+                await c.call("volume-set", name="pri",
+                             key="changelog.rollover-time", value="1")
+                await c.call("volume-start", name="pri")
+                await c.call("volume-start", name="sec")
+                await c.call("georep-create", name="pri",
+                             secondary=f"{d.host}:{d.port}:sec")
+                await c.call("georep-start", name="pri")
+                st = await c.call("georep-status", name="pri")
+                assert st["sessions"][0]["online"]
+
+            pc = await mount_volume(d.host, d.port, "pri")
+            sc = await mount_volume(d.host, d.port, "sec")
+            try:
+                await pc.write_file("/doc", b"geo" * 512)
+                await pc.mkdir("/dir")
+                await pc.write_file("/dir/n", b"nested")
+                ok = False
+                for _ in range(60):
+                    try:
+                        if (await sc.read_file("/doc") == b"geo" * 512 and
+                                await sc.read_file("/dir/n") == b"nested"):
+                            ok = True
+                            break
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.5)
+                assert ok, "secondary never converged"
+
+                # stop -> mutate -> start: resumes from checkpoint
+                async with MgmtClient(d.host, d.port) as c:
+                    await c.call("georep-stop", name="pri")
+                await pc.write_file("/late", b"after-restart")
+                async with MgmtClient(d.host, d.port) as c:
+                    await c.call("georep-start", name="pri")
+                ok = False
+                for _ in range(60):
+                    try:
+                        if await sc.read_file("/late") == b"after-restart":
+                            ok = True
+                            break
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.5)
+                assert ok, "post-restart mutation never synced"
+            finally:
+                await pc.unmount()
+                await sc.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
