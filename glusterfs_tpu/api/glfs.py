@@ -40,6 +40,23 @@ def _split(path: str) -> tuple[str, str]:
     return (parent or "/"), name
 
 
+async def wait_connected(graph: Graph, timeout: float = 15.0) -> bool:
+    """Poll until every protocol/client layer in the graph has finished
+    its handshake (the reference blocks the mount until CHILD_UP reaches
+    the top).  Returns whether all connected within the deadline."""
+    from ..protocol.client import ClientLayer
+
+    prot = [l for l in graph.by_name.values()
+            if isinstance(l, ClientLayer)]
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if all(p.connected for p in prot):
+            return True
+        await asyncio.sleep(0.05)
+    return all(p.connected for p in prot)
+
+
 class File:
     """An open file (glfs_fd_t analog)."""
 
@@ -84,6 +101,7 @@ class Client:
         self.graph = graph
         self.itable = InodeTable()
         self.mounted = False
+        self.watchers: list = []  # background tasks (volfile watcher)
 
     async def mount(self) -> None:
         if not self.graph.active:
@@ -91,9 +109,43 @@ class Client:
         self.mounted = True
 
     async def unmount(self) -> None:
+        # cancel AND await the watchers: a mid-flight reload() must
+        # finish its cleanup before we fini the graph under it
+        for t in self.watchers:
+            t.cancel()
+        for t in self.watchers:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self.watchers.clear()
         if self.graph.active:
             await self.graph.fini()
         self.mounted = False
+
+    async def reload(self, volfile_text: str) -> str:
+        """Apply a changed volfile to the live mount (the reference's
+        volfile-modified handling, graph.c:980-1089): same topology ->
+        per-layer reconfigure in place; topology change -> build and
+        activate the new graph, swap it in, retire the old one.  Open
+        fds keep working through the new graph: their per-layer contexts
+        miss, so every layer falls back to gfid-addressed anonymous fds
+        (the reference migrates fds onto the new graph for the same
+        reason)."""
+        if self.graph.apply_volfile(volfile_text):
+            return "reconfigured"
+        new = Graph.construct(volfile_text)
+        await new.activate()
+        try:
+            await wait_connected(new)
+            old, self.graph = self.graph, new
+        except BaseException:
+            # cancelled/failed mid-swap: don't leak the half-built graph
+            # (shielded — the fini must run even though we were cancelled)
+            await asyncio.shield(new.fini())
+            raise
+        await old.fini()
+        return "swapped"
 
     # -- resolution --------------------------------------------------------
 

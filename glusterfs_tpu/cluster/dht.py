@@ -301,6 +301,27 @@ class DistributeLayer(Layer):
         i, cfd = self._fd_target(fd)
         return await self.children[i].ftruncate(cfd, size, xdata)
 
+    async def fallocate(self, fd: FdObj, mode: int, offset: int,
+                        length: int, xdata: dict | None = None):
+        i, cfd = self._fd_target(fd)
+        return await self.children[i].fallocate(cfd, mode, offset, length,
+                                                xdata)
+
+    async def discard(self, fd: FdObj, offset: int, length: int,
+                      xdata: dict | None = None):
+        i, cfd = self._fd_target(fd)
+        return await self.children[i].discard(cfd, offset, length, xdata)
+
+    async def zerofill(self, fd: FdObj, offset: int, length: int,
+                       xdata: dict | None = None):
+        i, cfd = self._fd_target(fd)
+        return await self.children[i].zerofill(cfd, offset, length, xdata)
+
+    async def seek(self, fd: FdObj, offset: int, what: str = "data",
+                   xdata: dict | None = None):
+        i, cfd = self._fd_target(fd)
+        return await self.children[i].seek(cfd, offset, what, xdata)
+
     async def release(self, fd: FdObj):
         ctx: DhtFdCtx | None = fd.ctx_del(self)
         if ctx:

@@ -161,6 +161,28 @@ class DisperseLayer(Layer):
         self._eager: dict[bytes, _EagerState] = {}  # gfid -> held window
         self._bg: set[asyncio.Task] = set()  # strong refs to drain tasks
 
+    def reconfigure(self, options: dict) -> None:
+        """Live option apply (ec_reconfigure, ec.c:254): codec backend /
+        batching options rebuild the codec; geometry (redundancy) is
+        immutable on a live volume."""
+        old = dict(self.opts)
+        super().reconfigure(options)
+        if self.opts["redundancy"] != self.r:
+            log.warning(3, "%s: redundancy is immutable live (%d -> %d "
+                        "ignored)", self.name, self.r,
+                        self.opts["redundancy"])
+            self.opts["redundancy"] = self.r
+        codec_keys = ("cpu-extensions", "stripe-cache-window",
+                      "stripe-cache-min-batch")
+        if any(self.opts[k] != old[k] for k in codec_keys):
+            from ..ops.batch import BatchingCodec
+
+            self.codec = BatchingCodec(
+                self.k, self.r, self.opts["cpu-extensions"],
+                window=self.opts["stripe-cache-window"] / 1e6,
+                min_batch=self.opts["stripe-cache-min-batch"])
+        self._batching = self.opts["stripe-cache"]
+
     # -- child state -------------------------------------------------------
 
     def notify(self, event: Event, source=None, data=None):
@@ -887,6 +909,12 @@ class DisperseLayer(Layer):
         allocation: fragment content and sizes never change); the
         extension region past EOF becomes encoded zeros via the window
         write path, all under the inode's lock."""
+        if mode & ~1:
+            # punch/zero modes carve inside stripes; route them through
+            # discard/zerofill, which do the edge RMW (the reference
+            # also rejects unsupported fallocate modes, ec_fallocate)
+            raise FopError(errno.EOPNOTSUPP,
+                           "EC fallocate supports only KEEP_SIZE")
         loc = Loc(fd.path, gfid=fd.gfid)
         async with self._lock(fd.gfid):
             st = await self._eager_begin(loc, fd.gfid)
@@ -933,7 +961,9 @@ class DisperseLayer(Layer):
                     if offset < head_end:
                         await self._zero_in_window(fd, loc, st, offset,
                                                    head_end - offset)
-                    tail_start = max(a_hi, offset)
+                    # a range inside ONE stripe is fully covered by the
+                    # head zeroing; start the tail after it
+                    tail_start = max(a_hi, head_end)
                     if tail_start < end:
                         await self._zero_in_window(fd, loc, st, tail_start,
                                                    end - tail_start)

@@ -165,6 +165,34 @@ class Graph:
             await layer.fini()
         self.active = False
 
+    def same_shape(self, specs: list[VolumeSpec]) -> bool:
+        """True when specs describe this graph's exact topology (names,
+        types, subvolume wiring) — the precondition for in-place
+        reconfigure (reference glusterfs_graph_reconfigure vs the full
+        graph switch, graph.c:980-1089)."""
+        if {s.name for s in specs} != set(self.by_name):
+            return False
+        for s in specs:
+            layer = self.by_name[s.name]
+            if layer.type_name != s.type_name:
+                return False
+            if [c.name for c in layer.children] != s.subvolumes:
+                return False
+        return True
+
+    def apply_volfile(self, text: str) -> bool:
+        """Live option reconfigure: same topology -> push each spec's
+        options through ``layer.reconfigure`` (validated, defaults
+        restored for dropped keys) and return True; topology change ->
+        False, the caller must swap graphs."""
+        specs = parse_volfile(text)
+        if not self.same_shape(specs):
+            return False
+        for s in specs:
+            self.by_name[s.name].reconfigure(s.options)
+        self.volfile_text = text
+        return True
+
     def statedump(self) -> dict:
         """Full-graph introspection (the SIGUSR1 statedump / .meta analog,
         reference statedump.c:831; tests read this like volume.rc parses

@@ -32,7 +32,7 @@ async def serve_brick(volfile_text: str, host: str = "127.0.0.1",
     """Activate a brick graph and serve it (returns the running server)."""
     graph = Graph.construct(volfile_text, top_name=top_name)
     await graph.activate()
-    server = BrickServer(graph.top, host, port)
+    server = BrickServer(graph.top, host, port, graph=graph)
     await server.start()
     if portfile:
         tmp = portfile + ".tmp"

@@ -67,6 +67,7 @@ class Glusterd:
         self._server: asyncio.AbstractServer | None = None
         self._txn_lock = asyncio.Lock()
         self._txn_holder: str | None = None
+        self._subs: dict[str, set] = {}  # volname -> subscribed writers
 
     # -- store (glusterd-store.c analog) -----------------------------------
 
@@ -133,6 +134,16 @@ class Glusterd:
                 xid, mtype, payload = wire.unpack(rec)
                 try:
                     method, kwargs = payload
+                    if method == "subscribe":
+                        # volfile-change notifications for this
+                        # connection (the reference's mgmt fetch-spec
+                        # callback channel, glusterfsd-mgmt.c)
+                        self._subs.setdefault(
+                            kwargs["name"], set()).add(writer)
+                        writer.write(wire.pack(xid, wire.MT_REPLY,
+                                               {"ok": True}))
+                        await writer.drain()
+                        continue
                     fn = getattr(self, "op_" + method.replace("-", "_"),
                                  None)
                     if fn is None:
@@ -154,10 +165,22 @@ class Glusterd:
                     break
         finally:
             self._writers.discard(writer)
+            for subs in self._subs.values():
+                subs.discard(writer)
             try:
                 writer.close()
             except Exception:
                 pass
+
+    def _notify_subscribers(self, name: str) -> None:
+        """Push volfile-modified to every subscribed client connection."""
+        frame = wire.pack(0, wire.MT_EVENT,
+                          {"event": "volfile-modified", "volume": name})
+        for w in list(self._subs.get(name, ())):
+            try:
+                w.write(frame)
+            except Exception:
+                self._subs[name].discard(w)
 
     # -- peers (glusterd-sm.c peer membership) -----------------------------
 
@@ -355,15 +378,65 @@ class Glusterd:
     async def op_volume_set(self, name: str, key: str, value: str) -> dict:
         if key not in volgen.OPTION_MAP:
             raise MgmtError(f"unknown option {key!r}")
-        await self._cluster_txn("volume-set",
-                                {"name": name, "key": key, "value": value})
-        return {"ok": True}
+        results = await self._cluster_txn(
+            "volume-set", {"name": name, "key": key, "value": value})
+        return {"ok": True,
+                "applied": [r.get("result", {}).get("applied", "stored")
+                            for r in results]}
 
-    def commit_volume_set(self, name: str, key: str, value: str) -> dict:
+    async def commit_volume_set(self, name: str, key: str, value: str) -> dict:
         vol = self._vol(name)
         vol.setdefault("options", {})[key] = value
         self._save()
-        return {name: {key: value}}
+        applied = "stored"
+        if vol["status"] == "started":
+            applied = await self._apply_to_bricks(vol)
+            self._notify_subscribers(name)
+        return {name: {key: value}, "applied": applied}
+
+    async def _apply_to_bricks(self, vol: dict) -> str:
+        """Push the regenerated brick volfiles to running local bricks:
+        same topology -> live __reconfigure__ over the brick RPC; shape
+        change (feature toggle) -> respawn on the same port (the
+        reference's volfile-compare + graph switch, graph.c:980-1089)."""
+        outcome = "reconfigured"
+        bdir = os.path.join(self.workdir, "bricks")
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid or b["name"] not in self.bricks:
+                continue
+            text = volgen.build_brick_volfile(vol, b)
+            ok = False
+            port = self.ports.get(b["name"])
+            if port:
+                ok = await self._brick_reconfigure(port, text)
+            if not ok:
+                self._kill_brick(b["name"])
+                await self._spawn_brick(vol, b, port=b.get("port"))
+                outcome = "respawned"
+            volfile = os.path.join(bdir, b["name"] + ".vol")
+            try:
+                with open(volfile, "w") as f:
+                    f.write(text)
+            except OSError:
+                pass
+        return outcome
+
+    @staticmethod
+    async def _brick_reconfigure(port: int, text: str) -> bool:
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            try:
+                writer.write(wire.pack(1, wire.MT_CALL,
+                                       ["__reconfigure__", [text], {}]))
+                await writer.drain()
+                rec = await asyncio.wait_for(wire.read_frame(reader), 5)
+                _, mtype, payload = wire.unpack(rec)
+                return mtype == wire.MT_REPLY and bool(payload.get("ok"))
+            finally:
+                writer.close()
+        except Exception:
+            return False
 
     def op_volume_info(self, name: str | None = None) -> dict:
         if name:
@@ -698,10 +771,43 @@ class MgmtClient:
         return payload
 
 
+async def _watch_volfile(client, host: str, port: int,
+                         volname: str) -> None:
+    """Hold a subscribed mgmt connection and re-fetch + apply the
+    volfile on change pushes (glusterfsd-mgmt.c fetch-spec callback)."""
+    while True:
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            try:
+                writer.write(wire.pack(1, wire.MT_CALL,
+                                       ["subscribe", {"name": volname}]))
+                await writer.drain()
+                await wire.read_frame(reader)  # subscribe ack
+                while True:
+                    rec = await wire.read_frame(reader)
+                    _, mtype, payload = wire.unpack(rec)
+                    if mtype == wire.MT_EVENT and isinstance(payload, dict) \
+                            and payload.get("event") == "volfile-modified":
+                        async with MgmtClient(host, port) as c:
+                            spec = await c.call("getspec", name=volname)
+                        how = await client.reload(spec["volfile"])
+                        log.info(12, "volfile for %s applied live (%s)",
+                                 volname, how)
+            finally:
+                writer.close()
+        except asyncio.CancelledError:
+            return
+        except Exception as e:
+            log.debug(13, "volfile watcher retry (%r)", e)
+            await asyncio.sleep(1.0)
+
+
 async def mount_volume(host: str, port: int, volname: str):
     """Fetch the client volfile from glusterd and build a mounted client
-    (the glfs_set_volfile_server + GETSPEC path, api/src/glfs-mgmt.c)."""
-    from ..api.glfs import Client
+    (the glfs_set_volfile_server + GETSPEC path, api/src/glfs-mgmt.c).
+    The mount stays subscribed to volfile changes and applies them live
+    (reconfigure or graph swap)."""
+    from ..api.glfs import Client, wait_connected
     from ..core.graph import Graph
 
     async with MgmtClient(host, port) as c:
@@ -709,17 +815,9 @@ async def mount_volume(host: str, port: int, volname: str):
     graph = Graph.construct(spec["volfile"])
     client = Client(graph)
     await client.mount()
-    # wait for the protocol clients to finish their handshakes (the
-    # reference blocks the mount until CHILD_UP reaches the top)
-    from ..protocol.client import ClientLayer
-
-    prot = [l for l in graph.by_name.values()
-            if isinstance(l, ClientLayer)]
-    deadline = asyncio.get_running_loop().time() + 15
-    while asyncio.get_running_loop().time() < deadline:
-        if all(p.connected for p in prot):
-            break
-        await asyncio.sleep(0.05)
+    await wait_connected(graph)
+    client.watchers.append(
+        asyncio.create_task(_watch_volfile(client, host, port, volname)))
     return client
 
 

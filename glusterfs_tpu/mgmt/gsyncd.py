@@ -63,13 +63,16 @@ class GeoRepWorker:
 
     # -- journal tailing ----------------------------------------------------
 
-    def _collect_new(self) -> list[dict]:
+    def _collect_new(self) -> tuple[list[dict], dict]:
         """Read records past each brick's (segment, offset) cursor.
-        Cursors only advance in self.state; the caller persists them
-        after the batch fully applies."""
-        out = []
+        Returns (records, advanced-cursor-proposal) WITHOUT touching
+        self.state — cursors move only after the batch fully applies,
+        so a failed replay is re-read next tick (replay is idempotent)."""
+        out: list[dict] = []
+        proposal = {d: dict(c)
+                    for d, c in self.state["cursors"].items()}
         for d in self.dirs:
-            cur = self.state["cursors"].setdefault(d, {})
+            cur = proposal.setdefault(d, {})
             try:
                 segs = sorted(int(n.rsplit(".", 1)[1])
                               for n in os.listdir(d)
@@ -98,7 +101,7 @@ class GeoRepWorker:
                 cur["segment"] = seq
                 cur["offset"] = off + complete
         out.sort(key=lambda r: r.get("ts", 0))
-        return out
+        return out, proposal
 
     # -- replay -------------------------------------------------------------
 
@@ -111,13 +114,13 @@ class GeoRepWorker:
         try:
             f_in = await self.primary.open(path)
         except FopError:
-            return False
+            return False  # vanished on primary: benign
         try:
             try:
                 f_out = await self.secondary.create(path)
             except FopError as e:
                 if e.err != errno.EEXIST:
-                    return False
+                    raise  # secondary trouble is a REAL failure: retry batch
                 f_out = await self.secondary.open(path, os.O_RDWR)
             try:
                 off = 0
@@ -145,10 +148,13 @@ class GeoRepWorker:
             except FopError:
                 pass
 
-    async def _replay(self, rec: dict) -> None:
+    async def _replay(self, rec: dict) -> bool:
+        """Apply one record to the secondary; False = hard failure (the
+        caller must NOT advance the cursors; the batch re-applies next
+        tick)."""
         op, path = rec.get("op", ""), rec.get("path", "")
         if not path:
-            return
+            return True
         try:
             if op in ("unlink",):
                 try:
@@ -212,6 +218,8 @@ class GeoRepWorker:
                     pass
         except FopError as e:
             log.warning(1, "replay %s %s failed: %s", op, path, e)
+            return False
+        return True
 
     _SYNC_OPS = {"create", "icreate", "put"}
 
@@ -233,12 +241,18 @@ class GeoRepWorker:
                 if not cls._is_sync(r) or last.get(r.get("path", "")) == i]
 
     async def process_once(self) -> int:
-        recs = self._collect_new()
+        recs, proposal = self._collect_new()
         if not recs:
             return 0
         batch = self._coalesce(recs)
+        ok = True
         for rec in batch:
-            await self._replay(rec)
+            ok = await self._replay(rec) and ok
+        if not ok:
+            # leave the cursors where they were: the whole batch is
+            # re-read and re-applied (idempotently) next tick
+            return 0
+        self.state["cursors"] = proposal
         self.state["last_ts"] = recs[-1].get("ts", 0)
         self.batches += 1
         self._save_state()

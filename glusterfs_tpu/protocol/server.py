@@ -78,10 +78,12 @@ class _ClientConn:
 class BrickServer:
     """TCP service for one brick graph top (the brick process core)."""
 
-    def __init__(self, top: Layer, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, top: Layer, host: str = "127.0.0.1", port: int = 0,
+                 graph=None):
         self.top = top
         self.host = host
         self.port = port
+        self.graph = graph  # enables live option reconfigure
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[_ClientConn] = set()
 
@@ -188,6 +190,15 @@ class BrickServer:
                 return wire.MT_REPLY, "pong"
             if fop_name == "__statedump__":
                 return wire.MT_REPLY, _jsonable(self.top.statedump())
+            if fop_name == "__reconfigure__":
+                # live option apply from glusterd (xlator.reconfigure
+                # path, graph.c glusterfs_graph_reconfigure); topology
+                # changes need a daemon respawn instead
+                if self.graph is None:
+                    return wire.MT_REPLY, {"ok": False,
+                                           "reason": "no graph handle"}
+                ok = self.graph.apply_volfile(args[0])
+                return wire.MT_REPLY, {"ok": ok}
             if fop_name not in _FOPS and fop_name not in _RPC_EXTRAS:
                 raise FopError(95, f"unknown fop {fop_name!r}")
             fn = getattr(self.top, fop_name, None)

@@ -1,0 +1,140 @@
+"""Live reconfigure: `volume set` reaches running bricks (in-place
+xlator.reconfigure or same-port respawn on shape change) and mounted
+clients (volfile-modified push -> option apply or graph swap) WITHOUT
+remount — the reference's graph.c:980-1089 volfile compare + switch.
+VERDICT next-round #10 done criterion."""
+
+import asyncio
+
+import pytest
+
+from glusterfs_tpu.core.graph import Graph
+
+EC_VOLFILE = """
+volume b0
+    type storage/posix
+    option directory {dir}
+end-volume
+
+volume top
+    type debug/io-stats
+    subvolumes b0
+end-volume
+"""
+
+
+def test_apply_volfile_reconfigures_in_place(tmp_path):
+    g = Graph.construct(EC_VOLFILE.format(dir=tmp_path / "b"))
+    newtext = EC_VOLFILE.format(dir=tmp_path / "b").replace(
+        "    type debug/io-stats",
+        "    type debug/io-stats\n    option latency-measurement on")
+    top = g.top
+    assert g.apply_volfile(newtext) is True
+    assert g.top is top  # same objects, options applied
+    assert g.by_name["top"].opts["latency-measurement"] is True
+
+
+def test_apply_volfile_rejects_topology_change(tmp_path):
+    g = Graph.construct(EC_VOLFILE.format(dir=tmp_path / "b"))
+    changed = EC_VOLFILE.format(dir=tmp_path / "b") + """
+volume extra
+    type performance/io-cache
+    subvolumes top
+end-volume
+"""
+    assert g.apply_volfile(changed) is False
+
+
+@pytest.mark.slow
+def test_e2e_volume_set_applies_live(tmp_path):
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient, mount_volume
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                bricks = [{"path": str(tmp_path / f"b{i}")}
+                          for i in range(6)]
+                await c.call("volume-create", name="lv", vtype="disperse",
+                             bricks=bricks, redundancy=2)
+                await c.call("volume-start", name="lv")
+
+            client = await mount_volume(d.host, d.port, "lv")
+            try:
+                ec = next(l for l in client.graph.by_name.values()
+                          if l.type_name == "cluster/disperse")
+                for _ in range(150):
+                    if all(ch.connected for ch in ec.children):
+                        break
+                    await asyncio.sleep(0.1)
+                assert ec.opts["read-policy"] == "round-robin"
+                await client.write_file("/live", b"before-reconfigure")
+
+                # 1) client-side option: reaches the mounted graph with
+                # no remount, same layer objects
+                async with MgmtClient(d.host, d.port) as c:
+                    r = await c.call("volume-set", name="lv",
+                                     key="disperse.read-policy",
+                                     value="first-k")
+                ok = False
+                for _ in range(100):
+                    if ec.opts["read-policy"] == "first-k":
+                        ok = True
+                        break
+                    await asyncio.sleep(0.1)
+                assert ok, "client never saw the option change"
+                assert client.graph.by_name[ec.name] is ec  # no swap
+
+                # 2) brick-side option: live reconfigure on running
+                # brick daemons (no respawn)
+                async with MgmtClient(d.host, d.port) as c:
+                    r = await c.call("volume-set", name="lv",
+                                     key="performance.io-thread-count",
+                                     value="4")
+                assert r["applied"] == ["reconfigured"]
+
+                # 3) topology change: enabling a perf layer swaps the
+                # client graph; existing mount keeps working
+                f = await client.open("/live")  # fd across the swap
+                async with MgmtClient(d.host, d.port) as c:
+                    await c.call("volume-set", name="lv",
+                                 key="performance.io-cache", value="on")
+                ok = False
+                for _ in range(150):
+                    if any(l.type_name == "performance/io-cache"
+                           for l in client.graph.by_name.values()):
+                        ok = True
+                        break
+                    await asyncio.sleep(0.1)
+                assert ok, "graph never swapped in io-cache"
+                # the pre-swap fd and the path both still serve
+                assert await f.read(100, 0) == b"before-reconfigure"
+                await f.close()
+                assert await client.read_file("/live") == \
+                    b"before-reconfigure"
+                await client.write_file("/after", b"post-swap write")
+                assert await client.read_file("/after") == b"post-swap write"
+
+                # 4) brick shape change: feature toggle respawns bricks
+                # and enforcement starts without volume restart
+                async with MgmtClient(d.host, d.port) as c:
+                    r = await c.call("volume-set", name="lv",
+                                     key="features.read-only", value="on")
+                assert r["applied"] == ["respawned"]
+                ec2 = next(l for l in client.graph.by_name.values()
+                           if l.type_name == "cluster/disperse")
+                for _ in range(150):  # client reconnects to same ports
+                    if all(ch.connected for ch in ec2.children):
+                        break
+                    await asyncio.sleep(0.1)
+                with pytest.raises(Exception):
+                    await client.write_file("/denied", b"x")
+                assert await client.read_file("/live") == \
+                    b"before-reconfigure"
+            finally:
+                await client.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
