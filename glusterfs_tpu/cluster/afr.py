@@ -567,13 +567,16 @@ class ReplicateLayer(Layer):
             window = int(self.opts["self-heal-window-size"])
             sfd = FdObj(ia.gfid, path=path, anonymous=True)
             off = 0
+            from ..features.bit_rot_stub import HEAL_WRITE
+
             while off < src_ia.size:
                 chunk = await self.children[src].readv(
                     sfd, min(window, src_ia.size - off), off)
                 await self._dispatch(
                     bad, "writev",
                     lambda i: ((FdObj(ia.gfid, path=path, anonymous=True),
-                                chunk, off), {}))
+                                chunk, off),
+                               {"xdata": {HEAL_WRITE: True}}))
                 off += len(chunk)
             await self._dispatch(bad, "truncate",
                                  lambda i: ((loc, src_ia.size), {}))

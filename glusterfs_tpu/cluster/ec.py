@@ -1171,10 +1171,13 @@ class DisperseLayer(Layer):
                     frags_in[j, : b.size] = b
                 data = await self._codec_decode(frags_in, rows_sorted)
                 frags_out = await self._codec_encode(data)
+                from ..features.bit_rot_stub import HEAL_WRITE
+
                 await self._dispatch(
                     bad, "writev",
                     lambda i: ((self._child_fd(fd, i),
-                                frags_out[i].tobytes(), f_off), {}))
+                                frags_out[i].tobytes(), f_off),
+                               {"xdata": {HEAL_WRITE: True}}))
                 off += length
             # align counters on healed bricks; clear dirty everywhere
             fix = {XA_VERSION: _pack_u64x2(*version),

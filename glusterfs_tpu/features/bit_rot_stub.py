@@ -1,0 +1,126 @@
+"""features/bit-rot-stub — brick-side quarantine for corrupted objects.
+
+Reference: xlators/features/bit-rot/src/stub/bit-rot-stub.c:29-40: the
+stub rides every brick, maintains the object signature/version xattrs
+for bitd (the signer/scrubber daemon) and fences access to objects the
+scrubber marked bad — a corrupted replica/fragment must never be served
+to a client or used as a heal source.
+
+TPU-build mechanisms: the signer stores
+``trusted.bit-rot.signature`` = JSON {sha256, ts}; the scrubber marks
+``trusted.bit-rot.bad-file``.  The stub keeps the quarantine set in
+memory (rebuilt at init through posix's xattr-scan virtual), denies
+readv on bad objects with EIO, and lifts the quarantine when the object
+is rewritten (the heal path: shd decodes from good bricks and writevs
+through this stub, which clears the marker and the stale signature).
+"""
+
+from __future__ import annotations
+
+import errno
+
+from ..core.fops import FopError
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+from ..core import gflog
+from ..storage.posix import XA_SCAN_PREFIX
+
+log = gflog.get_logger("bitrot-stub")
+
+XA_SIG = "trusted.bit-rot.signature"
+XA_BAD = "trusted.bit-rot.bad-file"
+# xdata flag the heal engines set on rebuild writes: only those may
+# touch (and ultimately unquarantine) a bad object — a client's partial
+# write over a corrupt file must not lift the fence
+HEAL_WRITE = "glusterfs_tpu.heal-write"
+
+
+@register("features/bit-rot-stub")
+class BitRotStubLayer(Layer):
+    OPTIONS = (
+        Option("bitrot", "bool", default="on"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._bad: set[bytes] = set()
+
+    async def init(self):
+        await super().init()
+        # restart-survival: reload the persisted quarantine
+        try:
+            r = await self.children[0].getxattr(
+                Loc("/"), XA_SCAN_PREFIX + XA_BAD)
+            self._bad = {bytes.fromhex(h) for h in
+                         r[XA_SCAN_PREFIX + XA_BAD].decode().split()}
+        except FopError:
+            self._bad = set()
+        if self._bad:
+            log.warning(1, "%s: %d quarantined objects", self.name,
+                        len(self._bad))
+
+    def _deny(self, gfid: bytes) -> bool:
+        return self.opts["bitrot"] and gfid in self._bad
+
+    # -- fencing -----------------------------------------------------------
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        if self._deny(fd.gfid):
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        return await self.children[0].readv(fd, size, offset, xdata)
+
+    async def rchecksum(self, fd: FdObj, offset: int, length: int,
+                        xdata: dict | None = None):
+        if self._deny(fd.gfid):
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        return await self.children[0].rchecksum(fd, offset, length, xdata)
+
+    async def writev(self, fd: FdObj, data: bytes, offset: int,
+                     xdata: dict | None = None):
+        healing = bool((xdata or {}).get(HEAL_WRITE))
+        if self._deny(fd.gfid) and not healing:
+            # a client writing over a corrupt object would neither fix
+            # it nor leave a heal trigger — keep it fenced (the
+            # reference only lets internal rebuild writes through)
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        ret = await self.children[0].writev(fd, data, offset, xdata)
+        if healing and fd.gfid in self._bad:
+            # rebuild in progress (under the cluster heal lock): lift
+            # the quarantine and drop the now-stale signature
+            self._bad.discard(fd.gfid)
+            gloc = Loc(fd.path, gfid=fd.gfid)
+            for key in (XA_BAD, XA_SIG):
+                try:
+                    await self.children[0].removexattr(gloc, key)
+                except FopError:
+                    pass
+        return ret
+
+    # -- quarantine bookkeeping (bitd writes markers through us) -----------
+
+    async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
+                       xdata: dict | None = None):
+        ret = await self.children[0].setxattr(loc, xattrs, flags, xdata)
+        if XA_BAD in xattrs:
+            gfid = loc.gfid
+            if gfid is None:
+                try:
+                    gfid = (await self.children[0].lookup(loc))[0].gfid
+                except FopError:
+                    gfid = None
+            if gfid is not None:
+                self._bad.add(gfid)
+                log.warning(2, "%s: quarantined %s (%s)", self.name,
+                            gfid.hex(), loc.path)
+        return ret
+
+    async def removexattr(self, loc: Loc, name: str,
+                          xdata: dict | None = None):
+        ret = await self.children[0].removexattr(loc, name, xdata)
+        if name == XA_BAD and loc.gfid is not None:
+            self._bad.discard(loc.gfid)
+        return ret
+
+    def dump_private(self) -> dict:
+        return {"quarantined": sorted(g.hex() for g in self._bad)}

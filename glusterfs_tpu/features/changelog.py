@@ -37,7 +37,8 @@ D_FOPS = {Fop.WRITEV, Fop.TRUNCATE, Fop.FTRUNCATE, Fop.FALLOCATE,
 M_FOPS = {Fop.SETATTR, Fop.FSETATTR, Fop.SETXATTR, Fop.FSETXATTR,
           Fop.REMOVEXATTR, Fop.FREMOVEXATTR}
 
-_INTERNAL_NS = ("trusted.ec.", "trusted.afr.", "glusterfs_tpu.")
+_INTERNAL_NS = ("trusted.ec.", "trusted.afr.", "trusted.bit-rot.",
+                "glusterfs_tpu.")
 
 
 @register("features/changelog")

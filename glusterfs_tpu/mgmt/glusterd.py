@@ -64,6 +64,7 @@ class Glusterd:
         self.ports: dict[str, int] = {}  # portmap: brickname -> port
         self.shd: dict[str, subprocess.Popen] = {}  # volname -> shd proc
         self.gsync: dict[str, subprocess.Popen] = {}  # volname -> gsyncd
+        self.bitd: dict[str, subprocess.Popen] = {}  # volname -> bitd
         self._server: asyncio.AbstractServer | None = None
         self._txn_lock = asyncio.Lock()
         self._txn_holder: str | None = None
@@ -94,13 +95,16 @@ class Glusterd:
         self._save()
         log.info(10, "glusterd %s on %s:%d (workdir %s)", self.uuid[:8],
                  self.host, self.port, self.workdir)
-        # restart-resume: bricks/shd/gsyncd of started volumes come back
+        # restart-resume: bricks/shd/gsyncd/bitd of started volumes
         for vol in self.state["volumes"].values():
             if vol.get("status") == "started":
                 await self._start_local_bricks(vol)
                 self._spawn_shd(vol)
                 if vol.get("georep", {}).get("status") == "started":
                     self._spawn_gsync(vol)
+                if volgen._bool(vol.get("options", {}).get(
+                        "features.bitrot", "off")):
+                    self._spawn_bitd(vol)
         return self.port
 
     async def stop(self) -> None:
@@ -108,6 +112,8 @@ class Glusterd:
         # session status: a restarted glusterd resumes started sessions
         for name in list(self.gsync):
             self._kill_gsync(name)
+        for name in list(self.bitd):
+            self._kill_bitd(name)
         for name in list(self.shd):
             self._kill_shd(name)
         for name in list(self.bricks):
@@ -335,6 +341,9 @@ class Glusterd:
         self._save()
         await self._start_local_bricks(vol)
         self._spawn_shd(vol)
+        if volgen._bool(vol.get("options", {}).get("features.bitrot",
+                                                   "off")):
+            self._spawn_bitd(vol)
         return {"started": name,
                 "ports": {b["name"]: self.ports[b["name"]]
                           for b in vol["bricks"]
@@ -357,6 +366,7 @@ class Glusterd:
         vol = self._vol(name)
         vol["status"] = "stopped"
         self._save()
+        self._kill_bitd(name)
         self._kill_shd(name)
         for b in vol["bricks"]:
             if b["node"] == self.uuid:
@@ -524,6 +534,81 @@ class Glusterd:
         if vol is None:
             raise MgmtError(f"no volume {name!r}")
         return vol
+
+    # -- bit-rot (glusterd-bitrot.c op handlers analog) --------------------
+
+    async def op_volume_bitrot(self, name: str, action: str) -> dict:
+        """enable / disable / status / scrub-status for bit-rot
+        detection on a volume."""
+        vol = self._vol(name)
+        if action == "enable":
+            await self._cluster_txn("volume-set", {
+                "name": name, "key": "features.bitrot", "value": "on"})
+            # spawn on EVERY node holding bricks, not just the originator
+            await self._cluster_txn("bitrot-ctl",
+                                    {"name": name, "action": "spawn"})
+            return {"ok": True, "enabled": name}
+        if action == "disable":
+            await self._cluster_txn("bitrot-ctl",
+                                    {"name": name, "action": "kill"})
+            await self._cluster_txn("volume-set", {
+                "name": name, "key": "features.bitrot", "value": "off"})
+            return {"ok": True, "disabled": name}
+        if action in ("status", "scrub-status"):
+            proc = self.bitd.get(name)
+            out = {"online": proc is not None and proc.poll() is None}
+            try:
+                with open(os.path.join(self.workdir,
+                                       f"bitd-{name}.json")) as f:
+                    out.update(json.load(f))
+            except (FileNotFoundError, ValueError):
+                pass
+            return out
+        raise MgmtError(f"unknown bitrot action {action!r}")
+
+    def commit_bitrot_ctl(self, name: str, action: str) -> dict:
+        vol = self._vol(name)
+        if action == "spawn":
+            if vol["status"] == "started":
+                self._spawn_bitd(vol)
+        else:
+            self._kill_bitd(name)
+        return {action: name}
+
+    def _spawn_bitd(self, vol: dict) -> None:
+        name = vol["name"]
+        proc = self.bitd.get(name)
+        if proc is not None and proc.poll() is None:
+            return
+        local = [(b["name"], self.ports.get(b["name"], 0))
+                 for b in vol["bricks"]
+                 if b["node"] == self.uuid and self.ports.get(b["name"])]
+        if not local:
+            return
+        opts = vol.get("options", {})
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        statusfile = os.path.join(self.workdir, f"bitd-{name}.json")
+        with open(os.path.join(self.workdir, f"bitd-{name}.log"),
+                  "ab") as logf:
+            self.bitd[name] = subprocess.Popen(
+                [sys.executable, "-m", "glusterfs_tpu.mgmt.bitd",
+                 "--bricks", ",".join(f"{n}:{p}" for n, p in local),
+                 "--quiesce", str(opts.get("bitrot.signer-quiesce", 120)),
+                 "--scrub-interval",
+                 str(opts.get("bitrot.scrub-interval", 60)),
+                 "--statusfile", statusfile],
+                env=env, stdout=subprocess.DEVNULL, stderr=logf)
+
+    def _kill_bitd(self, name: str) -> None:
+        proc = self.bitd.pop(name, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
 
     # -- geo-replication (glusterd-geo-rep.c session mgmt analog) ----------
     # Session ops run through the cluster txn so every node stores the

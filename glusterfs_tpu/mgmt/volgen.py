@@ -55,6 +55,10 @@ OPTION_MAP = {
     # consumed by glusterd's gsyncd spawner, not a graph layer
     "georep.sync-interval": ("mgmt/gsyncd", "interval"),
     "changelog.rollover-time": ("features/changelog", "rollover-time"),
+    "features.bitrot": ("features/bit-rot-stub", "__enable__"),
+    # consumed by glusterd's bitd spawner, not a graph layer
+    "bitrot.scrub-interval": ("mgmt/bitd", "scrub-interval"),
+    "bitrot.signer-quiesce": ("mgmt/bitd", "quiesce"),
     "features.cache-invalidation": ("features/upcall", "__enable__"),
     "features.cache-invalidation-timeout": ("features/upcall",
                                             "cache-invalidation-timeout"),
@@ -123,6 +127,11 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
                          layer_options(volinfo, "features/changelog"),
                          [top]))
         top = f"{name}-changelog"
+    # corruption fencing (bitd's quarantine marker enforcement)
+    if _enabled(volinfo, "features.bitrot", False):
+        out.append(_emit(f"{name}-bitrot-stub", "features/bit-rot-stub",
+                         {}, [top]))
+        top = f"{name}-bitrot-stub"
     out.append(_emit(f"{name}-locks", "features/locks", {}, [top]))
     top = f"{name}-locks"
     # pending-heal index on every brick (server_graph_table puts index

@@ -204,6 +204,15 @@ class BrickServer:
             fn = getattr(self.top, fop_name, None)
             if fn is None:
                 raise FopError(95, f"fop {fop_name!r} unsupported")
+            # release retires the fd-table entry too (long-lived
+            # connections like bitd's would otherwise grow it unboundedly)
+            if fop_name == "release" and args and \
+                    isinstance(args[0], wire.FdHandle):
+                fd = conn.fds.pop(args[0].fdid, None)
+                if fd is None:
+                    return wire.MT_REPLY, {}
+                await self.top.release(fd)
+                return wire.MT_REPLY, {}
             args = conn.resolve(args)
             kwargs = {k: conn.resolve(v) for k, v in (kwargs or {}).items()}
             # scope lk-owners to this connection (cross-client isolation)

@@ -49,6 +49,8 @@ META_DIR = ".glusterfs_tpu"
 # (reference glusterfs.gfid2path, posix-inode-fd-ops.c); the shd's
 # gfid -> healable-path step rides on it
 XA_GFID2PATH = "glusterfs_tpu.gfid2path"
+# virtual xattr prefix: list gfids carrying a given xattr key
+XA_SCAN_PREFIX = "glusterfs_tpu.scan."
 
 
 def _fop_errno(e: OSError) -> FopError:
@@ -588,6 +590,22 @@ class PosixLayer(Layer):
             if not loc.gfid:
                 raise FopError(errno.EINVAL, "gfid2path needs a gfid loc")
             return {name: self._gfid_resolve(loc.gfid).encode()}
+        if name and name.startswith(XA_SCAN_PREFIX):
+            # which gfids carry xattr <key>?  (newline-joined hexes) —
+            # lets brick layers rebuild in-memory state after a restart
+            # (bit-rot-stub's quarantine set)
+            key = name[len(XA_SCAN_PREFIX):]
+            hexes = []
+            for n in os.listdir(self._xattr_dir):
+                if not n.endswith(".json"):
+                    continue
+                try:
+                    with open(os.path.join(self._xattr_dir, n)) as f:
+                        if key in json.load(f):
+                            hexes.append(n[:-5])
+                except (OSError, ValueError):
+                    continue
+            return {name: "\n".join(hexes).encode()}
         gfid = self._require_gfid(self._loc_path(loc))
         cur = self._xattr_load(gfid)
         if name is None:

@@ -1,0 +1,220 @@
+"""Bit-rot detection: signer checksums quiescent objects, scrubber
+catches silent on-disk corruption (content changed, mtime not),
+quarantines the object brick-side, and the heal machinery rebuilds it —
+the tests/bitrot/*.t analog.  Reference: bit-rot-stub.c:29-40,
+bit-rot.c (signer), bit-rot-scrub.c (scrubber)."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from glusterfs_tpu.api.glfs import SyncClient
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import FdObj, Loc
+from glusterfs_tpu.features.bit_rot_stub import XA_BAD, XA_SIG
+from glusterfs_tpu.mgmt.bitd import BrickBitd
+from glusterfs_tpu.mgmt.shd import crawl_once
+from glusterfs_tpu.utils.volspec import ec_volfile
+
+K, R = 4, 2
+N = K + R
+STRIPE = K * 512
+
+BRICK_LAYERS = [("features/bit-rot-stub", {}), ("features/locks", {}),
+                ("features/index", {})]
+
+
+def _rand(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+def _corrupt_preserving_mtime(path, offset=0, nbytes=16):
+    """Silent disk corruption: bytes change, mtime does not."""
+    st = os.stat(path)
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        old = f.read(nbytes)
+        f.seek(offset)
+        f.write(bytes(b ^ 0xFF for b in old))
+    os.utime(path, (st.st_atime, st.st_mtime))
+
+
+@pytest.fixture
+def vol(tmp_path):
+    g = Graph.construct(
+        ec_volfile(tmp_path, N, R, brick_layers=BRICK_LAYERS))
+    c = SyncClient(g)
+    c.mount()
+    yield c, g.top, tmp_path
+    c.close()
+
+
+def test_signer_signs_quiescent_only(vol):
+    c, ec, base = vol
+    c.write_file("/s", _rand(STRIPE, seed=1).tobytes())
+    brick0 = ec.children[0]
+    hot = BrickBitd(brick0, quiesce=3600)
+    assert c._run(hot.sign_pass()) == 0  # too recent: not signed
+    quiet = BrickBitd(brick0, quiesce=0)
+    assert c._run(quiet.sign_pass()) == 1
+    x = c._run(brick0.getxattr(Loc("/s"), XA_SIG))
+    sig = json.loads(x[XA_SIG].decode())
+    assert "sha256" in sig and sig["ts"] > 0
+    # already signed: second pass is a no-op
+    assert c._run(quiet.sign_pass()) == 0
+    # clean scrub finds nothing
+    assert c._run(quiet.scrub_pass()) == []
+
+
+def test_scrub_quarantines_and_heal_recovers(vol):
+    """Corrupt one EC fragment on disk: the scrubber catches it, the
+    stub fences reads on that brick, EC serves from the others, the shd
+    rebuilds the fragment, and the quarantine lifts."""
+    c, ec, base = vol
+    data = _rand(2 * STRIPE, seed=2).tobytes()
+    c.write_file("/f", data)
+    bitds = [BrickBitd(ch, quiesce=0) for ch in ec.children]
+    for b in bitds:
+        assert c._run(b.sign_pass()) == 1
+
+    _corrupt_preserving_mtime(base / "brick0" / "f")
+    # unmodified-but-different content -> corruption, quarantined
+    assert c._run(bitds[0].scrub_pass()) == ["/f"]
+    assert c._run(bitds[1].scrub_pass()) == []  # other bricks clean
+    gfid = c.stat("/f").gfid
+    bad_fd = FdObj(gfid, path="/f", anonymous=True)
+    with pytest.raises(FopError):
+        c._run(ec.children[0].readv(bad_fd, 512, 0))
+    # plain writes are fenced too: only heal rebuilds may touch (and
+    # unquarantine) a bad object
+    with pytest.raises(FopError):
+        c._run(ec.children[0].writev(bad_fd, b"x" * 512, 0))
+    # the volume still serves correct data (EC rides the other bricks)
+    assert c.read_file("/f") == data
+    # the scrub marks fed the heal path: index entry + direction
+    info = c._run(ec.heal_info(Loc("/f")))
+    assert info["bad"] == [0]
+    report = c._run(crawl_once(c._client))
+    assert [h["path"] for h in report["healed"]] == ["/f"]
+    # quarantine lifted by the rewrite; brick 0 serves again
+    assert c._run(ec.children[0].readv(bad_fd, 512, 0))
+    ec.set_child_up(4, False)
+    ec.set_child_up(5, False)
+    assert c.read_file("/f") == data  # brick 0 must participate
+    ec.set_child_up(4, True)
+    ec.set_child_up(5, True)
+    info = c._run(ec.heal_info(Loc("/f")))
+    assert info["bad"] == [] and not info["dirty"]
+
+
+def test_quarantine_survives_brick_restart(tmp_path):
+    g = Graph.construct(
+        ec_volfile(tmp_path, N, R, brick_layers=BRICK_LAYERS))
+    c = SyncClient(g)
+    c.mount()
+    data = _rand(STRIPE, seed=3).tobytes()
+    c.write_file("/p", data)
+    bitd = BrickBitd(g.top.children[2], quiesce=0)
+    c._run(bitd.sign_pass())
+    _corrupt_preserving_mtime(tmp_path / "brick2" / "p")
+    assert c._run(bitd.scrub_pass()) == ["/p"]
+    gfid = c.stat("/p").gfid
+    c.close()
+    # "restart" the brick stacks: a fresh graph over the same dirs
+    g2 = Graph.construct(
+        ec_volfile(tmp_path, N, R, brick_layers=BRICK_LAYERS))
+    c2 = SyncClient(g2)
+    c2.mount()
+    try:
+        bad_fd = FdObj(gfid, path="/p", anonymous=True)
+        with pytest.raises(FopError):
+            c2._run(g2.top.children[2].readv(bad_fd, 512, 0))
+        assert c2.read_file("/p") == data
+    finally:
+        c2.close()
+
+
+@pytest.mark.slow
+def test_e2e_bitrot_detect_and_autoheal(tmp_path):
+    """Full managed loop: bitd signs and scrubs over the brick RPC,
+    corruption quarantines + feeds the index, the shd rebuilds, heal
+    info drains — no operator action after 'bitrot enable'."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient, mount_volume
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                bricks = [{"path": str(tmp_path / f"b{i}")}
+                          for i in range(6)]
+                await c.call("volume-create", name="bv", vtype="disperse",
+                             bricks=bricks, redundancy=2)
+                for k, v in (("features.bitrot", "on"),
+                             ("bitrot.signer-quiesce", "0"),
+                             ("bitrot.scrub-interval", "0.5"),
+                             ("cluster.heal-timeout", "1")):
+                    await c.call("volume-set", name="bv", key=k, value=v)
+                await c.call("volume-start", name="bv")
+                st = await c.call("volume-bitrot", name="bv",
+                                  action="status")
+                assert st["online"]
+
+            client = await mount_volume(d.host, d.port, "bv")
+            try:
+                ec = next(l for l in client.graph.by_name.values()
+                          if l.type_name == "cluster/disperse")
+                for _ in range(150):
+                    if all(ch.connected for ch in ec.children):
+                        break
+                    await asyncio.sleep(0.1)
+                data = os.urandom(2 * 2048)
+                await client.write_file("/doc", data)
+
+                async with MgmtClient(d.host, d.port) as c:
+                    signed = False
+                    for _ in range(60):
+                        st = await c.call("volume-bitrot", name="bv",
+                                          action="scrub-status")
+                        per = st.get("bricks", {})
+                        if sum(b.get("signed", 0)
+                               for b in per.values()) >= 6:
+                            signed = True
+                            break
+                        await asyncio.sleep(0.5)
+                    assert signed, f"bitd never signed: {st}"
+
+                _corrupt_preserving_mtime(tmp_path / "b3" / "doc")
+                async with MgmtClient(d.host, d.port) as c:
+                    caught = False
+                    for _ in range(60):
+                        st = await c.call("volume-bitrot", name="bv",
+                                          action="scrub-status")
+                        per = st.get("bricks", {})
+                        if any(b.get("corrupted")
+                               for b in per.values()):
+                            caught = True
+                            break
+                        await asyncio.sleep(0.5)
+                    assert caught, f"corruption never detected: {st}"
+
+                    healed = False
+                    for _ in range(60):
+                        info = await c.call("volume-heal", name="bv",
+                                            action="info")
+                        if info["count"] == 0:
+                            healed = True
+                            break
+                        await asyncio.sleep(0.5)
+                    assert healed, f"heal info never drained: {info}"
+                assert await client.read_file("/doc") == data
+            finally:
+                await client.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
