@@ -1,0 +1,62 @@
+"""Cluster event emission — the gf_event analog.
+
+Reference: libglusterfs/src/events.c:27-31 (gf_event): any daemon fires
+a fire-and-forget UDP datagram at the local glustereventsd, which fans
+events out to registered webhooks (events/src/glustereventsd.py).
+
+Here: JSON datagrams to the endpoint named by ``GFTPU_EVENTSD``
+(host:port) or :func:`configure`; unset means events are disabled and
+emission is a no-op.  Never raises, never blocks — losing an event must
+not fail a fop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+
+_endpoint: tuple[str, int] | None = None
+_sock: socket.socket | None = None
+
+
+def configure(endpoint: str | None) -> None:
+    """'host:port' enables emission in this process; None disables."""
+    global _endpoint, _sock
+    if not endpoint:
+        _endpoint = None
+        return
+    host, _, port = endpoint.rpartition(":")
+    try:
+        _endpoint = (host or "127.0.0.1", int(port))
+    except ValueError:  # malformed endpoint disables, never raises
+        _endpoint = None
+        return
+    if _sock is None:
+        _sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        _sock.setblocking(False)
+
+
+def _resolve() -> tuple[str, int] | None:
+    if _endpoint is not None:
+        return _endpoint
+    env = os.environ.get("GFTPU_EVENTSD")
+    if env:
+        configure(env)
+        return _endpoint
+    return None
+
+
+def gf_event(event: str, **fields) -> bool:
+    """Emit one event; returns whether a datagram was sent."""
+    target = _resolve()
+    if target is None:
+        return False
+    payload = {"event": event, "ts": time.time(), "pid": os.getpid()}
+    payload.update(fields)
+    try:
+        _sock.sendto(json.dumps(payload).encode(), target)
+        return True
+    except OSError:
+        return False

@@ -191,6 +191,10 @@ class BrickBitd:
             bad.append(path)
             log.warning(3, "CORRUPTION on %s (%s)", path,
                         self.layer.name)
+            from ..core.events import gf_event
+
+            gf_event("BITROT_BAD_FILE", path=path,
+                     brick=self.layer.name)
         self.corrupted += bad
         return bad
 

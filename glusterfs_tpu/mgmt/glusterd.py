@@ -37,6 +37,7 @@ import uuid
 from typing import Any
 
 from ..core import gflog
+from ..core.events import gf_event
 from ..core.fops import FopError
 from ..rpc import wire
 from . import volgen
@@ -314,6 +315,8 @@ class Glusterd:
     def commit_volume_create(self, volinfo: dict) -> dict:
         self.state["volumes"][volinfo["name"]] = volinfo
         self._save()
+        gf_event("VOLUME_CREATE", name=volinfo["name"],
+                 type=volinfo["type"])
         return {"created": volinfo["name"]}
 
     def stage_volume_create(self, volinfo: dict) -> None:
@@ -344,6 +347,7 @@ class Glusterd:
         if volgen._bool(vol.get("options", {}).get("features.bitrot",
                                                    "off")):
             self._spawn_bitd(vol)
+        gf_event("VOLUME_START", name=name)
         return {"started": name,
                 "ports": {b["name"]: self.ports[b["name"]]
                           for b in vol["bricks"]
@@ -371,6 +375,7 @@ class Glusterd:
         for b in vol["bricks"]:
             if b["node"] == self.uuid:
                 self._kill_brick(b["name"])
+        gf_event("VOLUME_STOP", name=name)
         return {"stopped": name}
 
     async def op_volume_delete(self, name: str) -> dict:
@@ -383,6 +388,7 @@ class Glusterd:
     def commit_volume_delete(self, name: str) -> dict:
         self.state["volumes"].pop(name, None)
         self._save()
+        gf_event("VOLUME_DELETE", name=name)
         return {"deleted": name}
 
     async def op_volume_set(self, name: str, key: str, value: str) -> dict:
@@ -534,6 +540,214 @@ class Glusterd:
         if vol is None:
             raise MgmtError(f"no volume {name!r}")
         return vol
+
+    # -- snapshots (glusterd-snapshot.c analog, store-level) ---------------
+    # The reference snapshots LVM thin volumes; the TPU-build store is a
+    # plain directory, so a snapshot is a barriered full copy of each
+    # brick store (SURVEY §7's store-level checkpoint), restorable onto
+    # a stopped volume.
+
+    async def op_snapshot_create(self, name: str, volume: str) -> dict:
+        self._vol(volume)
+        if name in self.state.setdefault("snaps", {}):
+            raise MgmtError(f"snapshot {name} exists")
+        # three cluster-wide phases, reference glusterd-snapshot.c order:
+        # barrier EVERY node's bricks, then copy everywhere, then
+        # release — a write landing between one node's copy and
+        # another's would otherwise make replicas/stripe-groups diverge
+        # inside one snapshot
+        await self._cluster_txn("snapshot-barrier",
+                                {"volume": volume, "on": True})
+        try:
+            await self._cluster_txn("snapshot-create",
+                                    {"name": name, "volume": volume})
+        finally:
+            await self._cluster_txn("snapshot-barrier",
+                                    {"volume": volume, "on": False})
+        return {"ok": True, "snapshot": name}
+
+    async def commit_snapshot_barrier(self, volume: str, on: bool) -> dict:
+        vol = self._vol(volume)
+        if vol["status"] != "started":
+            return {"barriered": False}
+        if on:
+            await self._set_barrier(vol, True)
+            await self._await_barrier_drain(vol)
+        else:
+            await self._set_barrier(vol, False, strict=False)
+        return {"barriered": on}
+
+    def stage_snapshot_create(self, name: str, volume: str) -> None:
+        # per-node duplicate check: snapshot state is per-node, and a
+        # half-committed earlier attempt must fail the retry here in
+        # stage — commit's failure cleanup may only ever delete
+        # directories this run created
+        if name in self.state.get("snaps", {}):
+            raise MgmtError(f"snapshot {name} exists on {self.uuid[:8]}")
+        if os.path.exists(os.path.join(self.workdir, "snaps", name)):
+            raise MgmtError(f"stale snapshot dir for {name!r}; "
+                            "delete the snapshot first")
+
+    async def commit_snapshot_create(self, name: str, volume: str) -> dict:
+        import shutil
+
+        from ..storage.posix import snapshot_copy
+
+        vol = self._vol(volume)
+        snapdir = os.path.join(self.workdir, "snaps", name)
+        os.makedirs(snapdir, exist_ok=True)
+        try:
+            taken = {}
+            for b in vol["bricks"]:
+                if b["node"] != self.uuid:
+                    continue
+                dst = os.path.join(snapdir, b["name"])
+                await asyncio.to_thread(snapshot_copy, b["path"], dst)
+                taken[b["name"]] = dst
+        except BaseException:
+            # no partial snapshot may survive: a retry of the same name
+            # would hit copytree FileExistsError with no way out.
+            # (Safe to remove the whole dir: stage proved it did not
+            # pre-exist, so everything under it is ours.)
+            await asyncio.to_thread(shutil.rmtree, snapdir,
+                                    ignore_errors=True)
+            raise
+        self.state.setdefault("snaps", {})[name] = {
+            "volume": volume, "ts": time.time(), "bricks": taken,
+        }
+        self._save()
+        gf_event("SNAPSHOT_CREATED", snapshot=name, volume=volume)
+        return {"snapped": sorted(taken)}
+
+    async def _set_barrier(self, vol: dict, on: bool,
+                           strict: bool = True) -> None:
+        """Arm/release the barrier on this node's running bricks via
+        live reconfigure (glusterd_snap_brick_barrier analog).  strict:
+        a failed arm raises — copying an unquiesced brick would produce
+        a torn snapshot reported as success.  Release is best-effort
+        (the barrier timeout unwedges a brick we could not reach)."""
+        tmp = dict(vol)
+        tmp["options"] = dict(vol.get("options", {}))
+        tmp["options"]["features.barrier"] = "on" if on else "off"
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid or b["name"] not in self.bricks:
+                continue
+            port = self.ports.get(b["name"])
+            ok = bool(port) and await self._brick_reconfigure(
+                port, volgen.build_brick_volfile(tmp, b))
+            if not ok and strict:
+                raise MgmtError(
+                    f"could not {'arm' if on else 'release'} barrier on "
+                    f"brick {b['name']}")
+
+    async def _await_barrier_drain(self, vol: dict,
+                                   timeout: float = 10.0) -> None:
+        """Wait until every running brick's barrier layer reports zero
+        in-flight gated fops (writes that passed the gate before it was
+        armed are still mutating the store; copying under them tears
+        the snapshot)."""
+        deadline = time.monotonic() + timeout
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid or b["name"] not in self.bricks:
+                continue
+            port = self.ports.get(b["name"])
+            if not port:
+                continue
+            while True:
+                dump = await self._brick_statedump(port)
+                layers = (dump or {}).get("layers", {})
+                inflight = [l["private"].get("inflight", 0)
+                            for l in layers.values()
+                            if l.get("type") == "features/barrier"]
+                # a dump with no barrier layer would vacuously "drain";
+                # treat it as not-quiesced so the bug surfaces as a
+                # timeout, not a torn snapshot
+                if dump is not None and inflight and \
+                        all(n == 0 for n in inflight):
+                    break
+                if time.monotonic() > deadline:
+                    raise MgmtError(
+                        f"brick {b['name']} did not quiesce in "
+                        f"{timeout:.0f}s")
+                await asyncio.sleep(0.02)
+
+    @staticmethod
+    async def _brick_statedump(port: int) -> dict | None:
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            try:
+                writer.write(wire.pack(1, wire.MT_CALL,
+                                       ["__statedump__", [], {}]))
+                await writer.drain()
+                rec = await asyncio.wait_for(wire.read_frame(reader), 5)
+                _, mtype, payload = wire.unpack(rec)
+                return payload if mtype == wire.MT_REPLY else None
+            finally:
+                writer.close()
+        except Exception:
+            return None
+
+    def op_snapshot_list(self, volume: str | None = None) -> dict:
+        snaps = self.state.get("snaps", {})
+        out = {n: {"volume": s["volume"], "ts": s["ts"],
+                   "bricks": sorted(s["bricks"])}
+               for n, s in snaps.items()
+               if volume is None or s["volume"] == volume}
+        return {"snapshots": out}
+
+    async def op_snapshot_delete(self, name: str) -> dict:
+        if name not in self.state.get("snaps", {}):
+            raise MgmtError(f"no snapshot {name!r}")
+        await self._cluster_txn("snapshot-delete", {"name": name})
+        return {"ok": True}
+
+    async def commit_snapshot_delete(self, name: str) -> dict:
+        import shutil
+
+        snap = self.state.get("snaps", {}).pop(name, None)
+        self._save()
+        if snap:
+            await asyncio.to_thread(
+                shutil.rmtree, os.path.join(self.workdir, "snaps", name),
+                ignore_errors=True)
+        return {"deleted": name}
+
+    async def op_snapshot_restore(self, name: str) -> dict:
+        snap = self.state.get("snaps", {}).get(name)
+        if snap is None:
+            raise MgmtError(f"no snapshot {name!r}")
+        vol = self._vol(snap["volume"])
+        if vol["status"] == "started":
+            raise MgmtError("stop the volume before restore")
+        await self._cluster_txn("snapshot-restore", {"name": name})
+        return {"ok": True, "restored": snap["volume"]}
+
+    async def commit_snapshot_restore(self, name: str) -> dict:
+        import shutil
+
+        from ..storage.posix import rebuild_identity
+
+        snap = self.state.get("snaps", {}).get(name)
+        if snap is None:
+            return {"restored": []}
+        vol = self._vol(snap["volume"])
+        restored = []
+
+        def _restore_one(src: str, dst: str) -> None:
+            shutil.rmtree(dst, ignore_errors=True)
+            shutil.copytree(src, dst, symlinks=True)
+            # a file-level copy changes every inode: rebind the gfid
+            # identity store and handle farm onto the copied files
+            rebuild_identity(dst)
+
+        for b in vol["bricks"]:
+            src = snap["bricks"].get(b["name"])
+            if b["node"] != self.uuid or not src:
+                continue
+            await asyncio.to_thread(_restore_one, src, b["path"])
+            restored.append(b["name"])
+        return {"restored": restored}
 
     # -- bit-rot (glusterd-bitrot.c op handlers analog) --------------------
 

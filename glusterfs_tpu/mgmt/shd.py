@@ -103,6 +103,11 @@ async def crawl_once(client) -> dict:
             key = "skipped" if res.get("skipped") else "healed"
             report[key].append({"path": path, "gfid": hexgfid,
                                 "bricks": res.get("healed", [])})
+            if key == "healed":
+                from ..core.events import gf_event
+
+                gf_event("HEAL_COMPLETE", path=path, gfid=hexgfid,
+                         bricks=res.get("healed", []))
     return report
 
 

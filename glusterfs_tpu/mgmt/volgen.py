@@ -55,6 +55,8 @@ OPTION_MAP = {
     # consumed by glusterd's gsyncd spawner, not a graph layer
     "georep.sync-interval": ("mgmt/gsyncd", "interval"),
     "changelog.rollover-time": ("features/changelog", "rollover-time"),
+    "features.barrier": ("features/barrier", "barrier"),
+    "features.barrier-timeout": ("features/barrier", "barrier-timeout"),
     "features.bitrot": ("features/bit-rot-stub", "__enable__"),
     # consumed by glusterd's bitd spawner, not a graph layer
     "bitrot.scrub-interval": ("mgmt/bitd", "scrub-interval"),
@@ -148,6 +150,11 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
                      layer_options(volinfo, "performance/io-threads"),
                      [top]))
     top = f"{name}-io-threads"
+    # snapshot quiesce gate — ALWAYS present (arming rides live
+    # reconfigure; a gated layer would force a brick respawn to arm)
+    out.append(_emit(f"{name}-barrier", "features/barrier",
+                     layer_options(volinfo, "features/barrier"), [top]))
+    top = f"{name}-barrier"
     if _enabled(volinfo, "features.quota", False):
         out.append(_emit(f"{name}-quota", "features/quota",
                          layer_options(volinfo, "features/quota"), [top]))

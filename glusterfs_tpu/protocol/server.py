@@ -189,7 +189,10 @@ class BrickServer:
             if fop_name == "__ping__":
                 return wire.MT_REPLY, "pong"
             if fop_name == "__statedump__":
-                return wire.MT_REPLY, _jsonable(self.top.statedump())
+                # full-graph dump (has "layers") when the daemon handed
+                # us the graph; bare top-layer dump otherwise
+                src = self.graph if self.graph is not None else self.top
+                return wire.MT_REPLY, _jsonable(src.statedump())
             if fop_name == "__reconfigure__":
                 # live option apply from glusterd (xlator.reconfigure
                 # path, graph.c glusterfs_graph_reconfigure); topology

@@ -69,6 +69,129 @@ except (OSError, AttributeError):  # non-Linux: posix_fallocate fallback
     _libc_fallocate = None
 
 
+def split_gfid_record(content: str) -> tuple[str, str]:
+    """Parse a gfid record -> (inokey, relpath).  Modern records are
+    'dev:ino\\nrelpath' with a possibly-EMPTY key line (root is recorded
+    before its first bind); legacy single-line records are the path
+    alone (paths may legally contain newlines, which is why the key
+    comes first and is validated, not the path)."""
+    inokey, sep, relpath = content.partition("\n")
+    if not sep:
+        return "", content  # legacy single-line path
+    if inokey and (":" not in inokey
+                   or not inokey.replace(":", "").isdigit()):
+        return "", content  # legacy path that itself contains newlines
+    return inokey, relpath
+
+
+def rebuild_identity(root: str) -> int:
+    """Re-key a brick store's identity after a file-level copy (snapshot
+    restore): the dev:ino sidecars and the handle hardlink farm both
+    refer to the ORIGINAL inodes, so every gfid would resolve stale and
+    lookups would mint fresh gfids over the copied xattrs.  Walk the
+    gfid records, rebind each to the copied file, and rebuild the
+    handles.  Returns the number of rebound objects.  (The reference
+    avoids this by snapshotting at the block layer — LVM preserves
+    inodes; a store-level copy cannot.)"""
+    gfid_dir = os.path.join(root, META_DIR, "gfid")
+    xattr_dir = os.path.join(root, META_DIR, "xattr")
+    handle_dir = os.path.join(root, META_DIR, "handle")
+    if not os.path.isdir(gfid_dir):
+        return 0
+    for d, pred in ((xattr_dir, lambda n: n.startswith("ino-")),
+                    (handle_dir, lambda n: True)):
+        if os.path.isdir(d):
+            for n in os.listdir(d):
+                if pred(n):
+                    try:
+                        os.unlink(os.path.join(d, n))
+                    except OSError:
+                        pass
+    os.makedirs(handle_dir, exist_ok=True)
+    count = 0
+    for hexg in os.listdir(gfid_dir):
+        if hexg.endswith(".tmp"):
+            continue
+        rec = os.path.join(gfid_dir, hexg)
+        try:
+            with open(rec) as f:
+                _, relpath = split_gfid_record(f.read())
+        except OSError:
+            continue
+        ap = os.path.normpath(os.path.join(root, relpath.lstrip("/")))
+        if not os.path.lexists(ap):
+            # object not in this copy: drop the orphaned identity
+            for p in (rec, os.path.join(xattr_dir, hexg + ".json")):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            continue
+        st = os.lstat(ap)
+        key = f"{st.st_dev}:{st.st_ino}"
+        with open(os.path.join(xattr_dir, "ino-" + key), "wb") as f:
+            f.write(bytes.fromhex(hexg))
+        with open(rec + ".tmp", "w") as f:
+            f.write(key + "\n" + relpath)
+        os.replace(rec + ".tmp", rec)
+        if not os.path.isdir(ap):
+            try:
+                os.link(ap, os.path.join(handle_dir, hexg),
+                        follow_symlinks=False)
+            except OSError:
+                pass
+        count += 1
+    return count
+
+
+def snapshot_copy(src_root: str, dst_root: str) -> None:
+    """Copy a brick store for a snapshot (glusterd-snapshot.c analog at
+    the store level).  The handle hardlink farm is skipped — in a
+    file-level copy it would duplicate every file's bytes; it is
+    rebuilt by :func:`rebuild_identity` at restore.  The copied gfid
+    records' path hints are then refreshed from a live dev:ino walk of
+    the source: hints go stale under directory renames (only the
+    renamed object's own record is rewritten), and a stale hint at
+    restore would silently drop that object's identity and versioning
+    xattrs.  Run under an armed barrier so the tree is stable."""
+    import shutil
+
+    def _skip_handles(d, names):
+        return names if os.path.normpath(d).endswith(
+            os.path.join(META_DIR, "handle")) else []
+
+    shutil.copytree(src_root, dst_root, ignore=_skip_handles,
+                    symlinks=True)
+    xattr_dir = os.path.join(src_root, META_DIR, "xattr")
+    gfid_dir = os.path.join(dst_root, META_DIR, "gfid")
+    if not os.path.isdir(xattr_dir) or not os.path.isdir(gfid_dir):
+        return
+    for dirpath, dirnames, filenames in os.walk(src_root):
+        if dirpath == src_root and META_DIR in dirnames:
+            dirnames.remove(META_DIR)
+        for nm in dirnames + filenames:
+            ap = os.path.join(dirpath, nm)
+            try:
+                st = os.lstat(ap)
+                with open(os.path.join(
+                        xattr_dir, f"ino-{st.st_dev}:{st.st_ino}"),
+                        "rb") as f:
+                    hexg = f.read(16).hex()
+            except OSError:
+                continue
+            rec = os.path.join(gfid_dir, hexg)
+            rel = "/" + os.path.relpath(ap, src_root)
+            try:
+                with open(rec) as f:
+                    inokey, relpath = split_gfid_record(f.read())
+            except OSError:
+                continue
+            if relpath != rel:
+                with open(rec + ".tmp", "w") as f:
+                    f.write(inokey + "\n" + rel)
+                os.replace(rec + ".tmp", rec)
+
+
 def _sys_fallocate(fdno: int, mode: int, offset: int, length: int) -> None:
     """fallocate(2) honoring mode flags (KEEP_SIZE, PUNCH_HOLE)."""
     if _libc_fallocate is None:
@@ -156,12 +279,7 @@ class PosixLayer(Layer):
         """-> (inokey, relpath); raises ESTALE when the gfid is unknown."""
         try:
             with open(self._gfid_path(gfid)) as f:
-                inokey, sep, relpath = f.read().partition("\n")
-            if not sep or ":" not in inokey or \
-                    not inokey.replace(":", "").isdigit():
-                # legacy single-line format: the whole record is the path
-                return "", inokey + sep + relpath
-            return inokey, relpath
+                return split_gfid_record(f.read())
         except FileNotFoundError:
             raise FopError(errno.ESTALE, f"no such gfid {gfid.hex()}") from None
 

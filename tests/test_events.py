@@ -1,0 +1,115 @@
+"""Events subsystem: gf_event UDP datagrams -> eventsd -> webhooks —
+the libglusterfs/src/events.c + glustereventsd.py analog."""
+
+import asyncio
+import json
+
+import pytest
+
+from glusterfs_tpu.core import events
+from glusterfs_tpu.mgmt.eventsd import EventsDaemon
+
+
+@pytest.fixture
+def noevents():
+    yield
+    events.configure(None)
+
+
+def test_emit_disabled_is_noop(noevents):
+    events.configure(None)
+    assert events.gf_event("NOPE") is False
+
+
+def test_eventsd_collects_and_serves_recent(noevents):
+    async def run():
+        d = EventsDaemon()
+        udp, _ = await d.start()
+        events.configure(f"127.0.0.1:{udp}")
+        assert events.gf_event("TEST_EVENT", volume="v1", n=7)
+        for _ in range(100):
+            if d.received:
+                break
+            await asyncio.sleep(0.02)
+        assert d.received == 1
+        ev = d.recent[-1]
+        assert ev["event"] == "TEST_EVENT"
+        assert ev["volume"] == "v1" and ev["n"] == 7
+        assert d._ctl_op("status", {})["received"] == 1
+        assert d._ctl_op("recent", {})["events"][-1]["event"] == \
+            "TEST_EVENT"
+        await d.stop()
+
+    asyncio.run(run())
+
+
+def test_webhook_delivery(noevents):
+    async def run():
+        got = []
+        hit = asyncio.Event()
+
+        async def handler(reader, writer):
+            data = await reader.read(65536)
+            head, _, body = data.partition(b"\r\n\r\n")
+            got.append(json.loads(body.decode()))
+            writer.write(b"HTTP/1.1 200 OK\r\nContent-Length: 0\r\n\r\n")
+            await writer.drain()
+            writer.close()
+            hit.set()
+
+        srv = await asyncio.start_server(handler, "127.0.0.1", 0)
+        hport = srv.sockets[0].getsockname()[1]
+        d = EventsDaemon()
+        udp, _ = await d.start()
+        d._ctl_op("webhook-add",
+                  {"url": f"http://127.0.0.1:{hport}/hook"})
+        events.configure(f"127.0.0.1:{udp}")
+        events.gf_event("WEBHOOK_ME", volume="w")
+        await asyncio.wait_for(hit.wait(), 5)
+        assert got[0]["event"] == "WEBHOOK_ME"
+        for _ in range(100):
+            st = d._ctl_op("status", {})
+            url = f"http://127.0.0.1:{hport}/hook"
+            if st["webhooks"][url]["delivered"] == 1:
+                break
+            await asyncio.sleep(0.02)
+        assert st["webhooks"][url]["delivered"] == 1
+        d._ctl_op("webhook-del", {"url": url})
+        assert d._ctl_op("status", {})["webhooks"] == {}
+        await d.stop()
+        srv.close()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_glusterd_lifecycle_emits_events(tmp_path, noevents):
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient
+
+    async def run():
+        ed = EventsDaemon()
+        udp, _ = await ed.start()
+        events.configure(f"127.0.0.1:{udp}")
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="ev", vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "b0")}],
+                             redundancy=0)
+                await c.call("volume-start", name="ev")
+                await c.call("volume-stop", name="ev")
+                await c.call("volume-delete", name="ev")
+            for _ in range(100):
+                if ed.received >= 4:
+                    break
+                await asyncio.sleep(0.05)
+            names = [e["event"] for e in ed.recent]
+            for want in ("VOLUME_CREATE", "VOLUME_START", "VOLUME_STOP",
+                         "VOLUME_DELETE"):
+                assert want in names, names
+        finally:
+            await d.stop()
+            await ed.stop()
+
+    asyncio.run(run())
