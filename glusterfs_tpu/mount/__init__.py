@@ -1,0 +1,1 @@
+"""Kernel-facing access layers (reference xlators/mount/)."""

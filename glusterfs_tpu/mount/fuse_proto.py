@@ -1,0 +1,188 @@
+"""FUSE kernel wire protocol: opcodes + struct codecs.
+
+The reference talks to ``/dev/fuse`` raw (it does NOT use libfuse —
+xlators/mount/fuse/src/fuse-bridge.c:6096 reads and decodes kernel
+messages itself, with struct layouts vendored from the kernel headers in
+contrib/fuse-include).  This module is the same idea for the TPU build:
+the layouts below are the public Linux UAPI (``include/uapi/linux/fuse.h``)
+for protocol 7.31, the minor we negotiate — fixed-version structs keep
+every codec a static ``struct`` format string.
+
+Only the subset of opcodes the bridge serves is defined; everything else
+gets ENOSYS and the kernel stops sending it.
+"""
+
+from __future__ import annotations
+
+import struct
+
+#: Protocol version we speak (kernel adapts down during INIT).
+FUSE_KERNEL_VERSION = 7
+FUSE_KERNEL_MINOR_VERSION = 31
+
+# -- opcodes (uapi fuse.h enum fuse_opcode) -------------------------------
+LOOKUP = 1
+FORGET = 2
+GETATTR = 3
+SETATTR = 4
+READLINK = 5
+SYMLINK = 6
+MKNOD = 8
+MKDIR = 9
+UNLINK = 10
+RMDIR = 11
+RENAME = 12
+LINK = 13
+OPEN = 14
+READ = 15
+WRITE = 16
+STATFS = 17
+RELEASE = 18
+FSYNC = 20
+SETXATTR = 21
+GETXATTR = 22
+LISTXATTR = 23
+REMOVEXATTR = 24
+FLUSH = 25
+INIT = 26
+OPENDIR = 27
+READDIR = 28
+RELEASEDIR = 29
+FSYNCDIR = 30
+ACCESS = 34
+CREATE = 35
+INTERRUPT = 36
+DESTROY = 38
+BATCH_FORGET = 42
+FALLOCATE = 43
+READDIRPLUS = 44
+RENAME2 = 45
+LSEEK = 46
+
+_OPCODES = (
+    "LOOKUP", "FORGET", "GETATTR", "SETATTR", "READLINK", "SYMLINK",
+    "MKNOD", "MKDIR", "UNLINK", "RMDIR", "RENAME", "LINK", "OPEN",
+    "READ", "WRITE", "STATFS", "RELEASE", "FSYNC", "SETXATTR",
+    "GETXATTR", "LISTXATTR", "REMOVEXATTR", "FLUSH", "INIT", "OPENDIR",
+    "READDIR", "RELEASEDIR", "FSYNCDIR", "ACCESS", "CREATE",
+    "INTERRUPT", "DESTROY", "BATCH_FORGET", "FALLOCATE", "READDIRPLUS",
+    "RENAME2", "LSEEK",
+)
+OPCODE_NAMES = {globals()[k]: k for k in _OPCODES}
+
+# -- INIT flags we care about ---------------------------------------------
+FUSE_ASYNC_READ = 1 << 0
+FUSE_BIG_WRITES = 1 << 5
+FUSE_DO_READDIRPLUS = 1 << 13
+FUSE_READDIRPLUS_AUTO = 1 << 14
+FUSE_PARALLEL_DIROPS = 1 << 18
+FUSE_MAX_PAGES = 1 << 22
+
+# -- SETATTR valid bits ----------------------------------------------------
+FATTR_MODE = 1 << 0
+FATTR_UID = 1 << 1
+FATTR_GID = 1 << 2
+FATTR_SIZE = 1 << 3
+FATTR_ATIME = 1 << 4
+FATTR_MTIME = 1 << 5
+FATTR_FH = 1 << 6
+FATTR_ATIME_NOW = 1 << 7
+FATTR_MTIME_NOW = 1 << 8
+
+# -- notifications (reverse path: daemon -> kernel) ------------------------
+NOTIFY_INVAL_INODE = 2
+NOTIFY_INVAL_ENTRY = 3
+
+# -- struct codecs ---------------------------------------------------------
+# fuse_in_header: len, opcode, unique, nodeid, uid, gid, pid, padding
+IN_HEADER = struct.Struct("<IIQQIIII")
+# fuse_out_header: len, error, unique
+OUT_HEADER = struct.Struct("<IiQ")
+# fuse_attr: ino size blocks atime mtime ctime atimensec mtimensec
+#            ctimensec mode nlink uid gid rdev blksize flags
+ATTR = struct.Struct("<QQQQQQIIIIIIIIII")
+# fuse_entry_out prefix: nodeid generation entry_valid attr_valid
+#                        entry_valid_nsec attr_valid_nsec  (+ ATTR)
+ENTRY_OUT = struct.Struct("<QQQQII")
+# fuse_attr_out prefix: attr_valid attr_valid_nsec dummy  (+ ATTR)
+ATTR_OUT = struct.Struct("<QII")
+# fuse_init_in prefix (7.36+ sends more; we parse the stable prefix)
+INIT_IN = struct.Struct("<IIII")  # major minor max_readahead flags
+# fuse_init_out (7.23+ layout, 64 bytes total with trailing unused[7])
+INIT_OUT = struct.Struct("<IIIIHHIIHHI")  # major minor max_readahead flags
+#                                   max_background congestion max_write
+#                                   time_gran max_pages map_alignment flags2
+INIT_OUT_PAD = 28
+# fuse_getattr_in: getattr_flags dummy fh
+GETATTR_IN = struct.Struct("<IIQ")
+# fuse_setattr_in: valid padding fh size lock_owner atime mtime ctime
+#                  atimensec mtimensec ctimensec mode unused4 uid gid unused5
+SETATTR_IN = struct.Struct("<IIQQQQQQIIIIIIII")
+# fuse_open_in: flags open_flags
+OPEN_IN = struct.Struct("<II")
+# fuse_open_out: fh open_flags padding
+OPEN_OUT = struct.Struct("<QII")
+# fuse_create_in: flags mode umask open_flags  (+ name)
+CREATE_IN = struct.Struct("<IIII")
+# fuse_mkdir_in: mode umask
+MKDIR_IN = struct.Struct("<II")
+# fuse_mknod_in: mode rdev umask padding
+MKNOD_IN = struct.Struct("<IIII")
+# fuse_rename_in / fuse_rename2_in
+RENAME_IN = struct.Struct("<Q")
+RENAME2_IN = struct.Struct("<QII")
+# fuse_link_in: oldnodeid
+LINK_IN = struct.Struct("<Q")
+# fuse_read_in: fh offset size read_flags lock_owner flags padding
+READ_IN = struct.Struct("<QQIIQII")
+# fuse_write_in: fh offset size write_flags lock_owner flags padding
+WRITE_IN = struct.Struct("<QQIIQII")
+# fuse_write_out: size padding
+WRITE_OUT = struct.Struct("<II")
+# fuse_release_in: fh flags release_flags lock_owner
+RELEASE_IN = struct.Struct("<QIIQ")
+# fuse_flush_in: fh unused padding lock_owner
+FLUSH_IN = struct.Struct("<QIIQ")
+# fuse_fsync_in: fh fsync_flags padding
+FSYNC_IN = struct.Struct("<QII")
+# fuse_access_in: mask padding
+ACCESS_IN = struct.Struct("<II")
+# fuse_getxattr_in: size padding   (also used for listxattr)
+GETXATTR_IN = struct.Struct("<II")
+GETXATTR_OUT = struct.Struct("<II")  # size padding
+# fuse_setxattr_in (pre-SETXATTR_EXT): size flags
+SETXATTR_IN = struct.Struct("<II")
+# fuse_forget_in: nlookup
+FORGET_IN = struct.Struct("<Q")
+# fuse_batch_forget_in: count dummy  (+ count * {nodeid nlookup})
+BATCH_FORGET_IN = struct.Struct("<II")
+FORGET_ONE = struct.Struct("<QQ")
+# fuse_interrupt_in: unique
+INTERRUPT_IN = struct.Struct("<Q")
+# fuse_fallocate_in: fh offset length mode padding
+FALLOCATE_IN = struct.Struct("<QQQII")
+# fuse_lseek_in: fh offset whence padding ; fuse_lseek_out: offset
+LSEEK_IN = struct.Struct("<QQII")
+LSEEK_OUT = struct.Struct("<Q")
+# fuse_kstatfs: blocks bfree bavail files ffree bsize namelen frsize
+#               padding spare[6]
+KSTATFS = struct.Struct("<QQQQQIIII24x")
+# fuse_dirent prefix: ino off namelen type  (+ name, 8-aligned)
+DIRENT = struct.Struct("<QQII")
+# fuse_notify_inval_inode_out: ino off len
+NOTIFY_INVAL_INODE_OUT = struct.Struct("<Qqq")
+# fuse_notify_inval_entry_out: parent namelen padding (+ name NUL)
+NOTIFY_INVAL_ENTRY_OUT = struct.Struct("<QII")
+
+
+def pack_dirent(ino: int, off: int, dtype: int, name: bytes) -> bytes:
+    """One fuse_dirent, name 8-byte aligned (uapi FUSE_DIRENT_ALIGN)."""
+    ent = DIRENT.pack(ino, off, len(name), dtype) + name
+    pad = (-len(ent)) % 8
+    return ent + b"\0" * pad
+
+
+def pack_direntplus(entry_out: bytes, ino: int, off: int, dtype: int,
+                    name: bytes) -> bytes:
+    """One fuse_direntplus = fuse_entry_out + aligned dirent."""
+    return entry_out + pack_dirent(ino, off, dtype, name)

@@ -442,8 +442,16 @@ class PosixLayer(Layer):
                      xdata: dict | None = None):
         path = self._loc_path(loc)
         try:
+            # brick fds are always RDWR regardless of the client's access
+            # mode (blindly OR-ing O_RDWR onto O_WRONLY yields the
+            # can-do-nothing accmode 3): EC/AFR RMW and heal need read
+            # access on write-only client fds, like the reference's ec
+            # open-flag rewrite.  O_APPEND is stripped too — Linux
+            # pwrite(2) ignores the offset on O_APPEND fds, which would
+            # send EC's positional fragment writes to EOF
             fdno = os.open(self._abs(path),
-                           flags | os.O_CREAT | os.O_RDWR, mode)
+                           (flags & ~(os.O_ACCMODE | os.O_APPEND))
+                           | os.O_CREAT | os.O_RDWR, mode)
         except OSError as e:
             raise _fop_errno(e)
         gfid = (xdata or {}).get("gfid-req") or gfid_new()
@@ -534,8 +542,18 @@ class PosixLayer(Layer):
     async def open(self, loc: Loc, flags: int = os.O_RDWR,
                    xdata: dict | None = None):
         path = self._loc_path(loc)
+        base = flags & ~(os.O_CREAT | os.O_ACCMODE | os.O_APPEND)
         try:
-            fdno = os.open(self._abs(path), flags & ~os.O_CREAT)
+            # same access-mode/O_APPEND normalization as create
+            # (directories reject O_RDWR; they come through opendir)
+            try:
+                fdno = os.open(self._abs(path), base | os.O_RDWR)
+            except PermissionError:
+                if flags & os.O_ACCMODE != os.O_RDONLY:
+                    raise
+                # a file the brick cannot write (0444 etc.): serve the
+                # client's read-only open rather than failing it
+                fdno = os.open(self._abs(path), base | os.O_RDONLY)
         except OSError as e:
             raise _fop_errno(e)
         fd = FdObj(self._require_gfid(path), flags, path=path)
@@ -685,10 +703,18 @@ class PosixLayer(Layer):
 
     async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
                        xdata: dict | None = None):
-        """Values are bytes on the wire (str accepted, stored utf-8)."""
+        """Values are bytes on the wire (str accepted, stored utf-8).
+        flags carry setxattr(2) semantics: XATTR_CREATE fails EEXIST on
+        a present key, XATTR_REPLACE fails ENODATA on a missing one
+        (lock-like xattr protocols through the mount depend on them)."""
         gfid = self._require_gfid(self._loc_path(loc))
         cur = self._xattr_load(gfid)
+        XATTR_CREATE, XATTR_REPLACE = 0x1, 0x2
         for k, v in xattrs.items():
+            if flags & XATTR_CREATE and k in cur:
+                raise FopError(errno.EEXIST, k)
+            if flags & XATTR_REPLACE and k not in cur:
+                raise FopError(errno.ENODATA, k)
             cur[k] = (v if isinstance(v, bytes) else str(v).encode()).hex()
         self._xattr_store(gfid, cur)
         return {}
