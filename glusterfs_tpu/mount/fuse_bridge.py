@@ -104,11 +104,11 @@ class FuseBridge:
         # attrs we return — without it, allow_other would let any local
         # user bypass file modes entirely (the bridge runs as root and
         # winds fops with its own identity)
-        data = (f"fd={self.dev_fd},rootmode=40755,"
+        data = os.fsencode((f"fd={self.dev_fd},rootmode=40755,"
                 f"user_id={os.getuid()},group_id={os.getgid()},"
-                f"allow_other,default_permissions").encode()
-        ret = _libc.mount(self.volname.encode(),
-                          self.mountpoint.encode(), b"fuse",
+                f"allow_other,default_permissions"))
+        ret = _libc.mount(os.fsencode(self.volname),
+                          os.fsencode(self.mountpoint), b"fuse",
                           MS_NOSUID | MS_NODEV, data)
         if ret != 0:
             err = ctypes.get_errno()
@@ -122,7 +122,7 @@ class FuseBridge:
     async def unmount(self) -> None:
         if self.dev_fd < 0:
             return
-        _libc.umount2(self.mountpoint.encode(), MNT_DETACH)
+        _libc.umount2(os.fsencode(self.mountpoint), MNT_DETACH)
         self._teardown()
         tasks = list(self._tasks)
         for t in tasks:
@@ -165,7 +165,9 @@ class FuseBridge:
             except BlockingIOError:
                 return
             except OSError as e:
-                if e.errno == errno.EINTR:
+                # ENOENT: a queued request was aborted before we read it
+                # (libfuse and fuse_thread_proc both retry on it)
+                if e.errno in (errno.EINTR, errno.ENOENT):
                     continue
                 # ENODEV: the kernel unmounted us (external umount)
                 self._teardown()
@@ -344,7 +346,7 @@ class FuseBridge:
 
     async def _op_lookup(self, nodeid: int, payload: bytes) -> bytes:
         parent = self._node(nodeid)
-        name = payload.split(b"\0", 1)[0].decode()
+        name = os.fsdecode(payload.split(b"\0", 1)[0])
         _, ia = await self._child(parent, name)
         return self._entry_out(nodeid, name, ia)
 
@@ -388,22 +390,22 @@ class FuseBridge:
 
     async def _op_readlink(self, nodeid: int, payload: bytes) -> bytes:
         target = await self._top.readlink(self._loc(self._node(nodeid)))
-        return target.encode()
+        return os.fsencode(target)
 
     async def _op_symlink(self, nodeid: int, payload: bytes) -> bytes:
         name, target = payload.split(b"\0")[:2]
         parent = self._node(nodeid)
         base = self._path(parent)
-        loc = Loc((base if base != "/" else "") + "/" + name.decode(),
+        loc = Loc((base if base != "/" else "") + "/" + os.fsdecode(name),
                   parent=parent.gfid)
-        ia = await self._top.symlink(target.decode(), loc)
-        return self._entry_out(nodeid, name.decode(), ia)
+        ia = await self._top.symlink(os.fsdecode(target), loc)
+        return self._entry_out(nodeid, os.fsdecode(name), ia)
 
     async def _op_mknod(self, nodeid: int, payload: bytes) -> bytes:
         mode, rdev, umask, _ = fp.MKNOD_IN.unpack_from(payload)
         if not stat_mod.S_ISREG(mode):
             raise FopError(errno.EOPNOTSUPP, "only regular files")
-        name = payload[fp.MKNOD_IN.size:].split(b"\0", 1)[0].decode()
+        name = os.fsdecode(payload[fp.MKNOD_IN.size:].split(b"\0", 1)[0])
         parent = self._node(nodeid)
         base = self._path(parent)
         loc = Loc((base if base != "/" else "") + "/" + name,
@@ -414,7 +416,7 @@ class FuseBridge:
 
     async def _op_mkdir(self, nodeid: int, payload: bytes) -> bytes:
         mode, umask = fp.MKDIR_IN.unpack_from(payload)
-        name = payload[fp.MKDIR_IN.size:].split(b"\0", 1)[0].decode()
+        name = os.fsdecode(payload[fp.MKDIR_IN.size:].split(b"\0", 1)[0])
         parent = self._node(nodeid)
         base = self._path(parent)
         loc = Loc((base if base != "/" else "") + "/" + name,
@@ -424,14 +426,14 @@ class FuseBridge:
 
     async def _op_unlink(self, nodeid: int, payload: bytes) -> bytes:
         parent = self._node(nodeid)
-        name = payload.split(b"\0", 1)[0].decode()
+        name = os.fsdecode(payload.split(b"\0", 1)[0])
         loc, _ = await self._child(parent, name)
         await self._top.unlink(loc)
         return b""
 
     async def _op_rmdir(self, nodeid: int, payload: bytes) -> bytes:
         parent = self._node(nodeid)
-        name = payload.split(b"\0", 1)[0].decode()
+        name = os.fsdecode(payload.split(b"\0", 1)[0])
         loc, _ = await self._child(parent, name)
         await self._top.rmdir(loc)
         return b""
@@ -440,15 +442,15 @@ class FuseBridge:
         oldname, newname = names.split(b"\0")[:2]
         parent = self._node(nodeid)
         newparent = self._node(newdir)
-        oldloc, ia = await self._child(parent, oldname.decode())
+        oldloc, ia = await self._child(parent, os.fsdecode(oldname))
         base = self._path(newparent)
-        newloc = Loc((base if base != "/" else "") + "/" + newname.decode(),
+        newloc = Loc((base if base != "/" else "") + "/" + os.fsdecode(newname),
                      parent=newparent.gfid)
         await self._top.rename(oldloc, newloc)
         nid = self._by_gfid.get(ia.gfid)
         if nid is not None and nid in self._nodes:  # keep paths current
             self._nodes[nid].parent = newdir
-            self._nodes[nid].name = newname.decode()
+            self._nodes[nid].name = os.fsdecode(newname)
         return b""
 
     async def _op_rename(self, nodeid: int, payload: bytes) -> bytes:
@@ -465,7 +467,7 @@ class FuseBridge:
 
     async def _op_link(self, nodeid: int, payload: bytes) -> bytes:
         (oldnodeid,) = fp.LINK_IN.unpack_from(payload)
-        name = payload[fp.LINK_IN.size:].split(b"\0", 1)[0].decode()
+        name = os.fsdecode(payload[fp.LINK_IN.size:].split(b"\0", 1)[0])
         oldnode = self._node(oldnodeid)
         parent = self._node(nodeid)
         base = self._path(parent)
@@ -485,7 +487,7 @@ class FuseBridge:
 
     async def _op_create(self, nodeid: int, payload: bytes) -> bytes:
         flags, mode, umask, _ = fp.CREATE_IN.unpack_from(payload)
-        name = payload[fp.CREATE_IN.size:].split(b"\0", 1)[0].decode()
+        name = os.fsdecode(payload[fp.CREATE_IN.size:].split(b"\0", 1)[0])
         parent = self._node(nodeid)
         base = self._path(parent)
         loc = Loc((base if base != "/" else "") + "/" + name,
@@ -546,18 +548,18 @@ class FuseBridge:
         rest = payload[fp.SETXATTR_IN.size:]
         name, rest = rest.split(b"\0", 1)
         await self._top.setxattr(self._loc(self._node(nodeid)),
-                                 {name.decode(): bytes(rest[:size])}, flags)
+                                 {os.fsdecode(name): bytes(rest[:size])}, flags)
         return b""
 
     async def _op_getxattr(self, nodeid: int, payload: bytes) -> bytes:
         size, _ = fp.GETXATTR_IN.unpack_from(payload)
-        name = payload[fp.GETXATTR_IN.size:].split(b"\0", 1)[0].decode()
+        name = os.fsdecode(payload[fp.GETXATTR_IN.size:].split(b"\0", 1)[0])
         d = await self._top.getxattr(self._loc(self._node(nodeid)), name)
         if not d or name not in d:
             raise FopError(errno.ENODATA, name)
         val = d[name]
         if isinstance(val, str):
-            val = val.encode()
+            val = os.fsencode(val)
         if size == 0:
             return fp.GETXATTR_OUT.pack(len(val), 0)
         if len(val) > size:
@@ -567,7 +569,7 @@ class FuseBridge:
     async def _op_listxattr(self, nodeid: int, payload: bytes) -> bytes:
         size, _ = fp.GETXATTR_IN.unpack_from(payload)
         d = await self._top.getxattr(self._loc(self._node(nodeid)), None)
-        blob = b"".join(k.encode() + b"\0" for k in sorted(d or {}))
+        blob = b"".join(os.fsencode(k) + b"\0" for k in sorted(d or {}))
         if size == 0:
             return fp.GETXATTR_OUT.pack(len(blob), 0)
         if len(blob) > size:
@@ -575,13 +577,13 @@ class FuseBridge:
         return blob
 
     async def _op_removexattr(self, nodeid: int, payload: bytes) -> bytes:
-        name = payload.split(b"\0", 1)[0].decode()
+        name = os.fsdecode(payload.split(b"\0", 1)[0])
         await self._top.removexattr(self._loc(self._node(nodeid)), name)
         return b""
 
     @staticmethod
     def _dirent_len(name: str, plus: bool) -> int:
-        n = fp.DIRENT.size + len(name.encode())
+        n = fp.DIRENT.size + len(os.fsencode(name))
         n += (-n) % 8
         if plus:
             n += fp.ENTRY_OUT.size + fp.ATTR.size
@@ -595,17 +597,17 @@ class FuseBridge:
         # listing once per rewind and serve chunks from the fd-cached
         # copy (re-listing per chunk would be O(n^2) in graph fops)
         cached = None if offset == 0 else fd.ctx_get(self)
-        if cached is None or cached[0] != plus:
-            if plus:
-                entries = await self._top.readdirp(fd, 0, 0)
-            else:
-                entries = await self._top.readdir(fd, 0, 0)
+        if cached is None:
+            # always readdirp: plain READDIR must still fill real
+            # d_ino/d_type (getdents consumers alias to ino 1 otherwise);
+            # the iatts are simply not turned into kernel entries then
+            entries = await self._top.readdirp(fd, 0, 0)
             listing: list[tuple[str, Iatt | None]] = [(".", None),
                                                       ("..", None)]
             listing += [(n, ia) for n, ia in entries]
-            fd.ctx_set(self, (plus, listing))
+            fd.ctx_set(self, listing)
         else:
-            listing = cached[1]
+            listing = cached
         out = bytearray()
         for idx in range(offset, len(listing)):
             name, ia = listing[idx]
@@ -619,18 +621,18 @@ class FuseBridge:
                 if plus:
                     ent_attr = b"\0" * (fp.ENTRY_OUT.size + fp.ATTR.size)
                     ent = fp.pack_direntplus(ent_attr, 1, nxt, dtype,
-                                             name.encode())
+                                             os.fsencode(name))
                 else:
-                    ent = fp.pack_dirent(1, nxt, dtype, name.encode())
+                    ent = fp.pack_dirent(1, nxt, dtype, os.fsencode(name))
             else:
                 dtype = _DTYPE.get(ia.ia_type, 0)
                 ino = _gfid_ino(ia.gfid)
                 if plus:
                     ent = fp.pack_direntplus(
                         self._entry_out(nodeid, name, ia), ino, nxt,
-                        dtype, name.encode())
+                        dtype, os.fsencode(name))
                 else:
-                    ent = fp.pack_dirent(ino, nxt, dtype, name.encode())
+                    ent = fp.pack_dirent(ino, nxt, dtype, os.fsencode(name))
             out += ent
         return bytes(out)
 

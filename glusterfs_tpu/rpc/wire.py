@@ -110,7 +110,9 @@ def encode_value(v: Any, out: bytearray) -> None:
         out += b
     elif isinstance(v, str):
         out.append(_T_STR)
-        b = v.encode()
+        # surrogateescape: filenames come off the kernel/disk as raw
+        # bytes; non-UTF-8 names must round-trip the wire losslessly
+        b = v.encode("utf-8", "surrogateescape")
         _enc_uint(out, len(b))
         out += b
     elif isinstance(v, (list, tuple)):
@@ -163,7 +165,8 @@ def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
         return bytes(buf[pos:pos + n]), pos + n
     if tag == _T_STR:
         n, pos = _dec_uint(buf, pos)
-        return bytes(buf[pos:pos + n]).decode(), pos + n
+        return bytes(buf[pos:pos + n]).decode("utf-8", "surrogateescape"), \
+            pos + n
     if tag == _T_LIST:
         n, pos = _dec_uint(buf, pos)
         out = []

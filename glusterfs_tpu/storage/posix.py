@@ -709,11 +709,10 @@ class PosixLayer(Layer):
         (lock-like xattr protocols through the mount depend on them)."""
         gfid = self._require_gfid(self._loc_path(loc))
         cur = self._xattr_load(gfid)
-        XATTR_CREATE, XATTR_REPLACE = 0x1, 0x2
         for k, v in xattrs.items():
-            if flags & XATTR_CREATE and k in cur:
+            if flags & os.XATTR_CREATE and k in cur:
                 raise FopError(errno.EEXIST, k)
-            if flags & XATTR_REPLACE and k not in cur:
+            if flags & os.XATTR_REPLACE and k not in cur:
                 raise FopError(errno.ENODATA, k)
             cur[k] = (v if isinstance(v, bytes) else str(v).encode()).hex()
         self._xattr_store(gfid, cur)
