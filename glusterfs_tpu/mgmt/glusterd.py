@@ -301,6 +301,14 @@ class Glusterd:
             "name": name, "type": vtype, "bricks": parsed,
             "redundancy": redundancy, "status": "created",
             "options": {}, "id": str(uuid.uuid4()),
+            # per-volume transport credentials, written by volgen into
+            # both brick and client volfiles (glusterd_auth_set_username
+            # trusted-volfile model); the mgmt pair goes ONLY into brick
+            # volfiles so glusterd's own calls pass any auth.allow list
+            "auth": {"username": str(uuid.uuid4()),
+                     "password": str(uuid.uuid4()),
+                     "mgmt-username": str(uuid.uuid4()),
+                     "mgmt-password": str(uuid.uuid4())},
         }
         if group_size:
             volinfo["group-size"] = group_size
@@ -394,6 +402,11 @@ class Glusterd:
     async def op_volume_set(self, name: str, key: str, value: str) -> dict:
         if key not in volgen.OPTION_MAP:
             raise MgmtError(f"unknown option {key!r}")
+        if key == "server.ssl" and volgen._bool(value):
+            opts = self._vol(name).get("options", {})
+            if not opts.get("ssl.cert"):
+                raise MgmtError("server.ssl needs ssl.cert set first "
+                                "(bricks would fail to start)")
         results = await self._cluster_txn(
             "volume-set", {"name": name, "key": key, "value": value})
         return {"ok": True,
@@ -424,7 +437,7 @@ class Glusterd:
             ok = False
             port = self.ports.get(b["name"])
             if port:
-                ok = await self._brick_reconfigure(port, text)
+                ok = await self._brick_reconfigure(vol, port, text)
             if not ok:
                 self._kill_brick(b["name"])
                 await self._spawn_brick(vol, b, port=b.get("port"))
@@ -438,19 +451,51 @@ class Glusterd:
         return outcome
 
     @staticmethod
-    async def _brick_reconfigure(port: int, text: str) -> bool:
+    async def _brick_call(vol: dict, port: int, name: str, args: list):
+        """One authenticated mgmt call to a local brick: SETVOLUME
+        handshake with the volume's generated credentials, then the
+        call (bricks refuse unauthenticated RPC)."""
+        ssl_ctx = None
+        opts = vol.get("options", {})
+        if volgen._bool(opts.get("server.ssl", "off")):
+            from ..rpc import tls
+
+            ssl_ctx = tls.client_context(opts.get("ssl.ca", ""),
+                                         opts.get("ssl.cert", ""),
+                                         opts.get("ssl.key", ""))
+        # short timeout: during an ssl on/off transition the brick may
+        # still speak the other protocol — fail fast to the respawn path
+        # instead of wedging the cluster txn on a mutual stall
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection("127.0.0.1", port, ssl=ssl_ctx), 5)
         try:
-            reader, writer = await asyncio.open_connection("127.0.0.1",
-                                                           port)
-            try:
-                writer.write(wire.pack(1, wire.MT_CALL,
-                                       ["__reconfigure__", [text], {}]))
-                await writer.drain()
-                rec = await asyncio.wait_for(wire.read_frame(reader), 5)
-                _, mtype, payload = wire.unpack(rec)
-                return mtype == wire.MT_REPLY and bool(payload.get("ok"))
-            finally:
-                writer.close()
+            auth = vol.get("auth") or {}
+            creds = {"username": auth.get("mgmt-username",
+                                          auth.get("username", "")),
+                     "password": auth.get("mgmt-password",
+                                          auth.get("password", ""))}
+            writer.write(wire.pack(1, wire.MT_CALL, [
+                "__handshake__", [b"glusterd", "", creds], {}]))
+            await writer.drain()
+            rec = await asyncio.wait_for(wire.read_frame(reader), 5)
+            _, mtype, payload = wire.unpack(rec)
+            if mtype != wire.MT_REPLY or not payload.get("ok"):
+                raise MgmtError("brick handshake refused")
+            writer.write(wire.pack(2, wire.MT_CALL, [name, args, {}]))
+            await writer.drain()
+            rec = await asyncio.wait_for(wire.read_frame(reader), 5)
+            _, mtype, payload = wire.unpack(rec)
+            return payload if mtype == wire.MT_REPLY else None
+        finally:
+            writer.close()
+
+    @classmethod
+    async def _brick_reconfigure(cls, vol: dict, port: int,
+                                 text: str) -> bool:
+        try:
+            payload = await cls._brick_call(vol, port,
+                                            "__reconfigure__", [text])
+            return bool(payload and payload.get("ok"))
         except Exception:
             return False
 
@@ -634,7 +679,7 @@ class Glusterd:
                 continue
             port = self.ports.get(b["name"])
             ok = bool(port) and await self._brick_reconfigure(
-                port, volgen.build_brick_volfile(tmp, b))
+                vol, port, volgen.build_brick_volfile(tmp, b))
             if not ok and strict:
                 raise MgmtError(
                     f"could not {'arm' if on else 'release'} barrier on "
@@ -654,7 +699,7 @@ class Glusterd:
             if not port:
                 continue
             while True:
-                dump = await self._brick_statedump(port)
+                dump = await self._brick_statedump(vol, port)
                 layers = (dump or {}).get("layers", {})
                 inflight = [l["private"].get("inflight", 0)
                             for l in layers.values()
@@ -671,20 +716,10 @@ class Glusterd:
                         f"{timeout:.0f}s")
                 await asyncio.sleep(0.02)
 
-    @staticmethod
-    async def _brick_statedump(port: int) -> dict | None:
+    @classmethod
+    async def _brick_statedump(cls, vol: dict, port: int) -> dict | None:
         try:
-            reader, writer = await asyncio.open_connection("127.0.0.1",
-                                                           port)
-            try:
-                writer.write(wire.pack(1, wire.MT_CALL,
-                                       ["__statedump__", [], {}]))
-                await writer.drain()
-                rec = await asyncio.wait_for(wire.read_frame(reader), 5)
-                _, mtype, payload = wire.unpack(rec)
-                return payload if mtype == wire.MT_REPLY else None
-            finally:
-                writer.close()
+            return await cls._brick_call(vol, port, "__statedump__", [])
         except Exception:
             return None
 
@@ -803,12 +838,26 @@ class Glusterd:
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
+        auth = vol.get("auth") or {}
+        if auth:
+            env["GFTPU_BITD_USERNAME"] = auth.get("mgmt-username",
+                                                  auth.get("username", ""))
+            env["GFTPU_BITD_PASSWORD"] = auth.get("mgmt-password",
+                                                  auth.get("password", ""))
         statusfile = os.path.join(self.workdir, f"bitd-{name}.json")
         with open(os.path.join(self.workdir, f"bitd-{name}.log"),
                   "ab") as logf:
             self.bitd[name] = subprocess.Popen(
                 [sys.executable, "-m", "glusterfs_tpu.mgmt.bitd",
                  "--bricks", ",".join(f"{n}:{p}" for n, p in local),
+                 *(["--ssl"] if volgen._bool(opts.get("server.ssl", "off"))
+                   else []),
+                 *(["--ssl-ca", opts["ssl.ca"]] if opts.get("ssl.ca")
+                   else []),
+                 *(["--ssl-cert", opts["ssl.cert"]] if opts.get("ssl.cert")
+                   else []),
+                 *(["--ssl-key", opts["ssl.key"]] if opts.get("ssl.key")
+                   else []),
                  "--quiesce", str(opts.get("bitrot.signer-quiesce", 120)),
                  "--scrub-interval",
                  str(opts.get("bitrot.scrub-interval", 60)),
@@ -975,7 +1024,10 @@ class Glusterd:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "glusterfs_tpu.daemon",
                  "--volfile", volfile, "--listen", str(port or 0),
-                 "--portfile", portfile, "--top", b["name"]],
+                 "--portfile", portfile,
+                 # serve the auth-carrying protocol/server top, not the
+                 # io-stats layer underneath it
+                 "--top", b["name"] + "-server"],
                 env=env, stdout=subprocess.DEVNULL, stderr=logf)
         self.bricks[b["name"]] = proc
         deadline = time.time() + 20

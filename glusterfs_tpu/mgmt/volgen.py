@@ -20,6 +20,14 @@ from typing import Any
 
 # volume-set key -> (layer type, option name)  (glusterd-volume-set.c map)
 OPTION_MAP = {
+    "auth.allow": ("protocol/server", "auth-allow"),
+    "auth.reject": ("protocol/server", "auth-reject"),
+    "server.ssl": ("protocol/server", "ssl"),
+    "client.ssl": ("protocol/client", "ssl"),
+    # cert/key/ca paths feed both transport ends (socket.c ssl_setup)
+    "ssl.cert": ("__ssl__", "ssl-cert"),
+    "ssl.key": ("__ssl__", "ssl-key"),
+    "ssl.ca": ("__ssl__", "ssl-ca"),
     "disperse.cpu-extensions": ("cluster/disperse", "cpu-extensions"),
     "disperse.read-policy": ("cluster/disperse", "read-policy"),
     "disperse.quorum-count": ("cluster/disperse", "quorum-count"),
@@ -170,7 +178,31 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
         top = f"{name}-trash"
     out.append(_emit(name, "debug/io-stats",
                      layer_options(volinfo, "debug/io-stats"), [top]))
+    top = name
+    # protocol/server top carries transport auth: per-volume generated
+    # credentials (trusted-volfile model) + admin auth.allow/reject +
+    # TLS (server xlator at the top of every reference brick volfile)
+    sopts = dict(layer_options(volinfo, "protocol/server"))
+    sopts.update(_ssl_options(volinfo))
+    auth = volinfo.get("auth") or {}
+    if auth:
+        sopts["auth-user"] = auth["username"]
+        sopts["auth-password"] = auth["password"]
+        if auth.get("mgmt-username"):
+            sopts["auth-mgmt-user"] = auth["mgmt-username"]
+            sopts["auth-mgmt-password"] = auth["mgmt-password"]
+    out.append(_emit(f"{name}-server", "protocol/server", sopts, [top]))
     return "\n".join(out)
+
+
+def _ssl_options(volinfo: dict) -> dict[str, Any]:
+    """ssl.cert/key/ca volume keys -> layer ssl-* options (both ends)."""
+    out = {}
+    for key, val in volinfo.get("options", {}).items():
+        m = OPTION_MAP.get(key)
+        if m and m[0] == "__ssl__":
+            out[m[1]] = val
+    return out
 
 
 def build_client_volfile(volinfo: dict,
@@ -187,7 +219,15 @@ def build_client_volfile(volinfo: dict,
         opts = {"remote-host": b["host"],
                 "remote-port": ports.get(b["name"], b.get("port", 0)),
                 "remote-subvolume": b["name"]}
+        auth = volinfo.get("auth") or {}
+        if auth:
+            opts["username"] = auth["username"]
+            opts["password"] = auth["password"]
         opts.update(layer_options(volinfo, "protocol/client"))
+        opts.update(_ssl_options(volinfo))
+        # a TLS brick implies TLS clients (admins set server.ssl once)
+        if _enabled(volinfo, "server.ssl", False):
+            opts["ssl"] = "on"
         out.append(_emit(cname, "protocol/client", opts, []))
         names.append(cname)
 

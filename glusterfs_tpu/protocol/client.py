@@ -43,6 +43,17 @@ class ClientLayer(Layer):
                description="declare peer dead after this (network.ping-timeout)"),
         Option("reconnect-interval", "time", default="0.5"),
         Option("call-timeout", "time", default="30"),
+        Option("username", "str", default="",
+               description="login credential presented at SETVOLUME "
+                           "(volgen injects the volume's generated pair)"),
+        Option("password", "str", default=""),
+        Option("ssl", "bool", default="off",
+               description="TLS to the brick (client.ssl / socket.c)"),
+        Option("ssl-ca", "str", default="",
+               description="CA bundle to verify the brick cert against"),
+        Option("ssl-cert", "str", default="",
+               description="client certificate (mutual TLS)"),
+        Option("ssl-key", "str", default=""),
     )
 
     def __init__(self, *args, **kw):
@@ -81,18 +92,44 @@ class ClientLayer(Layer):
                     log.debug(3, "%s: connect failed: %r", self.name, e)
             await asyncio.sleep(self.opts["reconnect-interval"])
 
+    def _ssl_context(self):
+        if not self.opts["ssl"]:
+            return None
+        from ..rpc import tls
+
+        return tls.client_context(self.opts["ssl-ca"],
+                                  self.opts["ssl-cert"],
+                                  self.opts["ssl-key"])
+
     async def _connect(self) -> None:
         host = self.opts["remote-host"]
         port = self.opts["remote-port"]
-        reader, writer = await asyncio.open_connection(host, port)
+        # reap finished read-loop tasks from failed attempts
+        self._tasks = [t for t in self._tasks if not t.done()]
+        reader, writer = await asyncio.open_connection(
+            host, port, ssl=self._ssl_context())
         self._reader, self._writer = reader, writer
         self._tasks.append(asyncio.create_task(self._read_loop(reader)))
-        # handshake = SETVOLUME (client-handshake.c)
-        res = await self._call("__handshake__",
-                               (self.identity,
-                                self.opts["remote-subvolume"]), {})
+        # handshake = SETVOLUME (client-handshake.c) with auth/login
+        # credentials (client_setvolume req dict auth keys)
+        creds = {}
+        if self.opts["username"]:
+            creds = {"username": self.opts["username"],
+                     "password": self.opts["password"]}
+        try:
+            res = await self._call("__handshake__",
+                                   (self.identity,
+                                    self.opts["remote-subvolume"], creds),
+                                   {})
+        except BaseException:
+            await self._drop_connection(notify=False)
+            raise
         if not res.get("ok"):
-            raise FopError(errno.EACCES, "handshake rejected")
+            # close NOW: the retry loop would otherwise leak one socket
+            # + read task per attempt on both ends
+            await self._drop_connection(notify=False)
+            raise FopError(errno.EACCES,
+                           res.get("error", "handshake rejected"))
         self.connected = True
         loop = asyncio.get_running_loop()
         self._last_pong = loop.time()

@@ -1,4 +1,4 @@
-"""protocol/server — serves a brick graph over TCP.
+"""protocol/server — serves a brick graph over TCP, with auth and TLS.
 
 Reference: xlators/protocol/server (actor table server-rpc-fops_v2.c:6132,
 per-client fd tables + resolver, auth).  Here: an asyncio TCP service in
@@ -11,20 +11,103 @@ Protocol: framed records (rpc/wire.py); a CALL carries
 ``[fop_name, args, kwargs]``; fd arguments travel as FdHandle; replies
 carry the fop return (or MT_ERROR + FopError).  The handshake
 (SETVOLUME analog) is the first call: ``__handshake__`` with the client
-identity and requested subvolume name.
+identity, requested subvolume name, and credentials; no other fop is
+dispatched before it succeeds.
+
+Auth mirrors xlators/protocol/auth: ``auth-reject``/``auth-allow`` are
+address pattern lists checked in that order (auth/addr), and
+``auth-user``/``auth-password`` is the login scheme (auth/login) —
+glusterd generates per-volume credentials that volgen writes into both
+the brick and client volfiles, the reference's trusted-volfile model.
+TLS is the socket.c SSL analog: ``ssl on`` plus cert/key/ca paths turns
+the listener into a TLS endpoint (ssl stdlib), with optional mutual
+auth when a CA is configured.
+
+The ``protocol/server`` graph layer itself is a passthrough that only
+carries these options (the reference's server xlator at the top of every
+brick volfile); BrickServer reads them from the graph top.
 """
 
 from __future__ import annotations
 
 import asyncio
+import fnmatch
+import hmac
+import ssl as ssl_mod
 from typing import Any
 
 from ..core.fops import Fop, FopError
-from ..core.layer import FdObj, Layer
+from ..core.layer import FdObj, Layer, register
+from ..core.options import Option
 from ..core import gflog
 from ..rpc import wire
 
 log = gflog.get_logger("protocol.server")
+
+
+@register("protocol/server")
+class ServerLayer(Layer):
+    """Option-carrying top of a brick graph (server xlator analog).
+
+    All fops pass through; BrickServer enforces the auth/TLS options
+    (the reference's server_setvolume + rpc-transport/socket do the
+    same outside the fop path, server.c auth via gf_authenticate)."""
+
+    OPTIONS = (
+        Option("auth-allow", "str", default="*",
+               description="comma-separated address patterns allowed to "
+                           "connect (auth.addr.<brick>.allow)"),
+        Option("auth-reject", "str", default="",
+               description="comma-separated address patterns refused "
+                           "(auth.addr.<brick>.reject; wins over allow)"),
+        Option("auth-user", "str", default="",
+               description="login username (auth.login.<brick>.allow)"),
+        Option("auth-password", "str", default="",
+               description="login password (auth.login.<user>.password)"),
+        Option("auth-mgmt-user", "str", default="",
+               description="management credential pair: written only "
+                           "into the brick volfile (never served to "
+                           "clients) so glusterd's reconfigure/statedump "
+                           "calls pass even when auth.allow excludes "
+                           "this host"),
+        Option("auth-mgmt-password", "str", default=""),
+        Option("ssl", "bool", default="off",
+               description="serve TLS on the brick port (socket.c SSL)"),
+        Option("ssl-cert", "str", default="",
+               description="PEM certificate path (ssl-cert-file)"),
+        Option("ssl-key", "str", default="",
+               description="PEM private-key path (ssl-private-key)"),
+        Option("ssl-ca", "str", default="",
+               description="PEM CA bundle; when set, client certificates "
+                           "are required and verified (ssl-ca-list)"),
+    )
+
+    _TRANSPORT_OPTS = ("ssl", "ssl-cert", "ssl-key", "ssl-ca")
+
+    def reconfigure(self, options: dict) -> None:
+        """TLS material is bound to the live listener at start(): a
+        cert/key/ca change cannot take effect in-place, so refuse the
+        live path — glusterd then falls back to a respawn, which picks
+        the new material up (cert rotation must not silently no-op)."""
+        from ..core.options import validate_options
+
+        new = validate_options(self.OPTIONS, options)
+        if any(new[k] != self.opts[k] for k in self._TRANSPORT_OPTS):
+            raise RuntimeError("TLS transport change needs a restart")
+        super().reconfigure(options)
+
+
+def _addr_match(addr: str, patterns: str) -> bool:
+    return any(fnmatch.fnmatch(addr, p.strip())
+               for p in patterns.split(",") if p.strip())
+
+
+def _ct_eq(a, b) -> bool:
+    """Constant-time credential comparison (timing side-channel)."""
+    if not isinstance(a, str) or not isinstance(b, str):
+        return False
+    return hmac.compare_digest(a.encode("utf-8", "surrogateescape"),
+                               b.encode("utf-8", "surrogateescape"))
 
 _FOPS = {f.value for f in Fop}
 # non-wire-fop methods a client may invoke remotely (heal entry points,
@@ -41,6 +124,8 @@ class _ClientConn:
         self.next_fd = 1
         self.identity = b""
         self.name = ""
+        self.authed = False
+        self.peer_addr = "?"
 
     def register_fd(self, fd: FdObj) -> wire.FdHandle:
         fdid = self.next_fd
@@ -87,9 +172,53 @@ class BrickServer:
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[_ClientConn] = set()
 
+    @property
+    def _auth_opts(self):
+        """Live options of the protocol/server top layer, if present
+        (read per-use so ``volume set`` reconfigure takes effect)."""
+        return self.top.opts if isinstance(self.top, ServerLayer) else {}
+
+    def _ssl_context(self) -> ssl_mod.SSLContext | None:
+        opts = self._auth_opts
+        if not opts or not opts["ssl"]:
+            return None
+        from ..rpc import tls
+
+        return tls.server_context(opts["ssl-cert"], opts["ssl-key"],
+                                  opts["ssl-ca"])
+
+    def _addr_ok(self, addr: str) -> bool:
+        """auth/addr: reject list wins, then the allow list must match."""
+        opts = self._auth_opts
+        if not opts:
+            return True
+        if opts["auth-reject"] and _addr_match(addr, opts["auth-reject"]):
+            return False
+        return _addr_match(addr, opts["auth-allow"])
+
+    def _is_mgmt(self, creds: dict) -> bool:
+        """The volfile-only mgmt pair: glusterd's own calls pass even
+        when the address lists exclude this host."""
+        opts = self._auth_opts
+        return bool(opts and opts["auth-mgmt-user"]
+                    and _ct_eq(creds.get("username"),
+                               opts["auth-mgmt-user"])
+                    and _ct_eq(creds.get("password"),
+                               opts["auth-mgmt-password"]))
+
+    def _login_ok(self, creds: dict) -> bool:
+        """auth/login: when the brick carries credentials, the client
+        must present the matching pair (server_setvolume
+        gf_authenticate)."""
+        opts = self._auth_opts
+        if not opts or not opts["auth-user"]:
+            return True
+        return (_ct_eq(creds.get("username"), opts["auth-user"])
+                and _ct_eq(creds.get("password"), opts["auth-password"]))
+
     async def start(self) -> int:
         self._server = await asyncio.start_server(
-            self._serve, self.host, self.port)
+            self._serve, self.host, self.port, ssl=self._ssl_context())
         self.port = self._server.sockets[0].getsockname()[1]
         # hand the event-push callback to any upcall layer in the graph
         # (the reference's upcall xlator calls back through rpcsvc the
@@ -131,15 +260,27 @@ class BrickServer:
 
     # -- connection handling ----------------------------------------------
 
+    # an unauthenticated peer must complete SETVOLUME within this long,
+    # or the transport is dropped (no fd squatting / pre-auth probing)
+    HANDSHAKE_DEADLINE = 10.0
+
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername") or ("?",)
         conn = _ClientConn(self, writer)
+        conn.peer_addr = str(peer[0])
         self.connections.add(conn)
         try:
             while True:
                 try:
-                    rec = await wire.read_frame(reader)
-                except (asyncio.IncompleteReadError, ConnectionError):
+                    if conn.authed:
+                        rec = await wire.read_frame(reader)
+                    else:
+                        rec = await asyncio.wait_for(
+                            wire.read_frame(reader),
+                            self.HANDSHAKE_DEADLINE)
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        asyncio.TimeoutError):
                     break
                 xid, mtype, payload = wire.unpack(rec)
                 if mtype != wire.MT_CALL:
@@ -150,6 +291,9 @@ class BrickServer:
                     await writer.drain()
                 except ConnectionError:
                     break
+                if (not conn.authed and isinstance(payload, list)
+                        and payload and payload[0] == "__handshake__"):
+                    break  # refused SETVOLUME: drop the transport
         finally:
             self.connections.discard(conn)
             await self._cleanup(conn)
@@ -183,9 +327,27 @@ class BrickServer:
         try:
             fop_name, args, kwargs = payload
             if fop_name == "__handshake__":
+                creds = args[2] if len(args) > 2 else {}
+                # mgmt pair (volfile-only, never served to clients)
+                # bypasses BOTH address lists — an over-broad
+                # auth.reject must not cut glusterd off from its bricks
+                ok = self._is_mgmt(creds or {}) or (
+                    self._addr_ok(conn.peer_addr)
+                    and self._login_ok(creds or {}))
+                if not ok:
+                    log.warning(7, "handshake refused from %s (%r)",
+                                conn.peer_addr, args[0])
+                    return wire.MT_REPLY, {"ok": False,
+                                           "error": "authentication failed"}
                 conn.identity = args[0]
                 conn.name = args[1] if len(args) > 1 else ""
+                conn.authed = True
                 return wire.MT_REPLY, {"volume": self.top.name, "ok": True}
+            if not conn.authed:
+                # SETVOLUME gates everything — pings included (no
+                # pre-auth liveness probing; server.c refuses requests
+                # from unknown clients)
+                raise FopError(13, "handshake required")  # EACCES
             if fop_name == "__ping__":
                 return wire.MT_REPLY, "pong"
             if fop_name == "__statedump__":

@@ -1,0 +1,236 @@
+"""Transport auth + TLS: addr allow/reject, login credentials, the
+handshake gate, and TLS bricks (reference xlators/protocol/auth,
+server_setvolume gf_authenticate, rpc-transport/socket SSL)."""
+
+import asyncio
+import subprocess
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.daemon import serve_brick
+from glusterfs_tpu.rpc import wire
+
+from .harness import BRICK_VOLFILE
+
+def _auth_brick(**opts) -> str:
+    lines = "".join(f"    option {k} {v}\n"
+                    for k, v in opts.items() if k != "dir" and v)
+    return BRICK_VOLFILE + (
+        "\nvolume srv\n    type protocol/server\n"
+        f"{lines}    subvolumes locks\nend-volume\n")
+
+
+
+CLIENT_VOLFILE = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume srv
+    option reconnect-interval 0.1
+{extra}end-volume
+"""
+
+
+async def _wait(pred, timeout=5.0):
+    for _ in range(int(timeout / 0.05)):
+        if pred():
+            return True
+        await asyncio.sleep(0.05)
+    return pred()
+
+
+def _mk_client(port: int, **opts) -> Graph:
+    extra = "".join(f"    option {k} {v}\n" for k, v in opts.items())
+    return Graph.construct(CLIENT_VOLFILE.format(port=port, extra=extra))
+
+
+def test_auth_addr_reject(tmp_path):
+    """auth.reject patterns drop the transport before any RPC."""
+    async def run():
+        server = await serve_brick(_auth_brick(**{
+            "auth-allow": "*", "auth-reject": "127.*"}).format(
+                dir=tmp_path / "b"))
+        g = _mk_client(server.port)
+        c = Client(g)
+        await c.mount()
+        assert not await _wait(lambda: g.top.connected, timeout=1.5)
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_auth_login(tmp_path):
+    """Brick credentials: wrong/missing pair refused, right pair works."""
+    async def run():
+        server = await serve_brick(_auth_brick(**{
+            "auth-user": "u1", "auth-password": "s3cret"}).format(
+                dir=tmp_path / "b"))
+        # no credentials -> handshake refused, never connects
+        g0 = _mk_client(server.port)
+        c0 = Client(g0)
+        await c0.mount()
+        assert not await _wait(lambda: g0.top.connected, timeout=1.5)
+        await c0.unmount()
+        # wrong password -> refused
+        g1 = _mk_client(server.port, username="u1", password="wrong")
+        c1 = Client(g1)
+        await c1.mount()
+        assert not await _wait(lambda: g1.top.connected, timeout=1.5)
+        await c1.unmount()
+        # right pair -> full fop access
+        g2 = _mk_client(server.port, username="u1", password="s3cret")
+        c2 = Client(g2)
+        await c2.mount()
+        assert await _wait(lambda: g2.top.connected)
+        await c2.write_file("/x", b"authed")
+        assert await c2.read_file("/x") == b"authed"
+        await c2.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_fop_before_handshake_refused(tmp_path):
+    """The SETVOLUME gate: raw fops without a handshake get EACCES."""
+    async def run():
+        server = await serve_brick(_auth_brick().format(dir=tmp_path / "b"))
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       server.port)
+        writer.write(wire.pack(1, wire.MT_CALL,
+                               ["mkdir", [], {"loc": None}]))
+        await writer.drain()
+        rec = await asyncio.wait_for(wire.read_frame(reader), 5)
+        _, mtype, payload = wire.unpack(rec)
+        assert mtype == wire.MT_ERROR
+        assert isinstance(payload, FopError) and payload.err == 13
+        writer.close()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+@pytest.fixture(scope="module")
+def tls_cert(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    cert, key = str(d / "brick.pem"), str(d / "brick.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "2", "-subj",
+         "/CN=gftpu-test"], check=True, capture_output=True)
+    return cert, key
+
+
+def test_tls_brick(tmp_path, tls_cert):
+    """TLS end-to-end: verified client works, plaintext client cannot."""
+    cert, key = tls_cert
+
+    async def run():
+        server = await serve_brick(_auth_brick(**{
+            "ssl": "on", "ssl-cert": cert, "ssl-key": key}).format(
+                dir=tmp_path / "b"))
+        # plaintext client never completes a handshake
+        g0 = _mk_client(server.port)
+        c0 = Client(g0)
+        await c0.mount()
+        assert not await _wait(lambda: g0.top.connected, timeout=1.5)
+        await c0.unmount()
+        # TLS client verifying the brick cert: full access
+        g1 = _mk_client(server.port, ssl="on", **{"ssl-ca": cert})
+        c1 = Client(g1)
+        await c1.mount()
+        assert await _wait(lambda: g1.top.connected)
+        await c1.write_file("/t", b"over tls")
+        assert await c1.read_file("/t") == b"over tls"
+        await c1.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_managed_volume_credentials(tmp_path):
+    """glusterd generates per-volume credentials; the served client
+    volfile carries them (trusted-volfile model) and a credential-less
+    hand-built client is refused."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient
+
+    async def run():
+        gd = Glusterd(str(tmp_path / "gd"))
+        await gd.start()
+        async with MgmtClient(gd.host, gd.port) as c:
+            bricks = [{"path": str(tmp_path / f"b{i}")} for i in range(3)]
+            await c.call("volume-create", name="av", vtype="disperse",
+                         bricks=bricks, redundancy=1)
+            await c.call("volume-start", name="av")
+            spec = (await c.call("getspec", name="av"))["volfile"]
+        vol = gd.state["volumes"]["av"]
+        auth = vol["auth"]
+        assert auth["username"] and auth["password"]
+        assert auth["username"] in spec and auth["password"] in spec
+        # the served volfile mounts and works
+        g = Graph.construct(spec)
+        cl = Client(g)
+        await cl.mount()
+        from glusterfs_tpu.core.layer import walk
+        subs = [l for l in walk(g.top) if l.type_name == "protocol/client"]
+        assert await _wait(lambda: all(l.connected for l in subs))
+        await cl.write_file("/f", b"managed")
+        assert await cl.read_file("/f") == b"managed"
+        await cl.unmount()
+        # a hand-built client with no credentials is refused
+        port = gd.ports["av-brick-0"]
+        g0 = Graph.construct(CLIENT_VOLFILE.format(port=port, extra="")
+                             .replace("remote-subvolume srv",
+                                      "remote-subvolume av-brick-0"))
+        c0 = Client(g0)
+        await c0.mount()
+        assert not await _wait(lambda: g0.top.connected, timeout=1.5)
+        await c0.unmount()
+        await gd.stop()
+
+    asyncio.run(run())
+
+
+def test_auth_allow_excludes_clients_not_glusterd(tmp_path):
+    """auth.allow that excludes this host locks clients out but
+    glusterd's mgmt calls (volfile-only mgmt pair) still reconfigure
+    bricks live."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient
+
+    async def run():
+        gd = Glusterd(str(tmp_path / "gd"))
+        await gd.start()
+        async with MgmtClient(gd.host, gd.port) as c:
+            bricks = [{"path": str(tmp_path / f"b{i}")} for i in range(3)]
+            await c.call("volume-create", name="lv", vtype="disperse",
+                         bricks=bricks, redundancy=1)
+            await c.call("volume-start", name="lv")
+            r = await c.call("volume-set", name="lv",
+                             key="auth.allow", value="10.42.*")
+            assert "reconfigured" in r["applied"]
+            # mgmt path still live-reconfigures (no respawn needed)
+            r = await c.call("volume-set", name="lv",
+                             key="disperse.read-policy",
+                             value="round-robin")
+            assert "reconfigured" in r["applied"]
+            spec = (await c.call("getspec", name="lv"))["volfile"]
+        # mgmt pair never reaches client volfiles
+        auth = gd.state["volumes"]["lv"]["auth"]
+        assert auth["mgmt-password"] not in spec
+        # a credentialed client from 127.0.0.1 is now refused by addr
+        g = Graph.construct(spec)
+        cl = Client(g)
+        await cl.mount()
+        from glusterfs_tpu.core.layer import walk
+        subs = [l for l in walk(g.top)
+                if l.type_name == "protocol/client"]
+        assert not await _wait(lambda: any(l.connected for l in subs),
+                               timeout=1.5)
+        await cl.unmount()
+        await gd.stop()
+
+    asyncio.run(run())

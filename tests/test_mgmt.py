@@ -34,9 +34,10 @@ def test_volgen_brick_volfile(tmp_path):
     vi = _volinfo(tmp_path)
     text = volgen.build_brick_volfile(vi, vi["bricks"][0])
     g = Graph.construct(text)
-    assert g.top.type_name == "debug/io-stats"
+    assert g.top.type_name == "protocol/server"
     types = [l.type_name for l in g.by_name.values()]
     assert "storage/posix" in types and "features/locks" in types
+    assert "debug/io-stats" in types
 
 
 def test_volgen_client_volfile(tmp_path):
