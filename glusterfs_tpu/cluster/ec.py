@@ -830,7 +830,13 @@ class DisperseLayer(Layer):
         # inconsistent until healed
         st.good = prev_good & ok
         if len(ok) < self._write_quorum():
-            raise FopError(errno.EIO,
+            # surface the bricks' dominant errno (ec_fop_prepare_answer
+            # groups answers and picks the most common op_errno) so
+            # EDQUOT/ENOSPC reach the caller instead of a generic EIO
+            errs = [r.err for r in res.values()
+                    if isinstance(r, FopError)]
+            err = Counter(errs).most_common(1)[0][0] if errs else errno.EIO
+            raise FopError(err,
                            f"{op} quorum lost ({len(ok)}/{self.n})")
         st.delta += 1
         st.candidates = sorted(st.good)

@@ -1,44 +1,103 @@
-"""features/quota — directory usage limits.
+"""features/quota — directory usage limits with persistent marker
+accounting.
 
-Reference: xlators/features/quota (7k LoC; quota.c:635 quota_check_limit)
-with marker-based contribution accounting.  Here: limits live in the
-layer (set via ``limit_set``/options or the ``trusted.glusterfs.quota.
-limit-set`` xattr); usage is computed on demand by walking the subtree
-and then maintained incrementally by write/truncate/unlink deltas —
-functionally the marker accounting without the persistent xattr climb."""
+Reference: xlators/features/quota (quota.c:635 quota_check_limit, the
+enforcer) + xlators/features/marker (marker.c:469 contribution
+accounting) + quotad (quotad-aggregator.c).  The reference splits the
+job three ways: marker maintains per-directory size xattrs on each
+brick, quota enforces limits, quotad aggregates across bricks.  Here
+the brick-side layer does marker+enforcement in one place:
+
+* usage per limited directory is tracked incrementally from
+  write/truncate/unlink deltas and **persisted** in the directory's
+  ``trusted.glusterfs.quota.size`` xattr (the marker analog) so it
+  survives brick restarts without a re-crawl;
+* backend bytes are scaled to logical bytes by ``usage-scale`` (volgen
+  sets K for a disperse brick, where a fragment holds 1/K of the file;
+  1 elsewhere) so limits mean the same thing on every volume type;
+* ``quota_usage`` is the aggregator RPC surface quotad polls
+  (quotad-aggregator.c lookup path).
+
+Limits arrive via the ``limits`` option (JSON path->bytes), pushed by
+glusterd through live reconfigure on ``volume quota limit-usage``.
+"""
 
 from __future__ import annotations
 
 import errno
+import json
 
 from ..core.fops import FopError
 from ..core.iatt import IAType
 from ..core.layer import FdObj, Layer, Loc, register
 from ..core.options import Option
+from ..core import gflog
+
+log = gflog.get_logger("features.quota")
 
 XA_LIMIT = "trusted.glusterfs.quota.limit-set"
+XA_SIZE = "trusted.glusterfs.quota.size"
 
 
 @register("features/quota")
 class QuotaLayer(Layer):
     OPTIONS = (
+        Option("limits", "str", default="{}",
+               description="JSON {path: hard-limit-bytes} (logical)"),
+        Option("usage-scale", "int", default=1,
+               description="backend->logical byte factor (K on a "
+                           "disperse brick; fragments hold 1/K)"),
         Option("default-soft-limit", "percent", default=80.0),
         Option("hard-timeout", "time", default="5"),
     )
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
-        self.limits: dict[str, int] = {}  # dir path -> bytes
-        self._usage: dict[str, int] = {}  # dir path -> bytes (tracked)
+        self.limits: dict[str, int] = {}  # dir path -> logical bytes
+        self._usage: dict[str, int] = {}  # dir path -> backend bytes
+        self._soft_warned: set[str] = set()
+        self._dirty: set[str] = set()  # dirs with unpersisted deltas
+        self._persisted_at: dict[str, float] = {}
+        self._parse_limits(self.opts["limits"])
 
-    # -- admin API (quota CLI path) ----------------------------------------
+    def _parse_limits(self, text: str) -> None:
+        try:
+            raw = json.loads(text or "{}")
+        except ValueError:
+            log.warning(1, "%s: bad limits JSON ignored", self.name)
+            return
+        self.limits = {k.rstrip("/") or "/": int(v)
+                       for k, v in raw.items()}
+
+    async def init(self) -> None:
+        await super().init()
+        # seed usage from the persisted marker xattrs (no re-crawl)
+        for d in list(self.limits):
+            try:
+                xa = await self.children[0].getxattr(Loc(d), XA_SIZE)
+                val = (xa or {}).get(XA_SIZE)
+                if val is not None:
+                    self._usage[d] = int(val)
+            except (FopError, ValueError, TypeError):
+                pass
+
+    def reconfigure(self, options: dict) -> None:
+        super().reconfigure(options)
+        old_usage = self._usage
+        self._parse_limits(self.opts["limits"])
+        # keep cached usage for directories that are still limited
+        self._usage = {d: u for d, u in old_usage.items()
+                       if d in self.limits}
+
+    # -- admin API (quota CLI path / xattr interface) ----------------------
 
     def limit_set(self, path: str, limit: int) -> None:
         self.limits[path.rstrip("/") or "/"] = limit
-        self._usage.pop(path.rstrip("/") or "/", None)
 
     def limit_remove(self, path: str) -> None:
-        self.limits.pop(path.rstrip("/") or "/", None)
+        d = path.rstrip("/") or "/"
+        self.limits.pop(d, None)
+        self._usage.pop(d, None)
 
     async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
                        xdata: dict | None = None):
@@ -49,7 +108,17 @@ class QuotaLayer(Layer):
                 return {}
         return await self.children[0].setxattr(loc, xattrs, flags, xdata)
 
-    # -- accounting --------------------------------------------------------
+    async def quota_usage(self) -> dict:
+        """Aggregator surface (quotad polls this): logical usage and
+        limit per limited directory."""
+        scale = self.opts["usage-scale"]
+        out = {}
+        for d, lim in self.limits.items():
+            used = await self._use(d)
+            out[d] = {"used": int(used * scale), "limit": lim}
+        return out
+
+    # -- accounting (the marker analog) ------------------------------------
 
     def _covering(self, path: str) -> list[str]:
         out = []
@@ -75,27 +144,65 @@ class QuotaLayer(Layer):
                 total += ia.size
         return total
 
+    # marker persistence is debounced: the xattr may trail the live
+    # counter by up to _PERSIST_EVERY seconds (a crash loses only that
+    # window's deltas — the reference marker journals for the same
+    # reason); fini flushes the remainder
+    _PERSIST_EVERY = 1.0
+
+    async def _persist(self, d: str, force: bool = False) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        if not force and now - self._persisted_at.get(d, 0.0) < \
+                self._PERSIST_EVERY:
+            self._dirty.add(d)
+            return
+        try:
+            await self.children[0].setxattr(Loc(d),
+                                            {XA_SIZE: self._usage[d]})
+            self._persisted_at[d] = now
+            self._dirty.discard(d)
+        except FopError:
+            pass  # directory may not exist yet; next delta re-tries
+
+    async def fini(self) -> None:
+        for d in list(self._dirty):
+            if d in self._usage:
+                await self._persist(d, force=True)
+        await super().fini()
+
     async def _use(self, d: str) -> int:
         if d not in self._usage:
             self._usage[d] = await self._du(d if d != "/" else "/")
+            await self._persist(d, force=True)
         return self._usage[d]
 
     async def _check(self, path: str, delta: int) -> None:
-        """quota_check_limit analog: would +delta exceed any covering
-        limit?"""
+        """quota_check_limit analog on logical bytes; logs a one-shot
+        warning past the soft limit."""
         if delta <= 0:
             return
+        scale = self.opts["usage-scale"]
         for d in self._covering(path):
-            used = await self._use(d)
-            if used + delta > self.limits[d]:
+            used = (await self._use(d)) * scale
+            lim = self.limits[d]
+            if used + delta * scale > lim:
                 raise FopError(errno.EDQUOT,
                                f"quota exceeded on {d} "
-                               f"({used}+{delta} > {self.limits[d]})")
+                               f"({int(used)}+{int(delta * scale)} > "
+                               f"{lim})")
+            soft = lim * self.opts["default-soft-limit"] / 100.0
+            if used + delta * scale > soft and d not in self._soft_warned:
+                self._soft_warned.add(d)
+                log.warning(2, "%s: %s over soft limit (%d/%d)",
+                            self.name, d, int(used), lim)
 
-    def _account(self, path: str, delta: int) -> None:
+    async def _account(self, path: str, delta: int) -> None:
         for d in self._covering(path):
             if d in self._usage:
                 self._usage[d] = max(0, self._usage[d] + delta)
+                await self._persist(d)
 
     # -- enforced fops -----------------------------------------------------
 
@@ -106,7 +213,7 @@ class QuotaLayer(Layer):
         growth = max(0, offset + len(data) - ia.size)
         await self._check(path, growth)
         ret = await self.children[0].writev(fd, data, offset, xdata)
-        self._account(path, growth)
+        await self._account(path, growth)
         return ret
 
     async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
@@ -118,7 +225,17 @@ class QuotaLayer(Layer):
         if delta > 0:
             await self._check(loc.path, delta)
         ret = await self.children[0].truncate(loc, size, xdata)
-        self._account(loc.path, delta)
+        await self._account(loc.path, delta)
+        return ret
+
+    async def fallocate(self, fd: FdObj, mode: int, offset: int,
+                        length: int, xdata: dict | None = None):
+        ia = await self.children[0].fstat(fd)
+        growth = max(0, offset + length - ia.size)
+        await self._check(fd.path, growth)
+        ret = await self.children[0].fallocate(fd, mode, offset, length,
+                                               xdata)
+        await self._account(fd.path, growth)
         return ret
 
     async def unlink(self, loc: Loc, xdata: dict | None = None):
@@ -128,8 +245,11 @@ class QuotaLayer(Layer):
         except FopError:
             size = 0
         ret = await self.children[0].unlink(loc, xdata)
-        self._account(loc.path, -size)
+        await self._account(loc.path, -size)
         return ret
 
     def dump_private(self) -> dict:
-        return {"limits": dict(self.limits), "usage": dict(self._usage)}
+        scale = self.opts["usage-scale"]
+        return {"limits": dict(self.limits),
+                "usage": {d: int(u * scale)
+                          for d, u in self._usage.items()}}

@@ -205,27 +205,13 @@ class BrickBitd:
 
 async def _amain(args) -> None:
     from ..protocol.client import ClientLayer
+    from . import svcutil
 
     layers = []
     for spec in args.bricks.split(","):
         name, port = spec.rsplit(":", 1)
-        copts = {"remote-host": args.host, "remote-port": int(port),
-                 "remote-subvolume": name}
-        # credentials ride env vars, not argv (/proc/*/cmdline is
-        # world-readable; environ is owner-only)
-        user = os.environ.get("GFTPU_BITD_USERNAME", "")
-        if user:
-            copts.update(username=user,
-                         password=os.environ.get("GFTPU_BITD_PASSWORD",
-                                                 ""))
-        if args.ssl:
-            for k, v in (("ssl-ca", args.ssl_ca),
-                         ("ssl-cert", args.ssl_cert),
-                         ("ssl-key", args.ssl_key)):
-                if v:
-                    copts[k] = v
-            copts["ssl"] = "on"
-        layers.append(ClientLayer(f"bitd-{name}", copts))
+        layers.append(ClientLayer(f"bitd-{name}", svcutil.client_opts(
+            args, "GFTPU_BITD", args.host, int(port), name)))
     for l in layers:
         await l.init()
     # the connect loop runs in the background; a pass against
@@ -270,10 +256,8 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--bricks", required=True,
                    help="comma-separated brickname:port")
-    p.add_argument("--ssl", action="store_true")
-    p.add_argument("--ssl-ca", default="")
-    p.add_argument("--ssl-cert", default="")
-    p.add_argument("--ssl-key", default="")
+    from . import svcutil
+    svcutil.add_ssl_args(p)
     p.add_argument("--quiesce", type=float, default=120.0)
     p.add_argument("--scrub-interval", type=float, default=60.0)
     p.add_argument("--statusfile", default="")

@@ -8,6 +8,7 @@ Command surface kept (cli-cmd-volume.c vocabulary):
     gftpu volume info [NAME] | status NAME
     gftpu volume set NAME KEY VALUE
     gftpu volume heal NAME [info] [PATH]
+    gftpu volume quota NAME enable|disable|list|limit-usage PATH BYTES|remove PATH
     gftpu volume rebalance NAME
     gftpu volume profile NAME
     gftpu peer probe HOST:PORT | peer status
@@ -111,6 +112,17 @@ async def _run(args) -> Any:
                 return await top.heal_file(path)
             finally:
                 await client.unmount()
+        if sub == "quota":
+            # gftpu volume quota NAME enable|disable|list
+            #                        |limit-usage PATH BYTES|remove PATH
+            action = args.args[0] if args.args else "list"
+            kw = {"name": args.name, "action": action}
+            if action == "limit-usage":
+                kw.update(path=args.args[1], limit=int(args.args[2]))
+            elif action == "remove":
+                kw.update(path=args.args[1])
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-quota", **kw)
         if sub == "rebalance":
             client = await mount_volume(host, port, args.name)
             try:
@@ -192,7 +204,7 @@ def main(argv=None) -> int:
     vol = sp.add_parser("volume")
     vol.add_argument("sub", choices=["create", "start", "stop", "delete",
                                      "info", "status", "set", "heal",
-                                     "rebalance", "profile"])
+                                     "rebalance", "profile", "quota"])
     vol.add_argument("name", nargs="?", default="")
     vol.add_argument("args", nargs="*")
 

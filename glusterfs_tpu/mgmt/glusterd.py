@@ -66,6 +66,7 @@ class Glusterd:
         self.shd: dict[str, subprocess.Popen] = {}  # volname -> shd proc
         self.gsync: dict[str, subprocess.Popen] = {}  # volname -> gsyncd
         self.bitd: dict[str, subprocess.Popen] = {}  # volname -> bitd
+        self.quotad: dict[str, subprocess.Popen] = {}  # volname -> quotad
         self._server: asyncio.AbstractServer | None = None
         self._txn_lock = asyncio.Lock()
         self._txn_holder: str | None = None
@@ -106,6 +107,9 @@ class Glusterd:
                 if volgen._bool(vol.get("options", {}).get(
                         "features.bitrot", "off")):
                     self._spawn_bitd(vol)
+                if volgen._bool(vol.get("options", {}).get(
+                        "features.quota", "off")):
+                    self._spawn_quotad(vol)
         return self.port
 
     async def stop(self) -> None:
@@ -115,6 +119,8 @@ class Glusterd:
             self._kill_gsync(name)
         for name in list(self.bitd):
             self._kill_bitd(name)
+        for name in list(self.quotad):
+            self._kill_quotad(name)
         for name in list(self.shd):
             self._kill_shd(name)
         for name in list(self.bricks):
@@ -355,6 +361,9 @@ class Glusterd:
         if volgen._bool(vol.get("options", {}).get("features.bitrot",
                                                    "off")):
             self._spawn_bitd(vol)
+        if volgen._bool(vol.get("options", {}).get("features.quota",
+                                                   "off")):
+            self._spawn_quotad(vol)
         gf_event("VOLUME_START", name=name)
         return {"started": name,
                 "ports": {b["name"]: self.ports[b["name"]]
@@ -379,6 +388,7 @@ class Glusterd:
         vol["status"] = "stopped"
         self._save()
         self._kill_bitd(name)
+        self._kill_quotad(name)
         self._kill_shd(name)
         for b in vol["bricks"]:
             if b["node"] == self.uuid:
@@ -824,6 +834,147 @@ class Glusterd:
             self._kill_bitd(name)
         return {action: name}
 
+    # -- quota (quota.c enforcement + quotad-aggregator.c) -----------------
+
+    async def op_volume_quota(self, name: str, action: str,
+                              path: str = "", limit: int = 0) -> dict:
+        """gluster volume quota <v> enable|disable|limit-usage|remove|
+        list analog."""
+        self._vol(name)
+        if action == "enable":
+            await self._cluster_txn("volume-set", {
+                "name": name, "key": "features.quota", "value": "on"})
+            await self._cluster_txn("quota-ctl",
+                                    {"name": name, "action": "spawn"})
+            return {"ok": True, "enabled": name}
+        if action == "disable":
+            await self._cluster_txn("quota-ctl",
+                                    {"name": name, "action": "kill"})
+            await self._cluster_txn("volume-set", {
+                "name": name, "key": "features.quota", "value": "off"})
+            return {"ok": True, "disabled": name}
+        if action == "limit-usage":
+            if not path or int(limit) <= 0:
+                raise MgmtError("limit-usage needs a path and a "
+                                "positive byte limit")
+            await self._cluster_txn("quota-limit", {
+                "name": name, "path": path, "limit": int(limit)})
+            return {"ok": True, "path": path, "limit": int(limit)}
+        if action == "remove":
+            if not path:
+                raise MgmtError("remove needs a path")
+            await self._cluster_txn("quota-limit", {
+                "name": name, "path": path, "limit": 0})
+            return {"ok": True, "removed": path}
+        if action == "list":
+            if not volgen._bool(self._vol(name).get("options", {}).get(
+                    "features.quota", "off")):
+                raise MgmtError(f"quota not enabled on {name}")
+            port = self._quotad_port(name)
+            if port:
+                try:
+                    reader, writer = await asyncio.wait_for(
+                        asyncio.open_connection("127.0.0.1", port), 5)
+                    try:
+                        writer.write(wire.pack(1, wire.MT_CALL,
+                                               ["quota-list"]))
+                        await writer.drain()
+                        rec = await asyncio.wait_for(
+                            wire.read_frame(reader), 10)
+                        _, _, payload = wire.unpack(rec)
+                        return payload
+                    finally:
+                        writer.close()
+                except Exception:
+                    pass
+            # quotad unreachable: last persisted aggregate
+            try:
+                with open(os.path.join(self.workdir,
+                                       f"quotad-{name}.json")) as f:
+                    return json.load(f).get("usage", {})
+            except (FileNotFoundError, ValueError):
+                return {}
+        raise MgmtError(f"unknown quota action {action!r}")
+
+    async def commit_quota_limit(self, name: str, path: str,
+                                 limit: int) -> dict:
+        vol = self._vol(name)
+        limits = vol.setdefault("quota", {}).setdefault("limits", {})
+        p = path.rstrip("/") or "/"
+        if limit > 0:
+            limits[p] = int(limit)
+        else:
+            limits.pop(p, None)
+        self._save()
+        applied = "stored"
+        if vol["status"] == "started" and volgen._bool(
+                vol.get("options", {}).get("features.quota", "off")):
+            # limits ride the quota layer's `limits` option: live
+            # reconfigure, no brick restart
+            applied = await self._apply_to_bricks(vol)
+        return {"applied": applied}
+
+    def commit_quota_ctl(self, name: str, action: str) -> dict:
+        vol = self._vol(name)
+        if action == "spawn":
+            if vol["status"] == "started":
+                self._spawn_quotad(vol)
+        else:
+            self._kill_quotad(name)
+        return {action: name}
+
+    def _quotad_port(self, name: str) -> int:
+        try:
+            with open(os.path.join(self.workdir,
+                                   f"quotad-{name}.port")) as f:
+                return int(f.read())
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    def _spawn_quotad(self, vol: dict) -> None:
+        from . import svcutil
+
+        name = vol["name"]
+        proc = self.quotad.get(name)
+        if proc is not None and proc.poll() is None:
+            return
+        local = [(b["name"], self.ports.get(b["name"], 0),
+                  svcutil.brick_group(vol, b["index"]))
+                 for b in vol["bricks"]
+                 if b["node"] == self.uuid and self.ports.get(b["name"])]
+        if not local:
+            return
+        env = svcutil.spawn_env(vol, "GFTPU_QUOTAD")
+        portfile = os.path.join(self.workdir, f"quotad-{name}.port")
+        if os.path.exists(portfile):
+            os.unlink(portfile)
+        statusfile = os.path.join(self.workdir, f"quotad-{name}.json")
+        with open(os.path.join(self.workdir, f"quotad-{name}.log"),
+                  "ab") as logf:
+            self.quotad[name] = subprocess.Popen(
+                [sys.executable, "-m", "glusterfs_tpu.mgmt.quotad",
+                 "--bricks", ",".join(f"{n}:{p}:{g}" for n, p, g in local),
+                 *svcutil.spawn_ssl_argv(vol.get("options", {})),
+                 "--portfile", portfile, "--statusfile", statusfile],
+                env=env, stdout=subprocess.DEVNULL, stderr=logf)
+
+    def _kill_quotad(self, name: str) -> None:
+        proc = self.quotad.pop(name, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        # stale port/status files would make 'quota list' report old
+        # numbers as live after a disable
+        for suffix in (".port", ".json"):
+            try:
+                os.unlink(os.path.join(self.workdir,
+                                       f"quotad-{name}{suffix}"))
+            except FileNotFoundError:
+                pass
+
     def _spawn_bitd(self, vol: dict) -> None:
         name = vol["name"]
         proc = self.bitd.get(name)
@@ -834,30 +985,17 @@ class Glusterd:
                  if b["node"] == self.uuid and self.ports.get(b["name"])]
         if not local:
             return
+        from . import svcutil
+
         opts = vol.get("options", {})
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        auth = vol.get("auth") or {}
-        if auth:
-            env["GFTPU_BITD_USERNAME"] = auth.get("mgmt-username",
-                                                  auth.get("username", ""))
-            env["GFTPU_BITD_PASSWORD"] = auth.get("mgmt-password",
-                                                  auth.get("password", ""))
+        env = svcutil.spawn_env(vol, "GFTPU_BITD")
         statusfile = os.path.join(self.workdir, f"bitd-{name}.json")
         with open(os.path.join(self.workdir, f"bitd-{name}.log"),
                   "ab") as logf:
             self.bitd[name] = subprocess.Popen(
                 [sys.executable, "-m", "glusterfs_tpu.mgmt.bitd",
                  "--bricks", ",".join(f"{n}:{p}" for n, p in local),
-                 *(["--ssl"] if volgen._bool(opts.get("server.ssl", "off"))
-                   else []),
-                 *(["--ssl-ca", opts["ssl.ca"]] if opts.get("ssl.ca")
-                   else []),
-                 *(["--ssl-cert", opts["ssl.cert"]] if opts.get("ssl.cert")
-                   else []),
-                 *(["--ssl-key", opts["ssl.key"]] if opts.get("ssl.key")
-                   else []),
+                 *svcutil.spawn_ssl_argv(opts),
                  "--quiesce", str(opts.get("bitrot.signer-quiesce", 120)),
                  "--scrub-interval",
                  str(opts.get("bitrot.scrub-interval", 60)),

@@ -1,0 +1,78 @@
+"""Shared plumbing for per-volume service daemons (bitd, quotad, …):
+credential/TLS wiring between glusterd's spawner and the daemon's
+brick ClientLayers.  One copy, so an auth change lands everywhere
+(glusterd-svc-mgmt.c is the reference's shared service layer)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from . import volgen
+
+
+def add_ssl_args(parser) -> None:
+    parser.add_argument("--ssl", action="store_true")
+    parser.add_argument("--ssl-ca", default="")
+    parser.add_argument("--ssl-cert", default="")
+    parser.add_argument("--ssl-key", default="")
+
+
+def client_opts(args, env_prefix: str, host: str, port: int,
+                subvol: str) -> dict[str, Any]:
+    """ClientLayer options for a service daemon's brick connection:
+    credentials from the environment (argv is world-readable), TLS from
+    the spawner's flags."""
+    copts: dict[str, Any] = {"remote-host": host, "remote-port": port,
+                             "remote-subvolume": subvol}
+    user = os.environ.get(f"{env_prefix}_USERNAME", "")
+    if user:
+        copts["username"] = user
+        copts["password"] = os.environ.get(f"{env_prefix}_PASSWORD", "")
+    if args.ssl:
+        for k, v in (("ssl-ca", args.ssl_ca), ("ssl-cert", args.ssl_cert),
+                     ("ssl-key", args.ssl_key)):
+            if v:
+                copts[k] = v
+        copts["ssl"] = "on"
+    return copts
+
+
+def spawn_ssl_argv(opts: dict) -> list[str]:
+    """argv TLS flags matching add_ssl_args, from volume options."""
+    out: list[str] = []
+    if volgen._bool(opts.get("server.ssl", "off")):
+        out.append("--ssl")
+    for volkey, flag in (("ssl.ca", "--ssl-ca"),
+                         ("ssl.cert", "--ssl-cert"),
+                         ("ssl.key", "--ssl-key")):
+        if opts.get(volkey):
+            out += [flag, opts[volkey]]
+    return out
+
+
+def spawn_env(vol: dict, env_prefix: str) -> dict[str, str]:
+    """Subprocess environment for a service daemon: jax pinned to CPU
+    plus the volume's mgmt credential pair under the given prefix."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    auth = vol.get("auth") or {}
+    if auth:
+        env[f"{env_prefix}_USERNAME"] = auth.get(
+            "mgmt-username", auth.get("username", ""))
+        env[f"{env_prefix}_PASSWORD"] = auth.get(
+            "mgmt-password", auth.get("password", ""))
+    return env
+
+
+def brick_group(vol: dict, index: int) -> int:
+    """Aggregation group of a brick: bricks in one replica/disperse
+    group hold the same logical files (aggregate = max within group);
+    distinct groups hold disjoint DHT subtrees (aggregate = sum across
+    groups)."""
+    n = len(vol["bricks"])
+    if vol["type"] in ("disperse", "replicate"):
+        g = vol.get("group-size") or n
+        return index // g
+    return index  # pure distribute: every brick its own group

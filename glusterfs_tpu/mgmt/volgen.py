@@ -164,8 +164,20 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
                      layer_options(volinfo, "features/barrier"), [top]))
     top = f"{name}-barrier"
     if _enabled(volinfo, "features.quota", False):
-        out.append(_emit(f"{name}-quota", "features/quota",
-                         layer_options(volinfo, "features/quota"), [top]))
+        import json as _json
+
+        qopts = layer_options(volinfo, "features/quota")
+        # #-escape '#': the volfile parser strips comments, and a
+        # limited path containing '#' must not truncate the JSON
+        qopts["limits"] = _json.dumps(
+            volinfo.get("quota", {}).get("limits", {}),
+            separators=(",", ":")).replace("#", "\\u0023")
+        if volinfo["type"] == "disperse":
+            # a disperse brick holds 1/K of every file: scale backend
+            # bytes to logical so limits are volume-type independent
+            g = volinfo.get("group-size") or len(volinfo["bricks"])
+            qopts["usage-scale"] = g - volinfo.get("redundancy", 2)
+        out.append(_emit(f"{name}-quota", "features/quota", qopts, [top]))
         top = f"{name}-quota"
     if _enabled(volinfo, "features.read-only", False):
         out.append(_emit(f"{name}-ro", "features/read-only", {}, [top]))

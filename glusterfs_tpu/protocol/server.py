@@ -113,7 +113,7 @@ _FOPS = {f.value for f in Fop}
 # non-wire-fop methods a client may invoke remotely (heal entry points,
 # introspection — the reference exposes these via separate RPC programs)
 _RPC_EXTRAS = {"heal_info", "heal_file", "heal_entry", "rebalance",
-               "release", "getactivelk"}
+               "release", "getactivelk", "quota_usage"}
 
 
 class _ClientConn:
@@ -367,6 +367,17 @@ class BrickServer:
             if fop_name not in _FOPS and fop_name not in _RPC_EXTRAS:
                 raise FopError(95, f"unknown fop {fop_name!r}")
             fn = getattr(self.top, fop_name, None)
+            if fn is None and fop_name in _RPC_EXTRAS:
+                # extras (quota_usage, heal surfaces) live on a specific
+                # mid-graph layer, not the passthrough top — resolve by
+                # walking (the reference registers them as separate RPC
+                # programs per xlator)
+                from ..core.layer import walk
+
+                for layer in walk(self.top):
+                    fn = getattr(layer, fop_name, None)
+                    if fn is not None:
+                        break
             if fn is None:
                 raise FopError(95, f"fop {fop_name!r} unsupported")
             # release retires the fd-table entry too (long-lived

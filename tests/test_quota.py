@@ -1,0 +1,183 @@
+"""Quota: marker-persistent accounting, disperse scaling, quotad
+aggregation, and the managed enable/limit/list lifecycle (reference
+tests/basic/quota.t workloads; quota.c + marker + quotad analogs)."""
+
+import asyncio
+import errno
+import json
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import walk
+
+QUOTA_VOLFILE = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+
+volume quota
+    type features/quota
+    option limits {limits}
+    option usage-scale {scale}
+    subvolumes posix
+end-volume
+"""
+
+
+def _graph(tmp_path, limits, scale=1):
+    return Graph.construct(QUOTA_VOLFILE.format(
+        dir=tmp_path / "b", limits=json.dumps(limits,
+                                              separators=(",", ":")),
+        scale=scale))
+
+
+def test_quota_enforced_and_persisted(tmp_path):
+    """EDQUOT past the limit; usage survives a layer restart via the
+    marker xattr (no re-crawl)."""
+    async def run():
+        g = _graph(tmp_path, {"/d": 4096})
+        c = Client(g)
+        await c.mount()
+        await c.mkdir("/d")
+        await c.write_file("/d/a", b"x" * 3000)
+        with pytest.raises(FopError) as ei:
+            await c.write_file("/d/b", b"x" * 2000)
+        assert ei.value.err == errno.EDQUOT
+        # under the limit still works
+        await c.write_file("/d/c", b"x" * 500)
+        await c.unmount()
+
+        # a fresh graph (brick restart) seeds usage from the xattr
+        g2 = _graph(tmp_path, {"/d": 4096})
+        c2 = Client(g2)
+        await c2.mount()
+        ql = next(l for l in walk(g2.top)
+                  if l.type_name == "features/quota")
+        assert ql._usage.get("/d", 0) == 3500  # seeded, not re-crawled
+        with pytest.raises(FopError):
+            await c2.write_file("/d/more", b"x" * 1000)
+        await c2.unmount()
+
+    asyncio.run(run())
+
+
+def test_quota_scale(tmp_path):
+    """usage-scale maps backend (fragment) bytes to logical: a K=4
+    disperse brick holding 1000 backend bytes reports 4000 logical."""
+    async def run():
+        g = _graph(tmp_path, {"/": 4096}, scale=4)
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/f", b"x" * 1000)  # 4000 logical
+        ql = next(l for l in walk(g.top)
+                  if l.type_name == "features/quota")
+        usage = await ql.quota_usage()
+        assert usage["/"]["used"] == 4000
+        with pytest.raises(FopError) as ei:
+            await c.write_file("/g", b"x" * 100)  # +400 logical > 4096
+        assert ei.value.err == errno.EDQUOT
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_quota_unlink_releases(tmp_path):
+    async def run():
+        g = _graph(tmp_path, {"/": 2048})
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/a", b"x" * 2000)
+        with pytest.raises(FopError):
+            await c.write_file("/b", b"x" * 2000)
+        await c.unlink("/a")
+        await c.write_file("/b", b"x" * 2000)  # space released
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_managed_quota_lifecycle(tmp_path):
+    """volume quota enable -> limit-usage -> EDQUOT through a disperse
+    client -> quotad aggregation via 'quota list' -> remove lifts it."""
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    async def run():
+        gd = Glusterd(str(tmp_path / "gd"))
+        await gd.start()
+        async with MgmtClient(gd.host, gd.port) as c:
+            bricks = [{"path": str(tmp_path / f"b{i}")} for i in range(6)]
+            await c.call("volume-create", name="qv", vtype="disperse",
+                         bricks=bricks, redundancy=2)
+            await c.call("volume-start", name="qv")
+            await c.call("volume-quota", name="qv", action="enable")
+            await c.call("volume-quota", name="qv", action="limit-usage",
+                         path="/lim", limit=1 << 20)
+        cl = await mount_volume(gd.host, gd.port, "qv")
+        try:
+            subs = [l for l in walk(cl.graph.top)
+                    if l.type_name == "protocol/client"]
+            for _ in range(100):
+                if all(l.connected for l in subs):
+                    break
+                await asyncio.sleep(0.1)
+            await cl.mkdir("/lim")
+            await cl.write_file("/lim/ok", b"x" * (256 << 10))
+            with pytest.raises(FopError) as ei:
+                await cl.write_file("/lim/big", b"x" * (900 << 10))
+            assert ei.value.err == errno.EDQUOT
+            # aggregated listing reflects logical usage near 256KiB
+            async with MgmtClient(gd.host, gd.port) as c:
+                for _ in range(50):
+                    lst = await c.call("volume-quota", name="qv",
+                                       action="list")
+                    if "/lim" in lst and lst["/lim"]["used"] > 0:
+                        break
+                    await asyncio.sleep(0.2)
+            assert "/lim" in lst, lst
+            used = lst["/lim"]["used"]
+            assert (200 << 10) <= used <= (400 << 10), used
+            assert lst["/lim"]["limit"] == 1 << 20
+            # removing the limit lifts enforcement
+            async with MgmtClient(gd.host, gd.port) as c:
+                await c.call("volume-quota", name="qv", action="remove",
+                             path="/lim")
+            await cl.write_file("/lim/big", b"x" * (900 << 10))
+        finally:
+            await cl.unmount()
+            await gd.stop()
+
+    asyncio.run(run())
+
+
+def test_quotad_group_aggregation():
+    """sum over DHT groups of max within a replica/disperse group
+    (quotad-aggregator semantics for distributed-replicate shapes)."""
+    from glusterfs_tpu.mgmt.quotad import Quotad
+
+    class Fake:
+        connected = True
+
+        def __init__(self, name, usage):
+            self.name = name
+            self._u = usage
+
+        async def remote(self, method):
+            assert method == "quota_usage"
+            return self._u
+
+    # 2x2 distributed-replicate: group 0 holds 100 (both replicas),
+    # group 1 holds 40 (one replica trails at 35)
+    layers = [Fake("a", {"/d": {"used": 100, "limit": 1000}}),
+              Fake("b", {"/d": {"used": 100, "limit": 1000}}),
+              Fake("c", {"/d": {"used": 35, "limit": 1000}}),
+              Fake("d", {"/d": {"used": 40, "limit": 1000}})]
+    qd = Quotad(layers, {"a": 0, "b": 0, "c": 1, "d": 1})
+    agg = asyncio.run(qd.poll_once())
+    assert agg["/d"]["used"] == 140
+    assert agg["/d"]["available"] == 860
