@@ -38,6 +38,10 @@ log = gflog.get_logger("afr")
 
 XA_VERSION = "trusted.afr.version"
 XA_DIRTY = "trusted.afr.dirty"
+# per-target blame counters (trusted.afr.<brick>.pending analog):
+# pending.<j> on brick i counts writes i took that j missed — the
+# matrix afr_selfheal_find_direction reads; mutual blame = split-brain
+XA_PENDING = "trusted.afr.pending."
 
 
 def _u64x2(data: bytes | None) -> tuple[int, int]:
@@ -68,11 +72,47 @@ class ReplicateLayer(Layer):
         Option("self-heal-window-size", "size", default="1M"),
         Option("favorite-child", "int", default=-1, min=-1,
                description="split-brain resolution source (-1 = none)"),
+        Option("favorite-child-policy", "enum", default="none",
+               values=("none", "size", "mtime", "majority"),
+               description="automatic split-brain resolution "
+                           "(cluster.favorite-child-policy): pick the "
+                           "biggest / latest-mtime / most-common copy"),
+        Option("arbiter-count", "int", default=0, min=0, max=1,
+               description="the group's LAST brick is a metadata-only "
+                           "witness (features/arbiter on the brick): "
+                           "counted for quorum and blame, never read "
+                           "from, never a data-heal source"),
+        Option("thin-arbiter", "bool", default="off",
+               description="the LAST child is a remote tie-breaker "
+                           "holding one mark file per volume "
+                           "(features/thin-arbiter): consulted only "
+                           "when a data replica is down — a degraded "
+                           "write marks the absent replica bad there, "
+                           "and a lone replica may only serve if it is "
+                           "not the marked one"),
     )
+
+    TA_PATH = "/.thin-arbiter"
+    TA_KEY = "trusted.afr.ta.bad."
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         self.n = len(self.children)
+        # gfids a read found split-brained (inode-ctx refresh analog):
+        # writes consult this so they don't deepen a known divergence
+        self._sb_cache: set[bytes] = set()
+        self.ta = None
+        self.ta_up = True
+        if self.opts["thin-arbiter"]:
+            # the tie-breaker child is NOT a replica: it leaves the
+            # data-plane index space entirely
+            self.ta = self.children[-1]
+            self.n -= 1
+            if self.n != 2:
+                raise ValueError(f"{self.name}: thin-arbiter needs "
+                                 f"exactly 2 data replicas")
+        self.arbiters: set[int] = set(
+            range(self.n - self.opts["arbiter-count"], self.n))
         if self.n < 2:
             raise ValueError(f"{self.name}: replicate needs >= 2 children")
         self.up = [True] * self.n
@@ -90,6 +130,9 @@ class ReplicateLayer(Layer):
             return
         if source in self.children:
             idx = self.children.index(source)
+            if idx >= self.n:  # the thin-arbiter child
+                self.ta_up = event is not Event.CHILD_DOWN
+                return
             if event is Event.CHILD_DOWN:
                 self.up[idx] = False
             elif event is Event.CHILD_UP:
@@ -151,20 +194,102 @@ class ReplicateLayer(Layer):
             if isinstance(r, BaseException):
                 out[i] = r
             else:
+                pend = {}
+                for j in range(self.n):
+                    v = _u64x2(r.get(XA_PENDING + str(j)))[0]
+                    if v:
+                        pend[j] = v
                 out[i] = {"version": _u64x2(r.get(XA_VERSION)),
-                          "dirty": _u64x2(r.get(XA_DIRTY))}
+                          "dirty": _u64x2(r.get(XA_DIRTY)),
+                          "pending": pend}
         return out
 
+    @staticmethod
+    def _accused(vals: dict) -> set[int]:
+        """Bricks blamed by any OTHER reachable brick's pending matrix
+        (afr_selfheal_find_direction: pending counters point away from
+        sources)."""
+        out: set[int] = set()
+        for i, m in vals.items():
+            for j, cnt in m["pending"].items():
+                if j != i and cnt > 0:
+                    out.add(j)
+        return out
+
+    # -- thin-arbiter marks (thin-arbiter.c ta_update_fav_child) -----------
+
+    async def _ta_marks(self) -> dict[int, int]:
+        """Per-replica bad marks on the tie-breaker's volume file."""
+        if self.ta is None or not self.ta_up:
+            raise FopError(errno.ENOTCONN, "thin-arbiter unreachable")
+        loc = Loc(self.TA_PATH)
+        try:
+            xa = await self.ta.getxattr(loc, None)
+        except FopError as e:
+            if e.err == errno.ENOENT:
+                return {}
+            raise
+        out = {}
+        for j in range(self.n):
+            v = _u64x2((xa or {}).get(self.TA_KEY + str(j)))[0]
+            if v:
+                out[j] = v
+        return out
+
+    async def _ta_mark_bad(self, bad: list[int]) -> None:
+        """A degraded write first brands the absent replica on the
+        tie-breaker; only then may a single data brick accept writes."""
+        loc = Loc(self.TA_PATH)
+        try:
+            await self.ta.mknod(loc, 0o600)
+        except FopError as e:
+            if e.err != errno.EEXIST:
+                raise
+        await self.ta.xattrop(loc, "add64",
+                              {self.TA_KEY + str(j): _pack_u64x2(1, 0)
+                               for j in bad})
+
+    async def _ta_clear(self, healed: list[int]) -> None:
+        if self.ta is None:
+            return
+        try:
+            await self.ta.setxattr(
+                Loc(self.TA_PATH),
+                {self.TA_KEY + str(j): _pack_u64x2(0, 0) for j in healed})
+        except FopError:
+            pass
+
     async def _good_rows(self, loc: Loc) -> list[int]:
-        """Up children with the quorum-best version (clean preferred)."""
+        """Up children that no peer blames, at the best version (clean
+        preferred).  Mutual blame with no innocent brick is split-brain:
+        reads fail EIO rather than serve whichever divergent copy
+        happens to answer (afr_read_txn refuses split-brained inodes)."""
         ups = self._up_idx()
         meta = await self._get_meta(ups, loc)
         vals = {i: m for i, m in meta.items()
                 if not isinstance(m, BaseException)}
         if not vals:
             raise FopError(errno.ENOTCONN, "no readable children")
-        clean = {i: m for i, m in vals.items() if m["dirty"] == (0, 0)}
-        pool = clean or vals
+        if self.ta is not None and len(vals) < self.n:
+            # degraded 2-replica volume: the tie-breaker decides whether
+            # the surviving replica may serve (it must not be the one a
+            # degraded write branded bad)
+            marks = await self._ta_marks()
+            vals = {i: m for i, m in vals.items() if i not in marks}
+            if not vals:
+                raise FopError(errno.EIO,
+                               f"{loc.path}: surviving replica is "
+                               f"marked bad on the thin-arbiter")
+        accused = self._accused(vals)
+        innocent = {i: m for i, m in vals.items() if i not in accused}
+        if not innocent:
+            if loc.gfid:
+                self._sb_cache.add(bytes(loc.gfid))
+            raise FopError(errno.EIO,
+                           f"{loc.path}: split-brain (every replica "
+                           f"blamed; resolve with heal split-brain)")
+        clean = {i: m for i, m in innocent.items() if m["dirty"] == (0, 0)}
+        pool = clean or innocent
         best = max(m["version"] for m in pool.values())
         return [i for i, m in pool.items() if m["version"] == best]
 
@@ -246,19 +371,31 @@ class ReplicateLayer(Layer):
 
     # -- namespace fops ----------------------------------------------------
 
+    def _pick(self, good: dict):
+        """Representative answer: never the arbiter's if a data
+        replica answered — its iatt carries size 0 for every file."""
+        for i in sorted(good):
+            if i not in self.arbiters:
+                return good[i]
+        return next(iter(good.values()))
+
     async def _all(self, op: str, *args, **kw):
         res = await self._dispatch(self._up_idx(), op, lambda i: (args, kw))
         good = self._combine(res)
-        return next(iter(good.values()))
+        return self._pick(good)
 
     async def lookup(self, loc: Loc, xdata: dict | None = None):
         res = await self._dispatch(self._up_idx(), "lookup",
                                    lambda i: ((loc, xdata), {}))
         good = self._combine(res, min_ok=1)
-        return next(iter(good.values()))
+        return self._pick(good)
 
     async def stat(self, loc: Loc, xdata: dict | None = None):
-        rows = await self._good_rows(loc)
+        rows = [i for i in await self._good_rows(loc)
+                if i not in self.arbiters]
+        if not rows:
+            raise FopError(errno.ENOTCONN,
+                           "no data replica for stat (arbiter only)")
         return await self.children[rows[0]].stat(loc, xdata)
 
     async def fstat(self, fd: FdObj, xdata: dict | None = None):
@@ -418,7 +555,11 @@ class ReplicateLayer(Layer):
     async def readv(self, fd: FdObj, size: int, offset: int,
                     xdata: dict | None = None):
         loc = Loc(fd.path, gfid=fd.gfid)
-        candidates = await self._good_rows(loc)
+        candidates = [i for i in await self._good_rows(loc)
+                      if i not in self.arbiters]
+        if not candidates:
+            raise FopError(errno.ENOTCONN,
+                           "no data replica readable (arbiter only)")
         last: FopError | None = None
         for _ in range(len(candidates)):
             i = self._read_child(candidates, fd.gfid)
@@ -438,8 +579,27 @@ class ReplicateLayer(Layer):
         version bump on the good ones — dirty is released only when
         EVERY replica took the write (a partial success keeps the mark,
         and the brick-side pending-index entry, for the shd)."""
+        if gfid and bytes(gfid) in self._sb_cache:
+            raise FopError(errno.EIO,
+                           f"{loc.path}: split-brain (resolve first)")
         async with self._Txn(self, loc, gfid, "wr"):
             idxs = self._up_idx()
+            if self.ta is not None and len(idxs) < self.n:
+                if not idxs:
+                    # never brand with no survivor: marking both
+                    # replicas would poison every future degraded read
+                    raise FopError(errno.ENOTCONN,
+                                   f"{op}: no data replica up")
+                # tie-breaker gate: the lone survivor may take writes
+                # only after branding the absent replica bad — and never
+                # if it is itself the branded one
+                marks = await self._ta_marks()
+                if any(i in marks for i in idxs):
+                    raise FopError(errno.EIO,
+                                   f"{op}: this replica is marked bad "
+                                   f"on the thin-arbiter")
+                down = [j for j in range(self.n) if j not in idxs]
+                await self._ta_mark_bad(down)
             await self._dispatch(
                 idxs, "xattrop",
                 lambda i: ((loc, "add64",
@@ -447,12 +607,24 @@ class ReplicateLayer(Layer):
             res = await self._dispatch(idxs, op, argfn)
             good = [i for i, r in res.items()
                     if not isinstance(r, BaseException)]
-            if len(good) < self._quorum():
+            quorum = self._quorum()
+            if self.ta is not None and len(idxs) < self.n:
+                quorum = 1  # the thin-arbiter grant replaced the peer
+            if len(good) < quorum:
                 raise FopError(errno.EIO,
                                f"{op} quorum lost ({len(good)}/{self.n})")
             post = {XA_VERSION: _pack_u64x2(1, 0)}
             if len(good) == self.n:
                 post[XA_DIRTY] = _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0)
+            else:
+                # blame every replica that missed this write (down or
+                # failed): the survivors' pending.<j> counters are what
+                # heal reads as direction, and mutual marks from
+                # partitioned writes are the split-brain signature
+                # (afr_set_pending_dict, afr-transaction.c:629)
+                for j in range(self.n):
+                    if j not in good:
+                        post[XA_PENDING + str(j)] = _pack_u64x2(1, 0)
             await self._dispatch(
                 good, "xattrop", lambda i: ((loc, "add64", dict(post)), {}))
             return next(r for i, r in res.items() if i in good)
@@ -494,7 +666,8 @@ class ReplicateLayer(Layer):
     async def seek(self, fd: FdObj, offset: int, what: str = "data",
                    xdata: dict | None = None):
         loc = Loc(fd.path, gfid=fd.gfid)
-        candidates = await self._good_rows(loc)
+        candidates = [i for i in await self._good_rows(loc)
+                      if i not in self.arbiters]
         last: FopError | None = None
         for i in candidates:
             try:
@@ -509,36 +682,122 @@ class ReplicateLayer(Layer):
     # -- heal --------------------------------------------------------------
 
     async def heal_info(self, loc: Loc) -> dict:
-        """Heal direction by committed version, never clean-ness: a brick
-        that slept through the write is spotlessly clean AND stale —
-        electing it as source would heal new data away.  The highest
-        post-op version wins (afr_selfheal_find_direction semantics:
-        pending counters point away from sources); dirty marks on the
-        winners are expected after a partial write and do not disqualify
-        them."""
+        """Heal direction from the blame matrix, then committed version
+        — never clean-ness: a brick that slept through the write is
+        spotlessly clean AND stale.  Sources are reachable bricks no
+        peer blames, at the highest post-op version
+        (afr_selfheal_find_direction); mutual blame with no innocent
+        brick is split-brain.  Dirty marks on sources are expected
+        after a partial write and do not disqualify them."""
         meta = await self._get_meta(list(range(self.n)), loc)
-        versions = {}
-        for i, m in meta.items():
-            versions[i] = None if isinstance(m, BaseException) else \
-                (m["version"], m["dirty"])
-        ok = {i: v for i, v in versions.items() if v is not None}
-        if not ok:
+        vals = {i: m for i, m in meta.items()
+                if not isinstance(m, BaseException)}
+        per_brick = {i: ((m["version"], m["dirty"], m["pending"])
+                         if not isinstance(m, BaseException) else None)
+                     for i, m in meta.items()}
+        if not vals:
             raise FopError(errno.ENOTCONN, "no bricks reachable")
-        best = max(v[0] for v in ok.values())
-        good = [i for i, v in ok.items() if v[0] == best]
+        accused = self._accused(vals)
+        innocent = {i: m for i, m in vals.items() if i not in accused}
+        split = not innocent
+        if split:
+            good: list[int] = []
+            best = max(m["version"] for m in vals.values())
+        else:
+            best = max(m["version"] for m in innocent.values())
+            good = [i for i, m in innocent.items()
+                    if m["version"] == best]
         bad = [i for i in range(self.n) if i not in good]
-        dirty = any(v[1] != (0, 0) for v in ok.values())
+        dirty = any(m["dirty"] != (0, 0) for m in vals.values())
         return {"good": good, "bad": bad, "version": best,
-                "per_brick": versions, "dirty": dirty}
+                "per_brick": per_brick, "dirty": dirty,
+                "split_brain": split, "accused": sorted(accused)}
 
-    async def heal_file(self, path: str) -> dict:
+    def _policy_pick(self, stats: dict[int, "Iatt"], policy: str) -> int:
+        """Choose a split-brain source per favorite-child-policy
+        (afr_sh_get_fav_by_policy): biggest file, latest mtime, or the
+        most common (size, mtime) copy."""
+        if not stats:
+            raise FopError(errno.ENOTCONN, "no replica stat-able")
+        if policy == "size":
+            return max(stats, key=lambda i: stats[i].size)
+        if policy == "mtime":
+            return max(stats, key=lambda i: (stats[i].mtime, i))
+        if policy == "majority":
+            groups: dict[tuple, list[int]] = {}
+            for i, ia in stats.items():
+                groups.setdefault((ia.size, ia.mtime), []).append(i)
+            members = max(groups.values(), key=len)
+            if len(members) * 2 > len(stats):
+                return members[0]
+        raise FopError(errno.EIO, "no policy winner")
+
+    async def split_brain_resolve(self, path: str, policy: str,
+                                  source: int = -1) -> dict:
+        """glfs-heal.c split-brain resolution: bigger-file |
+        latest-mtime | source-brick <idx>.  Copies the chosen replica
+        over the others and clears the mutual blame."""
+        loc = Loc(path)
+        info = await self.heal_info(loc)
+        if not info["split_brain"] and policy != "source-brick":
+            raise FopError(errno.EINVAL,
+                           f"{path} is not in split-brain")
+        live = self._up_idx()
+        if policy == "source-brick":
+            if source not in range(self.n):
+                raise FopError(errno.EINVAL, f"bad source {source}")
+            src = source
+        else:
+            stats = {}
+            for i in live:
+                if i in self.arbiters:
+                    continue  # 0-byte witness: never a policy winner
+                try:
+                    stats[i] = await self.children[i].stat(loc)
+                except FopError:
+                    continue
+            if not stats:
+                raise FopError(errno.ENOTCONN, "no replica reachable")
+            key = {"bigger-file": "size",
+                   "latest-mtime": "mtime"}.get(policy, policy)
+            src = self._policy_pick(stats, key)
+        return await self.heal_file(path, source=src)
+
+    async def heal_file(self, path: str, source: int = -1) -> dict:
         loc = Loc(path)
         info = await self.heal_info(loc)
         good, bad = info["good"], info["bad"]
+        if info["split_brain"] and source < 0:
+            # automatic resolution only under an explicit policy
+            policy = self.opts["favorite-child-policy"]
+            fav = self.opts["favorite-child"]
+            if policy != "none":
+                stats = {}
+                for i in self._up_idx():
+                    if i in self.arbiters:
+                        continue
+                    try:
+                        stats[i] = await self.children[i].stat(loc)
+                    except FopError:
+                        continue
+                source = self._policy_pick(stats, policy)
+            elif fav >= 0:
+                source = fav
+            else:
+                raise FopError(errno.EIO,
+                               f"{path}: split-brain; resolve with heal "
+                               f"split-brain or favorite-child-policy")
+        if source >= 0:
+            good = [source]
+            bad = [i for i in range(self.n) if i != source]
         if not good:
             raise FopError(errno.EIO, "no heal source")
         fav = self.opts["favorite-child"]
-        src = fav if fav in good else good[0]
+        data_good = [i for i in good if i not in self.arbiters]
+        if not data_good:
+            raise FopError(errno.EIO,
+                           "no data replica to heal from (arbiter only)")
+        src = fav if fav in data_good else data_good[0]
         if not bad:
             if not info.get("dirty"):
                 return {"healed": [], "skipped": True}
@@ -569,25 +828,44 @@ class ReplicateLayer(Layer):
             off = 0
             from ..features.bit_rot_stub import HEAL_WRITE
 
+            # arbiter sinks take only the metadata fix below, no data
+            data_bad = [i for i in bad if i not in self.arbiters]
             while off < src_ia.size:
                 chunk = await self.children[src].readv(
                     sfd, min(window, src_ia.size - off), off)
                 await self._dispatch(
-                    bad, "writev",
+                    data_bad, "writev",
                     lambda i: ((FdObj(ia.gfid, path=path, anonymous=True),
                                 chunk, off),
                                {"xdata": {HEAL_WRITE: True}}))
                 off += len(chunk)
-            await self._dispatch(bad, "truncate",
+            await self._dispatch(data_bad, "truncate",
                                  lambda i: ((loc, src_ia.size), {}))
             meta = await self._get_meta([src], loc)
+            zero_pend = {XA_PENDING + str(j): _pack_u64x2(0, 0)
+                         for j in range(self.n)}
+            # healed sinks: adopt the source's version, drop dirty AND
+            # their stale accusations of others
             fix = {XA_VERSION: _pack_u64x2(*meta[src]["version"]),
-                   XA_DIRTY: _pack_u64x2(0, 0)}
-            await self._dispatch(bad, "setxattr",
-                                 lambda i: ((loc, dict(fix)), {}))
+                   XA_DIRTY: _pack_u64x2(0, 0), **zero_pend}
+            fres = await self._dispatch(bad, "setxattr",
+                                        lambda i: ((loc, dict(fix)), {}))
+            healed = [i for i in bad
+                      if not isinstance(fres.get(i), BaseException)]
+            failed = [i for i in bad if i not in healed]
+            # sources keep blaming sinks whose heal did NOT land —
+            # clearing their pending (or their thin-arbiter brand)
+            # would let an unhealed stale replica serve alone later
+            keep = {XA_PENDING + str(j) for j in failed}
             await self._dispatch(good, "setxattr", lambda i: (
-                (loc, {XA_DIRTY: _pack_u64x2(0, 0)}), {}))
-            return {"healed": bad, "skipped": False, "source": src}
+                (loc, {XA_DIRTY: _pack_u64x2(0, 0),
+                       **{k: v for k, v in zero_pend.items()
+                          if k not in keep}}), {}))
+            if not failed:
+                self._sb_cache.discard(bytes(ia.gfid))
+            await self._ta_clear(healed)
+            return {"healed": healed, "failed": failed,
+                    "skipped": False, "source": src}
 
     async def heal_entry(self, path: str = "/") -> dict:
         """Directory entry heal: union the listings, copy missing entries
@@ -625,7 +903,12 @@ class ReplicateLayer(Layer):
                 except FopError:
                     continue
             if src_ia.ia_type is not IAType.DIR:
-                await self.heal_file(child_path)
+                try:
+                    await self.heal_file(child_path)
+                except FopError:
+                    # a split-brained (or unreachable) file must not
+                    # stop the rest of the directory from healing
+                    continue
         return {"created": created}
 
     def dump_private(self) -> dict:

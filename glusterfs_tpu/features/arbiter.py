@@ -1,0 +1,70 @@
+"""features/arbiter — metadata-only third replica.
+
+Reference: xlators/features/arbiter (arbiter.c): the last brick of an
+arbiter replica-3 group stores every file's *metadata* (entry, gfid,
+afr xattrs) but no data — it exists to witness transactions so a
+2-data-brick volume cannot split-brain.  The brick-side layer makes
+that true mechanically:
+
+* ``writev`` succeeds without touching data (file length on the brick
+  stays 0; the fop still flows through locks/index/xattrop so version
+  and pending accounting are identical to a data brick);
+* data reads fail EINVAL (arbiter_readv) — the client never elects an
+  arbiter for reads;
+* truncate-class fops succeed as metadata no-ops.
+
+The client half lives in cluster/afr: ``arbiter-count`` excludes the
+group's last brick from read candidates, data heal, and size/policy
+decisions.
+"""
+
+from __future__ import annotations
+
+import errno
+
+from ..core.fops import FopError
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+
+
+@register("features/arbiter")
+class ArbiterLayer(Layer):
+    OPTIONS = (
+        Option("arbiter", "bool", default="on"),
+    )
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        """Ack the full write, store nothing (arbiter_writev returns
+        iov_length without winding the data)."""
+        ia = await self.children[0].fstat(fd)
+        ia.size = 0
+        return ia
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        raise FopError(errno.EINVAL, "arbiter holds no data")
+
+    async def truncate(self, loc: Loc, size: int,
+                       xdata: dict | None = None):
+        return await self.children[0].stat(loc)
+
+    async def ftruncate(self, fd: FdObj, size: int,
+                        xdata: dict | None = None):
+        return await self.children[0].fstat(fd)
+
+    async def fallocate(self, fd: FdObj, mode: int, offset: int,
+                        length: int, xdata: dict | None = None):
+        return await self.children[0].fstat(fd)
+
+    async def discard(self, fd: FdObj, offset: int, length: int,
+                      xdata: dict | None = None):
+        return await self.children[0].fstat(fd)
+
+    async def zerofill(self, fd: FdObj, offset: int, length: int,
+                       xdata: dict | None = None):
+        return await self.children[0].fstat(fd)
+
+    async def seek(self, fd: FdObj, offset: int, what: str = "data",
+                   xdata: dict | None = None):
+        raise FopError(errno.EINVAL, "arbiter holds no data")

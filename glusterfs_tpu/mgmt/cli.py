@@ -76,13 +76,21 @@ async def _run(args) -> Any:
                 vtype = "replicate"
                 group = int(rest[1])
                 rest = rest[2:]
+            arbiter = thin = 0
+            if rest and rest[0] == "arbiter":
+                arbiter = int(rest[1])
+                rest = rest[2:]
+            if rest and rest[0] == "thin-arbiter":
+                thin = int(rest[1])
+                rest = rest[2:]
             bricks = [{"path": b.split(":", 1)[-1],
                        "host": "127.0.0.1"} for b in rest]
             async with MgmtClient(host, port) as c:
                 return await c.call("volume-create", name=args.name,
                                     vtype=vtype, bricks=bricks,
                                     redundancy=redundancy,
-                                    group_size=group)
+                                    group_size=group, arbiter=arbiter,
+                                    thin_arbiter=thin)
         if sub in ("start", "stop", "delete", "status"):
             async with MgmtClient(host, port) as c:
                 return await c.call(f"volume-{sub}", name=args.name)
@@ -100,6 +108,15 @@ async def _run(args) -> Any:
                 top = _find_cluster_layer(client.graph)
                 from ..core.layer import Loc
 
+                if args.args and args.args[0] == "split-brain":
+                    # heal NAME split-brain bigger-file|latest-mtime PATH
+                    #                      |source-brick IDX PATH
+                    policy = args.args[1]
+                    if policy == "source-brick":
+                        return await top.split_brain_resolve(
+                            args.args[3], policy, int(args.args[2]))
+                    return await top.split_brain_resolve(args.args[2],
+                                                         policy)
                 path = args.args[1] if len(args.args) > 1 else \
                     (args.args[0] if args.args and
                      args.args[0] != "info" else "/")

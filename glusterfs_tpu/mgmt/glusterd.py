@@ -286,7 +286,9 @@ class Glusterd:
 
     async def op_volume_create(self, name: str, vtype: str,
                                bricks: list, redundancy: int = 2,
-                               group_size: int = 0) -> dict:
+                               group_size: int = 0,
+                               arbiter: int = 0,
+                               thin_arbiter: int = 0) -> dict:
         """bricks: list of {host, port(optional: mgmt node), path} or
         'host:/path' strings; host must match a node's host:port mgmt id
         or 'localhost'."""
@@ -318,6 +320,16 @@ class Glusterd:
         }
         if group_size:
             volinfo["group-size"] = group_size
+        if arbiter:
+            if vtype != "replicate" or arbiter != 1:
+                raise MgmtError("arbiter needs a replicate volume and "
+                                "arbiter count 1")
+            volinfo["arbiter"] = 1
+        if thin_arbiter:
+            if vtype != "replicate" or len(parsed) != 3 or arbiter:
+                raise MgmtError("thin-arbiter needs replica 2 + one "
+                                "tie-breaker brick (3 bricks)")
+            volinfo["thin-arbiter"] = 1
         if vtype == "disperse":
             n = len(parsed)
             g = group_size or n

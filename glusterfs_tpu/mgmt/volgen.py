@@ -37,7 +37,8 @@ OPTION_MAP = {
     # consumed by glusterd's shd spawner, not a graph layer
     "cluster.heal-timeout": ("mgmt/shd", "interval"),
     "cluster.read-hash-mode": ("cluster/replicate", "read-hash-mode"),
-    "cluster.favorite-child-policy": ("cluster/replicate", "favorite-child"),
+    "cluster.favorite-child-policy": ("cluster/replicate",
+                                      "favorite-child-policy"),
     "cluster.lookup-unhashed": ("cluster/distribute", "lookup-unhashed"),
     "cluster.min-free-disk": ("cluster/distribute", "min-free-disk"),
     "network.ping-timeout": ("protocol/client", "ping-timeout"),
@@ -130,6 +131,14 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
     out = [_emit(f"{name}-posix", "storage/posix",
                  {"directory": brick["path"]}, [])]
     top = f"{name}-posix"
+    # metadata-only witness brick: last of each replica group when the
+    # volume was created with `arbiter 1` (arbiter.c sits above posix)
+    if volinfo.get("arbiter"):
+        g = volinfo.get("group-size") or len(volinfo["bricks"])
+        if brick["index"] % g == g - 1:
+            out.append(_emit(f"{name}-arbiter", "features/arbiter", {},
+                             [top]))
+            top = f"{name}-arbiter"
     # fop journal directly above posix (server_graph_table order);
     # geo-rep create enables it (default off: no consumer, no journal)
     if _enabled(volinfo, "changelog.changelog", False):
@@ -253,6 +262,12 @@ def build_client_volfile(volinfo: dict,
         elif vtype == "replicate":
             lname = f"{vname}-replicate-{idx}"
             opts = layer_options(volinfo, "cluster/replicate")
+            if volinfo.get("arbiter"):
+                opts["arbiter-count"] = volinfo["arbiter"]
+            if volinfo.get("thin-arbiter"):
+                # single group: the volume's LAST brick is the
+                # tie-breaker child (thin-arbiter.rc layout)
+                opts["thin-arbiter"] = "on"
             out.append(_emit(lname, "cluster/replicate", opts, children))
         else:
             raise ValueError(vtype)
@@ -264,6 +279,8 @@ def build_client_volfile(volinfo: dict,
         out.append(_emit(top, "cluster/distribute", opts, names))
     elif vtype in ("disperse", "replicate"):
         group = volinfo.get("group-size", len(names))
+        if volinfo.get("thin-arbiter"):
+            group = len(names)  # 2 data + tie-breaker, one group
         if len(names) > group:  # distributed-disperse / -replicate
             subs = [cluster_over(names[i:i + group], i // group)
                     for i in range(0, len(names), group)]

@@ -1,0 +1,155 @@
+"""Arbiter replica: metadata-only witness brick prevents split-brain
+(reference features/arbiter + tests/basic/afr/arbiter.t)."""
+
+import asyncio
+import errno
+import os
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc
+
+VOLFILE = """
+volume b0
+    type storage/posix
+    option directory {base}/brick0
+end-volume
+
+volume b1
+    type storage/posix
+    option directory {base}/brick1
+end-volume
+
+volume b2p
+    type storage/posix
+    option directory {base}/brick2
+end-volume
+
+volume b2
+    type features/arbiter
+    subvolumes b2p
+end-volume
+
+volume repl
+    type cluster/replicate
+    option arbiter-count 1
+    subvolumes b0 b1 b2
+end-volume
+"""
+
+
+def _mk(base):
+    return Graph.construct(VOLFILE.format(base=base))
+
+
+def test_arbiter_stores_no_data(tmp_path):
+    async def run():
+        g = _mk(tmp_path)
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/f", b"payload-bytes")
+        assert await c.read_file("/f") == b"payload-bytes"
+        # data bricks hold the bytes, the arbiter brick holds none
+        for i, expect in ((0, 13), (1, 13), (2, 0)):
+            p = tmp_path / f"brick{i}" / "f"
+            assert p.exists()
+            assert p.stat().st_size == expect, (i, p.stat().st_size)
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_arbiter_witness_blocks_split_brain(tmp_path):
+    """The arbiter's whole point: with one data brick down the other
+    data brick + arbiter form quorum and blame it; the stale brick can
+    then never be written while the fresh one is down (no mutual
+    blame, no split-brain)."""
+    async def run():
+        g = _mk(tmp_path)
+        c = Client(g)
+        await c.mount()
+        afr = g.top
+        await c.write_file("/f", b"common")
+        # partition data brick 1 away; write succeeds via b0+arbiter
+        afr.set_child_up(1, False)
+        await c.write_file("/f", b"newer-content")
+        afr.set_child_up(1, True)
+        # now partition b0: the would-be split-brain write must FAIL,
+        # because b1 is blamed by both b0's and the arbiter's matrices
+        afr.set_child_up(0, False)
+        with pytest.raises(FopError):
+            await c.read_file("/f")  # b1 stale, arbiter dataless
+        afr.set_child_up(0, True)
+        info = await afr.heal_info(Loc("/f"))
+        assert info["split_brain"] is False
+        assert 1 in info["accused"]
+        out = await afr.heal_file("/f")
+        assert out["source"] == 0
+        assert 1 in out["healed"]
+        assert await c.read_file("/f") == b"newer-content"
+        # arbiter copy is healed metadata-only (still 0 bytes)
+        assert (tmp_path / "brick2" / "f").stat().st_size == 0
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_arbiter_never_serves_reads(tmp_path):
+    async def run():
+        g = _mk(tmp_path)
+        c = Client(g)
+        await c.mount()
+        afr = g.top
+        await c.write_file("/r", b"data")
+        # only the arbiter up: reads must refuse, not return zeros
+        afr.set_child_up(0, False)
+        afr.set_child_up(1, False)
+        with pytest.raises(FopError):
+            await c.read_file("/r")
+        afr.set_child_up(0, True)
+        afr.set_child_up(1, True)
+        assert await c.read_file("/r") == b"data"
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_managed_arbiter_volume(tmp_path):
+    """volume create replica 3 arbiter 1: volgen puts features/arbiter
+    on the last brick and arbiter-count on the client graph."""
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+    from glusterfs_tpu.core.layer import walk
+
+    async def run():
+        gd = Glusterd(str(tmp_path / "gd"))
+        await gd.start()
+        async with MgmtClient(gd.host, gd.port) as c:
+            bricks = [{"path": str(tmp_path / f"b{i}")} for i in range(3)]
+            await c.call("volume-create", name="arb", vtype="replicate",
+                         bricks=bricks, group_size=3, arbiter=1)
+            await c.call("volume-start", name="arb")
+        cl = await mount_volume(gd.host, gd.port, "arb")
+        try:
+            subs = [l for l in walk(cl.graph.top)
+                    if l.type_name == "protocol/client"]
+            for _ in range(150):
+                if all(l.connected for l in subs):
+                    break
+                await asyncio.sleep(0.1)
+            afr = next(l for l in walk(cl.graph.top)
+                       if l.type_name == "cluster/replicate")
+            assert afr.arbiters == {2}
+            await cl.write_file("/x", b"managed-arbiter")
+            assert await cl.read_file("/x") == b"managed-arbiter"
+            assert os.path.getsize(tmp_path / "b2" / "x") == 0
+            assert os.path.getsize(tmp_path / "b0" / "x") == 15
+        finally:
+            await cl.unmount()
+            await gd.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,164 @@
+"""AFR split-brain: mutual-blame detection via the pending matrix,
+read/write fencing, and glfsheal-style resolution (reference
+afr_selfheal_find_direction, glfs-heal.c:53,1201, heal split-brain
+CLI)."""
+
+import asyncio
+import errno
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc
+
+VOLFILE = """
+volume b0
+    type storage/posix
+    option directory {base}/brick0
+end-volume
+
+volume b1
+    type storage/posix
+    option directory {base}/brick1
+end-volume
+
+volume repl
+    type cluster/replicate
+    option quorum-count 1
+{extra}    subvolumes b0 b1
+end-volume
+"""
+
+
+def _mk(base, **opts):
+    extra = "".join(f"    option {k} {v}\n" for k, v in opts.items())
+    return Graph.construct(VOLFILE.format(base=base, extra=extra))
+
+
+async def _make_split_brain(c, afr, path="/f"):
+    """Classic 2-replica split-brain: write to each side while the
+    other is partitioned away."""
+    await c.write_file(path, b"common")
+    afr.set_child_up(1, False)
+    await c.write_file(path, b"side-A-content")  # b0 blames b1
+    afr.set_child_up(1, True)
+    afr.set_child_up(0, False)
+    await c.write_file(path, b"side-B!")         # b1 blames b0
+    afr.set_child_up(0, True)
+
+
+def test_split_brain_detected_and_fenced(tmp_path):
+    async def run():
+        g = _mk(tmp_path)
+        c = Client(g)
+        await c.mount()
+        afr = g.top
+        await _make_split_brain(c, afr)
+        info = await afr.heal_info(Loc("/f"))
+        assert info["split_brain"] is True
+        assert sorted(info["accused"]) == [0, 1]  # mutual blame
+        # reads refuse to pick a side
+        with pytest.raises(FopError) as ei:
+            await c.read_file("/f")
+        assert ei.value.err == errno.EIO
+        # plain heal refuses without a policy
+        with pytest.raises(FopError):
+            await afr.heal_file("/f")
+        # writes on the known-split file are fenced too
+        with pytest.raises(FopError):
+            await c.write_file("/f", b"new")
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_split_brain_resolve_bigger_file(tmp_path):
+    async def run():
+        g = _mk(tmp_path)
+        c = Client(g)
+        await c.mount()
+        await _make_split_brain(c, g.top)
+        out = await g.top.split_brain_resolve("/f", "bigger-file")
+        assert out["source"] == 0  # side-A-content is longer
+        assert await c.read_file("/f") == b"side-A-content"
+        info = await g.top.heal_info(Loc("/f"))
+        assert info["split_brain"] is False and not info["accused"]
+        # volume is fully writable again
+        await c.write_file("/f", b"post-heal")
+        assert await c.read_file("/f") == b"post-heal"
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_split_brain_resolve_latest_mtime(tmp_path):
+    async def run():
+        g = _mk(tmp_path)
+        c = Client(g)
+        await c.mount()
+        await _make_split_brain(c, g.top)  # side-B written last
+        out = await g.top.split_brain_resolve("/f", "latest-mtime")
+        assert out["source"] == 1
+        assert await c.read_file("/f") == b"side-B!"
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_split_brain_resolve_source_brick(tmp_path):
+    async def run():
+        g = _mk(tmp_path)
+        c = Client(g)
+        await c.mount()
+        await _make_split_brain(c, g.top)
+        out = await g.top.split_brain_resolve("/f", "source-brick",
+                                              source=1)
+        assert out["source"] == 1
+        assert await c.read_file("/f") == b"side-B!"
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_favorite_child_policy_auto_heal(tmp_path):
+    """cluster.favorite-child-policy size: heal_file auto-resolves
+    without an operator decision (shd crawl path)."""
+    async def run():
+        g = _mk(tmp_path, **{"favorite-child-policy": "size"})
+        c = Client(g)
+        await c.mount()
+        await _make_split_brain(c, g.top)
+        out = await g.top.heal_file("/f")
+        assert out["source"] == 0
+        assert await c.read_file("/f") == b"side-A-content"
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_stale_brick_not_split_brain(tmp_path):
+    """One-sided blame is NOT split-brain: the blamed brick is just
+    stale and heals automatically toward the innocent source."""
+    async def run():
+        g = _mk(tmp_path)
+        c = Client(g)
+        await c.mount()
+        afr = g.top
+        await c.write_file("/s", b"v1")
+        afr.set_child_up(1, False)
+        await c.write_file("/s", b"v2-longer")
+        afr.set_child_up(1, True)
+        info = await afr.heal_info(Loc("/s"))
+        assert info["split_brain"] is False
+        assert info["good"] == [0] and 1 in info["accused"]
+        # reads keep working (served from the source)
+        assert await c.read_file("/s") == b"v2-longer"
+        out = await afr.heal_file("/s")
+        assert out["source"] == 0 and out["healed"] == [1]
+        info = await afr.heal_info(Loc("/s"))
+        assert info["good"] == [0, 1] and not info["accused"]
+        await c.unmount()
+
+    asyncio.run(run())

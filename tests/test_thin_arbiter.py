@@ -1,0 +1,165 @@
+"""Thin-arbiter: remote tie-breaker for 2-way replication (reference
+features/thin-arbiter + tests/thin-arbiter.rc).  One mark file per
+volume — a degraded write brands the absent replica bad there, and the
+branded replica may never serve alone."""
+
+import asyncio
+import errno
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc
+
+VOLFILE = """
+volume b0
+    type storage/posix
+    option directory {base}/brick0
+end-volume
+
+volume b1
+    type storage/posix
+    option directory {base}/brick1
+end-volume
+
+volume ta
+    type storage/posix
+    option directory {base}/ta
+end-volume
+
+volume repl
+    type cluster/replicate
+    option thin-arbiter on
+    subvolumes b0 b1 ta
+end-volume
+"""
+
+
+@pytest.fixture
+def vol(tmp_path):
+    g = Graph.construct(VOLFILE.format(base=tmp_path))
+    c = Client(g)
+
+    async def setup():
+        await c.mount()
+    asyncio.run(setup())
+    return c, g.top, tmp_path
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_ta_degraded_write_and_fencing(tmp_path):
+    async def run():
+        g = Graph.construct(VOLFILE.format(base=tmp_path))
+        c = Client(g)
+        await c.mount()
+        afr = g.top
+        assert afr.n == 2 and afr.ta is not None
+        await c.write_file("/f", b"common")
+        # replica 1 dies: the survivor writes under a TA grant
+        afr.set_child_up(1, False)
+        await c.write_file("/f", b"fresh-from-b0")
+        marks = await afr._ta_marks()
+        assert 1 in marks  # b1 branded bad on the tie-breaker
+        # b1 returns, b0 dies: the branded replica must not serve
+        afr.set_child_up(1, True)
+        afr.set_child_up(0, False)
+        with pytest.raises(FopError) as ei:
+            await c.read_file("/f")
+        assert ei.value.err == errno.EIO
+        with pytest.raises(FopError):
+            await c.truncate("/f", 0)  # writes fenced too
+        # b0 back: reads work, heal clears the marks
+        afr.set_child_up(0, True)
+        assert await c.read_file("/f") == b"fresh-from-b0"
+        out = await afr.heal_file("/f")
+        assert out["source"] == 0 and 1 in out["healed"]
+        assert await afr._ta_marks() == {}
+        # roles can now swap: b0 down, b1 serves under a new grant
+        afr.set_child_up(0, False)
+        await c.write_file("/f", b"now-via-b1")
+        assert (await afr._ta_marks()).get(0)
+        assert await c.read_file("/f") == b"now-via-b1"
+        afr.set_child_up(0, True)
+        await c.unmount()
+
+    _run(run())
+
+
+def test_ta_unreachable_blocks_degraded_writes(tmp_path):
+    """2 of 3 down (peer + tie-breaker): no grant, no write — but with
+    both replicas up the tie-breaker is not needed at all."""
+    async def run():
+        g = Graph.construct(VOLFILE.format(base=tmp_path))
+        c = Client(g)
+        await c.mount()
+        afr = g.top
+        afr.ta_up = False
+        await c.write_file("/f", b"both-up-no-ta")  # TA not consulted
+        assert await c.read_file("/f") == b"both-up-no-ta"
+        afr.set_child_up(1, False)
+        with pytest.raises(FopError):
+            await c.truncate("/f", 0)
+        afr.set_child_up(1, True)
+        afr.ta_up = True
+        await c.unmount()
+
+    _run(run())
+
+
+def test_ta_never_sees_data_files(tmp_path):
+    async def run():
+        g = Graph.construct(VOLFILE.format(base=tmp_path))
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/data", b"x" * 100)
+        await c.mkdir("/d")
+        # the tie-breaker brick holds only its mark file, never data
+        names = {p.name for p in tmp_path.joinpath("ta").iterdir()
+                 if not p.name.startswith(".")}
+        assert names == set(), names
+        await c.unmount()
+
+    _run(run())
+
+
+@pytest.mark.slow
+def test_managed_thin_arbiter_volume(tmp_path):
+    """volume create replica 2 thin-arbiter 1: volgen marks the last
+    brick as the tie-breaker child of a single replicate group."""
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+    from glusterfs_tpu.core.layer import walk
+
+    async def run():
+        gd = Glusterd(str(tmp_path / "gd"))
+        await gd.start()
+        async with MgmtClient(gd.host, gd.port) as c:
+            bricks = [{"path": str(tmp_path / "b0")},
+                      {"path": str(tmp_path / "b1")},
+                      {"path": str(tmp_path / "ta")}]
+            await c.call("volume-create", name="tav", vtype="replicate",
+                         bricks=bricks, group_size=2, thin_arbiter=1)
+            await c.call("volume-start", name="tav")
+        cl = await mount_volume(gd.host, gd.port, "tav")
+        try:
+            subs = [l for l in walk(cl.graph.top)
+                    if l.type_name == "protocol/client"]
+            for _ in range(150):
+                if all(l.connected for l in subs):
+                    break
+                await asyncio.sleep(0.1)
+            afr = next(l for l in walk(cl.graph.top)
+                       if l.type_name == "cluster/replicate")
+            assert afr.n == 2 and afr.ta is not None
+            await cl.write_file("/x", b"ta-managed")
+            assert await cl.read_file("/x") == b"ta-managed"
+        finally:
+            await cl.unmount()
+            await gd.stop()
+
+    asyncio.run(run())
