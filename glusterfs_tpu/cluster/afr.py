@@ -103,6 +103,9 @@ class ReplicateLayer(Layer):
         self._sb_cache: set[bytes] = set()
         self.ta = None
         self.ta_up = True
+        # replicas already branded bad on the tie-breaker by THIS mount:
+        # steady-state degraded writes skip the TA round trips
+        self._ta_branded: set[int] = set()
         if self.opts["thin-arbiter"]:
             # the tie-breaker child is NOT a replica: it leaves the
             # data-plane index space entirely
@@ -132,11 +135,15 @@ class ReplicateLayer(Layer):
             idx = self.children.index(source)
             if idx >= self.n:  # the thin-arbiter child
                 self.ta_up = event is not Event.CHILD_DOWN
+                self._ta_branded.clear()  # re-verify after reconnect
                 return
             if event is Event.CHILD_DOWN:
                 self.up[idx] = False
             elif event is Event.CHILD_UP:
                 self.up[idx] = True
+                # a returning peer may have been healed and un-branded
+                # by another mount: drop the cached grant
+                self._ta_branded.discard(idx)
             ev = Event.CHILD_UP if sum(self.up) >= self._quorum() else \
                 Event.CHILD_DOWN
             for p in self.parents:
@@ -252,6 +259,7 @@ class ReplicateLayer(Layer):
     async def _ta_clear(self, healed: list[int]) -> None:
         if self.ta is None:
             return
+        self._ta_branded.difference_update(healed)
         try:
             await self.ta.setxattr(
                 Loc(self.TA_PATH),
@@ -288,6 +296,10 @@ class ReplicateLayer(Layer):
             raise FopError(errno.EIO,
                            f"{loc.path}: split-brain (every replica "
                            f"blamed; resolve with heal split-brain)")
+        if loc.gfid:
+            # the divergence may have been resolved by another mount
+            # (the CLI heals on its own client); un-fence local writes
+            self._sb_cache.discard(bytes(loc.gfid))
         clean = {i: m for i, m in innocent.items() if m["dirty"] == (0, 0)}
         pool = clean or innocent
         best = max(m["version"] for m in pool.values())
@@ -592,14 +604,18 @@ class ReplicateLayer(Layer):
                                    f"{op}: no data replica up")
                 # tie-breaker gate: the lone survivor may take writes
                 # only after branding the absent replica bad — and never
-                # if it is itself the branded one
-                marks = await self._ta_marks()
-                if any(i in marks for i in idxs):
-                    raise FopError(errno.EIO,
-                                   f"{op}: this replica is marked bad "
-                                   f"on the thin-arbiter")
+                # if it is itself the branded one.  A grant this mount
+                # already obtained is cached (one TA trip per outage,
+                # not per write).
                 down = [j for j in range(self.n) if j not in idxs]
-                await self._ta_mark_bad(down)
+                if not set(down) <= self._ta_branded:
+                    marks = await self._ta_marks()
+                    if any(i in marks for i in idxs):
+                        raise FopError(errno.EIO,
+                                       f"{op}: this replica is marked "
+                                       f"bad on the thin-arbiter")
+                    await self._ta_mark_bad(down)
+                    self._ta_branded |= set(down)
             await self._dispatch(
                 idxs, "xattrop",
                 lambda i: ((loc, "add64",
@@ -732,6 +748,17 @@ class ReplicateLayer(Layer):
                 return members[0]
         raise FopError(errno.EIO, "no policy winner")
 
+    async def _policy_stats(self, loc: Loc) -> dict:
+        stats = {}
+        for i in self._up_idx():
+            if i in self.arbiters:
+                continue  # 0-byte witness: never a policy winner
+            try:
+                stats[i] = await self.children[i].stat(loc)
+            except FopError:
+                continue
+        return stats
+
     async def split_brain_resolve(self, path: str, policy: str,
                                   source: int = -1) -> dict:
         """glfs-heal.c split-brain resolution: bigger-file |
@@ -742,22 +769,12 @@ class ReplicateLayer(Layer):
         if not info["split_brain"] and policy != "source-brick":
             raise FopError(errno.EINVAL,
                            f"{path} is not in split-brain")
-        live = self._up_idx()
         if policy == "source-brick":
             if source not in range(self.n):
                 raise FopError(errno.EINVAL, f"bad source {source}")
             src = source
         else:
-            stats = {}
-            for i in live:
-                if i in self.arbiters:
-                    continue  # 0-byte witness: never a policy winner
-                try:
-                    stats[i] = await self.children[i].stat(loc)
-                except FopError:
-                    continue
-            if not stats:
-                raise FopError(errno.ENOTCONN, "no replica reachable")
+            stats = await self._policy_stats(loc)
             key = {"bigger-file": "size",
                    "latest-mtime": "mtime"}.get(policy, policy)
             src = self._policy_pick(stats, key)
@@ -772,15 +789,8 @@ class ReplicateLayer(Layer):
             policy = self.opts["favorite-child-policy"]
             fav = self.opts["favorite-child"]
             if policy != "none":
-                stats = {}
-                for i in self._up_idx():
-                    if i in self.arbiters:
-                        continue
-                    try:
-                        stats[i] = await self.children[i].stat(loc)
-                    except FopError:
-                        continue
-                source = self._policy_pick(stats, policy)
+                source = self._policy_pick(
+                    await self._policy_stats(loc), policy)
             elif fav >= 0:
                 source = fav
             else:

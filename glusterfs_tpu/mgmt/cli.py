@@ -111,12 +111,25 @@ async def _run(args) -> Any:
                 if args.args and args.args[0] == "split-brain":
                     # heal NAME split-brain bigger-file|latest-mtime PATH
                     #                      |source-brick IDX PATH
-                    policy = args.args[1]
+                    usage = ("usage: volume heal NAME split-brain "
+                             "{bigger-file|latest-mtime} PATH | "
+                             "source-brick IDX PATH")
+                    rest = args.args[1:]
+                    if not rest:
+                        raise SystemExit(usage)
+                    policy = rest[0]
+                    if not hasattr(top, "split_brain_resolve"):
+                        raise SystemExit(
+                            "split-brain resolution is a replicate-"
+                            "volume operation")
                     if policy == "source-brick":
+                        if len(rest) < 3:
+                            raise SystemExit(usage)
                         return await top.split_brain_resolve(
-                            args.args[3], policy, int(args.args[2]))
-                    return await top.split_brain_resolve(args.args[2],
-                                                         policy)
+                            rest[2], policy, int(rest[1]))
+                    if len(rest) < 2:
+                        raise SystemExit(usage)
+                    return await top.split_brain_resolve(rest[1], policy)
                 path = args.args[1] if len(args.args) > 1 else \
                     (args.args[0] if args.args and
                      args.args[0] != "info" else "/")

@@ -321,9 +321,11 @@ class Glusterd:
         if group_size:
             volinfo["group-size"] = group_size
         if arbiter:
-            if vtype != "replicate" or arbiter != 1:
-                raise MgmtError("arbiter needs a replicate volume and "
-                                "arbiter count 1")
+            g = group_size or len(parsed)
+            if vtype != "replicate" or arbiter != 1 or g != 3:
+                # 2 data copies + 1 witness; anything else either has a
+                # single data copy or is shapes gluster also rejects
+                raise MgmtError("arbiter needs replica 3 arbiter 1")
             volinfo["arbiter"] = 1
         if thin_arbiter:
             if vtype != "replicate" or len(parsed) != 3 or arbiter:
@@ -1180,7 +1182,9 @@ class Glusterd:
                  "--top", b["name"] + "-server"],
                 env=env, stdout=subprocess.DEVNULL, stderr=logf)
         self.bricks[b["name"]] = proc
-        deadline = time.time() + 20
+        # generous: a cold interpreter+jax import on a loaded host can
+        # take the better part of a minute
+        deadline = time.time() + 90
         while time.time() < deadline:
             if os.path.exists(portfile):
                 with open(portfile) as f:
@@ -1193,7 +1197,11 @@ class Glusterd:
                     err = f.read().decode(errors="replace")[-2000:]
                 raise MgmtError(f"brick {b['name']} failed: {err}")
             await asyncio.sleep(0.05)
-        raise MgmtError(f"brick {b['name']} did not start")
+        # kill the straggler (terminate -> wait -> kill escalation): an
+        # orphan that binds its port AFTER we give up would serve a
+        # brick glusterd no longer tracks
+        self._kill_brick(b["name"])
+        raise MgmtError(f"brick {b['name']} did not start in time")
 
     def _kill_brick(self, name: str) -> None:
         proc = self.bricks.pop(name, None)

@@ -1,0 +1,1 @@
+"""Ops tooling (reference tools/: glusterfind, gfind_missing_files)."""

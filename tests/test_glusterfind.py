@@ -1,0 +1,131 @@
+"""glusterfind: session-based incremental change lists from the brick
+changelog journals (reference tools/glusterfind + changelog history
+API)."""
+
+import asyncio
+import os
+
+import pytest
+
+from glusterfs_tpu.tools.glusterfind import coalesce
+
+
+def _r(op, path, ts, path2=""):
+    rec = {"ts": ts, "op": op, "path": path, "gfid": ""}
+    if path2:
+        rec["path2"] = path2
+    return rec
+
+
+def test_coalesce_rules():
+    # NEW + writes stays NEW
+    assert coalesce([_r("create", "/a", 1), _r("writev", "/a", 2)]) == \
+        [("NEW", "/a")]
+    # born and died inside the window: nothing
+    assert coalesce([_r("create", "/b", 1), _r("unlink", "/b", 2)]) == []
+    # pre-existing modified then deleted: DELETE
+    assert coalesce([_r("writev", "/c", 1), _r("unlink", "/c", 2)]) == \
+        [("DELETE", "/c")]
+    # metadata-only change: MODIFY
+    assert coalesce([_r("setattr", "/d", 1)]) == [("MODIFY", "/d")]
+    # replica echoes dedupe
+    assert coalesce([_r("create", "/e", 1), _r("create", "/e", 1.001),
+                     _r("writev", "/e", 2), _r("writev", "/e", 2.001)]) \
+        == [("NEW", "/e")]
+    # rename of a pre-existing file
+    assert coalesce([_r("rename", "/f", 1, "/g")]) == \
+        [("RENAME", "/f", "/g")]
+    # NEW then renamed: NEW at the final path
+    assert coalesce([_r("create", "/h", 1),
+                     _r("rename", "/h", 2, "/i")]) == [("NEW", "/i")]
+    # rename chain keeps the original name
+    assert coalesce([_r("rename", "/j", 1, "/k"),
+                     _r("rename", "/k", 2, "/l")]) == \
+        [("RENAME", "/j", "/l")]
+    # delete after re-create is NEW again
+    assert coalesce([_r("unlink", "/m", 1), _r("create", "/m", 2)]) == \
+        [("NEW", "/m")]
+
+
+@pytest.mark.slow
+def test_glusterfind_session_lifecycle(tmp_path):
+    """create -> changes -> pre (lists them) -> post -> pre (empty) ->
+    more changes -> pre (only the new ones), via the real CLI entry."""
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+    from glusterfs_tpu.tools import glusterfind as gf
+    import argparse
+
+    async def run():
+        gd = Glusterd(str(tmp_path / "gd"))
+        await gd.start()
+        async with MgmtClient(gd.host, gd.port) as c:
+            bricks = [{"path": str(tmp_path / f"b{i}")} for i in range(2)]
+            await c.call("volume-create", name="fv", vtype="replicate",
+                         bricks=bricks, group_size=2)
+            await c.call("volume-start", name="fv")
+
+        def ns(**kw):
+            return argparse.Namespace(
+                server=f"{gd.host}:{gd.port}",
+                session_dir=str(tmp_path / "sessions"), **kw)
+
+        await gf.cmd_create(ns(session="s1", volume="fv"))
+        cl = await mount_volume(gd.host, gd.port, "fv")
+        from glusterfs_tpu.core.layer import walk
+        subs = [l for l in walk(cl.graph.top)
+                if l.type_name == "protocol/client"]
+        for _ in range(150):
+            if all(l.connected for l in subs):
+                break
+            await asyncio.sleep(0.1)
+        await cl.write_file("/one", b"1")
+        await cl.mkdir("/dir")
+        await cl.write_file("/dir/two", b"2")
+        await asyncio.sleep(0.05)
+
+        out1 = str(tmp_path / "pre1.txt")
+        r = await gf.cmd_pre(ns(session="s1", volume="fv", outfile=out1))
+        lines = set(open(out1).read().splitlines())
+        assert "NEW /one" in lines and "NEW /dir" in lines \
+            and "NEW /dir/two" in lines, lines
+        assert r["changes"] == len(lines)
+        await gf.cmd_post(ns(session="s1", volume="fv"))
+
+        # nothing new: empty increment
+        out2 = str(tmp_path / "pre2.txt")
+        await gf.cmd_pre(ns(session="s1", volume="fv", outfile=out2))
+        assert open(out2).read() == ""
+        await gf.cmd_post(ns(session="s1", volume="fv"))
+
+        # incremental: only the delta since post
+        await cl.write_file("/one", b"updated")
+        await cl.unlink("/dir/two")
+        await asyncio.sleep(0.05)
+        out3 = str(tmp_path / "pre3.txt")
+        await gf.cmd_pre(ns(session="s1", volume="fv", outfile=out3))
+        lines = set(open(out3).read().splitlines())
+        assert "MODIFY /one" in lines and "DELETE /dir/two" in lines, lines
+        assert not any(l.endswith(" /dir") for l in lines)
+
+        listing = await gf.cmd_list(ns())
+        assert "fv" in listing["s1"]
+        await gf.cmd_delete(ns(session="s1", volume="fv"))
+        assert (await gf.cmd_list(ns())) == {}
+
+        await cl.unmount()
+        await gd.stop()
+
+    asyncio.run(run())
+
+
+def test_coalesce_replica_echo_of_dropped_file():
+    """Both replicas journal create AND unlink: the duplicate unlink
+    must not resurrect a born-and-died file as DELETE (found by the
+    e2e CLI drive on a 2-replica volume)."""
+    recs = [_r("create", "/t", 1), _r("create", "/t", 1.01),
+            _r("writev", "/t", 2), _r("writev", "/t", 2.01),
+            _r("unlink", "/t", 3), _r("unlink", "/t", 3.01)]
+    assert coalesce(recs) == []
+    # but a genuine re-create after the drop is NEW again
+    assert coalesce(recs + [_r("create", "/t", 4)]) == [("NEW", "/t")]
