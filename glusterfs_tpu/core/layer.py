@@ -175,6 +175,7 @@ _TYPE_MODULES = {
     "cluster/disperse": "glusterfs_tpu.cluster.ec",
     "cluster/replicate": "glusterfs_tpu.cluster.afr",
     "cluster/distribute": "glusterfs_tpu.cluster.dht",
+    "meta": "glusterfs_tpu.meta.meta",
 }
 
 

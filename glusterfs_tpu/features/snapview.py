@@ -1,0 +1,342 @@
+"""features/snapview — user-serviceable snapshots: the ``/.snaps``
+virtual directory.
+
+Reference: xlators/features/snapview-client + snapview-server: the
+client half turns ``.snaps`` path components into virtual inodes; the
+server half holds one gfapi instance per activated snapshot volume and
+serves the real data out of it.  Here both halves live in one client
+layer: ``/.snaps`` lists the volume's **activated** snapshots (mgmt
+``snapshot-list``), and ``/.snaps/<snap>/<path>`` proxies read-class
+fops into a lazily-created in-process mount of the snapshot's own
+served volume (``snap-<name>``, spawned by ``snapshot activate`` — the
+snapd analog).  Snapshots are history: every mutation under /.snaps is
+EROFS (the snapshot volume's bricks are read-only anyway, belt and
+braces)."""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import hashlib
+import stat as stat_mod
+import time
+
+from ..core.fops import FopError
+from ..core.iatt import IAType, Iatt
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+from ..core import gflog
+
+log = gflog.get_logger("snapview")
+
+SNAPS = "/.snaps"
+
+
+def _gfid(path: str) -> bytes:
+    return hashlib.md5(b"snaps:" + path.encode(
+        "utf-8", "surrogateescape")).digest()
+
+
+@register("features/snapview")
+class SnapviewLayer(Layer):
+    OPTIONS = (
+        Option("mgmt-server", "str", default="127.0.0.1:24007",
+               description="glusterd endpoint for snapshot-list and "
+                           "snap volume volfiles"),
+        Option("volume", "str", default="",
+               description="parent volume whose snapshots to serve"),
+        Option("refresh-interval", "time", default="2",
+               description="snapshot-list cache lifetime"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._snaps: dict[str, dict] = {}
+        self._snaps_at = 0.0
+        self._mounts: dict[str, object] = {}  # snap -> in-process Client
+
+    async def fini(self):
+        for cl in self._mounts.values():
+            try:
+                await cl.unmount()
+            except Exception:
+                pass
+        self._mounts.clear()
+        await super().fini()
+
+    # -- snapshot discovery / proxy mounts ---------------------------------
+
+    def _mgmt(self):
+        host, _, port = self.opts["mgmt-server"].partition(":")
+        return host, int(port or 24007)
+
+    async def _snapshots(self) -> dict[str, dict]:
+        now = time.monotonic()
+        if now - self._snaps_at > self.opts["refresh-interval"]:
+            from ..mgmt.glusterd import MgmtClient
+
+            host, port = self._mgmt()
+            try:
+                async with MgmtClient(host, port) as c:
+                    out = await c.call("snapshot-list",
+                                       volume=self.opts["volume"])
+                self._snaps = {n: s for n, s in
+                               out.get("snapshots", {}).items()
+                               if s.get("activated")}
+                self._snaps_at = now
+                # a deactivated snapshot's cached proxy mount points at
+                # killed brick ports: drop it (a reactivation respawns
+                # on fresh ports)
+                for gone in set(self._mounts) - set(self._snaps):
+                    await self._drop_mount(gone)
+            except Exception as e:
+                log.debug(1, "snapshot-list failed: %r", e)
+        return self._snaps
+
+    async def _drop_mount(self, snap: str) -> None:
+        cl = self._mounts.pop(snap, None)
+        if cl is not None:
+            try:
+                await cl.unmount()
+            except Exception:
+                pass
+
+    async def _snap_client(self, snap: str):
+        cl = self._mounts.get(snap)
+        if cl is not None:
+            from ..protocol.client import ClientLayer
+            from ..core.layer import walk as _walk
+
+            subs = [l for l in _walk(cl.graph.top)
+                    if isinstance(l, ClientLayer)]
+            if subs and all(l.connected for l in subs):
+                return cl
+            # stale (deactivate/reactivate cycle): rebuild on the
+            # snapshot volume's current ports
+            await self._drop_mount(snap)
+        from ..mgmt.glusterd import mount_volume
+
+        host, port = self._mgmt()
+        cl = await mount_volume(host, port, f"snap-{snap}")
+        self._mounts[snap] = cl
+        return cl
+
+    # -- path splitting ----------------------------------------------------
+
+    @staticmethod
+    def _split(path: str | None):
+        """None if not under /.snaps, else (snap|None, inner path)."""
+        if not path or not (path == SNAPS or
+                            path.startswith(SNAPS + "/")):
+            return None
+        rest = path[len(SNAPS):].lstrip("/")
+        if not rest:
+            return ("", "/")
+        snap, _, inner = rest.partition("/")
+        return (snap, "/" + inner)
+
+    def _root_iatt(self, path: str) -> Iatt:
+        ia = Iatt(gfid=_gfid(path), ia_type=IAType.DIR)
+        ia.mode = stat_mod.S_IFDIR | 0o555
+        ia.nlink = 2
+        ia.atime = ia.mtime = ia.ctime = time.time()
+        return ia
+
+    async def _proxy(self, snap: str, op: str, inner_first, *rest):
+        snaps = await self._snapshots()
+        if snap not in snaps:
+            raise FopError(errno.ENOENT, f"{SNAPS}/{snap}")
+        cl = await self._snap_client(snap)
+        return await getattr(cl.graph.top, op)(inner_first, *rest)
+
+    # -- fops --------------------------------------------------------------
+
+    async def lookup(self, loc: Loc, xdata: dict | None = None):
+        sp = self._split(loc.path)
+        if sp is None:
+            return await self.children[0].lookup(loc, xdata)
+        snap, inner = sp
+        if not snap or inner == "/":
+            if snap and snap not in await self._snapshots():
+                raise FopError(errno.ENOENT, loc.path)
+            return self._root_iatt(loc.path), {}
+        return await self._proxy(snap, "lookup", Loc(inner), xdata)
+
+    async def stat(self, loc: Loc, xdata: dict | None = None):
+        sp = self._split(loc.path)
+        if sp is None:
+            return await self.children[0].stat(loc, xdata)
+        snap, inner = sp
+        if not snap or inner == "/":
+            if snap and snap not in await self._snapshots():
+                raise FopError(errno.ENOENT, loc.path)
+            return self._root_iatt(loc.path)
+        return await self._proxy(snap, "stat", Loc(inner), xdata)
+
+    async def open(self, loc: Loc, flags: int = 0,
+                   xdata: dict | None = None):
+        sp = self._split(loc.path)
+        if sp is None:
+            return await self.children[0].open(loc, flags, xdata)
+        snap, inner = sp
+        if not snap or inner == "/":
+            raise FopError(errno.EISDIR, loc.path)
+        import os as _os
+
+        if flags & (_os.O_WRONLY | _os.O_RDWR):
+            raise FopError(errno.EROFS, "snapshots are read-only")
+        fd = await self._proxy(snap, "open", Loc(inner), flags, xdata)
+        wrapped = FdObj(fd.gfid, flags, path=loc.path)
+        wrapped.ctx_set(self, (snap, fd))
+        return wrapped
+
+    def _inner_fd(self, fd: FdObj):
+        ctx = fd.ctx_get(self)
+        if ctx is None:
+            return None
+        return ctx
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        ctx = self._inner_fd(fd)
+        if ctx is None:
+            return await self.children[0].readv(fd, size, offset, xdata)
+        snap, inner = ctx
+        return await self._proxy(snap, "readv", inner, size, offset,
+                                 xdata)
+
+    async def fstat(self, fd: FdObj, xdata: dict | None = None):
+        ctx = self._inner_fd(fd)
+        if ctx is None:
+            return await self.children[0].fstat(fd, xdata)
+        snap, inner = ctx
+        return await self._proxy(snap, "fstat", inner, xdata)
+
+    async def release(self, fd: FdObj) -> None:
+        ctx = fd.ctx_del(self)
+        if ctx is None:
+            await super().release(fd)
+            return
+        snap, inner = ctx
+        cl = self._mounts.get(snap)
+        if cl is not None:
+            try:
+                await cl.graph.top.release(inner)
+            except Exception:
+                pass
+
+    async def flush(self, fd: FdObj, xdata: dict | None = None):
+        if self._inner_fd(fd) is not None:
+            return {}
+        return await self.children[0].flush(fd, xdata)
+
+    async def opendir(self, loc: Loc, xdata: dict | None = None):
+        sp = self._split(loc.path)
+        if sp is None:
+            return await self.children[0].opendir(loc, xdata)
+        snap, inner = sp
+        if not snap:
+            return FdObj(_gfid(loc.path), path=loc.path)
+        fd = await self._proxy(snap, "opendir", Loc(inner), xdata)
+        wrapped = FdObj(fd.gfid, path=loc.path)
+        wrapped.ctx_set(self, (snap, fd))
+        return wrapped
+
+    async def readdir(self, fd: FdObj, size: int = 0, offset: int = 0,
+                      xdata: dict | None = None):
+        ctx = self._inner_fd(fd)
+        if ctx is None:
+            if fd.path == SNAPS:
+                return [(n, None) for n in
+                        sorted(await self._snapshots())]
+            return await self.children[0].readdir(fd, size, offset,
+                                                  xdata)
+        snap, inner = ctx
+        return await self._proxy(snap, "readdir", inner, size, offset,
+                                 xdata)
+
+    async def readdirp(self, fd: FdObj, size: int = 0, offset: int = 0,
+                       xdata: dict | None = None):
+        ctx = self._inner_fd(fd)
+        if ctx is None:
+            if fd.path == SNAPS:
+                return [(n, self._root_iatt(SNAPS + "/" + n))
+                        for n in sorted(await self._snapshots())]
+            return await self.children[0].readdirp(fd, size, offset,
+                                                   xdata)
+        snap, inner = ctx
+        return await self._proxy(snap, "readdirp", inner, size, offset,
+                                 xdata)
+
+    async def readlink(self, loc: Loc, xdata: dict | None = None):
+        sp = self._split(loc.path)
+        if sp is None:
+            return await self.children[0].readlink(loc, xdata)
+        snap, inner = sp
+        return await self._proxy(snap, "readlink", Loc(inner), xdata)
+
+    async def getxattr(self, loc: Loc, name: str | None = None,
+                       xdata: dict | None = None):
+        sp = self._split(loc.path)
+        if sp is None:
+            return await self.children[0].getxattr(loc, name, xdata)
+        snap, inner = sp
+        if not snap or inner == "/":
+            return {}
+        return await self._proxy(snap, "getxattr", Loc(inner), name,
+                                 xdata)
+
+    async def seek(self, fd: FdObj, offset: int, what: str = "data",
+                   xdata: dict | None = None):
+        ctx = self._inner_fd(fd)
+        if ctx is None:
+            return await self.children[0].seek(fd, offset, what, xdata)
+        snap, inner = ctx
+        return await self._proxy(snap, "seek", inner, offset, what,
+                                 xdata)
+
+    async def fsync(self, fd: FdObj, datasync: int = 0,
+                    xdata: dict | None = None):
+        if self._inner_fd(fd) is not None:
+            return {}  # snapshots are immutable; nothing to sync
+        return await self.children[0].fsync(fd, datasync, xdata)
+
+    def dump_private(self) -> dict:
+        return {"volume": self.opts["volume"],
+                "snapshots": sorted(self._snaps),
+                "mounted": sorted(self._mounts)}
+
+
+def _reject_snaps(op_name: str):
+    async def impl(self, *args, **kwargs):
+        for a in args[:2]:
+            if isinstance(a, Loc) and self._split(a.path) is not None:
+                raise FopError(errno.EROFS, "snapshots are read-only")
+        return await getattr(self.children[0], op_name)(*args, **kwargs)
+    impl.__name__ = op_name
+    return impl
+
+
+for _op in ("unlink", "rmdir", "mkdir", "mknod", "create", "rename",
+            "link", "symlink", "truncate", "setattr", "setxattr",
+            "removexattr"):
+    setattr(SnapviewLayer, _op, _reject_snaps(_op))
+
+
+def _reject_snaps_fd(op_name: str):
+    """fd-carried mutations on a snapshot fd (or any /.snaps path) are
+    EROFS — they must never fall through to the live volume with a
+    foreign gfid."""
+    async def impl(self, fd, *args, **kwargs):
+        if fd.ctx_get(self) is not None or \
+                self._split(fd.path) is not None:
+            raise FopError(errno.EROFS, "snapshots are read-only")
+        return await getattr(self.children[0], op_name)(fd, *args,
+                                                        **kwargs)
+    impl.__name__ = op_name
+    return impl
+
+
+for _op in ("writev", "ftruncate", "fsetattr", "fsetxattr",
+            "fremovexattr", "fallocate", "discard", "zerofill"):
+    setattr(SnapviewLayer, _op, _reject_snaps_fd(_op))

@@ -1,0 +1,235 @@
+"""meta — the virtual ``.meta`` introspection tree on a mounted volume.
+
+Reference: xlators/meta (meta.c:25-34, root-dir.c:17-26): a procfs-like
+directory at the top of every client graph exposing the live graph,
+each xlator's private state and options, and logging knobs; the
+reference test suite reads files like
+``.meta/graphs/active/<vol>-disperse-0/private`` as its introspection
+oracle (tests/ec.rc:1-18) — statedump's interactive twin.
+
+Virtual tree served here:
+
+    /.meta/version                       package version
+    /.meta/logging                       recent in-memory log ring
+    /.meta/graphs/active/<layer>/type    layer type name
+    /.meta/graphs/active/<layer>/options validated live option values
+    /.meta/graphs/active/<layer>/private dump_private() JSON
+    /.meta/graphs/active/<layer>/stats   per-fop call/latency counters
+
+Everything under /.meta is synthesized read-only at access time from
+the layers below this one (walk of the live graph — no caching, the
+whole point is looking at NOW); every other path passes through."""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import json
+import stat as stat_mod
+import time
+
+from ..core.fops import FopError
+from ..core.iatt import IAType, Iatt
+from ..core.layer import FdObj, Layer, Loc, register, walk
+from ..core import gflog
+
+META = "/.meta"
+
+
+def _gfid(path: str) -> bytes:
+    return hashlib.md5(b"meta:" + path.encode(
+        "utf-8", "surrogateescape")).digest()
+
+
+@register("meta")
+class MetaLayer(Layer):
+    """Serve /.meta; wind everything else to the child."""
+
+    # -- tree synthesis ----------------------------------------------------
+
+    def _layers(self) -> dict[str, Layer]:
+        return {l.name: l for l in walk(self.children[0])}
+
+    def _node(self, path: str):
+        """Resolve a /.meta-relative path -> ("dir", entries) or
+        ("file", bytes) or None."""
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            return "dir", ["version", "logging", "graphs"]
+        if parts == ["version"]:
+            from .. import __version__
+
+            return "file", json.dumps(
+                {"version": __version__}, indent=1).encode()
+        if parts == ["logging"]:
+            return "file", "\n".join(
+                gflog.recent_messages(200)).encode() + b"\n"
+        if parts[0] != "graphs":
+            return None
+        if len(parts) == 1:
+            return "dir", ["active"]
+        if parts[1] != "active":
+            return None
+        layers = self._layers()
+        if len(parts) == 2:
+            return "dir", sorted(layers)
+        layer = layers.get(parts[2])
+        if layer is None:
+            return None
+        if len(parts) == 3:
+            return "dir", ["type", "options", "private", "stats"]
+        if len(parts) > 4:
+            return None
+        leaf = parts[3]
+        if leaf == "type":
+            return "file", (layer.type_name + "\n").encode()
+        if leaf == "options":
+            return "file", json.dumps(layer.opts, indent=1,
+                                      default=repr).encode()
+        if leaf == "private":
+            return "file", json.dumps(layer.dump_private(), indent=1,
+                                      default=repr).encode()
+        if leaf == "stats":
+            dump = layer.statedump()
+            return "file", json.dumps(dump.get("stats", {}), indent=1,
+                                      default=repr).encode()
+        return None
+
+    def _resolve(self, path: str):
+        rel = path[len(META):]
+        node = self._node(rel)
+        if node is None:
+            raise FopError(errno.ENOENT, path)
+        return node
+
+    def _iatt(self, path: str, node) -> Iatt:
+        kind, payload = node
+        ia = Iatt(gfid=_gfid(path),
+                  ia_type=IAType.DIR if kind == "dir" else IAType.REG)
+        now = time.time()
+        ia.atime = ia.mtime = ia.ctime = now
+        if kind == "dir":
+            ia.mode = stat_mod.S_IFDIR | 0o555
+            ia.nlink = 2
+        else:
+            ia.mode = stat_mod.S_IFREG | 0o444
+            ia.size = len(payload)
+            ia.nlink = 1
+        return ia
+
+    @staticmethod
+    def _is_meta(path: str | None) -> bool:
+        return bool(path) and (path == META or
+                               path.startswith(META + "/"))
+
+    # -- fops --------------------------------------------------------------
+
+    async def lookup(self, loc: Loc, xdata: dict | None = None):
+        if not self._is_meta(loc.path):
+            return await self.children[0].lookup(loc, xdata)
+        node = self._resolve(loc.path)
+        return self._iatt(loc.path, node), {}
+
+    async def stat(self, loc: Loc, xdata: dict | None = None):
+        if not self._is_meta(loc.path):
+            return await self.children[0].stat(loc, xdata)
+        return self._iatt(loc.path, self._resolve(loc.path))
+
+    async def fstat(self, fd: FdObj, xdata: dict | None = None):
+        if not self._is_meta(fd.path):
+            return await self.children[0].fstat(fd, xdata)
+        return self._iatt(fd.path, self._resolve(fd.path))
+
+    async def open(self, loc: Loc, flags: int = 0,
+                   xdata: dict | None = None):
+        if not self._is_meta(loc.path):
+            return await self.children[0].open(loc, flags, xdata)
+        kind, payload = self._resolve(loc.path)
+        if kind != "file":
+            raise FopError(errno.EISDIR, loc.path)
+        fd = FdObj(_gfid(loc.path), flags, path=loc.path)
+        # pin the content for this fd: live files (stats, logging)
+        # change length between chunked reads, and a regenerating
+        # tail would append garbage past the first snapshot
+        fd.ctx_set(self, payload)
+        return fd
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        if not self._is_meta(fd.path):
+            return await self.children[0].readv(fd, size, offset, xdata)
+        payload = fd.ctx_get(self)
+        if payload is None:  # anonymous fd: best-effort regeneration
+            kind, payload = self._resolve(fd.path)
+            if kind != "file":
+                raise FopError(errno.EISDIR, fd.path)
+        return payload[offset:offset + size]
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        if self._is_meta(fd.path):
+            raise FopError(errno.EROFS, ".meta is read-only")
+        return await self.children[0].writev(fd, data, offset, xdata)
+
+    async def opendir(self, loc: Loc, xdata: dict | None = None):
+        if not self._is_meta(loc.path):
+            return await self.children[0].opendir(loc, xdata)
+        kind, _ = self._resolve(loc.path)
+        if kind != "dir":
+            raise FopError(errno.ENOTDIR, loc.path)
+        return FdObj(_gfid(loc.path), path=loc.path)
+
+    async def readdir(self, fd: FdObj, size: int = 0, offset: int = 0,
+                      xdata: dict | None = None):
+        if not self._is_meta(fd.path):
+            return await self.children[0].readdir(fd, size, offset,
+                                                  xdata)
+        _, entries = self._resolve(fd.path)
+        return [(name, None) for name in entries]
+
+    async def readdirp(self, fd: FdObj, size: int = 0, offset: int = 0,
+                       xdata: dict | None = None):
+        if not self._is_meta(fd.path):
+            return await self.children[0].readdirp(fd, size, offset,
+                                                   xdata)
+        _, entries = self._resolve(fd.path)
+        out = []
+        for name in entries:
+            child = fd.path.rstrip("/") + "/" + name
+            out.append((name, self._iatt(child, self._resolve(child))))
+        return out
+
+    async def release(self, fd: FdObj) -> None:
+        if not self._is_meta(fd.path):
+            await super().release(fd)
+
+    async def flush(self, fd: FdObj, xdata: dict | None = None):
+        if self._is_meta(fd.path):
+            return {}
+        return await self.children[0].flush(fd, xdata)
+
+    async def getxattr(self, loc: Loc, name: str | None = None,
+                       xdata: dict | None = None):
+        if self._is_meta(loc.path):
+            return {}
+        return await self.children[0].getxattr(loc, name, xdata)
+
+    def dump_private(self) -> dict:
+        return {"layers": sorted(self._layers())}
+
+
+def _reject_meta(op_name: str, nloc: int):
+    """Mutations addressed at /.meta fail EROFS; others pass through."""
+    async def impl(self, *args, **kwargs):
+        for a in args[:nloc]:
+            if isinstance(a, Loc) and self._is_meta(a.path):
+                raise FopError(errno.EROFS, ".meta is read-only")
+        return await getattr(self.children[0], op_name)(*args, **kwargs)
+    impl.__name__ = op_name
+    return impl
+
+
+for _op in ("unlink", "rmdir", "mkdir", "mknod", "create", "rename",
+            "link", "symlink", "truncate", "setattr", "setxattr",
+            "removexattr"):
+    setattr(MetaLayer, _op, _reject_meta(_op, 2))

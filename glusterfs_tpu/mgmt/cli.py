@@ -61,6 +61,25 @@ async def _run(args) -> Any:
                 return await c.call("peer-probe", host=ph, port=int(pp))
             return await c.call("peer-status")
 
+    if args.cmd == "snapshot":
+        # snapshot create NAME VOLUME | list [VOLUME] |
+        #          delete|restore|activate|deactivate NAME
+        need = {"create": 2, "list": 0}.get(args.sub, 1)
+        if len(args.args) < need:
+            raise SystemExit(
+                "usage: snapshot create NAME VOLUME | list [VOLUME] | "
+                "delete|restore|activate|deactivate NAME")
+        async with MgmtClient(host, port) as c:
+            if args.sub == "create":
+                return await c.call("snapshot-create", name=args.args[0],
+                                    volume=args.args[1])
+            if args.sub == "list":
+                return await c.call(
+                    "snapshot-list",
+                    volume=args.args[0] if args.args else None)
+            return await c.call(f"snapshot-{args.sub}",
+                                name=args.args[0])
+
     if args.cmd == "volume":
         sub = args.sub
         if sub == "create":
@@ -237,6 +256,12 @@ def main(argv=None) -> int:
                                      "rebalance", "profile", "quota"])
     vol.add_argument("name", nargs="?", default="")
     vol.add_argument("args", nargs="*")
+
+    snap = sp.add_parser("snapshot")
+    snap.add_argument("sub", choices=["create", "list", "delete",
+                                      "restore", "activate",
+                                      "deactivate"])
+    snap.add_argument("args", nargs="*")
 
     peer = sp.add_parser("peer")
     peer.add_argument("sub", choices=["probe", "status"])

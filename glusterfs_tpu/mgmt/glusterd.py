@@ -110,6 +110,12 @@ class Glusterd:
                 if volgen._bool(vol.get("options", {}).get(
                         "features.quota", "off")):
                     self._spawn_quotad(vol)
+        # activated snapshots resume serving too
+        for s in self.state.get("snaps", {}).values():
+            vi = s.get("volinfo")
+            if vi:
+                for b in vi["bricks"]:
+                    await self._spawn_brick(vi, b)
         return self.port
 
     async def stop(self) -> None:
@@ -294,6 +300,9 @@ class Glusterd:
         or 'localhost'."""
         if name in self.state["volumes"]:
             raise MgmtError(f"volume {name} exists")
+        if name.startswith("snap-"):
+            raise MgmtError("volume names starting with 'snap-' are "
+                            "reserved for activated snapshots")
         parsed = []
         for i, b in enumerate(bricks):
             if isinstance(b, str):
@@ -596,12 +605,30 @@ class Glusterd:
             return {"started": brick, "port": self.ports.get(brick, 0)}
         raise MgmtError(f"unknown brick action {action!r}")
 
+    def _snap_volinfo_by_name(self, volname: str) -> dict | None:
+        for s in self.state.get("snaps", {}).values():
+            vi = s.get("volinfo")
+            if vi and vi["name"] == volname:
+                return vi
+        return None
+
     def op_getspec(self, name: str) -> dict:
-        """Serve the client volfile (__server_getspec analog)."""
-        vol = self._vol(name)
+        """Serve the client volfile (__server_getspec analog); activated
+        snapshots are served like volumes (snapd's volfile)."""
+        vol = self.state["volumes"].get(name)
+        is_snap = False
+        if vol is None:
+            vol = self._snap_volinfo_by_name(name)
+            is_snap = vol is not None
+        if vol is None:
+            raise MgmtError(f"no volume {name!r}")
         if vol["status"] != "started":
             raise MgmtError(f"volume {name} not started")
-        return {"volfile": volgen.build_client_volfile(vol, self.ports),
+        # no /.snaps inside a snapshot; classification is by identity
+        # ('snap-' user volume names are refused at create)
+        mgmt = None if is_snap else f"{self.host}:{self.port}"
+        return {"volfile": volgen.build_client_volfile(
+                    vol, self.ports, mgmt=mgmt),
                 "volname": name}
 
     def _vol(self, name: str) -> dict:
@@ -750,10 +777,93 @@ class Glusterd:
     def op_snapshot_list(self, volume: str | None = None) -> dict:
         snaps = self.state.get("snaps", {})
         out = {n: {"volume": s["volume"], "ts": s["ts"],
-                   "bricks": sorted(s["bricks"])}
+                   "bricks": sorted(s["bricks"]),
+                   "activated": bool(s.get("volinfo"))}
                for n, s in snaps.items()
                if volume is None or s["volume"] == volume}
         return {"snapshots": out}
+
+    # -- USS: snapshot activate/deactivate (the snapd analog: a
+    # snapshot becomes a served read-only volume the snapview layer
+    # mounts under /.snaps) ------------------------------------------------
+
+    def _snap_volname(self, name: str) -> str:
+        return f"snap-{name}"
+
+    async def op_snapshot_activate(self, name: str) -> dict:
+        snap = self.state.get("snaps", {}).get(name)
+        if snap is None:
+            raise MgmtError(f"no snapshot {name!r}")
+        if snap.get("volinfo"):
+            return {"ok": True, "already": True}
+        parent = self._vol(snap["volume"])
+        vi = json.loads(json.dumps(parent))  # deep, store-safe copy
+        sv = self._snap_volname(name)
+        vi["name"] = sv
+        vi["status"] = "started"
+        bricks = []
+        for b in vi["bricks"]:
+            src = snap["bricks"].get(b["name"])
+            if src is None:
+                continue  # brick lived on another node
+            nb = dict(b)
+            nb["path"] = src
+            nb["name"] = f"{sv}-brick-{b['index']}"
+            nb.pop("port", None)
+            bricks.append(nb)
+        if not bricks:
+            raise MgmtError("no local snapshot bricks to activate")
+        if len(bricks) < len(parent["bricks"]):
+            # partial activation would serve silently-partial history
+            # (distribute) or fail every read (disperse < k fragments)
+            raise MgmtError(
+                "snapshot bricks incomplete on this node: "
+                f"{len(bricks)}/{len(parent['bricks'])} "
+                "(multi-node snapshot activation is not supported)")
+        vi["bricks"] = bricks
+        # the snapshot is a file-level copy: rebind the gfid identity
+        # store onto the copied inodes before serving (restore does the
+        # same; LVM snapshots in the reference keep inodes so skip it)
+        from ..storage.posix import rebuild_identity
+
+        for b in bricks:
+            await asyncio.to_thread(rebuild_identity, b["path"])
+        # a snapshot is immutable history: read-only, no journals or
+        # background services
+        opts = vi.setdefault("options", {})
+        opts["features.read-only"] = "on"
+        for k in ("changelog.changelog", "features.bitrot",
+                  "features.quota"):
+            opts.pop(k, None)
+        spawned = []
+        try:
+            for b in bricks:
+                proc = self.bricks.get(b["name"])
+                if proc is not None and proc.poll() is None:
+                    continue  # a retry after partial failure
+                await self._spawn_brick(vi, b)
+                spawned.append(b["name"])
+        except BaseException:
+            # no half-activated snapshot: kill what we started
+            for name_ in spawned:
+                self._kill_brick(name_)
+            raise
+        snap["volinfo"] = vi
+        self._save()
+        gf_event("SNAPSHOT_ACTIVATED", snapshot=name)
+        return {"ok": True, "volume": sv}
+
+    async def op_snapshot_deactivate(self, name: str) -> dict:
+        snap = self.state.get("snaps", {}).get(name)
+        if snap is None:
+            raise MgmtError(f"no snapshot {name!r}")
+        vi = snap.pop("volinfo", None)
+        if vi:
+            for b in vi["bricks"]:
+                self._kill_brick(b["name"])
+                self.ports.pop(b["name"], None)
+        self._save()
+        return {"ok": True}
 
     async def op_snapshot_delete(self, name: str) -> dict:
         if name not in self.state.get("snaps", {}):
@@ -764,6 +874,8 @@ class Glusterd:
     async def commit_snapshot_delete(self, name: str) -> dict:
         import shutil
 
+        if self.state.get("snaps", {}).get(name, {}).get("volinfo"):
+            await self.op_snapshot_deactivate(name)
         snap = self.state.get("snaps", {}).pop(name, None)
         self._save()
         if snap:

@@ -227,9 +227,12 @@ def _ssl_options(volinfo: dict) -> dict[str, Any]:
 
 
 def build_client_volfile(volinfo: dict,
-                         ports: dict[str, int] | None = None) -> str:
+                         ports: dict[str, int] | None = None,
+                         mgmt: str | None = None) -> str:
     """protocol/client fan-in -> cluster layer(s) -> perf stack
-    (build_client_graph analog)."""
+    (build_client_graph analog).  mgmt (glusterd host:port) enables the
+    snapview layer so the mount serves /.snaps — omitted for snapshot
+    volfiles themselves (no .snaps inside a snapshot)."""
     vtype = volinfo["type"]
     bricks = volinfo["bricks"]
     ports = ports or {}
@@ -305,6 +308,17 @@ def build_client_volfile(volinfo: dict,
                              [top]))
             top = lname
 
-    out.append(_emit(volinfo["name"], "debug/io-stats",
+    out.append(_emit(f"{volinfo['name']}-io-stats", "debug/io-stats",
                      layer_options(volinfo, "debug/io-stats"), [top]))
+    top = f"{volinfo['name']}-io-stats"
+    if mgmt:
+        # user-serviceable snapshots: /.snaps browse (snapview-client)
+        out.append(_emit(f"{volinfo['name']}-snapview",
+                         "features/snapview",
+                         {"mgmt-server": mgmt,
+                          "volume": volinfo["name"]}, [top]))
+        top = f"{volinfo['name']}-snapview"
+    # virtual /.meta introspection at the very top (the reference
+    # autoloads meta on every fuse graph; tests read it like statedump)
+    out.append(_emit(volinfo["name"], "meta", {}, [top]))
     return "\n".join(out)

@@ -115,6 +115,7 @@ def coalesce(recs: list[dict]) -> list[tuple[str, ...]]:
     # path must not resurrect it as DELETE)
     state: dict[str, str] = {}
     renames: dict[str, str] = {}  # final path -> original path
+    applied_renames: set[tuple[str, str]] = set()  # replica-echo filter
     order: list[str] = []
 
     def touch(path: str, kind: str) -> None:
@@ -149,6 +150,9 @@ def coalesce(recs: list[dict]) -> list[tuple[str, ...]]:
             dst = r.get("path2", "")
             if not dst:
                 continue
+            if (path, dst) in applied_renames and path not in state:
+                continue  # a replica's echo of a rename already folded
+            applied_renames.add((path, dst))
             prev = state.pop(path, None)
             if path in order:
                 order.remove(path)

@@ -129,3 +129,14 @@ def test_coalesce_replica_echo_of_dropped_file():
     assert coalesce(recs) == []
     # but a genuine re-create after the drop is NEW again
     assert coalesce(recs + [_r("create", "/t", 4)]) == [("NEW", "/t")]
+
+
+def test_coalesce_rename_replica_echo():
+    """A replica's rename echo must not downgrade NEW to RENAME (the
+    consumer would rename a path it never received)."""
+    recs = [_r("create", "/a", 1), _r("create", "/a", 1.01),
+            _r("rename", "/a", 2, "/b"), _r("rename", "/a", 2.01, "/b")]
+    assert coalesce(recs) == [("NEW", "/b")]
+    # echoed rename of a pre-existing file stays one RENAME
+    recs = [_r("rename", "/x", 1, "/y"), _r("rename", "/x", 1.01, "/y")]
+    assert coalesce(recs) == [("RENAME", "/x", "/y")]

@@ -1,0 +1,130 @@
+"""meta xlator: the /.meta introspection tree (reference xlators/meta;
+tests/ec.rc reads .meta/graphs/active/<layer>/private as its oracle)."""
+
+import asyncio
+import errno
+import json
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+
+VOLFILE = """
+volume posix
+    type storage/posix
+    option directory {base}/brick
+end-volume
+
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+
+volume top
+    type meta
+    subvolumes locks
+end-volume
+"""
+
+
+def test_meta_tree(tmp_path):
+    async def run():
+        g = Graph.construct(VOLFILE.format(base=tmp_path))
+        c = Client(g)
+        await c.mount()
+        # normal I/O is untouched
+        await c.write_file("/real", b"data")
+        assert await c.read_file("/real") == b"data"
+        # the virtual tree
+        assert sorted(await c.listdir("/.meta")) == \
+            ["graphs", "logging", "version"]
+        assert await c.listdir("/.meta/graphs") == ["active"]
+        assert sorted(await c.listdir("/.meta/graphs/active")) == \
+            ["locks", "posix"]
+        priv = json.loads(await c.read_file(
+            "/.meta/graphs/active/posix/private"))
+        assert "directory" in priv or priv  # layer state, live
+        t = await c.read_file("/.meta/graphs/active/locks/type")
+        assert t.strip() == b"features/locks"
+        opts = json.loads(await c.read_file(
+            "/.meta/graphs/active/posix/options"))
+        assert opts["directory"].endswith("brick")
+        ver = json.loads(await c.read_file("/.meta/version"))
+        assert ver["version"]
+        # stats reflect live traffic
+        stats = json.loads(await c.read_file(
+            "/.meta/graphs/active/posix/stats"))
+        assert stats  # per-fop counters exist
+        # read-only: mutations refuse
+        with pytest.raises(FopError) as ei:
+            await c.write_file("/.meta/version", b"nope")
+        assert ei.value.err in (errno.EROFS, errno.EISDIR, errno.EEXIST)
+        with pytest.raises(FopError):
+            await c.unlink("/.meta/version")
+        with pytest.raises(FopError):
+            await c.mkdir("/.meta/newdir")
+        # missing virtual path
+        with pytest.raises(FopError) as ei:
+            await c.read_file("/.meta/graphs/active/nope/private")
+        assert ei.value.err == errno.ENOENT
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_meta_stat_shapes(tmp_path):
+    async def run():
+        g = Graph.construct(VOLFILE.format(base=tmp_path))
+        c = Client(g)
+        await c.mount()
+        ia = await c.stat("/.meta")
+        assert ia.is_dir()
+        ia = await c.stat("/.meta/version")
+        assert not ia.is_dir() and ia.size > 0
+        # listdir with stats (readdirp) works on virtual dirs
+        entries = dict(await c.listdir_with_stat("/.meta/graphs/active"))
+        assert "posix" in entries and entries["posix"].is_dir()
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_meta_on_managed_volume(tmp_path):
+    """volgen puts meta at the top of every client graph; the disperse
+    layer's private dump is readable exactly like tests/ec.rc does."""
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+    from glusterfs_tpu.core.layer import walk
+
+    async def run():
+        gd = Glusterd(str(tmp_path / "gd"))
+        await gd.start()
+        async with MgmtClient(gd.host, gd.port) as c:
+            bricks = [{"path": str(tmp_path / f"b{i}")} for i in range(6)]
+            await c.call("volume-create", name="mv", vtype="disperse",
+                         bricks=bricks, redundancy=2)
+            await c.call("volume-start", name="mv")
+        cl = await mount_volume(gd.host, gd.port, "mv")
+        try:
+            subs = [l for l in walk(cl.graph.top)
+                    if l.type_name == "protocol/client"]
+            for _ in range(150):
+                if all(l.connected for l in subs):
+                    break
+                await asyncio.sleep(0.1)
+            await cl.write_file("/f", b"x" * 1024)
+            priv = json.loads(await cl.read_file(
+                "/.meta/graphs/active/mv-disperse-0/private"))
+            # the ec.rc oracle: k/redundancy/up state visible
+            assert priv, priv
+            names = await cl.listdir("/.meta/graphs/active")
+            assert "mv-disperse-0" in names
+            assert any(n.startswith("mv-client-") for n in names)
+        finally:
+            await cl.unmount()
+            await gd.stop()
+
+    asyncio.run(run())

@@ -51,7 +51,8 @@ def test_volgen_client_volfile(tmp_path):
     assert "cluster/disperse" in types
     assert "performance/write-behind" in types  # default on
     assert "performance/io-cache" in types  # enabled by option
-    assert g.top.type_name == "debug/io-stats"
+    assert "debug/io-stats" in types
+    assert g.top.type_name == "meta"
 
 
 def test_volgen_distributed_disperse(tmp_path):

@@ -1,0 +1,79 @@
+"""USS / snapview: browse activated snapshots under /.snaps (reference
+features/snapview-client/server + snapshot activate)."""
+
+import asyncio
+import errno
+
+import pytest
+
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.layer import walk
+
+
+@pytest.mark.slow
+def test_uss_snaps_browse(tmp_path):
+    """Write v1, snapshot + activate, overwrite with v2: the live file
+    reads v2 while /.snaps/<snap>/ still serves v1, read-only."""
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    async def run():
+        gd = Glusterd(str(tmp_path / "gd"))
+        await gd.start()
+        async with MgmtClient(gd.host, gd.port) as c:
+            bricks = [{"path": str(tmp_path / f"b{i}")} for i in range(6)]
+            await c.call("volume-create", name="sv", vtype="disperse",
+                         bricks=bricks, redundancy=2)
+            await c.call("volume-start", name="sv")
+        cl = await mount_volume(gd.host, gd.port, "sv")
+        try:
+            subs = [l for l in walk(cl.graph.top)
+                    if l.type_name == "protocol/client"]
+            for _ in range(150):
+                if all(l.connected for l in subs):
+                    break
+                await asyncio.sleep(0.1)
+            await cl.write_file("/doc", b"version-one")
+            await cl.mkdir("/sub")
+            await cl.write_file("/sub/n", b"nested-v1")
+
+            async with MgmtClient(gd.host, gd.port) as c:
+                await c.call("snapshot-create", name="s1", volume="sv")
+                # not activated yet: .snaps is empty
+                assert await cl.listdir("/.snaps") == []
+                await c.call("snapshot-activate", name="s1")
+
+            await cl.write_file("/doc", b"version-TWO!")
+
+            # live vs history
+            assert await cl.read_file("/doc") == b"version-TWO!"
+            assert await cl.listdir("/.snaps") == ["s1"]
+            assert await cl.read_file("/.snaps/s1/doc") == b"version-one"
+            assert await cl.read_file("/.snaps/s1/sub/n") == b"nested-v1"
+            names = await cl.listdir("/.snaps/s1")
+            assert sorted(names) == ["doc", "sub"]
+            ia = await cl.stat("/.snaps/s1/doc")
+            assert ia.size == len(b"version-one")
+            # snapshots are immutable
+            with pytest.raises(FopError) as ei:
+                await cl.write_file("/.snaps/s1/doc", b"mutate")
+            assert ei.value.err == errno.EROFS
+            with pytest.raises(FopError):
+                await cl.unlink("/.snaps/s1/doc")
+            # unknown snapshot
+            with pytest.raises(FopError) as ei:
+                await cl.read_file("/.snaps/nope/doc")
+            assert ei.value.err == errno.ENOENT
+
+            # deactivate hides it again
+            async with MgmtClient(gd.host, gd.port) as c:
+                await c.call("snapshot-deactivate", name="s1")
+            sv_layer = next(l for l in walk(cl.graph.top)
+                            if l.type_name == "features/snapview")
+            sv_layer._snaps_at = 0.0  # drop the list cache
+            assert await cl.listdir("/.snaps") == []
+        finally:
+            await cl.unmount()
+            await gd.stop()
+
+    asyncio.run(run())
