@@ -1,0 +1,113 @@
+"""Shared machinery for virtual-namespace layers (meta, snapview,
+gfid-access) and xdata-carrying wrappers (utime, namespace).
+
+Virtual trees need stable synthetic gfids and iatts; read-only trees
+need BOTH the path-addressed and the fd-carried mutation surface
+rejected (an fd opened on a virtual object must never fall through to
+the live graph with a foreign gfid).  Fop wrappers that tag xdata must
+find it wherever the caller put it — layers forward xdata positionally
+as often as by keyword."""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import inspect
+import stat as stat_mod
+import time
+
+from .fops import FopError
+from .iatt import IAType, Iatt
+from .layer import FdObj, Loc
+
+
+def virtual_gfid(ns: str, path: str) -> bytes:
+    return hashlib.md5(f"{ns}:{path}".encode(
+        "utf-8", "surrogateescape")).digest()
+
+
+def virtual_dir_iatt(gfid: bytes) -> Iatt:
+    ia = Iatt(gfid=gfid, ia_type=IAType.DIR)
+    ia.mode = stat_mod.S_IFDIR | 0o555
+    ia.nlink = 2
+    ia.atime = ia.mtime = ia.ctime = time.time()
+    return ia
+
+
+def virtual_file_iatt(gfid: bytes, size: int) -> Iatt:
+    ia = Iatt(gfid=gfid, ia_type=IAType.REG)
+    ia.mode = stat_mod.S_IFREG | 0o444
+    ia.size = size
+    ia.nlink = 1
+    ia.atime = ia.mtime = ia.ctime = time.time()
+    return ia
+
+
+# path- and fd-carried mutation fops a read-only virtual tree rejects
+LOC_MUTATIONS = ("unlink", "rmdir", "mkdir", "mknod", "create",
+                 "rename", "link", "symlink", "truncate", "setattr",
+                 "setxattr", "removexattr")
+FD_MUTATIONS = ("writev", "ftruncate", "fsetattr", "fsetxattr",
+                "fremovexattr", "fallocate", "discard", "zerofill")
+
+
+def install_readonly_guards(cls, is_virtual_loc: str,
+                            is_virtual_fd: str, msg: str) -> None:
+    """Give cls EROFS guards over the whole mutation surface.
+    is_virtual_loc/is_virtual_fd name predicate methods on cls taking a
+    Loc / FdObj.  Methods the class defines itself are left alone."""
+
+    def loc_guard(op_name):
+        async def impl(self, *args, **kwargs):
+            pred = getattr(self, is_virtual_loc)
+            for a in args[:2]:
+                if isinstance(a, Loc) and pred(a):
+                    raise FopError(errno.EROFS, msg)
+            return await getattr(self.children[0], op_name)(*args,
+                                                            **kwargs)
+        impl.__name__ = op_name
+        return impl
+
+    def fd_guard(op_name):
+        async def impl(self, fd, *args, **kwargs):
+            if getattr(self, is_virtual_fd)(fd):
+                raise FopError(errno.EROFS, msg)
+            return await getattr(self.children[0], op_name)(fd, *args,
+                                                            **kwargs)
+        impl.__name__ = op_name
+        return impl
+
+    for op in LOC_MUTATIONS:
+        if op not in cls.__dict__:
+            setattr(cls, op, loc_guard(op))
+    for op in FD_MUTATIONS:
+        if op not in cls.__dict__:
+            setattr(cls, op, fd_guard(op))
+
+
+_SIG_CACHE: dict = {}
+
+
+def call_with_xdata(child, op_name: str, args: tuple, kwargs: dict,
+                    update: dict):
+    """Invoke child.op(*args, **kwargs) with `update` merged into its
+    xdata parameter wherever the caller put it (positional or keyword
+    or absent).  Returns the awaitable.  Existing keys win over the
+    update (setdefault semantics)."""
+    fn = getattr(child, op_name)
+    key = (type(child), op_name)
+    sig = _SIG_CACHE.get(key)
+    if sig is None:
+        sig = _SIG_CACHE[key] = inspect.signature(fn)
+    if "xdata" not in sig.parameters:
+        return fn(*args, **kwargs)
+    try:
+        ba = sig.bind(*args, **kwargs)
+    except TypeError:
+        return fn(*args, **kwargs)  # let the real call raise precisely
+    xd = ba.arguments.get("xdata")
+    if not isinstance(xd, dict):
+        xd = {}
+    merged = {**update, **xd}
+    ba.arguments["xdata"] = merged
+    return fn(*ba.args, **ba.kwargs)

@@ -1,0 +1,72 @@
+"""features/gfid-access — the virtual ``/.gfid/<uuid>`` access path.
+
+Reference: xlators/features/gfid-access (gfid-access.c): geo-rep's
+secondary addresses objects by gfid without knowing their path;
+``/.gfid/<hex-or-dashed-uuid>`` resolves straight to the inode.  Here:
+paths under /.gfid are rewritten to gfid-addressed Locs (the posix
+store resolves those natively via its handle farm)."""
+
+from __future__ import annotations
+
+import errno
+import uuid as uuid_mod
+
+from ..core.fops import Fop, FopError
+from ..core.layer import Layer, Loc, register
+
+GFID_DIR = "/.gfid"
+
+
+def _parse(path: str) -> bytes | None:
+    """/.gfid/<uuid>[/...] -> gfid bytes (sub-paths unsupported, like
+    the reference's aux-gfid-mount)."""
+    rest = path[len(GFID_DIR):].lstrip("/")
+    if not rest or "/" in rest:
+        return None
+    try:
+        return uuid_mod.UUID(rest).bytes
+    except ValueError:
+        try:
+            raw = bytes.fromhex(rest)
+            return raw if len(raw) == 16 else None
+        except ValueError:
+            return None
+
+
+@register("features/gfid-access")
+class GfidAccessLayer(Layer):
+    @staticmethod
+    def _rewrite(loc: Loc) -> Loc:
+        if not loc.path or not loc.path.startswith(GFID_DIR):
+            return loc
+        if loc.path == GFID_DIR:
+            raise FopError(errno.EPERM, ".gfid is virtual")
+        gfid = _parse(loc.path)
+        if gfid is None:
+            raise FopError(errno.EINVAL,
+                           f"bad gfid path {loc.path!r}")
+        return Loc("", gfid=gfid)
+
+    async def lookup(self, loc: Loc, xdata: dict | None = None):
+        if loc.path == GFID_DIR:
+            # the virtual dir itself resolves (path walkers visit it on
+            # the way to /.gfid/<uuid>), ga_virtual_lookup style
+            from ..core.virtfs import virtual_dir_iatt, virtual_gfid
+
+            return virtual_dir_iatt(virtual_gfid("gfid-access",
+                                                 GFID_DIR)), {}
+        return await self.children[0].lookup(self._rewrite(loc), xdata)
+
+
+def _rewriting(op_name: str):
+    async def impl(self, *args, **kwargs):
+        args = tuple(self._rewrite(a) if isinstance(a, Loc) else a
+                     for a in args)
+        return await getattr(self.children[0], op_name)(*args, **kwargs)
+    impl.__name__ = op_name
+    return impl
+
+
+for _f in Fop:
+    if _f.value not in GfidAccessLayer.__dict__:  # keep custom lookup
+        setattr(GfidAccessLayer, _f.value, _rewriting(_f.value))

@@ -1,0 +1,173 @@
+"""features/leases — NFS-style lease grants and recalls.
+
+Reference: xlators/features/leases (leases.c): a client may take a
+RD/RW lease on an inode; a conflicting fop from ANOTHER client recalls
+the lease (upcall to the holder) and blocks for the recall timeout; an
+unreturned lease is revoked.  Brick-side layer: leases are keyed by
+gfid and lease-id, conflict checks gate the write path, recalls ride
+the same event-push channel the upcall layer uses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import time
+from typing import Callable
+
+from ..core.fops import FopError, WRITE_FOPS
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+from ..core import gflog
+from ..rpc import wire
+
+log = gflog.get_logger("leases")
+
+RD_LEASE, RW_LEASE = "rd", "rw"
+
+
+class _Lease:
+    __slots__ = ("lease_id", "ltype", "client", "recalled_at")
+
+    def __init__(self, lease_id: str, ltype: str, client: bytes):
+        self.lease_id = lease_id
+        self.ltype = ltype
+        self.client = client
+        self.recalled_at = 0.0
+
+
+@register("features/leases")
+class LeasesLayer(Layer):
+    OPTIONS = (
+        Option("leases", "bool", default="on"),
+        Option("recall-timeout", "time", default="2",
+               description="grace before an unreturned lease is "
+                           "revoked (lease-lock-recall-timeout)"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._leases: dict[bytes, list[_Lease]] = {}  # gfid -> leases
+        self._sink: Callable | None = None
+        # revocations are per (client, lease-id) — one client's
+        # revoked id must not poison everyone else's
+        self._revoked: set[tuple[bytes, str]] = set()
+
+    def set_upcall_sink(self, sink) -> None:
+        self._sink = sink
+
+    def release_client(self, identity: bytes) -> None:
+        self._revoked = {(c, i) for c, i in self._revoked
+                         if c != identity}
+        for gfid in list(self._leases):
+            kept = [l for l in self._leases[gfid]
+                    if l.client != identity]
+            if kept:
+                self._leases[gfid] = kept
+            else:
+                del self._leases[gfid]
+
+    # -- the lease fop (GF_FOP_LEASE) --------------------------------------
+
+    async def lease(self, loc: Loc, cmd: str, ltype: str = RD_LEASE,
+                    lease_id: str = "", xdata: dict | None = None):
+        """cmd: grant | release | unlock-all."""
+        if not self.opts["leases"]:
+            raise FopError(errno.ENOTSUP, "leases disabled")
+        client = wire.CURRENT_CLIENT.get()
+        ia, _ = await self.children[0].lookup(loc)
+        gfid = bytes(ia.gfid)
+        held = self._leases.setdefault(gfid, [])
+        if cmd == "grant":
+            if not lease_id:
+                raise FopError(errno.EINVAL, "grant needs a lease-id")
+            if (client, lease_id) in self._revoked:
+                raise FopError(errno.ESTALE, "lease was revoked")
+            # a RW lease conflicts with anything from another client;
+            # RD leases share with RD
+            for l in held:
+                if l.client != client and (ltype == RW_LEASE or
+                                           l.ltype == RW_LEASE):
+                    raise FopError(errno.EAGAIN,
+                                   "conflicting lease held")
+            held.append(_Lease(lease_id, ltype, client))
+            return {"granted": ltype, "lease-id": lease_id}
+        if cmd == "release":
+            before = len(held)
+            held[:] = [l for l in held if not (
+                l.client == client and l.lease_id == lease_id)]
+            if not held:
+                self._leases.pop(gfid, None)
+            return {"released": before - len(held)}
+        if cmd == "unlock-all":
+            self.release_client(client)
+            return {"released": "all"}
+        raise FopError(errno.EINVAL, f"lease cmd {cmd!r}")
+
+    async def _check(self, gfid: bytes, is_write: bool) -> None:
+        """Conflict gate: recall other clients' conflicting leases and
+        wait out the grace, then revoke (lease_recall + timeout)."""
+        client = wire.CURRENT_CLIENT.get()
+        held = self._leases.get(gfid, [])
+        conflicting = [l for l in held if l.client != client and
+                       (is_write or l.ltype == RW_LEASE)]
+        if not conflicting:
+            return
+        now = time.monotonic()
+        for l in conflicting:
+            if not l.recalled_at:
+                l.recalled_at = now
+                if self._sink is not None:
+                    self._sink([l.client], {
+                        "event": "lease-recall",
+                        "gfid": gfid.hex(), "lease-id": l.lease_id})
+        deadline = max(l.recalled_at for l in conflicting) + \
+            self.opts["recall-timeout"]
+        while time.monotonic() < deadline:
+            held = self._leases.get(gfid, [])
+            if not any(l in held for l in conflicting):
+                return  # returned voluntarily
+            await asyncio.sleep(0.05)
+        # grace expired: revoke
+        for l in conflicting:
+            self._revoked.add((l.client, l.lease_id))
+        self._leases[gfid] = [l for l in self._leases.get(gfid, [])
+                              if l not in conflicting]
+        log.warning(1, "revoked %d unreturned lease(s) on %s",
+                    len(conflicting), gfid.hex())
+
+    async def open(self, loc: Loc, flags: int = 0,
+                   xdata: dict | None = None):
+        import os as _os
+
+        ret = await self.children[0].open(loc, flags, xdata)
+        if self.opts["leases"] and loc.gfid:
+            # opens for write conflict with RW leases (lease checks at
+            # open time, leases.c open path)
+            if flags & (_os.O_WRONLY | _os.O_RDWR):
+                await self._check(bytes(loc.gfid), True)
+        return ret
+
+    def dump_private(self) -> dict:
+        return {"inodes": len(self._leases),
+                "leases": sum(len(v) for v in self._leases.values())}
+
+
+def _gated(op_name: str):
+    async def impl(self, *args, **kwargs):
+        if self.opts["leases"]:
+            gfid = None
+            for a in args:
+                if isinstance(a, (Loc, FdObj)) and a.gfid:
+                    gfid = bytes(a.gfid)
+                    break
+            if gfid:
+                await self._check(gfid, True)
+        return await getattr(self.children[0], op_name)(*args, **kwargs)
+    impl.__name__ = op_name
+    return impl
+
+
+for _f in WRITE_FOPS:
+    if _f.value not in ("lease",):
+        setattr(LeasesLayer, _f.value, _gated(_f.value))

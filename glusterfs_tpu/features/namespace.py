@@ -1,0 +1,51 @@
+"""features/namespace — tag requests with a namespace from the path
+prefix (reference xlators/features/namespace: the first path component
+hashes to a namespace id used downstream for accounting/QoS).  The tag
+rides xdata as ``namespace``; per-namespace fop counts are kept for
+introspection."""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from ..core.fops import Fop
+from ..core.layer import FdObj, Layer, Loc, register
+
+
+def _ns_of(path: str | None) -> str:
+    if not path or path == "/":
+        return "/"
+    return path.lstrip("/").split("/", 1)[0]
+
+
+@register("features/namespace")
+class NamespaceLayer(Layer):
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.per_ns: Counter = Counter()
+
+    def dump_private(self) -> dict:
+        return {"namespaces": dict(self.per_ns)}
+
+
+def _tagging(op_name: str):
+    async def impl(self, *args, **kwargs):
+        from ..core.virtfs import call_with_xdata
+
+        ns = None
+        for a in args:
+            if isinstance(a, (Loc, FdObj)) and a.path:
+                ns = _ns_of(a.path)
+                break
+        if ns is None:
+            return await getattr(self.children[0], op_name)(*args,
+                                                            **kwargs)
+        self.per_ns[ns] += 1
+        return await call_with_xdata(self.children[0], op_name, args,
+                                     kwargs, {"namespace": ns})
+    impl.__name__ = op_name
+    return impl
+
+
+for _f in Fop:
+    setattr(NamespaceLayer, _f.value, _tagging(_f.value))

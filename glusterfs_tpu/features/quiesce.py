@@ -1,0 +1,67 @@
+"""features/quiesce — client-side fop pause/replay.
+
+Reference: xlators/features/quiesce (quiesce.c): during failover the
+client graph can be told to hold every fop in a queue instead of
+failing it; un-quiescing replays the queue in order.  Used by gfproxy
+failover; here it doubles as a general pause gate (option flips via
+live reconfigure, like barrier on the brick side)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..core.fops import Fop
+from ..core.layer import Layer, register
+from ..core.options import Option
+from ..core import gflog
+
+log = gflog.get_logger("quiesce")
+
+
+@register("features/quiesce")
+class QuiesceLayer(Layer):
+    OPTIONS = (
+        Option("quiesce", "bool", default="off",
+               description="hold all fops until turned off again"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._gate = asyncio.Event()
+        if not self.opts["quiesce"]:
+            self._gate.set()
+        self.queued_peak = 0
+        self._waiting = 0
+
+    def reconfigure(self, options: dict) -> None:
+        super().reconfigure(options)
+        if self.opts["quiesce"]:
+            self._gate.clear()
+        else:
+            self._gate.set()  # replay: every parked fop resumes FIFO
+
+    async def _pass(self, op_name: str, *args, **kwargs):
+        if not self._gate.is_set():
+            self._waiting += 1
+            self.queued_peak = max(self.queued_peak, self._waiting)
+            try:
+                await self._gate.wait()
+            finally:
+                self._waiting -= 1
+        return await getattr(self.children[0], op_name)(*args, **kwargs)
+
+    def dump_private(self) -> dict:
+        return {"quiesced": not self._gate.is_set(),
+                "waiting": self._waiting,
+                "queued_peak": self.queued_peak}
+
+
+def _held(op_name: str):
+    async def impl(self, *args, **kwargs):
+        return await self._pass(op_name, *args, **kwargs)
+    impl.__name__ = op_name
+    return impl
+
+
+for _f in Fop:
+    setattr(QuiesceLayer, _f.value, _held(_f.value))

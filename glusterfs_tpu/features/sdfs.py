@@ -1,0 +1,82 @@
+"""features/sdfs — serialize directory fops ("dentry fop serializer").
+
+Reference: xlators/features/sdfs (sdfs.c): entry fops racing on one
+directory (create/unlink/rename/mkdir...) are serialized with entrylks
+on the parent, closing lookup/create races the individual xlators
+would otherwise have to handle.  Here: a per-parent-directory asyncio
+lock (this layer instance is the serialization domain, like the
+entrylk domain in the reference); rename locks both parents in sorted
+order to stay deadlock-free."""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..core.layer import Layer, Loc, register
+
+
+def _parent(path: str) -> str:
+    return path.rsplit("/", 1)[0] or "/"
+
+
+@register("features/sdfs")
+class SdfsLayer(Layer):
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._locks: dict[str, asyncio.Lock] = {}
+        self.serialized = 0
+
+    def _lock(self, d: str) -> asyncio.Lock:
+        lk = self._locks.get(d)
+        if lk is None:
+            lk = self._locks[d] = asyncio.Lock()
+        return lk
+
+    async def _serialized(self, dirs: list[str], op: str, args, kwargs):
+        self.serialized += 1
+        ordered = sorted(set(dirs))
+        async with _MultiLock([self._lock(d) for d in ordered]):
+            return await getattr(self.children[0], op)(*args, **kwargs)
+
+    def dump_private(self) -> dict:
+        return {"serialized": self.serialized,
+                "dirs_tracked": len(self._locks)}
+
+
+class _MultiLock:
+    def __init__(self, locks):
+        self.locks = locks
+
+    async def __aenter__(self):
+        taken = []
+        try:
+            for lk in self.locks:
+                await lk.acquire()
+                taken.append(lk)
+        except BaseException:
+            # cancellation mid-acquire must not leave earlier locks
+            # held forever (every fop under that dir would hang)
+            for lk in reversed(taken):
+                lk.release()
+            raise
+
+    async def __aexit__(self, *exc):
+        for lk in reversed(self.locks):
+            lk.release()
+        return False
+
+
+def _entry_serialized(op_name: str, nloc: int):
+    async def impl(self, *args, **kwargs):
+        dirs = [_parent(a.path) for a in args[:nloc]
+                if isinstance(a, Loc) and a.path]
+        return await self._serialized(dirs or ["/"], op_name, args,
+                                      kwargs)
+    impl.__name__ = op_name
+    return impl
+
+
+for _op, _n in (("create", 1), ("mknod", 1), ("mkdir", 1),
+                ("unlink", 1), ("rmdir", 1), ("symlink", 2),
+                ("link", 2), ("rename", 2)):
+    setattr(SdfsLayer, _op, _entry_serialized(_op, _n))

@@ -1,0 +1,47 @@
+"""features/selinux — SELinux label xattr translation.
+
+Reference: xlators/features/selinux (selinux.c): clients get/set
+``security.selinux`` but bricks must not write the security namespace
+(it would relabel the brick's own files); the xlator maps it to
+``trusted.glusterfs.selinux`` at rest and back on the way out."""
+
+from __future__ import annotations
+
+from ..core.layer import FdObj, Layer, Loc, register
+
+CLIENT_KEY = "security.selinux"
+STORE_KEY = "trusted.glusterfs.selinux"
+
+
+def _to_store(xattrs: dict) -> dict:
+    return {STORE_KEY if k == CLIENT_KEY else k: v
+            for k, v in xattrs.items()}
+
+
+def _to_client(xattrs: dict) -> dict:
+    return {CLIENT_KEY if k == STORE_KEY else k: v
+            for k, v in xattrs.items()}
+
+
+@register("features/selinux")
+class SelinuxLayer(Layer):
+    async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
+                       xdata: dict | None = None):
+        return await self.children[0].setxattr(loc, _to_store(xattrs),
+                                               flags, xdata)
+
+    async def fsetxattr(self, fd: FdObj, xattrs: dict, flags: int = 0,
+                        xdata: dict | None = None):
+        return await self.children[0].fsetxattr(fd, _to_store(xattrs),
+                                                flags, xdata)
+
+    async def getxattr(self, loc: Loc, name: str | None = None,
+                       xdata: dict | None = None):
+        ret = await self.children[0].getxattr(
+            loc, STORE_KEY if name == CLIENT_KEY else name, xdata)
+        return _to_client(ret or {})
+
+    async def removexattr(self, loc: Loc, name: str,
+                          xdata: dict | None = None):
+        return await self.children[0].removexattr(
+            loc, STORE_KEY if name == CLIENT_KEY else name, xdata)

@@ -17,14 +17,14 @@ from __future__ import annotations
 
 import asyncio
 import errno
-import hashlib
-import stat as stat_mod
 import time
 
 from ..core.fops import FopError
-from ..core.iatt import IAType, Iatt
+from ..core.iatt import Iatt
 from ..core.layer import FdObj, Layer, Loc, register
 from ..core.options import Option
+from ..core.virtfs import (install_readonly_guards, virtual_dir_iatt,
+                           virtual_gfid)
 from ..core import gflog
 
 log = gflog.get_logger("snapview")
@@ -33,8 +33,7 @@ SNAPS = "/.snaps"
 
 
 def _gfid(path: str) -> bytes:
-    return hashlib.md5(b"snaps:" + path.encode(
-        "utf-8", "surrogateescape")).digest()
+    return virtual_gfid("snaps", path)
 
 
 @register("features/snapview")
@@ -136,11 +135,14 @@ class SnapviewLayer(Layer):
         return (snap, "/" + inner)
 
     def _root_iatt(self, path: str) -> Iatt:
-        ia = Iatt(gfid=_gfid(path), ia_type=IAType.DIR)
-        ia.mode = stat_mod.S_IFDIR | 0o555
-        ia.nlink = 2
-        ia.atime = ia.mtime = ia.ctime = time.time()
-        return ia
+        return virtual_dir_iatt(_gfid(path))
+
+    def _virt_loc(self, loc: Loc) -> bool:
+        return self._split(loc.path) is not None
+
+    def _virt_fd(self, fd: FdObj) -> bool:
+        return fd.ctx_get(self) is not None or \
+            self._split(fd.path) is not None
 
     async def _proxy(self, snap: str, op: str, inner_first, *rest):
         snaps = await self._snapshots()
@@ -307,36 +309,5 @@ class SnapviewLayer(Layer):
                 "mounted": sorted(self._mounts)}
 
 
-def _reject_snaps(op_name: str):
-    async def impl(self, *args, **kwargs):
-        for a in args[:2]:
-            if isinstance(a, Loc) and self._split(a.path) is not None:
-                raise FopError(errno.EROFS, "snapshots are read-only")
-        return await getattr(self.children[0], op_name)(*args, **kwargs)
-    impl.__name__ = op_name
-    return impl
-
-
-for _op in ("unlink", "rmdir", "mkdir", "mknod", "create", "rename",
-            "link", "symlink", "truncate", "setattr", "setxattr",
-            "removexattr"):
-    setattr(SnapviewLayer, _op, _reject_snaps(_op))
-
-
-def _reject_snaps_fd(op_name: str):
-    """fd-carried mutations on a snapshot fd (or any /.snaps path) are
-    EROFS — they must never fall through to the live volume with a
-    foreign gfid."""
-    async def impl(self, fd, *args, **kwargs):
-        if fd.ctx_get(self) is not None or \
-                self._split(fd.path) is not None:
-            raise FopError(errno.EROFS, "snapshots are read-only")
-        return await getattr(self.children[0], op_name)(fd, *args,
-                                                        **kwargs)
-    impl.__name__ = op_name
-    return impl
-
-
-for _op in ("writev", "ftruncate", "fsetattr", "fsetxattr",
-            "fremovexattr", "fallocate", "discard", "zerofill"):
-    setattr(SnapviewLayer, _op, _reject_snaps_fd(_op))
+install_readonly_guards(SnapviewLayer, "_virt_loc", "_virt_fd",
+                        "snapshots are read-only")

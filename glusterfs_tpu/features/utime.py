@@ -1,0 +1,39 @@
+"""features/utime — client-driven time consistency.
+
+Reference: xlators/features/utime (+ posix-metadata ctime): every
+replica/fragment brick stamping mtime from its own clock makes times
+diverge across copies; the utime xlator stamps the CLIENT's clock into
+the request so every brick stores the same instant.  Here: mutating
+fops get ``xdata["frame-time"]``; the posix store honors it for
+mtime/ctime."""
+
+from __future__ import annotations
+
+import time
+
+from ..core.fops import WRITE_FOPS
+from ..core.layer import Layer, register
+
+FRAME_TIME = "frame-time"
+
+
+@register("features/utime")
+class UtimeLayer(Layer):
+    pass
+
+
+def _stamping(op_name: str):
+    async def impl(self, *args, **kwargs):
+        from ..core.virtfs import call_with_xdata
+
+        # callers pass xdata positionally as often as by keyword:
+        # bind against the child's signature and merge there
+        return await call_with_xdata(self.children[0], op_name, args,
+                                     kwargs,
+                                     {FRAME_TIME: time.time()})
+    impl.__name__ = op_name
+    return impl
+
+
+for _f in WRITE_FOPS:
+    setattr(UtimeLayer, _f.value, _stamping(_f.value))

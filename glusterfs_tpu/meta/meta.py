@@ -23,22 +23,21 @@ whole point is looking at NOW); every other path passes through."""
 from __future__ import annotations
 
 import errno
-import hashlib
 import json
-import stat as stat_mod
 import time
 
 from ..core.fops import FopError
-from ..core.iatt import IAType, Iatt
+from ..core.iatt import Iatt
 from ..core.layer import FdObj, Layer, Loc, register, walk
+from ..core.virtfs import (install_readonly_guards, virtual_dir_iatt,
+                           virtual_file_iatt, virtual_gfid)
 from ..core import gflog
 
 META = "/.meta"
 
 
 def _gfid(path: str) -> bytes:
-    return hashlib.md5(b"meta:" + path.encode(
-        "utf-8", "surrogateescape")).digest()
+    return virtual_gfid("meta", path)
 
 
 @register("meta")
@@ -104,23 +103,20 @@ class MetaLayer(Layer):
 
     def _iatt(self, path: str, node) -> Iatt:
         kind, payload = node
-        ia = Iatt(gfid=_gfid(path),
-                  ia_type=IAType.DIR if kind == "dir" else IAType.REG)
-        now = time.time()
-        ia.atime = ia.mtime = ia.ctime = now
         if kind == "dir":
-            ia.mode = stat_mod.S_IFDIR | 0o555
-            ia.nlink = 2
-        else:
-            ia.mode = stat_mod.S_IFREG | 0o444
-            ia.size = len(payload)
-            ia.nlink = 1
-        return ia
+            return virtual_dir_iatt(_gfid(path))
+        return virtual_file_iatt(_gfid(path), len(payload))
 
     @staticmethod
     def _is_meta(path: str | None) -> bool:
         return bool(path) and (path == META or
                                path.startswith(META + "/"))
+
+    def _virt_loc(self, loc: Loc) -> bool:
+        return self._is_meta(loc.path)
+
+    def _virt_fd(self, fd: FdObj) -> bool:
+        return self._is_meta(fd.path)
 
     # -- fops --------------------------------------------------------------
 
@@ -218,18 +214,5 @@ class MetaLayer(Layer):
         return {"layers": sorted(self._layers())}
 
 
-def _reject_meta(op_name: str, nloc: int):
-    """Mutations addressed at /.meta fail EROFS; others pass through."""
-    async def impl(self, *args, **kwargs):
-        for a in args[:nloc]:
-            if isinstance(a, Loc) and self._is_meta(a.path):
-                raise FopError(errno.EROFS, ".meta is read-only")
-        return await getattr(self.children[0], op_name)(*args, **kwargs)
-    impl.__name__ = op_name
-    return impl
-
-
-for _op in ("unlink", "rmdir", "mkdir", "mknod", "create", "rename",
-            "link", "symlink", "truncate", "setattr", "setxattr",
-            "removexattr"):
-    setattr(MetaLayer, _op, _reject_meta(_op, 2))
+install_readonly_guards(MetaLayer, "_virt_loc", "_virt_fd",
+                        ".meta is read-only")

@@ -79,6 +79,19 @@ OPTION_MAP = {
     "features.trash": ("features/trash", "__enable__"),
     "features.shard": ("features/shard", "__enable__"),
     "features.shard-block-size": ("features/shard", "shard-block-size"),
+    "features.leases": ("features/leases", "__enable__"),
+    "features.lease-recall-timeout": ("features/leases",
+                                      "recall-timeout"),
+    "features.quiesce": ("features/quiesce", "quiesce"),
+    "features.gfid-access": ("features/gfid-access", "__enable__"),
+    "features.acl": ("system/posix-acl", "__enable__"),
+    "features.sdfs": ("features/sdfs", "__enable__"),
+    "features.namespace": ("features/namespace", "__enable__"),
+    "features.utime": ("features/utime", "__enable__"),
+    "features.selinux": ("features/selinux", "__enable__"),
+    "network.compression": ("protocol/client", "compression"),
+    "network.compression-min-size": ("protocol/client",
+                                     "compression-min-size"),
 }
 
 # default client-side performance stack, bottom -> top (volgen's
@@ -151,8 +164,24 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
         out.append(_emit(f"{name}-bitrot-stub", "features/bit-rot-stub",
                          {}, [top]))
         top = f"{name}-bitrot-stub"
+    if _enabled(volinfo, "features.selinux", False):
+        out.append(_emit(f"{name}-selinux", "features/selinux", {},
+                         [top]))
+        top = f"{name}-selinux"
+    if _enabled(volinfo, "features.sdfs", False):
+        out.append(_emit(f"{name}-sdfs", "features/sdfs", {}, [top]))
+        top = f"{name}-sdfs"
     out.append(_emit(f"{name}-locks", "features/locks", {}, [top]))
     top = f"{name}-locks"
+    if _enabled(volinfo, "features.leases", False):
+        out.append(_emit(f"{name}-leases", "features/leases",
+                         layer_options(volinfo, "features/leases"),
+                         [top]))
+        top = f"{name}-leases"
+    if _enabled(volinfo, "features.namespace", False):
+        out.append(_emit(f"{name}-namespace", "features/namespace", {},
+                         [top]))
+        top = f"{name}-namespace"
     # pending-heal index on every brick (server_graph_table puts index
     # above locks; index-base defaults under the posix root)
     out.append(_emit(f"{name}-index", "features/index", {}, [top]))
@@ -301,6 +330,18 @@ def build_client_volfile(volinfo: dict,
                          layer_options(volinfo, "features/shard"), [top]))
         top = f"{volinfo['name']}-shard"
 
+    vname = volinfo["name"]
+    if _enabled(volinfo, "features.gfid-access", False):
+        out.append(_emit(f"{vname}-gfid-access", "features/gfid-access",
+                         {}, [top]))
+        top = f"{vname}-gfid-access"
+    if _enabled(volinfo, "features.utime", False):
+        out.append(_emit(f"{vname}-utime", "features/utime", {}, [top]))
+        top = f"{vname}-utime"
+    if _enabled(volinfo, "features.acl", False):
+        out.append(_emit(f"{vname}-acl", "system/posix-acl", {}, [top]))
+        top = f"{vname}-acl"
+
     for ltype, key, default in DEFAULT_PERF_STACK:
         if _enabled(volinfo, key, default):
             lname = f"{volinfo['name']}-{ltype.split('/')[1]}"
@@ -308,6 +349,11 @@ def build_client_volfile(volinfo: dict,
                              [top]))
             top = lname
 
+    # pause gate ALWAYS present: arming rides live reconfigure
+    # (features.quiesce), like the brick-side barrier
+    out.append(_emit(f"{vname}-quiesce", "features/quiesce",
+                     layer_options(volinfo, "features/quiesce"), [top]))
+    top = f"{vname}-quiesce"
     out.append(_emit(f"{volinfo['name']}-io-stats", "debug/io-stats",
                      layer_options(volinfo, "debug/io-stats"), [top]))
     top = f"{volinfo['name']}-io-stats"

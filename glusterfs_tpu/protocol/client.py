@@ -54,6 +54,12 @@ class ClientLayer(Layer):
         Option("ssl-cert", "str", default="",
                description="client certificate (mutual TLS)"),
         Option("ssl-key", "str", default=""),
+        Option("compression", "bool", default="off",
+               description="zlib on-wire frames (the cdc/compress "
+                           "xlator analog); the brick mirrors it on "
+                           "replies after the handshake"),
+        Option("compression-min-size", "size", default="512",
+               description="frames below this ship uncompressed"),
     )
 
     def __init__(self, *args, **kw):
@@ -116,6 +122,8 @@ class ClientLayer(Layer):
         if self.opts["username"]:
             creds = {"username": self.opts["username"],
                      "password": self.opts["password"]}
+        if self.opts["compression"]:
+            creds["compress"] = True
         try:
             res = await self._call("__handshake__",
                                    (self.identity,
@@ -213,8 +221,14 @@ class ClientLayer(Layer):
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[xid] = fut
         try:
-            writer.write(wire.pack(xid, wire.MT_CALL,
-                                   [fop, list(args), kwargs or {}]))
+            body = [fop, list(args), kwargs or {}]
+            if self.opts["compression"]:
+                frame = wire.pack_z(xid, wire.MT_CALL, body,
+                                    int(self.opts[
+                                        "compression-min-size"]))
+            else:
+                frame = wire.pack(xid, wire.MT_CALL, body)
+            writer.write(frame)
             await writer.drain()
         except (ConnectionError, RuntimeError):
             self._pending.pop(xid, None)

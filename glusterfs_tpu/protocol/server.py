@@ -126,6 +126,7 @@ class _ClientConn:
         self.name = ""
         self.authed = False
         self.peer_addr = "?"
+        self.compress = False  # mirror zlib frames after handshake
 
     def register_fd(self, fd: FdObj) -> wire.FdHandle:
         fdid = self.next_fd
@@ -287,7 +288,10 @@ class BrickServer:
                     continue
                 resp_type, resp = await self._dispatch(conn, payload)
                 try:
-                    writer.write(wire.pack(xid, resp_type, resp))
+                    if conn.compress:
+                        writer.write(wire.pack_z(xid, resp_type, resp))
+                    else:
+                        writer.write(wire.pack(xid, resp_type, resp))
                     await writer.drain()
                 except ConnectionError:
                     break
@@ -342,6 +346,7 @@ class BrickServer:
                 conn.identity = args[0]
                 conn.name = args[1] if len(args) > 1 else ""
                 conn.authed = True
+                conn.compress = bool((creds or {}).get("compress"))
                 return wire.MT_REPLY, {"volume": self.top.name, "ok": True}
             if not conn.authed:
                 # SETVOLUME gates everything — pings included (no

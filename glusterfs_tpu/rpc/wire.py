@@ -23,6 +23,9 @@ MT_CALL = 1
 MT_REPLY = 2
 MT_ERROR = 3
 MT_EVENT = 4  # server -> client notifications (upcall channel analog)
+# on-wire compression (the cdc/compress xlator analog): an MT_ZLIB
+# record's body is the zlib deflate of a complete inner record
+MT_ZLIB = 5
 
 # The RPC peer identity of the request currently being dispatched
 # (set per-call by protocol/server, read by brick-side layers that need
@@ -208,10 +211,40 @@ def pack(xid: int, mtype: int, payload: Any) -> bytes:
     return struct.pack(">I", len(rec)) + rec
 
 
+# inflation cap: a few-KB zlib bomb must not materialize gigabytes
+# pre-auth (zlib ratios reach ~1000:1)
+_MAX_INFLATED = 256 << 20
+
+
 def unpack(rec: bytes) -> tuple[int, int, Any]:
     xid, mtype = _HDR.unpack_from(rec, 0)
+    if mtype == MT_ZLIB:
+        import zlib
+
+        d = zlib.decompressobj()
+        inner = d.decompress(rec[_HDR.size:], _MAX_INFLATED)
+        if d.unconsumed_tail:
+            raise WireError("compressed frame exceeds inflation cap")
+        if len(inner) >= 4 + _HDR.size and \
+                _HDR.unpack_from(inner, 4)[1] == MT_ZLIB:
+            raise WireError("nested compression refused")
+        return unpack(inner[4:])  # strip the inner length prefix
     payload, _ = decode_value(memoryview(rec), _HDR.size)
     return xid, mtype, payload
+
+
+def pack_z(xid: int, mtype: int, payload: Any,
+           min_size: int = 512) -> bytes:
+    """Compressed pack: deflate the whole record when it is worth it
+    (small frames ship plain — zlib would grow them)."""
+    import zlib
+
+    plain = pack(xid, mtype, payload)
+    if len(plain) < min_size:
+        return plain
+    body = zlib.compress(plain, 1)
+    rec = _HDR.pack(xid, MT_ZLIB) + body
+    return struct.pack(">I", len(rec)) + rec
 
 
 async def read_frame(reader) -> bytes:

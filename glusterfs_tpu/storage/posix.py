@@ -603,6 +603,11 @@ class PosixLayer(Layer):
 
         try:
             await self._io(work)
+            ft = (xdata or {}).get("frame-time")
+            if ft is not None:
+                # client-stamped time (features/utime): every brick
+                # stores the same instant instead of its own clock's
+                await self._io(os.utime, fdno, (ft, ft))
         except OSError as e:
             raise _fop_errno(e)
         return self._iatt_gfid(fd.gfid)
@@ -611,6 +616,9 @@ class PosixLayer(Layer):
         path = self._loc_path(loc)
         try:
             await self._io(os.truncate, self._abs(path), size)
+            ft = (xdata or {}).get("frame-time")
+            if ft is not None:
+                await self._io(os.utime, self._abs(path), (ft, ft))
         except OSError as e:
             raise _fop_errno(e)
         return self._iatt(path)

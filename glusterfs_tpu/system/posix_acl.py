@@ -1,0 +1,120 @@
+"""system/posix-acl — POSIX ACL permission checks in the graph.
+
+Reference: xlators/system/posix-acl (posix-acl.c): evaluates mode bits
+plus ``system.posix_acl_access`` entries against the caller's
+uid/gid for every access-controlled fop, so permissions hold even when
+the backing store runs as root.  Caller identity rides xdata
+(``uid``/``gid``/``groups`` — the FUSE bridge fills these from the
+kernel request header; in-process API callers may pass them
+explicitly; absent identity means a trusted internal caller and checks
+are skipped, like the reference's frame->root->pid < 0 bypass).
+
+ACL storage: the xattr value is a JSON list of entries
+``[{"tag": "user"|"group"|"other"|"mask", "qual": id|null,
+"perm": rwx-bits}]`` kept verbatim by the store; minimal-but-real
+evaluation order per POSIX 1003.1e: owner -> named users -> owning /
+named groups (masked) -> other."""
+
+from __future__ import annotations
+
+import errno
+import json
+
+from ..core.fops import FopError
+from ..core.iatt import Iatt
+from ..core.layer import Layer, Loc, register
+
+XA_ACL = "system.posix_acl_access"
+
+R, W, X = 4, 2, 1
+
+
+def _entries(raw: bytes | None):
+    if not raw:
+        return None
+    try:
+        return json.loads(raw.decode())
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+def acl_permits(ia: Iatt, acl, uid: int, gid: int, groups, want: int):
+    """POSIX 1003.1e short-circuit evaluation."""
+    if uid == 0:
+        return True
+    mode = ia.mode
+    if uid == ia.uid:
+        return (mode >> 6) & want == want
+    groups = set(groups or ()) | {gid}
+    if acl:
+        mask = next((e["perm"] for e in acl if e["tag"] == "mask"), 7)
+        for e in acl:
+            if e["tag"] == "user" and e["qual"] == uid:
+                return e["perm"] & mask & want == want
+        group_es = [e for e in acl if e["tag"] == "group"]
+        applicable = [e for e in group_es if e["qual"] in groups] + \
+            ([{"perm": (mode >> 3) & 7}] if ia.gid in groups else [])
+        if applicable:
+            return any(e["perm"] & mask & want == want
+                       for e in applicable)
+        other = next((e["perm"] for e in acl if e["tag"] == "other"),
+                     mode & 7)
+        return other & want == want
+    if ia.gid in groups:
+        return (mode >> 3) & want == want
+    return mode & want == want
+
+
+@register("system/posix-acl")
+class PosixAclLayer(Layer):
+    async def _acl_of(self, loc: Loc):
+        try:
+            xa = await self.children[0].getxattr(loc, XA_ACL)
+        except FopError:
+            return None
+        return _entries((xa or {}).get(XA_ACL))
+
+    async def _check(self, loc: Loc, want: int,
+                     xdata: dict | None) -> None:
+        if not xdata or "uid" not in xdata:
+            return  # trusted internal caller
+        ia, _ = await self.children[0].lookup(loc)
+        acl = await self._acl_of(loc)
+        if not acl_permits(ia, acl, int(xdata["uid"]),
+                           int(xdata.get("gid", -1)),
+                           xdata.get("groups"), want):
+            raise FopError(errno.EACCES,
+                           f"{loc.path}: permission denied")
+
+    async def open(self, loc: Loc, flags: int = 0,
+                   xdata: dict | None = None):
+        import os as _os
+
+        acc = flags & _os.O_ACCMODE
+        want = {_os.O_RDONLY: R, _os.O_WRONLY: W,
+                _os.O_RDWR: R | W}.get(acc)
+        if want is None:  # O_WRONLY|O_RDWR together is invalid
+            raise FopError(errno.EINVAL, f"bad access mode {acc}")
+        await self._check(loc, want, xdata)
+        return await self.children[0].open(loc, flags, xdata)
+
+    async def access(self, loc: Loc, mask: int = 0,
+                     xdata: dict | None = None):
+        await self._check(loc, mask & 7, xdata)
+        return {}
+
+    async def opendir(self, loc: Loc, xdata: dict | None = None):
+        await self._check(loc, R, xdata)
+        return await self.children[0].opendir(loc, xdata)
+
+    async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
+                     xdata: dict | None = None):
+        if loc.path and "/" in loc.path.rstrip("/"):
+            parent = loc.path.rsplit("/", 1)[0] or "/"
+            await self._check(Loc(parent), W | X, xdata)
+        return await self.children[0].create(loc, flags, mode, xdata)
+
+    async def unlink(self, loc: Loc, xdata: dict | None = None):
+        parent = loc.path.rsplit("/", 1)[0] or "/"
+        await self._check(Loc(parent), W | X, xdata)
+        return await self.children[0].unlink(loc, xdata)

@@ -1,0 +1,321 @@
+"""Feature-xlator batch: leases, quiesce, gfid-access, posix-acl,
+namespace, sdfs, utime, on-wire compression, selinux (SURVEY §2.7
+rows)."""
+
+import asyncio
+import errno
+import json
+import os
+import time
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc, walk
+from glusterfs_tpu.rpc import wire
+
+
+def _graph(tmp_path, *layers) -> Graph:
+    out = [f"volume posix\n    type storage/posix\n"
+           f"    option directory {tmp_path}/brick\nend-volume\n"]
+    top = "posix"
+    for i, (ltype, opts) in enumerate(layers):
+        name = f"l{i}"
+        body = "".join(f"    option {k} {v}\n" for k, v in opts.items())
+        out.append(f"volume {name}\n    type {ltype}\n{body}"
+                   f"    subvolumes {top}\nend-volume\n")
+        top = name
+    return Graph.construct("\n".join(out))
+
+
+def test_quiesce_pause_and_replay(tmp_path):
+    async def run():
+        g = _graph(tmp_path, ("features/quiesce", {}))
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/a", b"before")
+        q = g.top
+        q.reconfigure({"quiesce": "on"})
+        t = asyncio.create_task(c.write_file("/b", b"parked"))
+        await asyncio.sleep(0.2)
+        assert not t.done()  # held, not failed
+        assert q.dump_private()["quiesced"]
+        q.reconfigure({"quiesce": "off"})
+        await asyncio.wait_for(t, 5)  # replayed
+        assert await c.read_file("/b") == b"parked"
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_gfid_access_virtual_path(tmp_path):
+    async def run():
+        g = _graph(tmp_path, ("features/gfid-access", {}))
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/real", b"by-gfid")
+        ia = await c.stat("/real")
+        hexg = ia.gfid.hex()
+        data = await c.read_file(f"/.gfid/{hexg}")
+        assert data == b"by-gfid"
+        # dashed uuid form too
+        import uuid
+        dashed = str(uuid.UUID(bytes=ia.gfid))
+        assert (await c.stat(f"/.gfid/{dashed}")).gfid == ia.gfid
+        with pytest.raises(FopError) as ei:
+            await c.stat("/.gfid/zz-not-a-uuid")
+        assert ei.value.err == errno.EINVAL
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_posix_acl_enforcement(tmp_path):
+    async def run():
+        g = _graph(tmp_path, ("system/posix-acl", {}))
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/guarded", b"secret")
+        # file is 0o644 owned by our uid (root in CI): another uid has
+        # r but not w
+        top = g.top
+        ia = await c.stat("/guarded")
+        other = {"uid": ia.uid + 1000, "gid": ia.gid + 1000}
+        await top.open(Loc("/guarded"), os.O_RDONLY, dict(other))
+        with pytest.raises(FopError) as ei:
+            await top.open(Loc("/guarded"), os.O_WRONLY, dict(other))
+        assert ei.value.err == errno.EACCES
+        # a named-user ACL entry grants rw to that uid only
+        acl = [{"tag": "user", "qual": ia.uid + 1000, "perm": 6},
+               {"tag": "mask", "qual": None, "perm": 6}]
+        await top.setxattr(Loc("/guarded"),
+                           {"system.posix_acl_access":
+                            json.dumps(acl).encode()})
+        await top.open(Loc("/guarded"), os.O_WRONLY, dict(other))
+        third = {"uid": ia.uid + 2000, "gid": ia.gid + 2000}
+        with pytest.raises(FopError):
+            await top.open(Loc("/guarded"), os.O_WRONLY, dict(third))
+        # identity-less (internal) callers bypass, like the reference
+        await top.open(Loc("/guarded"), os.O_WRONLY)
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_namespace_tagging(tmp_path):
+    async def run():
+        g = _graph(tmp_path, ("features/namespace", {}))
+        c = Client(g)
+        await c.mount()
+        await c.mkdir("/tenant-a")
+        await c.write_file("/tenant-a/f", b"x")
+        await c.write_file("/top", b"y")
+        ns = g.top.dump_private()["namespaces"]
+        assert ns.get("tenant-a", 0) > 0
+        assert ns.get("top", 0) > 0
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_sdfs_serializes_entry_fops(tmp_path):
+    async def run():
+        g = _graph(tmp_path, ("features/sdfs", {}))
+        c = Client(g)
+        await c.mount()
+        # racing creates of the same name: exactly one wins, no torn
+        # state (the serializer makes the loser see EEXIST, not a race)
+        results = await asyncio.gather(
+            *(g.top.create(Loc("/same"), os.O_CREAT | os.O_EXCL)
+              for _ in range(8)), return_exceptions=True)
+        ok = [r for r in results if not isinstance(r, BaseException)]
+        errs = [r for r in results if isinstance(r, FopError)]
+        assert len(ok) == 1 and len(errs) == 7
+        assert all(e.err == errno.EEXIST for e in errs)
+        assert g.top.dump_private()["serialized"] >= 8
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_utime_client_stamp(tmp_path):
+    async def run():
+        g = _graph(tmp_path, ("features/utime", {}))
+        c = Client(g)
+        await c.mount()
+        before = time.time()
+        await c.write_file("/stamped", b"x")
+        ia = await c.stat("/stamped")
+        # mtime came from the client's clock at fop time
+        assert before - 1 <= ia.mtime <= time.time() + 1
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_selinux_xattr_translation(tmp_path):
+    async def run():
+        g = _graph(tmp_path, ("features/selinux", {}))
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/ctx", b"x")
+        await g.top.setxattr(Loc("/ctx"), {
+            "security.selinux": b"system_u:object_r:etc_t:s0"})
+        # clients read it back under the security name
+        xa = await g.top.getxattr(Loc("/ctx"), "security.selinux")
+        assert xa["security.selinux"] == b"system_u:object_r:etc_t:s0"
+        # at rest it lives in the trusted namespace
+        raw = await g.top.children[0].getxattr(Loc("/ctx"), None)
+        assert "trusted.glusterfs.selinux" in raw
+        assert "security.selinux" not in raw
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_wire_compression_roundtrip():
+    big = {"blob": b"A" * 100000, "n": 42}
+    frame = wire.pack_z(7, wire.MT_REPLY, big)
+    assert len(frame) < 5000  # actually compressed
+    xid, mtype, payload = wire.unpack(frame[4:])
+    assert xid == 7 and mtype == wire.MT_REPLY
+    assert payload["blob"] == b"A" * 100000 and payload["n"] == 42
+    # small frames ship plain
+    small = wire.pack_z(8, wire.MT_CALL, {"x": 1})
+    assert small == wire.pack(8, wire.MT_CALL, {"x": 1})
+
+
+def test_leases_grant_conflict_recall(tmp_path):
+    async def run():
+        g = _graph(tmp_path, ("features/locks", {}),
+                   ("features/leases", {"recall-timeout": "0.3"}))
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/leased", b"v")
+        top = g.top
+        recalls = []
+        top.set_upcall_sink(lambda targets, payload:
+                            recalls.append((targets, payload)))
+        # client A takes a RW lease
+        tok_a = wire.CURRENT_CLIENT.set(b"client-A")
+        await top.lease(Loc("/leased"), "grant", "rw", "lease-1")
+        # A's own writes pass untouched
+        await c.write_file("/leased", b"v2")
+        wire.CURRENT_CLIENT.reset(tok_a)
+        # client B writes: A is recalled; unreturned -> revoked after
+        # the grace, then B proceeds
+        tok_b = wire.CURRENT_CLIENT.set(b"client-B")
+        t0 = time.monotonic()
+        await c.write_file("/leased", b"from-B")
+        took = time.monotonic() - t0
+        wire.CURRENT_CLIENT.reset(tok_b)
+        assert recalls and recalls[0][0] == [b"client-A"]
+        assert recalls[0][1]["event"] == "lease-recall"
+        assert took >= 0.25  # waited the recall grace
+        assert await c.read_file("/leased") == b"from-B"
+        # the revoked lease id cannot be re-granted
+        tok_a = wire.CURRENT_CLIENT.set(b"client-A")
+        with pytest.raises(FopError) as ei:
+            await top.lease(Loc("/leased"), "grant", "rw", "lease-1")
+        assert ei.value.err == errno.ESTALE
+        # voluntary release path: grant + release, no recall needed
+        await top.lease(Loc("/leased"), "grant", "rd", "lease-2")
+        await top.lease(Loc("/leased"), "release", "rd", "lease-2")
+        wire.CURRENT_CLIENT.reset(tok_a)
+        assert top.dump_private()["leases"] == 0
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_volgen_wires_batch_layers(tmp_path):
+    from glusterfs_tpu.mgmt import volgen
+
+    vi = {
+        "name": "bv", "type": "disperse", "redundancy": 2,
+        "bricks": [{"index": i, "host": "h", "port": 1,
+                    "path": str(tmp_path / f"b{i}"),
+                    "name": f"bv-brick-{i}", "node": "x"}
+                   for i in range(6)],
+        "options": {"features.leases": "on", "features.sdfs": "on",
+                    "features.namespace": "on", "features.selinux": "on",
+                    "features.gfid-access": "on", "features.utime": "on",
+                    "features.acl": "on",
+                    "network.compression": "on"},
+    }
+    btext = volgen.build_brick_volfile(vi, vi["bricks"][0])
+    for t in ("features/leases", "features/sdfs", "features/namespace",
+              "features/selinux"):
+        assert f"type {t}" in btext, t
+    ctext = volgen.build_client_volfile(vi)
+    for t in ("features/gfid-access", "features/utime",
+              "system/posix-acl", "features/quiesce"):
+        assert f"type {t}" in ctext, t
+    assert "option compression on" in ctext
+    # both graphs construct
+    Graph = __import__("glusterfs_tpu.core.graph",
+                       fromlist=["Graph"]).Graph
+    Graph.construct(btext)
+    Graph.construct(ctext)
+
+
+def test_wire_compression_e2e(tmp_path):
+    """Compressed frames over a real brick connection: handshake
+    negotiates, both directions survive, payloads stay byte-exact."""
+    from glusterfs_tpu.daemon import serve_brick
+
+    BRICK = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+"""
+    CLIENT = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume posix
+    option compression on
+end-volume
+"""
+
+    async def run():
+        server = await serve_brick(BRICK)
+        g = Graph.construct(CLIENT.format(port=server.port))
+        c = Client(g)
+        await c.mount()
+        for _ in range(100):
+            if g.top.connected:
+                break
+            await asyncio.sleep(0.05)
+        blob = bytes(range(256)) * 4000  # 1MB compressible
+        await c.write_file("/z", blob)
+        assert await c.read_file("/z") == blob
+        srv_conn = next(iter(server.connections))
+        assert srv_conn.compress  # negotiated at handshake
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_utime_under_io_stats(tmp_path):
+    """The realistic stacking (io-stats forwards xdata positionally):
+    utime must bind into the child signature, not double-pass xdata."""
+    async def run():
+        g = _graph(tmp_path, ("features/utime", {}),
+                   ("debug/io-stats", {}))
+        c = Client(g)
+        await c.mount()
+        before = time.time()
+        await c.write_file("/f", b"x" * 1000)
+        await c.truncate("/f", 10)
+        ia = await c.stat("/f")
+        assert ia.size == 10
+        assert before - 1 <= ia.mtime <= time.time() + 1
+        await c.unmount()
+
+    asyncio.run(run())
