@@ -234,6 +234,72 @@ def main() -> None:
     dec_base = max(base.get("native_decode_MiB_s", 0),
                    base["avx_model_decode_MiB_s"])
 
+    # --- config sweep (BASELINE.md: 8+3 / 8+4 / 16+4, heal re-encode,
+    # batched rchecksum) — secondary metrics, one pass each ------------
+    sweep: dict = {}
+    try:
+        sweep_bytes = 16 * MIB
+        sdata = rng.integers(0, 256, sweep_bytes, dtype=np.uint8)
+        for sk, sr in ((8, 3), (8, 4), (16, 4)):
+            sn = sk + sr
+            if on_tpu:
+                efn = gf256_pallas._fused_encode_fn(sk, sn, False)
+            else:
+                efn = gf256_xla._encode_fn(sk, sn, "matmul")
+            sd = jnp.asarray(sdata)
+            sfr = np.asarray(jax.block_until_ready(efn(sd)))
+            assert np.array_equal(sfr, gf256.ref_encode(sdata, sk, sn)), \
+                f"{sk}+{sr} encode parity"
+            et = device_loop_seconds(efn, sd)
+            srows = tuple(range(sr, sn))  # first R fragments lost
+            if on_tpu:
+                dfn = gf256_pallas._fused_decode_fn(sk, srows, False)
+            else:
+                bb = jnp.asarray(gf256.decode_bits_cached(sk, srows))
+                raw = gf256_xla._decode_fn(sk, "matmul", None)
+                dfn = lambda s, _b=bb: raw(s, _b)  # noqa: E731
+            sv = jnp.asarray(sfr[list(srows)])
+            assert np.array_equal(np.asarray(dfn(sv)), sdata), \
+                f"{sk}+{sr} decode parity"
+            dt = device_loop_seconds(dfn, sv)
+            sweep[f"{sk}+{sr}"] = {
+                "encode_MiB_s": round(sweep_bytes / MIB / et, 1),
+                "decode_MiB_s": round(sweep_bytes / MIB / dt, 1),
+                "encode_vs_avx_model": round(
+                    sweep_bytes / MIB / et /
+                    (model_avx_bytes_per_s(sn, sk) / MIB), 2),
+            }
+        # heal re-encode: decode from K survivors, re-encode all N
+        # (ec_rebuild_data's compute, chained on device)
+        if on_tpu:
+            efn = gf256_pallas._fused_encode_fn(K, N, False)
+            dfn = gf256_pallas._fused_decode_fn(K, tuple(rows), False)
+
+            def heal_fn(s):
+                return efn(dfn(s).reshape(-1))
+
+            hv = jnp.asarray(np.asarray(frags_dev)[rows])
+            ht = device_loop_seconds(heal_fn, hv)
+            sweep["heal_reencode_MiB_s"] = round(DATA_BYTES / MIB / ht, 1)
+        # batched rchecksum (checksum.c on-device: adler32 of 64K blocks)
+        from glusterfs_tpu.ops import checksum as ckm
+
+        blocks_np = data[: 32 * MIB].reshape(-1, 64 * 1024)
+        jb = jnp.asarray(blocks_np)
+        out = np.asarray(jax.block_until_ready(
+            ckm.adler32_batch_jax(jb)))
+        import zlib as _zlib
+
+        assert out[0] == _zlib.adler32(blocks_np[0].tobytes())
+        ct = device_loop_seconds(ckm.adler32_batch_jax, jb)
+        zt = time_it(lambda: [_zlib.adler32(b.tobytes())
+                              for b in blocks_np[:64]], 1, 3)
+        sweep["rchecksum_MiB_s"] = round(32 * MIB / MIB / ct, 1)
+        sweep["rchecksum_zlib_MiB_s"] = round(
+            64 * 64 * 1024 / MIB / zt, 1)
+    except Exception as e:  # sweep is auxiliary; never sink the run
+        sweep["sweep_error"] = str(e)[:200]
+
     # e2e served-path numbers: device path (through the dev tunnel, which
     # adds ~100ms+ per transfer — a real TPU-local host skips that) and
     # the native CPU ladder for transfer-free context
@@ -256,6 +322,7 @@ def main() -> None:
         "baseline_encode_MiB_s": round(enc_base, 1),
         "baseline_decode_MiB_s": round(dec_base, 1),
         **{k: round(v, 1) for k, v in base.items()},
+        "sweep": sweep,
         **vol,
     }))
 

@@ -841,14 +841,29 @@ class ReplicateLayer(Layer):
             # arbiter sinks take only the metadata fix below, no data
             data_bad = [i for i in bad if i not in self.arbiters]
             while off < src_ia.size:
-                chunk = await self.children[src].readv(
-                    sfd, min(window, src_ia.size - off), off)
-                await self._dispatch(
-                    data_bad, "writev",
-                    lambda i: ((FdObj(ia.gfid, path=path, anonymous=True),
-                                chunk, off),
-                               {"xdata": {HEAL_WRITE: True}}))
-                off += len(chunk)
+                blk = min(window, src_ia.size - off)
+                # rchecksum handshake first (afr_selfheal_data block
+                # compare): byte-identical windows are skipped instead
+                # of shipped — most of a file usually matches
+                src_ck = await self.children[src].rchecksum(sfd, off,
+                                                            blk)
+                cks = await self._dispatch(
+                    data_bad, "rchecksum",
+                    lambda i: ((FdObj(ia.gfid, path=path,
+                                      anonymous=True), off, blk), {}))
+                need = [i for i in data_bad
+                        if isinstance(cks.get(i), BaseException)
+                        or cks[i].get("strong") != src_ck["strong"]
+                        or cks[i].get("len") != src_ck["len"]]
+                if need:
+                    chunk = await self.children[src].readv(sfd, blk,
+                                                           off)
+                    await self._dispatch(
+                        need, "writev",
+                        lambda i: ((FdObj(ia.gfid, path=path,
+                                          anonymous=True), chunk, off),
+                                   {"xdata": {HEAL_WRITE: True}}))
+                off += blk
             await self._dispatch(data_bad, "truncate",
                                  lambda i: ((loc, src_ia.size), {}))
             meta = await self._get_meta([src], loc)

@@ -88,6 +88,25 @@ def install_readonly_guards(cls, is_virtual_loc: str,
 _SIG_CACHE: dict = {}
 
 
+def extract_xdata(child, op_name: str, args: tuple,
+                  kwargs: dict) -> dict | None:
+    """Read the xdata argument wherever the caller put it, without
+    disturbing the call."""
+    fn = getattr(child, op_name)
+    key = (type(child), op_name)
+    sig = _SIG_CACHE.get(key)
+    if sig is None:
+        sig = _SIG_CACHE[key] = inspect.signature(fn)
+    if "xdata" not in sig.parameters:
+        return None
+    try:
+        ba = sig.bind(*args, **kwargs)
+    except TypeError:
+        return None
+    xd = ba.arguments.get("xdata")
+    return xd if isinstance(xd, dict) else None
+
+
 def call_with_xdata(child, op_name: str, args: tuple, kwargs: dict,
                     update: dict):
     """Invoke child.op(*args, **kwargs) with `update` merged into its

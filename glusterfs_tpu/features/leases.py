@@ -77,20 +77,23 @@ class LeasesLayer(Layer):
         client = wire.CURRENT_CLIENT.get()
         ia, _ = await self.children[0].lookup(loc)
         gfid = bytes(ia.gfid)
-        held = self._leases.setdefault(gfid, [])
+        held = self._leases.get(gfid, [])
         if cmd == "grant":
             if not lease_id:
                 raise FopError(errno.EINVAL, "grant needs a lease-id")
             if (client, lease_id) in self._revoked:
                 raise FopError(errno.ESTALE, "lease was revoked")
             # a RW lease conflicts with anything from another client;
-            # RD leases share with RD
+            # RD leases share with RD.  Only a SUCCESSFUL grant may
+            # materialize the gfid entry (failed probes must not grow
+            # the table).
             for l in held:
                 if l.client != client and (ltype == RW_LEASE or
                                            l.ltype == RW_LEASE):
                     raise FopError(errno.EAGAIN,
                                    "conflicting lease held")
-            held.append(_Lease(lease_id, ltype, client))
+            self._leases.setdefault(gfid, []).append(
+                _Lease(lease_id, ltype, client))
             return {"granted": ltype, "lease-id": lease_id}
         if cmd == "release":
             before = len(held)
@@ -142,11 +145,19 @@ class LeasesLayer(Layer):
 
         ret = await self.children[0].open(loc, flags, xdata)
         if self.opts["leases"] and loc.gfid:
-            # opens for write conflict with RW leases (lease checks at
-            # open time, leases.c open path)
-            if flags & (_os.O_WRONLY | _os.O_RDWR):
-                await self._check(bytes(loc.gfid), True)
+            # write-opens conflict with any lease; read-opens conflict
+            # with RW leases (leases.c open path)
+            wr = bool(flags & (_os.O_WRONLY | _os.O_RDWR))
+            await self._check(bytes(loc.gfid), wr)
         return ret
+
+    async def readv(self, fd, size: int, offset: int,
+                    xdata: dict | None = None):
+        if self.opts["leases"] and fd.gfid:
+            # a reader must recall another client's RW lease first
+            # (its holder may be caching unwritten data)
+            await self._check(bytes(fd.gfid), False)
+        return await self.children[0].readv(fd, size, offset, xdata)
 
     def dump_private(self) -> dict:
         return {"inodes": len(self._leases),

@@ -19,24 +19,50 @@ def _parent(path: str) -> str:
     return path.rsplit("/", 1)[0] or "/"
 
 
+class _DirLock:
+    __slots__ = ("lock", "refs")
+
+    def __init__(self):
+        self.lock = asyncio.Lock()
+        self.refs = 0
+
+
 @register("features/sdfs")
 class SdfsLayer(Layer):
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
-        self._locks: dict[str, asyncio.Lock] = {}
+        self._locks: dict[str, _DirLock] = {}
         self.serialized = 0
 
-    def _lock(self, d: str) -> asyncio.Lock:
-        lk = self._locks.get(d)
-        if lk is None:
-            lk = self._locks[d] = asyncio.Lock()
-        return lk
+    def _acquire_entry(self, d: str) -> "_DirLock":
+        e = self._locks.get(d)
+        if e is None:
+            e = self._locks[d] = _DirLock()
+        e.refs += 1
+        return e
+
+    def _release_entry(self, d: str) -> None:
+        e = self._locks.get(d)
+        if e is None:
+            return
+        e.refs -= 1
+        # refcounted eviction: only drop an entry no task references
+        # (a bare .locked() check would race a waiter holding the old
+        # object while a newcomer mints a fresh one)
+        if e.refs <= 0:
+            del self._locks[d]
 
     async def _serialized(self, dirs: list[str], op: str, args, kwargs):
         self.serialized += 1
         ordered = sorted(set(dirs))
-        async with _MultiLock([self._lock(d) for d in ordered]):
-            return await getattr(self.children[0], op)(*args, **kwargs)
+        entries = [self._acquire_entry(d) for d in ordered]
+        try:
+            async with _MultiLock([e.lock for e in entries]):
+                return await getattr(self.children[0], op)(*args,
+                                                           **kwargs)
+        finally:
+            for d in ordered:
+                self._release_entry(d)
 
     def dump_private(self) -> dict:
         return {"serialized": self.serialized,

@@ -45,3 +45,14 @@ class SelinuxLayer(Layer):
                           xdata: dict | None = None):
         return await self.children[0].removexattr(
             loc, STORE_KEY if name == CLIENT_KEY else name, xdata)
+
+    async def fgetxattr(self, fd: FdObj, name: str | None = None,
+                        xdata: dict | None = None):
+        ret = await self.children[0].fgetxattr(
+            fd, STORE_KEY if name == CLIENT_KEY else name, xdata)
+        return _to_client(ret or {})
+
+    async def fremovexattr(self, fd: FdObj, name: str,
+                           xdata: dict | None = None):
+        return await self.children[0].fremovexattr(
+            fd, STORE_KEY if name == CLIENT_KEY else name, xdata)

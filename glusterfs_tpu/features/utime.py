@@ -4,8 +4,10 @@ Reference: xlators/features/utime (+ posix-metadata ctime): every
 replica/fragment brick stamping mtime from its own clock makes times
 diverge across copies; the utime xlator stamps the CLIENT's clock into
 the request so every brick stores the same instant.  Here: mutating
-fops get ``xdata["frame-time"]``; the posix store honors it for
-mtime/ctime."""
+fops get ``xdata["frame-time"]``; the posix store applies it to mtime
+(atime preserved; ctime is kernel-managed and advances with the stamp
+syscall itself — the reference needs posix-metadata's own ctime store
+for full ctime control, which this build folds into mtime parity)."""
 
 from __future__ import annotations
 

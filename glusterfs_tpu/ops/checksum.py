@@ -1,0 +1,88 @@
+"""Block checksums — the libglusterfs checksum.c (gf_rchecksum)
+analog, TPU-batchable.
+
+The reference computes a weak rolling checksum + a strong digest per
+block so AFR data heal can skip byte-identical regions
+(afr-self-heal-data.c).  The weak sum here is Adler-32 (zlib.adler32
+byte-compatible) — sequential by definition, but algebraically just
+two weighted sums:
+
+    A = 1 + sum(d_i)                 (mod 65521)
+    B = n + sum((n - i) * d_i)       (mod 65521)
+
+which makes a [batch, block] uint8 array one reduction pair on the
+MXU-adjacent vector units — thousands of blocks checksummed per
+launch, the coalesced-batch regime everything else in ops/ uses.
+Strong digests stay sha256 on the host (cryptographic, not worth
+emulating on-device).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+_MOD = 65521
+
+
+def adler32_ref(block: bytes) -> int:
+    """zlib oracle."""
+    return zlib.adler32(block) & 0xFFFFFFFF
+
+
+def adler32_batch_np(blocks: np.ndarray) -> np.ndarray:
+    """NumPy fallback: [n, b] uint8 -> [n] uint32 adler32."""
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+    n, b = blocks.shape
+    d = blocks.astype(np.uint64)
+    a = (1 + d.sum(axis=1)) % _MOD
+    w = np.arange(b, 0, -1, dtype=np.uint64)
+    bsum = (b + (d * w).sum(axis=1)) % _MOD
+    return (bsum.astype(np.uint32) << 16) | a.astype(np.uint32)
+
+
+_JIT_CACHE: dict = {}
+
+
+def adler32_batch_jax(blocks):
+    """jit-compiled batched adler32: [n, b] uint8 on device -> [n]
+    uint32.  Weighted sums are taken in int32 segments small enough
+    not to overflow, then folded mod 65521."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        n, b = x.shape
+        d = x.astype(jnp.uint32)
+        # segment the weighted sum so partials stay under 2^31:
+        # max term = 255 * seg_len * seg_count-scaled weights; use
+        # float-free exact arithmetic by reducing in uint32 with
+        # interleaved mods every segment
+        seg = 4096
+        pad = (-b) % seg
+        dp = jnp.pad(d, ((0, 0), (0, pad)))
+        w = jnp.pad(jnp.arange(b, 0, -1, dtype=jnp.uint32),
+                    (0, pad))
+        ds = dp.reshape(n, -1, seg)
+        ws = w.reshape(-1, seg)
+        a = (1 + jnp.sum(ds, axis=(1, 2))) % _MOD
+        partial = jnp.sum(ds * ws[None, :, :] % _MOD,
+                          axis=2) % _MOD  # [n, segs]
+        bsum = (b + jnp.sum(partial, axis=1)) % _MOD
+        return (bsum << 16) | a
+
+    key = blocks.shape if hasattr(blocks, "shape") else None
+    jitted = _JIT_CACHE.get("fn")
+    if jitted is None:
+        jitted = _JIT_CACHE["fn"] = jax.jit(fn)
+    return jitted(blocks)
+
+
+def rchecksum(data: bytes, backend: str = "auto") -> dict:
+    """One block's weak+strong checksum (the posix rchecksum fop
+    payload)."""
+    import hashlib
+
+    return {"weak": adler32_ref(data),
+            "strong": hashlib.sha256(data).hexdigest()}

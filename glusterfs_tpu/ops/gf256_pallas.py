@@ -270,13 +270,16 @@ def _fused_encode_kernel(sels: tuple[tuple[int, ...], ...], k: int, n: int):
     return kernel
 
 
-def _fused_decode_kernel(sels: tuple[tuple[int, ...], ...], k: int):
+def _fused_decode_kernel(sels: tuple[tuple[int, ...], ...], k: int,
+                         ncols: int | None = None):
+    ncols = k if ncols is None else ncols
+
     def kernel(x_ref, o_ref):
         # one wide value first: lane-slicing from k separate (ts, 512)
         # block values generates markedly slower code
         x = jnp.concatenate([x_ref[f] for f in range(k)], axis=1)
         planes = [x[:, j * 64:(j + 1) * 64] for j in range(k * 8)]
-        for c in range(k):
+        for c in range(ncols):
             accs = []
             for b in range(8):
                 sel = sels[c * 8 + b]
@@ -289,12 +292,22 @@ def _fused_decode_kernel(sels: tuple[tuple[int, ...], ...], k: int):
     return kernel
 
 
+# past this many unrolled XOR selections per kernel body the TPU
+# compiler keels over (observed: 16+4 fails, 8+4 fine) — split the
+# output fragments across multiple pallas calls instead
+_MAX_SELS_PER_KERNEL = 100
+
+
 @functools.lru_cache(maxsize=64)
 def _fused_encode_fn(k: int, n: int, interpret: bool):
     """jitted: flat stripe-major bytes (S*k*512,) -> fragments (n, S*512)."""
     sels = _sels_from_bits(gf256.expand_bitmatrix(gf256.encode_matrix(k, n)))
-    kernel = _fused_encode_kernel(sels, k, n)
     ts = _FUSED_TS
+    group = max(1, _MAX_SELS_PER_KERNEL // (8 * max(1, k // 8)))
+    groups = [(f0, min(f0 + group, n)) for f0 in range(0, n, group)] \
+        if k > 8 else [(0, n)]
+    kernels = [(_fused_encode_kernel(sels[f0 * 8:f1 * 8], k, f1 - f0),
+                f0, f1) for f0, f1 in groups]
 
     @jax.jit
     def run(flat):
@@ -303,16 +316,22 @@ def _fused_encode_fn(k: int, n: int, interpret: bool):
         x = flat.reshape(s, k * gf256.CHUNK_SIZE)
         if sp != s:
             x = jnp.pad(x, ((0, sp - s), (0, 0)))
-        out = pl.pallas_call(
-            kernel,
-            out_shape=jax.ShapeDtypeStruct((n, sp, 512), jnp.uint8),
-            grid=(sp // ts,),
-            in_specs=[pl.BlockSpec((ts, k * 512), lambda i: (i, 0),
-                                   memory_space=pltpu.VMEM)],
-            out_specs=pl.BlockSpec((n, ts, 512), lambda i: (0, i, 0),
-                                   memory_space=pltpu.VMEM),
-            interpret=interpret,
-        )(x)
+        parts = []
+        for kernel, f0, f1 in kernels:
+            g = f1 - f0
+            parts.append(pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((g, sp, 512), jnp.uint8),
+                grid=(sp // ts,),
+                in_specs=[pl.BlockSpec((ts, k * 512), lambda i: (i, 0),
+                                       memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec((g, ts, 512),
+                                       lambda i: (0, i, 0),
+                                       memory_space=pltpu.VMEM),
+                interpret=interpret,
+            )(x))
+        out = parts[0] if len(parts) == 1 else \
+            jnp.concatenate(parts, axis=0)
         return out[:, :s, :].reshape(n, s * gf256.CHUNK_SIZE)
 
     return run
@@ -325,8 +344,12 @@ def _fused_decode_fn(k: int, rows: tuple[int, ...], interpret: bool):
     One jitted decoder per surviving mask (the LRU here mirrors the
     reference's LRU of inverted matrices, ec-method.c:200-245)."""
     sels = _sels_from_bits(gf256.decode_bits_cached(k, rows))
-    kernel = _fused_decode_kernel(sels, k)
     ts = _FUSED_TS
+    group = max(1, _MAX_SELS_PER_KERNEL // (8 * max(1, k // 8)))
+    groups = [(c0, min(c0 + group, k)) for c0 in range(0, k, group)] \
+        if k > 8 else [(0, k)]
+    kernels = [(_fused_decode_kernel(sels[c0 * 8:c1 * 8], k, c1 - c0),
+                c0, c1) for c0, c1 in groups]
 
     @jax.jit
     def run(frags):
@@ -335,16 +358,22 @@ def _fused_decode_fn(k: int, rows: tuple[int, ...], interpret: bool):
         x = frags.reshape(k, s, 512)
         if sp != s:
             x = jnp.pad(x, ((0, 0), (0, sp - s), (0, 0)))
-        out = pl.pallas_call(
-            kernel,
-            out_shape=jax.ShapeDtypeStruct((sp, k * 512), jnp.uint8),
-            grid=(sp // ts,),
-            in_specs=[pl.BlockSpec((k, ts, 512), lambda i: (0, i, 0),
-                                   memory_space=pltpu.VMEM)],
-            out_specs=pl.BlockSpec((ts, k * 512), lambda i: (i, 0),
-                                   memory_space=pltpu.VMEM),
-            interpret=interpret,
-        )(x)
+        parts = []
+        for kernel, c0, c1 in kernels:
+            g = c1 - c0
+            parts.append(pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((sp, g * 512), jnp.uint8),
+                grid=(sp // ts,),
+                in_specs=[pl.BlockSpec((k, ts, 512),
+                                       lambda i: (0, i, 0),
+                                       memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec((ts, g * 512), lambda i: (i, 0),
+                                       memory_space=pltpu.VMEM),
+                interpret=interpret,
+            )(x))
+        out = parts[0] if len(parts) == 1 else \
+            jnp.concatenate(parts, axis=1)
         return out[:s].reshape(s * k * gf256.CHUNK_SIZE)
 
     return run

@@ -605,9 +605,11 @@ class PosixLayer(Layer):
             await self._io(work)
             ft = (xdata or {}).get("frame-time")
             if ft is not None:
-                # client-stamped time (features/utime): every brick
-                # stores the same instant instead of its own clock's
-                await self._io(os.utime, fdno, (ft, ft))
+                # client-stamped mtime (features/utime): every brick
+                # stores the same instant instead of its own clock's;
+                # atime is preserved (POSIX: write leaves atime alone)
+                st = await self._io(os.fstat, fdno)
+                await self._io(os.utime, fdno, (st.st_atime, ft))
         except OSError as e:
             raise _fop_errno(e)
         return self._iatt_gfid(fd.gfid)
@@ -618,7 +620,9 @@ class PosixLayer(Layer):
             await self._io(os.truncate, self._abs(path), size)
             ft = (xdata or {}).get("frame-time")
             if ft is not None:
-                await self._io(os.utime, self._abs(path), (ft, ft))
+                st = await self._io(os.stat, self._abs(path))
+                await self._io(os.utime, self._abs(path),
+                               (st.st_atime, ft))
         except OSError as e:
             raise _fop_errno(e)
         return self._iatt(path)
@@ -879,12 +883,14 @@ class PosixLayer(Layer):
 
     async def rchecksum(self, fd: FdObj, offset: int, length: int,
                         xdata: dict | None = None):
-        """(weak, strong) checksums of a byte range (reference
-        libglusterfs checksum.c rchecksum: adler32 weak + strong hash)."""
-        data = await self.readv(fd, length, offset)
-        import hashlib
+        """Weak (adler32) + strong (sha256) checksum of a byte range —
+        the posix_rchecksum fop (libglusterfs checksum.c): heal
+        compares block checksums across bricks instead of shipping
+        the bytes."""
+        from ..ops.checksum import rchecksum as _rck
 
-        return zlib.adler32(data), hashlib.md5(data).digest()
+        data = await self.readv(fd, length, offset)
+        return {**_rck(data), "len": len(data)}
 
     async def ipc(self, op: int = 0, xdata: dict | None = None):
         return {}

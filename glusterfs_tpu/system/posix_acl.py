@@ -118,3 +118,39 @@ class PosixAclLayer(Layer):
         parent = loc.path.rsplit("/", 1)[0] or "/"
         await self._check(Loc(parent), W | X, xdata)
         return await self.children[0].unlink(loc, xdata)
+
+
+def _self_write_gated(op_name: str):
+    """Mutations of the object itself need W on it."""
+    async def impl(self, loc: Loc, *args, **kwargs):
+        from ..core.virtfs import extract_xdata
+
+        xd = extract_xdata(self.children[0], op_name,
+                           (loc, *args), kwargs)
+        await self._check(loc, W, xd)
+        return await getattr(self.children[0], op_name)(loc, *args,
+                                                        **kwargs)
+    impl.__name__ = op_name
+    return impl
+
+
+def _parent_write_gated(op_name: str, nloc: int):
+    """Namespace mutations need W|X on every parent involved."""
+    async def impl(self, *args, **kwargs):
+        from ..core.virtfs import extract_xdata
+
+        xd = extract_xdata(self.children[0], op_name, args, kwargs)
+        for a in args[:nloc]:
+            if isinstance(a, Loc) and a.path:
+                parent = a.path.rsplit("/", 1)[0] or "/"
+                await self._check(Loc(parent), W | X, xd)
+        return await getattr(self.children[0], op_name)(*args, **kwargs)
+    impl.__name__ = op_name
+    return impl
+
+
+for _op in ("truncate", "setattr", "setxattr", "removexattr"):
+    setattr(PosixAclLayer, _op, _self_write_gated(_op))
+for _op, _n in (("mkdir", 1), ("mknod", 1), ("rmdir", 1),
+                ("symlink", 2), ("rename", 2), ("link", 2)):
+    setattr(PosixAclLayer, _op, _parent_write_gated(_op, _n))
