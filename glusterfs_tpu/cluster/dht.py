@@ -171,10 +171,22 @@ class DistributeLayer(Layer):
             raise last
         return {}
 
+    def sched_idx(self, loc: Loc) -> int:
+        """Which subvol NEW files land on.  Plain distribute follows
+        the hash; the nufa/switch variants override this (the
+        reference's dht_methods/scheduler indirection, nufa.c,
+        switch.c)."""
+        return self._hashed(loc)
+
     async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
                      xdata: dict | None = None):
-        idx = self._hashed(loc)
+        idx = self.sched_idx(loc)
         fd_c, ia = await self.children[idx].create(loc, flags, mode, xdata)
+        hi = self._hashed(loc)
+        if hi != idx:
+            # scheduled off the hashed subvol: leave the lookup pointer
+            # (dht_linkfile_create in nufa_create_cbk / switch)
+            await self._make_linkto(hi, loc, idx, ia.gfid)
         fd = FdObj(ia.gfid, flags, path=loc.path)
         fd.ctx_set(self, DhtFdCtx(idx, fd_c))
         return fd, ia
@@ -188,8 +200,12 @@ class DistributeLayer(Layer):
 
     async def mknod(self, loc: Loc, mode: int = 0o644, rdev: int = 0,
                     xdata: dict | None = None):
-        return await self.children[self._hashed(loc)].mknod(
-            loc, mode, rdev, xdata)
+        idx = self.sched_idx(loc)
+        ia = await self.children[idx].mknod(loc, mode, rdev, xdata)
+        hi = self._hashed(loc)
+        if hi != idx:
+            await self._make_linkto(hi, loc, idx, ia.gfid)
+        return ia
 
     async def symlink(self, target: str, loc: Loc, xdata: dict | None = None):
         return await self.children[self._hashed(loc)].symlink(

@@ -1,0 +1,43 @@
+"""cluster/nufa — Non-Uniform File Access distribute variant.
+
+Reference: xlators/cluster/dht/src/nufa.c — same layout/lookup engine
+as DHT, but NEW files are created on the *local* subvolume (the brick
+on the creating node; option ``local-volume-name``), with a linkto
+pointer left on the hashed subvolume so every other client still
+resolves the file (nufa_create -> dht_linkfile semantics).  Built for
+compute-on-storage deployments where a node mostly reads what it
+wrote.
+"""
+
+from __future__ import annotations
+
+from ..core.layer import Loc, register
+from ..core.options import Option
+from .dht import DistributeLayer
+
+
+@register("cluster/nufa")
+class NufaLayer(DistributeLayer):
+    OPTIONS = DistributeLayer.OPTIONS + (
+        Option("local-volume-name", "str", default="",
+               description="child subvolume that receives new files "
+               "(nufa.c local-volume-name; defaults to the first "
+               "child, the in-process stand-in for 'this node's "
+               "brick')"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._local = 0
+        want = self.opts["local-volume-name"]
+        if want:
+            for i, c in enumerate(self.children):
+                if c.name == want:
+                    self._local = i
+                    break
+            else:
+                raise ValueError(
+                    f"{self.name}: no child named {want!r}")
+
+    def sched_idx(self, loc: Loc) -> int:
+        return self._local

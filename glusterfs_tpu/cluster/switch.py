@@ -1,0 +1,57 @@
+"""cluster/switch — pattern-routed distribute variant.
+
+Reference: xlators/cluster/dht/src/switch.c — files whose basename
+matches a glob pattern are created on a named subset of subvolumes
+(option ``pattern.switch.case`` = ``pat:sub1|sub2;pat2:sub3``); the
+rest follow normal DHT hashing.  Lookup still resolves anywhere via
+the hashed linkto pointer, so routing only shapes placement.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+
+from ..core.layer import Loc, register
+from ..core.options import Option
+from .dht import DistributeLayer, dm_hash
+
+
+@register("cluster/switch")
+class SwitchLayer(DistributeLayer):
+    OPTIONS = DistributeLayer.OPTIONS + (
+        Option("pattern-switch-case", "str", default="",
+               description="';'-separated glob:subvol[|subvol...] "
+               "placement rules (switch.c pattern.switch.case)"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        byname = {c.name: i for i, c in enumerate(self.children)}
+        self._rules: list[tuple[str, list[int]]] = []
+        spec = self.opts["pattern-switch-case"].strip()
+        if spec:
+            for rule in spec.split(";"):
+                rule = rule.strip()
+                if not rule:
+                    continue
+                pat, _, subs = rule.partition(":")
+                idxs = []
+                for s in subs.split("|"):
+                    s = s.strip()
+                    if s not in byname:
+                        raise ValueError(f"{self.name}: rule "
+                                         f"{rule!r}: no child {s!r}")
+                    idxs.append(byname[s])
+                if not idxs:
+                    raise ValueError(f"{self.name}: rule {rule!r} "
+                                     "names no subvolumes")
+                self._rules.append((pat.strip(), idxs))
+
+    def sched_idx(self, loc: Loc) -> int:
+        name = loc.name or loc.path.rsplit("/", 1)[-1]
+        for pat, idxs in self._rules:
+            if fnmatch.fnmatch(name, pat):
+                # hash WITHIN the matched set so multi-subvol rules
+                # still spread load (switch_local scheduling)
+                return idxs[dm_hash(name) % len(idxs)]
+        return self._hashed(loc)

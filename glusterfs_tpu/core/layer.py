@@ -125,6 +125,11 @@ def _timed(op_name: str, fn: Callable) -> Callable:
     wrapper.__name__ = fn.__name__
     wrapper.__qualname__ = fn.__qualname__
     wrapper.__doc__ = fn.__doc__
+    # keep the real signature reachable: inspect.signature follows
+    # __wrapped__, and virtfs.extract_xdata needs the true parameter
+    # list to find a caller's xdata (else identity-gated layers above
+    # a timed fop silently skip their checks)
+    wrapper.__wrapped__ = fn  # type: ignore[attr-defined]
     wrapper._gf_timed = True  # type: ignore[attr-defined]
     return wrapper
 

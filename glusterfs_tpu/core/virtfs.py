@@ -88,23 +88,60 @@ def install_readonly_guards(cls, is_virtual_loc: str,
 _SIG_CACHE: dict = {}
 
 
+def _fop_signature(child, op_name: str):
+    """Best available signature for a fop: the child's own if it names
+    its parameters, else the CANONICAL one from the posix storage layer
+    — many mid-graph layers define fops as ``(self, *args, **kwargs)``
+    passthroughs, and binding against those would hide every named
+    argument (an identity gate above such a layer must still find
+    xdata)."""
+    key = (type(child), op_name)
+    sig = _SIG_CACHE.get(key)
+    if sig is not None:
+        return sig
+    sig = inspect.signature(getattr(child, op_name))
+    if all(p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                      inspect.Parameter.VAR_KEYWORD)
+           for p in sig.parameters.values()):
+        from ..storage.posix import PosixLayer
+
+        canon = getattr(PosixLayer, op_name, None)
+        if canon is not None:
+            csig = inspect.signature(canon)
+            # drop `self`: we bind call-site args of a bound method
+            params = [p for n, p in csig.parameters.items()
+                      if n != "self"]
+            sig = csig.replace(parameters=params)
+    _SIG_CACHE[key] = sig
+    return sig
+
+
+def _bound_arg(child, op_name: str, args: tuple, kwargs: dict,
+               param: str):
+    sig = _fop_signature(child, op_name)
+    if param not in sig.parameters:
+        return kwargs.get(param)
+    try:
+        ba = sig.bind(*args, **kwargs)
+    except TypeError:
+        return kwargs.get(param)
+    return ba.arguments.get(param)
+
+
 def extract_xdata(child, op_name: str, args: tuple,
                   kwargs: dict) -> dict | None:
     """Read the xdata argument wherever the caller put it, without
     disturbing the call."""
-    fn = getattr(child, op_name)
-    key = (type(child), op_name)
-    sig = _SIG_CACHE.get(key)
-    if sig is None:
-        sig = _SIG_CACHE[key] = inspect.signature(fn)
-    if "xdata" not in sig.parameters:
-        return None
-    try:
-        ba = sig.bind(*args, **kwargs)
-    except TypeError:
-        return None
-    xd = ba.arguments.get("xdata")
+    xd = _bound_arg(child, op_name, args, kwargs, "xdata")
     return xd if isinstance(xd, dict) else None
+
+
+def extract_arg(child, op_name: str, args: tuple, kwargs: dict,
+                param: str):
+    """Read any named fop argument wherever the caller put it
+    (positional or keyword), resolving var-arg passthrough layers to
+    the canonical fop signature."""
+    return _bound_arg(child, op_name, args, kwargs, param)
 
 
 def call_with_xdata(child, op_name: str, args: tuple, kwargs: dict,
@@ -114,10 +151,7 @@ def call_with_xdata(child, op_name: str, args: tuple, kwargs: dict,
     or absent).  Returns the awaitable.  Existing keys win over the
     update (setdefault semantics)."""
     fn = getattr(child, op_name)
-    key = (type(child), op_name)
-    sig = _SIG_CACHE.get(key)
-    if sig is None:
-        sig = _SIG_CACHE[key] = inspect.signature(fn)
+    sig = _fop_signature(child, op_name)
     if "xdata" not in sig.parameters:
         return fn(*args, **kwargs)
     try:

@@ -1,0 +1,218 @@
+"""features/simple-quota — lightweight namespace quota.
+
+Reference: xlators/features/simple-quota (simple-quota.c).  Unlike the
+full quota/marker/quotad triple, simple-quota scopes accounting to
+*namespaces* — top-level directories — and keeps one delta-updated
+usage counter per namespace:
+
+* limit arrives as a setxattr of ``trusted.gfs.squota.limit`` on the
+  namespace directory (simple-quota.c:905 sq_set_xattr path) and is
+  persisted there;
+* usage is updated in memory from write/truncate/unlink size deltas
+  (sq_update_namespace, simple-quota.c:150) and lazily flushed to the
+  namespace dir's ``trusted.gfs.squota.size`` xattr, re-seeded from it
+  on init (sq_read_size, simple-quota.c:222);
+* writes into a namespace over its hard limit fail EDQUOT
+  (sq_writev's take_action path);
+* ``glusterfs.quota.total-usage`` reads back usage+limit virtually
+  (QUOTA_USAGE_KEY, simple-quota.c:19).
+
+Accounting is approximate by design (the reference's stated tradeoff):
+deltas, not crawls, so a brick that missed traffic re-seeds from the
+persisted xattr rather than re-scanning.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+
+from ..core.fops import FopError
+from ..core.iatt import IAType
+from ..core.layer import FdObj, Layer, Loc, register
+from ..core.options import Option
+from ..core import gflog
+
+log = gflog.get_logger("features.simple-quota")
+
+XA_LIMIT = "trusted.gfs.squota.limit"
+XA_SIZE = "trusted.gfs.squota.size"
+V_USAGE = "glusterfs.quota.total-usage"
+
+
+def _ns_of(path: str) -> str | None:
+    """Namespace = first path component ('/a/b/c' -> '/a')."""
+    parts = path.strip("/").split("/", 1)
+    return f"/{parts[0]}" if parts and parts[0] else None
+
+
+@register("features/simple-quota")
+class SimpleQuotaLayer(Layer):
+    OPTIONS = (
+        Option("usage-scale", "int", default=1,
+               description="backend->logical byte factor (K on a "
+                           "disperse brick)"),
+        Option("flush-interval", "time", default="2",
+               description="seconds between usage xattr flushes"),
+    )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.limits: dict[str, int] = {}   # ns dir -> bytes (logical)
+        self._usage: dict[str, int] = {}   # ns dir -> backend bytes
+        self._flushed: dict[str, float] = {}
+
+    async def init(self) -> None:
+        await super().init()
+        # discover limited namespaces: scan top-level dirs once
+        try:
+            fd = await self.children[0].opendir(Loc("/"))
+            entries = await self.children[0].readdir(fd)
+        except FopError:
+            return
+        for e in entries:
+            name = e[0] if isinstance(e, tuple) else e
+            if name in (".", ".."):
+                continue
+            ns = f"/{name}"
+            try:
+                xa = await self.children[0].getxattr(Loc(ns)) or {}
+            except FopError:
+                continue
+            if XA_LIMIT in xa:
+                try:
+                    self.limits[ns] = int(xa[XA_LIMIT])
+                    self._usage[ns] = int(xa.get(XA_SIZE, 0))
+                except (TypeError, ValueError):
+                    pass
+
+    # -- limit admin (xattr interface) -------------------------------------
+
+    async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
+                       xdata: dict | None = None):
+        if XA_LIMIT in xattrs:
+            ns = loc.path.rstrip("/")
+            if not ns or "/" in ns.lstrip("/"):
+                raise FopError(errno.EINVAL,
+                               "squota limit goes on a top-level "
+                               "namespace directory")
+            ia, _ = await self.children[0].lookup(loc)
+            if ia.ia_type is not IAType.DIR:
+                raise FopError(errno.ENOTDIR, loc.path)
+            limit = int(xattrs[XA_LIMIT])
+            if limit > 0:
+                self.limits[ns] = limit
+                self._usage.setdefault(ns, 0)
+            else:  # limit 0/negative clears (QUOTA_RESET_KEY spirit)
+                self.limits.pop(ns, None)
+                self._usage.pop(ns, None)
+        return await self.children[0].setxattr(loc, xattrs, flags, xdata)
+
+    async def getxattr(self, loc: Loc, name: str | None = None,
+                       xdata: dict | None = None):
+        if name == V_USAGE:
+            ns = loc.path.rstrip("/") or _ns_of(loc.path)
+            scale = self.opts["usage-scale"]
+            if ns in self.limits:
+                return {V_USAGE: json.dumps({
+                    "used": self._usage.get(ns, 0) * scale,
+                    "limit": self.limits[ns]}).encode()}
+            raise FopError(errno.ENODATA, f"no squota on {ns}")
+        return await self.children[0].getxattr(loc, name, xdata)
+
+    # -- accounting + enforcement ------------------------------------------
+
+    def _charge(self, path: str | None, delta: int) -> None:
+        if not path or not delta:
+            return
+        ns = _ns_of(path)
+        if ns in self.limits:
+            self._usage[ns] = max(0, self._usage.get(ns, 0) + delta)
+
+    def _enforce(self, path: str | None, want: int) -> None:
+        ns = _ns_of(path or "")
+        if ns is None or ns not in self.limits:
+            return
+        scale = self.opts["usage-scale"]
+        if (self._usage.get(ns, 0) + want) * scale > self.limits[ns]:
+            raise FopError(errno.EDQUOT,
+                           f"{ns}: simple-quota limit "
+                           f"{self.limits[ns]} exceeded")
+
+    async def _flush(self, ns: str) -> None:
+        import time as _t
+
+        now = _t.monotonic()
+        if now - self._flushed.get(ns, 0) < float(
+                self.opts["flush-interval"]):
+            return
+        self._flushed[ns] = now
+        try:
+            await self.children[0].setxattr(
+                Loc(ns), {XA_SIZE: str(self._usage.get(ns, 0)).encode()})
+        except FopError:
+            pass
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        path = getattr(fd, "path", None)
+        grow = max(0, offset + len(data))  # worst case: all new bytes
+        if path and _ns_of(path) in self.limits:
+            ia = await self.children[0].fstat(fd)
+            grow = max(0, offset + len(data) - ia.size)
+            self._enforce(path, grow)
+        out = await self.children[0].writev(fd, data, offset, xdata)
+        if path and grow:
+            self._charge(path, grow)
+            ns = _ns_of(path)
+            if ns in self.limits:
+                await self._flush(ns)
+        return out
+
+    async def truncate(self, loc: Loc, size: int,
+                       xdata: dict | None = None):
+        ns = _ns_of(loc.path)
+        old = None
+        if ns in self.limits:
+            ia, _ = await self.children[0].lookup(loc)
+            old = ia.size
+            self._enforce(loc.path, size - old)
+        out = await self.children[0].truncate(loc, size, xdata)
+        if old is not None:
+            self._charge(loc.path, size - old)
+            await self._flush(ns)
+        return out
+
+    async def ftruncate(self, fd: FdObj, size: int,
+                        xdata: dict | None = None):
+        path = getattr(fd, "path", None)
+        ns = _ns_of(path or "")
+        old = None
+        if path and ns in self.limits:
+            ia = await self.children[0].fstat(fd)
+            old = ia.size
+            self._enforce(path, size - old)
+        out = await self.children[0].ftruncate(fd, size, xdata)
+        if old is not None:
+            self._charge(path, size - old)
+            await self._flush(ns)
+        return out
+
+    async def unlink(self, loc: Loc, xdata: dict | None = None):
+        ns = _ns_of(loc.path)
+        freed = 0
+        if ns in self.limits:
+            try:
+                ia, _ = await self.children[0].lookup(loc)
+                freed = ia.size
+            except FopError:
+                pass
+        out = await self.children[0].unlink(loc, xdata)
+        if freed:
+            self._charge(loc.path, -freed)
+            await self._flush(ns)
+        return out
+
+    def dump_private(self) -> dict:
+        return {"limits": dict(self.limits),
+                "usage": dict(self._usage)}

@@ -29,10 +29,57 @@ from typing import Any
 from .glusterd import MgmtClient, mount_volume
 
 
-def _fmt(v: Any, as_json: bool) -> str:
+def _fmt(v: Any, as_json: bool, as_xml: bool = False) -> str:
+    if as_xml:
+        return _xml_output(v)
     if as_json:
         return json.dumps(v, indent=1, default=repr)
     return _pretty(v)
+
+
+_NCNAME = None
+
+
+def _xml_output(v: Any, op_ret: int = 0, op_errno: int = 0,
+                op_errstr: str = "") -> str:
+    """Machine-readable XML in the reference's cli-xml-output.c
+    envelope: <cliOutput><opRet/><opErrno/><opErrstr/>payload."""
+    import re
+    import xml.etree.ElementTree as ET
+
+    global _NCNAME
+    if _NCNAME is None:
+        _NCNAME = re.compile(r"^[A-Za-z_][\w.-]*$")
+
+    def build(parent, val, key=None):
+        if key is None or not _NCNAME.match(str(key)):
+            el = ET.SubElement(parent, "entry")
+            if key is not None:
+                el.set("name", str(key))
+        else:
+            el = ET.SubElement(parent, str(key))
+        if isinstance(val, dict):
+            for k, x in val.items():
+                build(el, x, k)
+        elif isinstance(val, (list, tuple)):
+            for x in val:
+                build(el, x, "item")
+        elif val is not None:
+            el.text = str(val)
+        return el
+
+    root = ET.Element("cliOutput")
+    ET.SubElement(root, "opRet").text = str(op_ret)
+    ET.SubElement(root, "opErrno").text = str(op_errno)
+    ET.SubElement(root, "opErrstr").text = op_errstr
+    if isinstance(v, dict):
+        for k, x in v.items():
+            build(root, x, k)
+    elif v is not None:
+        build(root, v, "output")
+    ET.indent(root)
+    return ET.tostring(root, encoding="unicode",
+                       xml_declaration=True)
 
 
 def _pretty(v: Any, indent: int = 0) -> str:
@@ -60,6 +107,17 @@ async def _run(args) -> Any:
                 ph, _, pp = args.target.partition(":")
                 return await c.call("peer-probe", host=ph, port=int(pp))
             return await c.call("peer-status")
+
+    if args.cmd == "georep":
+        # georep PRIMARY create SECONDARY | start|stop|status PRIMARY
+        async with MgmtClient(host, port) as c:
+            if args.sub == "create":
+                if not args.args:
+                    raise SystemExit("usage: georep NAME create "
+                                     "host:port:volume")
+                return await c.call("georep-create", name=args.name,
+                                    secondary=args.args[0])
+            return await c.call(f"georep-{args.sub}", name=args.name)
 
     if args.cmd == "snapshot":
         # snapshot create NAME VOLUME | list [VOLUME] |
@@ -172,6 +230,11 @@ async def _run(args) -> Any:
                 kw.update(path=args.args[1])
             async with MgmtClient(host, port) as c:
                 return await c.call("volume-quota", **kw)
+        if sub == "bitrot":
+            action = args.args[0] if args.args else "status"
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-bitrot", name=args.name,
+                                    action=action)
         if sub == "rebalance":
             client = await mount_volume(host, port, args.name)
             try:
@@ -248,14 +311,23 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="gftpu")
     p.add_argument("--server", default="127.0.0.1:24007")
     p.add_argument("--json", action="store_true")
+    p.add_argument("--xml", action="store_true",
+                   help="cli-xml-output.c style machine output")
     sp = p.add_subparsers(dest="cmd", required=True)
 
     vol = sp.add_parser("volume")
     vol.add_argument("sub", choices=["create", "start", "stop", "delete",
                                      "info", "status", "set", "heal",
-                                     "rebalance", "profile", "quota"])
+                                     "rebalance", "profile", "quota",
+                                     "bitrot"])
     vol.add_argument("name", nargs="?", default="")
     vol.add_argument("args", nargs="*")
+
+    geo = sp.add_parser("georep")
+    geo.add_argument("name")
+    geo.add_argument("sub", choices=["create", "start", "stop",
+                                     "status"])
+    geo.add_argument("args", nargs="*")
 
     snap = sp.add_parser("snapshot")
     snap.add_argument("sub", choices=["create", "list", "delete",
@@ -271,9 +343,14 @@ def main(argv=None) -> int:
     try:
         out = asyncio.run(_run(args))
     except Exception as e:
-        print(f"error: {e}", file=sys.stderr)
+        if args.xml:
+            err = getattr(e, "err", 1)
+            print(_xml_output(None, op_ret=-1, op_errno=int(err),
+                              op_errstr=str(e)))
+        else:
+            print(f"error: {e}", file=sys.stderr)
         return 1
-    print(_fmt(out, args.json))
+    print(_fmt(out, args.json, args.xml))
     return 0
 
 

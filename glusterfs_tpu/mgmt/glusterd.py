@@ -71,6 +71,11 @@ class Glusterd:
         self._txn_lock = asyncio.Lock()
         self._txn_holder: str | None = None
         self._subs: dict[str, set] = {}  # volname -> subscribed writers
+        # server-quorum (glusterd-server-quorum.c): volumes whose bricks
+        # this node killed because the mgmt cluster lost quorum
+        self.quorum_interval = 5.0
+        self._quorum_blocked: set[str] = set()
+        self._quorum_task: asyncio.Task | None = None
 
     # -- store (glusterd-store.c analog) -----------------------------------
 
@@ -116,11 +121,19 @@ class Glusterd:
             if vi:
                 for b in vi["bricks"]:
                     await self._spawn_brick(vi, b)
+        self._quorum_task = asyncio.create_task(self._quorum_loop())
         return self.port
 
     async def stop(self) -> None:
         # daemon shutdown kills workers WITHOUT touching the persisted
         # session status: a restarted glusterd resumes started sessions
+        if self._quorum_task is not None:
+            self._quorum_task.cancel()
+            try:
+                await self._quorum_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._quorum_task = None
         for name in list(self.gsync):
             self._kill_gsync(name)
         for name in list(self.bitd):
@@ -228,6 +241,134 @@ class Glusterd:
             p for p in self.state["peers"].values()
             if p["uuid"] != self.uuid]
 
+    def op_peer_ping(self) -> dict:
+        return {"ok": True, "uuid": self.uuid}
+
+    # -- server quorum (glusterd-server-quorum.c) --------------------------
+    # cluster.server-quorum-type=server volumes have their local bricks
+    # killed while fewer than server-quorum-ratio percent of the mgmt
+    # cluster's nodes are reachable, and respawned when quorum returns —
+    # fencing writes on a partitioned node so the majority side's heal
+    # has a single authoritative history.
+
+    def _quorum_volumes(self) -> list[dict]:
+        return [v for v in self.state["volumes"].values()
+                if v.get("status") == "started"
+                and v.get("options", {}).get(
+                    "cluster.server-quorum-type") == "server"]
+
+    async def _alive_count(self) -> tuple[int, int]:
+        """(reachable nodes incl. me, total nodes incl. me)."""
+        peers = [p for p in self.state["peers"].values()
+                 if p["uuid"] != self.uuid]
+
+        async def ping(p: dict) -> bool:
+            async def one() -> None:
+                async with MgmtClient(p["host"], p["port"]) as c:
+                    await c.call("peer-ping")
+
+            # bound the CONNECT too: a black-holed peer (packets dropped,
+            # no RST) must not stall loss detection for the kernel's
+            # minutes-long connect timeout
+            try:
+                await asyncio.wait_for(one(), 2)
+                return True
+            except Exception:
+                return False
+
+        alive = await asyncio.gather(*(ping(p) for p in peers))
+        return 1 + sum(alive), 1 + len(peers)
+
+    def _quorum_met(self, vol: dict, alive: int, total: int) -> bool:
+        ratio = float(vol.get("options", {}).get(
+            "cluster.server-quorum-ratio", 51))
+        return alive * 100 >= ratio * total
+
+    async def _quorum_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.quorum_interval)
+            try:
+                await self._check_server_quorum()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.debug(14, "quorum check failed: %r", e)
+
+    async def _check_server_quorum(self) -> None:
+        vols = self._quorum_volumes()
+        peers = [p for p in self.state["peers"].values()
+                 if p["uuid"] != self.uuid]
+        if not vols or not peers:  # single-node clusters are quorate
+            return
+        alive, total = await self._alive_count()
+        for vol in vols:
+            name = vol["name"]
+            met = self._quorum_met(vol, alive, total)
+            if not met and name not in self._quorum_blocked:
+                self._quorum_blocked.add(name)
+                for b in vol["bricks"]:
+                    if b["node"] == self.uuid:
+                        self._kill_brick(b["name"])
+                log.error(15, "server quorum lost (%d/%d): stopped "
+                          "bricks of %s", alive, total, name)
+                gf_event("SERVER_QUORUM_LOST", volume=name,
+                         alive=alive, total=total)
+            elif met and name in self._quorum_blocked:
+                self._quorum_blocked.discard(name)
+                for b in vol["bricks"]:
+                    if b["node"] == self.uuid and \
+                            b["name"] not in self.bricks:
+                        # reuse the recorded port: fenced clients are
+                        # still retrying it
+                        await self._spawn_brick(vol, b, port=b.get("port"))
+                log.info(16, "server quorum regained (%d/%d): restarted "
+                         "bricks of %s", alive, total, name)
+                gf_event("SERVER_QUORUM_REGAINED", volume=name,
+                         alive=alive, total=total)
+
+    # -- hooks (glusterd-hooks.c) ------------------------------------------
+    # Executable S*-prefixed scripts under
+    # <workdir>/hooks/1/<op>/{pre,post}/ run around each volume op's
+    # commit on every committing node, with --volname=<name> plus
+    # op-specific args; failures are logged, never fatal (the
+    # reference's advisory hook semantics).
+
+    async def _run_hooks(self, op: str, phase: str, volname: str,
+                         extra: tuple = ()) -> list[str]:
+        # scripts block; keep the mgmt event loop (peer pings!) live
+        return await asyncio.to_thread(
+            self._run_hooks_sync, op, phase, volname, extra)
+
+    def _run_hooks_sync(self, op: str, phase: str, volname: str,
+                        extra: tuple = ()) -> list[str]:
+        hookdir = os.path.join(self.workdir, "hooks", "1", op, phase)
+        try:
+            scripts = sorted(s for s in os.listdir(hookdir)
+                             if s.startswith("S"))
+        except FileNotFoundError:
+            return []
+        env = dict(os.environ)
+        env["GLUSTERD_WORKDIR"] = self.workdir
+        ran = []
+        for s in scripts:
+            path = os.path.join(hookdir, s)
+            if not os.access(path, os.X_OK):
+                continue
+            try:
+                res = subprocess.run(
+                    [path, f"--volname={volname}", *extra], env=env,
+                    timeout=30, check=False, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.PIPE)
+                if res.returncode != 0:
+                    log.error(17, "hook %s/%s/%s exited %d: %s", op,
+                              phase, s, res.returncode,
+                              (res.stderr or b"")[-500:].decode(
+                                  errors="replace"))
+                ran.append(s)
+            except Exception as e:
+                log.error(17, "hook %s/%s/%s failed: %r", op, phase, s, e)
+        return ran
+
     # -- txn engine (lock -> stage -> commit, glusterd-op-sm.h:28-43) ------
 
     def op_txn_lock(self, holder: str) -> dict:
@@ -256,13 +397,40 @@ class Glusterd:
         return {"ok": True, "result": ret}
 
     async def _cluster_txn(self, op: str, payload: dict) -> list:
-        """Run lock/stage/commit across all nodes (originator drives)."""
-        nodes = self._all_nodes()
+        """Run lock/stage/commit across all reachable nodes (originator
+        drives).  Peers that cannot be reached at lock time are skipped
+        for the whole txn — the reference's op-sm spans only connected
+        peers (rpc-state gated), so a dead node never wedges volume ops;
+        it re-syncs state on its next handshake."""
+        nodes = []
         holder = self.uuid
         locked: list[dict] = []
         try:
-            for n in nodes:
-                await self._node_call(n, "txn-lock", holder=holder)
+            for n in self._all_nodes():
+                try:
+                    # EOFError: peer died between connect and reply
+                    # (IncompleteReadError); 10s bound: accepted-but-hung
+                    # peers must not wedge every volume op
+                    await asyncio.wait_for(
+                        self._node_call(n, "txn-lock", holder=holder), 10)
+                except FopError:
+                    # the peer ANSWERED (e.g. cluster busy): a real
+                    # rejection, not unreachability — abort the txn
+                    raise
+                except asyncio.TimeoutError:
+                    # the peer may have APPLIED the lock after we gave
+                    # up: keep it out of stage/commit but send the
+                    # best-effort unlock, else its stale holder wedges
+                    # every later txn
+                    locked.append(n)
+                    log.error(18, "peer %s lock timed out: skipped "
+                              "from %s txn", n["uuid"][:8], op)
+                    continue
+                except (ConnectionError, OSError, EOFError):
+                    log.error(18, "peer %s unreachable: skipped from "
+                              "%s txn", n["uuid"][:8], op)
+                    continue
+                nodes.append(n)
                 locked.append(n)
             for n in nodes:
                 await self._node_call(n, "txn-stage", op=op, payload=payload)
@@ -274,7 +442,9 @@ class Glusterd:
         finally:
             for n in locked:
                 try:
-                    await self._node_call(n, "txn-unlock", holder=holder)
+                    await asyncio.wait_for(
+                        self._node_call(n, "txn-unlock", holder=holder),
+                        10)
                 except Exception:
                     pass
 
@@ -349,11 +519,13 @@ class Glusterd:
         await self._cluster_txn("volume-create", {"volinfo": volinfo})
         return {"ok": True, "volume": name}
 
-    def commit_volume_create(self, volinfo: dict) -> dict:
+    async def commit_volume_create(self, volinfo: dict) -> dict:
+        await self._run_hooks("create", "pre", volinfo["name"])
         self.state["volumes"][volinfo["name"]] = volinfo
         self._save()
         gf_event("VOLUME_CREATE", name=volinfo["name"],
                  type=volinfo["type"])
+        await self._run_hooks("create", "post", volinfo["name"])
         return {"created": volinfo["name"]}
 
     def stage_volume_create(self, volinfo: dict) -> None:
@@ -377,6 +549,7 @@ class Glusterd:
 
     async def commit_volume_start(self, name: str) -> dict:
         vol = self._vol(name)
+        await self._run_hooks("start", "pre", name)
         vol["status"] = "started"
         self._save()
         await self._start_local_bricks(vol)
@@ -388,6 +561,7 @@ class Glusterd:
                                                    "off")):
             self._spawn_quotad(vol)
         gf_event("VOLUME_START", name=name)
+        await self._run_hooks("start", "post", name)
         return {"started": name,
                 "ports": {b["name"]: self.ports[b["name"]]
                           for b in vol["bricks"]
@@ -406,9 +580,11 @@ class Glusterd:
         await self._cluster_txn("volume-stop", {"name": name})
         return {"ok": True}
 
-    def commit_volume_stop(self, name: str) -> dict:
+    async def commit_volume_stop(self, name: str) -> dict:
         vol = self._vol(name)
+        await self._run_hooks("stop", "pre", name)
         vol["status"] = "stopped"
+        self._quorum_blocked.discard(name)
         self._save()
         self._kill_bitd(name)
         self._kill_quotad(name)
@@ -417,6 +593,7 @@ class Glusterd:
             if b["node"] == self.uuid:
                 self._kill_brick(b["name"])
         gf_event("VOLUME_STOP", name=name)
+        await self._run_hooks("stop", "post", name)
         return {"stopped": name}
 
     async def op_volume_delete(self, name: str) -> dict:
@@ -426,10 +603,12 @@ class Glusterd:
         await self._cluster_txn("volume-delete", {"name": name})
         return {"ok": True}
 
-    def commit_volume_delete(self, name: str) -> dict:
+    async def commit_volume_delete(self, name: str) -> dict:
+        await self._run_hooks("delete", "pre", name)
         self.state["volumes"].pop(name, None)
         self._save()
         gf_event("VOLUME_DELETE", name=name)
+        await self._run_hooks("delete", "post", name)
         return {"deleted": name}
 
     async def op_volume_set(self, name: str, key: str, value: str) -> dict:
@@ -448,12 +627,14 @@ class Glusterd:
 
     async def commit_volume_set(self, name: str, key: str, value: str) -> dict:
         vol = self._vol(name)
+        await self._run_hooks("set", "pre", name, (f"-o{key}={value}",))
         vol.setdefault("options", {})[key] = value
         self._save()
         applied = "stored"
         if vol["status"] == "started":
             applied = await self._apply_to_bricks(vol)
             self._notify_subscribers(name)
+        await self._run_hooks("set", "post", name, (f"-o{key}={value}",))
         return {name: {key: value}, "applied": applied}
 
     async def _apply_to_bricks(self, vol: dict) -> str:

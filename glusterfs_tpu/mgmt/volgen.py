@@ -76,6 +76,7 @@ OPTION_MAP = {
     "features.read-only": ("features/read-only", "__enable__"),
     "features.worm": ("features/worm", "__enable__"),
     "features.quota": ("features/quota", "__enable__"),
+    "features.simple-quota": ("features/simple-quota", "__enable__"),
     "features.trash": ("features/trash", "__enable__"),
     "features.shard": ("features/shard", "__enable__"),
     "features.shard-block-size": ("features/shard", "shard-block-size"),
@@ -92,6 +93,16 @@ OPTION_MAP = {
     "network.compression": ("protocol/client", "compression"),
     "network.compression-min-size": ("protocol/client",
                                      "compression-min-size"),
+    # consumed by glusterd itself (glusterd-server-quorum.c): when the
+    # mgmt cluster loses quorum, bricks of enforcing volumes are killed
+    "cluster.server-quorum-type": ("mgmt/glusterd", "server-quorum-type"),
+    "cluster.server-quorum-ratio": ("mgmt/glusterd",
+                                    "server-quorum-ratio"),
+    # distribute variants (nufa.c / switch.c): swap the dht layer type
+    "cluster.nufa": ("cluster/nufa", "__enable__"),
+    "cluster.nufa-local-volume-name": ("cluster/nufa",
+                                       "local-volume-name"),
+    "cluster.switch-pattern": ("cluster/switch", "pattern-switch-case"),
 }
 
 # default client-side performance stack, bottom -> top (volgen's
@@ -217,6 +228,14 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
             qopts["usage-scale"] = g - volinfo.get("redundancy", 2)
         out.append(_emit(f"{name}-quota", "features/quota", qopts, [top]))
         top = f"{name}-quota"
+    if _enabled(volinfo, "features.simple-quota", False):
+        sqopts = layer_options(volinfo, "features/simple-quota")
+        if volinfo["type"] == "disperse":
+            g = volinfo.get("group-size") or len(volinfo["bricks"])
+            sqopts["usage-scale"] = g - volinfo.get("redundancy", 2)
+        out.append(_emit(f"{name}-simple-quota", "features/simple-quota",
+                         sqopts, [top]))
+        top = f"{name}-simple-quota"
     if _enabled(volinfo, "features.read-only", False):
         out.append(_emit(f"{name}-ro", "features/read-only", {}, [top]))
         top = f"{name}-ro"
@@ -305,10 +324,20 @@ def build_client_volfile(volinfo: dict,
             raise ValueError(vtype)
         return lname
 
+    def _dht_type(volinfo: dict) -> str:
+        """Plain dht, or a variant (nufa.c / switch.c volgen swap)."""
+        if _enabled(volinfo, "cluster.nufa", False):
+            return "cluster/nufa"
+        if volinfo.get("options", {}).get("cluster.switch-pattern"):
+            return "cluster/switch"
+        return "cluster/distribute"
+
     if vtype == "distribute":
+        dtype = _dht_type(volinfo)
         opts = layer_options(volinfo, "cluster/distribute")
+        opts.update(layer_options(volinfo, dtype))
         top = f"{volinfo['name']}-dht"
-        out.append(_emit(top, "cluster/distribute", opts, names))
+        out.append(_emit(top, dtype, opts, names))
     elif vtype in ("disperse", "replicate"):
         group = volinfo.get("group-size", len(names))
         if volinfo.get("thin-arbiter"):

@@ -72,14 +72,13 @@ def adler32_batch_jax(blocks):
         bsum = (b + jnp.sum(partial, axis=1)) % _MOD
         return (bsum << 16) | a
 
-    key = blocks.shape if hasattr(blocks, "shape") else None
     jitted = _JIT_CACHE.get("fn")
     if jitted is None:
         jitted = _JIT_CACHE["fn"] = jax.jit(fn)
     return jitted(blocks)
 
 
-def rchecksum(data: bytes, backend: str = "auto") -> dict:
+def rchecksum(data: bytes) -> dict:
     """One block's weak+strong checksum (the posix rchecksum fop
     payload)."""
     import hashlib

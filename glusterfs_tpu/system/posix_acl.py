@@ -121,7 +121,7 @@ class PosixAclLayer(Layer):
 
 
 def _self_write_gated(op_name: str):
-    """Mutations of the object itself need W on it."""
+    """Mutations of the object's data need W on it."""
     async def impl(self, loc: Loc, *args, **kwargs):
         from ..core.virtfs import extract_xdata
 
@@ -134,13 +134,56 @@ def _self_write_gated(op_name: str):
     return impl
 
 
-def _parent_write_gated(op_name: str, nloc: int):
-    """Namespace mutations need W|X on every parent involved."""
+def _acl_key(arg) -> bool:
+    """Does this setxattr dict / removexattr name touch ACL xattrs?"""
+    keys = arg.keys() if isinstance(arg, dict) else (arg,)
+    return any(str(k).startswith("system.posix_acl") for k in keys)
+
+
+def _owner_or_write_gated(op_name: str, always_owner: bool):
+    """chmod/chown (setattr) and ACL changes need OWNERSHIP, not W —
+    a 0444 file's owner can still chmod it, and group-writers cannot
+    (POSIX; reference posix_acl_setattr uid check).  Non-ACL xattrs
+    are data-adjacent: plain W."""
+    async def impl(self, loc: Loc, *args, **kwargs):
+        from ..core.virtfs import extract_arg, extract_xdata
+
+        xd = extract_xdata(self.children[0], op_name,
+                           (loc, *args), kwargs)
+        # resolve the xattr payload by NAME: a caller may pass it
+        # positionally or as a keyword, and both must hit the gate
+        payload = None
+        if not always_owner:
+            payload = extract_arg(
+                self.children[0], op_name, (loc, *args), kwargs,
+                "xattrs" if op_name == "setxattr" else "name")
+        owner_op = always_owner or (payload is not None
+                                    and _acl_key(payload))
+        if xd and "uid" in xd and not owner_op:
+            await self._check(loc, W, xd)
+        elif xd and "uid" in xd:
+            uid = int(xd["uid"])
+            if uid != 0:
+                ia, _ = await self.children[0].lookup(loc)
+                if uid != ia.uid:
+                    raise FopError(errno.EPERM,
+                                   f"{loc.path}: not owner")
+        return await getattr(self.children[0], op_name)(loc, *args,
+                                                        **kwargs)
+    impl.__name__ = op_name
+    return impl
+
+
+def _parent_write_gated(op_name: str, locidx: tuple):
+    """Namespace mutations need W|X on the parent of each mutated
+    name (for link only the NEW name's parent — reading the source
+    needs no write access)."""
     async def impl(self, *args, **kwargs):
         from ..core.virtfs import extract_xdata
 
         xd = extract_xdata(self.children[0], op_name, args, kwargs)
-        for a in args[:nloc]:
+        for i in locidx:
+            a = args[i] if i < len(args) else None
             if isinstance(a, Loc) and a.path:
                 parent = a.path.rsplit("/", 1)[0] or "/"
                 await self._check(Loc(parent), W | X, xd)
@@ -149,8 +192,11 @@ def _parent_write_gated(op_name: str, nloc: int):
     return impl
 
 
-for _op in ("truncate", "setattr", "setxattr", "removexattr"):
-    setattr(PosixAclLayer, _op, _self_write_gated(_op))
-for _op, _n in (("mkdir", 1), ("mknod", 1), ("rmdir", 1),
-                ("symlink", 2), ("rename", 2), ("link", 2)):
-    setattr(PosixAclLayer, _op, _parent_write_gated(_op, _n))
+setattr(PosixAclLayer, "truncate", _self_write_gated("truncate"))
+setattr(PosixAclLayer, "setattr", _owner_or_write_gated("setattr", True))
+for _op in ("setxattr", "removexattr"):
+    setattr(PosixAclLayer, _op, _owner_or_write_gated(_op, False))
+for _op, _idx in (("mkdir", (0,)), ("mknod", (0,)), ("rmdir", (0,)),
+                  ("symlink", (0, 1)), ("rename", (0, 1)),
+                  ("link", (1,))):
+    setattr(PosixAclLayer, _op, _parent_write_gated(_op, _idx))

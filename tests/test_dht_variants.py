@@ -1,0 +1,112 @@
+"""DHT variants: nufa (local-preferred create, nufa.c) and switch
+(pattern-routed placement, switch.c), and their volgen wiring."""
+
+import pytest
+
+from glusterfs_tpu.api.glfs import SyncClient
+from glusterfs_tpu.core.graph import Graph
+
+N = 3
+
+
+def volfile(base, dht_type: str, opts: dict) -> str:
+    out = []
+    for i in range(N):
+        out.append(f"volume b{i}\n    type storage/posix\n"
+                   f"    option directory {base}/brick{i}\nend-volume\n")
+    subs = " ".join(f"b{i}" for i in range(N))
+    body = "".join(f"    option {k} {v}\n" for k, v in opts.items())
+    out.append(f"volume top\n    type {dht_type}\n{body}"
+               f"    subvolumes {subs}\nend-volume\n")
+    return "\n".join(out)
+
+
+def _mounted(tmp_path, dht_type, opts):
+    c = SyncClient(Graph.construct(volfile(tmp_path, dht_type, opts)))
+    c.mount()
+    return c
+
+
+def test_nufa_creates_locally_with_linkto(tmp_path):
+    c = _mounted(tmp_path, "cluster/nufa",
+                 {"local-volume-name": "b1"})
+    try:
+        top = c.graph.top
+        names = [f"f{i:02d}" for i in range(12)]
+        for n in names:
+            c.write_file(f"/{n}", n.encode())
+        # data always lands on the local subvol
+        for n in names:
+            assert (tmp_path / "brick1" / n).read_bytes() == n.encode()
+            hi = top.hashed_idx(n)
+            if hi != 1:  # linkto pointer on the hashed brick
+                assert (tmp_path / f"brick{hi}" / n).exists()
+        # any client resolves the file through the pointer
+        for n in names:
+            assert c.read_file(f"/{n}") == n.encode()
+        # unlink removes data AND pointer
+        c.unlink(f"/{names[0]}")
+        for i in range(N):
+            assert not (tmp_path / f"brick{i}" / names[0]).exists()
+    finally:
+        c.close()
+
+
+def test_nufa_unknown_local_volume_rejected(tmp_path):
+    with pytest.raises(ValueError):
+        _mounted(tmp_path, "cluster/nufa",
+                 {"local-volume-name": "nope"})
+
+
+def test_switch_pattern_routing(tmp_path):
+    c = _mounted(tmp_path, "cluster/switch",
+                 {"pattern-switch-case": "*.jpg:b0;*.log:b1|b2"})
+    try:
+        top = c.graph.top
+        for n in ("a.jpg", "b.jpg", "zz.jpg"):
+            c.write_file(f"/{n}", b"J")
+            assert (tmp_path / "brick0" / n).exists()
+        # multi-subvol rule spreads within the named set only
+        logs = [f"w{i}.log" for i in range(8)]
+        for n in logs:
+            c.write_file(f"/{n}", b"L")
+            on = [i for i in range(N)
+                  if (tmp_path / f"brick{i}" / n).exists()
+                  and (tmp_path / f"brick{i}" / n).stat().st_size]
+            assert on and set(on) <= {1, 2}, (n, on)
+        # unmatched names hash normally
+        c.write_file("/plain", b"P")
+        hi = top.hashed_idx("plain")
+        assert (tmp_path / f"brick{hi}" / "plain").read_bytes() == b"P"
+        # everything resolves through lookup
+        for n in ("a.jpg", *logs, "plain"):
+            assert c.read_file(f"/{n}")
+    finally:
+        c.close()
+
+
+def test_switch_bad_rule_rejected(tmp_path):
+    with pytest.raises(ValueError):
+        _mounted(tmp_path, "cluster/switch",
+                 {"pattern-switch-case": "*.jpg:zzz"})
+
+
+def test_volgen_emits_variants(tmp_path):
+    from glusterfs_tpu.mgmt import volgen
+
+    vi = {
+        "name": "nv", "type": "distribute", "redundancy": 0,
+        "bricks": [{"index": i, "host": "h", "port": 1,
+                    "path": str(tmp_path / f"b{i}"),
+                    "name": f"nv-brick-{i}", "node": "x"}
+                   for i in range(2)],
+        "options": {"cluster.nufa": "on",
+                    "cluster.nufa-local-volume-name": "nv-client-1"},
+    }
+    text = volgen.build_client_volfile(vi)
+    assert "type cluster/nufa" in text
+    assert "option local-volume-name nv-client-1" in text
+    vi["options"] = {"cluster.switch-pattern": "*.jpg:nv-client-0"}
+    text = volgen.build_client_volfile(vi)
+    assert "type cluster/switch" in text
+    assert "option pattern-switch-case *.jpg:nv-client-0" in text

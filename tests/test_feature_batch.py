@@ -104,6 +104,82 @@ def test_posix_acl_enforcement(tmp_path):
     asyncio.run(run())
 
 
+def test_posix_acl_ownership_gates(tmp_path):
+    """chmod/chown and ACL xattr changes need OWNERSHIP, not W: the
+    owner of a 0444 file can chmod it, a group-writer cannot chown or
+    replace the ACL; link needs W|X only on the NEW name's parent
+    (reference posix-acl.c setattr/link gating)."""
+
+    async def run():
+        g = _graph(tmp_path, ("system/posix-acl", {}))
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/d", b"x")
+        top = g.top
+        ia = await c.stat("/d")
+        owner = {"uid": ia.uid, "gid": ia.gid}
+        stranger = {"uid": ia.uid + 1000, "gid": ia.gid + 1000}
+        # owner may chmod their own read-only file
+        await top.setattr(Loc("/d"), {"mode": 0o444}, xdata=dict(owner))
+        await top.setattr(Loc("/d"), {"mode": 0o666}, xdata=dict(owner))
+        # non-owner with W (0666 now) still may NOT chmod or set ACLs
+        with pytest.raises(FopError) as ei:
+            await top.setattr(Loc("/d"), {"mode": 0o600},
+                              xdata=dict(stranger))
+        assert ei.value.err == errno.EPERM
+        with pytest.raises(FopError):
+            await top.setxattr(
+                Loc("/d"), {"system.posix_acl_access": b"[]"},
+                xdata=dict(stranger))
+        # ...but CAN set a plain user xattr (W-gated, mode is 0666)
+        await top.setxattr(Loc("/d"), {"user.note": b"hi"},
+                           xdata=dict(stranger))
+        # link: source parent read-only is fine; only the destination
+        # parent needs W|X
+        await top.mkdir(Loc("/dst"), 0o777)
+        await top.setattr(Loc("/dst"), {"mode": 0o777},
+                          xdata=dict(owner))  # umask-proof
+        await top.setattr(Loc("/"), {"mode": 0o555}, xdata=dict(owner))
+        try:
+            await top.link(Loc("/d"), Loc("/dst/hard"),
+                           xdata=dict(stranger))
+            assert (await c.read_file("/dst/hard")) == b"x"
+        finally:
+            await top.setattr(Loc("/"), {"mode": 0o755},
+                              xdata=dict(owner))
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_posix_acl_gates_through_passthrough_layers(tmp_path):
+    """Identity gates must hold when the layer below posix-acl defines
+    fops as (*args, **kwargs) passthroughs (utime's stamped fops):
+    extract_xdata falls back to the canonical posix signature."""
+
+    async def run():
+        g = _graph(tmp_path, ("features/utime", {}),
+                   ("system/posix-acl", {}))
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/p", b"x")
+        top = g.top
+        ia = await c.stat("/p")
+        stranger = {"uid": ia.uid + 1000, "gid": ia.gid + 1000}
+        with pytest.raises(FopError) as ei:
+            await top.setattr(Loc("/p"), {"mode": 0o777},
+                              xdata=dict(stranger))
+        assert ei.value.err == errno.EPERM
+        # kwargs-passed ACL xattr hits the ownership gate too
+        with pytest.raises(FopError):
+            await top.setxattr(
+                Loc("/p"), xattrs={"system.posix_acl_access": b"[]"},
+                xdata=dict(stranger))
+        await c.unmount()
+
+    asyncio.run(run())
+
+
 def test_namespace_tagging(tmp_path):
     async def run():
         g = _graph(tmp_path, ("features/namespace", {}))

@@ -1,0 +1,96 @@
+"""Ops tools: setgfid2path (identity repair) and gfind_missing_files
+(secondary-gap crawl) — tools/setgfid2path + tools/gfind_missing_files
+analogs."""
+
+import asyncio
+import os
+
+from glusterfs_tpu.api.glfs import SyncClient
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.tools.gfid_tools import (gfind_missing_paths,
+                                            setgfid2path, write_missing)
+
+
+def _posix_spec(d) -> str:
+    return (f"volume posix\n    type storage/posix\n"
+            f"    option directory {d}\nend-volume\n")
+
+
+def test_setgfid2path_stamps_sideloaded_and_prunes(tmp_path):
+    brick = tmp_path / "brick"
+    c = SyncClient(Graph.construct(_posix_spec(brick)))
+    c.mount()
+    c.write_file("/known", b"k")
+    c.mkdir("/sub")
+    c.write_file("/sub/also", b"a")
+    c.close()
+    # side-load objects behind the store's back (rsync'd data)
+    (brick / "loaded").write_bytes(b"L")
+    (brick / "sub" / "extra").write_bytes(b"E")
+    # orphan a record: delete a file directly
+    os.unlink(brick / "known")
+
+    out = setgfid2path(str(brick))
+    assert out["stamped"] == 2          # loaded + sub/extra
+    assert out["pruned"] == 1           # known's orphaned record
+
+    # the repaired store serves side-loaded files with stable identity
+    c2 = SyncClient(Graph.construct(_posix_spec(brick)))
+    c2.mount()
+    assert c2.read_file("/loaded") == b"L"
+    g1 = c2.stat("/loaded").gfid
+    c2.close()
+    # idempotent: second run changes nothing, gfid stays
+    out2 = setgfid2path(str(brick))
+    assert out2["stamped"] == 0 and out2["pruned"] == 0
+    c3 = SyncClient(Graph.construct(_posix_spec(brick)))
+    c3.mount()
+    assert c3.stat("/loaded").gfid == g1
+    c3.close()
+
+
+def test_gfind_missing_against_secondary(tmp_path):
+    primary = tmp_path / "primary"
+    cp = SyncClient(Graph.construct(_posix_spec(primary)))
+    cp.mount()
+    cp.write_file("/synced", b"s")
+    cp.mkdir("/d")
+    cp.write_file("/d/synced2", b"s2")
+    cp.write_file("/unsynced", b"u")
+    cp.write_file("/d/unsynced2", b"u2")
+    cp.close()
+
+    secondary = tmp_path / "secondary"
+    cs = SyncClient(Graph.construct(_posix_spec(secondary)))
+    cs.mount()
+    cs.write_file("/synced", b"s")
+    cs.mkdir("/d")
+    cs.write_file("/d/synced2", b"s2")
+
+    async def run():
+        return await gfind_missing_paths(str(primary), cs.graph.top)
+
+    scanned, missing = asyncio.run(run())
+    cs.close()
+    assert scanned == 4
+    assert sorted(missing) == ["/d/unsynced2", "/unsynced"]
+    out = tmp_path / "missing.txt"
+    write_missing(str(out), missing)
+    assert sorted(out.read_text().splitlines()) == \
+        ["/d/unsynced2", "/unsynced"]
+
+
+def test_cli_xml_output():
+    from glusterfs_tpu.mgmt.cli import _xml_output
+
+    xml = _xml_output({"volume": {"name": "tv", "bricks": [
+        {"path": "/b/0", "online": True}]},
+        "count": 1, "/odd key": "v"})
+    assert xml.startswith("<?xml")
+    assert "<opRet>0</opRet>" in xml
+    assert "<name>tv</name>" in xml
+    assert "<count>1</count>" in xml
+    assert '<entry name="/odd key">v</entry>' in xml
+    err = _xml_output(None, op_ret=-1, op_errno=2, op_errstr="no vol")
+    assert "<opRet>-1</opRet>" in err and "<opErrno>2</opErrno>" in err
+    assert "<opErrstr>no vol</opErrstr>" in err

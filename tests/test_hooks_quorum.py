@@ -1,0 +1,132 @@
+"""glusterd hooks (glusterd-hooks.c analog) and server quorum
+(glusterd-server-quorum.c analog) behavior."""
+
+import asyncio
+import os
+import stat
+
+import pytest
+
+from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient
+
+
+def _install_hook(workdir: str, op: str, phase: str, outfile: str,
+                  name: str = "S10probe.sh") -> str:
+    hookdir = os.path.join(workdir, "hooks", "1", op, phase)
+    os.makedirs(hookdir, exist_ok=True)
+    path = os.path.join(hookdir, name)
+    with open(path, "w") as f:
+        f.write(f"#!/bin/sh\necho \"{name} {op} {phase} $@\" >> {outfile}\n")
+    os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR)
+    return path
+
+
+def test_hooks_run_around_volume_ops(tmp_path):
+    """Pre/post hook scripts fire on create/set/delete with --volname
+    and -o key=value args, in S-name order; non-executables skipped."""
+    out = str(tmp_path / "hooklog")
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            for op, phase in (("create", "pre"), ("create", "post"),
+                              ("set", "post"), ("delete", "pre")):
+                _install_hook(d.workdir, op, phase, out)
+            # ordering: a second script sorts after S10
+            _install_hook(d.workdir, "create", "post", out, "S20second.sh")
+            # non-executable must be skipped
+            skip = os.path.join(d.workdir, "hooks", "1", "create", "post",
+                                "S05noexec.sh")
+            with open(skip, "w") as f:
+                f.write(f"#!/bin/sh\necho NOEXEC >> {out}\n")
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="hv", vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "b0")}])
+                await c.call("volume-set", name="hv",
+                             key="performance.io-cache", value="on")
+                await c.call("volume-delete", name="hv")
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+    with open(out) as f:
+        lines = f.read().splitlines()
+    assert lines[0] == "S10probe.sh create pre --volname=hv"
+    assert lines[1] == "S10probe.sh create post --volname=hv"
+    assert lines[2] == "S20second.sh create post --volname=hv"
+    assert "S10probe.sh set post --volname=hv " \
+           "-operformance.io-cache=on" in lines
+    assert "S10probe.sh delete pre --volname=hv" in lines
+    assert not any("NOEXEC" in l for l in lines)
+
+
+@pytest.mark.slow
+def test_server_quorum_fences_and_restores_bricks(tmp_path):
+    """Two-node cluster, quorum-enforcing volume: losing the peer kills
+    the local bricks; the peer coming back respawns them on the same
+    port (glusterd-server-quorum.c semantics)."""
+
+    async def brick_online(c, vol="qv"):
+        st = await c.call("volume-status", name=vol)
+        return all(b["online"] for b in st["bricks"]
+                   if b["node"] == st["bricks"][0]["node"])
+
+    async def wait_for(pred, timeout=30.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            if await pred():
+                return True
+            if asyncio.get_event_loop().time() > deadline:
+                return False
+            await asyncio.sleep(0.2)
+
+    async def run():
+        d1 = Glusterd(str(tmp_path / "gd1"))
+        d1.quorum_interval = 0.3
+        await d1.start()
+        d2 = Glusterd(str(tmp_path / "gd2"))
+        await d2.start()
+        d2_port = d2.port
+        try:
+            async with MgmtClient(d1.host, d1.port) as c:
+                await c.call("peer-probe", host=d2.host, port=d2.port)
+                await c.call("volume-create", name="qv", vtype="distribute",
+                             bricks=[{"node": d1.uuid,
+                                      "path": str(tmp_path / "b0")}])
+                await c.call("volume-set", name="qv",
+                             key="cluster.server-quorum-type",
+                             value="server")
+                await c.call("volume-start", name="qv")
+                assert await brick_online(c)
+                port0 = (await c.call(
+                    "volume-status", name="qv"))["bricks"][0]["port"]
+
+                # partition: peer glusterd goes away -> 1/2 alive < 51%
+                await d2.stop()
+                assert await wait_for(
+                    lambda: _not(brick_online(c))), "brick not fenced"
+
+                # peer returns on its recorded endpoint -> quorum back
+                d2b = Glusterd(str(tmp_path / "gd2"), port=d2_port)
+                await d2b.start()
+                try:
+                    async def restored():
+                        st = await c.call("volume-status", name="qv")
+                        b = st["bricks"][0]
+                        return b["online"] and b["port"] != 0
+
+                    assert await wait_for(restored), "brick not restored"
+                    port1 = (await c.call(
+                        "volume-status", name="qv"))["bricks"][0]["port"]
+                    assert port1 == port0, "restore must reuse the port"
+                    await c.call("volume-stop", name="qv")
+                finally:
+                    await d2b.stop()
+        finally:
+            await d1.stop()
+
+    async def _not(coro):
+        return not await coro
+
+    asyncio.run(run())
