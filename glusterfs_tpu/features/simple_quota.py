@@ -111,7 +111,10 @@ class SimpleQuotaLayer(Layer):
     async def getxattr(self, loc: Loc, name: str | None = None,
                        xdata: dict | None = None):
         if name == V_USAGE:
-            ns = loc.path.rstrip("/") or _ns_of(loc.path)
+            p = loc.path.rstrip("/") or "/"
+            # querying any path INSIDE a namespace reports the
+            # enclosing namespace's usage (sq_get_xattr lookup walk)
+            ns = p if p in self.limits else _ns_of(p)
             scale = self.opts["usage-scale"]
             if ns in self.limits:
                 return {V_USAGE: json.dumps({

@@ -76,6 +76,9 @@ class Glusterd:
         self.quorum_interval = 5.0
         self._quorum_blocked: set[str] = set()
         self._quorum_task: asyncio.Task | None = None
+        # brick multiplexing (glusterfsd-mgmt.c ATTACH): one shared
+        # daemon per node serving every brick-multiplex'd brick
+        self._mux: dict | None = None  # {proc, port, bricks:set}
 
     # -- store (glusterd-store.c analog) -----------------------------------
 
@@ -144,6 +147,15 @@ class Glusterd:
             self._kill_shd(name)
         for name in list(self.bricks):
             self._kill_brick(name)
+        if self._mux is not None:
+            proc = self._mux["proc"]
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            self._mux = None
         if self._server is not None:
             self._server.close()
             for w in list(getattr(self, "_writers", [])):
@@ -298,7 +310,24 @@ class Glusterd:
         vols = self._quorum_volumes()
         peers = [p for p in self.state["peers"].values()
                  if p["uuid"] != self.uuid]
-        if not vols or not peers:  # single-node clusters are quorate
+        # volumes blocked earlier that stopped enforcing (option
+        # flipped to none) or lost their peers (detach): unblock them —
+        # single-node clusters are quorate, and a non-enforcing volume
+        # must never stay fenced
+        if self._quorum_blocked:
+            enforcing = {v["name"] for v in vols} if peers else set()
+            for stale in list(self._quorum_blocked - enforcing):
+                vol = self.state["volumes"].get(stale)
+                self._quorum_blocked.discard(stale)
+                if vol is None or vol.get("status") != "started":
+                    continue
+                for b in vol["bricks"]:
+                    if b["node"] == self.uuid and \
+                            b["name"] not in self.bricks:
+                        await self._spawn_brick(vol, b, port=b.get("port"))
+                log.info(16, "quorum enforcement lifted: restarted "
+                         "bricks of %s", stale)
+        if not vols or not peers:
             return
         alive, total = await self._alive_count()
         for vol in vols:
@@ -308,7 +337,7 @@ class Glusterd:
                 self._quorum_blocked.add(name)
                 for b in vol["bricks"]:
                     if b["node"] == self.uuid:
-                        self._kill_brick(b["name"])
+                        await self._stop_brick(vol, b)
                 log.error(15, "server quorum lost (%d/%d): stopped "
                           "bricks of %s", alive, total, name)
                 gf_event("SERVER_QUORUM_LOST", volume=name,
@@ -432,12 +461,20 @@ class Glusterd:
                     continue
                 nodes.append(n)
                 locked.append(n)
+            # bounded like the lock phase: a peer hanging AFTER it
+            # granted its lock must not hold the cluster lock forever.
+            # Stage validates (fast); commit may spawn bricks, so its
+            # bound is generous.  Timeout aborts the txn (a commit is
+            # not safely skippable) — the finally-unlock still runs.
             for n in nodes:
-                await self._node_call(n, "txn-stage", op=op, payload=payload)
+                await asyncio.wait_for(
+                    self._node_call(n, "txn-stage", op=op,
+                                    payload=payload), 60)
             results = []
             for n in nodes:
-                results.append(await self._node_call(
-                    n, "txn-commit", op=op, payload=payload))
+                results.append(await asyncio.wait_for(
+                    self._node_call(n, "txn-commit", op=op,
+                                    payload=payload), 600))
             return results
         finally:
             for n in locked:
@@ -591,7 +628,7 @@ class Glusterd:
         self._kill_shd(name)
         for b in vol["bricks"]:
             if b["node"] == self.uuid:
-                self._kill_brick(b["name"])
+                await self._stop_brick(vol, b)
         gf_event("VOLUME_STOP", name=name)
         await self._run_hooks("stop", "post", name)
         return {"stopped": name}
@@ -651,9 +688,10 @@ class Glusterd:
             ok = False
             port = self.ports.get(b["name"])
             if port:
-                ok = await self._brick_reconfigure(vol, port, text)
+                ok = await self._brick_reconfigure(
+                    vol, port, text, subvol=b["name"] + "-server")
             if not ok:
-                self._kill_brick(b["name"])
+                await self._stop_brick(vol, b)
                 await self._spawn_brick(vol, b, port=b.get("port"))
                 outcome = "respawned"
             volfile = os.path.join(bdir, b["name"] + ".vol")
@@ -665,10 +703,12 @@ class Glusterd:
         return outcome
 
     @staticmethod
-    async def _brick_call(vol: dict, port: int, name: str, args: list):
+    async def _brick_call(vol: dict, port: int, name: str, args: list,
+                          subvol: str = ""):
         """One authenticated mgmt call to a local brick: SETVOLUME
         handshake with the volume's generated credentials, then the
-        call (bricks refuse unauthenticated RPC)."""
+        call (bricks refuse unauthenticated RPC).  subvol routes to a
+        specific brick graph on a multiplexed daemon."""
         ssl_ctx = None
         opts = vol.get("options", {})
         if volgen._bool(opts.get("server.ssl", "off")):
@@ -689,7 +729,7 @@ class Glusterd:
                      "password": auth.get("mgmt-password",
                                           auth.get("password", ""))}
             writer.write(wire.pack(1, wire.MT_CALL, [
-                "__handshake__", [b"glusterd", "", creds], {}]))
+                "__handshake__", [b"glusterd", subvol, creds], {}]))
             await writer.drain()
             rec = await asyncio.wait_for(wire.read_frame(reader), 5)
             _, mtype, payload = wire.unpack(rec)
@@ -705,10 +745,11 @@ class Glusterd:
 
     @classmethod
     async def _brick_reconfigure(cls, vol: dict, port: int,
-                                 text: str) -> bool:
+                                 text: str, subvol: str = "") -> bool:
         try:
             payload = await cls._brick_call(vol, port,
-                                            "__reconfigure__", [text])
+                                            "__reconfigure__", [text],
+                                            subvol=subvol)
             return bool(payload and payload.get("ok"))
         except Exception:
             return False
@@ -776,7 +817,7 @@ class Glusterd:
         if b is None:
             raise MgmtError(f"no brick {brick!r} in {name}")
         if action == "stop":
-            self._kill_brick(brick)
+            await self._stop_brick(vol, b)
             return {"stopped": brick}
         if action == "start":
             proc = self.bricks.get(brick)
@@ -911,7 +952,8 @@ class Glusterd:
                 continue
             port = self.ports.get(b["name"])
             ok = bool(port) and await self._brick_reconfigure(
-                vol, port, volgen.build_brick_volfile(tmp, b))
+                vol, port, volgen.build_brick_volfile(tmp, b),
+                subvol=b["name"] + "-server")
             if not ok and strict:
                 raise MgmtError(
                     f"could not {'arm' if on else 'release'} barrier on "
@@ -931,7 +973,8 @@ class Glusterd:
             if not port:
                 continue
             while True:
-                dump = await self._brick_statedump(vol, port)
+                dump = await self._brick_statedump(
+                    vol, port, subvol=b["name"] + "-server")
                 layers = (dump or {}).get("layers", {})
                 inflight = [l["private"].get("inflight", 0)
                             for l in layers.values()
@@ -949,9 +992,11 @@ class Glusterd:
                 await asyncio.sleep(0.02)
 
     @classmethod
-    async def _brick_statedump(cls, vol: dict, port: int) -> dict | None:
+    async def _brick_statedump(cls, vol: dict, port: int,
+                               subvol: str = "") -> dict | None:
         try:
-            return await cls._brick_call(vol, port, "__statedump__", [])
+            return await cls._brick_call(vol, port, "__statedump__", [],
+                                         subvol=subvol)
         except Exception:
             return None
 
@@ -1023,11 +1068,13 @@ class Glusterd:
                 if proc is not None and proc.poll() is None:
                     continue  # a retry after partial failure
                 await self._spawn_brick(vi, b)
-                spawned.append(b["name"])
+                spawned.append(b)
         except BaseException:
-            # no half-activated snapshot: kill what we started
-            for name_ in spawned:
-                self._kill_brick(name_)
+            # no half-activated snapshot: stop what we started (detach,
+            # not kill, when multiplexed — the shared daemon serves
+            # other volumes' bricks too)
+            for b_ in spawned:
+                await self._stop_brick(vi, b_)
             raise
         snap["volinfo"] = vi
         self._save()
@@ -1041,7 +1088,7 @@ class Glusterd:
         vi = snap.pop("volinfo", None)
         if vi:
             for b in vi["bricks"]:
-                self._kill_brick(b["name"])
+                await self._stop_brick(vi, b)
                 self.ports.pop(b["name"], None)
         self._save()
         return {"ok": True}
@@ -1349,7 +1396,7 @@ class Glusterd:
             for b in vol["bricks"]:
                 if b["node"] == self.uuid and b["name"] in self.bricks:
                     port = b.get("port")
-                    self._kill_brick(b["name"])
+                    await self._stop_brick(vol, b)
                     await self._spawn_brick(vol, b, port=port)
         return {"created": name}
 
@@ -1451,8 +1498,121 @@ class Glusterd:
                 continue
             await self._spawn_brick(vol, b)
 
+    # -- brick multiplexing (glusterfsd-mgmt.c ATTACH / brick-mux) ---------
+    # One shared daemon per node anchored on a glusterd-owned stub
+    # graph; every brick of a cluster.brick-multiplex volume is
+    # attached into it over the ATTACH RPC and served on ONE port,
+    # routed by the client's SETVOLUME remote-subvolume.
+
+    def _mux_enabled(self, vol: dict) -> bool:
+        if not volgen._bool(vol.get("options", {}).get(
+                "cluster.brick-multiplex", "off")):
+            return False
+        if volgen._bool(vol.get("options", {}).get("server.ssl", "off")):
+            # the mux transport carries the anchor's (plaintext) TLS
+            # identity; a per-volume-TLS brick needs its own process
+            log.warning(19, "%s: server.ssl volume gets a dedicated "
+                        "brick process despite brick-multiplex",
+                        vol["name"])
+            return False
+        return True
+
+    def _mux_auth_vol(self) -> dict:
+        """Pseudo-volinfo carrying the node's anchor credentials (for
+        mgmt calls against the shared daemon's default graph)."""
+        auth = self.state.setdefault("mux-auth", {
+            "mgmt-username": str(uuid.uuid4()),
+            "mgmt-password": str(uuid.uuid4())})
+        return {"name": "mux-anchor", "options": {}, "auth": auth}
+
+    async def _ensure_mux_proc(self) -> int:
+        if self._mux and self._mux["proc"].poll() is None:
+            return self._mux["port"]
+        anchor = self._mux_auth_vol()
+        bdir = os.path.join(self.workdir, "bricks")
+        os.makedirs(bdir, exist_ok=True)
+        adir = os.path.join(self.workdir, "mux-anchor")
+        os.makedirs(adir, exist_ok=True)
+        volfile = os.path.join(bdir, "mux-anchor.vol")
+        portfile = os.path.join(bdir, "mux-anchor.port")
+        with open(volfile, "w") as f:
+            f.write(
+                f"volume mux-anchor-posix\n    type storage/posix\n"
+                f"    option directory {adir}\nend-volume\n"
+                f"volume mux-anchor-server\n    type protocol/server\n"
+                f"    option auth-mgmt-user "
+                f"{anchor['auth']['mgmt-username']}\n"
+                f"    option auth-mgmt-password "
+                f"{anchor['auth']['mgmt-password']}\n"
+                # no client credentials exist for the anchor: refuse
+                # every non-mgmt handshake outright
+                f"    option auth-reject *\n"
+                f"    subvolumes mux-anchor-posix\nend-volume\n")
+        if os.path.exists(portfile):
+            os.unlink(portfile)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        logfile = os.path.join(bdir, "mux-anchor.log")
+        with open(logfile, "ab") as logf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "glusterfs_tpu.daemon",
+                 "--volfile", volfile, "--listen", "0",
+                 "--portfile", portfile,
+                 "--top", "mux-anchor-server"],
+                env=env, stdout=subprocess.DEVNULL, stderr=logf)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if os.path.exists(portfile):
+                with open(portfile) as f:
+                    port = int(f.read())
+                self._mux = {"proc": proc, "port": port,
+                             "bricks": set()}
+                return port
+            if proc.poll() is not None:
+                with open(logfile, "rb") as f:
+                    err = f.read().decode(errors="replace")[-2000:]
+                raise MgmtError(f"mux daemon failed: {err}")
+            await asyncio.sleep(0.05)
+        proc.terminate()
+        raise MgmtError("mux daemon did not start in time")
+
+    async def _attach_brick(self, vol: dict, b: dict) -> None:
+        port = await self._ensure_mux_proc()
+        text = volgen.build_brick_volfile(vol, b)
+        payload = await self._brick_call(
+            self._mux_auth_vol(), port, "__attach__",
+            [text, b["name"] + "-server"])
+        if not (payload and payload.get("ok")):
+            raise MgmtError(f"attach of {b['name']} refused: {payload}")
+        self._mux["bricks"].add(b["name"])
+        self.bricks[b["name"]] = self._mux["proc"]
+        self.ports[b["name"]] = port
+        b["port"] = port
+        self._save()
+
+    async def _stop_brick(self, vol: dict, b: dict) -> None:
+        """Stop serving one brick: detach from the shared daemon when
+        multiplexed, else kill its dedicated process."""
+        name = b["name"]
+        if self._mux and name in self._mux["bricks"]:
+            try:
+                await self._brick_call(
+                    self._mux_auth_vol(), self._mux["port"],
+                    "__detach__", [name + "-server"])
+            except Exception as e:
+                log.warning(20, "detach of %s failed: %r", name, e)
+            self._mux["bricks"].discard(name)
+            self.bricks.pop(name, None)
+            self.ports.pop(name, None)
+            return
+        self._kill_brick(name)
+
     async def _spawn_brick(self, vol: dict, b: dict,
                            port: int | None = None) -> None:
+        if self._mux_enabled(vol):
+            await self._attach_brick(vol, b)
+            return
         bdir = os.path.join(self.workdir, "bricks")
         os.makedirs(bdir, exist_ok=True)
         volfile = os.path.join(bdir, b["name"] + ".vol")

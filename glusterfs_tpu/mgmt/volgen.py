@@ -98,6 +98,9 @@ OPTION_MAP = {
     "cluster.server-quorum-type": ("mgmt/glusterd", "server-quorum-type"),
     "cluster.server-quorum-ratio": ("mgmt/glusterd",
                                     "server-quorum-ratio"),
+    # consumed by glusterd's brick spawner: attach bricks into one
+    # shared daemon process (glusterfsd-mgmt.c ATTACH, brick-mux)
+    "cluster.brick-multiplex": ("mgmt/glusterd", "brick-multiplex"),
     # distribute variants (nufa.c / switch.c): swap the dht layer type
     "cluster.nufa": ("cluster/nufa", "__enable__"),
     "cluster.nufa-local-volume-name": ("cluster/nufa",
@@ -346,9 +349,10 @@ def build_client_volfile(volinfo: dict,
             subs = [cluster_over(names[i:i + group], i // group)
                     for i in range(0, len(names), group)]
             top = f"{volinfo['name']}-dht"
-            out.append(_emit(top, "cluster/distribute",
-                             layer_options(volinfo, "cluster/distribute"),
-                             subs))
+            dtype = _dht_type(volinfo)  # nufa/switch apply here too
+            dopts = layer_options(volinfo, "cluster/distribute")
+            dopts.update(layer_options(volinfo, dtype))
+            out.append(_emit(top, dtype, dopts, subs))
         else:
             top = cluster_over(names)
     else:

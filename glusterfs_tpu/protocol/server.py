@@ -125,8 +125,13 @@ class _ClientConn:
         self.identity = b""
         self.name = ""
         self.authed = False
+        self.is_mgmt = False
         self.peer_addr = "?"
         self.compress = False  # mirror zlib frames after handshake
+        # the brick this transport bound to at SETVOLUME (multiplexed
+        # processes serve several; glusterfsd-mgmt.c ATTACH model)
+        self.top: Layer | None = None
+        self.graph = None
 
     def register_fd(self, fd: FdObj) -> wire.FdHandle:
         fdid = self.next_fd
@@ -170,16 +175,37 @@ class BrickServer:
         self.host = host
         self.port = port
         self.graph = graph  # enables live option reconfigure
+        # multiplexing (glusterfsd-mgmt.c ATTACH): additional brick
+        # graphs served on this same transport, keyed by served top name
+        self.attached: dict[str, tuple[Layer, Any]] = {}
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[_ClientConn] = set()
 
+    def _select_top(self, name: str) -> tuple[Layer, Any]:
+        """SETVOLUME routing: the requested remote-subvolume picks the
+        brick graph (default brick when unnamed or named directly).
+        Clients name the brick ('v-brick-0'); attached graphs are keyed
+        by their served top ('v-brick-0-server') — accept either."""
+        if name:
+            for key in (name, name + "-server"):
+                if key in self.attached:
+                    return self.attached[key]
+        return self.top, self.graph
+
+    @staticmethod
+    def _opts_of(top: Layer):
+        """Live options of a protocol/server top layer, if present
+        (read per-use so ``volume set`` reconfigure takes effect)."""
+        return top.opts if isinstance(top, ServerLayer) else {}
+
     @property
     def _auth_opts(self):
-        """Live options of the protocol/server top layer, if present
-        (read per-use so ``volume set`` reconfigure takes effect)."""
-        return self.top.opts if isinstance(self.top, ServerLayer) else {}
+        return self._opts_of(self.top)
 
     def _ssl_context(self) -> ssl_mod.SSLContext | None:
+        # one TLS identity per transport: multiplexed bricks share the
+        # anchor brick's certificate (the reference's mux shares the
+        # rpcsvc listener the same way)
         opts = self._auth_opts
         if not opts or not opts["ssl"]:
             return None
@@ -188,34 +214,80 @@ class BrickServer:
         return tls.server_context(opts["ssl-cert"], opts["ssl-key"],
                                   opts["ssl-ca"])
 
-    def _addr_ok(self, addr: str) -> bool:
+    def _addr_ok(self, addr: str, top: Layer | None = None) -> bool:
         """auth/addr: reject list wins, then the allow list must match."""
-        opts = self._auth_opts
+        opts = self._opts_of(top if top is not None else self.top)
         if not opts:
             return True
         if opts["auth-reject"] and _addr_match(addr, opts["auth-reject"]):
             return False
         return _addr_match(addr, opts["auth-allow"])
 
-    def _is_mgmt(self, creds: dict) -> bool:
+    def _is_mgmt(self, creds: dict, top: Layer | None = None) -> bool:
         """The volfile-only mgmt pair: glusterd's own calls pass even
         when the address lists exclude this host."""
-        opts = self._auth_opts
+        opts = self._opts_of(top if top is not None else self.top)
         return bool(opts and opts["auth-mgmt-user"]
                     and _ct_eq(creds.get("username"),
                                opts["auth-mgmt-user"])
                     and _ct_eq(creds.get("password"),
                                opts["auth-mgmt-password"]))
 
-    def _login_ok(self, creds: dict) -> bool:
+    def _login_ok(self, creds: dict, top: Layer | None = None) -> bool:
         """auth/login: when the brick carries credentials, the client
         must present the matching pair (server_setvolume
         gf_authenticate)."""
-        opts = self._auth_opts
+        opts = self._opts_of(top if top is not None else self.top)
         if not opts or not opts["auth-user"]:
             return True
         return (_ct_eq(creds.get("username"), opts["auth-user"])
                 and _ct_eq(creds.get("password"), opts["auth-password"]))
+
+    def _wire_upcall(self, top: Layer) -> None:
+        from ..core.layer import walk
+
+        for layer in walk(top):
+            sink = getattr(layer, "set_upcall_sink", None)
+            if sink is not None:
+                sink(self.push_event)
+
+    async def attach(self, volfile_text: str,
+                     top_name: str | None = None) -> str:
+        """Serve another brick graph on this transport (the brick-mux
+        ATTACH RPC, glusterfsd-mgmt.c:913)."""
+        from ..core.graph import Graph
+
+        graph = Graph.construct(volfile_text, top_name=top_name)
+        name = graph.top.name
+        if name == self.top.name or name in self.attached:
+            raise FopError(17, f"brick {name!r} already served")  # EEXIST
+        await graph.activate()
+        self._wire_upcall(graph.top)
+        self.attached[name] = (graph.top, graph)
+        log.info(8, "attached brick %s (now %d on this port)", name,
+                 1 + len(self.attached))
+        return name
+
+    async def detach(self, name: str) -> bool:
+        """Stop serving an attached brick; its bound transports drop
+        (glusterfsd-mgmt.c brick terminate for mux bricks)."""
+        entry = self.attached.pop(name, None)
+        if entry is None:
+            return False
+        top, graph = entry
+        for conn in list(self.connections):
+            if conn.top is top:
+                try:
+                    conn.writer.close()
+                except Exception:
+                    pass
+                self.connections.discard(conn)
+                await self._cleanup(conn)
+        try:
+            await graph.fini()
+        except Exception as e:
+            log.warning(9, "detach fini of %s: %r", name, e)
+        return True
 
     async def start(self) -> int:
         self._server = await asyncio.start_server(
@@ -224,12 +296,7 @@ class BrickServer:
         # hand the event-push callback to any upcall layer in the graph
         # (the reference's upcall xlator calls back through rpcsvc the
         # same way)
-        from ..core.layer import walk
-
-        for layer in walk(self.top):
-            sink = getattr(layer, "set_upcall_sink", None)
-            if sink is not None:
-                sink(self.push_event)
+        self._wire_upcall(self.top)
         log.info(1, "brick %s serving on %s:%d", self.top.name, self.host,
                  self.port)
         return self.port
@@ -308,8 +375,9 @@ class BrickServer:
 
     async def _cleanup(self, conn: _ClientConn) -> None:
         """Disconnect: release fds + this client's locks (client_t reap)."""
+        top = conn.top if conn.top is not None else self.top
         for fd in conn.fds.values():
-            rel = getattr(self.top, "release", None)
+            rel = getattr(top, "release", None)
             if rel is not None:
                 try:
                     await rel(fd)
@@ -319,7 +387,7 @@ class BrickServer:
         if conn.identity:
             from ..core.layer import walk
 
-            for layer in walk(self.top):
+            for layer in walk(top):
                 rc = getattr(layer, "release_client", None)
                 if rc is not None:
                     try:
@@ -332,46 +400,69 @@ class BrickServer:
             fop_name, args, kwargs = payload
             if fop_name == "__handshake__":
                 creds = args[2] if len(args) > 2 else {}
+                want = args[1] if len(args) > 1 else ""
+                # routing first: auth is checked against the BRICK the
+                # client asked for (each mux'd graph carries its own
+                # volume's credentials)
+                top, graph = self._select_top(want)
                 # mgmt pair (volfile-only, never served to clients)
                 # bypasses BOTH address lists — an over-broad
                 # auth.reject must not cut glusterd off from its bricks
-                ok = self._is_mgmt(creds or {}) or (
-                    self._addr_ok(conn.peer_addr)
-                    and self._login_ok(creds or {}))
+                is_mgmt = self._is_mgmt(creds or {}, top)
+                ok = is_mgmt or (
+                    self._addr_ok(conn.peer_addr, top)
+                    and self._login_ok(creds or {}, top))
                 if not ok:
                     log.warning(7, "handshake refused from %s (%r)",
                                 conn.peer_addr, args[0])
                     return wire.MT_REPLY, {"ok": False,
                                            "error": "authentication failed"}
                 conn.identity = args[0]
-                conn.name = args[1] if len(args) > 1 else ""
+                conn.name = want
                 conn.authed = True
+                conn.is_mgmt = is_mgmt
+                conn.top, conn.graph = top, graph
                 conn.compress = bool((creds or {}).get("compress"))
-                return wire.MT_REPLY, {"volume": self.top.name, "ok": True}
+                return wire.MT_REPLY, {"volume": top.name, "ok": True}
             if not conn.authed:
                 # SETVOLUME gates everything — pings included (no
                 # pre-auth liveness probing; server.c refuses requests
                 # from unknown clients)
                 raise FopError(13, "handshake required")  # EACCES
+            top = conn.top if conn.top is not None else self.top
+            graph = conn.graph if conn.top is not None else self.graph
             if fop_name == "__ping__":
                 return wire.MT_REPLY, "pong"
+            if fop_name == "__attach__":
+                # brick-mux ATTACH (glusterfsd-mgmt.c:913): mgmt-only
+                if not conn.is_mgmt:
+                    raise FopError(13, "attach is a mgmt operation")
+                name = await self.attach(args[0],
+                                         args[1] if len(args) > 1
+                                         else None)
+                return wire.MT_REPLY, {"ok": True, "attached": name}
+            if fop_name == "__detach__":
+                if not conn.is_mgmt:
+                    raise FopError(13, "detach is a mgmt operation")
+                ok = await self.detach(args[0])
+                return wire.MT_REPLY, {"ok": ok}
             if fop_name == "__statedump__":
                 # full-graph dump (has "layers") when the daemon handed
                 # us the graph; bare top-layer dump otherwise
-                src = self.graph if self.graph is not None else self.top
+                src = graph if graph is not None else top
                 return wire.MT_REPLY, _jsonable(src.statedump())
             if fop_name == "__reconfigure__":
                 # live option apply from glusterd (xlator.reconfigure
                 # path, graph.c glusterfs_graph_reconfigure); topology
                 # changes need a daemon respawn instead
-                if self.graph is None:
+                if graph is None:
                     return wire.MT_REPLY, {"ok": False,
                                            "reason": "no graph handle"}
-                ok = self.graph.apply_volfile(args[0])
+                ok = graph.apply_volfile(args[0])
                 return wire.MT_REPLY, {"ok": ok}
             if fop_name not in _FOPS and fop_name not in _RPC_EXTRAS:
                 raise FopError(95, f"unknown fop {fop_name!r}")
-            fn = getattr(self.top, fop_name, None)
+            fn = getattr(top, fop_name, None)
             if fn is None and fop_name in _RPC_EXTRAS:
                 # extras (quota_usage, heal surfaces) live on a specific
                 # mid-graph layer, not the passthrough top — resolve by
@@ -379,7 +470,7 @@ class BrickServer:
                 # programs per xlator)
                 from ..core.layer import walk
 
-                for layer in walk(self.top):
+                for layer in walk(top):
                     fn = getattr(layer, fop_name, None)
                     if fn is not None:
                         break
@@ -392,7 +483,7 @@ class BrickServer:
                 fd = conn.fds.pop(args[0].fdid, None)
                 if fd is None:
                     return wire.MT_REPLY, {}
-                await self.top.release(fd)
+                await top.release(fd)
                 return wire.MT_REPLY, {}
             args = conn.resolve(args)
             kwargs = {k: conn.resolve(v) for k, v in (kwargs or {}).items()}

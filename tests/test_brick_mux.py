@@ -1,0 +1,106 @@
+"""Brick multiplexing: many bricks served by ONE shared daemon process
+on one port, attach/detach lifecycle (glusterfsd-mgmt.c ATTACH,
+cluster.brick-multiplex)."""
+
+import asyncio
+import os
+
+import pytest
+
+from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient, mount_volume
+
+
+@pytest.mark.slow
+def test_brick_mux_lifecycle(tmp_path):
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="mv",
+                             vtype="replicate",
+                             bricks=[{"path": str(tmp_path / f"b{i}")}
+                                     for i in range(2)])
+                await c.call("volume-set", name="mv",
+                             key="cluster.brick-multiplex", value="on")
+                await c.call("volume-start", name="mv")
+                st = await c.call("volume-status", name="mv")
+                ports = {b["port"] for b in st["bricks"]}
+                assert len(ports) == 1 and 0 not in ports, \
+                    f"mux bricks must share one port: {st}"
+                # one shared daemon process for both bricks
+                pids = {p.pid for p in d.bricks.values()}
+                assert len(pids) == 1
+                assert d._mux and d._mux["bricks"] == {"mv-brick-0",
+                                                       "mv-brick-1"}
+
+                # data path works through SETVOLUME routing
+                m = await mount_volume(d.host, d.port, "mv")
+                try:
+                    await m.write_file("/f", b"mux" * 100)
+                    assert await m.read_file("/f") == b"mux" * 100
+                    # both replicas materialized on disk
+                    for i in range(2):
+                        assert (tmp_path / f"b{i}" / "f").exists()
+
+                    # detach ONE brick: the other keeps serving
+                    await c.call("volume-brick", name="mv",
+                                 brick="mv-brick-0", action="stop")
+                    st = await c.call("volume-status", name="mv")
+                    on = {b["name"]: b["online"] for b in st["bricks"]}
+                    assert on == {"mv-brick-0": False,
+                                  "mv-brick-1": True}, on
+                    assert d._mux["proc"].poll() is None, \
+                        "shared daemon must survive a detach"
+                    # degraded read through the surviving replica
+                    assert await m.read_file("/f") == b"mux" * 100
+                    # re-attach
+                    await c.call("volume-brick", name="mv",
+                                 brick="mv-brick-0", action="start")
+                    st = await c.call("volume-status", name="mv")
+                    assert all(b["online"] for b in st["bricks"])
+                    assert len({b["port"] for b in st["bricks"]}) == 1
+                finally:
+                    await m.unmount()
+                await c.call("volume-stop", name="mv")
+                assert not d._mux["bricks"]
+        finally:
+            await d.stop()
+            assert d._mux is None
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_brick_mux_reconfigure_and_statedump(tmp_path):
+    """Per-brick mgmt calls (statedump / live reconfigure) route to the
+    right graph inside the shared daemon."""
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="xv",
+                             vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "xb0")}])
+                await c.call("volume-set", name="xv",
+                             key="cluster.brick-multiplex", value="on")
+                await c.call("volume-start", name="xv")
+                vol = d.state["volumes"]["xv"]
+                port = d.ports["xv-brick-0"]
+                dump = await d._brick_statedump(
+                    vol, port, subvol="xv-brick-0-server")
+                names = set((dump or {}).get("layers", {}))
+                assert "xv-brick-0-posix" in names, names
+                # live reconfigure reaches the attached graph
+                out = await c.call("volume-set", name="xv",
+                                   key="performance.io-thread-count",
+                                   value="3")
+                assert out["applied"][0] in ("reconfigured",
+                                             "respawned")
+                await c.call("volume-stop", name="xv")
+        finally:
+            await d.stop()
+
+    asyncio.run(run())

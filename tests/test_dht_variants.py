@@ -110,3 +110,18 @@ def test_volgen_emits_variants(tmp_path):
     text = volgen.build_client_volfile(vi)
     assert "type cluster/switch" in text
     assert "option pattern-switch-case *.jpg:nv-client-0" in text
+    # variants apply to the distributed-X aggregate layer too
+    vi2 = {
+        "name": "dv", "type": "replicate", "redundancy": 0,
+        "group-size": 2,
+        "bricks": [{"index": i, "host": "h", "port": 1,
+                    "path": str(tmp_path / f"db{i}"),
+                    "name": f"dv-brick-{i}", "node": "x"}
+                   for i in range(4)],
+        "options": {"cluster.nufa": "on",
+                    "cluster.nufa-local-volume-name":
+                        "dv-replicate-0"},
+    }
+    text = volgen.build_client_volfile(vi2)
+    assert "type cluster/nufa" in text
+    assert "option local-volume-name dv-replicate-0" in text

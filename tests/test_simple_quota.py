@@ -56,6 +56,10 @@ def test_simple_quota_enforce_and_account(tmp_path):
         usage = json.loads((await top.getxattr(
             Loc("/proj"), V_USAGE))[V_USAGE])
         assert usage["used"] == 100
+        # usage query from a path INSIDE the namespace resolves to it
+        inner = json.loads((await top.getxattr(
+            Loc("/proj/c"), V_USAGE))[V_USAGE])
+        assert inner["limit"] == 4096
         # limit 0 clears
         await top.setxattr(Loc("/proj"), {XA_LIMIT: b"0"})
         with pytest.raises(FopError):
