@@ -297,6 +297,10 @@ def main() -> None:
         sweep["rchecksum_MiB_s"] = round(32 * MIB / MIB / ct, 1)
         sweep["rchecksum_zlib_MiB_s"] = round(
             64 * 64 * 1024 / MIB / zt, 1)
+        if native.available():
+            nt = time_it(lambda: native.adler32_batch(blocks_np), 1, 3)
+            sweep["rchecksum_native_MiB_s"] = round(32 * MIB / MIB / nt,
+                                                    1)
     except Exception as e:  # sweep is auxiliary; never sink the run
         sweep["sweep_error"] = str(e)[:200]
 

@@ -111,10 +111,9 @@ class SimpleQuotaLayer(Layer):
     async def getxattr(self, loc: Loc, name: str | None = None,
                        xdata: dict | None = None):
         if name == V_USAGE:
-            p = loc.path.rstrip("/") or "/"
-            # querying any path INSIDE a namespace reports the
-            # enclosing namespace's usage (sq_get_xattr lookup walk)
-            ns = p if p in self.limits else _ns_of(p)
+            # any path inside a namespace reports the enclosing
+            # namespace's usage (limits only ever key top-level dirs)
+            ns = _ns_of(loc.path)
             scale = self.opts["usage-scale"]
             if ns in self.limits:
                 return {V_USAGE: json.dumps({

@@ -79,6 +79,7 @@ class Glusterd:
         # brick multiplexing (glusterfsd-mgmt.c ATTACH): one shared
         # daemon per node serving every brick-multiplex'd brick
         self._mux: dict | None = None  # {proc, port, bricks:set}
+        self._mux_lock = asyncio.Lock()
 
     # -- store (glusterd-store.c analog) -----------------------------------
 
@@ -318,13 +319,17 @@ class Glusterd:
             enforcing = {v["name"] for v in vols} if peers else set()
             for stale in list(self._quorum_blocked - enforcing):
                 vol = self.state["volumes"].get(stale)
-                self._quorum_blocked.discard(stale)
                 if vol is None or vol.get("status") != "started":
+                    self._quorum_blocked.discard(stale)
                     continue
+                # un-block only AFTER the respawn succeeds: a failed
+                # spawn must leave the name in the set so the next
+                # tick retries instead of stranding the bricks
                 for b in vol["bricks"]:
                     if b["node"] == self.uuid and \
                             b["name"] not in self.bricks:
                         await self._spawn_brick(vol, b, port=b.get("port"))
+                self._quorum_blocked.discard(stale)
                 log.info(16, "quorum enforcement lifted: restarted "
                          "bricks of %s", stale)
         if not vols or not peers:
@@ -343,13 +348,15 @@ class Glusterd:
                 gf_event("SERVER_QUORUM_LOST", volume=name,
                          alive=alive, total=total)
             elif met and name in self._quorum_blocked:
-                self._quorum_blocked.discard(name)
                 for b in vol["bricks"]:
                     if b["node"] == self.uuid and \
                             b["name"] not in self.bricks:
                         # reuse the recorded port: fenced clients are
                         # still retrying it
                         await self._spawn_brick(vol, b, port=b.get("port"))
+                # only now: a failed respawn keeps the volume blocked
+                # so the next tick retries
+                self._quorum_blocked.discard(name)
                 log.info(16, "server quorum regained (%d/%d): restarted "
                          "bricks of %s", alive, total, name)
                 gf_event("SERVER_QUORUM_REGAINED", volume=name,
@@ -1525,18 +1532,62 @@ class Glusterd:
             "mgmt-password": str(uuid.uuid4())})
         return {"name": "mux-anchor", "options": {}, "auth": auth}
 
-    async def _ensure_mux_proc(self) -> int:
-        if self._mux and self._mux["proc"].poll() is None:
-            return self._mux["port"]
-        anchor = self._mux_auth_vol()
-        bdir = os.path.join(self.workdir, "bricks")
-        os.makedirs(bdir, exist_ok=True)
-        adir = os.path.join(self.workdir, "mux-anchor")
-        os.makedirs(adir, exist_ok=True)
-        volfile = os.path.join(bdir, "mux-anchor.vol")
-        portfile = os.path.join(bdir, "mux-anchor.port")
+    async def _spawn_daemon(self, volfile: str, text: str, portfile: str,
+                            logfile: str, top: str,
+                            port: int | None = None,
+                            what: str = "brick"
+                            ) -> tuple[subprocess.Popen, int]:
+        """Shared spawn-and-wait machinery for brick daemons (dedicated
+        bricks and the mux anchor use the same path)."""
         with open(volfile, "w") as f:
-            f.write(
+            f.write(text)
+        if os.path.exists(portfile):
+            os.unlink(portfile)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        with open(logfile, "ab") as logf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "glusterfs_tpu.daemon",
+                 "--volfile", volfile, "--listen", str(port or 0),
+                 "--portfile", portfile, "--top", top],
+                env=env, stdout=subprocess.DEVNULL, stderr=logf)
+        # generous: a cold interpreter+jax import on a loaded host can
+        # take the better part of a minute
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if os.path.exists(portfile):
+                with open(portfile) as f:
+                    return proc, int(f.read())
+            if proc.poll() is not None:
+                with open(logfile, "rb") as f:
+                    err = f.read().decode(errors="replace")[-2000:]
+                raise MgmtError(f"{what} failed: {err}")
+            await asyncio.sleep(0.05)
+        # kill the straggler (terminate -> wait -> kill escalation): an
+        # orphan that binds its port AFTER we give up would serve a
+        # brick glusterd no longer tracks
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        raise MgmtError(f"{what} did not start in time")
+
+    async def _ensure_mux_proc(self) -> int:
+        async with self._mux_lock:
+            # re-check under the lock: a concurrent caller may have
+            # finished the (up to 90s) spawn while we waited — two
+            # anchors would strand the first one's attached bricks
+            if self._mux and self._mux["proc"].poll() is None:
+                return self._mux["port"]
+            anchor = self._mux_auth_vol()
+            bdir = os.path.join(self.workdir, "bricks")
+            os.makedirs(bdir, exist_ok=True)
+            adir = os.path.join(self.workdir, "mux-anchor")
+            os.makedirs(adir, exist_ok=True)
+            text = (
                 f"volume mux-anchor-posix\n    type storage/posix\n"
                 f"    option directory {adir}\nend-volume\n"
                 f"volume mux-anchor-server\n    type protocol/server\n"
@@ -1548,34 +1599,13 @@ class Glusterd:
                 # every non-mgmt handshake outright
                 f"    option auth-reject *\n"
                 f"    subvolumes mux-anchor-posix\nend-volume\n")
-        if os.path.exists(portfile):
-            os.unlink(portfile)
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        logfile = os.path.join(bdir, "mux-anchor.log")
-        with open(logfile, "ab") as logf:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "glusterfs_tpu.daemon",
-                 "--volfile", volfile, "--listen", "0",
-                 "--portfile", portfile,
-                 "--top", "mux-anchor-server"],
-                env=env, stdout=subprocess.DEVNULL, stderr=logf)
-        deadline = time.time() + 90
-        while time.time() < deadline:
-            if os.path.exists(portfile):
-                with open(portfile) as f:
-                    port = int(f.read())
-                self._mux = {"proc": proc, "port": port,
-                             "bricks": set()}
-                return port
-            if proc.poll() is not None:
-                with open(logfile, "rb") as f:
-                    err = f.read().decode(errors="replace")[-2000:]
-                raise MgmtError(f"mux daemon failed: {err}")
-            await asyncio.sleep(0.05)
-        proc.terminate()
-        raise MgmtError("mux daemon did not start in time")
+            proc, port = await self._spawn_daemon(
+                os.path.join(bdir, "mux-anchor.vol"), text,
+                os.path.join(bdir, "mux-anchor.port"),
+                os.path.join(bdir, "mux-anchor.log"),
+                "mux-anchor-server", what="mux daemon")
+            self._mux = {"proc": proc, "port": port, "bricks": set()}
+            return port
 
     async def _attach_brick(self, vol: dict, b: dict) -> None:
         port = await self._ensure_mux_proc()
@@ -1615,46 +1645,19 @@ class Glusterd:
             return
         bdir = os.path.join(self.workdir, "bricks")
         os.makedirs(bdir, exist_ok=True)
-        volfile = os.path.join(bdir, b["name"] + ".vol")
-        portfile = os.path.join(bdir, b["name"] + ".port")
-        with open(volfile, "w") as f:
-            f.write(volgen.build_brick_volfile(vol, b))
-        if os.path.exists(portfile):
-            os.unlink(portfile)
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        logfile = os.path.join(bdir, b["name"] + ".log")
-        with open(logfile, "ab") as logf:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "glusterfs_tpu.daemon",
-                 "--volfile", volfile, "--listen", str(port or 0),
-                 "--portfile", portfile,
-                 # serve the auth-carrying protocol/server top, not the
-                 # io-stats layer underneath it
-                 "--top", b["name"] + "-server"],
-                env=env, stdout=subprocess.DEVNULL, stderr=logf)
+        proc, bport = await self._spawn_daemon(
+            os.path.join(bdir, b["name"] + ".vol"),
+            volgen.build_brick_volfile(vol, b),
+            os.path.join(bdir, b["name"] + ".port"),
+            os.path.join(bdir, b["name"] + ".log"),
+            # serve the auth-carrying protocol/server top, not the
+            # io-stats layer underneath it
+            b["name"] + "-server", port=port,
+            what=f"brick {b['name']}")
         self.bricks[b["name"]] = proc
-        # generous: a cold interpreter+jax import on a loaded host can
-        # take the better part of a minute
-        deadline = time.time() + 90
-        while time.time() < deadline:
-            if os.path.exists(portfile):
-                with open(portfile) as f:
-                    self.ports[b["name"]] = int(f.read())
-                b["port"] = self.ports[b["name"]]
-                self._save()
-                return
-            if proc.poll() is not None:
-                with open(logfile, "rb") as f:
-                    err = f.read().decode(errors="replace")[-2000:]
-                raise MgmtError(f"brick {b['name']} failed: {err}")
-            await asyncio.sleep(0.05)
-        # kill the straggler (terminate -> wait -> kill escalation): an
-        # orphan that binds its port AFTER we give up would serve a
-        # brick glusterd no longer tracks
-        self._kill_brick(b["name"])
-        raise MgmtError(f"brick {b['name']} did not start in time")
+        self.ports[b["name"]] = bport
+        b["port"] = bport
+        self._save()
 
     def _kill_brick(self, name: str) -> None:
         proc = self.bricks.pop(name, None)

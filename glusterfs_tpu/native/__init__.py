@@ -18,7 +18,9 @@ import threading
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "src", "gf256_kernels.cpp")
+_SRCS = sorted(
+    os.path.join(_DIR, "src", f)
+    for f in os.listdir(os.path.join(_DIR, "src")) if f.endswith(".cpp"))
 _LOCK = threading.Lock()
 _LIB: ctypes.CDLL | None = None
 _BUILD_ERROR: str | None = None
@@ -29,15 +31,18 @@ CHUNK = WORD * BITS
 
 
 def _build() -> str:
-    with open(_SRC, "rb") as f:
-        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    h = hashlib.sha256()
+    for src in _SRCS:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()[:16]
     so = os.path.join(_DIR, f"libgf256_{tag}.so")
     if os.path.exists(so):
         return so
     tmp = f"{so}.{os.getpid()}.tmp"  # pid-unique: concurrent builds race
     cmd = [
         "g++", "-O3", "-mavx2", "-funroll-loops", "-fPIC", "-shared",
-        "-std=c++17", _SRC, "-o", tmp,
+        "-std=c++17", *_SRCS, "-o", tmp,
     ]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, so)
@@ -62,6 +67,9 @@ def _lib() -> ctypes.CDLL:
         lib.gf_encode.argtypes = [
             u8p, u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_size_t]
         lib.gf_decode.argtypes = [u8p, u8p, u8p, ctypes.c_int, ctypes.c_size_t]
+        lib.adler32_batch.argtypes = [
+            u8p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32)]
         _LIB = lib
         return lib
 
@@ -106,6 +114,18 @@ def decode(frags: np.ndarray, k: int, bbits: np.ndarray) -> np.ndarray:
     bbits = np.ascontiguousarray(bbits, dtype=np.uint8)
     out = np.empty(s * k * CHUNK, dtype=np.uint8)
     _lib().gf_decode(_u8p(frags), _u8p(out), _u8p(bbits), k, s)
+    return out
+
+
+def adler32_batch(blocks: np.ndarray) -> np.ndarray:
+    """[n, b] uint8 -> [n] uint32 zlib-compatible adler32 (the batched
+    weak-checksum rung of the rchecksum backend ladder)."""
+    blocks = np.ascontiguousarray(blocks, dtype=np.uint8)
+    n, b = blocks.shape
+    out = np.empty(n, dtype=np.uint32)
+    _lib().adler32_batch(_u8p(blocks), n, b,
+                         out.ctypes.data_as(
+                             ctypes.POINTER(ctypes.c_uint32)))
     return out
 
 

@@ -78,6 +78,35 @@ def adler32_batch_jax(blocks):
     return jitted(blocks)
 
 
+def adler32_batch(blocks: np.ndarray, backend: str = "auto"):
+    """Backend ladder for the batched weak checksum — the
+    disperse.cpu-extensions dispatch pattern applied to the rchecksum
+    workload: TPU (jax) when a device is live, native C++ (AVX2
+    auto-vectorized) when the toolchain built, NumPy always.
+    Returns [n] uint32."""
+    if backend in ("auto", "jax", "tpu"):
+        try:
+            import jax
+
+            if backend != "auto" or any(
+                    d.platform in ("tpu", "axon")
+                    for d in jax.devices()):
+                import jax.numpy as jnp
+
+                return np.asarray(adler32_batch_jax(jnp.asarray(blocks)))
+        except Exception:
+            if backend != "auto":
+                raise
+    if backend in ("auto", "native"):
+        from .. import native
+
+        if native.available():
+            return native.adler32_batch(blocks)
+        if backend == "native":
+            raise RuntimeError("native checksum backend unavailable")
+    return adler32_batch_np(blocks)
+
+
 def rchecksum(data: bytes) -> dict:
     """One block's weak+strong checksum (the posix rchecksum fop
     payload)."""

@@ -261,7 +261,17 @@ class BrickServer:
         name = graph.top.name
         if name == self.top.name or name in self.attached:
             raise FopError(17, f"brick {name!r} already served")  # EEXIST
-        await graph.activate()
+        try:
+            await graph.activate()
+        except BaseException:
+            # activate inits bottom-up: layers below the failing one
+            # are live (fds, background tasks) — fini them or every
+            # retried attach leaks another set
+            try:
+                await graph.fini()
+            except Exception:
+                pass
+            raise
         self._wire_upcall(graph.top)
         self.attached[name] = (graph.top, graph)
         log.info(8, "attached brick %s (now %d on this port)", name,
@@ -434,16 +444,22 @@ class BrickServer:
             if fop_name == "__ping__":
                 return wire.MT_REPLY, "pong"
             if fop_name == "__attach__":
-                # brick-mux ATTACH (glusterfsd-mgmt.c:913): mgmt-only
-                if not conn.is_mgmt:
-                    raise FopError(13, "attach is a mgmt operation")
+                # brick-mux ATTACH (glusterfsd-mgmt.c:913): only the
+                # ANCHOR graph's mgmt pair authorizes it — a volume's
+                # own mgmt credential must stay scoped to that volume's
+                # graph (reconfigure/statedump), never arbitrary-graph
+                # execution or another volume's detach
+                if not (conn.is_mgmt and conn.top is self.top):
+                    raise FopError(13, "attach needs the anchor "
+                                   "mgmt credential")
                 name = await self.attach(args[0],
                                          args[1] if len(args) > 1
                                          else None)
                 return wire.MT_REPLY, {"ok": True, "attached": name}
             if fop_name == "__detach__":
-                if not conn.is_mgmt:
-                    raise FopError(13, "detach is a mgmt operation")
+                if not (conn.is_mgmt and conn.top is self.top):
+                    raise FopError(13, "detach needs the anchor "
+                                   "mgmt credential")
                 ok = await self.detach(args[0])
                 return wire.MT_REPLY, {"ok": ok}
             if fop_name == "__statedump__":

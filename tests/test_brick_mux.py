@@ -72,6 +72,50 @@ def test_brick_mux_lifecycle(tmp_path):
 
 
 @pytest.mark.slow
+def test_attach_requires_anchor_credential(tmp_path):
+    """A volume's own mgmt credential must NOT authorize __attach__ /
+    __detach__ — only the anchor graph's pair may manage the shared
+    daemon's graph set (privilege scoping)."""
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="pv",
+                             vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "pb0")}])
+                await c.call("volume-set", name="pv",
+                             key="cluster.brick-multiplex", value="on")
+                await c.call("volume-start", name="pv")
+            vol = d.state["volumes"]["pv"]
+            port = d.ports["pv-brick-0"]
+            evil = (f"volume evil-posix\n    type storage/posix\n"
+                    f"    option directory {tmp_path}\nend-volume\n"
+                    f"volume evil-server\n    type protocol/server\n"
+                    f"    subvolumes evil-posix\nend-volume\n")
+            # volume creds, routed to the volume's own graph: refused
+            out = await d._brick_call(vol, port, "__attach__",
+                                      [evil, "evil-server"],
+                                      subvol="pv-brick-0-server")
+            assert out is None, f"attach must be refused: {out}"
+            out = await d._brick_call(vol, port, "__detach__",
+                                      ["pv-brick-0-server"],
+                                      subvol="pv-brick-0-server")
+            assert out is None, f"detach must be refused: {out}"
+            # the anchor credential still works (detach + re-attach)
+            st = await d._brick_call(d._mux_auth_vol(), port,
+                                     "__detach__", ["pv-brick-0-server"])
+            assert st and st.get("ok")
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-stop", name="pv")
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
 def test_brick_mux_reconfigure_and_statedump(tmp_path):
     """Per-brick mgmt calls (statedump / live reconfigure) route to the
     right graph inside the shared daemon."""

@@ -31,6 +31,31 @@ def test_adler32_batch_jax_parity():
             assert got[i] == zlib.adler32(blocks[i].tobytes()), b
 
 
+def test_adler32_batch_native_parity():
+    from glusterfs_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(2)
+    for b in (512, 4096, 65536):
+        blocks = rng.integers(0, 256, (8, b), dtype=np.uint8)
+        got = native.adler32_batch(blocks)
+        for i in range(8):
+            assert got[i] == zlib.adler32(blocks[i].tobytes()), b
+
+
+def test_adler32_ladder_dispatch():
+    rng = np.random.default_rng(3)
+    blocks = rng.integers(0, 256, (4, 1024), dtype=np.uint8)
+    want = [zlib.adler32(blocks[i].tobytes()) for i in range(4)]
+    for backend in ("auto", "native", "numpy"):
+        try:
+            got = ck.adler32_batch(blocks, backend)
+        except RuntimeError:
+            continue  # rung unavailable in this environment
+        assert list(got) == want, backend
+
+
 def test_posix_rchecksum_fop(tmp_path):
     from glusterfs_tpu.api.glfs import Client
     from glusterfs_tpu.core.graph import Graph
