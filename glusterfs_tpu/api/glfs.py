@@ -40,6 +40,27 @@ def _split(path: str) -> tuple[str, str]:
     return (parent or "/"), name
 
 
+async def _drain_graph(graph: Graph, timeout: float = 10.0) -> None:
+    """Wait until the graph's transports have no in-flight RPCs for a
+    few consecutive ticks — multi-RPC fops mid-flight get scheduler
+    turns to issue their next call before the graph is retired."""
+    from ..protocol.client import ClientLayer
+
+    clients = [l for l in graph.by_name.values()
+               if isinstance(l, ClientLayer)]
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    streak = 0
+    while loop.time() < deadline:
+        if any(l._pending for l in clients):
+            streak = 0
+        else:
+            streak += 1
+            if streak >= 3:
+                return
+        await asyncio.sleep(0.05)
+
+
 async def wait_connected(graph: Graph, timeout: float = 15.0) -> bool:
     """Poll until every protocol/client layer in the graph has finished
     its handshake (the reference blocks the mount until CHILD_UP reaches
@@ -144,7 +165,14 @@ class Client:
             # (shielded — the fini must run even though we were cancelled)
             await asyncio.shield(new.fini())
             raise
-        await old.fini()
+        try:
+            # fops that entered through the OLD graph must complete
+            # before it is torn down — fini would unwind their in-flight
+            # RPCs as spurious ENOTCONN (the reference drains old graphs
+            # by refcount before cleanup, graph.c)
+            await _drain_graph(old)
+        finally:
+            await asyncio.shield(old.fini())
         return "swapped"
 
     # -- resolution --------------------------------------------------------

@@ -68,6 +68,11 @@ class DistributeLayer(Layer):
         Option("lookup-unhashed", "bool", default="on",
                description="fan-out lookup on hashed-subvol miss"),
         Option("min-free-disk", "percent", default=10.0),
+        Option("decommissioned", "str", default="",
+               description="comma-separated child names leaving the "
+               "volume (remove-brick start): excluded from the layout "
+               "so no NEW data lands on them while rebalance drains "
+               "them (dht decommission_node_map)"),
     )
 
     def __init__(self, *args, **kw):
@@ -75,14 +80,28 @@ class DistributeLayer(Layer):
         self.n = len(self.children)
         if self.n < 1:
             raise ValueError(f"{self.name}: needs >= 1 child")
+        self._recompute_active()
+
+    def _recompute_active(self) -> None:
+        gone = {s.strip() for s in
+                self.opts["decommissioned"].split(",") if s.strip()}
+        self._active = [i for i, c in enumerate(self.children)
+                        if c.name not in gone]
+        if not self._active:
+            raise ValueError(f"{self.name}: every child decommissioned")
+
+    def reconfigure(self, options: dict) -> None:
+        super().reconfigure(options)
+        self._recompute_active()
 
     # -- placement ---------------------------------------------------------
 
     def hashed_idx(self, name: str) -> int:
-        """Even split of the 2^32 hash space over children
-        (dht_layout_t ranges)."""
-        span = (1 << 32) // self.n
-        return min(dm_hash(name) // span, self.n - 1)
+        """Even split of the 2^32 hash space over the ACTIVE children
+        (dht_layout_t ranges; decommissioned nodes hold no range)."""
+        span = (1 << 32) // len(self._active)
+        return self._active[min(dm_hash(name) // span,
+                                len(self._active) - 1)]
 
     def _hashed(self, loc: Loc) -> int:
         return self.hashed_idx(loc.name or loc.path.rsplit("/", 1)[-1])
@@ -287,55 +306,63 @@ class DistributeLayer(Layer):
 
     # -- data fops (forward to cached subvol) ------------------------------
 
-    def _fd_target(self, fd: FdObj) -> tuple[int, FdObj]:
+    async def _fd_target(self, fd: FdObj) -> tuple[int, FdObj]:
         ctx: DhtFdCtx | None = fd.ctx_get(self)
-        if ctx is None:
+        if ctx is not None:
+            return ctx.idx, ctx.child_fd
+        # fd from a retired graph (hot graph swap) or anonymous: resolve
+        # the cached subvol again and address by gfid (the reference
+        # migrates fds onto the new graph; anonymous fds carry it here)
+        if not fd.path and not fd.gfid:
             raise FopError(errno.EBADF, "dht: unknown fd")
-        return ctx.idx, ctx.child_fd
+        idx = await self._cached_idx(Loc(fd.path, gfid=fd.gfid))
+        cfd = FdObj(fd.gfid, fd.flags, path=fd.path, anonymous=True)
+        fd.ctx_set(self, DhtFdCtx(idx, cfd))
+        return idx, cfd
 
     async def readv(self, fd: FdObj, size: int, offset: int,
                     xdata: dict | None = None):
-        i, cfd = self._fd_target(fd)
+        i, cfd = await self._fd_target(fd)
         return await self.children[i].readv(cfd, size, offset, xdata)
 
     async def writev(self, fd: FdObj, data, offset: int,
                      xdata: dict | None = None):
-        i, cfd = self._fd_target(fd)
+        i, cfd = await self._fd_target(fd)
         return await self.children[i].writev(cfd, data, offset, xdata)
 
     async def flush(self, fd: FdObj, xdata: dict | None = None):
-        i, cfd = self._fd_target(fd)
+        i, cfd = await self._fd_target(fd)
         return await self.children[i].flush(cfd, xdata)
 
     async def fsync(self, fd: FdObj, datasync: int = 0,
                     xdata: dict | None = None):
-        i, cfd = self._fd_target(fd)
+        i, cfd = await self._fd_target(fd)
         return await self.children[i].fsync(cfd, datasync, xdata)
 
     async def ftruncate(self, fd: FdObj, size: int,
                         xdata: dict | None = None):
-        i, cfd = self._fd_target(fd)
+        i, cfd = await self._fd_target(fd)
         return await self.children[i].ftruncate(cfd, size, xdata)
 
     async def fallocate(self, fd: FdObj, mode: int, offset: int,
                         length: int, xdata: dict | None = None):
-        i, cfd = self._fd_target(fd)
+        i, cfd = await self._fd_target(fd)
         return await self.children[i].fallocate(cfd, mode, offset, length,
                                                 xdata)
 
     async def discard(self, fd: FdObj, offset: int, length: int,
                       xdata: dict | None = None):
-        i, cfd = self._fd_target(fd)
+        i, cfd = await self._fd_target(fd)
         return await self.children[i].discard(cfd, offset, length, xdata)
 
     async def zerofill(self, fd: FdObj, offset: int, length: int,
                        xdata: dict | None = None):
-        i, cfd = self._fd_target(fd)
+        i, cfd = await self._fd_target(fd)
         return await self.children[i].zerofill(cfd, offset, length, xdata)
 
     async def seek(self, fd: FdObj, offset: int, what: str = "data",
                    xdata: dict | None = None):
-        i, cfd = self._fd_target(fd)
+        i, cfd = await self._fd_target(fd)
         return await self.children[i].seek(cfd, offset, what, xdata)
 
     async def release(self, fd: FdObj):
@@ -502,8 +529,10 @@ class DistributeLayer(Layer):
         return {"moved": moved, "scanned": scanned}
 
     def dump_private(self) -> dict:
+        span = (1 << 32) // len(self._active)
+        ranges = {idx: [j * span, (j + 1) * span - 1]
+                  for j, idx in enumerate(self._active)}
         return {"subvolumes": self.n,
                 "layout": [{"subvol": c.name,
-                            "range": [i * ((1 << 32) // self.n),
-                                      (i + 1) * ((1 << 32) // self.n) - 1]}
+                            "range": ranges.get(i, "decommissioned")}
                            for i, c in enumerate(self.children)]}

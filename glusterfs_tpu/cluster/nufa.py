@@ -40,4 +40,6 @@ class NufaLayer(DistributeLayer):
                     f"{self.name}: no child named {want!r}")
 
     def sched_idx(self, loc: Loc) -> int:
-        return self._local
+        if self._local in self._active:
+            return self._local
+        return self._hashed(loc)  # local brick is being removed

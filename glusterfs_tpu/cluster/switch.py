@@ -50,8 +50,9 @@ class SwitchLayer(DistributeLayer):
     def sched_idx(self, loc: Loc) -> int:
         name = loc.name or loc.path.rsplit("/", 1)[-1]
         for pat, idxs in self._rules:
-            if fnmatch.fnmatch(name, pat):
+            live = [i for i in idxs if i in self._active]
+            if live and fnmatch.fnmatch(name, pat):
                 # hash WITHIN the matched set so multi-subvol rules
                 # still spread load (switch_local scheduling)
-                return idxs[dm_hash(name) % len(idxs)]
+                return live[dm_hash(name) % len(live)]
         return self._hashed(loc)

@@ -230,6 +230,30 @@ async def _run(args) -> Any:
                 kw.update(path=args.args[1])
             async with MgmtClient(host, port) as c:
                 return await c.call("volume-quota", **kw)
+        if sub == "add-brick":
+            bricks = [{"path": b.split(":", 1)[-1], "host": "127.0.0.1"}
+                      for b in args.args]
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-add-brick", name=args.name,
+                                    bricks=bricks)
+        if sub == "remove-brick":
+            # volume remove-brick NAME BRICK... start|status|commit|force
+            action = args.args[-1] if args.args and args.args[-1] in (
+                "start", "status", "commit", "force") else "start"
+            named = [a for a in args.args
+                     if a not in ("start", "status", "commit", "force")]
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-remove-brick",
+                                    name=args.name, bricks=named,
+                                    action=action)
+        if sub == "replace-brick":
+            if len(args.args) < 2:
+                raise SystemExit("usage: volume replace-brick NAME "
+                                 "BRICK NEWPATH [commit force]")
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-replace-brick",
+                                    name=args.name, brick=args.args[0],
+                                    new_path=args.args[1])
         if sub == "bitrot":
             action = args.args[0] if args.args else "status"
             async with MgmtClient(host, port) as c:
@@ -319,7 +343,8 @@ def main(argv=None) -> int:
     vol.add_argument("sub", choices=["create", "start", "stop", "delete",
                                      "info", "status", "set", "heal",
                                      "rebalance", "profile", "quota",
-                                     "bitrot"])
+                                     "bitrot", "add-brick",
+                                     "remove-brick", "replace-brick"])
     vol.add_argument("name", nargs="?", default="")
     vol.add_argument("args", nargs="*")
 

@@ -80,6 +80,10 @@ class Glusterd:
         # daemon per node serving every brick-multiplex'd brick
         self._mux: dict | None = None  # {proc, port, bricks:set}
         self._mux_lock = asyncio.Lock()
+        # strong refs to fire-and-forget work (drain, post-replace
+        # heal): the loop keeps only weak refs, and a GC'd drain task
+        # would strand remove-brick in status "started" forever
+        self._bg_tasks: set[asyncio.Task] = set()
 
     # -- store (glusterd-store.c analog) -----------------------------------
 
@@ -256,6 +260,12 @@ class Glusterd:
 
     def op_peer_ping(self) -> dict:
         return {"ok": True, "uuid": self.uuid}
+
+    def _spawn_task(self, coro) -> asyncio.Task:
+        t = asyncio.create_task(coro)
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+        return t
 
     # -- server quorum (glusterd-server-quorum.c) --------------------------
     # cluster.server-quorum-type=server volumes have their local bricks
@@ -796,6 +806,10 @@ class Glusterd:
             if action == "info":
                 return await shd_mod.gather_heal_info(client)
             if action == "full":
+                # full namespace sweep (ec_shd_full_sweep): also heals
+                # bricks with no index record (replaced/wiped)
+                return await shd_mod.full_crawl(client)
+            if action == "index":
                 return await shd_mod.crawl_once(client)
             if action == "file":
                 if not path:
@@ -833,6 +847,230 @@ class Glusterd:
             await self._spawn_brick(vol, b, port=b.get("port"))
             return {"started": brick, "port": self.ports.get(brick, 0)}
         raise MgmtError(f"unknown brick action {action!r}")
+
+    # -- brick ops: add / remove / replace (glusterd-brick-ops.c,
+    # glusterd-replace-brick.c) --------------------------------------------
+
+    def _parse_new_bricks(self, vol: dict, bricks: list) -> list[dict]:
+        start = 1 + max((b["index"] for b in vol["bricks"]), default=-1)
+        parsed = []
+        for i, b in enumerate(bricks):
+            if isinstance(b, str):
+                nodeid, _, path = b.partition(":")
+                b = {"node": nodeid, "path": path}
+            idx = start + i
+            parsed.append({
+                "index": idx, "node": b.get("node", self.uuid),
+                "host": b.get("host", "127.0.0.1"), "path": b["path"],
+                "name": f"{vol['name']}-brick-{idx}",
+            })
+        return parsed
+
+    def _group_size(self, vol: dict) -> int:
+        return vol.get("group-size") or len(vol["bricks"])
+
+    async def op_volume_add_brick(self, name: str, bricks: list) -> dict:
+        """``volume add-brick`` — grow the volume.  disperse/replicate
+        volumes grow by whole groups (the volume becomes / stays
+        distributed-X); plain distribute grows brick by brick."""
+        vol = self._vol(name)
+        if not bricks:
+            raise MgmtError("add-brick needs bricks")
+        group_size = 0
+        if vol["type"] in ("disperse", "replicate"):
+            group_size = self._group_size(vol)
+            if len(bricks) % group_size:
+                raise MgmtError(
+                    f"add-brick on a {vol['type']} volume needs a "
+                    f"multiple of {group_size} bricks (whole groups)")
+        parsed = self._parse_new_bricks(vol, bricks)
+        results = await self._cluster_txn(
+            "add-brick", {"name": name, "bricks": parsed,
+                          "group_size": group_size})
+        if vol["status"] == "started":
+            ports: dict[str, int] = {}
+            for r in results:
+                ports.update(r.get("result", {}).get("ports", {}))
+            for node in self._all_nodes():
+                try:
+                    await self._node_call(node, "portmap-update",
+                                          name=name, ports=ports)
+                except Exception:
+                    pass
+        return {"ok": True, "added": [b["name"] for b in parsed]}
+
+    def stage_add_brick(self, name: str, bricks: list,
+                        group_size: int = 0) -> None:
+        vol = self._vol(name)
+        have = {b["name"] for b in vol["bricks"]}
+        if any(b["name"] in have for b in bricks):
+            raise MgmtError("brick name collision")
+
+    async def commit_add_brick(self, name: str, bricks: list,
+                               group_size: int = 0) -> dict:
+        vol = self._vol(name)
+        if group_size and "group-size" not in vol:
+            # first growth of a single-group volume fixes the group
+            # size so volgen starts emitting the dht aggregate
+            vol["group-size"] = group_size
+        vol["bricks"].extend(bricks)
+        self._save()
+        if vol["status"] == "started":
+            for b in bricks:
+                if b["node"] == self.uuid:
+                    await self._spawn_brick(vol, b)
+            self._notify_subscribers(name)  # topology change: graph swap
+        gf_event("VOLUME_ADD_BRICK", name=name,
+                 bricks=[b["name"] for b in bricks])
+        return {"added": [b["name"] for b in bricks],
+                "ports": {b["name"]: self.ports[b["name"]]
+                          for b in bricks
+                          if b["name"] in self.ports}}
+
+    async def op_volume_remove_brick(self, name: str, bricks: list,
+                                     action: str = "start") -> dict:
+        """``volume remove-brick start|status|commit`` — shrink the
+        volume: start excludes the leaving bricks from the dht layout
+        and drains their data (decommission rebalance,
+        dht-rebalance.c); commit drops them once drained."""
+        vol = self._vol(name)
+        rb = vol.get("remove-brick") or {}
+        if action == "status":
+            return dict(rb) or {"status": "not-started"}
+        if action == "start":
+            leaving = set(bricks or ())
+            have = {b["name"] for b in vol["bricks"]}
+            if not leaving or not leaving <= have:
+                raise MgmtError(f"unknown bricks {sorted(leaving - have)}")
+            if len(leaving) >= len(have):
+                raise MgmtError("cannot remove every brick")
+            if vol["type"] in ("disperse", "replicate"):
+                g = self._group_size(vol)
+                if len(leaving) % g:
+                    raise MgmtError(
+                        f"remove-brick on a {vol['type']} volume "
+                        f"drains whole groups of {g}")
+                ordered = [b["name"] for b in vol["bricks"]]
+                for j in range(0, len(ordered), g):
+                    grp = set(ordered[j:j + g])
+                    if grp & leaving and not grp <= leaving:
+                        raise MgmtError("partial group in remove-brick")
+            await self._cluster_txn("remove-brick-start", {
+                "name": name, "bricks": sorted(leaving)})
+            # drain asynchronously (the reference's rebalance process);
+            # status flips to completed when the migration finishes
+            self._spawn_task(self._drain_bricks(name))
+            return {"ok": True, "status": "started"}
+        if action in ("commit", "force"):
+            if not rb:
+                raise MgmtError("no remove-brick in progress")
+            if rb.get("status") != "completed" and action != "force":
+                raise MgmtError(
+                    f"migration {rb.get('status')!r}; wait or use force")
+            await self._cluster_txn("remove-brick-commit",
+                                    {"name": name})
+            return {"ok": True, "removed": rb.get("bricks", [])}
+        raise MgmtError(f"unknown remove-brick action {action!r}")
+
+    def commit_remove_brick_start(self, name: str,
+                                  bricks: list) -> dict:
+        vol = self._vol(name)
+        vol["remove-brick"] = {"status": "started", "bricks": bricks}
+        self._save()
+        if vol["status"] == "started":
+            self._notify_subscribers(name)  # layout excludes leavers
+        return {"draining": bricks}
+
+    async def _drain_bricks(self, name: str) -> None:
+        """Migrate data off the leaving bricks (decommission walk)."""
+        vol = self._vol(name)
+        rb = vol.get("remove-brick") or {}
+        try:
+            if vol["status"] == "started":
+                from ..cluster.dht import DistributeLayer
+
+                client = await mount_volume(self.host, self.port, name)
+                try:
+                    dht = next(
+                        (l for l in client.graph.by_name.values()
+                         if isinstance(l, DistributeLayer)), None)
+                    out = await dht.rebalance("/") if dht else {}
+                finally:
+                    await client.unmount()
+                rb["moved"] = len(out.get("moved", ()))
+                rb["scanned"] = out.get("scanned", 0)
+            rb["status"] = "completed"
+        except Exception as e:
+            rb["status"] = "failed"
+            rb["error"] = repr(e)[:300]
+            log.error(21, "remove-brick drain of %s failed: %r", name, e)
+        self._save()
+
+    async def commit_remove_brick_commit(self, name: str) -> dict:
+        vol = self._vol(name)
+        rb = vol.pop("remove-brick", None) or {}
+        leaving = set(rb.get("bricks") or ())
+        keep, gone = [], []
+        for b in vol["bricks"]:
+            (gone if b["name"] in leaving else keep).append(b)
+        vol["bricks"] = keep
+        self._save()
+        for b in gone:
+            if b["node"] == self.uuid:
+                await self._stop_brick(vol, b)
+        if vol["status"] == "started":
+            self._notify_subscribers(name)
+        gf_event("VOLUME_REMOVE_BRICK", name=name,
+                 bricks=sorted(leaving))
+        return {"removed": sorted(leaving)}
+
+    async def op_volume_replace_brick(self, name: str, brick: str,
+                                      new_path: str) -> dict:
+        """``volume replace-brick ... commit force`` — swap a brick for
+        an empty one; the self-heal daemon rebuilds its content from
+        the surviving replicas/fragments (glusterd-replace-brick.c +
+        full heal)."""
+        vol = self._vol(name)
+        if vol["type"] not in ("replicate", "disperse"):
+            raise MgmtError("replace-brick needs a replicate or "
+                            "disperse volume (distribute would lose "
+                            "that brick's data)")
+        if not any(b["name"] == brick for b in vol["bricks"]):
+            raise MgmtError(f"no brick {brick!r} in {name}")
+        await self._cluster_txn("replace-brick", {
+            "name": name, "brick": brick, "new_path": new_path})
+        # rebuild the empty brick NOW (the reference triggers a full
+        # self-heal on replace); shd's periodic crawl also covers it
+        if vol["status"] == "started":
+            self._spawn_task(self._heal_full(name))
+        return {"ok": True, "replaced": brick, "path": new_path}
+
+    async def commit_replace_brick(self, name: str, brick: str,
+                                   new_path: str) -> dict:
+        vol = self._vol(name)
+        b = next(x for x in vol["bricks"] if x["name"] == brick)
+        if b["node"] == self.uuid and b["name"] in self.bricks:
+            await self._stop_brick(vol, b)
+        b["path"] = new_path
+        b.pop("port", None)
+        self._save()
+        if vol["status"] == "started" and b["node"] == self.uuid:
+            await self._spawn_brick(vol, b)
+            self._notify_subscribers(name)
+        gf_event("VOLUME_REPLACE_BRICK", name=name, brick=brick)
+        return {"replaced": brick}
+
+    async def _heal_full(self, name: str) -> None:
+        try:
+            from . import shd as shd_mod
+
+            client = await mount_volume(self.host, self.port, name)
+            try:
+                await shd_mod.full_crawl(client)
+            finally:
+                await client.unmount()
+        except Exception as e:
+            log.warning(22, "post-replace heal of %s: %r", name, e)
 
     def _snap_volinfo_by_name(self, volname: str) -> dict | None:
         for s in self.state.get("snaps", {}).values():

@@ -71,6 +71,60 @@ async def _resolve(layer, gfid: bytes) -> str | None:
     return None
 
 
+async def full_crawl(client) -> dict:
+    """``heal full``: walk the whole namespace and heal every entry —
+    the reference's full sweep (ec-heald.c:418 ec_shd_full_sweep /
+    afr full crawl).  Unlike the index sweep, this repairs bricks with
+    NO pending record — a replaced (empty) brick, a wiped backend —
+    because heal_info re-derives good/bad from the live lookups."""
+    from ..cluster.dht import DistributeLayer
+
+    report = {"healed": [], "skipped": [], "failed": []}
+    layers = _heal_layers(client.graph)
+    # distributed-X: a file lives in exactly ONE group — route its heal
+    # to the owning group layer, or every group wastes a fan-out and
+    # reports spurious failures for files it does not hold
+    dht = next((l for l in client.graph.by_name.values()
+                if isinstance(l, DistributeLayer)), None)
+
+    async def owners(path: str) -> list:
+        if dht is None or not all(l in dht.children for l in layers):
+            return layers
+        try:
+            child = dht.children[await dht._cached_idx(Loc(path))]
+        except FopError:
+            return layers
+        return [child] if child in layers else layers
+
+    async def one(layer, path: str, is_dir: bool) -> None:
+        try:
+            if is_dir:
+                if callable(getattr(layer, "heal_entry", None)):
+                    await layer.heal_entry(path)
+                return
+            res = await layer.heal_file(path)
+        except FopError as e:
+            report["failed"].append({"path": path, "error": str(e)})
+            return
+        key = "skipped" if res.get("skipped") else "healed"
+        report[key].append({"path": path,
+                            "bricks": res.get("healed", [])})
+
+    async def walk(path: str) -> None:
+        for layer in layers:  # directories exist in every group
+            await one(layer, path, True)
+        for name, ia in await client.listdir_with_stat(path):
+            child = path.rstrip("/") + "/" + name
+            if ia is not None and ia.is_dir():
+                await walk(child)
+            else:
+                for layer in await owners(child):
+                    await one(layer, child, False)
+
+    await walk("/")
+    return report
+
+
 async def crawl_once(client) -> dict:
     """One full index sweep; returns a heal report."""
     report = {"healed": [], "skipped": [], "failed": [], "pruned": []}

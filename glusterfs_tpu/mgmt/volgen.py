@@ -335,10 +335,23 @@ def build_client_volfile(volinfo: dict,
             return "cluster/switch"
         return "cluster/distribute"
 
+    def _leaving() -> set:
+        """Brick names being drained by remove-brick start (excluded
+        from the dht layout until commit)."""
+        rb = volinfo.get("remove-brick") or {}
+        if rb.get("status") in ("started", "completed"):
+            return set(rb.get("bricks") or ())
+        return set()
+
     if vtype == "distribute":
         dtype = _dht_type(volinfo)
         opts = layer_options(volinfo, "cluster/distribute")
         opts.update(layer_options(volinfo, dtype))
+        leaving = _leaving()
+        if leaving:
+            opts["decommissioned"] = ",".join(
+                f"{volinfo['name']}-client-{b['index']}"
+                for b in bricks if b["name"] in leaving)
         top = f"{volinfo['name']}-dht"
         out.append(_emit(top, dtype, opts, names))
     elif vtype in ("disperse", "replicate"):
@@ -352,6 +365,16 @@ def build_client_volfile(volinfo: dict,
             dtype = _dht_type(volinfo)  # nufa/switch apply here too
             dopts = layer_options(volinfo, "cluster/distribute")
             dopts.update(layer_options(volinfo, dtype))
+            leaving = _leaving()
+            if leaving:
+                # remove-brick drains whole groups: a group layer is
+                # decommissioned when every brick in it is leaving
+                gone = []
+                for j in range(0, len(bricks), group):
+                    if all(b["name"] in leaving
+                           for b in bricks[j:j + group]):
+                        gone.append(subs[j // group])
+                dopts["decommissioned"] = ",".join(gone)
             out.append(_emit(top, dtype, dopts, subs))
         else:
             top = cluster_over(names)

@@ -8,7 +8,7 @@
 //   B = blen + sum((blen - i) * d_i)   (mod 65521)
 // which lets the whole block reduce with multiply-accumulate loops
 // instead of the serial a+=d; b+=a; recurrence.  64-bit accumulators
-// hold exactly for blocks up to ~256 MiB (65536^2 * 255 < 2^63).
+// hold exactly while blen^2 * 255 < 2^63, i.e. blocks up to ~180 MiB.
 
 #include <cstddef>
 #include <cstdint>
@@ -33,13 +33,6 @@ void adler32_batch(const uint8_t* blocks, size_t n, size_t blen,
         uint64_t bb = (blen + s2) % MOD;
         out[b] = static_cast<uint32_t>((bb << 16) | a);
     }
-}
-
-// Single-buffer form for the rchecksum fop payload.
-uint32_t adler32_one(const uint8_t* data, size_t len) {
-    uint32_t out;
-    adler32_batch(data, 1, len, &out);
-    return out;
 }
 
 }  // extern "C"

@@ -196,13 +196,23 @@ class ClientLayer(Layer):
         timeout = self.opts["ping-timeout"]
         try:
             while self.connected:
+                t0 = loop.time()
                 await asyncio.sleep(interval)
+                # a LOCAL event-loop stall (host overload, long compile)
+                # silences our own ping clock — don't blame the peer
+                # for it (rpc-clnt-ping only counts time the transport
+                # was actually serviced)
+                stalled = loop.time() - t0 > 3 * interval
                 try:
                     await asyncio.wait_for(
                         self._call("__ping__", (), {}), interval)
                     self._last_pong = loop.time()
                 except (FopError, asyncio.TimeoutError):
                     pass
+                if stalled:
+                    self._last_pong = max(self._last_pong,
+                                          loop.time() - interval)
+                    continue
                 if loop.time() - self._last_pong > timeout:
                     log.warning(6, "%s: ping timeout (%.1fs)", self.name,
                                 timeout)
