@@ -231,7 +231,9 @@ async def _run(args) -> Any:
             async with MgmtClient(host, port) as c:
                 return await c.call("volume-quota", **kw)
         if sub == "add-brick":
-            bricks = [{"path": b.split(":", 1)[-1], "host": "127.0.0.1"}
+            # raw "node:path" (or bare path) strings: glusterd's
+            # _parse_new_bricks resolves the node part
+            bricks = [b if ":" in b else {"path": b, "host": "127.0.0.1"}
                       for b in args.args]
             async with MgmtClient(host, port) as c:
                 return await c.call("volume-add-brick", name=args.name,

@@ -938,6 +938,15 @@ class Glusterd:
         if action == "status":
             return dict(rb) or {"status": "not-started"}
         if action == "start":
+            if vol["status"] != "started":
+                # the drain migrates THROUGH a mounted client; on a
+                # stopped volume it would no-op "completed" and a
+                # later commit would silently drop un-drained data
+                raise MgmtError("volume must be started to drain "
+                                "bricks (remove-brick start)")
+            if rb.get("status") == "started":
+                raise MgmtError("a remove-brick is already in "
+                                "progress; commit or wait first")
             leaving = set(bricks or ())
             have = {b["name"] for b in vol["bricks"]}
             if not leaving or not leaving <= have:
@@ -1037,11 +1046,22 @@ class Glusterd:
                             "that brick's data)")
         if not any(b["name"] == brick for b in vol["bricks"]):
             raise MgmtError(f"no brick {brick!r} in {name}")
-        await self._cluster_txn("replace-brick", {
+        results = await self._cluster_txn("replace-brick", {
             "name": name, "brick": brick, "new_path": new_path})
-        # rebuild the empty brick NOW (the reference triggers a full
-        # self-heal on replace); shd's periodic crawl also covers it
         if vol["status"] == "started":
+            # the replacement bound a fresh port on its node: broadcast
+            # it (volume-start's pmap sync) so peers' volfiles carry it
+            ports: dict[str, int] = {}
+            for r in results:
+                ports.update(r.get("result", {}).get("ports", {}))
+            for node in self._all_nodes():
+                try:
+                    await self._node_call(node, "portmap-update",
+                                          name=name, ports=ports)
+                except Exception:
+                    pass
+            # rebuild the empty brick NOW (the reference triggers a
+            # full self-heal on replace); shd's crawl also covers it
             self._spawn_task(self._heal_full(name))
         return {"ok": True, "replaced": brick, "path": new_path}
 
@@ -1058,7 +1078,9 @@ class Glusterd:
             await self._spawn_brick(vol, b)
             self._notify_subscribers(name)
         gf_event("VOLUME_REPLACE_BRICK", name=name, brick=brick)
-        return {"replaced": brick}
+        return {"replaced": brick,
+                "ports": {brick: self.ports[brick]}
+                if brick in self.ports else {}}
 
     async def _heal_full(self, name: str) -> None:
         try:

@@ -250,7 +250,23 @@ def apply_bitmatrix(
 # (ec-method.c:393-433): fragment f = its 512-byte chunk from every stripe.
 # ---------------------------------------------------------------------------
 
-_FUSED_TS = 128  # stripes per grid step (measured best on v5e)
+_FUSED_TS = 128  # stripes per grid step (measured best on v5e, k=4)
+
+# Per-config tiles from an on-chip sweep (v5e, 64 MiB batches, quiet
+# host, best of ts in {16,32,48,64,96,128}): wide-k kernels have much
+# larger per-step working sets, so SMALLER stripe tiles pipeline
+# better — encode 8+3: 46.7 GiB/s @16 vs 38.3 @128; 16+4 encode
+# 28.2 @16 vs 20.2 @128; 16+4 decode 92.5 @32 vs 62.5 @128.
+
+
+def _enc_ts(k: int) -> int:
+    return 16 if k >= 8 else _FUSED_TS
+
+
+def _dec_ts(k: int) -> int:
+    if k >= 8:
+        return 64 if k == 8 else 32
+    return _FUSED_TS
 
 
 def _fused_encode_kernel(sels: tuple[tuple[int, ...], ...], k: int, n: int):
@@ -302,7 +318,7 @@ _MAX_SELS_PER_KERNEL = 100
 def _fused_encode_fn(k: int, n: int, interpret: bool):
     """jitted: flat stripe-major bytes (S*k*512,) -> fragments (n, S*512)."""
     sels = _sels_from_bits(gf256.expand_bitmatrix(gf256.encode_matrix(k, n)))
-    ts = _FUSED_TS
+    ts = _enc_ts(k)
     group = max(1, _MAX_SELS_PER_KERNEL // (8 * max(1, k // 8)))
     groups = [(f0, min(f0 + group, n)) for f0 in range(0, n, group)] \
         if k > 8 else [(0, n)]
@@ -344,7 +360,7 @@ def _fused_decode_fn(k: int, rows: tuple[int, ...], interpret: bool):
     One jitted decoder per surviving mask (the LRU here mirrors the
     reference's LRU of inverted matrices, ec-method.c:200-245)."""
     sels = _sels_from_bits(gf256.decode_bits_cached(k, rows))
-    ts = _FUSED_TS
+    ts = _dec_ts(k)
     group = max(1, _MAX_SELS_PER_KERNEL // (8 * max(1, k // 8)))
     groups = [(c0, min(c0 + group, k)) for c0 in range(0, k, group)] \
         if k > 8 else [(0, k)]
