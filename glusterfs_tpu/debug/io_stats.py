@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import time
 
-from ..core.layer import FdObj, Layer, register
+from ..core.layer import FdObj, Layer, Loc, register
 from ..core.options import Option
 
 
@@ -17,26 +17,96 @@ class IoStatsLayer(Layer):
     OPTIONS = (
         Option("count-fop-hits", "bool", default="on"),
         Option("latency-measurement", "bool", default="on"),
+        Option("fd-hard-limit", "int", default=2048,
+               description="max distinct paths tracked for `volume "
+                           "top` (io-stats ios_stat_list cap)"),
     )
 
     def __init__(self, *args, **kw):
+        from collections import OrderedDict
+
         super().__init__(*args, **kw)
         self.read_bytes = 0
         self.write_bytes = 0
         self.started = time.time()
         self._interval_base: dict = {}
+        # per-path counters backing `volume top` (ios_stat_head): path
+        # -> {opens, reads, writes, read_bytes, write_bytes}; LRU so
+        # eviction at the cap is O(1), not a full scan per hot fop
+        self._per_path: "OrderedDict[str, dict]" = OrderedDict()
+
+    def _path_stat(self, path: str | None) -> dict | None:
+        if not path:
+            return None
+        st = self._per_path.get(path)
+        if st is None:
+            if len(self._per_path) >= self.opts["fd-hard-limit"]:
+                # bounded like the reference's fixed-size stat list:
+                # evict the least-recently-touched path
+                self._per_path.popitem(last=False)
+            st = self._per_path[path] = {
+                "opens": 0, "reads": 0, "writes": 0,
+                "read_bytes": 0, "write_bytes": 0}
+        else:
+            self._per_path.move_to_end(path)
+        return st
+
+    async def open(self, loc: Loc, flags: int = 0,
+                   xdata: dict | None = None):
+        fd = await self.children[0].open(loc, flags, xdata)
+        st = self._path_stat(loc.path)
+        if st is not None:
+            st["opens"] += 1
+        return fd
+
+    async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
+                     xdata: dict | None = None):
+        out = await self.children[0].create(loc, flags, mode, xdata)
+        st = self._path_stat(loc.path)
+        if st is not None:
+            st["opens"] += 1
+        return out
 
     async def readv(self, fd: FdObj, size: int, offset: int,
                     xdata: dict | None = None):
         data = await self.children[0].readv(fd, size, offset, xdata)
         self.read_bytes += len(data)
+        st = self._path_stat(getattr(fd, "path", None))
+        if st is not None:
+            st["reads"] += 1
+            st["read_bytes"] += len(data)
         return data
 
     async def writev(self, fd: FdObj, data, offset: int,
                      xdata: dict | None = None):
         ret = await self.children[0].writev(fd, data, offset, xdata)
         self.write_bytes += len(data)
+        st = self._path_stat(getattr(fd, "path", None))
+        if st is not None:
+            st["writes"] += 1
+            st["write_bytes"] += len(data)
         return ret
+
+    # -- `volume top` backend (io-stats ios_stat_list) ---------------------
+
+    def top(self, metric: str = "open", count: int = 10) -> list:
+        """Top paths by metric: open | read | write | read-bytes |
+        write-bytes (gluster volume top semantics)."""
+        key = {"open": "opens", "read": "reads", "write": "writes",
+               "read-bytes": "read_bytes",
+               "write-bytes": "write_bytes"}.get(metric)
+        if key is None:
+            raise ValueError(f"unknown top metric {metric!r}")
+        ranked = sorted(self._per_path.items(),
+                        key=lambda kv: kv[1][key], reverse=True)
+        return [{"path": p, **st} for p, st in ranked[:count]
+                if st[key] > 0]
+
+    async def top_stats(self, metric: str = "open",
+                        count: int = 10) -> list:
+        """RPC surface for ``gluster volume top`` (the brick server
+        resolves this by graph walk, like quota_usage)."""
+        return self.top(metric, count)
 
     # -- profile API (volume profile incremental/cumulative analog) --------
 

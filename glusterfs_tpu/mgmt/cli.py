@@ -281,6 +281,15 @@ async def _run(args) -> Any:
                 return st.profile() if st else {}
             finally:
                 await client.unmount()
+        if sub == "top":
+            # volume top NAME [open|read|write|read-bytes|write-bytes]
+            # [COUNT] — ranked per-path counters from each BRICK's
+            # io-stats layer (gluster volume top)
+            metric = args.args[0] if args.args else "open"
+            cnt = int(args.args[1]) if len(args.args) > 1 else 10
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-top", name=args.name,
+                                    metric=metric, count=cnt)
     raise SystemExit(f"unknown command {args.cmd} {args.sub}")
 
 
@@ -346,14 +355,15 @@ def main(argv=None) -> int:
                                      "info", "status", "set", "heal",
                                      "rebalance", "profile", "quota",
                                      "bitrot", "add-brick",
-                                     "remove-brick", "replace-brick"])
+                                     "remove-brick", "replace-brick",
+                                     "top"])
     vol.add_argument("name", nargs="?", default="")
     vol.add_argument("args", nargs="*")
 
     geo = sp.add_parser("georep")
     geo.add_argument("name")
     geo.add_argument("sub", choices=["create", "start", "stop",
-                                     "status"])
+                                     "status", "checkpoint"])
     geo.add_argument("args", nargs="*")
 
     snap = sp.add_parser("snapshot")

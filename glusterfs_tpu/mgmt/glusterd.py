@@ -532,9 +532,11 @@ class Glusterd:
             if isinstance(b, str):
                 nodeid, _, path = b.partition(":")
                 b = {"node": nodeid, "path": path}
+            node = self._resolve_node(b["node"]) if b.get("node") \
+                else self._peer_info()
             parsed.append({
-                "index": i, "node": b.get("node", self.uuid),
-                "host": b.get("host", "127.0.0.1"),
+                "index": i, "node": node["uuid"],
+                "host": b.get("host", node["host"]),
                 "path": b["path"],
                 "name": f"{name}-brick-{i}",
             })
@@ -828,6 +830,54 @@ class Glusterd:
         finally:
             await client.unmount()
 
+    _TOP_METRICS = ("open", "read", "write", "read-bytes",
+                    "write-bytes")
+
+    async def op_volume_top(self, name: str, metric: str = "open",
+                            count: int = 10) -> dict:
+        """``gluster volume top <v> open|read|write|read-bytes|
+        write-bytes`` — per-brick ranked per-path counters from each
+        brick's io-stats layer (io-stats.c ios_stat_list backend),
+        aggregated across every node's bricks."""
+        if metric not in self._TOP_METRICS:
+            # validate HERE: a typo'd metric must not come back as
+            # empty rows indistinguishable from "no activity"
+            raise MgmtError(f"unknown top metric {metric!r} "
+                            f"(one of {', '.join(self._TOP_METRICS)})")
+        vol = self._vol(name)
+        if vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        out: dict[str, list] = {}
+        for node in self._all_nodes():
+            try:
+                part = await self._node_call(
+                    node, "volume-top-local", name=name,
+                    metric=metric, count=int(count))
+            except Exception:
+                continue  # node down: its bricks are offline anyway
+            out.update(part.get("bricks", {}))
+        return {"volume": name, "metric": metric, "bricks": out}
+
+    async def op_volume_top_local(self, name: str, metric: str = "open",
+                                  count: int = 10) -> dict:
+        """One node's share of volume-top: its local bricks."""
+        vol = self._vol(name)
+        out: dict[str, list] = {}
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid:
+                continue
+            port = self.ports.get(b["name"])
+            if not port:
+                continue
+            try:
+                rows = await self._brick_call(
+                    vol, port, "top_stats", [metric, int(count)],
+                    subvol=b["name"] + "-server")
+            except Exception:
+                rows = None  # dead brick: report empty, not an error
+            out[b["name"]] = rows or []
+        return {"bricks": out}
+
     async def op_volume_brick(self, name: str, brick: str,
                               action: str) -> dict:
         """Stop / start one local brick daemon (the tests' kill_brick +
@@ -851,6 +901,24 @@ class Glusterd:
     # -- brick ops: add / remove / replace (glusterd-brick-ops.c,
     # glusterd-replace-brick.c) --------------------------------------------
 
+    def _resolve_node(self, nodeid: str) -> dict:
+        """'node' in a brick spec -> {uuid, host}: accepts a node uuid
+        (or prefix) or a peer's host[:port] — anything else would wire
+        a brick NO glusterd ever spawns into the volume."""
+        me = self._peer_info()
+        cands = [me] + [p for p in self.state["peers"].values()
+                        if p["uuid"] != self.uuid]
+        for p in cands:
+            if p["uuid"] == nodeid or (
+                    len(nodeid) >= 8 and p["uuid"].startswith(nodeid)):
+                return p
+        for p in cands:
+            if nodeid in (p["host"], f"{p['host']}:{p['port']}",
+                          "localhost"):
+                return p
+        raise MgmtError(f"brick node {nodeid!r} matches no cluster "
+                        "member (peer probe it first)")
+
     def _parse_new_bricks(self, vol: dict, bricks: list) -> list[dict]:
         start = 1 + max((b["index"] for b in vol["bricks"]), default=-1)
         parsed = []
@@ -858,10 +926,12 @@ class Glusterd:
             if isinstance(b, str):
                 nodeid, _, path = b.partition(":")
                 b = {"node": nodeid, "path": path}
+            node = self._resolve_node(b["node"]) if b.get("node") \
+                else self._peer_info()
             idx = start + i
             parsed.append({
-                "index": idx, "node": b.get("node", self.uuid),
-                "host": b.get("host", "127.0.0.1"), "path": b["path"],
+                "index": idx, "node": node["uuid"],
+                "host": b.get("host", node["host"]), "path": b["path"],
                 "name": f"{vol['name']}-brick-{idx}",
             })
         return parsed
@@ -1078,9 +1148,13 @@ class Glusterd:
             await self._spawn_brick(vol, b)
             self._notify_subscribers(name)
         gf_event("VOLUME_REPLACE_BRICK", name=name, brick=brick)
-        return {"replaced": brick,
-                "ports": {brick: self.ports[brick]}
-                if brick in self.ports else {}}
+        # only the HOSTING node reports a port: peers still hold the
+        # old port in self.ports and would overwrite the fresh one in
+        # the originator's last-write-wins merge
+        ports = {}
+        if b["node"] == self.uuid and brick in self.ports:
+            ports[brick] = self.ports[brick]
+        return {"replaced": brick, "ports": ports}
 
     async def _heal_full(self, name: str) -> None:
         try:
@@ -1737,6 +1811,25 @@ class Glusterd:
         self._save()
         return {"stopped": name}
 
+    async def op_georep_checkpoint(self, name: str) -> dict:
+        """Stamp a checkpoint on the session (gsyncd checkpoint):
+        status reports it reached once the worker has replayed every
+        change journaled before this instant (gsyncdstatus.py
+        checkpoint completion)."""
+        vol = self._vol(name)
+        if not vol.get("georep"):
+            raise MgmtError(f"no geo-rep session on {name}")
+        ts = time.time()
+        await self._cluster_txn("georep-checkpoint",
+                                {"name": name, "ts": ts})
+        return {"ok": True, "checkpoint": ts}
+
+    def commit_georep_checkpoint(self, name: str, ts: float) -> dict:
+        vol = self._vol(name)
+        vol["georep"]["checkpoint"] = ts
+        self._save()
+        return {"checkpoint": ts}
+
     def op_georep_status(self, name: str) -> dict:
         vol = self._vol(name)
         geo = vol.get("georep")
@@ -1750,12 +1843,19 @@ class Glusterd:
                 worker_state = json.load(f)
         except (FileNotFoundError, ValueError):
             pass
-        return {"sessions": [{
+        last_ts = worker_state.get("last_ts", 0)
+        synced_through = worker_state.get("synced_through", last_ts)
+        sess = {
             "primary": name, "secondary": geo["secondary"],
             "status": geo["status"],
             "online": proc is not None and proc.poll() is None,
-            "last_ts": worker_state.get("last_ts", 0),
-        }]}
+            "last_ts": last_ts,
+        }
+        cp = geo.get("checkpoint")
+        if cp:
+            sess["checkpoint"] = cp
+            sess["checkpoint_completed"] = synced_through >= cp
+        return {"sessions": [sess]}
 
     # -- brick lifecycle (glusterd-utils.c runner + pmap) ------------------
 

@@ -241,8 +241,18 @@ class GeoRepWorker:
                 if not cls._is_sync(r) or last.get(r.get("path", "")) == i]
 
     async def process_once(self) -> int:
+        import time as _t
+
+        # stamp BEFORE the scan: a record journaled between scan and
+        # stamp must not fall inside a "synced through" window
+        scan_started = _t.time()
         recs, proposal = self._collect_new()
         if not recs:
+            # caught up THROUGH the scan start: checkpoint completion
+            # must not wait for new traffic on an idle session
+            # (gsyncdstatus checkpoint semantics)
+            self.state["synced_through"] = scan_started
+            self._save_state()
             return 0
         batch = self._coalesce(recs)
         ok = True
@@ -254,6 +264,7 @@ class GeoRepWorker:
             return 0
         self.state["cursors"] = proposal
         self.state["last_ts"] = recs[-1].get("ts", 0)
+        self.state["synced_through"] = self.state["last_ts"]
         self.batches += 1
         self._save_state()
         self._prune_consumed()

@@ -113,7 +113,7 @@ _FOPS = {f.value for f in Fop}
 # non-wire-fop methods a client may invoke remotely (heal entry points,
 # introspection — the reference exposes these via separate RPC programs)
 _RPC_EXTRAS = {"heal_info", "heal_file", "heal_entry", "rebalance",
-               "release", "getactivelk", "quota_usage"}
+               "release", "getactivelk", "quota_usage", "top_stats"}
 
 
 class _ClientConn:

@@ -92,6 +92,40 @@ end-volume
     c.close()
 
 
+def test_iostats_volume_top(tmp_path):
+    """`volume top` backend: per-path ranked open/read/write counters
+    (io-stats ios_stat_list)."""
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume stats
+    type debug/io-stats
+    subvolumes posix
+end-volume
+"""
+    c = SyncClient(Graph.construct(vf))
+    c.mount()
+    c.write_file("/hot", b"x" * 100)
+    for _ in range(5):
+        assert c.read_file("/hot")
+    c.write_file("/cold", b"y")
+    st = c.graph.by_name["stats"]
+    top_read = st.top("read")
+    assert top_read and top_read[0]["path"] == "/hot"
+    assert top_read[0]["reads"] == 5
+    top_open = st.top("open", count=1)
+    assert len(top_open) == 1 and top_open[0]["path"] == "/hot"
+    assert st.top("write-bytes")[0]["write_bytes"] == 100
+    try:
+        st.top("bogus")
+        raise AssertionError("bad metric accepted")
+    except ValueError:
+        pass
+    c.close()
+
+
 def test_ec_with_flaky_brick(tmp_path):
     """One brick fails 100% of writes: EC rides through on quorum and
     heal_info flags the brick (error-gen as the brick-failure harness)."""

@@ -234,6 +234,22 @@ def test_e2e_georep_through_glusterd(tmp_path):
                         pass
                     await asyncio.sleep(0.5)
                 assert ok, "post-restart mutation never synced"
+
+                # checkpoint: stamped now, completes once the worker
+                # has replayed everything journaled before it
+                async with MgmtClient(d.host, d.port) as c:
+                    cp = await c.call("georep-checkpoint", name="pri")
+                    assert cp["checkpoint"] > 0
+                    done = False
+                    for _ in range(60):
+                        st = await c.call("georep-status", name="pri")
+                        s = st["sessions"][0]
+                        assert s["checkpoint"] == cp["checkpoint"]
+                        if s["checkpoint_completed"]:
+                            done = True
+                            break
+                        await asyncio.sleep(0.5)
+                    assert done, "checkpoint never completed"
             finally:
                 await pc.unmount()
                 await sc.unmount()

@@ -113,6 +113,15 @@ def test_glusterd_volume_lifecycle(tmp_path):
             assert await client.read_file("/hello") == b"managed!"
             await client.unmount()
 
+            # `volume top`: brick-side per-path counters over the RPC
+            async with MgmtClient(d.host, d.port) as c:
+                top = await c.call("volume-top", name="vol1",
+                                   metric="write")
+                rows = [r for rows_ in top["bricks"].values()
+                        for r in rows_]
+                assert any(r["path"] == "/hello" and r["writes"] >= 1
+                           for r in rows), top
+
             async with MgmtClient(d.host, d.port) as c:
                 await c.call("volume-stop", name="vol1")
                 with pytest.raises(Exception):
