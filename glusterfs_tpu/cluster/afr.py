@@ -144,7 +144,8 @@ class ReplicateLayer(Layer):
                 # a returning peer may have been healed and un-branded
                 # by another mount: drop the cached grant
                 self._ta_branded.discard(idx)
-            ev = Event.CHILD_UP if sum(self.up) >= self._quorum() else \
+            ev = Event.CHILD_UP if self._quorum_met(
+                {i for i, u in enumerate(self.up) if u}) else \
                 Event.CHILD_DOWN
             for p in self.parents:
                 p.notify(ev, self, data)
@@ -160,6 +161,19 @@ class ReplicateLayer(Layer):
     def _quorum(self) -> int:
         q = self.opts["quorum-count"]
         return q if q else self.n // 2 + 1
+
+    def _quorum_met(self, good) -> bool:
+        """quorum-type auto (afr_has_quorum): a strict majority, OR —
+        for EVEN replica counts with exactly half alive — the half
+        containing the FIRST brick wins the tie (so a 2-way replica
+        keeps writing when brick 1 dies, but not when brick 0 does)."""
+        q = self.opts["quorum-count"]
+        if q:
+            return len(good) >= q
+        if len(good) >= self.n // 2 + 1:
+            return True
+        return (self.n % 2 == 0 and len(good) == self.n // 2
+                and 0 in good)
 
     def _lock(self, key: bytes) -> asyncio.Lock:
         lk = self._locks.get(key)
@@ -179,10 +193,12 @@ class ReplicateLayer(Layer):
         return dict(zip(idxs, results))
 
     def _combine(self, res: dict, min_ok: int | None = None):
-        min_ok = self._quorum() if min_ok is None else min_ok
         good = {i: r for i, r in res.items()
                 if not isinstance(r, BaseException)}
-        if len(good) >= min_ok:
+        if min_ok is None:
+            if self._quorum_met(good):
+                return good
+        elif len(good) >= min_ok:
             return good
         errs = [r.err for r in res.values() if isinstance(r, FopError)]
         if errs:
@@ -623,10 +639,11 @@ class ReplicateLayer(Layer):
             res = await self._dispatch(idxs, op, argfn)
             good = [i for i, r in res.items()
                     if not isinstance(r, BaseException)]
-            quorum = self._quorum()
             if self.ta is not None and len(idxs) < self.n:
-                quorum = 1  # the thin-arbiter grant replaced the peer
-            if len(good) < quorum:
+                met = len(good) >= 1  # thin-arbiter grant replaced peer
+            else:
+                met = self._quorum_met(set(good))
+            if not met:
                 raise FopError(errno.EIO,
                                f"{op} quorum lost ({len(good)}/{self.n})")
             post = {XA_VERSION: _pack_u64x2(1, 0)}

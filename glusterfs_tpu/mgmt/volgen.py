@@ -42,6 +42,8 @@ OPTION_MAP = {
     "cluster.lookup-unhashed": ("cluster/distribute", "lookup-unhashed"),
     "cluster.min-free-disk": ("cluster/distribute", "min-free-disk"),
     "network.ping-timeout": ("protocol/client", "ping-timeout"),
+    "storage.health-check-interval": ("storage/posix",
+                                      "health-check-interval"),
     "performance.write-behind": ("performance/write-behind", "__enable__"),
     "performance.write-behind-window-size": ("performance/write-behind",
                                              "window-size"),
@@ -155,8 +157,9 @@ def _enabled(volinfo: dict, enable_key: str, default: bool) -> bool:
 def build_brick_volfile(volinfo: dict, brick: dict) -> str:
     """posix -> locks -> io-stats (server_graph_table order, trimmed)."""
     name = brick["name"]
-    out = [_emit(f"{name}-posix", "storage/posix",
-                 {"directory": brick["path"]}, [])]
+    popts = {"directory": brick["path"]}
+    popts.update(layer_options(volinfo, "storage/posix"))
+    out = [_emit(f"{name}-posix", "storage/posix", popts, [])]
     top = f"{name}-posix"
     # metadata-only witness brick: last of each replica group when the
     # volume was created with `arbiter 1` (arbiter.c sits above posix)

@@ -33,6 +33,7 @@ import errno
 import json
 import os
 import struct
+import time
 import zlib
 
 from ..core.fops import FopError
@@ -213,6 +214,10 @@ class PosixLayer(Layer):
         Option("directory", "path", description="brick root directory"),
         Option("o-direct", "bool", default="off"),
         Option("update-link-count-parent", "bool", default="off"),
+        Option("health-check-interval", "time", default="30",
+               description="seconds between backend probes (0 = off); "
+               "a failing backend marks the brick down "
+               "(posix_health_check_thread_proc)"),
     )
 
     def __init__(self, *args, **kw):
@@ -248,11 +253,78 @@ class PosixLayer(Layer):
         # root of the brick always has the fixed ROOT_GFID
         if not os.path.exists(self._gfid_path(ROOT_GFID)):
             self._gfid_set(ROOT_GFID, "/")
+        self._failed_health: str | None = None
+        if float(self.opts["health-check-interval"]) > 0:
+            self._health_task = asyncio.create_task(self._health_loop())
         await super().init()
+
+    async def fini(self):
+        t = getattr(self, "_health_task", None)
+        if t is not None:
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._health_task = None
+        await super().fini()
+
+    def reconfigure(self, options: dict) -> None:
+        old = float(self.opts["health-check-interval"])
+        super().reconfigure(options)
+        new = float(self.opts["health-check-interval"])
+        if new == old or getattr(self, "_failed_health", None):
+            return  # a failed brick stays down until respawn
+        t = getattr(self, "_health_task", None)
+        if t is not None:
+            t.cancel()
+            self._health_task = None
+        if new > 0:
+            try:
+                self._health_task = asyncio.create_task(
+                    self._health_loop())
+            except RuntimeError:
+                pass  # no running loop (offline reconfigure)
+
+    # -- health checker (posix_health_check_thread_proc analog) ------------
+    # The reference stats + writes a probe under .glusterfs every
+    # interval; a failing backend (dead disk, unmounted FS) kills the
+    # brick so clients fail over instead of hanging on EIO storage.
+    # Here the brick marks itself down: every fop raises ENOTCONN and
+    # CHILD_DOWN propagates up to the serving graph.
+
+    async def _health_loop(self) -> None:
+        from ..core.layer import Event
+
+        interval = float(self.opts["health-check-interval"])
+        probe = os.path.join(self.root, META_DIR, "health_check")
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                def check() -> None:
+                    os.statvfs(self.root)
+                    with open(probe, "w") as f:
+                        f.write(str(time.time()))
+                        f.flush()
+                        os.fsync(f.fileno())
+
+                await asyncio.to_thread(check)
+            except asyncio.CancelledError:
+                raise
+            except OSError as e:
+                self._failed_health = str(e)
+                log.error(9, "%s: backend health check failed: %s — "
+                          "marking brick down", self.name, e)
+                self.notify(Event.CHILD_DOWN, None, None)
+                return
 
     # -- path / gfid helpers ----------------------------------------------
 
     def _abs(self, path: str) -> str:
+        if getattr(self, "_failed_health", None):
+            raise FopError(errno.ENOTCONN,
+                           f"brick backend failed health check: "
+                           f"{self._failed_health}")
         rel = path.lstrip("/")
         if rel.split("/", 1)[0] == META_DIR:
             raise FopError(errno.EPERM, "reserved namespace")
@@ -295,6 +367,10 @@ class PosixLayer(Layer):
         """GFID -> ABSOLUTE path for I/O.  Regular files/symlinks go via
         the handle hardlink (immune to rename/unlink of any one name);
         directories via the recorded path."""
+        if getattr(self, "_failed_health", None):
+            raise FopError(errno.ENOTCONN,
+                           f"brick backend failed health check: "
+                           f"{self._failed_health}")
         hp = self._handle_path(gfid)
         if os.path.lexists(hp):
             return hp
@@ -569,6 +645,14 @@ class PosixLayer(Layer):
         return fd
 
     def _os_fd(self, fd: FdObj) -> int:
+        if getattr(self, "_failed_health", None):
+            # a cached os-level fd would happily keep writing into the
+            # dead backend's orphaned inodes — every fd fop must fail
+            # like the path fops so the layers above record blame and
+            # fail over (the reference gets this by killing the brick)
+            raise FopError(errno.ENOTCONN,
+                           f"brick backend failed health check: "
+                           f"{self._failed_health}")
         fdno = fd.ctx_get(self)
         if fdno is None:
             # anonymous fd: open on demand via the handle hardlink

@@ -233,3 +233,29 @@ def test_read_during_write_sees_whole_version(tmp_path):
 
     # without per-txn lk-owners this measures 3-4 mixed reads out of 4
     assert asyncio.run(run()) == 0, "read decoded a mix of write versions"
+
+
+@pytest.mark.parametrize("k,r", [(2, 1), (4, 1), (4, 3), (8, 3),
+                                 (8, 4), (16, 4)])
+def test_config_sweep_roundtrip_and_degraded(tmp_path, k, r):
+    """Redundancy sweep at the VOLUME level (the reference's
+    ec-{3-1,4-1,5-2,6-2,12-4}.t config matrix): write, read back,
+    degraded read with r bricks down."""
+    from glusterfs_tpu.utils.volspec import ec_volfile
+
+    g = Graph.construct(ec_volfile(tmp_path, k + r, r,
+                                   options={"cpu-extensions": "auto"}))
+    c = SyncClient(g)
+    c.mount()
+    try:
+        data = _rand(k * 512 * 3 + 137, seed=k * 31 + r)  # unaligned
+        c.write_file("/s", bytes(data))
+        assert c.read_file("/s") == bytes(data)
+        # degradation: wipe r whole brick stores, reads reconstruct
+        import shutil
+
+        for i in range(r):
+            shutil.rmtree(tmp_path / f"brick{i}")
+        assert c.read_file("/s") == bytes(data)
+    finally:
+        c.close()

@@ -220,3 +220,49 @@ def test_rename_updates_fd_of_source(client):
     f.write(b"HELLO", 0)
     f.close()
     assert client.read_file("/y") == b"HELLO"
+
+
+def test_health_checker_marks_brick_down(tmp_path):
+    """posix health checker (posix_health_check_thread_proc analog): a
+    backend that stops accepting writes marks the brick down — fops
+    raise ENOTCONN and CHILD_DOWN propagates, instead of every fop
+    hitting raw EIO storage."""
+    import errno
+    import shutil
+
+    spec = (f"volume brick0\n    type storage/posix\n"
+            f"    option directory {tmp_path}/hb\n"
+            f"    option health-check-interval 0.2\nend-volume\n")
+
+    async def run():
+        c = Client(Graph.construct(spec))
+        await c.mount()
+        posix = c.graph.by_name["brick0"]
+        events = []
+        # a fake parent records notifications (ec/afr would mark the
+        # child down the same way)
+        class Sink:
+            def notify(self, ev, src, data):
+                events.append(ev)
+        posix.parents.append(Sink())
+        await c.write_file("/ok", b"fine")
+        f = await c.open("/ok", os.O_RDWR)  # pre-failure fd
+        # kill the backend under the brick (unmounted/dead disk analog)
+        shutil.rmtree(tmp_path / "hb")
+        deadline = asyncio.get_event_loop().time() + 10
+        while not events:
+            assert asyncio.get_event_loop().time() < deadline, \
+                "health check never fired"
+            await asyncio.sleep(0.1)
+        with pytest.raises(FopError) as ei:
+            await c.write_file("/nope", b"x")
+        assert ei.value.err == errno.ENOTCONN
+        # fd fops on cached os-level fds must fail too — a silent
+        # write into the dead backend's orphaned inode records no
+        # blame and vanishes
+        with pytest.raises(FopError) as ei:
+            await f.write(b"gone", 0)
+        assert ei.value.err == errno.ENOTCONN
+        await c.unmount()
+
+    asyncio.run(run())
