@@ -643,6 +643,18 @@ class ReplicateLayer(Layer):
                 met = len(good) >= 1  # thin-arbiter grant replaced peer
             else:
                 met = self._quorum_met(set(good))
+                failed = [i for i in idxs if i not in good]
+                if self.ta is not None and met and failed:
+                    # mid-write degradation on a TA volume: the ack is
+                    # only safe once the missed replica is branded on
+                    # the tie-breaker — else it could later return
+                    # alone, find itself unbranded, and accept writes
+                    # (mutual-blame split-brain)
+                    try:
+                        await self._ta_mark_bad(failed)
+                        self._ta_branded |= set(failed)
+                    except FopError:
+                        met = False
             if not met:
                 raise FopError(errno.EIO,
                                f"{op} quorum lost ({len(good)}/{self.n})")

@@ -320,11 +320,17 @@ class PosixLayer(Layer):
 
     # -- path / gfid helpers ----------------------------------------------
 
-    def _abs(self, path: str) -> str:
+    def _health_gate(self) -> None:
+        """Every resolution path funnels here once the checker marks
+        the backend dead: a brick must fail loudly (ENOTCONN), never
+        serve stale metadata or record bookkeeping on a dead disk."""
         if getattr(self, "_failed_health", None):
             raise FopError(errno.ENOTCONN,
                            f"brick backend failed health check: "
                            f"{self._failed_health}")
+
+    def _abs(self, path: str) -> str:
+        self._health_gate()
         rel = path.lstrip("/")
         if rel.split("/", 1)[0] == META_DIR:
             raise FopError(errno.EPERM, "reserved namespace")
@@ -367,10 +373,7 @@ class PosixLayer(Layer):
         """GFID -> ABSOLUTE path for I/O.  Regular files/symlinks go via
         the handle hardlink (immune to rename/unlink of any one name);
         directories via the recorded path."""
-        if getattr(self, "_failed_health", None):
-            raise FopError(errno.ENOTCONN,
-                           f"brick backend failed health check: "
-                           f"{self._failed_health}")
+        self._health_gate()
         hp = self._handle_path(gfid)
         if os.path.lexists(hp):
             return hp
@@ -645,14 +648,11 @@ class PosixLayer(Layer):
         return fd
 
     def _os_fd(self, fd: FdObj) -> int:
-        if getattr(self, "_failed_health", None):
-            # a cached os-level fd would happily keep writing into the
-            # dead backend's orphaned inodes — every fd fop must fail
-            # like the path fops so the layers above record blame and
-            # fail over (the reference gets this by killing the brick)
-            raise FopError(errno.ENOTCONN,
-                           f"brick backend failed health check: "
-                           f"{self._failed_health}")
+        # a cached os-level fd would happily keep writing into the
+        # dead backend's orphaned inodes — fd fops must fail like the
+        # path fops so the layers above record blame and fail over
+        # (the reference gets this by killing the brick)
+        self._health_gate()
         fdno = fd.ctx_get(self)
         if fdno is None:
             # anonymous fd: open on demand via the handle hardlink
@@ -915,6 +915,9 @@ class PosixLayer(Layer):
         return {}
 
     async def statfs(self, loc: Loc, xdata: dict | None = None):
+        # a dead disk's cached statvfs would keep min-free-disk
+        # placing data here
+        self._health_gate()
         try:
             sv = os.statvfs(self.root)
         except OSError as e:

@@ -1,0 +1,69 @@
+"""A volume whose bricks span TWO glusterd nodes: create/start spawn
+on the right node, portmap syncs cluster-wide, clients mount through
+either node, and node-local ops (top, status) aggregate across nodes —
+the tests/cluster.rc multi-node volume scenario."""
+
+import asyncio
+
+import pytest
+
+from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient, mount_volume
+
+
+@pytest.mark.slow
+def test_volume_spanning_two_nodes(tmp_path):
+    async def run():
+        d1 = Glusterd(str(tmp_path / "gd1"))
+        await d1.start()
+        d2 = Glusterd(str(tmp_path / "gd2"))
+        await d2.start()
+        try:
+            async with MgmtClient(d1.host, d1.port) as c:
+                await c.call("peer-probe", host=d2.host, port=d2.port)
+                await c.call("volume-create", name="mn",
+                             vtype="replicate",
+                             bricks=[{"node": d1.uuid,
+                                      "path": str(tmp_path / "n1b")},
+                                     {"node": d2.uuid,
+                                      "path": str(tmp_path / "n2b")}])
+                await c.call("volume-start", name="mn")
+                # each node spawned ITS brick
+                assert "mn-brick-0" in d1.bricks
+                assert "mn-brick-1" in d2.bricks
+                assert "mn-brick-0" not in d2.bricks
+                # portmap synced: both nodes know both ports
+                for d in (d1, d2):
+                    st = d.op_volume_status("mn")  # local view
+                    ports = {b["name"]: b["port"] for b in st["bricks"]}
+                    assert ports["mn-brick-0"] == d1.ports["mn-brick-0"]
+                    assert ports["mn-brick-1"] == d2.ports["mn-brick-1"]
+                    assert 0 not in ports.values()
+
+            # mount through NODE 2 (volfile served with both ports)
+            m = await mount_volume(d2.host, d2.port, "mn")
+            try:
+                await m.write_file("/cross", b"spans nodes" * 50)
+                assert await m.read_file("/cross") == b"spans nodes" * 50
+                # both replicas materialized, one per node
+                assert (tmp_path / "n1b" / "cross").exists()
+                assert (tmp_path / "n2b" / "cross").exists()
+            finally:
+                await m.unmount()
+
+            # volume top aggregates BOTH nodes' bricks
+            async with MgmtClient(d1.host, d1.port) as c:
+                top = await c.call("volume-top", name="mn",
+                                   metric="write")
+                assert set(top["bricks"]) == {"mn-brick-0",
+                                              "mn-brick-1"}, top
+                for rows in top["bricks"].values():
+                    assert any(r["path"] == "/cross" for r in rows)
+                await c.call("volume-stop", name="mn")
+            # stop reached both nodes
+            assert "mn-brick-0" not in d1.bricks
+            assert "mn-brick-1" not in d2.bricks
+        finally:
+            await d2.stop()
+            await d1.stop()
+
+    asyncio.run(run())
