@@ -639,22 +639,25 @@ class ReplicateLayer(Layer):
             res = await self._dispatch(idxs, op, argfn)
             good = [i for i, r in res.items()
                     if not isinstance(r, BaseException)]
-            if self.ta is not None and len(idxs) < self.n:
-                met = len(good) >= 1  # thin-arbiter grant replaced peer
-            else:
-                met = self._quorum_met(set(good))
+            if self.ta is not None:
+                # thin-arbiter volumes: ANY lone survivor may ack, but
+                # only after branding the replicas that missed the
+                # write on the tie-breaker — an unbranded missed
+                # replica could later return alone, find no mark
+                # against itself, and accept writes (mutual-blame
+                # split-brain).  Covers both the pre-granted path
+                # (len(idxs) < n) and mid-write failures of EITHER
+                # brick, including tie-winning brick 0.
                 failed = [i for i in idxs if i not in good]
-                if self.ta is not None and met and failed:
-                    # mid-write degradation on a TA volume: the ack is
-                    # only safe once the missed replica is branded on
-                    # the tie-breaker — else it could later return
-                    # alone, find itself unbranded, and accept writes
-                    # (mutual-blame split-brain)
+                met = len(good) >= 1
+                if met and failed:
                     try:
                         await self._ta_mark_bad(failed)
                         self._ta_branded |= set(failed)
                     except FopError:
                         met = False
+            else:
+                met = self._quorum_met(set(good))
             if not met:
                 raise FopError(errno.EIO,
                                f"{op} quorum lost ({len(good)}/{self.n})")

@@ -108,6 +108,11 @@ async def _run(args) -> Any:
                 return await c.call("peer-probe", host=ph, port=int(pp))
             return await c.call("peer-status")
 
+    if args.cmd == "eventsapi":
+        async with MgmtClient(host, port) as c:
+            return await c.call("eventsapi", action=args.sub,
+                                url=args.args[0] if args.args else "")
+
     if args.cmd == "georep":
         # georep PRIMARY create SECONDARY | start|stop|status PRIMARY
         async with MgmtClient(host, port) as c:
@@ -375,6 +380,11 @@ def main(argv=None) -> int:
     peer = sp.add_parser("peer")
     peer.add_argument("sub", choices=["probe", "status"])
     peer.add_argument("target", nargs="?", default="")
+
+    ev = sp.add_parser("eventsapi")
+    ev.add_argument("sub", choices=["webhook-add", "webhook-del",
+                                    "status"])
+    ev.add_argument("args", nargs="*")
 
     args = p.parse_args(argv)
     try:

@@ -898,6 +898,65 @@ class Glusterd:
             return {"started": brick, "port": self.ports.get(brick, 0)}
         raise MgmtError(f"unknown brick action {action!r}")
 
+    # -- eventsapi (events/src/peer_eventsapi.py analog) -------------------
+    # Webhook config is cluster-wide: the op fans out over the txn and
+    # every node forwards to ITS eventsd's ctl port (from the
+    # GFTPU_EVENTSD_CTL env, set by whoever runs gftpu-eventsd there).
+
+    async def op_eventsapi(self, action: str, url: str = "") -> dict:
+        if action in ("webhook-add", "webhook-del"):
+            if not url:
+                raise MgmtError(f"{action} needs a url")
+            results = await self._cluster_txn(
+                "eventsapi", {"action": action, "url": url})
+            return {"ok": True,
+                    "nodes": [r.get("result", {}) for r in results]}
+        if action == "status":
+            # cluster-wide view (peer_eventsapi status): the contacted
+            # node having no eventsd must not hide everyone else's
+            out = {}
+            for node in self._all_nodes():
+                try:
+                    out[node["uuid"][:8]] = await self._node_call(
+                        node, "eventsapi-local", ctl_method="status")
+                except Exception as e:
+                    out[node["uuid"][:8]] = {"error": repr(e)[:120]}
+            return {"nodes": out}
+        raise MgmtError(f"unknown eventsapi action {action!r}")
+
+    async def op_eventsapi_local(self, ctl_method: str) -> dict:
+        return await self._eventsd_ctl(ctl_method, {})
+
+    async def commit_eventsapi(self, action: str, url: str) -> dict:
+        return await self._eventsd_ctl(action, {"url": url})
+
+    async def _eventsd_ctl(self, method: str, kwargs: dict) -> dict:
+        ep = os.environ.get("GFTPU_EVENTSD_CTL", "")
+        if not ep:
+            return {"skipped": "no eventsd on this node "
+                               "(GFTPU_EVENTSD_CTL unset)"}
+        host, _, port = ep.partition(":")
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, int(port)), 5)
+        except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+            # a crashed eventsd must degrade like an absent one, not
+            # abort the cluster txn half-committed
+            return {"skipped": f"eventsd unreachable: {e!r}"[:200]}
+        try:
+            writer.write(wire.pack(1, wire.MT_CALL, [method, kwargs]))
+            await writer.drain()
+            rec = await asyncio.wait_for(wire.read_frame(reader), 5)
+            _, mtype, payload = wire.unpack(rec)
+            if mtype != wire.MT_REPLY:
+                raise MgmtError(f"eventsd refused {method}: {payload}")
+            return payload
+        except (ConnectionError, OSError, asyncio.TimeoutError,
+                asyncio.IncompleteReadError) as e:
+            return {"skipped": f"eventsd unreachable: {e!r}"[:200]}
+        finally:
+            writer.close()
+
     # -- brick ops: add / remove / replace (glusterd-brick-ops.c,
     # glusterd-replace-brick.c) --------------------------------------------
 

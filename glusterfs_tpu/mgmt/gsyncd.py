@@ -293,7 +293,67 @@ class GeoRepWorker:
                     except OSError:
                         pass
 
+    async def initial_crawl(self) -> int:
+        """Hybrid/xsync crawl (reference primary.py XCrawlMixin): data
+        written BEFORE the session existed has no journal records —
+        walk the primary namespace once and materialize everything on
+        the secondary, then hand over to changelog tailing.  Runs
+        before the first journal batch; idempotent (copy reads current
+        primary state), so a crash mid-crawl just re-walks."""
+        from ..core.iatt import IAType
+
+        synced = 0
+
+        async def meta(child: str, ia) -> None:
+            # pre-session data has no 'M' journal records: carry
+            # mode/ownership in the crawl itself
+            try:
+                await self.secondary.setattr(
+                    child, {"mode": ia.mode & 0o7777,
+                            "uid": ia.uid, "gid": ia.gid})
+            except FopError:
+                pass
+
+        async def walk(path: str) -> int:
+            n = 0
+            for name, ia in await self.primary.listdir_with_stat(path):
+                child = path.rstrip("/") + "/" + name
+                if ia is not None and ia.is_dir():
+                    try:
+                        await self.secondary.mkdir(child)
+                    except FopError:
+                        pass
+                    await meta(child, ia)
+                    n += await walk(child)
+                elif ia is not None and ia.ia_type is IAType.LNK:
+                    # symlinks must stay symlinks (journal replay's
+                    # op=='symlink' path does the same)
+                    try:
+                        target = await self.primary.readlink(child)
+                        await self.secondary.symlink(target, child)
+                        n += 1
+                    except FopError:
+                        pass
+                else:
+                    if await self._copy_file(child):
+                        if ia is not None:
+                            await meta(child, ia)
+                        n += 1
+            return n
+
+        synced = await walk("/")
+        self.state["initial_done"] = True
+        self._save_state()
+        log.info(3, "initial crawl synced %d files", synced)
+        return synced
+
     async def run(self) -> None:
+        while not self.state.get("initial_done"):
+            try:
+                await self.initial_crawl()
+            except Exception as e:
+                log.error(4, "initial crawl failed (will retry): %r", e)
+                await asyncio.sleep(self.interval)
         while True:
             try:
                 await self.process_once()

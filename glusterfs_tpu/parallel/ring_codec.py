@@ -98,9 +98,12 @@ def _ring_decode_fn(k: int, rows: tuple[int, ...], mesh: Mesh):
     # split decode columns per member along the input-plane dim
     bb_full = jnp.asarray(bbits)
 
+    # stripes shard over dp as well: each dp row runs its own
+    # independent ring over its stripe slice (specs naming only frag
+    # would replicate the whole problem dp times)
     kwargs = dict(mesh=mesh,
-                  in_specs=(P("frag", None, None), P(None, "frag")),
-                  out_specs=P("frag", None, None))
+                  in_specs=(P("frag", "dp", None), P(None, "frag")),
+                  out_specs=P(("dp", "frag"), None, None))
     try:  # jax>=0.8 renamed the replication-check knob
         fn = shard_map(shard_body, check_vma=False, **kwargs)
     except TypeError:
@@ -127,7 +130,8 @@ def ring_decode(k: int, rows, frags: np.ndarray,
     x = gf256.frags_to_planes(frags, k)    # (S, k*8, 64)
     s = x.shape[0]
     p = mesh.devices.shape[mesh.axis_names.index("frag")]
-    pad = (-s) % p
+    dp = mesh.devices.shape[mesh.axis_names.index("dp")]
+    pad = (-s) % (p * dp)  # dp slices, each ring-split into p blocks
     if pad:
         x = np.concatenate(
             [x, np.zeros((pad, *x.shape[1:]), dtype=np.uint8)], axis=0)

@@ -113,3 +113,35 @@ def test_glusterd_lifecycle_emits_events(tmp_path, noevents):
             await ed.stop()
 
     asyncio.run(run())
+
+
+def test_eventsapi_cluster_webhook_config(tmp_path, noevents,
+                                          monkeypatch):
+    """peer_eventsapi analog: glusterd's eventsapi op forwards webhook
+    config to the node's eventsd ctl port (GFTPU_EVENTSD_CTL)."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient
+
+    async def run():
+        ed = EventsDaemon()
+        _, ctl = await ed.start()
+        monkeypatch.setenv("GFTPU_EVENTSD_CTL", f"127.0.0.1:{ctl}")
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                out = await c.call("eventsapi", action="webhook-add",
+                                   url="http://127.0.0.1:1/hook")
+                assert out["ok"]
+                assert "http://127.0.0.1:1/hook" in ed.webhooks
+                st = await c.call("eventsapi", action="status")
+                assert any("http://127.0.0.1:1/hook"
+                           in n.get("webhooks", {})
+                           for n in st["nodes"].values()), st
+                await c.call("eventsapi", action="webhook-del",
+                             url="http://127.0.0.1:1/hook")
+                assert "http://127.0.0.1:1/hook" not in ed.webhooks
+        finally:
+            await d.stop()
+            await ed.stop()
+
+    asyncio.run(run())

@@ -194,6 +194,13 @@ def test_e2e_georep_through_glusterd(tmp_path):
                              key="changelog.rollover-time", value="1")
                 await c.call("volume-start", name="pri")
                 await c.call("volume-start", name="sec")
+            # data that PREDATES the session: no journal records exist,
+            # only the initial xsync crawl can sync it
+            pre = await mount_volume(d.host, d.port, "pri")
+            await pre.mkdir("/old")
+            await pre.write_file("/old/history", b"pre-session" * 64)
+            await pre.unmount()
+            async with MgmtClient(d.host, d.port) as c:
                 await c.call("georep-create", name="pri",
                              secondary=f"{d.host}:{d.port}:sec")
                 await c.call("georep-start", name="pri")
@@ -217,6 +224,9 @@ def test_e2e_georep_through_glusterd(tmp_path):
                         pass
                     await asyncio.sleep(0.5)
                 assert ok, "secondary never converged"
+                # pre-session data arrived via the initial crawl
+                assert await sc.read_file("/old/history") == \
+                    b"pre-session" * 64
 
                 # stop -> mutate -> start: resumes from checkpoint
                 async with MgmtClient(d.host, d.port) as c:
