@@ -652,6 +652,15 @@ class ReplicateLayer(Layer):
                 met = len(good) >= 1
                 if met and failed:
                     try:
+                        # a survivor that is ITSELF marked bad on the
+                        # tie-breaker (stale, un-healed) must not take
+                        # writes — acking onto it puts the only copy of
+                        # new data on a replica heal will overwrite
+                        marks = await self._ta_marks()
+                        if any(i in marks for i in good):
+                            raise FopError(
+                                errno.EIO, "surviving replica is "
+                                "marked bad on the thin-arbiter")
                         await self._ta_mark_bad(failed)
                         self._ta_branded |= set(failed)
                     except FopError:

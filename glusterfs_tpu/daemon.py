@@ -42,6 +42,25 @@ async def serve_brick(volfile_text: str, host: str = "127.0.0.1",
     return server
 
 
+def _dump_state(server: BrickServer, volfile: str) -> None:
+    """SIGUSR1 statedump (reference glusterfsd.c:2230 wiring +
+    statedump.c:831): full graph dump to a timestamped file next to
+    the volfile — the de-facto live-debugging interface."""
+    import json
+    import time
+
+    src = server.graph if server.graph is not None else server.top
+    path = (os.path.splitext(volfile)[0]
+            + f".dump.{int(time.time())}.{os.getpid()}")
+    try:
+        with open(path + ".tmp", "w") as f:
+            json.dump(src.statedump(), f, indent=1, default=repr)
+        os.replace(path + ".tmp", path)
+        log.info(2, "statedump written to %s", path)
+    except Exception as e:
+        log.error(3, "statedump failed: %r", e)
+
+
 async def _amain(args) -> None:
     with open(args.volfile) as f:
         text = f.read()
@@ -51,6 +70,8 @@ async def _amain(args) -> None:
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
+    loop.add_signal_handler(signal.SIGUSR1, _dump_state, server,
+                            args.volfile)
     await stop.wait()
     await server.stop()
 

@@ -347,13 +347,48 @@ async def _heal_all(client, top):
     return {"healed": healed, "count": len(healed)}
 
 
+def _shell(server: str, flags: list[str]) -> int:
+    """Interactive command shell (the reference's readline UI,
+    cli-rl.c): `gftpu` with no command drops into `gftpu> ` and runs
+    each line through the normal parser against --server, keeping the
+    outer --json/--xml formatting."""
+    import shlex
+
+    try:
+        import readline  # noqa: F401  (line editing + history)
+    except ImportError:
+        pass
+    print("gftpu interactive shell — 'exit' to quit")
+    while True:
+        try:
+            line = input("gftpu> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if not line:
+            continue
+        if line in ("exit", "quit", "q"):
+            return 0
+        try:
+            words = shlex.split(line)  # quoted args survive
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            continue
+        try:
+            main(["--server", server, *flags, *words])
+        except SystemExit:
+            pass  # argparse usage error: printed; the shell continues
+        except KeyboardInterrupt:
+            print()  # Ctrl-C aborts the command, not the shell
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="gftpu")
     p.add_argument("--server", default="127.0.0.1:24007")
     p.add_argument("--json", action="store_true")
     p.add_argument("--xml", action="store_true",
                    help="cli-xml-output.c style machine output")
-    sp = p.add_subparsers(dest="cmd", required=True)
+    sp = p.add_subparsers(dest="cmd")  # no cmd -> interactive shell
 
     vol = sp.add_parser("volume")
     vol.add_argument("sub", choices=["create", "start", "stop", "delete",
@@ -387,6 +422,10 @@ def main(argv=None) -> int:
     ev.add_argument("args", nargs="*")
 
     args = p.parse_args(argv)
+    if args.cmd is None:
+        flags = [f for f, on in (("--json", args.json),
+                                 ("--xml", args.xml)) if on]
+        return _shell(args.server, flags)
     try:
         out = asyncio.run(_run(args))
     except Exception as e:

@@ -917,8 +917,9 @@ class Glusterd:
             out = {}
             for node in self._all_nodes():
                 try:
-                    out[node["uuid"][:8]] = await self._node_call(
-                        node, "eventsapi-local", ctl_method="status")
+                    out[node["uuid"][:8]] = await asyncio.wait_for(
+                        self._node_call(node, "eventsapi-local",
+                                        ctl_method="status"), 10)
                 except Exception as e:
                     out[node["uuid"][:8]] = {"error": repr(e)[:120]}
             return {"nodes": out}
@@ -936,6 +937,9 @@ class Glusterd:
             return {"skipped": "no eventsd on this node "
                                "(GFTPU_EVENTSD_CTL unset)"}
         host, _, port = ep.partition(":")
+        if not host or not port.isdigit():
+            return {"skipped": f"malformed GFTPU_EVENTSD_CTL {ep!r} "
+                               "(want host:port)"}
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(host, int(port)), 5)

@@ -316,7 +316,13 @@ class GeoRepWorker:
 
         async def walk(path: str) -> int:
             n = 0
-            for name, ia in await self.primary.listdir_with_stat(path):
+            try:
+                entries = await self.primary.listdir_with_stat(path)
+            except FopError:
+                # directory vanished mid-crawl (live primary churn):
+                # skip the subtree; a journal record covers its fate
+                return 0
+            for name, ia in entries:
                 child = path.rstrip("/") + "/" + name
                 if ia is not None and ia.is_dir():
                     try:
