@@ -103,13 +103,10 @@ def _timed_call(fn, arg) -> float:
     return time.perf_counter() - t0
 
 
-def volume_bench(n_clients: int = 16, file_mib: int = 1,
-                 backend: str = "auto", prefix: str = "volume") -> dict:
-    """e2e served-data-path number: n concurrent clients writing then
-    reading 1 MiB files on an in-process 4+2 volume with the stripe-cache
-    batching window on — measures the coalesced regime the north star
-    describes (fops -> one device batch per tick), including all
-    host<->device transfer and dispatch cost."""
+def _on_mounted_volume(body, backend: str, groups: int = 1):
+    """Shared bench harness: build a (possibly distributed-) 4+2
+    volume with the stripe-cache window on, mount, run ``body(c)``,
+    tear down.  One copy of the scaffolding for every volume bench."""
     import asyncio
     import shutil
     import tempfile
@@ -120,40 +117,56 @@ def volume_bench(n_clients: int = 16, file_mib: int = 1,
 
     base = tempfile.mkdtemp(prefix="ecbench")
     spec = ec_volfile(base, N, R, options={
-        "cpu-extensions": backend, "stripe-cache": "on"})
-    rng = np.random.default_rng(1)
-    payload = rng.integers(0, 256, file_mib * MIB, dtype=np.uint8).tobytes()
+        "cpu-extensions": backend, "stripe-cache": "on"}, groups=groups)
 
     async def run():
         c = Client(Graph.construct(spec))
         await c.mount()
         try:
-            ec = c.graph.top
-            # warm jit off the clock; snapshot stats after so the reported
-            # coalescing ratio covers only the timed workload
-            await c.write_file("/warm", payload)
-            await c.read_file("/warm")
-            warm = ec.codec.dump_stats()
-            t0 = time.perf_counter()
-            await asyncio.gather(*(
-                c.write_file(f"/f{i}", payload) for i in range(n_clients)))
-            t_w = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            datas = await asyncio.gather(*(
-                c.read_file(f"/f{i}") for i in range(n_clients)))
-            t_r = time.perf_counter() - t0
-            assert all(d == payload for d in datas), "volume parity failure"
-            stats = ec.codec.dump_stats()
-            for key in ("launches", "batched_fops"):
-                stats[key] -= warm[key]
-            return t_w, t_r, stats
+            return await body(c)
         finally:
             await c.unmount()
 
     try:
-        t_w, t_r, stats = asyncio.run(run())
+        return asyncio.run(run())
     finally:
         shutil.rmtree(base, ignore_errors=True)
+
+
+def volume_bench(n_clients: int = 16, file_mib: int = 1,
+                 backend: str = "auto", prefix: str = "volume") -> dict:
+    """e2e served-data-path number: n concurrent clients writing then
+    reading 1 MiB files on an in-process 4+2 volume with the stripe-cache
+    batching window on — measures the coalesced regime the north star
+    describes (fops -> one device batch per tick), including all
+    host<->device transfer and dispatch cost."""
+    import asyncio
+
+    rng = np.random.default_rng(1)
+    payload = rng.integers(0, 256, file_mib * MIB, dtype=np.uint8).tobytes()
+
+    async def body(c):
+        ec = c.graph.top
+        # warm jit off the clock; snapshot stats after so the reported
+        # coalescing ratio covers only the timed workload
+        await c.write_file("/warm", payload)
+        await c.read_file("/warm")
+        warm = ec.codec.dump_stats()
+        t0 = time.perf_counter()
+        await asyncio.gather(*(
+            c.write_file(f"/f{i}", payload) for i in range(n_clients)))
+        t_w = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        datas = await asyncio.gather(*(
+            c.read_file(f"/f{i}") for i in range(n_clients)))
+        t_r = time.perf_counter() - t0
+        assert all(d == payload for d in datas), "volume parity failure"
+        stats = ec.codec.dump_stats()
+        for key in ("launches", "batched_fops"):
+            stats[key] -= warm[key]
+        return t_w, t_r, stats
+
+    t_w, t_r, stats = _on_mounted_volume(body, backend)
     total = n_clients * file_mib
     return {
         f"{prefix}_write_MiB_s": round(total / t_w, 1),
@@ -162,6 +175,58 @@ def volume_bench(n_clients: int = 16, file_mib: int = 1,
         f"{prefix}_batched_fops": stats["batched_fops"],
         f"{prefix}_max_batch": stats["max_batch"],
     }
+
+
+def randrw_bench(n_clients: int = 64, backend: str = "auto") -> dict:
+    """BASELINE config #5: distributed-disperse 2x(4+2), concurrent
+    64-client mixed random read/write (the fio randrw analog) —
+    measures the coalesced codec regime under a mixed op stream
+    through the dht + two disperse groups."""
+    import asyncio
+    import random
+
+    rng = np.random.default_rng(3)
+    fsz = MIB
+    blk = 64 * 1024
+    payload = rng.integers(0, 256, fsz, dtype=np.uint8).tobytes()
+
+    async def client(c, i, n_ops, stats):
+        import os as _os
+
+        r = random.Random(i)
+        path = f"/rw{i % 16}"
+        for _ in range(n_ops):
+            off = r.randrange(0, fsz - blk)
+            if r.random() < 0.5:
+                f = await c.open(path, _os.O_RDONLY)
+                try:
+                    data = await f.read(blk, off)
+                finally:
+                    await f.close()
+                stats["read"] += len(data)
+            else:
+                f = await c.open(path, _os.O_RDWR)
+                try:
+                    await f.write(payload[off:off + blk], off)
+                finally:
+                    await f.close()
+                stats["write"] += blk
+
+    async def body(c):
+        for i in range(16):
+            await c.write_file(f"/rw{i}", payload)
+        stats = {"read": 0, "write": 0}
+        t0 = time.perf_counter()
+        await asyncio.gather(*(client(c, i, 4, stats)
+                               for i in range(n_clients)))
+        return stats, time.perf_counter() - t0
+
+    stats, dt = _on_mounted_volume(body, backend, groups=2)
+    total = (stats["read"] + stats["write"]) / MIB
+    return {"randrw_2x4p2_MiB_s": round(total / dt, 1),
+            "randrw_clients": n_clients,
+            "randrw_read_MiB": round(stats["read"] / MIB, 1),
+            "randrw_write_MiB": round(stats["write"] / MIB, 1)}
 
 
 def main() -> None:
@@ -313,6 +378,10 @@ def main() -> None:
         vol.update(volume_bench(backend="native", prefix="volume_native"))
     except Exception as e:  # volume bench is auxiliary; never sink the run
         vol["volume_bench_error"] = str(e)[:200]
+    try:
+        vol.update(randrw_bench(backend="native"))
+    except Exception as e:
+        vol["randrw_bench_error"] = str(e)[:200]
 
     print(json.dumps({
         "metric": "ec_encode_4p2_1MiB_stripes",

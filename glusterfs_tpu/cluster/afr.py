@@ -650,7 +650,12 @@ class ReplicateLayer(Layer):
                 # brick, including tie-winning brick 0.
                 failed = [i for i in idxs if i not in good]
                 met = len(good) >= 1
-                if met and failed:
+                # one TA trip per outage, not per write: skip the round
+                # trips when this client already branded these failures
+                # (and none of the survivors is one IT branded)
+                cached = (set(failed) <= self._ta_branded
+                          and not set(good) & self._ta_branded)
+                if met and failed and not cached:
                     try:
                         # a survivor that is ITSELF marked bad on the
                         # tie-breaker (stale, un-healed) must not take

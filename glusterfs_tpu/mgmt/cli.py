@@ -374,6 +374,10 @@ def _shell(server: str, flags: list[str]) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             continue
+        if not any(not w.startswith("-") for w in words):
+            # flag-only line would recurse into a nested shell
+            print("error: missing command", file=sys.stderr)
+            continue
         try:
             main(["--server", server, *flags, *words])
         except SystemExit:
@@ -423,6 +427,11 @@ def main(argv=None) -> int:
 
     args = p.parse_args(argv)
     if args.cmd is None:
+        if not sys.stdin.isatty():
+            # scripts/cron piping into `gftpu` must get the usage
+            # error they always got, not an accidental shell
+            p.error("a command is required (interactive shell needs "
+                    "a tty)")
         flags = [f for f, on in (("--json", args.json),
                                  ("--xml", args.xml)) if on]
         return _shell(args.server, flags)

@@ -318,10 +318,16 @@ class GeoRepWorker:
             n = 0
             try:
                 entries = await self.primary.listdir_with_stat(path)
-            except FopError:
-                # directory vanished mid-crawl (live primary churn):
-                # skip the subtree; a journal record covers its fate
-                return 0
+            except FopError as e:
+                if e.err in (errno.ENOENT, errno.ESTALE):
+                    # directory vanished mid-crawl (live churn): its
+                    # removal IS journaled, so skipping is safe
+                    return 0
+                # transient trouble (ENOTCONN, EIO): pre-session data
+                # has NO journal records — finishing the crawl now
+                # would mark initial_done with this subtree missing
+                # forever; re-raise so run() retries the whole walk
+                raise
             for name, ia in entries:
                 child = path.rstrip("/") + "/" + name
                 if ia is not None and ia.is_dir():

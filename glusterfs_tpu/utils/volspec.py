@@ -31,12 +31,26 @@ def brick_volumes(base, n: int, layers: list[tuple[str, dict]] | None = None,
 
 def ec_volfile(base, n: int, r: int, options: dict | None = None,
                brick_layers: list[tuple[str, dict]] | None = None,
-               top: str = "disp") -> str:
-    """A disperse (n = k+r) volume over n local posix bricks."""
-    chunks, tops = brick_volumes(base, n, brick_layers)
+               top: str = "disp", groups: int = 1) -> str:
+    """A disperse (n = k+r) volume over n local posix bricks; with
+    ``groups`` > 1, a distributed-disperse volume of ``groups``
+    (n, r) groups under a dht top (the 2x(4+2) bench shape)."""
+    chunks, tops = brick_volumes(base, n * groups, brick_layers)
     body = "".join(f"    option {k} {v}\n"
                    for k, v in (options or {}).items())
-    chunks.append(f"volume {top}\n    type cluster/disperse\n"
-                  f"    option redundancy {r}\n{body}"
-                  f"    subvolumes {' '.join(tops)}\nend-volume\n")
+    if groups == 1:
+        chunks.append(f"volume {top}\n    type cluster/disperse\n"
+                      f"    option redundancy {r}\n{body}"
+                      f"    subvolumes {' '.join(tops)}\nend-volume\n")
+    else:
+        subs = []
+        for g in range(groups):
+            gname = f"{top}-g{g}"
+            gt = tops[g * n:(g + 1) * n]
+            chunks.append(f"volume {gname}\n    type cluster/disperse\n"
+                          f"    option redundancy {r}\n{body}"
+                          f"    subvolumes {' '.join(gt)}\nend-volume\n")
+            subs.append(gname)
+        chunks.append(f"volume {top}\n    type cluster/distribute\n"
+                      f"    subvolumes {' '.join(subs)}\nend-volume\n")
     return "\n".join(chunks)
