@@ -650,23 +650,23 @@ class ReplicateLayer(Layer):
                 # brick, including tie-winning brick 0.
                 failed = [i for i in idxs if i not in good]
                 met = len(good) >= 1
-                # one TA trip per outage, not per write: skip the round
-                # trips when this client already branded these failures
-                # (and none of the survivors is one IT branded)
-                cached = (set(failed) <= self._ta_branded
-                          and not set(good) & self._ta_branded)
-                if met and failed and not cached:
+                if met and failed:
                     try:
-                        # a survivor that is ITSELF marked bad on the
-                        # tie-breaker (stale, un-healed) must not take
-                        # writes — acking onto it puts the only copy of
-                        # new data on a replica heal will overwrite
+                        # ALWAYS re-read the tie-breaker (one RTT, not
+                        # cached): another mount's heal may have
+                        # cleared a mark this client cached, and a
+                        # survivor that is ITSELF marked bad must not
+                        # take writes — acking onto it puts the only
+                        # copy of new data on a replica heal will
+                        # overwrite
                         marks = await self._ta_marks()
                         if any(i in marks for i in good):
                             raise FopError(
                                 errno.EIO, "surviving replica is "
                                 "marked bad on the thin-arbiter")
-                        await self._ta_mark_bad(failed)
+                        need = [i for i in failed if i not in marks]
+                        if need:  # write RTT only when mark is absent
+                            await self._ta_mark_bad(need)
                         self._ta_branded |= set(failed)
                     except FopError:
                         met = False

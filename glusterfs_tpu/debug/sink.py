@@ -1,0 +1,66 @@
+"""debug/sink — terminate every fop with success.
+
+Reference: xlators/debug/sink (sink.c, 93 LoC): a graph terminator
+that answers everything positively without any backend, used to
+isolate upper-layer behavior and as a load-generator target.  The
+same trimmed fop set here: lookups/stats answer with a synthetic
+root-ish iatt, writes swallow bytes, reads return empty.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..core.iatt import IAType, Iatt, ROOT_GFID
+from ..core.layer import FdObj, Layer, Loc, register
+
+
+def _ia(loc: Loc) -> Iatt:
+    now = time.time()
+    return Iatt(gfid=loc.gfid or ROOT_GFID, ia_type=IAType.DIR
+                if (loc.path or "/") == "/" else IAType.REG,
+                mode=0o755, uid=0, gid=0, size=0, nlink=1,
+                atime=now, mtime=now, ctime=now)
+
+
+@register("debug/sink")
+class SinkLayer(Layer):
+    async def lookup(self, loc: Loc, xdata: dict | None = None):
+        return _ia(loc), {}
+
+    async def stat(self, loc: Loc, xdata: dict | None = None):
+        return _ia(loc)
+
+    async def open(self, loc: Loc, flags: int = 0,
+                   xdata: dict | None = None):
+        return FdObj(loc.gfid or ROOT_GFID, flags, path=loc.path)
+
+    async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
+                     xdata: dict | None = None):
+        return FdObj(loc.gfid or ROOT_GFID, flags,
+                     path=loc.path), _ia(loc)
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        return len(data)
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        return b""
+
+    async def flush(self, fd: FdObj, xdata: dict | None = None):
+        return {}
+
+    async def release(self, fd: FdObj):
+        return None
+
+    async def mkdir(self, loc: Loc, mode: int = 0o755,
+                    xdata: dict | None = None):
+        return _ia(loc)
+
+    async def unlink(self, loc: Loc, xdata: dict | None = None):
+        return {}
+
+    async def readdir(self, fd: FdObj, size: int = 0, offset: int = 0,
+                      xdata: dict | None = None):
+        return []

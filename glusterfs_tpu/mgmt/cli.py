@@ -278,14 +278,11 @@ async def _run(args) -> Any:
             finally:
                 await client.unmount()
         if sub == "profile":
-            client = await mount_volume(host, port, args.name)
-            try:
-                from ..debug.io_stats import IoStatsLayer
-
-                st = _find_layer(client.graph, IoStatsLayer)
-                return st.profile() if st else {}
-            finally:
-                await client.unmount()
+            # BRICK-side cumulative stats (volume profile info): the
+            # bricks have been counting since they started — a freshly
+            # mounted client's own io-stats would be empty
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-profile", name=args.name)
         if sub == "top":
             # volume top NAME [open|read|write|read-bytes|write-bytes]
             # [COUNT] — ranked per-path counters from each BRICK's
@@ -374,8 +371,10 @@ def _shell(server: str, flags: list[str]) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             continue
-        if not any(not w.startswith("-") for w in words):
+        if not any(not w.startswith("-") for w in words) and \
+                not {"-h", "--help"} & set(words):
             # flag-only line would recurse into a nested shell
+            # (--help is fine: argparse SystemExits before the shell)
             print("error: missing command", file=sys.stderr)
             continue
         try:

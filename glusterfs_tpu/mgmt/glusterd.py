@@ -833,6 +833,56 @@ class Glusterd:
     _TOP_METRICS = ("open", "read", "write", "read-bytes",
                     "write-bytes")
 
+    async def _gather_bricks(self, local_op: str, **kw) -> dict:
+        """Fan a per-node brick query out to every node CONCURRENTLY
+        (bounded per node) and merge the 'bricks' maps — shared by
+        volume top / profile; a hung peer costs one timeout, not a
+        serial wait, and never hides the other nodes' answers."""
+        async def one(node):
+            try:
+                return await asyncio.wait_for(
+                    self._node_call(node, local_op, **kw), 30)
+            except Exception:
+                return {}
+
+        parts = await asyncio.gather(
+            *(one(n) for n in self._all_nodes()))
+        out: dict[str, dict] = {}
+        for part in parts:
+            out.update(part.get("bricks", {}))
+        return out
+
+    async def op_volume_profile(self, name: str) -> dict:
+        """``gluster volume profile <v> info`` — BRICK-side cumulative
+        per-fop counters/latency from each brick's io-stats layer (the
+        reference aggregates brick responses the same way;
+        io-stats.c:129-197)."""
+        vol = self._vol(name)
+        if vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        bricks = await self._gather_bricks("volume-profile-local",
+                                           name=name)
+        return {"volume": name, "bricks": bricks}
+
+    async def op_volume_profile_local(self, name: str) -> dict:
+        vol = self._vol(name)
+        out: dict[str, dict] = {}
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid:
+                continue
+            port = self.ports.get(b["name"])
+            if not port:
+                continue
+            dump = await self._brick_statedump(
+                vol, port, subvol=b["name"] + "-server")
+            layers = (dump or {}).get("layers", {})
+            prof = next((l.get("private") for l in layers.values()
+                         if l.get("type") == "debug/io-stats"
+                         and "fops" in (l.get("private") or {})), None)
+            if prof is not None:
+                out[b["name"]] = prof
+        return {"bricks": out}
+
     async def op_volume_top(self, name: str, metric: str = "open",
                             count: int = 10) -> dict:
         """``gluster volume top <v> open|read|write|read-bytes|
@@ -847,16 +897,10 @@ class Glusterd:
         vol = self._vol(name)
         if vol["status"] != "started":
             raise MgmtError(f"volume {name} not started")
-        out: dict[str, list] = {}
-        for node in self._all_nodes():
-            try:
-                part = await self._node_call(
-                    node, "volume-top-local", name=name,
-                    metric=metric, count=int(count))
-            except Exception:
-                continue  # node down: its bricks are offline anyway
-            out.update(part.get("bricks", {}))
-        return {"volume": name, "metric": metric, "bricks": out}
+        bricks = await self._gather_bricks(
+            "volume-top-local", name=name, metric=metric,
+            count=int(count))
+        return {"volume": name, "metric": metric, "bricks": bricks}
 
     async def op_volume_top_local(self, name: str, metric: str = "open",
                                   count: int = 10) -> dict:

@@ -165,3 +165,21 @@ end-volume
     assert 2 in res["healed"]
     assert c._run(ec.heal_info(Loc("/f")))["bad"] == []
     c.close()
+
+
+def test_sink_terminates_graph(tmp_path):
+    """debug/sink answers everything without a backend (sink.c)."""
+    vf = """
+volume devnull
+    type debug/sink
+end-volume
+"""
+    c = SyncClient(Graph.construct(vf))
+    c.mount()
+    f = c.create("/anything")
+    assert f.write(b"swallowed", 0) == 9
+    f.close()
+    assert c.stat("/whatever") is not None
+    c.mkdir("/dir")
+    c.unlink("/anything")
+    c.close()

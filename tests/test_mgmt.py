@@ -121,6 +121,11 @@ def test_glusterd_volume_lifecycle(tmp_path):
                         for r in rows_]
                 assert any(r["path"] == "/hello" and r["writes"] >= 1
                            for r in rows), top
+                # `volume profile`: BRICK-side cumulative fop stats
+                prof = await c.call("volume-profile", name="vol1")
+                assert len(prof["bricks"]) == 6
+                assert all(p["fops"]["writev"]["count"] >= 1
+                           for p in prof["bricks"].values()), prof
 
             async with MgmtClient(d.host, d.port) as c:
                 await c.call("volume-stop", name="vol1")
