@@ -229,6 +229,37 @@ def randrw_bench(n_clients: int = 64, backend: str = "auto") -> dict:
             "randrw_write_MiB": round(stats["write"] / MIB, 1)}
 
 
+def smallfile_bench(n_files: int = 200, backend: str = "native") -> dict:
+    """glfs-bm analog (extras/benchmarking): small-file metadata rate —
+    create+write+close, stat, read, unlink over many 4 KiB files on a
+    4+2 volume; reports ops/s per phase."""
+    payload = b"s" * 4096
+
+    async def body(c):
+        out = {}
+        t0 = time.perf_counter()
+        for i in range(n_files):
+            await c.write_file(f"/s{i:04d}", payload)
+        out["create"] = n_files / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for i in range(n_files):
+            await c.stat(f"/s{i:04d}")
+        out["stat"] = n_files / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for i in range(n_files):
+            await c.read_file(f"/s{i:04d}")
+        out["read"] = n_files / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for i in range(n_files):
+            await c.unlink(f"/s{i:04d}")
+        out["unlink"] = n_files / (time.perf_counter() - t0)
+        return out
+
+    rates = _on_mounted_volume(body, backend)
+    return {f"smallfile_{k}_per_s": round(v, 1)
+            for k, v in rates.items()}
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -315,7 +346,9 @@ def main() -> None:
             sfr = np.asarray(jax.block_until_ready(efn(sd)))
             assert np.array_equal(sfr, gf256.ref_encode(sdata, sk, sn)), \
                 f"{sk}+{sr} encode parity"
-            et = device_loop_seconds(efn, sd)
+            # best-of like the headline: a cold/contended tunnel
+            # window must not record a bogus low for a config
+            et = best_of(lambda: device_loop_seconds(efn, sd), 2, 2.0)
             srows = tuple(range(sr, sn))  # first R fragments lost
             if on_tpu:
                 dfn = gf256_pallas._fused_decode_fn(sk, srows, False)
@@ -326,7 +359,7 @@ def main() -> None:
             sv = jnp.asarray(sfr[list(srows)])
             assert np.array_equal(np.asarray(dfn(sv)), sdata), \
                 f"{sk}+{sr} decode parity"
-            dt = device_loop_seconds(dfn, sv)
+            dt = best_of(lambda: device_loop_seconds(dfn, sv), 2, 2.0)
             sweep[f"{sk}+{sr}"] = {
                 "encode_MiB_s": round(sweep_bytes / MIB / et, 1),
                 "decode_MiB_s": round(sweep_bytes / MIB / dt, 1),
@@ -382,6 +415,10 @@ def main() -> None:
         vol.update(randrw_bench(backend="native"))
     except Exception as e:
         vol["randrw_bench_error"] = str(e)[:200]
+    try:
+        vol.update(smallfile_bench())
+    except Exception as e:
+        vol["smallfile_bench_error"] = str(e)[:200]
 
     print(json.dumps({
         "metric": "ec_encode_4p2_1MiB_stripes",

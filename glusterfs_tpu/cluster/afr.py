@@ -619,19 +619,21 @@ class ReplicateLayer(Layer):
                     raise FopError(errno.ENOTCONN,
                                    f"{op}: no data replica up")
                 # tie-breaker gate: the lone survivor may take writes
-                # only after branding the absent replica bad — and never
-                # if it is itself the branded one.  A grant this mount
-                # already obtained is cached (one TA trip per outage,
-                # not per write).
+                # only after branding the absent replica bad — and
+                # never if it is itself the branded one.  Marks are
+                # RE-READ every degraded write (another mount's heal
+                # may have cleared a brand this client cached); only
+                # the branding WRITE is skipped when already present.
                 down = [j for j in range(self.n) if j not in idxs]
-                if not set(down) <= self._ta_branded:
-                    marks = await self._ta_marks()
-                    if any(i in marks for i in idxs):
-                        raise FopError(errno.EIO,
-                                       f"{op}: this replica is marked "
-                                       f"bad on the thin-arbiter")
-                    await self._ta_mark_bad(down)
-                    self._ta_branded |= set(down)
+                marks = await self._ta_marks()
+                if any(i in marks for i in idxs):
+                    raise FopError(errno.EIO,
+                                   f"{op}: this replica is marked "
+                                   f"bad on the thin-arbiter")
+                need = [j for j in down if j not in marks]
+                if need:
+                    await self._ta_mark_bad(need)
+                self._ta_branded |= set(down)
             await self._dispatch(
                 idxs, "xattrop",
                 lambda i: ((loc, "add64",
