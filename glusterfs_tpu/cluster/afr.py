@@ -103,9 +103,6 @@ class ReplicateLayer(Layer):
         self._sb_cache: set[bytes] = set()
         self.ta = None
         self.ta_up = True
-        # replicas already branded bad on the tie-breaker by THIS mount:
-        # steady-state degraded writes skip the TA round trips
-        self._ta_branded: set[int] = set()
         if self.opts["thin-arbiter"]:
             # the tie-breaker child is NOT a replica: it leaves the
             # data-plane index space entirely
@@ -135,15 +132,11 @@ class ReplicateLayer(Layer):
             idx = self.children.index(source)
             if idx >= self.n:  # the thin-arbiter child
                 self.ta_up = event is not Event.CHILD_DOWN
-                self._ta_branded.clear()  # re-verify after reconnect
                 return
             if event is Event.CHILD_DOWN:
                 self.up[idx] = False
             elif event is Event.CHILD_UP:
                 self.up[idx] = True
-                # a returning peer may have been healed and un-branded
-                # by another mount: drop the cached grant
-                self._ta_branded.discard(idx)
             ev = Event.CHILD_UP if self._quorum_met(
                 {i for i, u in enumerate(self.up) if u}) else \
                 Event.CHILD_DOWN
@@ -275,7 +268,6 @@ class ReplicateLayer(Layer):
     async def _ta_clear(self, healed: list[int]) -> None:
         if self.ta is None:
             return
-        self._ta_branded.difference_update(healed)
         try:
             await self.ta.setxattr(
                 Loc(self.TA_PATH),
@@ -633,7 +625,6 @@ class ReplicateLayer(Layer):
                 need = [j for j in down if j not in marks]
                 if need:
                     await self._ta_mark_bad(need)
-                self._ta_branded |= set(down)
             await self._dispatch(
                 idxs, "xattrop",
                 lambda i: ((loc, "add64",
@@ -669,7 +660,6 @@ class ReplicateLayer(Layer):
                         need = [i for i in failed if i not in marks]
                         if need:  # write RTT only when mark is absent
                             await self._ta_mark_bad(need)
-                        self._ta_branded |= set(failed)
                     except FopError:
                         met = False
             else:

@@ -95,12 +95,42 @@ async def content_hash(layer: Layer, path: str, gfid: bytes,
     return h.hexdigest()
 
 
+class TokenBucket:
+    """Scrub bandwidth cap — the libglusterfs throttle-tbf.c analog:
+    the scrubber refills ``rate`` byte-tokens per second and sleeps
+    when a read would overdraw, so background verification never
+    starves live I/O.  rate <= 0 disables."""
+
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+        self.tokens = self.rate
+        self._t = time.monotonic()
+
+    async def take(self, n: int) -> None:
+        if self.rate <= 0:
+            return
+        while True:
+            now = time.monotonic()
+            self.tokens = min(self.rate,
+                              self.tokens + (now - self._t) * self.rate)
+            self._t = now
+            # an object bigger than one second's budget proceeds when
+            # the bucket is full (tbf_mod semantics: never starve)
+            if self.tokens >= n or self.tokens >= self.rate:
+                self.tokens -= n
+                return
+            await asyncio.sleep(
+                min(1.0, (min(n, self.rate) - self.tokens) / self.rate))
+
+
 class BrickBitd:
     """Signer + scrubber over one brick graph top."""
 
-    def __init__(self, layer: Layer, quiesce: float = 120.0):
+    def __init__(self, layer: Layer, quiesce: float = 120.0,
+                 throttle: float = 64 * (1 << 20)):
         self.layer = layer
         self.quiesce = quiesce
+        self.tbf = TokenBucket(throttle)
         self.signed = 0
         self.scrubbed = 0
         self.corrupted: list[str] = []
@@ -134,6 +164,7 @@ class BrickBitd:
                 continue  # signature current
             if now - ia.mtime < self.quiesce:
                 continue  # still hot; sign once it goes quiet
+            await self.tbf.take(ia.size)  # signer paces like the scrubber
             try:
                 digest = await content_hash(self.layer, path, ia.gfid,
                                             ia.size)
@@ -162,6 +193,7 @@ class BrickBitd:
             sig = self._sig(x)
             if sig is None or sig.get("ts", 0) < ia.mtime:
                 continue  # changed since signing: the signer's job
+            await self.tbf.take(ia.size)  # throttle-tbf pacing
             try:
                 digest = await content_hash(self.layer, path, ia.gfid,
                                             ia.size)
@@ -221,7 +253,8 @@ async def _amain(args) -> None:
         if all(l.connected for l in layers):
             break
         await asyncio.sleep(0.1)
-    workers = [BrickBitd(l, args.quiesce) for l in layers]
+    workers = [BrickBitd(l, args.quiesce, args.scrub_throttle)
+               for l in layers]
 
     async def loop_fn():
         while True:
@@ -260,6 +293,9 @@ def main(argv=None) -> int:
     svcutil.add_ssl_args(p)
     p.add_argument("--quiesce", type=float, default=120.0)
     p.add_argument("--scrub-interval", type=float, default=60.0)
+    p.add_argument("--scrub-throttle", type=float,
+                   default=64 * (1 << 20),
+                   help="scrub bandwidth cap, bytes/s (0 = unlimited)")
     p.add_argument("--statusfile", default="")
     args = p.parse_args(argv)
     asyncio.run(_amain(args))

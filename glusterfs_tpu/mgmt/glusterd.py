@@ -1801,6 +1801,8 @@ class Glusterd:
                  "--quiesce", str(opts.get("bitrot.signer-quiesce", 120)),
                  "--scrub-interval",
                  str(opts.get("bitrot.scrub-interval", 60)),
+                 "--scrub-throttle",
+                 str(opts.get("bitrot.scrub-throttle", 64 * (1 << 20))),
                  "--statusfile", statusfile],
                 env=env, stdout=subprocess.DEVNULL, stderr=logf)
 

@@ -72,6 +72,7 @@ OPTION_MAP = {
     # consumed by glusterd's bitd spawner, not a graph layer
     "bitrot.scrub-interval": ("mgmt/bitd", "scrub-interval"),
     "bitrot.signer-quiesce": ("mgmt/bitd", "quiesce"),
+    "bitrot.scrub-throttle": ("mgmt/bitd", "scrub-throttle"),
     "features.cache-invalidation": ("features/upcall", "__enable__"),
     "features.cache-invalidation-timeout": ("features/upcall",
                                             "cache-invalidation-timeout"),
