@@ -40,6 +40,8 @@ from ..features.bit_rot_stub import XA_BAD, XA_SIG
 log = gflog.get_logger("bitd")
 
 HASH_WINDOW = 1 << 20
+# one default, referenced by glusterd's spawner and the argparse flag
+DEFAULT_SCRUB_THROTTLE = 64 * (1 << 20)  # bytes/s
 
 
 async def _release(layer: Layer, fd) -> None:
@@ -127,7 +129,7 @@ class BrickBitd:
     """Signer + scrubber over one brick graph top."""
 
     def __init__(self, layer: Layer, quiesce: float = 120.0,
-                 throttle: float = 64 * (1 << 20)):
+                 throttle: float = DEFAULT_SCRUB_THROTTLE):
         self.layer = layer
         self.quiesce = quiesce
         self.tbf = TokenBucket(throttle)
@@ -294,7 +296,7 @@ def main(argv=None) -> int:
     p.add_argument("--quiesce", type=float, default=120.0)
     p.add_argument("--scrub-interval", type=float, default=60.0)
     p.add_argument("--scrub-throttle", type=float,
-                   default=64 * (1 << 20),
+                   default=DEFAULT_SCRUB_THROTTLE,
                    help="scrub bandwidth cap, bytes/s (0 = unlimited)")
     p.add_argument("--statusfile", default="")
     args = p.parse_args(argv)

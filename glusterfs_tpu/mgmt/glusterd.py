@@ -1778,6 +1778,8 @@ class Glusterd:
                 pass
 
     def _spawn_bitd(self, vol: dict) -> None:
+        from . import bitd as _bitd_mod
+
         name = vol["name"]
         proc = self.bitd.get(name)
         if proc is not None and proc.poll() is None:
@@ -1802,7 +1804,8 @@ class Glusterd:
                  "--scrub-interval",
                  str(opts.get("bitrot.scrub-interval", 60)),
                  "--scrub-throttle",
-                 str(opts.get("bitrot.scrub-throttle", 64 * (1 << 20))),
+                 str(opts.get("bitrot.scrub-throttle",
+                              _bitd_mod.DEFAULT_SCRUB_THROTTLE)),
                  "--statusfile", statusfile],
                 env=env, stdout=subprocess.DEVNULL, stderr=logf)
 

@@ -106,3 +106,18 @@ end-volume
             out.append(f"volume top\n    type {cluster_type}\n{opts}"
                        f"    subvolumes {subs}\nend-volume\n")
         return "\n".join(out)
+
+
+async def wait_async(pred, timeout: float = 60.0,
+                     interval: float = 0.3) -> bool:
+    """Poll an async predicate until true or timeout (EXPECT_WITHIN)."""
+    import asyncio
+
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while True:
+        if await pred():
+            return True
+        if loop.time() > deadline:
+            return False
+        await asyncio.sleep(interval)

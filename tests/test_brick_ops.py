@@ -12,15 +12,7 @@ from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
                                          mount_volume)
 
 
-async def _wait(pred, timeout=60.0):
-    loop = asyncio.get_event_loop()
-    deadline = loop.time() + timeout
-    while True:
-        if await pred():
-            return True
-        if loop.time() > deadline:
-            return False
-        await asyncio.sleep(0.25)
+from tests.harness import wait_async as _wait
 
 
 @pytest.mark.slow

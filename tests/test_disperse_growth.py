@@ -1,0 +1,98 @@
+"""Growing a single-group disperse volume into distributed-disperse
+by add-brick (whole groups), then shrinking back by remove-brick of a
+group — the glusterd-brick-ops.c disperse-geometry paths."""
+
+import asyncio
+
+import pytest
+
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                         mount_volume)
+
+
+from tests.harness import wait_async as _wait
+
+
+@pytest.mark.slow
+def test_disperse_volume_grows_to_distributed(tmp_path):
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="gv", vtype="disperse",
+                             bricks=[{"path": str(tmp_path / f"b{i}")}
+                                     for i in range(3)],
+                             redundancy=1)
+                await c.call("volume-start", name="gv")
+                m = await mount_volume(d.host, d.port, "gv")
+                try:
+                    names = [f"f{i:02d}" for i in range(12)]
+                    for n in names:
+                        await m.write_file(f"/{n}", n.encode() * 40)
+
+                    # partial group must be refused (2+1 geometry)
+                    with pytest.raises(FopError):
+                        await c.call("volume-add-brick", name="gv",
+                                     bricks=[{"path":
+                                              str(tmp_path / "bx")}])
+
+                    # whole group: 3 more bricks -> 2x(2+1)
+                    out = await c.call(
+                        "volume-add-brick", name="gv",
+                        bricks=[{"path": str(tmp_path / f"b{i}")}
+                                for i in range(3, 6)])
+                    assert len(out["added"]) == 3
+                    info = await c.call("volume-info", name="gv")
+                    assert info["gv"]["group-size"] == 3
+
+                    async def swapped():
+                        types = [l.type_name
+                                 for l in m.graph.by_name.values()]
+                        return (types.count("cluster/disperse") == 2
+                                and "cluster/distribute" in types)
+
+                    assert await _wait(swapped), "graph not distributed"
+                    # old data readable; new files spread to group 2
+                    for n in names:
+                        assert await m.read_file(f"/{n}") == \
+                            n.encode() * 40
+                    for i in range(12, 30):
+                        await m.write_file(f"/g{i}", b"NEW")
+                    import os as _os
+
+                    g2 = [f for f in _os.listdir(tmp_path / "b3")
+                          if f.startswith("g")]
+                    assert g2, "no new data placed on the second group"
+
+                    # drain + remove the SECOND group
+                    await c.call(
+                        "volume-remove-brick", name="gv",
+                        bricks=[f"gv-brick-{i}" for i in range(3, 6)],
+                        action="start")
+
+                    async def drained():
+                        st = await c.call("volume-remove-brick",
+                                          name="gv", bricks=[],
+                                          action="status")
+                        return st.get("status") == "completed"
+
+                    assert await _wait(drained), "drain never finished"
+                    await c.call("volume-remove-brick", name="gv",
+                                 bricks=[], action="commit")
+                    info = await c.call("volume-info", name="gv")
+                    assert len(info["gv"]["bricks"]) == 3
+                    # everything still readable after the shrink
+                    for n in names:
+                        assert await m.read_file(f"/{n}") == \
+                            n.encode() * 40
+                    for i in range(12, 30):
+                        assert await m.read_file(f"/g{i}") == b"NEW"
+                finally:
+                    await m.unmount()
+                await c.call("volume-stop", name="gv")
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
