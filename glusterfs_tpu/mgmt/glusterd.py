@@ -38,6 +38,7 @@ from typing import Any
 
 from ..core import gflog
 from ..core.events import gf_event
+from .bitd import DEFAULT_SCRUB_THROTTLE
 from ..core.fops import FopError
 from ..rpc import wire
 from . import volgen
@@ -1778,8 +1779,6 @@ class Glusterd:
                 pass
 
     def _spawn_bitd(self, vol: dict) -> None:
-        from . import bitd as _bitd_mod
-
         name = vol["name"]
         proc = self.bitd.get(name)
         if proc is not None and proc.poll() is None:
@@ -1805,7 +1804,7 @@ class Glusterd:
                  str(opts.get("bitrot.scrub-interval", 60)),
                  "--scrub-throttle",
                  str(opts.get("bitrot.scrub-throttle",
-                              _bitd_mod.DEFAULT_SCRUB_THROTTLE)),
+                              DEFAULT_SCRUB_THROTTLE)),
                  "--statusfile", statusfile],
                 env=env, stdout=subprocess.DEVNULL, stderr=logf)
 

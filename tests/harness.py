@@ -121,3 +121,40 @@ async def wait_async(pred, timeout: float = 60.0,
         if loop.time() > deadline:
             return False
         await asyncio.sleep(interval)
+
+
+def spawn_fuse(server: str, volume: str, ready: str, mnt: str,
+               timeout: float = 60.0):
+    """Spawn the FUSE bridge for a managed volume and block until the
+    mount is ready.  Returns the Popen; callers stop it with
+    stop_fuse().  One home for the hardened recipe (module spawn, env
+    scrub, readyfile poll with death detection)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "glusterfs_tpu.mount.fuse_bridge",
+         "--server", server, "--volume", volume,
+         "--readyfile", ready, str(mnt)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    deadline = time.time() + timeout
+    while not os.path.exists(ready):
+        if proc.poll() is not None:
+            raise RuntimeError("fuse daemon died: "
+                               + proc.stderr.read().decode()[-2000:])
+        if time.time() > deadline:
+            proc.terminate()
+            raise TimeoutError("mount never became ready")
+        time.sleep(0.1)
+    return proc
+
+
+def stop_fuse(proc, mnt: str) -> None:
+    """Terminate the bridge, wait it out, and lazily unmount."""
+    proc.terminate()
+    try:
+        proc.wait(timeout=15)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    subprocess.run(["umount", "-l", str(mnt)],
+                   stderr=subprocess.DEVNULL)

@@ -225,23 +225,11 @@ def test_e2e_fuse_disperse_degraded(tmp_path):
 
     lt = _LoopThread()
     d = lt.run(setup())
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    fuse_proc = subprocess.Popen(
-        [sys.executable, "-m", "glusterfs_tpu.mount.fuse_bridge",
-         "--server", f"{d.host}:{d.port}", "--volume", "fv",
-         "--readyfile", str(ready), str(mnt)],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    from tests.harness import spawn_fuse, stop_fuse
+
+    fuse_proc = spawn_fuse(f"{d.host}:{d.port}", "fv", str(ready),
+                           str(mnt))
     try:
-        deadline = time.time() + 60
-        while not ready.exists():
-            if fuse_proc.poll() is not None:
-                raise RuntimeError("fuse daemon died: "
-                                   + fuse_proc.stderr.read().decode()[-2000:])
-            if time.time() > deadline:
-                raise TimeoutError("mount never became ready")
-            time.sleep(0.1)
 
         blob = os.urandom(1 << 20)
         with open(mnt / "big", "wb") as f:
@@ -268,13 +256,8 @@ def test_e2e_fuse_disperse_degraded(tmp_path):
         lt.run(admin("volume-heal", name="fv", action="full"))
         assert (mnt / "degraded").read_bytes() == blob2
     finally:
-        fuse_proc.terminate()
-        try:
-            fuse_proc.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            fuse_proc.kill()
-        subprocess.run(["umount", "-l", str(mnt)],
-                       stderr=subprocess.DEVNULL)
+        stop_fuse(fuse_proc, str(mnt))
+
         async def teardown():
             await admin.d.stop()
         lt.run(teardown())

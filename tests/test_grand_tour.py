@@ -1,0 +1,100 @@
+"""Grand tour: one scenario threading the major subsystems together —
+a multiplexed distributed-disperse volume served through a real kernel
+FUSE mount, driven by real programs, surviving brick detach and
+growing live.  The closest analog of the reference's long .t flows."""
+
+import os
+import subprocess
+import time
+
+import pytest
+
+from tests.harness import spawn_fuse, stop_fuse
+
+needs_fuse = pytest.mark.skipif(
+    not os.path.exists("/dev/fuse") or os.geteuid() != 0,
+    reason="needs /dev/fuse and root")
+
+
+@needs_fuse
+@pytest.mark.slow
+def test_grand_tour(tmp_path):
+    import asyncio
+
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient)
+
+    mnt = tmp_path / "mnt"
+    mnt.mkdir()
+
+    def sh(cmd):
+        r = subprocess.run(cmd, shell=True, capture_output=True,
+                           text=True)
+        assert r.returncode == 0, (cmd, r.stderr)
+        return r.stdout
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        fuse = None
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="tour",
+                             vtype="disperse",
+                             bricks=[{"path": str(tmp_path / f"b{i}")}
+                                     for i in range(3)],
+                             redundancy=1)
+                await c.call("volume-set", name="tour",
+                             key="cluster.brick-multiplex", value="on")
+                await c.call("volume-start", name="tour")
+                st = await c.call("volume-status", name="tour")
+                assert len({b["port"] for b in st["bricks"]}) == 1
+
+            fuse = await asyncio.to_thread(
+                spawn_fuse, f"127.0.0.1:{d.port}", "tour",
+                str(tmp_path / "ready"), str(mnt))
+
+            # real programs against the kernel mount
+            await asyncio.to_thread(
+                sh, f"dd if=/dev/urandom of={tmp_path}/blob bs=256K "
+                    f"count=4 2>/dev/null && cp {tmp_path}/blob "
+                    f"{mnt}/blob && cmp {tmp_path}/blob {mnt}/blob")
+            s0 = (await asyncio.to_thread(
+                sh, f"sha1sum < {mnt}/blob")).split()[0]
+
+            # detach one mux'd brick: degraded reads keep working
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-brick", name="tour",
+                             brick="tour-brick-0", action="stop")
+            s1 = (await asyncio.to_thread(
+                sh, f"sha1sum < {mnt}/blob")).split()[0]
+            assert s1 == s0
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-brick", name="tour",
+                             brick="tour-brick-0", action="start")
+
+                # grow live into 2x(2+1) while the kernel mount serves
+                await c.call("volume-add-brick", name="tour",
+                             bricks=[{"path": str(tmp_path / f"b{i}")}
+                                     for i in range(3, 6)])
+            await asyncio.sleep(2)  # graph swap reaches the fuse client
+            await asyncio.to_thread(
+                sh, f"cmp {tmp_path}/blob {mnt}/blob")
+            for i in range(8):
+                await asyncio.to_thread(
+                    sh, f"echo tour{i} > {mnt}/n{i} && "
+                        f"grep -q tour{i} {mnt}/n{i}")
+            async with MgmtClient(d.host, d.port) as c:
+                st = await c.call("volume-status", name="tour")
+                assert len(st["bricks"]) == 6
+                assert all(b["online"] for b in st["bricks"])
+        finally:
+            if fuse is not None:
+                await asyncio.to_thread(stop_fuse, fuse, str(mnt))
+            try:
+                async with MgmtClient(d.host, d.port) as c:
+                    await c.call("volume-stop", name="tour")
+            except Exception:
+                pass
+            await d.stop()
+
+    asyncio.run(run())
