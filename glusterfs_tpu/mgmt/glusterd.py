@@ -45,6 +45,11 @@ from . import volgen
 
 log = gflog.get_logger("mgmt")
 
+# this build's management op-version (xlator.h:758 / GD_OP_VERSION):
+# peers advertise theirs at probe time and the cluster operates at the
+# minimum, gating newer volume-set keys until every member upgrades
+OP_VERSION = 2
+
 
 class MgmtError(Exception):
     pass
@@ -62,6 +67,7 @@ class Glusterd:
         self._store = os.path.join(self.workdir, "store.json")
         self.state = self._load()
         self.uuid = self.state.setdefault("uuid", str(uuid.uuid4()))
+        self.op_version = OP_VERSION
         self.bricks: dict[str, subprocess.Popen] = {}  # brickname -> proc
         self.ports: dict[str, int] = {}  # portmap: brickname -> port
         self.shd: dict[str, subprocess.Popen] = {}  # volname -> shd proc
@@ -252,7 +258,33 @@ class Glusterd:
 
     def _peer_info(self) -> dict:
         return {"uuid": self.uuid, "host": self.host, "port": self.port,
-                "workdir": self.workdir}
+                "workdir": self.workdir, "op-version": self.op_version}
+
+    def cluster_op_version(self) -> int:
+        """The version every member supports: min over self + peers
+        (peers probed by older builds advertise nothing -> 1)."""
+        vers = [self.op_version]
+        for p in self.state["peers"].values():
+            if p["uuid"] != self.uuid:
+                vers.append(int(p.get("op-version", 1)))
+        return min(vers)
+
+    async def _refresh_peers(self) -> None:
+        """Re-handshake every reachable peer so stored peer info (esp.
+        op-version) reflects its CURRENT build — the stored value is a
+        probe-time snapshot, and an upgraded-and-restarted peer must be
+        able to lift the cluster op-version without detach+re-probe
+        (the reference re-advertises on every RPC handshake)."""
+        for p in list(self.state["peers"].values()):
+            if p["uuid"] == self.uuid:
+                continue
+            try:
+                info = await asyncio.wait_for(self._node_call(
+                    p, "peer-hello", me=self._peer_info()), 5)
+                self.state["peers"][info["uuid"]] = info
+            except Exception:
+                continue  # unreachable: keep the snapshot
+        self._save()
 
     def _all_nodes(self) -> list[dict]:
         return [self._peer_info()] + [
@@ -671,6 +703,20 @@ class Glusterd:
     async def op_volume_set(self, name: str, key: str, value: str) -> dict:
         if key not in volgen.OPTION_MAP:
             raise MgmtError(f"unknown option {key!r}")
+        need = volgen.OPTION_MIN_OPVERSION.get(key, 1)
+        if need > self.cluster_op_version():
+            # stored versions are probe-time snapshots: re-handshake
+            # before refusing, so upgraded-and-restarted peers lift the
+            # cluster without a detach + re-probe
+            await self._refresh_peers()
+        have = self.cluster_op_version()
+        if need > have:
+            # mixed-version skew guard (glusterd op-version gating): a
+            # member that doesn't understand the option would silently
+            # build wrong volfiles
+            raise MgmtError(
+                f"option {key!r} requires cluster op-version {need}, "
+                f"but a member is at {have} (upgrade all nodes first)")
         if key == "server.ssl" and volgen._bool(value):
             opts = self._vol(name).get("options", {})
             if not opts.get("ssl.cert"):

@@ -18,6 +18,22 @@ from __future__ import annotations
 
 from typing import Any
 
+# Options introduced after op-version 1 (glusterd-volume-set.c's
+# .op_version fields): a mixed-version cluster may only set keys every
+# member understands — the cluster op-version is the MINIMUM any
+# member advertises (xlator.h:758 op_version model).
+OPTION_MIN_OPVERSION = {
+    "cluster.brick-multiplex": 2,
+    "cluster.nufa": 2,
+    "cluster.nufa-local-volume-name": 2,
+    "cluster.switch-pattern": 2,
+    "cluster.server-quorum-type": 2,
+    "cluster.server-quorum-ratio": 2,
+    "features.simple-quota": 2,
+    "bitrot.scrub-throttle": 2,
+    "storage.health-check-interval": 2,
+}
+
 # volume-set key -> (layer type, option name)  (glusterd-volume-set.c map)
 OPTION_MAP = {
     "auth.allow": ("protocol/server", "auth-allow"),

@@ -179,3 +179,44 @@ def test_server_quorum_fences_and_restores_bricks(tmp_path):
         return not await coro
 
     asyncio.run(run())
+
+
+def test_op_version_gates_new_options(tmp_path):
+    """Mixed-version skew guard (glusterd op-version): options newer
+    than the cluster minimum are refused until every member upgrades."""
+
+    async def run():
+        d1 = Glusterd(str(tmp_path / "g1"))
+        await d1.start()
+        d2 = Glusterd(str(tmp_path / "g2"))
+        d2.op_version = 1  # an old build in the cluster
+        await d2.start()
+        try:
+            async with MgmtClient(d1.host, d1.port) as c:
+                await c.call("peer-probe", host=d2.host, port=d2.port)
+                await c.call("volume-create", name="ov",
+                             vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "ob")}])
+                # a v2 option is refused while a v1 member exists
+                try:
+                    await c.call("volume-set", name="ov",
+                                 key="cluster.brick-multiplex",
+                                 value="on")
+                    raise AssertionError("v2 option accepted at v1")
+                except Exception as e:
+                    assert "op-version" in str(e), e
+                # v1 options still work
+                await c.call("volume-set", name="ov",
+                             key="performance.io-cache", value="on")
+            # the old member leaves: cluster rises to v2
+            d1.state["peers"] = {u: p for u, p in
+                                 d1.state["peers"].items()
+                                 if p["uuid"] != d2.uuid}
+            async with MgmtClient(d1.host, d1.port) as c:
+                await c.call("volume-set", name="ov",
+                             key="cluster.brick-multiplex", value="on")
+        finally:
+            await d2.stop()
+            await d1.stop()
+
+    asyncio.run(run())
