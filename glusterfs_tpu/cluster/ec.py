@@ -177,6 +177,7 @@ class DisperseLayer(Layer):
         if any(self.opts[k] != old[k] for k in codec_keys):
             from ..ops.batch import BatchingCodec
 
+            self.codec.close()  # release the replaced codec's pool
             self.codec = BatchingCodec(
                 self.k, self.r, self.opts["cpu-extensions"],
                 window=self.opts["stripe-cache-window"] / 1e6,
@@ -1214,6 +1215,7 @@ class DisperseLayer(Layer):
                 await self._eager_drain(Loc("", gfid=gfid), gfid)
             except Exception:
                 pass
+        self.codec.close()
         await super().fini()
 
     def dump_private(self) -> dict:

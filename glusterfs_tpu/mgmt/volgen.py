@@ -32,6 +32,9 @@ OPTION_MIN_OPVERSION = {
     "features.simple-quota": 2,
     "bitrot.scrub-throttle": 2,
     "storage.health-check-interval": 2,
+    "disperse.stripe-cache": 2,
+    "disperse.stripe-cache-window": 2,
+    "disperse.stripe-cache-min-batch": 2,
 }
 
 # volume-set key -> (layer type, option name)  (glusterd-volume-set.c map)
@@ -45,6 +48,12 @@ OPTION_MAP = {
     "ssl.key": ("__ssl__", "ssl-key"),
     "ssl.ca": ("__ssl__", "ssl-ca"),
     "disperse.cpu-extensions": ("cluster/disperse", "cpu-extensions"),
+    # stripe-cache (ec.c:286): the TPU batching window over the codec
+    "disperse.stripe-cache": ("cluster/disperse", "stripe-cache"),
+    "disperse.stripe-cache-window": ("cluster/disperse",
+                                     "stripe-cache-window"),
+    "disperse.stripe-cache-min-batch": ("cluster/disperse",
+                                        "stripe-cache-min-batch"),
     "disperse.read-policy": ("cluster/disperse", "read-policy"),
     "disperse.quorum-count": ("cluster/disperse", "quorum-count"),
     "disperse.self-heal-window-size": ("cluster/disperse",

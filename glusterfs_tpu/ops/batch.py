@@ -11,9 +11,23 @@ coalesced into HBM-resident batches" — is a batching window:
   kernel launch for the whole batch (encode; decodes group by surviving
   mask — one launch per mask, same keying as the reference's LRU of
   inverted matrices);
-* a latency cutoff keeps small/straggler batches off the device: below
-  ``min_batch`` bytes the flush runs on the native/CPU ladder instead, so
-  a lone metadata-sized write never pays a device dispatch.
+* flushes run OFF the event loop in a small thread pool, so batch N+1
+  keeps filling (and can dispatch) while batch N is on the device — fop
+  latency never serializes on a device round trip;
+* device launches are shape-bucketed: the concatenated batch is padded
+  with zero stripes up to the next power-of-two stripe count, so the
+  jitted kernel cache sees a bounded set of shapes instead of recompiling
+  for every distinct batch size (correct because stripes are independent,
+  ec-method.c:393-408, and the codec is linear so zero stripes encode to
+  zero fragments that we slice off);
+* routing between the device and the CPU ladder is MEASURED, not assumed:
+  a background calibration times the device at two bucket sizes (fitting
+  ``t = overhead + bytes/rate``) and the native ladder on the same data;
+  each flush then goes to whichever path predicts faster for its size.
+  Until calibration completes, flushes run on the CPU ladder — a served
+  volume is never slower than the native path while the device warms up.
+  Production flush timings keep updating the models (EMA), so a drifting
+  transfer latency (e.g. a congested tunnel) re-routes automatically.
 
 Correctness leans on fragment-stream concatenation: fragment ``f`` of
 ``concat(stripes_a, stripes_b)`` is ``concat(frag_f(a), frag_f(b))`` —
@@ -23,6 +37,9 @@ stripes are independent (ec-method.c:393-408 loops stripes).
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
+import threading
+import time
 
 import numpy as np
 
@@ -30,6 +47,61 @@ from . import gf256
 from .codec import Codec
 
 _DEVICE_BACKENDS = ("pallas-xor", "pallas-mxu", "xla", "xla-xor")
+
+# Shape buckets: power-of-two stripe counts with this floor.  Bounded
+# distinct shapes -> bounded jit compiles per (k, n) / (k, mask).
+_BUCKET_FLOOR_STRIPES = 16
+
+# Calibration bucket sizes (in stripes): a small and a large point to fit
+# t(n) = overhead + n / rate.  The large point also warms the kernel cache
+# for the bucket real traffic most often lands in.
+_CAL_SMALL = 64
+_CAL_LARGE = 2048
+
+_EMA = 0.3  # weight of a new production sample in the online models
+
+
+def _bucket_stripes(s: int) -> int:
+    b = _BUCKET_FLOOR_STRIPES
+    while b < s:
+        b <<= 1
+    return b
+
+
+class _PathModel:
+    """Online ``t(bytes) = overhead + bytes / rate`` timing model."""
+
+    def __init__(self) -> None:
+        self.overhead = 0.0
+        self.rate = 0.0  # bytes/s; 0 -> uncalibrated
+        self.samples = 0
+
+    @property
+    def ready(self) -> bool:
+        return self.rate > 0.0
+
+    def fit_two_points(self, n1: int, t1: float, n2: int, t2: float) -> None:
+        """Exact fit from calibration at two sizes (n2 > n1)."""
+        slope = max((t2 - t1) / max(n2 - n1, 1), 1e-15)
+        self.rate = 1.0 / slope
+        self.overhead = max(t1 - n1 * slope, 0.0)
+        self.samples = 2
+
+    def observe(self, nbytes: int, secs: float) -> None:
+        """EMA update from a production flush (overhead held, rate tracked)."""
+        if not self.ready:
+            return
+        span = secs - self.overhead
+        if span <= 0:
+            # faster than the modeled overhead: overhead was overestimated
+            self.overhead = (1 - _EMA) * self.overhead + _EMA * secs * 0.5
+            span = max(secs - self.overhead, 1e-9)
+        implied = nbytes / span
+        self.rate = (1 - _EMA) * self.rate + _EMA * implied
+        self.samples += 1
+
+    def predict(self, nbytes: int) -> float:
+        return self.overhead + nbytes / self.rate if self.ready else float("inf")
 
 
 class BatchingCodec(Codec):
@@ -39,8 +111,14 @@ class BatchingCodec(Codec):
     tests); the data path awaits ``encode_async``/``decode_async``.
 
     Stats: ``launches`` counts device batch launches, ``cpu_launches``
-    counts small-batch fallbacks, ``batched_fops`` total fops served,
-    ``max_batch`` the largest coalesced batch in fops.
+    counts flushes routed to the CPU ladder, ``batched_fops`` total fops
+    served, ``max_batch`` the largest coalesced batch in fops.
+
+    ``min_batch`` is a hard floor below which flushes never go to the
+    device; ``min_batch=0`` disables routing entirely (every flush takes
+    the device path — tests and kernel benches use this to pin the path).
+    Between the floor and the measured break-even, the calibrated models
+    decide per flush.
     """
 
     def __init__(self, k: int, r: int, backend: str = "auto", *,
@@ -60,15 +138,25 @@ class BatchingCodec(Codec):
         self.cpu_launches = 0
         self.batched_fops = 0
         self.max_batch = 0
+        # two workers: batch N's device round trip overlaps batch N+1's
+        # dispatch/host work (jax serializes on-device execution itself)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix=f"ec-codec-{k}+{r}")
+        self._lock = threading.Lock()
+        self._dev = _PathModel()
+        self._nat = _PathModel()
+        self._cal_state = "idle"  # idle -> running -> done/failed
 
     # -- stats hooks (count every device launch, sync path included) ------
 
     def encode(self, data: np.ndarray) -> np.ndarray:
-        self.launches += 1
+        with self._lock:
+            self.launches += 1
         return super().encode(data)
 
     def decode(self, frags: np.ndarray, rows) -> np.ndarray:
-        self.launches += 1
+        with self._lock:
+            self.launches += 1
         return super().decode(frags, rows)
 
     def _small(self) -> Codec:
@@ -81,6 +169,123 @@ class BatchingCodec(Codec):
             else:
                 self._cpu = self  # already a CPU ladder backend
         return self._cpu
+
+    # -- measured break-even routing --------------------------------------
+
+    def _calibrate(self) -> None:
+        """Time device + native at two bucket sizes; fit both models.
+
+        Runs in the pool.  Each size gets a warmup launch (pays the jit
+        compile, which production flushes to that bucket then reuse) and a
+        timed launch.
+        """
+        try:
+            small = self._small()
+            pts_dev, pts_nat = [], []
+            for stripes in (_CAL_SMALL, _CAL_LARGE):
+                data = np.frombuffer(
+                    np.random.default_rng(stripes).bytes(
+                        stripes * self.stripe_size), dtype=np.uint8)
+                super().encode(data)  # warmup: compile + cache
+                t0 = time.perf_counter()
+                super().encode(data)
+                pts_dev.append((data.size, time.perf_counter() - t0))
+                t0 = time.perf_counter()
+                small.encode(data)
+                pts_nat.append((data.size, time.perf_counter() - t0))
+            with self._lock:
+                self._dev.fit_two_points(*pts_dev[0], *pts_dev[1])
+                self._nat.fit_two_points(*pts_nat[0], *pts_nat[1])
+                self._cal_state = "done"
+        except Exception:  # device unusable -> stay on the CPU ladder
+            with self._lock:
+                self._cal_state = "failed"
+
+    def _maybe_start_calibration(self) -> None:
+        with self._lock:
+            if self._cal_state != "idle":
+                return
+            self._cal_state = "running"
+        self._pool.submit(self._calibrate)
+
+    async def ensure_calibrated(self) -> bool:
+        """Run (or await) calibration; True if the device model is ready.
+
+        Benches call this so routing decisions in the measured window are
+        model-driven rather than 'calibrating -> CPU'.  Daemons never wait.
+        """
+        if self._small() is self:
+            return False
+        self._maybe_start_calibration()
+        while True:
+            with self._lock:
+                st = self._cal_state
+            if st in ("done", "failed"):
+                return st == "done"
+            await asyncio.sleep(0.01)
+
+    def _route(self, total: int) -> tuple[Codec, bool]:
+        """Pick the codec for a flush of ``total`` bytes -> (codec, device?)."""
+        small = self._small()
+        if small is self:
+            return self, False  # CPU-ladder backend: nothing to route
+        if self.min_batch <= 0:
+            return self, True  # routing disabled: force the device path
+        if total < self.min_batch:
+            return small, False
+        with self._lock:
+            st, dev, nat = self._cal_state, self._dev, self._nat
+            if st != "done":
+                pass
+            elif dev.predict(self._padded(total)) <= nat.predict(total):
+                return self, True
+            else:
+                return small, False
+        self._maybe_start_calibration()
+        return small, False
+
+    def _padded(self, total: int) -> int:
+        return _bucket_stripes(total // self.stripe_size) * self.stripe_size
+
+    def break_even_bytes(self) -> int | None:
+        """Bytes past which the device model predicts a win (None if flat)."""
+        with self._lock:
+            if not (self._dev.ready and self._nat.ready):
+                return None
+            inv = 1.0 / self._nat.rate - 1.0 / self._dev.rate
+            if inv <= 0:
+                return None
+            # 0 when the device model wins at every size (overhead
+            # below native's): never report a negative byte count
+            return max(0, int((self._dev.overhead - self._nat.overhead)
+                              / inv))
+
+    def _observe(self, device: bool, nbytes: int, secs: float) -> None:
+        with self._lock:
+            (self._dev if device else self._nat).observe(nbytes, secs)
+
+    # -- bucketed device launches ------------------------------------------
+
+    def _encode_bucketed(self, data: np.ndarray) -> np.ndarray:
+        """Device encode with zero-stripe padding to a bucketed shape."""
+        s = data.size // self.stripe_size
+        sb = _bucket_stripes(s)
+        if sb != s:
+            data = np.concatenate(
+                [data, np.zeros((sb - s) * self.stripe_size, dtype=np.uint8)])
+        frags = self.encode(data)
+        return frags[:, : s * self.fragment_chunk]
+
+    def _decode_bucketed(self, frags: np.ndarray, rows) -> np.ndarray:
+        w = frags.shape[1]
+        s = w // self.fragment_chunk
+        sb = _bucket_stripes(s)
+        if sb != s:
+            frags = np.concatenate(
+                [frags,
+                 np.zeros((frags.shape[0], (sb - s) * self.fragment_chunk),
+                          dtype=np.uint8)], axis=1)
+        return self.decode(frags, rows)[: w * self.k]
 
     # -- encode ------------------------------------------------------------
 
@@ -112,27 +317,58 @@ class BatchingCodec(Codec):
         self.batched_fops += len(batch)
         self.max_batch = max(self.max_batch, len(batch))
         total = sum(d.size for d, _ in batch)
-        codec: Codec = self
-        if total < self.min_batch and self._small() is not self:
-            codec = self._small()
+        codec, device = self._route(total)
+        if not device and codec is not self:
             self.cpu_launches += 1
+        loop = asyncio.get_running_loop()
+        self._submit(self._run_encode, loop, batch, codec, device, total)
+
+    def _submit(self, fn, loop, *args) -> None:
+        """Pool submit with an inline fallback: a batch still pending in
+        the window when close() shuts the pool (live reconfigure swaps
+        the codec) must NOT strand its awaiting fops — run the flush on
+        the loop thread instead."""
         try:
+            self._pool.submit(fn, loop, *args)
+        except RuntimeError:  # pool shut down after close()
+            fn(loop, *args)
+
+    def _run_encode(self, loop, batch, codec: Codec, device: bool,
+                    total: int) -> None:
+        """Executes in the pool: concatenate, launch, time, resolve."""
+        try:
+            t0 = time.perf_counter()
             if len(batch) == 1:
-                frags = codec.encode(batch[0][0])
-                batch[0][1].set_result(frags)
-                return
-            cat = np.concatenate([d for d, _ in batch])
-            frags = codec.encode(cat)  # ONE launch for the whole batch
-            off = 0
-            for d, fut in batch:
+                cat = batch[0][0]
+            else:
+                cat = np.concatenate([d for d, _ in batch])
+            if device:
+                frags = self._encode_bucketed(cat)
+            else:
+                frags = codec.encode(cat)
+            # device samples observe the PADDED size — the launch did
+            # that much work, and _route predicts with padded bytes too
+            self._observe(device, self._padded(total) if device else total,
+                          time.perf_counter() - t0)
+            results, off = [], 0
+            for d, _ in batch:
                 flen = d.size // self.k
-                if not fut.cancelled():
-                    fut.set_result(frags[:, off:off + flen].copy())
+                results.append(frags[:, off:off + flen].copy()
+                               if len(batch) > 1 else frags)
                 off += flen
-        except Exception as e:  # pragma: no cover - propagate to callers
-            for _, fut in batch:
-                if not fut.done():
-                    fut.set_exception(e)
+            loop.call_soon_threadsafe(self._resolve, batch, results, None)
+        except Exception as e:
+            loop.call_soon_threadsafe(self._resolve, batch, None, e)
+
+    @staticmethod
+    def _resolve(batch, results, err) -> None:
+        for i, (_, fut) in enumerate(batch):
+            if fut.done() or fut.cancelled():
+                continue
+            if err is not None:
+                fut.set_exception(err)
+            else:
+                fut.set_result(results[i])
 
     # -- decode ------------------------------------------------------------
 
@@ -159,32 +395,60 @@ class BatchingCodec(Codec):
             self._dec_task.cancel()
             self._dec_task = None
         queues, self._dec_q = self._dec_q, {}
+        if not queues:
+            return
+        loop = asyncio.get_running_loop()
         for rows, batch in queues.items():
             self.batched_fops += len(batch)
             self.max_batch = max(self.max_batch, len(batch))
             total = sum(f.size for f, _ in batch)
-            codec: Codec = self
-            if total < self.min_batch and self._small() is not self:
-                codec = self._small()
+            codec, device = self._route(total)
+            if not device and codec is not self:
                 self.cpu_launches += 1
-            try:
-                if len(batch) == 1:
-                    batch[0][1].set_result(codec.decode(batch[0][0], rows))
-                    continue
+            self._submit(self._run_decode, loop, rows, batch, codec,
+                         device, total)
+
+    def _run_decode(self, loop, rows, batch, codec: Codec, device: bool,
+                    total: int) -> None:
+        try:
+            t0 = time.perf_counter()
+            if len(batch) == 1:
+                cat = batch[0][0]
+            else:
                 cat = np.concatenate([f for f, _ in batch], axis=1)
-                out = codec.decode(cat, rows)  # one launch per mask
-                off = 0
-                for f, fut in batch:
-                    nbytes = f.shape[1] * self.k
-                    if not fut.cancelled():
-                        fut.set_result(out[off:off + nbytes].copy())
-                    off += nbytes
-            except Exception as e:  # pragma: no cover
-                for _, fut in batch:
-                    if not fut.done():
-                        fut.set_exception(e)
+            if device:
+                out = self._decode_bucketed(cat, rows)
+            else:
+                out = codec.decode(cat, rows)
+            self._observe(device, self._padded(total) if device else total,
+                          time.perf_counter() - t0)
+            results, off = [], 0
+            for f, _ in batch:
+                nbytes = f.shape[1] * self.k
+                results.append(out[off:off + nbytes].copy()
+                               if len(batch) > 1 else out)
+                off += nbytes
+            loop.call_soon_threadsafe(self._resolve, batch, results, None)
+        except Exception as e:
+            loop.call_soon_threadsafe(self._resolve, batch, None, e)
+
+    def close(self) -> None:
+        """Release the flush pool.  The EC layer calls this when a
+        reconfigure replaces the codec and at graph fini — without it
+        every rebuild leaks the two worker threads.  Queued flushes
+        still run (their awaiters must resolve); threads exit after."""
+        self._pool.shutdown(wait=False)
 
     def dump_stats(self) -> dict:
+        with self._lock:
+            dev_ready = self._dev.ready
+            dev = {"overhead_s": round(self._dev.overhead, 6),
+                   "rate_MiB_s": round(self._dev.rate / 2**20, 1),
+                   "samples": self._dev.samples} if dev_ready else None
+            nat = {"overhead_s": round(self._nat.overhead, 6),
+                   "rate_MiB_s": round(self._nat.rate / 2**20, 1),
+                   "samples": self._nat.samples} if self._nat.ready else None
+            cal = self._cal_state
         return {
             "backend": self.backend,
             "launches": self.launches,
@@ -193,4 +457,8 @@ class BatchingCodec(Codec):
             "max_batch": self.max_batch,
             "window_s": self.window,
             "min_batch_bytes": self.min_batch,
+            "calibration": cal,
+            "device_model": dev,
+            "native_model": nat,
+            "break_even_bytes": self.break_even_bytes(),
         }
