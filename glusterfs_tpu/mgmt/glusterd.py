@@ -107,6 +107,16 @@ class Glusterd:
             json.dump(self.state, f, indent=1)
         os.replace(tmp, self._store)
 
+    @staticmethod
+    def _bump(vol: dict) -> None:
+        """Advance a volume's config generation.  Every cluster-txn commit
+        that mutates volinfo bumps in lockstep on the nodes that saw it;
+        peer-hello reconciliation then imports the higher generation into
+        nodes that missed the txn (the friend-sm volinfo import of
+        glusterd-utils.c glusterd_compare_friend_volume, keyed there on
+        volinfo->version exactly like this)."""
+        vol["version"] = int(vol.get("version", 1)) + 1
+
     # -- service -----------------------------------------------------------
 
     async def start(self) -> int:
@@ -121,6 +131,9 @@ class Glusterd:
         for vol in self.state["volumes"].values():
             if vol.get("status") == "started":
                 await self._start_local_bricks(vol)
+                # fire-and-forget: the fan-out waits up to 10s per
+                # unreachable peer and must not stall daemon startup
+                self._spawn_task(self._broadcast_local_ports(vol))
                 self._spawn_shd(vol)
                 if vol.get("georep", {}).get("status") == "started":
                     self._spawn_gsync(vol)
@@ -137,6 +150,11 @@ class Glusterd:
                 for b in vi["bricks"]:
                     await self._spawn_brick(vi, b)
         self._quorum_task = asyncio.create_task(self._quorum_loop())
+        # catch up on config txns committed while this node was down
+        # (the restart side of the friend handshake)
+        if any(p["uuid"] != self.uuid
+               for p in self.state["peers"].values()):
+            self._spawn_task(self._refresh_peers())
         return self.port
 
     async def stop(self) -> None:
@@ -242,15 +260,148 @@ class Glusterd:
 
     async def op_peer_probe(self, host: str, port: int) -> dict:
         async with MgmtClient(host, port) as peer:
-            info = await peer.call("peer-hello", me=self._peer_info())
-        self.state["peers"][info["uuid"]] = info
+            info = await peer.call("peer-hello", me=self._peer_info(),
+                                   **self._volume_export())
+        self.state["peers"][info["uuid"]] = {
+            k: v for k, v in info.items()
+            if k not in ("volumes", "tombstones")}
         self._save()
+        await self._reconcile_volumes(info.get("volumes"),
+                                      info.get("tombstones"),
+                                      from_uuid=info["uuid"])
         return {"ok": True, "peer": info}
 
-    def op_peer_hello(self, me: dict) -> dict:
+    async def op_peer_hello(self, me: dict, volumes: dict | None = None,
+                            tombstones: dict | None = None) -> dict:
         self.state["peers"][me["uuid"]] = me
         self._save()
-        return self._peer_info()
+        await self._reconcile_volumes(volumes, tombstones,
+                                      from_uuid=me["uuid"])
+        return {**self._peer_info(), **self._volume_export()}
+
+    def _volume_export(self) -> dict:
+        """Everything a peer needs to catch up on missed config txns."""
+        return {"volumes": self.state["volumes"],
+                "tombstones": self.state.get("tombstones", {})}
+
+    async def _reconcile_volumes(self, volumes: dict | None,
+                                 tombstones: dict | None,
+                                 from_uuid: str | None = None
+                                 ) -> list[str]:
+        """Import newer volume generations a handshaking peer carries.
+
+        The reference's friend handshake imports/compares volumes
+        (glusterd-sm.c friend-sm + glusterd_compare_friend_volume); this
+        is what lets its op-sm safely skip disconnected peers — they
+        catch up here, not in the txn.  Deletions travel as tombstones
+        (name -> generation at delete) so a peer that missed
+        volume-delete drops the volume instead of resurrecting it; a
+        re-created volume starts past its tombstone generation, so it
+        survives reconciliation against stale tombstones.
+        """
+        changed: list[str] = []
+        dirty = False  # learned tombstones must persist even with no
+        vols = self.state["volumes"]  # volume change (else a restart
+        tset = self.state.setdefault("tombstones", {})  # forgets them)
+        for name, tver in (tombstones or {}).items():
+            mine = vols.get(name)
+            if mine is not None and tver >= int(mine.get("version", 1)):
+                log.info(24, "reconcile: dropping %s (deleted at gen %d "
+                         "while this node was away)", name, tver)
+                vols.pop(name)
+                await self._conform_local_daemons(
+                    {**mine, "status": "stopped", "name": name},
+                    deleted=True)
+                self._notify_subscribers(name)
+                changed.append(name)
+            if int(tset.get(name, 0)) < int(tver):
+                tset[name] = int(tver)
+                dirty = True
+        for name, vi in (volumes or {}).items():
+            if int(tset.get(name, -1)) >= int(vi.get("version", 1)):
+                continue  # deleted here at/after that generation
+            mine = vols.get(name)
+            if mine is None or \
+                    int(vi.get("version", 1)) > int(mine.get("version", 1)):
+                log.info(24, "reconcile: importing %s gen %d (had %s)",
+                         name, int(vi.get("version", 1)),
+                         "none" if mine is None
+                         else f"gen {int(mine.get('version', 1))}")
+                vols[name] = json.loads(json.dumps(vi))  # own copy
+                changed.append(name)
+        # brick ports are RUNTIME state owned by the hosting node, not
+        # config: adopt the sender's ports for bricks IT hosts even when
+        # generations tie (two nodes that both restarted hold equal gens
+        # yet each has rebound its own bricks — version-keyed import
+        # alone would leave both serving the other's dead ports)
+        for name, vi in (volumes or {}).items():
+            mine = vols.get(name)
+            if mine is None or from_uuid is None or name in changed:
+                continue
+            theirs = {b["name"]: b["port"] for b in vi.get("bricks", ())
+                      if b.get("node") == from_uuid and b.get("port")}
+            for b in mine["bricks"]:
+                p = theirs.get(b["name"])
+                if p and b.get("port") != p:
+                    b["port"] = p
+                    self.ports[b["name"]] = p
+                    dirty = True
+                    if name not in changed:
+                        changed.append(name)
+        if changed or dirty:
+            self._save()
+            for name in changed:
+                vol = vols.get(name)
+                if vol is not None:
+                    await self._conform_local_daemons(vol)
+                    self._notify_subscribers(name)
+        return changed
+
+    async def _conform_local_daemons(self, vol: dict,
+                                     deleted: bool = False) -> None:
+        """Make local processes match an imported volinfo: start missing
+        bricks/daemons of started volumes, stop leftovers of stopped or
+        shrunk ones (the respawn side of glusterd_import_friend_volume).
+        ``deleted``: the volume was dropped by a tombstone — every
+        worker goes, including the geo-rep one a plain stop keeps."""
+        name = vol["name"]
+        started = vol.get("status") == "started"
+        want = {b["name"] for b in vol["bricks"] if b["node"] == self.uuid}
+        prefix = f"{name}-brick-"
+        for bname in [b for b in self.bricks if b.startswith(prefix)]:
+            if not started or bname not in want:
+                b = next((x for x in vol["bricks"] if x["name"] == bname),
+                         {"name": bname, "node": self.uuid})
+                await self._stop_brick(vol, b)
+        if started:
+            try:
+                await self._start_local_bricks(vol)
+            except MgmtError as e:
+                log.error(24, "reconcile: brick start for %s failed: %s",
+                          name, e)
+            # the imported volinfo carries the PEER's (possibly stale)
+            # view of this node's brick ports: re-assert the live local
+            # ports and push them cluster-wide, else peers keep serving
+            # client volfiles pointing at the pre-restart ports.
+            # Fire-and-forget: this runs inside the peer-hello RPC
+            # handler, and a second unreachable peer would stall the
+            # reply past the caller's 5s timeout, losing the catch-up.
+            self._spawn_task(self._broadcast_local_ports(vol))
+            self._spawn_shd(vol)
+            if volgen._bool(vol.get("options", {}).get(
+                    "features.bitrot", "off")):
+                self._spawn_bitd(vol)
+            if volgen._bool(vol.get("options", {}).get(
+                    "features.quota", "off")):
+                self._spawn_quotad(vol)
+            if vol.get("georep", {}).get("status") == "started":
+                self._spawn_gsync(vol)
+        else:
+            self._kill_shd(name)
+            self._kill_bitd(name)
+            self._kill_quotad(name)
+            if deleted:
+                self._kill_gsync(name)
 
     def op_peer_status(self) -> dict:
         return {"me": self._peer_info(),
@@ -280,10 +431,16 @@ class Glusterd:
                 continue
             try:
                 info = await asyncio.wait_for(self._node_call(
-                    p, "peer-hello", me=self._peer_info()), 5)
-                self.state["peers"][info["uuid"]] = info
+                    p, "peer-hello", me=self._peer_info(),
+                    **self._volume_export()), 5)
+                self.state["peers"][info["uuid"]] = {
+                    k: v for k, v in info.items()
+                    if k not in ("volumes", "tombstones")}
             except Exception:
                 continue  # unreachable: keep the snapshot
+            await self._reconcile_volumes(info.get("volumes"),
+                                          info.get("tombstones"),
+                                          from_uuid=info["uuid"])
         self._save()
 
     def _all_nodes(self) -> list[dict]:
@@ -576,6 +733,11 @@ class Glusterd:
         volinfo = {
             "name": name, "type": vtype, "bricks": parsed,
             "redundancy": redundancy, "status": "created",
+            # config generation for friend-volinfo reconciliation; a
+            # re-create starts past any tombstone so peers that missed
+            # the delete+create don't resurrect the old shape
+            "version": int(self.state.get("tombstones", {})
+                           .get(name, 0)) + 1,
             "options": {}, "id": str(uuid.uuid4()),
             # per-volume transport credentials, written by volgen into
             # both brick and client volfiles (glusterd_auth_set_username
@@ -611,6 +773,7 @@ class Glusterd:
     async def commit_volume_create(self, volinfo: dict) -> dict:
         await self._run_hooks("create", "pre", volinfo["name"])
         self.state["volumes"][volinfo["name"]] = volinfo
+        self.state.get("tombstones", {}).pop(volinfo["name"], None)
         self._save()
         gf_event("VOLUME_CREATE", name=volinfo["name"],
                  type=volinfo["type"])
@@ -640,6 +803,7 @@ class Glusterd:
         vol = self._vol(name)
         await self._run_hooks("start", "pre", name)
         vol["status"] = "started"
+        self._bump(vol)
         self._save()
         await self._start_local_bricks(vol)
         self._spawn_shd(vol)
@@ -674,6 +838,7 @@ class Glusterd:
         await self._run_hooks("stop", "pre", name)
         vol["status"] = "stopped"
         self._quorum_blocked.discard(name)
+        self._bump(vol)
         self._save()
         self._kill_bitd(name)
         self._kill_quotad(name)
@@ -694,7 +859,10 @@ class Glusterd:
 
     async def commit_volume_delete(self, name: str) -> dict:
         await self._run_hooks("delete", "pre", name)
-        self.state["volumes"].pop(name, None)
+        vol = self.state["volumes"].pop(name, None)
+        if vol is not None:
+            self.state.setdefault("tombstones", {})[name] = \
+                int(vol.get("version", 1))
         self._save()
         gf_event("VOLUME_DELETE", name=name)
         await self._run_hooks("delete", "post", name)
@@ -732,6 +900,7 @@ class Glusterd:
         vol = self._vol(name)
         await self._run_hooks("set", "pre", name, (f"-o{key}={value}",))
         vol.setdefault("options", {})[key] = value
+        self._bump(vol)
         self._save()
         applied = "stored"
         if vol["status"] == "started":
@@ -1138,6 +1307,7 @@ class Glusterd:
             # size so volgen starts emitting the dht aggregate
             vol["group-size"] = group_size
         vol["bricks"].extend(bricks)
+        self._bump(vol)
         self._save()
         if vol["status"] == "started":
             for b in bricks:
@@ -1209,6 +1379,7 @@ class Glusterd:
                                   bricks: list) -> dict:
         vol = self._vol(name)
         vol["remove-brick"] = {"status": "started", "bricks": bricks}
+        self._bump(vol)
         self._save()
         if vol["status"] == "started":
             self._notify_subscribers(name)  # layout excludes leavers
@@ -1237,7 +1408,31 @@ class Glusterd:
             rb["status"] = "failed"
             rb["error"] = repr(e)[:300]
             log.error(21, "remove-brick drain of %s failed: %r", name, e)
+        self._bump(vol)
         self._save()
+        # propagate the terminal drain status cluster-wide so
+        # `remove-brick status`/`commit` addressed to ANY node sees it
+        # (the reference's rebalance process reports back through the
+        # defrag status op to every glusterd); unreachable peers catch
+        # up via peer-hello volinfo reconciliation
+        for node in self._all_nodes():
+            if node["uuid"] == self.uuid:
+                continue
+            try:
+                await asyncio.wait_for(self._node_call(
+                    node, "remove-brick-update", name=name,
+                    rb=dict(rb)), 10)
+            except Exception:
+                pass
+
+    def op_remove_brick_update(self, name: str, rb: dict) -> dict:
+        """Originator pushes terminal drain status to every peer."""
+        vol = self._vol(name)
+        if vol.get("remove-brick") is not None:
+            vol["remove-brick"].update(rb)
+            self._bump(vol)
+            self._save()
+        return {"ok": True}
 
     async def commit_remove_brick_commit(self, name: str) -> dict:
         vol = self._vol(name)
@@ -1247,6 +1442,7 @@ class Glusterd:
         for b in vol["bricks"]:
             (gone if b["name"] in leaving else keep).append(b)
         vol["bricks"] = keep
+        self._bump(vol)
         self._save()
         for b in gone:
             if b["node"] == self.uuid:
@@ -1297,6 +1493,7 @@ class Glusterd:
             await self._stop_brick(vol, b)
         b["path"] = new_path
         b.pop("port", None)
+        self._bump(vol)
         self._save()
         if vol["status"] == "started" and b["node"] == self.uuid:
             await self._spawn_brick(vol, b)
@@ -1754,6 +1951,7 @@ class Glusterd:
             limits[p] = int(limit)
         else:
             limits.pop(p, None)
+        self._bump(vol)
         self._save()
         applied = "stored"
         if vol["status"] == "started" and volgen._bool(
@@ -1889,6 +2087,7 @@ class Glusterd:
         # bricks so their graphs pick it up (reference: geo-rep create
         # force-enables changelog + marker)
         vol.setdefault("options", {})["changelog.changelog"] = "on"
+        self._bump(vol)
         self._save()
         if vol["status"] == "started":
             for b in vol["bricks"]:
@@ -1911,6 +2110,7 @@ class Glusterd:
         vol = self._vol(name)
         geo = vol["georep"]
         geo["status"] = "started"
+        self._bump(vol)
         self._save()
         self._spawn_gsync(vol)
         return {"started": name}
@@ -1965,6 +2165,7 @@ class Glusterd:
         vol = self._vol(name)
         self._kill_gsync(name)
         vol["georep"]["status"] = "stopped"
+        self._bump(vol)
         self._save()
         return {"stopped": name}
 
@@ -2021,6 +2222,34 @@ class Glusterd:
             if b["node"] != self.uuid or b["name"] in self.bricks:
                 continue
             await self._spawn_brick(vol, b)
+
+    async def _broadcast_local_ports(self, vol: dict) -> None:
+        """pmap sync for this node's live bricks: write their current
+        ports into volinfo and push them to every peer (the signed-in
+        side of glusterd-pmap.c; restart-resume and reconciliation both
+        bind fresh ports that peers' volfiles must pick up)."""
+        ports = {b["name"]: self.ports[b["name"]]
+                 for b in vol["bricks"]
+                 if b["node"] == self.uuid and b["name"] in self.ports}
+        if not ports:
+            return
+        changed = False
+        for b in vol["bricks"]:
+            if b["name"] in ports and b.get("port") != ports[b["name"]]:
+                b["port"] = ports[b["name"]]
+                changed = True
+        if changed:
+            self._save()
+            self._notify_subscribers(vol["name"])
+        for node in self._all_nodes():
+            if node["uuid"] == self.uuid:
+                continue
+            try:
+                await asyncio.wait_for(self._node_call(
+                    node, "portmap-update", name=vol["name"],
+                    ports=ports), 10)
+            except Exception:
+                continue
 
     # -- brick multiplexing (glusterfsd-mgmt.c ATTACH / brick-mux) ---------
     # One shared daemon per node anchored on a glusterd-owned stub

@@ -105,15 +105,27 @@ class GeoRepWorker:
 
     # -- replay -------------------------------------------------------------
 
-    async def _copy_file(self, path: str) -> bool:
-        """Sync the CURRENT primary state of path to the secondary."""
+    async def _copy_file(self, path: str, strict: bool = False) -> bool:
+        """Sync the CURRENT primary state of path to the secondary.
+
+        ``strict`` is the initial-crawl mode: pre-session data has no
+        journal records, so a transient primary-side failure (ENOTCONN,
+        EIO) must re-raise and retry the walk — only a genuinely
+        vanished entry may be skipped.  Journal replay passes False:
+        there a vanished source is benign because a later record
+        covers the final state."""
+        _gone = (errno.ENOENT, errno.ESTALE)
         try:
             ia = await self.primary.stat(path)
-        except FopError:
+        except FopError as e:
+            if strict and e.err not in _gone:
+                raise
             return False  # vanished since the record; a later E handles it
         try:
             f_in = await self.primary.open(path)
-        except FopError:
+        except FopError as e:
+            if strict and e.err not in _gone:
+                raise
             return False  # vanished on primary: benign
         try:
             try:
@@ -304,6 +316,13 @@ class GeoRepWorker:
 
         synced = 0
 
+        # pre-session data has NO journal records, so a transiently
+        # failing secondary op here (ENOTCONN, EIO) loses the entry
+        # forever if swallowed — only the benign races (entry already
+        # there / vanished under live churn) may pass; everything else
+        # re-raises so run() retries the walk, same as the listdir path.
+        _benign = (errno.EEXIST, errno.ENOENT, errno.ESTALE)
+
         async def meta(child: str, ia) -> None:
             # pre-session data has no 'M' journal records: carry
             # mode/ownership in the crawl itself
@@ -311,8 +330,9 @@ class GeoRepWorker:
                 await self.secondary.setattr(
                     child, {"mode": ia.mode & 0o7777,
                             "uid": ia.uid, "gid": ia.gid})
-            except FopError:
-                pass
+            except FopError as e:
+                if e.err not in _benign:
+                    raise
 
         async def walk(path: str) -> int:
             n = 0
@@ -333,8 +353,9 @@ class GeoRepWorker:
                 if ia is not None and ia.is_dir():
                     try:
                         await self.secondary.mkdir(child)
-                    except FopError:
-                        pass
+                    except FopError as e:
+                        if e.err not in _benign:
+                            raise
                     await meta(child, ia)
                     n += await walk(child)
                 elif ia is not None and ia.ia_type is IAType.LNK:
@@ -344,10 +365,11 @@ class GeoRepWorker:
                         target = await self.primary.readlink(child)
                         await self.secondary.symlink(target, child)
                         n += 1
-                    except FopError:
-                        pass
+                    except FopError as e:
+                        if e.err not in _benign:
+                            raise
                 else:
-                    if await self._copy_file(child):
+                    if await self._copy_file(child, strict=True):
                         if ia is not None:
                             await meta(child, ia)
                         n += 1

@@ -376,11 +376,14 @@ class FuseBridge:
             attrs["uid"] = uid
         if valid & fp.FATTR_GID:
             attrs["gid"] = gid
+        # None = UTIME_NOW: the distinction must survive to posix-acl,
+        # which grants plain writers the touch-to-now path but demands
+        # ownership for explicit timestamps (utimensat(2) semantics)
         if valid & (fp.FATTR_ATIME | fp.FATTR_ATIME_NOW):
-            attrs["atime"] = (time.time()
+            attrs["atime"] = (None
                               if valid & fp.FATTR_ATIME_NOW else atime)
         if valid & (fp.FATTR_MTIME | fp.FATTR_MTIME_NOW):
-            attrs["mtime"] = (time.time()
+            attrs["mtime"] = (None
                               if valid & fp.FATTR_MTIME_NOW else mtime)
         if attrs:
             ia = await self._top.setattr(loc, attrs, valid)

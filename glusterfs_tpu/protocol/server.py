@@ -31,6 +31,7 @@ brick volfile); BrickServer reads them from the graph top.
 from __future__ import annotations
 
 import asyncio
+import errno
 import fnmatch
 import hmac
 import ssl as ssl_mod
@@ -185,12 +186,30 @@ class BrickServer:
         """SETVOLUME routing: the requested remote-subvolume picks the
         brick graph (default brick when unnamed or named directly).
         Clients name the brick ('v-brick-0'); attached graphs are keyed
-        by their served top ('v-brick-0-server') — accept either."""
-        if name:
-            for key in (name, name + "-server"):
-                if key in self.attached:
-                    return self.attached[key]
-        return self.top, self.graph
+        by their served top ('v-brick-0-server') — accept either.
+
+        A nonempty name matching neither the default graph nor any
+        attached graph fails the handshake explicitly (the reference's
+        server_setvolume "remote-subvolume not found" error) instead of
+        silently authing the client against the wrong graph — on a mux
+        daemon that produced an opaque 'authentication failed' from the
+        anchor's auth-reject, masking the real condition."""
+        if not name:
+            return self.top, self.graph
+        for key in (name, name + "-server"):
+            if key in self.attached:
+                return self.attached[key]
+        if name == self.top.name or name + "-server" == self.top.name:
+            return self.top, self.graph
+        if self.graph is None or \
+                name in getattr(self.graph, "by_name", {}):
+            # bare-Layer servers (no graph) cannot enumerate their
+            # subvolumes; graph-backed ones accept any layer by name
+            # (the reference resolves remote-subvolume anywhere in the
+            # brick volfile)
+            return self.top, self.graph
+        raise FopError(errno.ENOENT,
+                       f"unknown remote-subvolume {name!r}")
 
     @staticmethod
     def _opts_of(top: Layer):
@@ -414,7 +433,12 @@ class BrickServer:
                 # routing first: auth is checked against the BRICK the
                 # client asked for (each mux'd graph carries its own
                 # volume's credentials)
-                top, graph = self._select_top(want)
+                try:
+                    top, graph = self._select_top(want)
+                except FopError as e:
+                    log.warning(7, "handshake from %s: %s",
+                                conn.peer_addr, e)
+                    return wire.MT_REPLY, {"ok": False, "error": str(e)}
                 # mgmt pair (volfile-only, never served to clients)
                 # bypasses BOTH address lists — an over-broad
                 # auth.reject must not cut glusterd off from its bricks

@@ -777,8 +777,11 @@ class PosixLayer(Layer):
             os.chown(ap, attrs.get("uid", -1), attrs.get("gid", -1))
         if "atime" in attrs or "mtime" in attrs:
             st = os.stat(ap)
-            os.utime(ap, (attrs.get("atime", st.st_atime),
-                          attrs.get("mtime", st.st_mtime)))
+            now = time.time()  # value None = UTIME_NOW
+            a = attrs.get("atime", st.st_atime)
+            m = attrs.get("mtime", st.st_mtime)
+            os.utime(ap, (now if a is None else a,
+                          now if m is None else m))
 
     async def setattr(self, loc: Loc, attrs: dict, valid: int = 0,
                       xdata: dict | None = None):

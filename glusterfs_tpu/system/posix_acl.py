@@ -144,7 +144,10 @@ def _owner_or_write_gated(op_name: str, always_owner: bool):
     """chmod/chown (setattr) and ACL changes need OWNERSHIP, not W —
     a 0444 file's owner can still chmod it, and group-writers cannot
     (POSIX; reference posix_acl_setattr uid check).  Non-ACL xattrs
-    are data-adjacent: plain W."""
+    are data-adjacent: plain W.  Timestamp-only setattr (the utimes
+    path) needs only W: POSIX lets any writer touch atime/mtime, and
+    the reference's posix-acl setattr gate does the same — only
+    mode/uid/gid changes demand ownership."""
     async def impl(self, loc: Loc, *args, **kwargs):
         from ..core.virtfs import extract_arg, extract_xdata
 
@@ -159,6 +162,18 @@ def _owner_or_write_gated(op_name: str, always_owner: bool):
                 "xattrs" if op_name == "setxattr" else "name")
         owner_op = always_owner or (payload is not None
                                     and _acl_key(payload))
+        touch_now = False
+        if op_name == "setattr" and owner_op:
+            attrs = extract_arg(self.children[0], op_name,
+                                (loc, *args), kwargs, "attrs")
+            if isinstance(attrs, dict) and attrs and \
+                    set(attrs) <= {"atime", "mtime"} and \
+                    all(v is None for v in attrs.values()):
+                # touch-to-now (UTIME_NOW, value None): owner OR any
+                # W-holder may do it; EXPLICIT timestamps still demand
+                # ownership (utimensat(2) — else any group-writer could
+                # forge mtimes and defeat mtime-based change detection)
+                touch_now = True
         if xd and "uid" in xd and not owner_op:
             await self._check(loc, W, xd)
         elif xd and "uid" in xd:
@@ -166,8 +181,10 @@ def _owner_or_write_gated(op_name: str, always_owner: bool):
             if uid != 0:
                 ia, _ = await self.children[0].lookup(loc)
                 if uid != ia.uid:
-                    raise FopError(errno.EPERM,
-                                   f"{loc.path}: not owner")
+                    if not touch_now:
+                        raise FopError(errno.EPERM,
+                                       f"{loc.path}: not owner")
+                    await self._check(loc, W, xd)  # non-owner touch
         return await getattr(self.children[0], op_name)(loc, *args,
                                                         **kwargs)
     impl.__name__ = op_name

@@ -152,6 +152,31 @@ def test_tls_brick(tmp_path, tls_cert):
     asyncio.run(run())
 
 
+def test_unknown_remote_subvolume_explicit_error(tmp_path):
+    """A handshake naming a subvolume that exists nowhere in the brick
+    graph fails with an explicit unknown-remote-subvolume error
+    (reference server_setvolume), not an opaque authentication failure
+    against the wrong graph."""
+    async def run():
+        server = await serve_brick(
+            _auth_brick().format(dir=tmp_path / "b"))
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", server.port)
+        try:
+            writer.write(wire.pack(1, wire.MT_CALL, [
+                "__handshake__", [b"t", "no-such-subvol", {}], {}]))
+            await writer.drain()
+            _, mtype, payload = wire.unpack(await wire.read_frame(reader))
+            assert mtype == wire.MT_REPLY
+            assert payload["ok"] is False
+            assert "unknown remote-subvolume" in payload["error"]
+        finally:
+            writer.close()
+        await server.stop()
+
+    asyncio.run(run())
+
+
 def test_managed_volume_credentials(tmp_path):
     """glusterd generates per-volume credentials; the served client
     volfile carries them (trusted-volfile model) and a credential-less

@@ -152,6 +152,52 @@ def test_posix_acl_ownership_gates(tmp_path):
     asyncio.run(run())
 
 
+def test_posix_acl_times_with_write_permission(tmp_path):
+    """Touch-to-now (UTIME_NOW, value None) needs only W, not
+    ownership — POSIX lets any writer touch timestamps to the current
+    time; EXPLICIT timestamps and mixed payloads still demand
+    ownership (utimensat(2); reference posix-acl setattr gating)."""
+
+    async def run():
+        g = _graph(tmp_path, ("system/posix-acl", {}))
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/t", b"x")
+        top = g.top
+        ia = await c.stat("/t")
+        owner = {"uid": ia.uid, "gid": ia.gid}
+        stranger = {"uid": ia.uid + 1000, "gid": ia.gid + 1000}
+        await top.setattr(Loc("/t"), {"mode": 0o666}, xdata=dict(owner))
+        # a W-holder may touch times to NOW
+        before = (await c.stat("/t")).mtime
+        await top.setattr(Loc("/t"), {"atime": None, "mtime": None},
+                          xdata=dict(stranger))
+        assert (await c.stat("/t")).mtime >= before
+        # ...but may NOT set explicit times (mtime forgery)
+        with pytest.raises(FopError) as ei:
+            await top.setattr(Loc("/t"), {"mtime": 3.0},
+                              xdata=dict(stranger))
+        assert ei.value.err == errno.EPERM
+        # the owner may set explicit times
+        await top.setattr(Loc("/t"), {"mtime": 3.0}, xdata=dict(owner))
+        assert int((await c.stat("/t")).mtime) == 3
+        # without W (0644) even touch-to-now is refused
+        await top.setattr(Loc("/t"), {"mode": 0o644}, xdata=dict(owner))
+        with pytest.raises(FopError) as ei:
+            await top.setattr(Loc("/t"), {"mtime": None},
+                              xdata=dict(stranger))
+        assert ei.value.err in (errno.EACCES, errno.EPERM)
+        # mixed payload (times + mode) still needs ownership even with W
+        await top.setattr(Loc("/t"), {"mode": 0o666}, xdata=dict(owner))
+        with pytest.raises(FopError) as ei:
+            await top.setattr(Loc("/t"), {"mtime": None, "mode": 0o600},
+                              xdata=dict(stranger))
+        assert ei.value.err == errno.EPERM
+        await c.unmount()
+
+    asyncio.run(run())
+
+
 def test_posix_acl_gates_through_passthrough_layers(tmp_path):
     """Identity gates must hold when the layer below posix-acl defines
     fops as (*args, **kwargs) passthroughs (utime's stamped fops):

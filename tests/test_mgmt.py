@@ -175,6 +175,72 @@ def test_glusterd_peers_and_txn(tmp_path):
     asyncio.run(run())
 
 
+@pytest.mark.slow
+def test_peer_volinfo_reconciliation(tmp_path):
+    """A peer that was down during a config txn catches up on restart:
+    peer-hello carries per-volume generation counters and the newer
+    volinfo is imported (glusterd friend-sm volinfo import analog);
+    a missed volume-delete travels as a tombstone instead of being
+    resurrected by the returning peer."""
+    async def run():
+        d1 = Glusterd(str(tmp_path / "r1"))
+        d2 = Glusterd(str(tmp_path / "r2"))
+        await d1.start()
+        await d2.start()
+        try:
+            async with MgmtClient(d1.host, d1.port) as c:
+                await c.call("peer-probe", host=d2.host, port=d2.port)
+                await c.call("volume-create", name="rv", vtype="replicate",
+                             bricks=[{"path": str(tmp_path / "rb0")},
+                                     {"path": str(tmp_path / "rb1")}],
+                             redundancy=0)
+            assert "rv" in d2.state["volumes"]
+            gen0 = d1.state["volumes"]["rv"]["version"]
+            # peer goes down; a volume-set commits without it
+            await d2.stop()
+            async with MgmtClient(d1.host, d1.port) as c:
+                await c.call("volume-set", name="rv",
+                             key="performance.io-cache", value="on")
+            assert d1.state["volumes"]["rv"]["version"] > gen0
+            assert d2.state["volumes"]["rv"].get("options", {}).get(
+                "performance.io-cache") != "on"
+            # peer restarts: the start-time re-handshake imports the
+            # missed generation
+            d2b = Glusterd(str(tmp_path / "r2"))
+            await d2b.start()
+            try:
+                for _ in range(100):
+                    if d2b.state["volumes"].get("rv", {}).get(
+                            "options", {}).get(
+                            "performance.io-cache") == "on":
+                        break
+                    await asyncio.sleep(0.05)
+                vol = d2b.state["volumes"]["rv"]
+                assert vol["options"]["performance.io-cache"] == "on"
+                assert vol["version"] == \
+                    d1.state["volumes"]["rv"]["version"]
+            finally:
+                await d2b.stop()
+            # missed DELETE: tombstone wins over the stale volinfo
+            async with MgmtClient(d1.host, d1.port) as c:
+                await c.call("volume-delete", name="rv")
+            d2c = Glusterd(str(tmp_path / "r2"))
+            await d2c.start()
+            try:
+                for _ in range(100):
+                    if "rv" not in d2c.state["volumes"]:
+                        break
+                    await asyncio.sleep(0.05)
+                assert "rv" not in d2c.state["volumes"]
+                assert "rv" in d2c.state.get("tombstones", {})
+            finally:
+                await d2c.stop()
+        finally:
+            await d1.stop()
+
+    asyncio.run(run())
+
+
 # -- CLI -------------------------------------------------------------------
 
 @pytest.mark.slow
