@@ -361,12 +361,57 @@ class BrickServer:
     # or the transport is dropped (no fd squatting / pre-auth probing)
     HANDSHAKE_DEADLINE = 10.0
 
+    # concurrent in-flight requests per connection (the io-threads queue
+    # depth analog): bounds memory under a flood while letting fops that
+    # block (a waiting inodelk, a slow disk op) overlap with pings and
+    # other traffic on the same transport
+    MAX_INFLIGHT = 128
+
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
+        """Per-frame dispatch runs CONCURRENTLY (reference rpcsvc +
+        io-threads): requests are read in order but each is served in
+        its own task, with replies interleaving as they finish — the
+        client correlates by xid.  A blocking fop (a queued lock) must
+        not starve heartbeats behind it; serial dispatch also capped
+        wire throughput at one fop round-trip at a time."""
         peer = writer.get_extra_info("peername") or ("?",)
         conn = _ClientConn(self, writer)
         conn.peer_addr = str(peer[0])
         self.connections.add(conn)
+        tasks: set[asyncio.Task] = set()
+        sem = asyncio.Semaphore(self.MAX_INFLIGHT)
+        wlock = asyncio.Lock()
+
+        async def send(xid: int, resp_type, resp) -> None:
+            async with wlock:
+                if conn.compress:
+                    writer.write(wire.pack_z(xid, resp_type, resp))
+                else:
+                    writer.write(wire.pack(xid, resp_type, resp))
+                await writer.drain()
+
+        async def serve_one(xid: int, payload):
+            try:
+                try:
+                    resp_type, resp = await self._dispatch(conn, payload)
+                    await send(xid, resp_type, resp)
+                except (ConnectionError, RuntimeError):
+                    pass
+                except Exception as e:
+                    # a reply wire.pack can't serialize must still
+                    # ANSWER the xid — a silently dead task would hang
+                    # the client's call forever while pings keep passing
+                    log.error(2, "reply serialization failed: %r", e)
+                    try:
+                        await send(xid, wire.MT_ERROR,
+                                   FopError(5, f"unserializable reply: "
+                                               f"{e!r}"))
+                    except Exception:
+                        pass
+            finally:
+                sem.release()
+
         try:
             while True:
                 try:
@@ -382,19 +427,36 @@ class BrickServer:
                 xid, mtype, payload = wire.unpack(rec)
                 if mtype != wire.MT_CALL:
                     continue
-                resp_type, resp = await self._dispatch(conn, payload)
-                try:
-                    if conn.compress:
-                        writer.write(wire.pack_z(xid, resp_type, resp))
-                    else:
+                if conn.authed and isinstance(payload, list) and payload \
+                        and payload[0] == "__ping__":
+                    # reserved heartbeat lane: pings bypass the inflight
+                    # semaphore, else 128 fops blocked on a held lock
+                    # would starve the very liveness probe this
+                    # concurrency exists to protect
+                    try:
+                        await send(xid, wire.MT_REPLY, "pong")
+                    except ConnectionError:
+                        break
+                    continue
+                if not conn.authed:
+                    # SETVOLUME runs inline: everything else is gated
+                    # on its outcome
+                    resp_type, resp = await self._dispatch(conn, payload)
+                    try:
                         writer.write(wire.pack(xid, resp_type, resp))
-                    await writer.drain()
-                except ConnectionError:
-                    break
-                if (not conn.authed and isinstance(payload, list)
-                        and payload and payload[0] == "__handshake__"):
-                    break  # refused SETVOLUME: drop the transport
+                        await writer.drain()
+                    except ConnectionError:
+                        break
+                    if not conn.authed:
+                        break  # refused SETVOLUME: drop the transport
+                    continue
+                await sem.acquire()
+                t = asyncio.create_task(serve_one(xid, payload))
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
         finally:
+            for t in tasks:
+                t.cancel()
             self.connections.discard(conn)
             await self._cleanup(conn)
             try:
@@ -544,10 +606,19 @@ class BrickServer:
 
 def _scope_owner(args, kwargs, identity: bytes) -> None:
     """Prefix lk-owner with the connection identity so two clients using
-    the same owner bytes don't alias (frame lk_owner + client uid)."""
+    the same owner bytes don't alias (frame lk_owner + client uid).
+    The owner riding a compound ``unlock-inodelk`` payload (the
+    xattrop post-op + unlock fold) must be scoped identically, or the
+    brick-side unlock would target an owner that never took the lock."""
     for container in list(args) + list(kwargs.values()):
-        if isinstance(container, dict) and "lk-owner" in container:
+        if not isinstance(container, dict):
+            continue
+        if "lk-owner" in container:
             container["lk-owner"] = identity + b"/" + container["lk-owner"]
+        unlock = container.get("unlock-inodelk")
+        if isinstance(unlock, (list, tuple)) and len(unlock) == 5:
+            container["unlock-inodelk"] = [
+                *unlock[:4], identity + b"/" + unlock[4]]
 
 
 def _jsonable(v):
