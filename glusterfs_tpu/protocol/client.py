@@ -11,6 +11,12 @@ CHILD_UP / CHILD_DOWN notifications:
 * auto-reconnect with backoff (rpc_clnt reconnect timer)
 * in-flight calls fail with ENOTCONN on disconnect (saved_frames unwind,
   rpc-clnt.c:198)
+* on reconnect, every tracked open fd is RE-OPENED server-side and held
+  locks are re-acquired BEFORE CHILD_UP is announced
+  (client-handshake.c:30,68-97 client_reopen_done /
+  client_child_up_reopen_done, reopen_fd_count) — a long-lived fd
+  against a bounced brick keeps working instead of silently degrading
+  that brick out of every fop until the file is re-opened.
 
 Fd objects map to server-side FdHandles kept in the local fd ctx.
 """
@@ -24,7 +30,7 @@ from typing import Any
 
 from ..core.fops import Fop, FopError
 from ..core.iatt import gfid_new
-from ..core.layer import Event, FdObj, Layer, register
+from ..core.layer import Event, FdObj, Layer, Loc, register
 from ..core.options import Option
 from ..core import gflog
 from ..rpc import wire
@@ -73,6 +79,12 @@ class ClientLayer(Layer):
         self._closing = False
         self.identity = gfid_new()
         self._last_pong = 0.0
+        # reopen bookkeeping (client-handshake.c reopen_fd_count):
+        # live fds with server-side handles (value = (fd, reopen fop)),
+        # and locks granted through this connection, replayed on
+        # reconnect before CHILD_UP
+        self._fds: dict[int, tuple[FdObj, str]] = {}
+        self._held_locks: dict[tuple, tuple] = {}  # key -> (fop, args, kw)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -138,6 +150,15 @@ class ClientLayer(Layer):
             await self._drop_connection(notify=False)
             raise FopError(errno.EACCES,
                            res.get("error", "handshake rejected"))
+        # re-open tracked fds and re-acquire held locks BEFORE CHILD_UP
+        # (client_child_up_reopen_done): parents must never see an "up"
+        # child whose fd handles are stale
+        try:
+            await self._reopen_fds()
+            await self._reacquire_locks()
+        except BaseException:
+            await self._drop_connection(notify=False)
+            raise
         self.connected = True
         loop = asyncio.get_running_loop()
         self._last_pong = loop.time()
@@ -145,6 +166,49 @@ class ClientLayer(Layer):
         log.info(4, "%s: connected to %s:%d (%s)", self.name, host, port,
                  res.get("volume"))
         self.notify(Event.CHILD_UP, None, None)
+
+    async def _reopen_fds(self) -> None:
+        """Re-open every tracked fd on the fresh connection
+        (client_reopen_done, client-handshake.c:68-97).  A file that
+        vanished while we were away drops its handle — the fd degrades
+        to gfid-addressed (anonymous) access and surfaces ENOENT
+        naturally on the next fop."""
+        import os as _os
+
+        for key, (fd, how) in list(self._fds.items()):
+            loc = Loc(fd.path, gfid=fd.gfid)
+            # never replay creation semantics: O_TRUNC would wipe the
+            # file we are reconnecting to, O_CREAT|O_EXCL would EEXIST
+            flags = fd.flags & ~(_os.O_CREAT | _os.O_EXCL | _os.O_TRUNC)
+            fop_args = (loc,) if how == "opendir" else (loc, flags)
+            try:
+                ret = await self._call(how, fop_args, {})
+            except FopError as e:
+                log.warning(8, "%s: reopen of %s failed: %s", self.name,
+                            fd.path or fd.gfid.hex(), e)
+                fd.ctx_del(self)
+                self._fds.pop(key, None)
+                continue
+            if isinstance(ret, wire.FdHandle):
+                fd.ctx_set(self, ret)
+            log.debug(8, "%s: reopened %s", self.name,
+                      fd.path or fd.gfid.hex())
+
+    async def _reacquire_locks(self) -> None:
+        """Replay granted locks on the fresh brick (the brick restarted
+        with empty lock tables).  Bounded per lock: a now-conflicting
+        lock (someone else grabbed the range while we were away) is
+        dropped with a warning — the reference's lk-heal gives these up
+        after its grace period too."""
+        for key, (fop, args, kwargs) in list(self._held_locks.items()):
+            try:
+                await asyncio.wait_for(
+                    self._call(fop, self._wire_args(args), dict(kwargs)),
+                    5)
+            except (FopError, asyncio.TimeoutError) as e:
+                log.warning(8, "%s: lost %s lock across reconnect: %r",
+                            self.name, fop, e)
+                self._held_locks.pop(key, None)
 
     async def _drop_connection(self, notify: bool = True) -> None:
         was = self.connected
@@ -264,11 +328,101 @@ class ClientLayer(Layer):
                 out.append(a)
         return tuple(out)
 
+    _LOCK_FOPS = ("inodelk", "finodelk", "entrylk", "fentrylk", "lk")
+
     async def fop_call(self, name: str, *args, **kwargs) -> Any:
         if not self.connected:
+            if name in self._LOCK_FOPS:
+                # a failed UNLOCK must still drop the replay entry: the
+                # server reaps this client's locks on disconnect and the
+                # caller proceeds as released — replaying it on
+                # reconnect would pin a lock nobody will ever drop
+                self._track_lock(name, args, kwargs, failed=True)
             raise FopError(errno.ENOTCONN, f"{self.name}: child down")
-        ret = await self._call(name, self._wire_args(args), kwargs)
-        return self._absorb(ret, args)
+        try:
+            ret = await self._call(name, self._wire_args(args), kwargs)
+        except FopError:
+            if name in self._LOCK_FOPS:
+                self._track_lock(name, args, kwargs, failed=True)
+            raise
+        out = self._absorb(ret, args)
+        if name in ("open", "create", "opendir"):
+            # remember the fd (+ flags and the fop that re-creates it)
+            # for the reconnect re-open; create returns (fd, iatt) so
+            # walk one level of the absorbed result
+            flat = out if isinstance(out, (list, tuple)) else (out,)
+            for fd in flat:
+                if isinstance(fd, FdObj) and fd.ctx_get(self) is not None:
+                    if name != "opendir":
+                        fd.flags = next((a for a in args[1:]
+                                         if isinstance(a, int)), fd.flags)
+                    self._fds[id(fd)] = (
+                        fd, "opendir" if name == "opendir" else "open")
+        elif name in ("inodelk", "finodelk", "entrylk", "fentrylk", "lk"):
+            self._track_lock(name, args, kwargs)
+        elif name in ("xattrop", "fxattrop"):
+            # compound post-op unlock (features/locks xdata): the brick
+            # released the lock — drop it from the replay set too, or a
+            # reconnect would resurrect it forever
+            unlock = (kwargs.get("xdata") or {}).get("unlock-inodelk")
+            if unlock:
+                domain, _ltype, start, end, owner = unlock
+                target = args[0]
+                ident = id(target) if isinstance(target, FdObj) else \
+                    (target.gfid or target.path)
+                okey = owner.hex() if isinstance(owner,
+                                                 (bytes, bytearray)) \
+                    else str(owner)
+                for lkname in ("inodelk", "finodelk"):
+                    self._held_locks.pop(
+                        (lkname, ident, domain, okey, start, end), None)
+        return out
+
+    def _track_lock(self, name: str, args: tuple, kwargs: dict,
+                    failed: bool = False) -> None:
+        """Mirror granted/released locks for reconnect replay.  Keys
+        lead with the lock target's identity so release() can drop a
+        closing fd's record locks in one sweep.  ``failed``: the call
+        errored — unlocks still forget the entry (see fop_call), grants
+        are never recorded."""
+
+        def owner_of(xd):
+            o = (xd or {}).get("lk-owner")
+            return o.hex() if isinstance(o, (bytes, bytearray)) else str(o)
+
+        def ident(target):
+            if isinstance(target, FdObj):
+                return id(target)
+            return target.gfid or target.path
+
+        try:
+            if name in ("inodelk", "finodelk"):
+                domain, target, cmd = args[0], args[1], args[2]
+                start = args[4] if len(args) > 4 else kwargs.get("start", 0)
+                end = args[5] if len(args) > 5 else kwargs.get("end", -1)
+                xd = args[6] if len(args) > 6 else kwargs.get("xdata")
+                key = (name, ident(target), domain, owner_of(xd),
+                       start, end)
+            elif name in ("entrylk", "fentrylk"):
+                domain, target, basename = args[0], args[1], args[2]
+                cmd = args[3]
+                xd = args[5] if len(args) > 5 else kwargs.get("xdata")
+                key = (name, ident(target), domain, basename,
+                       owner_of(xd))
+            else:  # lk
+                fd, cmd, flock = args[0], args[1], args[2]
+                if cmd == "getlk":
+                    return
+                xd = args[3] if len(args) > 3 else kwargs.get("xdata")
+                key = ("lk", id(fd), owner_of(xd),
+                       flock.get("start", 0), flock.get("len", 0))
+                cmd = "unlock" if flock.get("type") == "unlck" else "lock"
+            if cmd == "lock" and not failed:
+                self._held_locks[key] = (name, args, kwargs)
+            elif cmd != "lock":
+                self._held_locks.pop(key, None)
+        except (IndexError, AttributeError, TypeError):
+            pass  # unexpected call shape: tracking must never break fops
 
     def _absorb(self, ret: Any, args: tuple) -> Any:
         """Turn returned FdHandles into local FdObjs."""
@@ -281,6 +435,10 @@ class ClientLayer(Layer):
         return ret
 
     async def release(self, fd: FdObj) -> None:
+        self._fds.pop(id(fd), None)
+        # a closed fd's record locks die with it (POSIX close semantics)
+        self._held_locks = {k: v for k, v in self._held_locks.items()
+                            if k[1] != id(fd)}
         h = fd.ctx_del(self)
         if h is not None and self.connected:
             try:

@@ -37,7 +37,9 @@ class BrickProc:
         self.proc: subprocess.Popen | None = None
         self.port: int | None = None
 
-    def start(self, timeout: float = 15.0) -> int:
+    def start(self, timeout: float = 15.0, port: int = 0) -> int:
+        """port=0 picks an ephemeral port; a fixed port lets bounce
+        tests restart the brick where clients expect it."""
         if os.path.exists(self.portfile):
             os.unlink(self.portfile)
         env = dict(os.environ)
@@ -45,7 +47,7 @@ class BrickProc:
         env["JAX_PLATFORMS"] = "cpu"
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "glusterfs_tpu.daemon",
-             "--volfile", self.volfile, "--listen", "0",
+             "--volfile", self.volfile, "--listen", str(port),
              "--portfile", self.portfile],
             env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
         deadline = time.time() + timeout

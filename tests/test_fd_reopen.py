@@ -1,0 +1,159 @@
+"""fd re-open + lock recovery across brick reconnect (reference
+client-handshake.c:30,68-97 reopen_fd_count / client_reopen_done):
+an fd opened before a brick bounce must keep working THROUGH the same
+fd on every brick afterward, with no degraded-index residue."""
+
+import asyncio
+import os
+
+import pytest
+
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc
+
+from .harness import BrickProc
+
+
+def test_fd_write_through_bounced_brick_managed(tmp_path):
+    """VERDICT r2 missing #1 done criterion: open fd -> bounce brick ->
+    write through the same fd succeeds on ALL bricks (the write is not
+    degraded, so no index entry appears and heal info stays empty
+    without shd running)."""
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                bricks = [{"path": str(tmp_path / f"b{i}")}
+                          for i in range(6)]
+                await c.call("volume-create", name="rv", vtype="disperse",
+                             bricks=bricks, redundancy=2)
+                # shd must NOT mask an un-reopened fd by healing behind
+                # our back: make its sweep effectively never fire
+                await c.call("volume-set", name="rv",
+                             key="cluster.heal-timeout", value="3600")
+                await c.call("volume-start", name="rv")
+            client = await mount_volume(d.host, d.port, "rv")
+            try:
+                ec = next(l for l in client.graph.by_name.values()
+                          if l.type_name == "cluster/disperse")
+                for _ in range(150):
+                    if all(ch.connected for ch in ec.children):
+                        break
+                    await asyncio.sleep(0.1)
+                stripe = 4 * 512
+                data = os.urandom(3 * stripe)
+                f = await client.create("/longlived")
+                await f.write(data, 0)
+                # drain the eager window so its deferred post-op isn't
+                # in flight across the outage (that would legitimately
+                # leave pending marks on any EC implementation); the
+                # test isolates the FD path
+                await f.fsync()
+                # bounce brick 1 while the fd stays open
+                async with MgmtClient(d.host, d.port) as c:
+                    await c.call("volume-brick", name="rv",
+                                 brick="rv-brick-1", action="stop")
+                for _ in range(100):
+                    if not ec.children[1].connected:
+                        break
+                    await asyncio.sleep(0.1)
+                assert not ec.children[1].connected
+                async with MgmtClient(d.host, d.port) as c:
+                    await c.call("volume-brick", name="rv",
+                                 brick="rv-brick-1", action="start")
+                for _ in range(150):
+                    if ec.children[1].connected:
+                        break
+                    await asyncio.sleep(0.1)
+                assert ec.children[1].connected
+                # write through the SAME fd: must hit all six bricks
+                patch = os.urandom(stripe)
+                await f.write(patch, stripe)
+                await f.close()
+                async with MgmtClient(d.host, d.port) as c:
+                    info = await c.call("volume-heal", name="rv",
+                                        action="info")
+                assert info["count"] == 0, (
+                    f"write through reopened fd degraded a brick: {info}")
+                assert (await client.read_file("/longlived")) == \
+                    data[:stripe] + patch + data[2 * stripe:]
+            finally:
+                await client.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+
+
+def test_lock_reacquired_across_reconnect(tmp_path):
+    """An inodelk granted before the brick bounces is re-acquired on
+    reconnect before CHILD_UP: a second owner's conflicting lock still
+    blocks afterward (the brick restarted with empty lock tables)."""
+
+    from glusterfs_tpu.api.glfs import Client
+
+    async def run():
+        brick = BrickProc(str(tmp_path), "b0")
+        port = brick.start()
+        g = Graph.construct(f"""
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume locks
+    option reconnect-interval 0.1
+    option ping-interval 0.2
+    option ping-timeout 1
+end-volume
+""")
+        top = g.top
+        c = Client(g)
+        await c.mount()
+        brick2 = None
+        try:
+            for _ in range(100):
+                if top.connected:
+                    break
+                await asyncio.sleep(0.05)
+            assert top.connected
+            await top.mkdir(Loc("/d"), 0o755)
+            me = {"lk-owner": b"owner-A"}
+            await top.inodelk("test.dom", Loc("/d"), "lock", "wr",
+                              0, -1, me)
+            # bounce the brick on the same port
+            brick.kill()
+            for _ in range(100):
+                if not top.connected:
+                    break
+                await asyncio.sleep(0.05)
+            assert not top.connected
+            brick2 = BrickProc(str(tmp_path), "b0")
+            brick2.start(port=port)
+            for _ in range(200):
+                if top.connected:
+                    break
+                await asyncio.sleep(0.05)
+            assert top.connected
+            # owner B must STILL conflict: the lock was replayed
+            other = {"lk-owner": b"owner-B"}
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(
+                    top.inodelk("test.dom", Loc("/d"), "lock", "wr",
+                                0, -1, other), 1.5)
+            # owner A releases; B acquires promptly
+            await top.inodelk("test.dom", Loc("/d"), "unlock", "wr",
+                              0, -1, me)
+            await asyncio.wait_for(
+                top.inodelk("test.dom", Loc("/d"), "lock", "wr",
+                            0, -1, other), 5)
+        finally:
+            await c.unmount()
+            brick.kill()
+            if brick2 is not None:
+                brick2.kill()
+
+    asyncio.run(run())
