@@ -113,8 +113,11 @@ class DisperseLayer(Layer):
         Option("stripe-cache", "bool", default="on",
                description="coalesce concurrent fop codec work into one "
                            "device batch per tick (ec.c:286 analog)"),
-        Option("stripe-cache-window", "int", default=300, min=0,
-               description="batching window in microseconds"),
+        Option("stripe-cache-window", "int", default=0, min=0,
+               description="batching window in microseconds; 0 = "
+                           "same-tick coalescing (flush on the next "
+                           "loop pass — concurrent fops still batch, "
+                           "a lone sequential writer never waits)"),
         Option("stripe-cache-min-batch", "size", default="256KB",
                description="batches below this run on the CPU ladder"),
         Option("eager-lock", "bool", default="on",
@@ -231,51 +234,80 @@ class DisperseLayer(Layer):
     # -- cluster-wide transaction locks (ec-locks.c / ec_lock analog) ------
 
     async def _inodelk_wind(self, loc: Loc, ltype: str,
-                            owner: bytes | None = None) -> list[int]:
+                            owner: bytes | None = None,
+                            start: int = 0, end: int = -1,
+                            collect: dict | None = None) -> list[int]:
         """Take an inodelk on every up child (brick-side features/locks);
         children without a locks layer (EOPNOTSUPP) are skipped.  Locks
         are wound in index order — all clients use the same order, so
-        cross-client deadlock cannot occur (ec-locks.c ordering)."""
+        cross-client deadlock cannot occur (ec-locks.c ordering).
+        ``start``/``end`` bound the byte range (end exclusive, -1 =
+        EOF): writes lock the whole file, heal locks one window at a
+        time (ec_heal_inodelk offset/size, ec-heal.c:251).
+        ``collect``: lock-and-fetch — each grant returns the inode's
+        xattrs (collect[i] = dict), folding the window's metadata
+        fan-out into the lock wave."""
         if self._locks_supported is False:
             return []
         xd = {"lk-owner": owner or self._lk_owner}
+        if collect is not None:
+            xd["get-xattrs"] = True
         locked: list[int] = []
         try:
             for i in self._up_idx():
                 try:
-                    await self.children[i].inodelk(
-                        "ec.transaction", loc, "lock", ltype, 0, -1, xd)
+                    ret = await self.children[i].inodelk(
+                        "ec.transaction", loc, "lock", ltype, start, end,
+                        xd)
                     locked.append(i)
+                    # only trust fetches that carry real counter state:
+                    # a failed fetch (None), a locks layer predating
+                    # get-xattrs (None grant return), or a brick whose
+                    # counters are simply absent must NOT be parsed as
+                    # "clean version 0, size 0" — that fabricated entry
+                    # could win _pick_meta's vote and corrupt the
+                    # recorded size.  Missing entries force the caller
+                    # back to the classic metadata wave.
+                    if collect is not None and isinstance(ret, dict) \
+                            and XA_VERSION in ret:
+                        collect[i] = ret
                 except FopError as e:
                     if e.err == errno.EOPNOTSUPP:
                         continue
                     raise
         except FopError:
-            await self._inodelk_unwind(loc, locked, owner)
+            await self._inodelk_unwind(loc, locked, owner, start, end)
             raise
         if self._locks_supported is None:
             self._locks_supported = bool(locked)
         return locked
 
     async def _inodelk_unwind(self, loc: Loc, locked: list[int],
-                              owner: bytes | None = None) -> None:
+                              owner: bytes | None = None,
+                              start: int = 0, end: int = -1) -> None:
         xd = {"lk-owner": owner or self._lk_owner}
         for i in locked:
             try:
                 await self.children[i].inodelk(
-                    "ec.transaction", loc, "unlock", "wr", 0, -1, xd)
+                    "ec.transaction", loc, "unlock", "wr", start, end, xd)
             except FopError:
                 pass
 
     class _Txn:
-        """Write-transaction scope: local serialization + cluster inodelk."""
+        """Write-transaction scope: local serialization + cluster inodelk.
+
+        ``start``/``end`` bound the locked byte range (end exclusive,
+        -1 = EOF).  Writes use the full range; heal uses one window per
+        txn so writers interleave between windows (ec-heal.c:251)."""
 
         def __init__(self, ec: "DisperseLayer", loc: Loc, gfid: bytes,
-                     ltype: str = "wr"):
+                     ltype: str = "wr", start: int = 0, end: int = -1):
             self.ec = ec
             self.loc = loc
             self.gfid = gfid
             self.ltype = ltype
+            self.start = start
+            self.end = end
             self.locked: list[int] = []
             self.local = ltype == "wr" or ec._locks_supported is False
             # Per-transaction lk-owner (reference frame->root->lk_owner):
@@ -299,7 +331,8 @@ class DisperseLayer(Layer):
                     await self.ec._eager_flush(self.loc, self.gfid)
             try:
                 self.locked = await self.ec._inodelk_wind(
-                    self.loc, self.ltype, self.owner)
+                    self.loc, self.ltype, self.owner, self.start,
+                    self.end)
             except BaseException:
                 if self.local:
                     self.ec._lock(self.gfid).release()
@@ -311,7 +344,9 @@ class DisperseLayer(Layer):
             return self
 
         async def __aexit__(self, *exc):
-            await self.ec._inodelk_unwind(self.loc, self.locked, self.owner)
+            await self.ec._inodelk_unwind(self.loc, self.locked,
+                                          self.owner, self.start,
+                                          self.end)
             if self.local:
                 self.ec._lock(self.gfid).release()
             return False
@@ -330,9 +365,17 @@ class DisperseLayer(Layer):
                 st.timer = None
             return st
         owner = gfid_new()
-        locked = await self._inodelk_wind(loc, "wr", owner)
+        fetched: dict[int, dict] = {}
+        locked = await self._inodelk_wind(loc, "wr", owner,
+                                          collect=fetched)
         try:
-            candidates, size = await self._read_meta(loc)
+            if locked and set(self._up_idx()) <= set(fetched):
+                # lock-and-fetch covered every up child: the lock wave
+                # WAS the metadata wave
+                candidates, size = self._pick_meta(
+                    {i: self._parse_meta(r) for i, r in fetched.items()})
+            else:
+                candidates, size = await self._read_meta(loc)
         except BaseException:
             await self._inodelk_unwind(loc, locked, owner)
             raise
@@ -389,6 +432,7 @@ class DisperseLayer(Layer):
         if st.timer is not None:
             st.timer.cancel()
             st.timer = None
+        unlocked: set[int] = set()
         try:
             post: dict = {}
             if st.delta:
@@ -399,11 +443,23 @@ class DisperseLayer(Layer):
                                   _pack_u64x2(-1 & 0xFFFFFFFFFFFFFFFF, 0)]
             targets = sorted(st.good & set(self._up_idx()))
             if post and targets:
-                await self._dispatch(
+                # compound unlock: the brick releases this window's
+                # inodelk right after committing the post-op (handled by
+                # features/locks) — one wave instead of two per window
+                lockset = set(st.locked)
+                xd = {"unlock-inodelk": ["ec.transaction", "wr", 0, -1,
+                                         st.owner]}
+                res = await self._dispatch(
                     targets, "xattrop",
-                    lambda i: ((loc, "mixed", dict(post)), {}))
+                    lambda i: ((loc, "mixed", dict(post)),
+                               {"xdata": dict(xd)}
+                               if i in lockset else {}))
+                unlocked = {i for i, r in res.items()
+                            if i in lockset
+                            and not isinstance(r, BaseException)}
         finally:
-            await self._inodelk_unwind(loc, st.locked, st.owner)
+            rest = [i for i in st.locked if i not in unlocked]
+            await self._inodelk_unwind(loc, rest, st.owner)
 
     async def _eager_drain_fd(self, fd: FdObj) -> None:
         if fd.gfid in self._eager:
@@ -411,9 +467,35 @@ class DisperseLayer(Layer):
 
     # -- dispatch + combine (ec-common.c:816-900, ec-combine.c) ------------
 
+    @property
+    def _local_children(self) -> bool:
+        """True when no child subtree crosses a wire: awaiting them in
+        sequence costs nothing in latency (same event loop does all the
+        work anyway) and skips one task creation + wakeup per child per
+        wave — a measurable share of the smallfile budget.  Wire
+        children keep the concurrent gather so RTTs overlap."""
+        cached = getattr(self, "_local_cached", None)
+        if cached is None:
+            from ..core.layer import walk
+
+            cached = all(l.type_name != "protocol/client"
+                         for ch in self.children for l in walk(ch))
+            self._local_cached = cached
+        return cached
+
     async def _dispatch(self, idxs: list[int], op: str, argfn):
         """Run fop on children idxs concurrently; returns {idx: result or
         exception}.  argfn(i) -> (args, kwargs) per child."""
+        if self._local_children:
+            out = {}
+            for i in idxs:
+                args, kwargs = argfn(i)
+                try:
+                    out[i] = await getattr(self.children[i], op)(*args,
+                                                                 **kwargs)
+                except Exception as e:
+                    out[i] = e
+            return out
 
         async def one(i):
             args, kwargs = argfn(i)
@@ -443,21 +525,21 @@ class DisperseLayer(Layer):
 
     # -- xattr counters ----------------------------------------------------
 
+    @staticmethod
+    def _parse_meta(r: dict) -> dict:
+        return {
+            "version": _u64x2(r.get(XA_VERSION)),
+            "size": struct.unpack(
+                ">Q", r.get(XA_SIZE, b"\0" * 8).ljust(8, b"\0"))[0],
+            "dirty": _u64x2(r.get(XA_DIRTY)),
+        }
+
     async def _get_meta(self, idxs, loc: Loc):
         """Per-child (version, size, dirty) from xattrs."""
         res = await self._dispatch(idxs, "getxattr", lambda i: ((loc, None), {}))
-        out = {}
-        for i, r in res.items():
-            if isinstance(r, BaseException):
-                out[i] = r
-            else:
-                out[i] = {
-                    "version": _u64x2(r.get(XA_VERSION)),
-                    "size": struct.unpack(
-                        ">Q", r.get(XA_SIZE, b"\0" * 8).ljust(8, b"\0"))[0],
-                    "dirty": _u64x2(r.get(XA_DIRTY)),
-                }
-        return out
+        return {i: (r if isinstance(r, BaseException)
+                    else self._parse_meta(r))
+                for i, r in res.items()}
 
     async def _xattrop(self, idxs, loc: Loc, deltas: dict[str, bytes]):
         return await self._dispatch(
@@ -642,17 +724,18 @@ class DisperseLayer(Layer):
 
         xdata = dict(xdata or {})
         xdata.setdefault("gfid-req", gfid_new())
+        # counters ride the create itself (storage/posix init-xattrs):
+        # one wave instead of create + setxattr
+        xdata["init-xattrs"] = {
+            XA_VERSION: _pack_u64x2(0, 0),
+            XA_SIZE: struct.pack(">Q", 0),
+            XA_DIRTY: _pack_u64x2(0, 0)}
         idxs = self._up_idx()
         res = await self._dispatch(idxs, "create",
                                    lambda i: ((loc, flags, mode, xdata), {}))
         good = self._combine(res, min_ok=self._write_quorum())
         child_fds = {i: r[0] for i, r in good.items()}
         ia = next(iter(good.values()))[1]
-        # initialize counters
-        zero = {XA_VERSION: _pack_u64x2(0, 0), XA_SIZE: struct.pack(">Q", 0),
-                XA_DIRTY: _pack_u64x2(0, 0)}
-        await self._dispatch(list(good), "setxattr",
-                             lambda i: ((loc, dict(zero)), {}))
         fd = FdObj(ia.gfid, flags, path=loc.path)
         fd.ctx_set(self, ECFdCtx(child_fds, flags))
         return fd, ia
@@ -710,6 +793,9 @@ class DisperseLayer(Layer):
         meta = await self._get_meta(ups, loc)
         vals = {i: m for i, m in meta.items()
                 if not isinstance(m, BaseException)}
+        return self._pick_meta(vals)
+
+    def _pick_meta(self, vals: dict[int, dict]) -> tuple[list[int], int]:
         if not vals:
             raise FopError(errno.ENOTCONN, "no readable children")
         clean = {i: m for i, m in vals.items() if m["dirty"] == (0, 0)}
@@ -816,13 +902,26 @@ class DisperseLayer(Layer):
         once per window, poison-across-dispatch (a torn-off wave must
         never let the flush release dirty over diverged fragments),
         good-set intersection, quorum, version delta."""
-        if not st.pre:
-            # pre-op once per window: dirty+1 (ec-common.c:2377)
-            pre_targets = sorted(st.good)
-            await self._xattrop(pre_targets, loc,
-                                {XA_DIRTY: _pack_u64x2(1, 0)})
-            st.pre = set(pre_targets)
         targets = sorted(st.good & set(self._up_idx()))
+        if not st.pre:
+            # pre-op once per window: dirty+1 (ec-common.c:2377).  For
+            # the common case — first fop is a write and every pre
+            # target is in the wave — the marker rides the write itself
+            # (compound pre-xattrop, applied brick-side before the
+            # data), saving one full fan-out wave per window
+            pre_targets = sorted(st.good)
+            if op == "writev" and pre_targets == targets:
+                base = argfn
+
+                def argfn(i, _b=base):
+                    args, kw = _b(i)
+                    xd = dict(kw.get("xdata") or {})
+                    xd["pre-xattrop"] = {XA_DIRTY: _pack_u64x2(1, 0)}
+                    return args, {**kw, "xdata": xd}
+            else:
+                await self._xattrop(pre_targets, loc,
+                                    {XA_DIRTY: _pack_u64x2(1, 0)})
+            st.pre = set(pre_targets)
         prev_good = st.good
         st.good = set()
         res = await self._dispatch(targets, op, argfn)
@@ -1118,8 +1217,21 @@ class DisperseLayer(Layer):
                 "per_brick": versions, "dirty": dirty}
 
     async def heal_file(self, path: str) -> dict:
-        """Full-file re-encode heal: decode from good K, rewrite bad
-        fragments, align counters (ec_rebuild_data, ec-heal.c:2048)."""
+        """Region-locked re-encode heal: decode from good K, rewrite bad
+        fragments, align counters (ec_rebuild_data, ec-heal.c:2048).
+
+        Locking is per heal window, not whole-file (ec_heal_inodelk
+        takes offset/size, ec-heal.c:251): direction + file creation run
+        under a brief full-range txn, each window rebuild under a txn
+        covering only that window's byte range, and the final counter
+        alignment under a full-range txn again.  Writers — who lock the
+        full range per fop — wait at most one window, so a multi-GiB
+        heal never freezes I/O to the file.  This is safe because live
+        writes dispatch to ALL up bricks (including the ones being
+        healed), so regions the heal already rebuilt stay current; if
+        the version moved while healing (a write landed), dirty is left
+        set so the next shd pass re-verifies instead of force-clearing
+        counters under a concurrent writer."""
         loc = Loc(path)
         info = await self.heal_info(loc)
         good, bad = info["good"], info["bad"]
@@ -1138,16 +1250,15 @@ class DisperseLayer(Layer):
             bad = good[self.k:]
             good = good[: self.k]
         gfid = (await self.lookup(loc))[0].gfid
+        fd = FdObj(gfid, path=path, anonymous=True)
         async with self._Txn(self, loc, gfid, "wr"):
             meta = await self._get_meta(good, loc)
-            rep = meta[good[0]]
+            rep = next((m for m in meta.values()
+                        if not isinstance(m, BaseException)), None)
+            if rep is None:
+                raise FopError(errno.EIO, "heal: no readable source meta")
             true_size = rep["size"]
             version = rep["version"]
-            fd = FdObj((await self.lookup(loc))[0].gfid, path=path,
-                       anonymous=True)
-            window = int(self.opts["self-heal-window-size"])
-            window = max(self.stripe, window // self.stripe * self.stripe)
-            healed = []
             # ensure bad bricks have the file at all
             for i in bad:
                 try:
@@ -1155,49 +1266,73 @@ class DisperseLayer(Layer):
                 except FopError:
                     try:
                         await self.children[i].mknod(
-                            loc, 0o644, 0, {"gfid-req": fd.gfid})
+                            loc, 0o644, 0, {"gfid-req": gfid})
                     except FopError:
                         continue
-            a_total = self._frag_len(true_size) * self.k
-            off = 0
-            while off < a_total:
-                length = min(window, a_total - off)
-                # decode strictly from good bricks
-                rows = good[: self.k]
+        window = int(self.opts["self-heal-window-size"])
+        window = max(self.stripe, window // self.stripe * self.stripe)
+        healed = []
+        a_total = self._frag_len(true_size) * self.k
+        rows = good[: self.k]
+        rows_sorted = sorted(rows)
+        from ..features.bit_rot_stub import HEAL_WRITE
+
+        off = 0
+        while off < a_total:
+            length = min(window, a_total - off)
+            # one ranged txn per window: writers (full-range locks)
+            # interleave between windows instead of waiting out the
+            # whole rebuild
+            async with self._Txn(self, loc, gfid, "wr",
+                                 start=off, end=off + length):
                 f_off, f_len = off // self.k, length // self.k
                 res = await self._dispatch(
                     rows, "readv",
                     lambda i: ((self._child_fd(fd, i), f_len, f_off), {}))
                 frags_in = np.zeros((self.k, f_len), dtype=np.uint8)
-                rows_sorted = sorted(rows)
                 for j, i in enumerate(rows_sorted):
                     r = res[i]
                     if isinstance(r, BaseException):
-                        raise FopError(errno.EIO, "heal source read failed")
+                        raise FopError(errno.EIO,
+                                       "heal source read failed")
                     b = np.frombuffer(r, dtype=np.uint8)
                     frags_in[j, : b.size] = b
                 data = await self._codec_decode(frags_in, rows_sorted)
                 frags_out = await self._codec_encode(data)
-                from ..features.bit_rot_stub import HEAL_WRITE
-
                 await self._dispatch(
                     bad, "writev",
                     lambda i: ((self._child_fd(fd, i),
                                 frags_out[i].tobytes(), f_off),
                                {"xdata": {HEAL_WRITE: True}}))
-                off += length
-            # align counters on healed bricks; clear dirty everywhere
-            fix = {XA_VERSION: _pack_u64x2(*version),
-                   XA_SIZE: struct.pack(">Q", true_size),
-                   XA_DIRTY: _pack_u64x2(0, 0)}
+            off += length
+        async with self._Txn(self, loc, gfid, "wr"):
+            # counters: re-read under the full lock.  Untouched version
+            # -> the heal saw every byte as of `version`: align bad and
+            # clear dirty (the pre-region-lock behavior).  Version moved
+            # -> writes landed mid-heal; their data DID reach the bad
+            # bricks (writes go to all up children) so align version/
+            # size to the current good value, but leave dirty for the
+            # next shd pass: a write that failed on a brick mid-heal
+            # after its window was rebuilt is only detectable there.
+            meta2 = await self._get_meta(good, loc)
+            rep2 = next((m for m in meta2.values()
+                         if not isinstance(m, BaseException)), None)
+            if rep2 is None:
+                raise FopError(errno.EIO, "heal: source meta lost")
+            fix = {XA_VERSION: _pack_u64x2(*rep2["version"]),
+                   XA_SIZE: struct.pack(">Q", rep2["size"])}
+            stable = rep2["version"] == version
+            if stable:
+                fix[XA_DIRTY] = _pack_u64x2(0, 0)
             await self._dispatch(bad, "setxattr",
                                  lambda i: ((loc, dict(fix)), {}))
-            await self._dispatch(good, "setxattr", lambda i: (
-                (loc, {XA_DIRTY: _pack_u64x2(0, 0)}), {}))
+            if stable:
+                await self._dispatch(good, "setxattr", lambda i: (
+                    (loc, {XA_DIRTY: _pack_u64x2(0, 0)}), {}))
             for i in bad:
                 healed.append(i)
             return {"healed": healed, "skipped": False,
-                    "size": true_size}
+                    "size": rep2["size"], "stable": stable}
 
     async def _codec_encode(self, buf):
         if self._batching:

@@ -162,14 +162,45 @@ class LocksLayer(Layer):
                       ltype: str = "wr", start: int = 0, end: int = -1,
                       xdata: dict | None = None):
         gfid = await self._gfid_for(loc)
-        return await self._do(self._inodelk, (gfid, domain), cmd,
-                              _Lock(self._owner(xdata), ltype, start, end))
+        ret = await self._do(self._inodelk, (gfid, domain), cmd,
+                             _Lock(self._owner(xdata), ltype, start, end))
+        if cmd == "lock" and (xdata or {}).get("get-xattrs"):
+            # lock-and-fetch: return the inode's xattrs with the grant,
+            # saving the caller a separate metadata round trip (the
+            # xdata-piggyback idiom the reference uses on lookups).
+            # None on failure — callers must never mistake a failed
+            # fetch for an inode with no xattrs
+            try:
+                return await self.children[0].getxattr(loc, None)
+            except FopError:
+                return None
+        return ret
 
     async def finodelk(self, domain: str, fd: FdObj, cmd: str,
                        ltype: str = "wr", start: int = 0, end: int = -1,
                        xdata: dict | None = None):
         return await self._do(self._inodelk, (fd.gfid, domain), cmd,
                               _Lock(self._owner(xdata), ltype, start, end))
+
+    async def xattrop(self, loc: Loc, op: str, xattrs: dict,
+                      xdata: dict | None = None):
+        """Compound post-op: an ``unlock-inodelk`` payload releases the
+        caller's transaction lock right after the xattrop commits —
+        clients fold the window-close unlock wave into the post-op wave
+        (ordering preserved: counters land, then the lock drops)."""
+        unlock = (xdata or {}).get("unlock-inodelk")
+        if unlock:
+            xdata = {k: v for k, v in xdata.items()
+                     if k != "unlock-inodelk"}
+        out = await self.children[0].xattrop(loc, op, xattrs, xdata)
+        if unlock:
+            domain, ltype, start, end, owner = unlock
+            try:
+                await self.inodelk(domain, loc, "unlock", ltype,
+                                   start, end, {"lk-owner": owner})
+            except FopError:
+                pass  # already gone (restarted brick): nothing to drop
+        return out
 
     async def entrylk(self, domain: str, loc: Loc, basename: str,
                       cmd: str, ltype: str = "wr",
