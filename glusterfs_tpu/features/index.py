@@ -171,6 +171,19 @@ class IndexLayer(Layer):
         await self._track(None, fd, out)
         return out
 
+    async def writev(self, fd: FdObj, data, offset: int = 0,
+                     xdata: dict | None = None):
+        """Compound pre-op: a ``pre-xattrop`` payload in xdata applies
+        (and index-tracks) the dirty marker in the SAME brick round as
+        the data write — the client saves a full fan-out wave, the
+        crash-ordering guarantee is unchanged (marker lands before the
+        data, both inside this one brick op)."""
+        pre = (xdata or {}).get("pre-xattrop")
+        if pre:
+            xdata = {k: v for k, v in xdata.items() if k != "pre-xattrop"}
+            await self.fxattrop(fd, "add64", dict(pre), None)
+        return await self.children[0].writev(fd, data, offset, xdata)
+
     async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
                        xdata: dict | None = None):
         if XA_INDEX_PRUNE in xattrs:

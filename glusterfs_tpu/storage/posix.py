@@ -70,6 +70,13 @@ except (OSError, AttributeError):  # non-Linux: posix_fallocate fallback
     _libc_fallocate = None
 
 
+def _hex_val(v) -> str:
+    """Canonical sidecar encoding of one xattr value (bytes -> hex;
+    anything else through its str form) — create's init-xattrs and
+    setxattr must stay byte-identical."""
+    return (v if isinstance(v, bytes) else str(v).encode()).hex()
+
+
 def split_gfid_record(content: str) -> tuple[str, str]:
     """Parse a gfid record -> (inokey, relpath).  Modern records are
     'dev:ino\\nrelpath' with a possibly-EMPTY key line (root is recorded
@@ -99,6 +106,27 @@ def rebuild_identity(root: str) -> int:
     handle_dir = os.path.join(root, META_DIR, "handle")
     if not os.path.isdir(gfid_dir):
         return 0
+    # fold any xattr journal into the JSON files first, so the orphan
+    # sweep below sees (and prunes) the real final state
+    journal = os.path.join(xattr_dir, "journal.jsonl")
+    if os.path.exists(journal):
+        with open(journal) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                p = os.path.join(xattr_dir, rec["g"] + ".json")
+                if rec["x"] is None:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+                else:
+                    with open(p + ".tmp", "w") as g:
+                        json.dump(rec["x"], g)
+                    os.replace(p + ".tmp", p)
+        os.unlink(journal)
     for d, pred in ((xattr_dir, lambda n: n.startswith("ino-")),
                     (handle_dir, lambda n: True)):
         if os.path.isdir(d):
@@ -220,6 +248,17 @@ class PosixLayer(Layer):
                "(posix_health_check_thread_proc)"),
     )
 
+    # journal records between sidecar compactions (the xattr write-path
+    # cost model: one O_APPEND write per update instead of the four
+    # syscalls of open+write+close+replace; same durability — neither
+    # path fsyncs, both live in the page cache until the OS flushes)
+    XATTR_COMPACT_EVERY = 4096
+    # cache bounds: clean entries evict once past these, so a brick
+    # serving millions of files stays O(cap) resident, not O(files);
+    # dirty xattr entries are pinned until compaction persists them
+    XATTR_CACHE_MAX = 65536
+    INO_CACHE_MAX = 262144
+
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         root = self.opts.get("directory")
@@ -230,6 +269,17 @@ class PosixLayer(Layer):
         self._xattr_dir = os.path.join(self.root, META_DIR, "xattr")
         self._handle_dir = os.path.join(self.root, META_DIR, "handle")
         self._executor = None  # worker pool injected by io-threads
+        # xattr sidecar cache + append journal (posix-metadata.c keeps
+        # metadata in ONE xattr blob; the analog here is one in-memory
+        # dict per gfid, journaled on update, compacted to the per-gfid
+        # JSON files every XATTR_COMPACT_EVERY records)
+        self._xa_cache: dict[bytes, dict] = {}
+        self._xa_dirty: set[bytes] = set()
+        self._ino_cache: dict[str, bytes] = {}  # "dev:ino" -> gfid
+        self._xa_journal_path = os.path.join(self._xattr_dir,
+                                             "journal.jsonl")
+        self._xa_journal_fd: int | None = None
+        self._xa_records = 0
 
     def set_io_executor(self, executor) -> None:
         """io-threads hands us its worker pool; data-plane syscalls run
@@ -250,6 +300,7 @@ class PosixLayer(Layer):
         os.makedirs(self._gfid_dir, exist_ok=True)
         os.makedirs(self._xattr_dir, exist_ok=True)
         os.makedirs(self._handle_dir, exist_ok=True)
+        self._xa_replay_journal()
         # root of the brick always has the fixed ROOT_GFID
         if not os.path.exists(self._gfid_path(ROOT_GFID)):
             self._gfid_set(ROOT_GFID, "/")
@@ -267,6 +318,12 @@ class PosixLayer(Layer):
             except (asyncio.CancelledError, Exception):
                 pass
             self._health_task = None
+        # clean shutdown folds the xattr journal into the JSON files
+        # (a kill skips this; init replays the journal instead)
+        try:
+            self._xa_compact()
+        except OSError:
+            pass
         await super().fini()
 
     def reconfigure(self, options: dict) -> None:
@@ -390,29 +447,43 @@ class PosixLayer(Layer):
         try:
             inokey, _ = self._gfid_read(gfid)
             if inokey:
+                self._ino_cache.pop(inokey, None)
                 os.unlink(os.path.join(self._xattr_dir, "ino-" + inokey))
         except (FopError, FileNotFoundError):
             pass
-        for p in (self._handle_path(gfid), self._gfid_path(gfid),
-                  os.path.join(self._xattr_dir, gfid.hex() + ".json")):
+        for p in (self._handle_path(gfid), self._gfid_path(gfid)):
             try:
                 os.unlink(p)
             except FileNotFoundError:
                 pass
+        self._xattr_del(gfid)
 
     def _gfid_of(self, path: str) -> bytes | None:
-        """Read the per-object gfid marker (sidecar next to xattr store)."""
+        """Read the per-object gfid marker (sidecar next to xattr store).
+        dev:ino -> gfid is immutable for an inode's lifetime, so a hit
+        in the in-memory map skips the sidecar read every stat pays."""
         try:
             st = os.lstat(self._abs(path))
         except OSError as e:
             raise _fop_errno(e)
         key = f"{st.st_dev}:{st.st_ino}"
+        g = self._ino_cache.get(key)
+        if g is not None:
+            return g
         p = os.path.join(self._xattr_dir, "ino-" + key)
         try:
             with open(p, "rb") as f:
-                return f.read(16)
+                g = f.read(16)
         except FileNotFoundError:
             return None
+        if len(g) != 16:  # torn record from a crash mid-write
+            return None
+        if len(self._ino_cache) >= self.INO_CACHE_MAX:
+            # shed an arbitrary half: every entry is re-derivable
+            for k in list(self._ino_cache)[: self.INO_CACHE_MAX // 2]:
+                del self._ino_cache[k]
+        self._ino_cache[key] = g
+        return g
 
     def _gfid_bind(self, path: str, gfid: bytes) -> None:
         ap = self._abs(path)
@@ -422,9 +493,11 @@ class PosixLayer(Layer):
             raise _fop_errno(e)
         key = f"{st.st_dev}:{st.st_ino}"
         p = os.path.join(self._xattr_dir, "ino-" + key)
-        with open(p + ".tmp", "wb") as f:
+        # single 16-byte write: a torn record reads short and is treated
+        # as unbound (then re-healed), so the tmp+replace dance is waste
+        with open(p, "wb") as f:
             f.write(gfid)
-        os.replace(p + ".tmp", p)
+        self._ino_cache[key] = gfid
         self._gfid_set(gfid, path if path.startswith("/") else "/" + path,
                        inokey=key)
         # handle hardlink for anything hardlinkable (reference
@@ -461,23 +534,125 @@ class PosixLayer(Layer):
             raise _fop_errno(e)
         return Iatt.from_stat(st, self._require_gfid(path))
 
-    # -- xattr sidecar -----------------------------------------------------
+    # -- xattr sidecar (in-memory cache + append journal) ------------------
+    # Updates append ONE record to a per-brick journal and mutate the
+    # cache; the per-gfid JSON files are rewritten only at compaction.
+    # A killed brick replays the journal over the JSON files at init —
+    # byte-for-byte the state an uncached store would have had, because
+    # neither path fsyncs (page-cache durability either way).  All xattr
+    # mutation runs on the brick event loop (see set_io_executor), so
+    # the cache needs no locking.
 
     def _xattr_path(self, gfid: bytes) -> str:
         return os.path.join(self._xattr_dir, gfid.hex() + ".json")
 
-    def _xattr_load(self, gfid: bytes) -> dict[str, str]:
+    def _xa_replay_journal(self) -> None:
         try:
-            with open(self._xattr_path(gfid)) as f:
-                return json.load(f)
+            with open(self._xa_journal_path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail record from a kill
+                    g = bytes.fromhex(rec["g"])
+                    if rec["x"] is None:
+                        self._xa_cache.pop(g, None)
+                        try:
+                            os.unlink(self._xattr_path(g))
+                        except OSError:
+                            pass
+                    else:
+                        self._xa_cache[g] = rec["x"]
+                    self._xa_dirty.add(g)
+                    self._xa_records += 1
         except FileNotFoundError:
-            return {}
+            return
+
+    def _xa_append(self, gfid: bytes, xattrs: dict | None) -> None:
+        if self._xa_journal_fd is None:
+            self._xa_journal_fd = os.open(
+                self._xa_journal_path,
+                os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+        os.write(self._xa_journal_fd,
+                 (json.dumps({"g": gfid.hex(), "x": xattrs}) + "\n")
+                 .encode())
+        self._xa_dirty.add(gfid)
+        self._xa_records += 1
+        if self._xa_records >= self.XATTR_COMPACT_EVERY:
+            self._xa_compact()
+
+    def _xa_compact(self) -> None:
+        """Fold the journal into the per-gfid JSON files and truncate."""
+        for g in self._xa_dirty:
+            p = self._xattr_path(g)
+            cur = self._xa_cache.get(g)
+            if cur is None:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+                continue
+            with open(p + ".tmp", "w") as f:
+                json.dump(cur, f)
+            os.replace(p + ".tmp", p)
+        self._xa_dirty.clear()
+        self._xa_records = 0
+        if self._xa_journal_fd is not None:
+            os.close(self._xa_journal_fd)
+            self._xa_journal_fd = None
+        try:
+            os.truncate(self._xa_journal_path, 0)
+        except OSError:
+            pass
+
+    def drop_caches(self) -> None:
+        """Forget all in-memory sidecar state.  For tooling/tests that
+        mutate the brick backend out-of-band under a live layer (a real
+        brick replacement respawns the process, making this implicit)."""
+        self._xa_cache.clear()
+        self._xa_dirty.clear()
+        self._ino_cache.clear()
+        if self._xa_journal_fd is not None:
+            os.close(self._xa_journal_fd)
+            self._xa_journal_fd = None
+        self._xa_records = 0
+
+    def _xa_evict(self) -> None:
+        """Bound the cache: shed clean entries once past the cap (dirty
+        ones carry journal-only state and stay pinned to compaction)."""
+        if len(self._xa_cache) <= self.XATTR_CACHE_MAX:
+            return
+        for g in list(self._xa_cache):
+            if g not in self._xa_dirty:
+                del self._xa_cache[g]
+                if len(self._xa_cache) <= self.XATTR_CACHE_MAX // 2:
+                    break
+
+    def _xattr_load(self, gfid: bytes) -> dict[str, str]:
+        cur = self._xa_cache.get(gfid)
+        if cur is None:
+            try:
+                with open(self._xattr_path(gfid)) as f:
+                    cur = json.load(f)
+            except FileNotFoundError:
+                cur = {}
+            self._xa_cache[gfid] = cur
+            self._xa_evict()
+        return dict(cur)  # callers mutate-then-store; never alias cache
 
     def _xattr_store(self, gfid: bytes, xattrs: dict[str, str]) -> None:
-        p = self._xattr_path(gfid)
-        with open(p + ".tmp", "w") as f:
-            json.dump(xattrs, f)
-        os.replace(p + ".tmp", p)
+        self._xa_cache[gfid] = dict(xattrs)
+        self._xa_append(gfid, xattrs)
+        self._xa_evict()
+
+    def _xattr_del(self, gfid: bytes) -> None:
+        """Drop a gfid's xattrs entirely (unlink/nuke paths)."""
+        self._xa_cache.pop(gfid, None)
+        self._xa_append(gfid, None)
+        try:
+            os.unlink(self._xattr_path(gfid))
+        except OSError:
+            pass
 
     # -- namespace fops ----------------------------------------------------
 
@@ -535,6 +710,12 @@ class PosixLayer(Layer):
             raise _fop_errno(e)
         gfid = (xdata or {}).get("gfid-req") or gfid_new()
         self._gfid_bind(path, gfid)
+        init = (xdata or {}).get("init-xattrs")
+        if init:
+            # cluster layers seed their counter xattrs in the SAME fop
+            # as the create — one wave instead of create + setxattr
+            self._xattr_store(gfid,
+                              {k: _hex_val(v) for k, v in init.items()})
         fd = FdObj(gfid, flags, path=path)
         fd.ctx_set(self, fdno)
         return fd, self._iatt(path)
@@ -673,6 +854,11 @@ class PosixLayer(Layer):
 
     async def writev(self, fd: FdObj, data: bytes, offset: int,
                      xdata: dict | None = None):
+        pre = (xdata or {}).get("pre-xattrop")
+        if pre:
+            # fallback for graphs with no features/index above (which
+            # normally consumes the key): marker before data, same op
+            await self.fxattrop(fd, "add64", dict(pre), None)
         fdno = self._os_fd(fd)
 
         def work():
@@ -813,7 +999,7 @@ class PosixLayer(Layer):
                 raise FopError(errno.EEXIST, k)
             if flags & os.XATTR_REPLACE and k not in cur:
                 raise FopError(errno.ENODATA, k)
-            cur[k] = (v if isinstance(v, bytes) else str(v).encode()).hex()
+            cur[k] = _hex_val(v)
         self._xattr_store(gfid, cur)
         return {}
 
@@ -838,8 +1024,11 @@ class PosixLayer(Layer):
             # (bit-rot-stub's quarantine set)
             key = name[len(XA_SCAN_PREFIX):]
             hexes = []
+            # union of compacted files and the live cache (journal-only
+            # gfids have no JSON file yet); cache wins on overlap
+            cached = {g.hex() for g in self._xa_cache}
             for n in os.listdir(self._xattr_dir):
-                if not n.endswith(".json"):
+                if not n.endswith(".json") or n[:-5] in cached:
                     continue
                 try:
                     with open(os.path.join(self._xattr_dir, n)) as f:
@@ -847,6 +1036,9 @@ class PosixLayer(Layer):
                             hexes.append(n[:-5])
                 except (OSError, ValueError):
                     continue
+            for g, xs in self._xa_cache.items():
+                if key in xs:
+                    hexes.append(g.hex())
             return {name: "\n".join(hexes).encode()}
         gfid = self._require_gfid(self._loc_path(loc))
         cur = self._xattr_load(gfid)

@@ -210,6 +210,9 @@ def test_full_crawl_routes_to_owning_group(tmp_path):
         for sub in ("gfid", "xattr", "handle",
                     os.path.join("indices", "xattrop")):
             os.makedirs(tmp_path / "brick1" / ".glusterfs_tpu" / sub)
+        # the live layer caches sidecar state; an out-of-band wipe needs
+        # the explicit invalidation a real respawn gets for free
+        c.graph.by_name["b1"].drop_caches()
         report = await full_crawl(c)
         # routing: the non-owning group must produce NO spurious
         # failures (before routing, every file errored once per
