@@ -238,10 +238,13 @@ def randrw_bench(n_clients: int = 64, backend: str = "auto") -> dict:
             "randrw_write_MiB": round(stats["write"] / MIB, 1)}
 
 
-def smallfile_bench(n_files: int = 200, backend: str = "native") -> dict:
+def smallfile_bench(n_files: int = 200, backend: str = "native",
+                    passes: int = 2) -> dict:
     """glfs-bm analog (extras/benchmarking): small-file metadata rate —
     create+write+close, stat, read, unlink over many 4 KiB files on a
-    4+2 volume; reports ops/s per phase."""
+    4+2 volume; reports ops/s per phase.  Best of ``passes`` runs: the
+    single shared core makes one-shot rates hostage to whatever else
+    ticked during the measurement."""
     payload = b"s" * 4096
 
     async def body(c):
@@ -264,9 +267,13 @@ def smallfile_bench(n_files: int = 200, backend: str = "native") -> dict:
         out["unlink"] = n_files / (time.perf_counter() - t0)
         return out
 
-    rates = _on_mounted_volume(body, backend)
+    best: dict = {}
+    for _ in range(max(1, passes)):
+        rates = _on_mounted_volume(body, backend)
+        for k, v in rates.items():
+            best[k] = max(best.get(k, 0.0), v)
     return {f"smallfile_{k}_per_s": round(v, 1)
-            for k, v in rates.items()}
+            for k, v in best.items()}
 
 
 def fullstack_bench(n_clients: int = 8, file_mib: int = 1) -> dict:
