@@ -4,10 +4,14 @@ Reference: xlators/cluster/dht (34k LoC).  Behaviors kept:
 
 * **Placement** (dht-hashfn.c:72, dht-layout.c:20-94): a file lives on the
   subvolume whose hash range covers ``hash(basename)``; directories exist
-  on every subvolume.  The reference persists per-directory range maps in
-  ``trusted.glusterfs.dht``; this build derives an even split of the
-  32-bit hash space over the child list (layout regeneration on
-  add/remove-brick is rebalance's job, as there).
+  on every subvolume.  Per-directory hash ranges are PERSISTED in a
+  ``trusted.glusterfs.dht`` xattr on each subvolume's copy of the
+  directory (written at mkdir, read at first use, cached with a TTL);
+  a directory without the xattr falls back to the derived even split.
+  ``rebalance fix-layout`` rewrites ranges — optionally weighted — over
+  the current child set (dht-selfheal.c layout set + fix-layout), which
+  is what lets add-brick direct NEW creates at the new brick without
+  lookup-everywhere.
 * **Linkto files** (dht-linkfile.c:95): after rename/rebalance, a file
   whose data lives off its hashed subvolume leaves a zero-byte pointer
   file there carrying ``trusted.glusterfs.dht.linkto = <real subvol>``;
@@ -25,6 +29,7 @@ required since layouts are never exchanged with the reference).
 from __future__ import annotations
 
 import errno
+import struct
 from collections import Counter
 
 from ..core.fops import FopError
@@ -36,6 +41,14 @@ from ..core import gflog
 log = gflog.get_logger("dht")
 
 XA_LINKTO = "trusted.glusterfs.dht.linkto"
+XA_LAYOUT = "trusted.glusterfs.dht"
+# packed per-subvol range: (version, commit, start, stop) — the shape of
+# the reference's on-disk layout record (dht-layout.c:20-94); commit is
+# the layout generation (reference vol_commit_hash): when it matches the
+# CURRENT child set, a miss at the range owner is authoritative and the
+# everywhere-lookup is skipped (cluster.lookup-optimize semantics)
+_LAYOUT_FMT = ">IIII"
+LAYOUT_TTL = 5.0  # seconds a cached directory layout stays trusted
 
 
 def dm_hash(name: str) -> int:
@@ -73,6 +86,10 @@ class DistributeLayer(Layer):
                "volume (remove-brick start): excluded from the layout "
                "so no NEW data lands on them while rebalance drains "
                "them (dht decommission_node_map)"),
+        Option("lookup-optimize", "bool", default="on",
+               description="skip the everywhere-lookup on a miss when "
+               "the directory's layout commit matches the current "
+               "child set (cluster.lookup-optimize)"),
     )
 
     def __init__(self, *args, **kw):
@@ -80,6 +97,9 @@ class DistributeLayer(Layer):
         self.n = len(self.children)
         if self.n < 1:
             raise ValueError(f"{self.name}: needs >= 1 child")
+        # persisted-layout cache: dirpath -> (expiry, ranges) where
+        # ranges = [(start, stop, child_idx)] or None (= derived split)
+        self._layouts: dict[str, tuple[float, list | None]] = {}
         self._recompute_active()
 
     def _recompute_active(self) -> None:
@@ -89,6 +109,10 @@ class DistributeLayer(Layer):
                         if c.name not in gone]
         if not self._active:
             raise ValueError(f"{self.name}: every child decommissioned")
+        # cached layouts (and their authoritative flags) were judged
+        # against the OLD active set: a stale authoritative=True would
+        # let lookup-optimize ENOENT files that moved routing
+        self._layouts.clear()
 
     def reconfigure(self, options: dict) -> None:
         super().reconfigure(options)
@@ -98,7 +122,8 @@ class DistributeLayer(Layer):
 
     def hashed_idx(self, name: str) -> int:
         """Even split of the 2^32 hash space over the ACTIVE children
-        (dht_layout_t ranges; decommissioned nodes hold no range)."""
+        (dht_layout_t ranges; decommissioned nodes hold no range) —
+        the DERIVED layout used when a directory has no persisted one."""
         span = (1 << 32) // len(self._active)
         return self._active[min(dm_hash(name) // span,
                                 len(self._active) - 1)]
@@ -106,10 +131,216 @@ class DistributeLayer(Layer):
     def _hashed(self, loc: Loc) -> int:
         return self.hashed_idx(loc.name or loc.path.rsplit("/", 1)[-1])
 
+    # -- persisted per-directory layouts (dht-layout.c / dht-selfheal.c) --
+
+    @staticmethod
+    def _parent_of(loc: Loc) -> str:
+        p = loc.path.rstrip("/")
+        return p.rsplit("/", 1)[0] or "/"
+
+    def compute_ranges(self, weights: dict[str, float] | None = None
+                       ) -> list[tuple[int, int, int]]:
+        """Split the 2^32 space over active children, proportionally to
+        ``weights`` (by child NAME; missing = 1.0) — the weighted-layout
+        capability derived layouts cannot express."""
+        ws = [max(0.0, float((weights or {}).get(
+            self.children[i].name, 1.0))) for i in self._active]
+        total = sum(ws) or float(len(self._active))
+        ranges: list[tuple[int, int, int]] = []
+        cursor = 0
+        for pos, i in enumerate(self._active):
+            stop = (1 << 32) - 1 if pos == len(self._active) - 1 else \
+                cursor + max(1, int((1 << 32) * ws[pos] / total)) - 1
+            stop = min(stop, (1 << 32) - 1)
+            ranges.append((cursor, stop, i))
+            cursor = stop + 1
+            if cursor > (1 << 32) - 1:
+                ranges.extend((0, -1, j) for j in self._active[pos + 1:])
+                break
+        return [r for r in ranges if r[1] >= r[0]]
+
+    def _active_commit(self) -> int:
+        """Layout generation for the CURRENT active child set (the
+        vol_commit_hash analog): stored into every written layout, so a
+        later child-set change makes old layouts non-authoritative."""
+        return dm_hash("|".join(self.children[i].name
+                                for i in self._active))
+
+    async def _dir_meta(self, dirpath: str) -> tuple[list | None, bool]:
+        """(persisted layout of ``dirpath`` or None, authoritative?).
+
+        None layout = no child carries the xattr, or the union is
+        anomalous (holes/overlap -> derived fallback; the reference
+        treats those as needing a layout heal).  Authoritative = every
+        record's commit matches the current child set, so a miss at the
+        range owner proves absence (lookup-optimize)."""
+        import time as _time
+
+        hit = self._layouts.get(dirpath)
+        now = _time.monotonic()
+        if hit is not None and hit[0] > now:
+            return hit[1], hit[2]
+        loc = Loc(dirpath)
+        ranges: list[tuple[int, int, int]] = []
+        commits: set[int] = set()
+        found = False
+        for i in range(self.n):
+            try:
+                out = await self.children[i].getxattr(loc, XA_LAYOUT)
+            except FopError:
+                continue
+            try:
+                _v, commit, start, stop = struct.unpack(
+                    _LAYOUT_FMT, out[XA_LAYOUT])
+            except (KeyError, struct.error):
+                continue
+            found = True
+            commits.add(commit)
+            if stop >= start:
+                ranges.append((start, stop, i))
+        layout: list | None = None
+        if found:
+            ranges.sort()
+            ok = bool(ranges) and ranges[0][0] == 0 and \
+                ranges[-1][1] == (1 << 32) - 1 and \
+                all(ranges[j][1] + 1 == ranges[j + 1][0]
+                    for j in range(len(ranges) - 1))
+            if ok:
+                layout = ranges
+            else:
+                log.warning(2, "%s: anomalous layout on %s (%d ranges):"
+                            " derived fallback", self.name, dirpath,
+                            len(ranges))
+        authoritative = layout is not None and \
+            commits == {self._active_commit()}
+        self._layouts[dirpath] = (now + LAYOUT_TTL, layout, authoritative)
+        if len(self._layouts) > 4096:  # bound: every entry re-derivable
+            for k in list(self._layouts)[:2048]:
+                self._layouts.pop(k, None)
+        return layout, authoritative
+
+    async def _dir_layout(self, dirpath: str) -> list | None:
+        return (await self._dir_meta(dirpath))[0]
+
+    async def _write_layout(self, dirpath: str,
+                            ranges: list[tuple[int, int, int]]) -> None:
+        """Persist one range per owning child on ITS copy of the dir;
+        children that LOST their range (decommission + fix-layout) get
+        the record removed, else the stale range overlaps the new union
+        and every read degrades to the anomalous-layout fallback."""
+        loc = Loc(dirpath)
+        commit = self._active_commit()
+        by_child = {idx: (start, stop) for start, stop, idx in ranges}
+        for i in range(self.n):
+            try:
+                if i in by_child:
+                    start, stop = by_child[i]
+                    await self.children[i].setxattr(loc, {
+                        XA_LAYOUT: struct.pack(_LAYOUT_FMT, 1, commit,
+                                               start, stop)})
+                else:
+                    await self.children[i].removexattr(loc, XA_LAYOUT)
+            except FopError as e:
+                if e.err not in (errno.ENODATA, errno.ENOENT,
+                                 errno.ESTALE):
+                    log.warning(2, "%s: layout write on %s child %d: "
+                                "%s", self.name, dirpath, i, e)
+        import time as _time
+
+        self._layouts[dirpath] = (_time.monotonic() + LAYOUT_TTL,
+                                  sorted(ranges), True)
+
+    async def _placed(self, loc: Loc) -> int:
+        """Owning subvol for a basename per the parent's PERSISTED
+        layout; derived split when none exists."""
+        name = loc.name or loc.path.rsplit("/", 1)[-1]
+        layout = await self._dir_layout(self._parent_of(loc))
+        if layout:
+            h = dm_hash(name)
+            for start, stop, idx in layout:
+                if start <= h <= stop:
+                    # a decommissioned child keeps its range until
+                    # fix-layout; route around it like the derived path
+                    return idx if idx in self._active else \
+                        self.hashed_idx(name)
+        return self.hashed_idx(name)
+
+    async def fix_layout(self, path: str = "/",
+                         weights: dict[str, float] | None = None) -> dict:
+        """Recompute + persist every directory's ranges over the CURRENT
+        active children (``rebalance fix-layout``): creates missing
+        directory copies (a just-added brick has none), writes the new
+        ranges, and descends.  Data stays put — only NEW names follow
+        the new layout; ``rebalance`` migrates existing files."""
+        fixed = 0
+        loc = Loc(path)
+        src = None
+        for i in range(self.n):
+            try:
+                ia, _ = await self.children[i].lookup(loc)
+                src = (i, ia)
+                break
+            except FopError:
+                continue
+        if src is None:
+            raise FopError(errno.ENOENT, path)
+        for i in self._active:
+            if i == src[0]:
+                continue
+            try:
+                await self.children[i].lookup(loc)
+            except FopError:
+                try:
+                    await self.children[i].mkdir(
+                        loc, src[1].mode & 0o7777,
+                        {"gfid-req": src[1].gfid})
+                except FopError:
+                    pass
+        ranges = self.compute_ranges(weights)
+
+        def owner_of(name: str) -> int:
+            h = dm_hash(name)
+            for start, stop, idx in ranges:
+                if start <= h <= stop:
+                    return idx
+            return self.hashed_idx(name)
+
+        # walk under the OLD layout first: names the NEW ranges re-home
+        # get a linkto at their new owner (dht_linkfile) BEFORE the new
+        # layout goes live, so lookup-optimize's authoritative miss can
+        # never lose a pre-fix file — its new position either holds the
+        # file or points at it
+        fd = await self.opendir(loc)
+        entries = await self.readdirp(fd)
+        for name, ia in entries:
+            if ia is not None and ia.ia_type is IAType.DIR:
+                continue
+            child = path.rstrip("/") + "/" + name
+            cloc = Loc(child)
+            try:
+                cur = await self._cached_idx(cloc)
+            except FopError:
+                continue
+            new_owner = owner_of(name)
+            if new_owner != cur:
+                try:
+                    await self.children[new_owner].lookup(cloc)
+                except FopError:
+                    gfid = (await self.children[cur].lookup(cloc))[0].gfid
+                    await self._make_linkto(new_owner, cloc, cur, gfid)
+        await self._write_layout(path, ranges)
+        fixed += 1
+        for name, ia in entries:
+            if ia is not None and ia.ia_type is IAType.DIR:
+                sub = await self.fix_layout(
+                    path.rstrip("/") + "/" + name, weights)
+                fixed += sub["fixed"]
+        return {"fixed": fixed, "path": path}
+
     async def _cached_idx(self, loc: Loc) -> int:
         """Subvol actually holding the file: hashed, linkto target, or
         global-lookup result (dht cached-subvol resolution)."""
-        hi = self._hashed(loc)
+        hi = await self._placed(loc)
         try:
             ia, _ = await self.children[hi].lookup(loc)
             if ia.ia_type is IAType.DIR:
@@ -123,6 +354,16 @@ class DistributeLayer(Layer):
                 raise
         if not self.opts["lookup-unhashed"]:
             raise FopError(errno.ENOENT, loc.path)
+        if self.opts["lookup-optimize"] and loc.path:
+            # an up-to-date persisted layout proves absence: every name
+            # placed under it went to its range owner, and fix-layout
+            # leaves linktos there for names the layout re-homed — the
+            # fan-out would find nothing (cluster.lookup-optimize).
+            # gfid-only locs (handle API) carry no name to place, so
+            # they always take the everywhere pass.
+            _, authoritative = await self._dir_meta(self._parent_of(loc))
+            if authoritative:
+                raise FopError(errno.ENOENT, loc.path)
         for i in range(self.n):  # everywhere-lookup
             if i == hi:
                 continue
@@ -173,6 +414,9 @@ class DistributeLayer(Layer):
                 errs.append(e)
         if not results:
             raise errs[0]
+        # persist the new directory's hash ranges (dht_selfheal_dir:
+        # every fresh dir gets a layout written at creation)
+        await self._write_layout(loc.path, self.compute_ranges())
         return results[0]
 
     async def rmdir(self, loc: Loc, flags: int = 0,
@@ -190,18 +434,18 @@ class DistributeLayer(Layer):
             raise last
         return {}
 
-    def sched_idx(self, loc: Loc) -> int:
-        """Which subvol NEW files land on.  Plain distribute follows
-        the hash; the nufa/switch variants override this (the
-        reference's dht_methods/scheduler indirection, nufa.c,
-        switch.c)."""
-        return self._hashed(loc)
+    async def _sched(self, loc: Loc) -> int:
+        """Which subvol NEW files land on: the parent's persisted
+        layout.  The nufa/switch variants override this with their
+        policy placement (the reference's dht_methods/scheduler
+        indirection, nufa.c, switch.c)."""
+        return await self._placed(loc)
 
     async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
                      xdata: dict | None = None):
-        idx = self.sched_idx(loc)
+        idx = await self._sched(loc)
         fd_c, ia = await self.children[idx].create(loc, flags, mode, xdata)
-        hi = self._hashed(loc)
+        hi = await self._placed(loc)
         if hi != idx:
             # scheduled off the hashed subvol: leave the lookup pointer
             # (dht_linkfile_create in nufa_create_cbk / switch)
@@ -219,15 +463,15 @@ class DistributeLayer(Layer):
 
     async def mknod(self, loc: Loc, mode: int = 0o644, rdev: int = 0,
                     xdata: dict | None = None):
-        idx = self.sched_idx(loc)
+        idx = await self._sched(loc)
         ia = await self.children[idx].mknod(loc, mode, rdev, xdata)
-        hi = self._hashed(loc)
+        hi = await self._placed(loc)
         if hi != idx:
             await self._make_linkto(hi, loc, idx, ia.gfid)
         return ia
 
     async def symlink(self, target: str, loc: Loc, xdata: dict | None = None):
-        return await self.children[self._hashed(loc)].symlink(
+        return await self.children[await self._placed(loc)].symlink(
             target, loc, xdata)
 
     async def readlink(self, loc: Loc, xdata: dict | None = None):
@@ -236,7 +480,7 @@ class DistributeLayer(Layer):
 
     async def unlink(self, loc: Loc, xdata: dict | None = None):
         idx = await self._cached_idx(loc)
-        hi = self._hashed(loc)
+        hi = await self._placed(loc)
         if idx != hi:  # drop the linkto too
             try:
                 await self.children[hi].unlink(loc, xdata)
@@ -262,7 +506,7 @@ class DistributeLayer(Layer):
             if out is None:
                 raise FopError(errno.EIO, "dir rename failed everywhere")
             return out
-        dst_hashed = self._hashed(newloc)
+        dst_hashed = await self._placed(newloc)
         # POSIX rename overwrites an existing destination.  The rename on
         # src only replaces a same-subvol dst; a live dst file elsewhere
         # must be unlinked, or _make_linkto would silently convert it into
@@ -285,7 +529,7 @@ class DistributeLayer(Layer):
             # dst-hashed subvol (dht-linkfile.c:95)
             await self._make_linkto(dst_hashed, newloc, src, ia.gfid)
         # stale linkto at old hashed location?
-        old_hashed = self._hashed(oldloc)
+        old_hashed = await self._placed(oldloc)
         if old_hashed != src:
             try:
                 await self.children[old_hashed].unlink(oldloc)
@@ -506,7 +750,7 @@ class DistributeLayer(Layer):
                 scanned += sub["scanned"]
                 continue
             scanned += 1
-            hi = self._hashed(cloc)
+            hi = await self._placed(cloc)
             if hi == idx:
                 continue
             # migrate: copy data + xattrs, then swap

@@ -39,7 +39,7 @@ class NufaLayer(DistributeLayer):
                 raise ValueError(
                     f"{self.name}: no child named {want!r}")
 
-    def sched_idx(self, loc: Loc) -> int:
+    async def _sched(self, loc: Loc) -> int:
         if self._local in self._active:
             return self._local
-        return self._hashed(loc)  # local brick is being removed
+        return await self._placed(loc)  # local brick is being removed

@@ -47,7 +47,7 @@ class SwitchLayer(DistributeLayer):
                                      "names no subvolumes")
                 self._rules.append((pat.strip(), idxs))
 
-    def sched_idx(self, loc: Loc) -> int:
+    def _rule_idx(self, loc: Loc) -> int | None:
         name = loc.name or loc.path.rsplit("/", 1)[-1]
         for pat, idxs in self._rules:
             live = [i for i in idxs if i in self._active]
@@ -55,4 +55,8 @@ class SwitchLayer(DistributeLayer):
                 # hash WITHIN the matched set so multi-subvol rules
                 # still spread load (switch_local scheduling)
                 return live[dm_hash(name) % len(live)]
-        return self._hashed(loc)
+        return None
+
+    async def _sched(self, loc: Loc) -> int:
+        idx = self._rule_idx(loc)
+        return idx if idx is not None else await self._placed(loc)

@@ -267,6 +267,10 @@ async def _run(args) -> Any:
                 return await c.call("volume-bitrot", name=args.name,
                                     action=action)
         if sub == "rebalance":
+            # volume rebalance NAME [fix-layout] [child=weight ...] —
+            # fix-layout rewrites every directory's persisted hash
+            # ranges over the current brick set (optionally weighted)
+            # without moving data; bare rebalance migrates files
             client = await mount_volume(host, port, args.name)
             try:
                 from ..cluster.dht import DistributeLayer
@@ -274,6 +278,18 @@ async def _run(args) -> Any:
                 dht = _find_layer(client.graph, DistributeLayer)
                 if dht is None:
                     return {"error": "not a distributed volume"}
+                if args.args and args.args[0] == "fix-layout":
+                    weights = {}
+                    for spec in args.args[1:]:
+                        child, sep, w = spec.partition("=")
+                        try:
+                            if not sep:
+                                raise ValueError
+                            weights[child] = float(w)
+                        except ValueError:
+                            return {"error": f"bad weight {spec!r} "
+                                             "(want child=NUMBER)"}
+                    return await dht.fix_layout("/", weights or None)
                 return await dht.rebalance("/")
             finally:
                 await client.unmount()
