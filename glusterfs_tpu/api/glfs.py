@@ -8,6 +8,14 @@ syncop.c:263, becomes an event loop on a worker thread).
 
 Path resolution walks components through ``lookup`` with an inode/dentry
 cache (glfs-resolve.c analog).
+
+The handle-based surface (``h_*``, reference api/src/glfs-handles.h:
+glfs_h_lookupat/extract_handle/create_from_handle/open/...) is what
+NFS-Ganesha-class consumers build on: a :class:`Handle` is a portable
+16-byte gfid — extract it on one client, reconstruct it on another, and
+address the object without any path.  Handle ops resolve gfid -> current
+volume path through the bricks' gfid2path records, so they keep working
+across renames.  See docs/gfapi_coverage.md for the symbol map.
 """
 
 from __future__ import annotations
@@ -76,6 +84,26 @@ async def wait_connected(graph: Graph, timeout: float = 15.0) -> bool:
             return True
         await asyncio.sleep(0.05)
     return all(p.connected for p in prot)
+
+
+class Handle:
+    """Opaque portable file handle (glfs-handles.h glfs_object analog):
+    the 16-byte gfid.  Extract with :meth:`Client.h_extract`, rebuild
+    anywhere with :meth:`Client.h_create_from_handle`."""
+
+    __slots__ = ("gfid",)
+
+    def __init__(self, gfid: bytes):
+        self.gfid = bytes(gfid)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Handle) and self.gfid == other.gfid
+
+    def __hash__(self) -> int:
+        return hash(self.gfid)
+
+    def __repr__(self) -> str:
+        return f"Handle({self.gfid.hex()})"
 
 
 class File:
@@ -321,6 +349,142 @@ class Client:
         f = await self.open(path, os.O_RDONLY)
         try:
             return await f.read(ia.size, 0)
+        finally:
+            await f.close()
+
+    async def removexattr(self, path: str, name: str) -> None:
+        loc = await self.resolve(path)
+        await self.graph.top.removexattr(loc, name)
+
+    # -- handle-based API (glfs-handles.h: glfs_h_*) ----------------------
+
+    async def h_lookupat(self, path: str) -> "Handle":
+        """Path -> portable handle (glfs_h_lookupat + extract)."""
+        ia = await self.stat(path)
+        return Handle(ia.gfid)
+
+    @staticmethod
+    def h_extract(h: "Handle") -> bytes:
+        """Handle -> 16 opaque bytes (glfs_h_extract_handle); ship them
+        anywhere, rebuild with :meth:`h_create_from_handle`."""
+        return bytes(h.gfid)
+
+    async def h_create_from_handle(self, data: bytes) -> "Handle":
+        """Opaque bytes -> live handle (glfs_h_create_from_handle);
+        verifies the object still exists on this volume."""
+        if len(data) != 16:
+            raise FopError(errno.EINVAL, "handle must be 16 bytes")
+        h = Handle(bytes(data))
+        await self.h_stat(h)  # ESTALE/ENOENT if the object is gone
+        return h
+
+    async def _h_path(self, h: "Handle") -> str:
+        """Current volume path of a handle via the bricks' gfid2path
+        records (rename-safe: records track the object, not the name)."""
+        from ..storage.posix import XA_GFID2PATH
+
+        if bytes(h.gfid) == bytes(ROOT_GFID):
+            return "/"
+        out = await self.graph.top.getxattr(Loc("", gfid=h.gfid),
+                                            XA_GFID2PATH)
+        return out[XA_GFID2PATH].decode()
+
+    async def h_stat(self, h: "Handle") -> Iatt:
+        return await self.stat(await self._h_path(h))
+
+    async def h_open(self, h: "Handle", flags: int = os.O_RDWR) -> File:
+        return await self.open(await self._h_path(h), flags)
+
+    async def h_opendir(self, h: "Handle") -> list[str]:
+        return await self.listdir(await self._h_path(h))
+
+    async def h_creat(self, parent: "Handle", name: str,
+                      flags: int = os.O_RDWR,
+                      mode: int = 0o644) -> tuple["Handle", File]:
+        base = await self._h_path(parent)
+        f = await self.create(f"{base.rstrip('/')}/{name}", flags, mode)
+        ia = await f.fstat()
+        return Handle(ia.gfid), f
+
+    async def h_mkdir(self, parent: "Handle", name: str,
+                      mode: int = 0o755) -> "Handle":
+        base = await self._h_path(parent)
+        ia = await self.mkdir(f"{base.rstrip('/')}/{name}", mode)
+        return Handle(ia.gfid)
+
+    async def h_unlink(self, parent: "Handle", name: str) -> None:
+        base = await self._h_path(parent)
+        await self.unlink(f"{base.rstrip('/')}/{name}")
+
+    async def h_truncate(self, h: "Handle", size: int) -> Iatt:
+        return await self.truncate(await self._h_path(h), size)
+
+    async def h_setattrs(self, h: "Handle", attrs: dict) -> Iatt:
+        return await self.setattr(await self._h_path(h), attrs)
+
+    async def h_getxattrs(self, h: "Handle", name: str | None = None):
+        return await self.getxattr(await self._h_path(h), name)
+
+    async def h_setxattrs(self, h: "Handle", xattrs: dict) -> None:
+        await self.setxattr(await self._h_path(h), xattrs)
+
+    async def h_rename(self, src_parent: "Handle", oldname: str,
+                       dst_parent: "Handle", newname: str) -> None:
+        src = await self._h_path(src_parent)
+        dst = await self._h_path(dst_parent)
+        await self.rename(f"{src.rstrip('/')}/{oldname}",
+                          f"{dst.rstrip('/')}/{newname}")
+
+    async def h_link(self, h: "Handle", dst_parent: "Handle",
+                     name: str) -> Iatt:
+        base = await self._h_path(dst_parent)
+        return await self.link(await self._h_path(h),
+                               f"{base.rstrip('/')}/{name}")
+
+    async def h_readlink(self, h: "Handle") -> str:
+        return await self.readlink(await self._h_path(h))
+
+    async def h_symlink(self, parent: "Handle", name: str,
+                        target: str) -> "Handle":
+        base = await self._h_path(parent)
+        ia = await self.symlink(target, f"{base.rstrip('/')}/{name}")
+        return Handle(ia.gfid)
+
+    def h_root(self) -> "Handle":
+        return Handle(bytes(ROOT_GFID))
+
+    async def h_getattrs(self, h: "Handle") -> Iatt:
+        return await self.h_stat(h)  # glfs_h_getattrs == stat shape
+
+    async def h_removexattrs(self, h: "Handle", name: str) -> None:
+        await self.removexattr(await self._h_path(h), name)
+
+    async def h_statfs(self, h: "Handle") -> dict:
+        return await self.statvfs(await self._h_path(h))
+
+    async def h_mknod(self, parent: "Handle", name: str,
+                      mode: int = 0o644) -> "Handle":
+        base = await self._h_path(parent)
+        path = f"{base.rstrip('/')}/{name}"
+        loc = await self._parent_loc(path)
+        ia = await self.graph.top.mknod(loc, mode, 0)
+        self.itable.link(loc.parent, loc.name, ia.gfid, ia.ia_type, ia)
+        return Handle(ia.gfid)
+
+    async def h_anonymous_read(self, h: "Handle", size: int,
+                               offset: int = 0) -> bytes:
+        """One-shot read by handle, no fd held (glfs_h_anonymous_read)."""
+        f = await self.h_open(h, os.O_RDONLY)
+        try:
+            return await f.read(size, offset)
+        finally:
+            await f.close()
+
+    async def h_anonymous_write(self, h: "Handle", data: bytes,
+                                offset: int = 0) -> int:
+        f = await self.h_open(h, os.O_RDWR)
+        try:
+            return await f.write(data, offset)
         finally:
             await f.close()
 
