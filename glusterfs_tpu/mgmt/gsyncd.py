@@ -421,16 +421,29 @@ async def _amain(args) -> None:
     from .glusterd import mount_volume
 
     ph, pp, pv = _parse_endpoint(args.primary)
-    sh, sp, sv = _parse_endpoint(args.secondary)
     primary = secondary = None
+    broker = args.transport == "broker"
     while primary is None or secondary is None:
         try:
             if primary is None:
                 primary = await mount_volume(ph, pp, pv)
             if secondary is None:
-                secondary = await mount_volume(sh, sp, sv)
+                if broker:
+                    # the "geo" in geo-rep: the secondary site is only
+                    # reachable through a spawned agent (repce/ssh
+                    # analog) — THIS process holds no secondary client
+                    from .repce import RepceClient
+
+                    secondary = RepceClient(args.secondary)
+                    await secondary._call("__ping__")  # spawn + mount
+                else:
+                    sh, sp, sv = _parse_endpoint(args.secondary)
+                    secondary = await mount_volume(sh, sp, sv)
         except Exception as e:
             log.warning(3, "gsyncd mount retry: %r", e)
+            if broker and secondary is not None:
+                await secondary.close()
+                secondary = None
             await asyncio.sleep(1.0)
     worker = GeoRepWorker(primary, secondary, args.changelogs.split(","),
                           args.state, args.interval)
@@ -446,7 +459,12 @@ async def _amain(args) -> None:
     await stop.wait()
     await worker.stop()
     await primary.unmount()
-    await secondary.unmount()
+    try:
+        await secondary.unmount()  # broker: proxied into the agent
+    except Exception:
+        pass
+    if broker:
+        await secondary.close()
 
 
 def main(argv=None) -> int:
@@ -458,6 +476,11 @@ def main(argv=None) -> int:
     p.add_argument("--state", required=True)
     p.add_argument("--interval", type=float, default=5.0)
     p.add_argument("--statusfile", default="")
+    p.add_argument("--transport", choices=("broker", "direct"),
+                   default="broker",
+                   help="broker (default): reach the secondary only "
+                        "through a spawned agent process (repce/ssh "
+                        "analog); direct: mount it in-process")
     args = p.parse_args(argv)
     asyncio.run(_amain(args))
     return 0

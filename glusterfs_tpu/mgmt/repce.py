@@ -1,0 +1,264 @@
+"""RPC-over-subprocess-stdio channel for geo-replication (repce analog).
+
+Reference: geo-replication/syncdaemon/repce.py:35-223 — the primary-side
+gsyncd never talks to the secondary site directly; it spawns an agent
+(there: over ssh to the remote site) and drives it with a pickled RPC
+protocol on the agent's stdin/stdout, while resource.py moves data
+through the same channel.
+
+Same contract here, tpu-build mechanisms: the agent is a subprocess
+whose ONLY link to the worker is its stdio pipes, carrying the
+repository's tagged binary wire frames (rpc/wire.py — no pickle).  The
+agent mounts the secondary volume in ITS process; the worker process
+holds no secondary client at all, which is what makes the link a true
+site boundary — swap the local spawn for an ssh spawn and nothing else
+changes.
+
+* :class:`RepceClient` — worker side: spawns/respawns the agent,
+  correlates xids, exposes the secondary as an async proxy with the
+  same method surface a mounted Client has (plus File proxies).
+* ``agent`` / ``python -m glusterfs_tpu.mgmt.repce`` — the agent:
+  serves ``[method, args, kwargs]`` calls against its mounted client;
+  fds are held agent-side in a handle table (fd -> File), the worker
+  sees integer handles only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import errno
+import itertools
+import os
+import sys
+
+from ..core.fops import FopError
+from ..core import gflog
+from ..rpc import wire
+
+log = gflog.get_logger("repce")
+
+_FD_METHODS = ("fwrite", "fread", "fclose")
+
+
+# ---------------------------------------------------------------------------
+# agent side (subprocess; stdio only)
+# ---------------------------------------------------------------------------
+
+
+class _AgentServer:
+    def __init__(self, client):
+        self.client = client
+        self.files: dict[int, object] = {}
+        self._ids = itertools.count(1)
+
+    async def handle(self, method: str, args: list, kwargs: dict):
+        if method == "__ping__":
+            return "pong"
+        if method in ("open", "create"):
+            f = await getattr(self.client, method)(*args, **kwargs)
+            fdid = next(self._ids)
+            self.files[fdid] = f
+            return {"fd": fdid}
+        if method in _FD_METHODS:
+            fdid = args[0]
+            f = self.files.get(fdid)
+            if f is None:
+                raise FopError(errno.EBADF, f"agent fd {fdid}")
+            if method == "fwrite":
+                return await f.write(args[1], args[2])
+            if method == "fread":
+                return await f.read(args[1], args[2])
+            self.files.pop(fdid, None)
+            await f.close()
+            return None
+        fn = getattr(self.client, method, None)
+        if fn is None or method.startswith("_"):
+            raise FopError(errno.ENOSYS, f"agent method {method!r}")
+        ret = await fn(*args, **kwargs)
+        # returns stay worker-opaque (the worker only checks errors);
+        # shipping Iatt objects across the pipe buys nothing
+        return ret if isinstance(ret, (str, bytes, int, list)) else None
+
+    async def serve(self) -> None:
+        loop = asyncio.get_running_loop()
+        reader = asyncio.StreamReader()
+        await loop.connect_read_pipe(
+            lambda: asyncio.StreamReaderProtocol(reader), sys.stdin)
+        out_fd = sys.stdout.fileno()
+        while True:
+            try:
+                rec = await wire.read_frame(reader)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return  # worker went away: exit with it
+            xid, _mtype, payload = wire.unpack(rec)
+            try:
+                method, args, kwargs = payload
+                ret = await self.handle(method, list(args), kwargs or {})
+                frame = wire.pack(xid, wire.MT_REPLY, ret)
+            except FopError as e:
+                frame = wire.pack(xid, wire.MT_ERROR, e)
+            except Exception as e:  # noqa: BLE001 - agent must answer
+                frame = wire.pack(xid, wire.MT_ERROR,
+                                  FopError(errno.EIO, repr(e)))
+            os.write(out_fd, frame)
+
+
+async def _agent_amain(args) -> None:
+    from .glusterd import mount_volume
+
+    host, port, vol = args.secondary.rsplit(":", 2)
+    client = None
+    while client is None:
+        try:
+            client = await mount_volume(host, int(port), vol)
+        except Exception as e:
+            log.warning(1, "agent mount retry: %r", e)
+            await asyncio.sleep(1.0)
+    try:
+        await _AgentServer(client).serve()
+    finally:
+        try:
+            await client.unmount()
+        except Exception:
+            pass
+
+
+def agent_main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="gftpu-georep-agent")
+    p.add_argument("--secondary", required=True, help="host:port:volume")
+    args = p.parse_args(argv)
+    asyncio.run(_agent_amain(args))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+
+
+class _RemoteFile:
+    """File proxy: integer handle on the agent, File surface here."""
+
+    def __init__(self, broker: "RepceClient", fdid: int):
+        self._b = broker
+        self._fd = fdid
+
+    async def write(self, data: bytes, offset: int = 0) -> int:
+        return await self._b._call("fwrite", self._fd, data, offset)
+
+    async def read(self, size: int, offset: int = 0) -> bytes:
+        return await self._b._call("fread", self._fd, size, offset)
+
+    async def close(self) -> None:
+        await self._b._call("fclose", self._fd)
+
+
+class RepceClient:
+    """The secondary volume as seen through the broker: every call goes
+    over the agent's stdio; this process never opens a connection to the
+    secondary site."""
+
+    def __init__(self, secondary: str, spawn_env: dict | None = None):
+        self.secondary = secondary
+        self._env = spawn_env
+        self._proc: asyncio.subprocess.Process | None = None
+        self._xid = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._reader_task: asyncio.Task | None = None
+
+    async def _ensure(self) -> None:
+        if self._proc is not None and self._proc.returncode is None:
+            return
+        env = dict(self._env or os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        self._proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "glusterfs_tpu.mgmt.repce",
+            "--secondary", self.secondary,
+            stdin=asyncio.subprocess.PIPE, stdout=asyncio.subprocess.PIPE,
+            env=env)
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        self._reader_task = asyncio.create_task(
+            self._read_loop(self._proc.stdout))
+        log.info(2, "georep agent spawned (pid %d) for %s",
+                 self._proc.pid, self.secondary)
+
+    async def _read_loop(self, reader) -> None:
+        try:
+            while True:
+                rec = await wire.read_frame(reader)
+                xid, mtype, payload = wire.unpack(rec)
+                fut = self._pending.pop(xid, None)
+                if fut is None or fut.done():
+                    continue
+                if mtype == wire.MT_ERROR:
+                    fut.set_exception(
+                        payload if isinstance(payload, FopError)
+                        else FopError(errno.EIO, str(payload)))
+                else:
+                    fut.set_result(payload)
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(
+                        FopError(errno.ENOTCONN, "georep agent died"))
+            self._pending.clear()
+
+    async def _call(self, method: str, *args, **kwargs):
+        await self._ensure()
+        xid = next(self._xid)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[xid] = fut
+        try:
+            self._proc.stdin.write(wire.pack(
+                xid, wire.MT_CALL, [method, list(args), kwargs or {}]))
+            await self._proc.stdin.drain()
+        except (ConnectionError, RuntimeError, BrokenPipeError):
+            self._pending.pop(xid, None)
+            raise FopError(errno.ENOTCONN, "georep agent pipe") from None
+        return await fut
+
+    async def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            self._reader_task = None
+        if self._proc is not None and self._proc.returncode is None:
+            self._proc.terminate()
+            try:
+                await asyncio.wait_for(self._proc.wait(), 5)
+            except asyncio.TimeoutError:
+                self._proc.kill()
+        self._proc = None
+
+    # -- the Client surface the worker drives ------------------------------
+
+    async def open(self, path, flags=os.O_RDWR):
+        out = await self._call("open", path, flags)
+        return _RemoteFile(self, out["fd"])
+
+    async def create(self, path, flags=os.O_RDWR, mode=0o644):
+        out = await self._call("create", path, flags, mode)
+        return _RemoteFile(self, out["fd"])
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        async def proxied(*args, **kwargs):
+            return await self._call(name, *args, **kwargs)
+
+        proxied.__name__ = name
+        return proxied
+
+
+def main(argv=None) -> int:
+    return agent_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
