@@ -102,9 +102,10 @@ class DisperseLayer(Layer):
         Option("redundancy", "int", default=2, min=1, max=8),
         Option("cpu-extensions", "enum", default="auto",
                values=("auto", "ref", "native", "xla", "xla-xor",
-                       "pallas-xor", "pallas-mxu"),
+                       "pallas-xor", "pallas-mxu", "mesh"),
                description="codec backend (reference disperse.cpu-extensions"
-                           " {none,auto,x64,sse,avx} -> TPU ladder)"),
+                           " {none,auto,x64,sse,avx} -> TPU ladder; mesh ="
+                           " multi-chip sharded data plane)"),
         Option("read-policy", "enum", default="round-robin",
                values=("round-robin", "gfid-hash", "first-k")),
         Option("quorum-count", "int", default=0, min=0,

@@ -46,7 +46,7 @@ import numpy as np
 from . import gf256
 from .codec import Codec
 
-_DEVICE_BACKENDS = ("pallas-xor", "pallas-mxu", "xla", "xla-xor")
+_DEVICE_BACKENDS = ("pallas-xor", "pallas-mxu", "xla", "xla-xor", "mesh")
 
 # Shape buckets: power-of-two stripe counts with this floor.  Bounded
 # distinct shapes -> bounded jit compiles per (k, n) / (k, mask).

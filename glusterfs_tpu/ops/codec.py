@@ -14,7 +14,13 @@ backend        implementation
 ``xla-xor``    VPU XOR chains via jitted XLA
 ``pallas-xor`` Pallas TPU kernel, static XOR chains in VMEM
 ``pallas-mxu`` Pallas TPU kernel, in-VMEM unpack + MXU matmul
-``auto``       pallas-xor on TPU, else native, else xla
+``mesh``       multi-chip: stripes sharded over the device mesh's
+               ``dp`` axis, fragments over ``frag`` (parallel/
+               mesh_codec shard_map plane); decodes past a memory
+               threshold ride the ring-pipelined ppermute reduce
+``auto``       mesh on a multi-chip TPU host; pallas-xor on one
+               chip (wide-k encode auto-routes to the MXU form);
+               else native, else xla
 =============  =================================================
 
 All backends are byte-exact against ``ref`` (the ``ec-cpu-extensions.t``
@@ -31,7 +37,13 @@ import numpy as np
 
 from . import gf256
 
-BACKENDS = ("ref", "native", "xla", "xla-xor", "pallas-xor", "pallas-mxu")
+BACKENDS = ("ref", "native", "xla", "xla-xor", "pallas-xor", "pallas-mxu",
+            "mesh")
+
+# mesh decodes larger than this ride the ring-pipelined ppermute path
+# (streaming reduce over the frag axis instead of one all-gather whose
+# gathered operand must fit each device)
+MESH_RING_DECODE_BYTES = 64 << 20
 
 
 @functools.cache
@@ -49,8 +61,9 @@ def detect(requested: str = "auto") -> str:
     """Resolve a requested backend name to an available one.
 
     Mirrors ec_code_detect's fall-forward: an unavailable explicit request
-    raises (the reference logs + falls back; we prefer loud), ``auto`` walks
-    the ladder pallas-xor -> native -> xla.
+    raises (the reference logs + falls back; we prefer loud), ``auto``
+    walks the ladder mesh (multi-chip) -> pallas-xor (one chip) ->
+    native -> xla.
     """
     if requested != "auto":
         if requested not in BACKENDS:
@@ -62,7 +75,14 @@ def detect(requested: str = "auto") -> str:
                 raise RuntimeError("native backend unavailable (no toolchain?)")
         return requested
     if _tpu_present():
-        return "pallas-xor"
+        import jax
+
+        accels = [d for d in jax.devices()
+                  if d.platform in ("tpu", "axon")]
+        # multi-chip host: the mesh data plane (stripes over dp,
+        # fragments over frag) IS the scale-out path; one chip keeps
+        # the single-device pallas kernels
+        return "mesh" if len(accels) > 1 else "pallas-xor"
     from glusterfs_tpu import native
 
     return "native" if native.available() else "xla"
@@ -97,6 +117,9 @@ class Codec:
             raise ValueError("k + r must be <= 255")
         self.fragment_chunk = gf256.CHUNK_SIZE
         self.stripe_size = k * gf256.CHUNK_SIZE
+        # auto-resolved backends may re-route per geometry (wide-k
+        # encode rides the MXU); an EXPLICIT backend is honored as-is
+        self._auto = backend == "auto"
         self.backend = detect(backend)
 
     # -- encode ------------------------------------------------------------
@@ -110,6 +133,10 @@ class Codec:
         b = self.backend
         if b == "ref":
             return gf256.ref_encode(data, self.k, self.n)
+        if b == "mesh":
+            from glusterfs_tpu.parallel import mesh_codec
+
+            return mesh_codec.sharded_encode(self.k, self.r, data)
         if b == "native":
             from glusterfs_tpu import native
 
@@ -126,6 +153,12 @@ class Codec:
         from . import gf256_pallas
 
         form = "fused" if b == "pallas-xor" else "mxu"
+        if form == "fused" and self._auto and \
+                self.k >= gf256_pallas._ENC_MXU_MIN_K:
+            # auto routing only: wide-k encode is compute-bound on the
+            # VPU XOR form; the MXU matmul wins even with its transpose
+            # sandwich (gf256_pallas._ENC_MXU_MIN_K rationale)
+            form = "mxu"
         return gf256_pallas.encode(data, self.k, self.n, form)
 
     # -- decode ------------------------------------------------------------
@@ -141,6 +174,12 @@ class Codec:
         b = self.backend
         if b == "ref":
             return gf256.ref_decode(frags, rows, self.k)
+        if b == "mesh":
+            from glusterfs_tpu.parallel import mesh_codec, ring_codec
+
+            if frags.size > MESH_RING_DECODE_BYTES:
+                return ring_codec.ring_decode(self.k, tuple(rows), frags)
+            return mesh_codec.sharded_decode(self.k, tuple(rows), frags)
         if b == "native":
             from glusterfs_tpu import native
 

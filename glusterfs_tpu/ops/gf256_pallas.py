@@ -456,6 +456,17 @@ def _decode_fn(k: int, formulation: str, interpret: bool,
     return jax.jit(run)
 
 
+# Wide-k encode is better served by the MXU than by unrolled XOR
+# chains: at k=16 the XOR form is compute-bound (~160 output bit-planes
+# x ~64 terms each on the VPU, split over 4 pallas calls that each
+# re-read the input because the unroll exceeds the compiler's
+# appetite), while the (n*8, k*8) binary matmul is nearly free on the
+# MXU even paying the transpose sandwich — measured 38 vs 28 GiB/s for
+# 16+4 on v5e.  The ROUTING decision lives in ops/codec.py's auto
+# path; an explicit formulation request here is honored as written.
+_ENC_MXU_MIN_K = 16
+
+
 def encode(data, k: int, n: int, formulation: str = "fused",
            interpret: bool = False) -> np.ndarray:
     data = np.ascontiguousarray(data, dtype=np.uint8).ravel()

@@ -107,6 +107,45 @@ def run_step(k: int, r: int, batch: np.ndarray, mesh: Mesh | None = None):
     return frags, int(mism)
 
 
+@functools.lru_cache(maxsize=32)
+def _encode_fn(k: int, n: int, mesh: Mesh):
+    """Jitted encode, stripes sharded over ``dp``, fragments laid out
+    over ``frag`` — the encode IS the scatter-to-bricks step."""
+    abits = jnp.asarray(gf256.expand_bitmatrix(gf256.encode_matrix(k, n)))
+    in_s = NamedSharding(mesh, P("dp", None, None))
+    out_s = NamedSharding(mesh, P("frag", "dp", None))
+    return jax.jit(
+        lambda x: jnp.transpose(_apply(abits, x), (1, 0, 2)),
+        in_shardings=in_s, out_shardings=out_s)
+
+
+def sharded_encode(k: int, r: int, data: np.ndarray,
+                   mesh: Mesh | None = None) -> np.ndarray:
+    """Encode stripe-aligned bytes into wire-layout fragments
+    ``(n, S*512)`` with stripes sharded over the mesh's ``dp`` axis and
+    the fragment dimension over ``frag`` (the served-volume entry point
+    the BatchingCodec's ``mesh`` backend feeds)."""
+    if mesh is None:
+        mesh = make_mesh()
+    n = k + r
+    data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
+    s = data.size // (k * gf256.CHUNK_SIZE)
+    x = data.reshape(s, k * 8, gf256.WORD_SIZE)
+    dp = mesh.devices.shape[0]
+    pad = (-s) % dp
+    if pad:
+        x = np.concatenate(
+            [x, np.zeros((pad, *x.shape[1:]), dtype=np.uint8)], axis=0)
+    y = np.asarray(_encode_fn(k, n, mesh)(jnp.asarray(x)))  # (n*8, S', 64)
+    y = y[:, :s, :]
+    # plane-major -> wire fragment-major (n, S*512): fragment f's chunk
+    # for stripe s' interleaves its 8 planes (same transform as the
+    # single-chip sandwich, gf256_pallas._encode_fn)
+    return (y.reshape(n, 8, s, gf256.WORD_SIZE)
+             .transpose(0, 2, 1, 3)
+             .reshape(n, s * gf256.CHUNK_SIZE))
+
+
 @functools.lru_cache(maxsize=256)
 def _decode_fn(k: int, rows: tuple[int, ...], mesh: Mesh):
     """Jitted degraded decode for one surviving mask, stripes sharded

