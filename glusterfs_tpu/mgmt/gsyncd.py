@@ -420,7 +420,10 @@ def _parse_endpoint(spec: str) -> tuple[str, int, str]:
 async def _amain(args) -> None:
     from .glusterd import mount_volume
 
+    # fail FAST on malformed endpoints: a retry loop cannot fix a typo,
+    # and in broker mode it would respawn a doomed agent forever
     ph, pp, pv = _parse_endpoint(args.primary)
+    _parse_endpoint(args.secondary)
     primary = secondary = None
     broker = args.transport == "broker"
     while primary is None or secondary is None:
@@ -459,10 +462,15 @@ async def _amain(args) -> None:
     await stop.wait()
     await worker.stop()
     await primary.unmount()
-    try:
-        await secondary.unmount()  # broker: proxied into the agent
-    except Exception:
-        pass
+    # broker: only proxy the unmount into an agent that is still alive
+    # (unmounting through a respawned agent would mount the secondary
+    # just to unmount it — or hang shutdown when the site is down), and
+    # bound it so a wedged agent can't stop gsyncd from exiting
+    if not broker or secondary.alive:
+        try:
+            await asyncio.wait_for(secondary.unmount(), 15)
+        except Exception:
+            pass
     if broker:
         await secondary.close()
 

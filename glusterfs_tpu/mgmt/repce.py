@@ -167,9 +167,23 @@ class RepceClient:
         self._pending: dict[int, asyncio.Future] = {}
         self._reader_task: asyncio.Task | None = None
 
+    @property
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.returncode is None
+
     async def _ensure(self) -> None:
-        if self._proc is not None and self._proc.returncode is None:
+        if self.alive:
             return
+        # retire the dead channel FIRST: the old reader's unwind clears
+        # self._pending, and it must never clobber futures registered
+        # against the fresh agent (respawn race)
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._reader_task = None
         env = dict(self._env or os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
@@ -178,8 +192,6 @@ class RepceClient:
             "--secondary", self.secondary,
             stdin=asyncio.subprocess.PIPE, stdout=asyncio.subprocess.PIPE,
             env=env)
-        if self._reader_task is not None:
-            self._reader_task.cancel()
         self._reader_task = asyncio.create_task(
             self._read_loop(self._proc.stdout))
         log.info(2, "georep agent spawned (pid %d) for %s",
