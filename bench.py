@@ -499,9 +499,9 @@ def main() -> None:
                 "encode_vs_avx_model": round(
                     sweep_bytes / MIB / et /
                     (model_avx_bytes_per_s(sn, sk) / MIB), 2),
-                "encode_form": ("mxu" if on_tpu
-                                and sk >= gf256_pallas._ENC_MXU_MIN_K
-                                else "xor"),
+                "encode_form": (
+                    ("mxu" if sk >= gf256_pallas._ENC_MXU_MIN_K
+                     else "xor") if on_tpu else "matmul"),
             }
         if on_tpu:
             # pallas-mxu validated ON SILICON at the headline config:

@@ -126,16 +126,22 @@ async def _run(args) -> Any:
 
     if args.cmd == "snapshot":
         # snapshot create NAME VOLUME | list [VOLUME] |
+        #          clone CLONENAME SNAPNAME |
         #          delete|restore|activate|deactivate NAME
-        need = {"create": 2, "list": 0}.get(args.sub, 1)
+        need = {"create": 2, "clone": 2, "list": 0}.get(args.sub, 1)
         if len(args.args) < need:
             raise SystemExit(
                 "usage: snapshot create NAME VOLUME | list [VOLUME] | "
+                "clone CLONENAME SNAPNAME | "
                 "delete|restore|activate|deactivate NAME")
         async with MgmtClient(host, port) as c:
             if args.sub == "create":
                 return await c.call("snapshot-create", name=args.args[0],
                                     volume=args.args[1])
+            if args.sub == "clone":
+                return await c.call("snapshot-clone",
+                                    clonename=args.args[0],
+                                    snapname=args.args[1])
             if args.sub == "list":
                 return await c.call(
                     "snapshot-list",
@@ -426,8 +432,8 @@ def main(argv=None) -> int:
     geo.add_argument("args", nargs="*")
 
     snap = sp.add_parser("snapshot")
-    snap.add_argument("sub", choices=["create", "list", "delete",
-                                      "restore", "activate",
+    snap.add_argument("sub", choices=["create", "clone", "list",
+                                      "delete", "restore", "activate",
                                       "deactivate"])
     snap.add_argument("args", nargs="*")
 

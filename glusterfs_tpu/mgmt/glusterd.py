@@ -51,6 +51,21 @@ log = gflog.get_logger("mgmt")
 OP_VERSION = 2
 
 
+def _copy_store(src: str, dst: str) -> None:
+    """Replace a brick store with a copy of another (snapshot restore
+    and clone both land here): a file-level copy changes every inode,
+    so the gfid identity store and handle farm are rebound onto the
+    copied files afterwards."""
+    import shutil
+
+    from ..storage.posix import rebuild_identity
+
+    shutil.rmtree(dst, ignore_errors=True)
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    shutil.copytree(src, dst, symlinks=True)
+    rebuild_identity(dst)
+
+
 class MgmtError(Exception):
     pass
 
@@ -1624,10 +1639,99 @@ class Glusterd:
             raise
         self.state.setdefault("snaps", {})[name] = {
             "volume": volume, "ts": time.time(), "bricks": taken,
+            # the volume's SHAPE at snap time: restore/clone must pair
+            # snapped stores with the geometry they were taken under,
+            # not whatever the volume grew into afterwards
+            "src_volinfo": json.loads(json.dumps(vol)),
         }
         self._save()
         gf_event("SNAPSHOT_CREATED", snapshot=name, volume=volume)
         return {"snapped": sorted(taken)}
+
+    # -- snapshot clone (glusterd-snapshot.c clone: a snapshot becomes a
+    # NEW independent writable volume) -------------------------------------
+
+    async def op_snapshot_clone(self, clonename: str,
+                                snapname: str) -> dict:
+        snap = self.state.get("snaps", {}).get(snapname)
+        if snap is None:
+            raise MgmtError(f"no snapshot {snapname!r}")
+        if clonename in self.state["volumes"]:
+            raise MgmtError(f"volume {clonename} exists")
+        if clonename.startswith("snap-"):
+            raise MgmtError("volume names starting with 'snap-' are "
+                            "reserved for activated snapshots")
+        base = snap.get("src_volinfo") or self._vol(snap["volume"])
+        nodes = {n["uuid"]: n for n in self._all_nodes()}
+        bricks, sources = [], {}
+        for i, b in enumerate(base["bricks"]):
+            node = nodes.get(b["node"])
+            if node is None:
+                raise MgmtError(f"brick node {b['node'][:8]} unknown")
+            bname = f"{clonename}-brick-{i}"
+            bricks.append({
+                "index": i, "node": b["node"], "host": b["host"],
+                "path": os.path.join(node["workdir"], "clones",
+                                     clonename, bname),
+                "name": bname,
+            })
+            sources[bname] = b["name"]
+        volinfo = {
+            "name": clonename, "type": base["type"],
+            "redundancy": base.get("redundancy", 0),
+            "bricks": bricks, "status": "created",
+            "version": int(self.state.get("tombstones", {})
+                           .get(clonename, 0)) + 1,
+            "options": dict(base.get("options", {})),
+            "id": str(uuid.uuid4()),
+            "auth": {"username": str(uuid.uuid4()),
+                     "password": str(uuid.uuid4()),
+                     "mgmt-username": str(uuid.uuid4()),
+                     "mgmt-password": str(uuid.uuid4())},
+        }
+        for key in ("group-size", "arbiter", "thin-arbiter"):
+            if key in base:
+                volinfo[key] = base[key]
+        await self._cluster_txn("snapshot-clone", {
+            "snapname": snapname, "volinfo": volinfo,
+            "sources": sources})
+        return {"ok": True, "volume": clonename}
+
+    def stage_snapshot_clone(self, snapname: str, volinfo: dict,
+                             sources: dict) -> None:
+        """Per-node validation BEFORE any store copies: a commit-phase
+        failure on one node would leave a half-created clone that
+        reconciliation then spreads cluster-wide with an empty brick."""
+        if volinfo["name"] in self.state["volumes"]:
+            raise MgmtError(f"volume {volinfo['name']} exists here")
+        snap = self.state.get("snaps", {}).get(snapname) or {}
+        for b in volinfo["bricks"]:
+            if b["node"] != self.uuid:
+                continue
+            src = snap.get("bricks", {}).get(sources.get(b["name"], ""))
+            if not src or not os.path.isdir(src):
+                raise MgmtError(
+                    f"no snapped store for {b['name']} on this node")
+
+    async def commit_snapshot_clone(self, snapname: str, volinfo: dict,
+                                    sources: dict) -> dict:
+        snap = self.state.get("snaps", {}).get(snapname) or {}
+        cloned = []
+        for b in volinfo["bricks"]:
+            if b["node"] != self.uuid:
+                continue
+            src = snap.get("bricks", {}).get(sources.get(b["name"], ""))
+            if not src:
+                raise MgmtError(
+                    f"no snapped store for {b['name']} on this node")
+            await asyncio.to_thread(_copy_store, src, b["path"])
+            cloned.append(b["name"])
+        self.state["volumes"][volinfo["name"]] = volinfo
+        self.state.get("tombstones", {}).pop(volinfo["name"], None)
+        self._save()
+        gf_event("SNAPSHOT_CLONED", snapshot=snapname,
+                 volume=volinfo["name"])
+        return {"cloned": cloned}
 
     async def _set_barrier(self, vol: dict, on: bool,
                            strict: bool = True) -> None:
@@ -1815,28 +1919,31 @@ class Glusterd:
         return {"ok": True, "restored": snap["volume"]}
 
     async def commit_snapshot_restore(self, name: str) -> dict:
-        import shutil
-
-        from ..storage.posix import rebuild_identity
-
         snap = self.state.get("snaps", {}).get(name)
         if snap is None:
             return {"restored": []}
         vol = self._vol(snap["volume"])
+        # restore rolls the volume's SHAPE back to snap time too (the
+        # reference swaps in the snapshot's volinfo wholesale): a volume
+        # grown after the snapshot must not end up with bricks from two
+        # epochs — snap-time content on the old bricks, post-snap
+        # content on the new ones — serving inconsistent stripes
+        src_vi = snap.get("src_volinfo")
+        if src_vi is not None:
+            for key in ("type", "bricks", "redundancy", "group-size",
+                        "arbiter", "thin-arbiter"):
+                if key in src_vi:
+                    vol[key] = json.loads(json.dumps(src_vi[key]))
+                else:
+                    vol.pop(key, None)
+            self._bump(vol)
+            self._save()
         restored = []
-
-        def _restore_one(src: str, dst: str) -> None:
-            shutil.rmtree(dst, ignore_errors=True)
-            shutil.copytree(src, dst, symlinks=True)
-            # a file-level copy changes every inode: rebind the gfid
-            # identity store and handle farm onto the copied files
-            rebuild_identity(dst)
-
         for b in vol["bricks"]:
             src = snap["bricks"].get(b["name"])
             if b["node"] != self.uuid or not src:
                 continue
-            await asyncio.to_thread(_restore_one, src, b["path"])
+            await asyncio.to_thread(_copy_store, src, b["path"])
             restored.append(b["name"])
         return {"restored": restored}
 

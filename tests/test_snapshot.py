@@ -191,3 +191,95 @@ def test_e2e_snapshot_create_restore(tmp_path):
             await d.stop()
 
     asyncio.run(run())
+
+
+def test_e2e_snapshot_clone(tmp_path):
+    """snapshot clone -> a NEW independent writable volume carrying the
+    snapshot-time content (glusterd-snapshot.c clone): the original and
+    the clone diverge freely after the clone."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient, mount_volume
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="cv", vtype="disperse",
+                             bricks=[{"path": str(tmp_path / f"b{i}")}
+                                     for i in range(3)], redundancy=1)
+                await c.call("volume-start", name="cv")
+            client = await mount_volume(d.host, d.port, "cv")
+            await client.write_file("/base", b"at snap time")
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("snapshot-create", name="s1", volume="cv")
+            await client.write_file("/after", b"post-snap divergence")
+            await client.unmount()
+
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("snapshot-clone", clonename="cvclone",
+                             snapname="s1")
+                info = await c.call("volume-info")
+                assert "cvclone" in info
+                await c.call("volume-start", name="cvclone")
+            clone = await mount_volume(d.host, d.port, "cvclone")
+            assert await clone.read_file("/base") == b"at snap time"
+            assert not await clone.exists("/after")
+            # the clone is writable and independent
+            await clone.write_file("/clone-only", b"clone write")
+            await clone.unmount()
+            orig = await mount_volume(d.host, d.port, "cv")
+            assert not await orig.exists("/clone-only")
+            assert await orig.read_file("/after") == \
+                b"post-snap divergence"
+            await orig.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+
+
+def test_restore_rolls_back_grown_shape(tmp_path):
+    """Restoring a snapshot taken BEFORE an add-brick rolls the
+    volume's shape back too — never snap-time content on old bricks
+    mixed with post-snap content on new ones (two-epoch volume)."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient, mount_volume
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="gv",
+                             vtype="distribute",
+                             bricks=[{"path": str(tmp_path / f"g{i}")}
+                                     for i in range(2)], redundancy=0)
+                await c.call("volume-start", name="gv")
+            cl = await mount_volume(d.host, d.port, "gv")
+            for i in range(8):
+                await cl.write_file(f"/s{i}", b"epoch-1")
+            await cl.unmount()
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("snapshot-create", name="pre", volume="gv")
+                await c.call("volume-add-brick", name="gv",
+                             bricks=[{"path": str(tmp_path / "g2"),
+                                      "host": "127.0.0.1"}])
+            cl = await mount_volume(d.host, d.port, "gv")
+            for i in range(8):
+                await cl.write_file(f"/post{i}", b"epoch-2")
+            await cl.unmount()
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-stop", name="gv")
+                await c.call("snapshot-restore", name="pre")
+                info = await c.call("volume-info", name="gv")
+                assert len(info["gv"]["bricks"]) == 2, \
+                    "restore must roll the brick set back to snap time"
+                await c.call("volume-start", name="gv")
+            cl = await mount_volume(d.host, d.port, "gv")
+            for i in range(8):
+                assert await cl.read_file(f"/s{i}") == b"epoch-1"
+                assert not await cl.exists(f"/post{i}")
+            await cl.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
