@@ -134,6 +134,35 @@ class File:
     async def fgetxattr(self, name: str | None = None):
         return await self._client.graph.top.fgetxattr(self.fd, name)
 
+    async def fsetxattr(self, xattrs: dict, flags: int = 0) -> None:
+        await self._client.graph.top.fsetxattr(self.fd, xattrs, flags)
+
+    async def fremovexattr(self, name: str) -> None:
+        await self._client.graph.top.fremovexattr(self.fd, name)
+
+    async def copy_range(self, dst: "File", size: int,
+                         src_offset: int = 0, dst_offset: int = 0,
+                         window: int = 1 << 20) -> int:
+        """glfs_copy_file_range analog: windowed read+write composition
+        (no dedicated fop; the reference's also degrades to this when
+        the backend lacks the syscall)."""
+        if dst.fd.gfid == self.fd.gfid and \
+                src_offset < dst_offset + size and \
+                dst_offset < src_offset + size:
+            # copy_file_range(2): overlapping same-file ranges are
+            # EINVAL — windowed copying would re-read its own writes
+            raise FopError(errno.EINVAL,
+                           "overlapping copy_range on one file")
+        done = 0
+        while done < size:
+            chunk = await self.read(min(window, size - done),
+                                    src_offset + done)
+            if not chunk:
+                break
+            await dst.write(chunk, dst_offset + done)
+            done += len(chunk)
+        return done
+
     async def close(self) -> None:
         if not self.closed:
             self.closed = True

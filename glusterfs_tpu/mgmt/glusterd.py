@@ -51,6 +51,25 @@ log = gflog.get_logger("mgmt")
 OP_VERSION = 2
 
 
+def _new_volinfo(state: dict, name: str, vtype: str, bricks: list,
+                 redundancy: int) -> dict:
+    """Volinfo scaffolding shared by volume-create and snapshot-clone:
+    tombstone-seeded config generation, fresh id, and the per-volume
+    credential pairs (client pair in every volfile, mgmt pair only in
+    brick volfiles — glusterd_auth_set_username trusted-volfile model).
+    The two creation paths must mint identical shapes."""
+    return {
+        "name": name, "type": vtype, "bricks": bricks,
+        "redundancy": redundancy, "status": "created",
+        "version": int(state.get("tombstones", {}).get(name, 0)) + 1,
+        "options": {}, "id": str(uuid.uuid4()),
+        "auth": {"username": str(uuid.uuid4()),
+                 "password": str(uuid.uuid4()),
+                 "mgmt-username": str(uuid.uuid4()),
+                 "mgmt-password": str(uuid.uuid4())},
+    }
+
+
 def _copy_store(src: str, dst: str) -> None:
     """Replace a brick store with a copy of another (snapshot restore
     and clone both land here): a file-level copy changes every inode,
@@ -745,24 +764,8 @@ class Glusterd:
                 "path": b["path"],
                 "name": f"{name}-brick-{i}",
             })
-        volinfo = {
-            "name": name, "type": vtype, "bricks": parsed,
-            "redundancy": redundancy, "status": "created",
-            # config generation for friend-volinfo reconciliation; a
-            # re-create starts past any tombstone so peers that missed
-            # the delete+create don't resurrect the old shape
-            "version": int(self.state.get("tombstones", {})
-                           .get(name, 0)) + 1,
-            "options": {}, "id": str(uuid.uuid4()),
-            # per-volume transport credentials, written by volgen into
-            # both brick and client volfiles (glusterd_auth_set_username
-            # trusted-volfile model); the mgmt pair goes ONLY into brick
-            # volfiles so glusterd's own calls pass any auth.allow list
-            "auth": {"username": str(uuid.uuid4()),
-                     "password": str(uuid.uuid4()),
-                     "mgmt-username": str(uuid.uuid4()),
-                     "mgmt-password": str(uuid.uuid4())},
-        }
+        volinfo = _new_volinfo(self.state, name, vtype, parsed,
+                               redundancy)
         if group_size:
             volinfo["group-size"] = group_size
         if arbiter:
@@ -1676,19 +1679,9 @@ class Glusterd:
                 "name": bname,
             })
             sources[bname] = b["name"]
-        volinfo = {
-            "name": clonename, "type": base["type"],
-            "redundancy": base.get("redundancy", 0),
-            "bricks": bricks, "status": "created",
-            "version": int(self.state.get("tombstones", {})
-                           .get(clonename, 0)) + 1,
-            "options": dict(base.get("options", {})),
-            "id": str(uuid.uuid4()),
-            "auth": {"username": str(uuid.uuid4()),
-                     "password": str(uuid.uuid4()),
-                     "mgmt-username": str(uuid.uuid4()),
-                     "mgmt-password": str(uuid.uuid4())},
-        }
+        volinfo = _new_volinfo(self.state, clonename, base["type"],
+                               bricks, base.get("redundancy", 0))
+        volinfo["options"] = dict(base.get("options", {}))
         for key in ("group-size", "arbiter", "thin-arbiter"):
             if key in base:
                 volinfo[key] = base[key]

@@ -112,3 +112,47 @@ def test_stale_handle_rejected(tmp_path):
         await c.unmount()
 
     asyncio.run(run())
+
+
+def test_file_facade_fd_xattrs_and_copy_range(tmp_path):
+    """fd-addressed xattr ops and the copy_file_range composition on
+    the File facade (glfs_fsetxattr/fremovexattr/copy_file_range)."""
+
+    async def run():
+        c = Client(_graph(tmp_path))
+        await c.mount()
+        src = await c.create("/src")
+        await src.write(b"x" * 5000, 0)
+        await src.fsetxattr({"user.tag": b"v1"})
+        assert (await src.fgetxattr("user.tag"))["user.tag"] == b"v1"
+        await src.fremovexattr("user.tag")
+        with pytest.raises(FopError):
+            await src.fgetxattr("user.tag")
+        dst = await c.create("/dst")
+        n = await src.copy_range(dst, 5000, window=1024)
+        assert n == 5000
+        await src.close()
+        await dst.close()
+        assert await c.read_file("/dst") == b"x" * 5000
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_copy_range_rejects_same_file_overlap(tmp_path):
+    async def run():
+        c = Client(_graph(tmp_path))
+        await c.mount()
+        f = await c.create("/o")
+        await f.write(b"a" * 8192, 0)
+        with pytest.raises(FopError) as ei:
+            await f.copy_range(f, 4096, src_offset=0, dst_offset=1024,
+                               window=1024)
+        assert ei.value.err == errno.EINVAL
+        # non-overlapping same-file copy is fine
+        n = await f.copy_range(f, 1024, src_offset=0, dst_offset=6000)
+        assert n == 1024
+        await f.close()
+        await c.unmount()
+
+    asyncio.run(run())
