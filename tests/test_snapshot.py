@@ -5,6 +5,7 @@ a started volume's state — the tests/basic/volume-snapshot.t analog
 glusterd-snapshot.c."""
 
 import asyncio
+import os
 
 import pytest
 
@@ -281,5 +282,55 @@ def test_restore_rolls_back_grown_shape(tmp_path):
             await cl.unmount()
         finally:
             await d.stop()
+
+    asyncio.run(run())
+
+
+def test_clone_across_nodes(tmp_path):
+    """Cloning a snapshot of a volume whose bricks span two glusterds:
+    each node stages/copies ITS snapped stores, and the clone's brick
+    paths land under each node's own workdir."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient, mount_volume
+
+    async def run():
+        d1 = Glusterd(str(tmp_path / "n1"))
+        d2 = Glusterd(str(tmp_path / "n2"))
+        await d1.start()
+        await d2.start()
+        try:
+            async with MgmtClient(d1.host, d1.port) as c:
+                await c.call("peer-probe", host=d2.host, port=d2.port)
+                bricks = [
+                    {"node": f"{d1.host}:{d1.port}",
+                     "path": str(tmp_path / "x0")},
+                    {"node": f"{d2.host}:{d2.port}",
+                     "path": str(tmp_path / "x1")},
+                ]
+                await c.call("volume-create", name="xv",
+                             vtype="replicate", bricks=bricks,
+                             redundancy=0)
+                await c.call("volume-start", name="xv")
+            cl = await mount_volume(d1.host, d1.port, "xv")
+            await cl.write_file("/two-node", b"spanning")
+            await cl.unmount()
+            async with MgmtClient(d1.host, d1.port) as c:
+                await c.call("snapshot-create", name="xs", volume="xv")
+                await c.call("snapshot-clone", clonename="xc",
+                             snapname="xs")
+            # the clone registered on BOTH nodes with per-node paths
+            for d in (d1, d2):
+                vi = d.state["volumes"]["xc"]
+                mine = [b for b in vi["bricks"] if b["node"] == d.uuid]
+                assert len(mine) == 1
+                assert mine[0]["path"].startswith(d.workdir)
+                assert os.path.isdir(mine[0]["path"])
+            async with MgmtClient(d1.host, d1.port) as c:
+                await c.call("volume-start", name="xc")
+            c2 = await mount_volume(d1.host, d1.port, "xc")
+            assert await c2.read_file("/two-node") == b"spanning"
+            await c2.unmount()
+        finally:
+            await d2.stop()
+            await d1.stop()
 
     asyncio.run(run())
