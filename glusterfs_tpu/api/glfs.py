@@ -114,11 +114,13 @@ class File:
         self.fd = fd
         self.path = path
         self.closed = False
+        self._dirty = False  # any write/truncate since open
 
     async def read(self, size: int, offset: int = 0) -> bytes:
         return await self._client.graph.top.readv(self.fd, size, offset)
 
     async def write(self, data: bytes, offset: int = 0) -> int:
+        self._dirty = True
         await self._client.graph.top.writev(self.fd, bytes(data), offset)
         return len(data)
 
@@ -129,6 +131,7 @@ class File:
         await self._client.graph.top.fsync(self.fd, int(datasync))
 
     async def ftruncate(self, size: int) -> None:
+        self._dirty = True
         await self._client.graph.top.ftruncate(self.fd, size)
 
     async def fgetxattr(self, name: str | None = None):
@@ -166,7 +169,11 @@ class File:
     async def close(self) -> None:
         if not self.closed:
             self.closed = True
-            await self._client.graph.top.flush(self.fd)
+            if self._dirty:
+                # flush reports write-back errors at close (POSIX);
+                # a read-only fd has nothing to report and skips the
+                # fan-out (EC release still drains any eager window)
+                await self._client.graph.top.flush(self.fd)
             release = getattr(self._client.graph.top, "release", None)
             if release is not None:
                 await release(self.fd)
@@ -362,12 +369,18 @@ class Client:
         return File(self, fd, loc.path)
 
     async def write_file(self, path: str, data: bytes) -> int:
-        """Convenience: create/overwrite a file with data."""
-        if await self.exists(path):
+        """Convenience: create/overwrite a file with data.
+
+        Create-first (O_EXCL): the common fresh-file case pays no
+        existence probe; an existing file falls back to the
+        truncate+open overwrite path on EEXIST."""
+        try:
+            f = await self.create(path, os.O_RDWR | os.O_EXCL)
+        except FopError as e:
+            if e.err != errno.EEXIST:
+                raise
             await self.truncate(path, 0)
             f = await self.open(path)
-        else:
-            f = await self.create(path)
         try:
             return await f.write(data, 0)
         finally:

@@ -29,6 +29,7 @@ required since layouts are never exchanged with the reference).
 from __future__ import annotations
 
 import errno
+import os
 import struct
 from collections import Counter
 
@@ -443,6 +444,20 @@ class DistributeLayer(Layer):
 
     async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
                      xdata: dict | None = None):
+        if flags & os.O_EXCL:
+            # O_EXCL must see the file ANYWHERE: the scheduler may
+            # target a subvol other than the holder (nufa/switch local
+            # placement, layout drift), and creating there would FORK
+            # the file — two data copies, the old one orphaned.
+            # Resolution costs one child probe under an authoritative
+            # layout (linktos stand in for re-homed names).
+            try:
+                await self._cached_idx(loc)
+            except FopError as e:
+                if e.err not in (errno.ENOENT, errno.ESTALE):
+                    raise
+            else:
+                raise FopError(errno.EEXIST, loc.path)
         idx = await self._sched(loc)
         fd_c, ia = await self.children[idx].create(loc, flags, mode, xdata)
         hi = await self._placed(loc)

@@ -125,3 +125,51 @@ def test_volgen_emits_variants(tmp_path):
     text = volgen.build_client_volfile(vi2)
     assert "type cluster/nufa" in text
     assert "option local-volume-name dv-replicate-0" in text
+
+
+def test_nufa_write_file_overwrite_does_not_fork(tmp_path):
+    """write_file on an existing file through a DIFFERENT nufa-local
+    client must overwrite, never fork: O_EXCL create resolves existence
+    cluster-wide before targeting the scheduler's subvol (two data
+    copies with an orphan — or a linkto stamped over real data — was
+    the failure)."""
+    import asyncio
+    import os as _os
+
+    from glusterfs_tpu.api.glfs import Client
+    from glusterfs_tpu.core.graph import Graph
+
+    def volfile(local):
+        out = []
+        for i in range(2):
+            out.append(f"""
+volume b{i}
+    type storage/posix
+    option directory {tmp_path}/nb{i}
+end-volume
+""")
+        out.append(f"volume top\n    type cluster/nufa\n"
+                   f"    option local-volume-name {local}\n"
+                   f"    subvolumes b0 b1\nend-volume\n")
+        return "\n".join(out)
+
+    async def run():
+        c1 = Client(Graph.construct(volfile("b1")))
+        await c1.mount()
+        await c1.write_file("/f00", b"old-contents")
+        await c1.unmount()
+        c0 = Client(Graph.construct(volfile("b0")))
+        await c0.mount()
+        await c0.write_file("/f00", b"new")
+        assert await c0.read_file("/f00") == b"new"
+        await c0.unmount()
+        # exactly ONE data copy exists across the bricks (a linkto
+        # pointer is fine; two data files is the fork)
+        datas = []
+        for i in range(2):
+            p = tmp_path / f"nb{i}" / "f00"
+            if p.exists() and p.stat().st_size > 0:
+                datas.append((i, p.read_bytes()))
+        assert datas == [(1, b"new")] or datas == [(0, b"new")], datas
+
+    asyncio.run(run())
