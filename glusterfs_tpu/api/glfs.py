@@ -117,7 +117,11 @@ class File:
         self._dirty = False  # any write/truncate since open
 
     async def read(self, size: int, offset: int = 0) -> bytes:
-        return await self._client.graph.top.readv(self.fd, size, offset)
+        data = await self._client.graph.top.readv(self.fd, size, offset)
+        # glfs_read hands the caller plain bytes; a memoryview off the
+        # wire blob lane must not escape the library boundary (it pins
+        # its RPC frame and breaks bytes-only callers)
+        return data if isinstance(data, bytes) else bytes(data)
 
     async def write(self, data: bytes, offset: int = 0) -> int:
         self._dirty = True

@@ -78,10 +78,15 @@ class FuseBridge:
     """Serve one mountpoint from one mounted :class:`api.glfs.Client`."""
 
     def __init__(self, client: Client, mountpoint: str,
-                 volname: str = "gftpu"):
+                 volname: str = "gftpu", keep_cache: bool = False):
         self.client = client
         self.mountpoint = os.path.abspath(mountpoint)
         self.volname = volname
+        # --fopen-keep-cache (fuse-bridge.c:1617-1635): let the kernel
+        # keep a file's page cache across open()s.  Off by default like
+        # the reference: safe for single-writer mounts, stale for
+        # multi-client files unless upcall invalidation is on
+        self.keep_cache = keep_cache
         self.dev_fd = -1
         self.proto_minor = 0
         self._nodes: dict[int, _Node] = {}
@@ -182,7 +187,11 @@ class FuseBridge:
         hdr = fp.OUT_HEADER.pack(fp.OUT_HEADER.size + len(data),
                                  -error, unique)
         try:
-            os.write(self.dev_fd, hdr + data)
+            # vectored: read payloads arrive as memoryviews into the
+            # RPC frame (wire blob lane) — writev ships them to the
+            # kernel without a concat copy (and bytes+memoryview would
+            # TypeError anyway)
+            os.writev(self.dev_fd, (hdr, data))
         except OSError:
             pass  # request raced an unmount/interrupt
 
@@ -482,7 +491,8 @@ class FuseBridge:
     async def _op_open(self, nodeid: int, payload: bytes) -> bytes:
         flags, _ = fp.OPEN_IN.unpack_from(payload)
         fd = await self._top.open(self._loc(self._node(nodeid)), flags)
-        return fp.OPEN_OUT.pack(self._new_fh(fd), 0, 0)
+        open_flags = fp.FOPEN_KEEP_CACHE if self.keep_cache else 0
+        return fp.OPEN_OUT.pack(self._new_fh(fd), open_flags, 0)
 
     async def _op_opendir(self, nodeid: int, payload: bytes) -> bytes:
         fd = await self._top.opendir(self._loc(self._node(nodeid)))
@@ -687,7 +697,8 @@ async def _amain(args) -> int:
     host, _, port = args.server.rpartition(":")
     client = await mount_volume(host or "127.0.0.1", int(port),
                                 args.volume)
-    bridge = FuseBridge(client, args.mountpoint, args.volume)
+    bridge = FuseBridge(client, args.mountpoint, args.volume,
+                        keep_cache=args.fopen_keep_cache)
     bridge.mount()
     if args.readyfile:
         with open(args.readyfile + ".tmp", "w") as f:
@@ -718,6 +729,9 @@ def main(argv=None) -> int:
     p.add_argument("--volume", required=True)
     p.add_argument("--readyfile", default="",
                    help="file created once the mount is live")
+    p.add_argument("--fopen-keep-cache", action="store_true",
+                   help="keep kernel page cache across opens "
+                        "(glusterfs --fopen-keep-cache)")
     p.add_argument("mountpoint")
     args = p.parse_args(argv)
     return asyncio.run(_amain(args))

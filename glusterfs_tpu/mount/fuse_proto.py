@@ -73,6 +73,10 @@ OPCODE_NAMES = {globals()[k]: k for k in _OPCODES}
 # -- INIT flags we care about ---------------------------------------------
 FUSE_ASYNC_READ = 1 << 0
 FUSE_BIG_WRITES = 1 << 5
+
+# open_out.open_flags bits (include/uapi/linux/fuse.h)
+FOPEN_DIRECT_IO = 1 << 0
+FOPEN_KEEP_CACHE = 1 << 1
 FUSE_DO_READDIRPLUS = 1 << 13
 FUSE_READDIRPLUS_AUTO = 1 << 14
 FUSE_PARALLEL_DIROPS = 1 << 18

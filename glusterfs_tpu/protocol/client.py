@@ -297,12 +297,15 @@ class ClientLayer(Layer):
         try:
             body = [fop, list(args), kwargs or {}]
             if self.opts["compression"]:
-                frame = wire.pack_z(xid, wire.MT_CALL, body,
-                                    int(self.opts[
-                                        "compression-min-size"]))
+                writer.write(wire.pack_z(xid, wire.MT_CALL, body,
+                                         int(self.opts[
+                                             "compression-min-size"])))
             else:
-                frame = wire.pack(xid, wire.MT_CALL, body)
-            writer.write(frame)
+                # payload blobs ride out-of-band and writelines hands
+                # the ORIGINAL buffers to the transport — a writev
+                # payload is never copied on this side (iobref submit)
+                writer.writelines(wire.pack_frames(xid, wire.MT_CALL,
+                                                   body))
             await writer.drain()
         except (ConnectionError, RuntimeError):
             self._pending.pop(xid, None)
@@ -314,6 +317,10 @@ class ClientLayer(Layer):
             self._pending.pop(xid, None)
             raise FopError(errno.ETIMEDOUT, f"{fop} timed out") from None
 
+    # payloads at or above this ride the out-of-band blob lane; below
+    # it the tagged codec's inline copy is cheaper than a second iovec
+    BLOB_MIN = 4096
+
     def _wire_args(self, args: tuple) -> tuple:
         out = []
         for a in args:
@@ -324,6 +331,9 @@ class ClientLayer(Layer):
                     out.append({"__anon_fd__": a.gfid, "path": a.path})
                 else:
                     out.append(h)
+            elif isinstance(a, (bytes, bytearray, memoryview)) and \
+                    len(a) >= self.BLOB_MIN:
+                out.append(wire.Blob(a))
             else:
                 out.append(a)
         return tuple(out)
@@ -417,9 +427,9 @@ class ClientLayer(Layer):
                 key = ("lk", id(fd), owner_of(xd),
                        flock.get("start", 0), flock.get("len", 0))
                 cmd = "unlock" if flock.get("type") == "unlck" else "lock"
-            if cmd == "lock" and not failed:
+            if cmd in ("lock", "lock-nb") and not failed:
                 self._held_locks[key] = (name, args, kwargs)
-            elif cmd != "lock":
+            elif cmd not in ("lock", "lock-nb"):
                 self._held_locks.pop(key, None)
         except (IndexError, AttributeError, TypeError):
             pass  # unexpected call shape: tracking must never break fops

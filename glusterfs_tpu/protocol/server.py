@@ -114,7 +114,8 @@ _FOPS = {f.value for f in Fop}
 # non-wire-fop methods a client may invoke remotely (heal entry points,
 # introspection — the reference exposes these via separate RPC programs)
 _RPC_EXTRAS = {"heal_info", "heal_file", "heal_entry", "rebalance",
-               "release", "getactivelk", "quota_usage", "top_stats"}
+               "release", "getactivelk", "quota_usage", "top_stats",
+               "changelog_history"}
 
 
 class _ClientConn:
@@ -155,9 +156,16 @@ class _ClientConn:
             return [self.resolve(x) for x in v]
         return v
 
+    # reply payloads at or above this ride the out-of-band blob lane
+    # (readv data must not crawl through the tagged codec byte-wise)
+    BLOB_MIN = 4096
+
     def wrap(self, v: Any) -> Any:
         if isinstance(v, FdObj):
             return self.register_fd(v)
+        if isinstance(v, (bytes, bytearray, memoryview)) and \
+                len(v) >= self.BLOB_MIN:
+            return wire.Blob(v)
         if isinstance(v, tuple):
             return [self.wrap(x) for x in v]
         if isinstance(v, list):
@@ -388,7 +396,11 @@ class BrickServer:
                 if conn.compress:
                     writer.write(wire.pack_z(xid, resp_type, resp))
                 else:
-                    writer.write(wire.pack(xid, resp_type, resp))
+                    # blob replies (readv data) go out as raw trailing
+                    # buffers — no payload copy between the fop return
+                    # and the socket
+                    writer.writelines(wire.pack_frames(xid, resp_type,
+                                                       resp))
                 await writer.drain()
 
         async def serve_one(xid: int, payload):
