@@ -125,6 +125,16 @@ class DisperseLayer(Layer):
                description="hold the txn inodelk across consecutive fops "
                            "on one inode with a delayed combined post-op "
                            "(disperse.eager-lock, ec-common.c:2176)"),
+        Option("other-eager-lock", "bool", default="on",
+               description="non-write fops (reads) share the eager "
+                           "window too (disperse.other-eager-lock): "
+                           "consecutive reads on one inode pay one lock "
+                           "wave total.  The window's inodelk is "
+                           "exclusive, so cross-CLIENT concurrent "
+                           "readers of one file serialize on lock "
+                           "handoffs — turn this off for that workload "
+                           "(reads then take shared rd locks per fop), "
+                           "same tradeoff the reference documents"),
         Option("eager-lock-timeout", "time", default="0.2",
                description="idle window before the eager lock releases "
                            "(reference post-op-delay semantics)"),
@@ -253,25 +263,54 @@ class DisperseLayer(Layer):
         xd = {"lk-owner": owner or self._lk_owner}
         if collect is not None:
             xd["get-xattrs"] = True
+
+        def absorb(i: int, ret) -> None:
+            # only trust fetches that carry real counter state: a failed
+            # fetch (None), a locks layer predating get-xattrs (None
+            # grant return), or a brick whose counters are simply absent
+            # must NOT be parsed as "clean version 0, size 0" — that
+            # fabricated entry could win _pick_meta's vote and corrupt
+            # the recorded size.  Missing entries force the caller back
+            # to the classic metadata wave.
+            if collect is not None and isinstance(ret, dict) \
+                    and XA_VERSION in ret:
+                collect[i] = ret
+
+        # Fast path (ec-locks.c / afr_lock: try NON-BLOCKING on every
+        # child in ONE parallel wave; conflicts fall back to the ordered
+        # blocking walk).  The sequential walk alone costs up-count
+        # round trips of pure latency per transaction.
+        ups = self._up_idx()
+        res = await self._dispatch(
+            ups, "inodelk",
+            lambda i: (("ec.transaction", loc, "lock-nb", ltype, start,
+                        end, xd), {}))
+        granted = [i for i, r in res.items()
+                   if not isinstance(r, BaseException)]
+        errs = {i: r for i, r in res.items() if isinstance(r, BaseException)}
+        if all(isinstance(e, FopError) and e.err == errno.EOPNOTSUPP
+               for e in errs.values()):
+            for i in granted:
+                absorb(i, res[i])
+            if self._locks_supported is None:
+                self._locks_supported = bool(granted)
+            return sorted(granted)
+        # somebody holds a conflicting lock (or a brick failed): release
+        # what we took and walk children in index order with BLOCKING
+        # locks — all clients use the same order, so cross-client
+        # deadlock cannot occur (ec-locks.c ordering)
+        await self._inodelk_unwind(loc, sorted(granted), owner, start, end)
+        if collect is not None:
+            collect.clear()
         locked: list[int] = []
         try:
-            for i in self._up_idx():
+            for i in ups:
                 try:
                     ret = await self.children[i].inodelk(
                         "ec.transaction", loc, "lock", ltype, start, end,
                         xd)
                     locked.append(i)
-                    # only trust fetches that carry real counter state:
-                    # a failed fetch (None), a locks layer predating
-                    # get-xattrs (None grant return), or a brick whose
-                    # counters are simply absent must NOT be parsed as
-                    # "clean version 0, size 0" — that fabricated entry
-                    # could win _pick_meta's vote and corrupt the
-                    # recorded size.  Missing entries force the caller
-                    # back to the classic metadata wave.
-                    if collect is not None and isinstance(ret, dict) \
-                            and XA_VERSION in ret:
-                        collect[i] = ret
+                    absorb(i, ret)
                 except FopError as e:
                     if e.err == errno.EOPNOTSUPP:
                         continue
@@ -286,13 +325,15 @@ class DisperseLayer(Layer):
     async def _inodelk_unwind(self, loc: Loc, locked: list[int],
                               owner: bytes | None = None,
                               start: int = 0, end: int = -1) -> None:
+        if not locked:
+            return
         xd = {"lk-owner": owner or self._lk_owner}
-        for i in locked:
-            try:
-                await self.children[i].inodelk(
-                    "ec.transaction", loc, "unlock", "wr", start, end, xd)
-            except FopError:
-                pass
+        # one parallel wave; failures (restarted brick: lock already
+        # reaped) are ignored per child
+        await self._dispatch(
+            list(locked), "inodelk",
+            lambda i: (("ec.transaction", loc, "unlock", "wr", start, end,
+                        xd), {}))
 
     class _Txn:
         """Write-transaction scope: local serialization + cluster inodelk.
@@ -302,7 +343,8 @@ class DisperseLayer(Layer):
         txn so writers interleave between windows (ec-heal.c:251)."""
 
         def __init__(self, ec: "DisperseLayer", loc: Loc, gfid: bytes,
-                     ltype: str = "wr", start: int = 0, end: int = -1):
+                     ltype: str = "wr", start: int = 0, end: int = -1,
+                     fetch: bool = False):
             self.ec = ec
             self.loc = loc
             self.gfid = gfid
@@ -310,6 +352,9 @@ class DisperseLayer(Layer):
             self.start = start
             self.end = end
             self.locked: list[int] = []
+            # lock-and-fetch: grants carry the inode's xattrs so the
+            # caller's metadata wave folds into the lock wave
+            self.fetched: dict[int, dict] = {} if fetch else None
             self.local = ltype == "wr" or ec._locks_supported is False
             # Per-transaction lk-owner (reference frame->root->lk_owner):
             # with a per-client owner this client's reads would never
@@ -333,7 +378,7 @@ class DisperseLayer(Layer):
             try:
                 self.locked = await self.ec._inodelk_wind(
                     self.loc, self.ltype, self.owner, self.start,
-                    self.end)
+                    self.end, collect=self.fetched)
             except BaseException:
                 if self.local:
                     self.ec._lock(self.gfid).release()
@@ -548,15 +593,24 @@ class DisperseLayer(Layer):
 
     # -- size helpers ------------------------------------------------------
 
+    @staticmethod
+    def _vote_size(values) -> int | None:
+        """Most-common decoded trusted.ec.size among raw xattr values
+        (ONE copy of the unpack + vote semantics for every caller)."""
+        sizes = [struct.unpack(">Q", v.ljust(8, b"\0"))[0]
+                 for v in values]
+        if not sizes:
+            return None
+        return Counter(sizes).most_common(1)[0][0]
+
     async def _true_size(self, loc: Loc, idxs=None) -> int:
         idxs = idxs if idxs is not None else self._up_idx()
         res = await self._dispatch(idxs, "getxattr",
                                    lambda i: ((loc, XA_SIZE), {}))
-        sizes = [struct.unpack(">Q", r[XA_SIZE].ljust(8, b"\0"))[0]
-                 for r in res.values() if not isinstance(r, BaseException)]
-        if not sizes:
-            return 0
-        return Counter(sizes).most_common(1)[0][0]
+        vote = self._vote_size(
+            r[XA_SIZE] for r in res.values()
+            if not isinstance(r, BaseException) and XA_SIZE in r)
+        return 0 if vote is None else vote
 
     def _frag_len(self, nbytes: int) -> int:
         """Fragment bytes covering nbytes of user data (stripe padded)."""
@@ -575,8 +629,14 @@ class DisperseLayer(Layer):
     # -- namespace fops: dispatch-all + combine ----------------------------
 
     async def lookup(self, loc: Loc, xdata: dict | None = None):
+        # ask every child to piggyback its xattrs on the reply: the
+        # true-size vote then needs no second fan-out (the reference
+        # loads trusted.ec.* through lookup's dict_t request keys,
+        # ec-generic.c ec_lookup)
+        xd_req = dict(xdata or {})
+        xd_req["get-xattrs"] = True
         res = await self._dispatch(self._up_idx(), "lookup",
-                                   lambda i: ((loc, xdata), {}))
+                                   lambda i: ((loc, xd_req), {}))
         good = self._combine(res)
         ia, xd = next(iter(good.values()))
         ia = Iatt(**{**ia.__dict__})
@@ -584,8 +644,19 @@ class DisperseLayer(Layer):
             st = self._eager.get(ia.gfid)
             # an open eager window caches the authoritative size (the
             # size xattr commit is deferred to window close)
-            ia.size = st.size if st is not None else \
-                await self._true_size(loc, list(good))
+            if st is not None:
+                ia.size = st.size
+            else:
+                vote = self._vote_size(
+                    r[1][XA_SIZE] for r in good.values()
+                    if isinstance(r[1], dict) and XA_SIZE in r[1])
+                ia.size = vote if vote is not None \
+                    else await self._true_size(loc, list(good))
+        if isinstance(xd, dict) and xd:
+            # the piggybacked counters are EC-internal: never leak
+            # trusted.ec.* into upper caches / user-visible xattrs
+            xd = {k: v for k, v in xd.items()
+                  if not k.startswith("trusted.ec.")}
         return ia, xd
 
     async def stat(self, loc: Loc, xdata: dict | None = None):
@@ -781,6 +852,16 @@ class DisperseLayer(Layer):
 
     # -- the data path -----------------------------------------------------
 
+    async def _txn_meta(self, txn: "_Txn") -> tuple[list[int], int]:
+        """Metadata for a fetch=True transaction: use the xattrs that
+        rode the lock grants when every up child answered; fall back to
+        the classic metadata wave otherwise."""
+        if txn.locked and txn.fetched is not None and \
+                set(self._up_idx()) <= set(txn.fetched):
+            return self._pick_meta({i: self._parse_meta(r)
+                                    for i, r in txn.fetched.items()})
+        return await self._read_meta(txn.loc)
+
     async def _read_meta(self, loc: Loc) -> tuple[list[int], int]:
         """(consistent candidate rows, true size) in ONE metadata fan-out.
 
@@ -877,23 +958,24 @@ class DisperseLayer(Layer):
     async def readv(self, fd: FdObj, size: int, offset: int,
                     xdata: dict | None = None):
         loc = Loc(fd.path, gfid=fd.gfid)
-        if fd.gfid in self._eager:
-            # this client holds the eager write lock: serve the read
-            # under it from the cached window metadata (serialized with
-            # our own writes by the local gfid lock)
+        if self.opts["eager-lock"] and self.opts["other-eager-lock"]:
+            # reads share the eager window (disperse.other-eager-lock):
+            # the first read on an inode pays one lock-and-fetch wave,
+            # consecutive reads pay ONLY the fragment wave — without
+            # this every kernel-readahead chunk through the mount costs
+            # lock + meta + unlock waves of pure latency.  Same-inode
+            # ops serialize on the local gfid lock (the reference
+            # chains same-inode fops on the lock owner too).
             async with self._lock(fd.gfid):
-                st = self._eager.get(fd.gfid)
-                if st is not None:
-                    if st.timer is not None:
-                        st.timer.cancel()
-                        st.timer = None
-                    try:
-                        return await self._readv_window(
-                            fd, size, offset, st.candidates, st.size)
-                    finally:
-                        await self._eager_end(loc, fd.gfid)
-        async with self._Txn(self, loc, fd.gfid, "rd"):
-            candidates, true_size = await self._read_meta(loc)
+                st = await self._eager_begin(loc, fd.gfid)
+                try:
+                    return await self._readv_window(
+                        fd, size, offset, st.candidates, st.size)
+                finally:
+                    await self._eager_end(loc, fd.gfid)
+        async with self._Txn(self, loc, fd.gfid, "rd",
+                             fetch=True) as txn:
+            candidates, true_size = await self._txn_meta(txn)
             return await self._readv_window(fd, size, offset, candidates,
                                             true_size)
 

@@ -164,7 +164,7 @@ class LocksLayer(Layer):
         gfid = await self._gfid_for(loc)
         ret = await self._do(self._inodelk, (gfid, domain), cmd,
                              _Lock(self._owner(xdata), ltype, start, end))
-        if cmd == "lock" and (xdata or {}).get("get-xattrs"):
+        if cmd in ("lock", "lock-nb") and (xdata or {}).get("get-xattrs"):
             # lock-and-fetch: return the inode's xattrs with the grant,
             # saving the caller a separate metadata round trip (the
             # xdata-piggyback idiom the reference uses on lookups).

@@ -56,6 +56,11 @@ OPTION_MAP = {
                                         "stripe-cache-min-batch"),
     "disperse.read-policy": ("cluster/disperse", "read-policy"),
     "disperse.quorum-count": ("cluster/disperse", "quorum-count"),
+    "disperse.eager-lock": ("cluster/disperse", "eager-lock"),
+    "disperse.other-eager-lock": ("cluster/disperse",
+                                  "other-eager-lock"),
+    "disperse.eager-lock-timeout": ("cluster/disperse",
+                                    "eager-lock-timeout"),
     "disperse.self-heal-window-size": ("cluster/disperse",
                                        "self-heal-window-size"),
     "cluster.quorum-count": ("cluster/replicate", "quorum-count"),
@@ -66,6 +71,7 @@ OPTION_MAP = {
                                       "favorite-child-policy"),
     "cluster.lookup-unhashed": ("cluster/distribute", "lookup-unhashed"),
     "cluster.min-free-disk": ("cluster/distribute", "min-free-disk"),
+    "cluster.rebal-throttle": ("cluster/distribute", "rebal-throttle"),
     "network.ping-timeout": ("protocol/client", "ping-timeout"),
     "storage.health-check-interval": ("storage/posix",
                                       "health-check-interval"),
@@ -139,12 +145,15 @@ OPTION_MAP = {
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key
 DEFAULT_PERF_STACK = [
+    # reference defaults (glusterd-volume-set.c): write-behind,
+    # read-ahead, io-cache, quick-read, open-behind and stat-prefetch
+    # (md-cache) all default ON; readdir-ahead and nl-cache are opt-in
     ("performance/write-behind", "performance.write-behind", True),
-    ("performance/read-ahead", "performance.read-ahead", False),
+    ("performance/read-ahead", "performance.read-ahead", True),
     ("performance/readdir-ahead", "performance.readdir-ahead", False),
-    ("performance/io-cache", "performance.io-cache", False),
-    ("performance/quick-read", "performance.quick-read", False),
-    ("performance/open-behind", "performance.open-behind", False),
+    ("performance/io-cache", "performance.io-cache", True),
+    ("performance/quick-read", "performance.quick-read", True),
+    ("performance/open-behind", "performance.open-behind", True),
     ("performance/md-cache", "performance.md-cache", True),
     ("performance/nl-cache", "performance.nl-cache", False),
 ]

@@ -146,6 +146,20 @@ class BatchingCodec(Codec):
         self._dev = _PathModel()
         self._nat = _PathModel()
         self._cal_state = "idle"  # idle -> running -> done/failed
+        # calibration is DEFERRED to an idle gap: the first device
+        # encode pays jax imports + kernel compiles that monopolize the
+        # GIL for seconds — run that while production flushes are
+        # arriving and every in-flight fop (and the event loop's own
+        # heartbeats) stalls behind it.  Flushes stamp _last_flush; a
+        # debounce task starts calibrating only after _CAL_IDLE_S of
+        # quiet.  ensure_calibrated() (benches) still forces it NOW.
+        # Seeded with NOW, not 0: a zero seed would make the first
+        # flush see an "infinite" idle gap and fire calibration under
+        # the cold-start burst.
+        self._last_flush = time.monotonic()
+        self._cal_timer: asyncio.Task | None = None
+
+    _CAL_IDLE_S = 0.3
 
     # -- stats hooks (count every device launch, sync path included) ------
 
@@ -206,7 +220,30 @@ class BatchingCodec(Codec):
             if self._cal_state != "idle":
                 return
             self._cal_state = "running"
+        if self._cal_timer is not None:
+            self._cal_timer.cancel()
+            self._cal_timer = None
         self._pool.submit(self._calibrate)
+
+    def _maybe_schedule_calibration(self) -> None:
+        """Debounced: start calibration after an idle gap, not under load."""
+        if self._cal_state != "idle" or self._cal_timer is not None:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+
+        async def when_idle():
+            while True:
+                gap = time.monotonic() - self._last_flush
+                if gap >= self._CAL_IDLE_S:
+                    break
+                await asyncio.sleep(self._CAL_IDLE_S - gap)
+            self._cal_timer = None
+            self._maybe_start_calibration()
+
+        self._cal_timer = loop.create_task(when_idle())
 
     async def ensure_calibrated(self) -> bool:
         """Run (or await) calibration; True if the device model is ready.
@@ -241,7 +278,7 @@ class BatchingCodec(Codec):
                 return self, True
             else:
                 return small, False
-        self._maybe_start_calibration()
+        self._maybe_schedule_calibration()
         return small, False
 
     def _padded(self, total: int) -> int:
@@ -319,6 +356,7 @@ class BatchingCodec(Codec):
         batch, self._enc_q = self._enc_q, []
         if not batch:
             return
+        self._last_flush = time.monotonic()
         self.batched_fops += len(batch)
         self.max_batch = max(self.max_batch, len(batch))
         total = sum(d.size for d, _ in batch)
@@ -402,6 +440,7 @@ class BatchingCodec(Codec):
         queues, self._dec_q = self._dec_q, {}
         if not queues:
             return
+        self._last_flush = time.monotonic()
         loop = asyncio.get_running_loop()
         for rows, batch in queues.items():
             self.batched_fops += len(batch)
@@ -442,6 +481,9 @@ class BatchingCodec(Codec):
         reconfigure replaces the codec and at graph fini — without it
         every rebuild leaks the two worker threads.  Queued flushes
         still run (their awaiters must resolve); threads exit after."""
+        if self._cal_timer is not None:
+            self._cal_timer.cancel()
+            self._cal_timer = None
         self._pool.shutdown(wait=False)
 
     def dump_stats(self) -> dict:

@@ -108,6 +108,15 @@ def _invalidating(op_name: str):
                 self.invalidate(a.gfid)
             elif isinstance(a, FdObj):
                 self.invalidate(a.gfid)
+        # absorb the postbuf (mdc_writev_cbk and friends update from
+        # postbuf iatts): a stat right after a write is served from
+        # cache instead of paying a fresh cluster lookup wave
+        ia = ret
+        if isinstance(ia, (tuple, list)) and ia and \
+                hasattr(ia[-1], "gfid"):
+            ia = ia[-1]
+        if hasattr(ia, "gfid") and hasattr(ia, "size") and ia.gfid:
+            self._iatt[ia.gfid] = (time.monotonic(), ia)
         return ret
     fop.__name__ = op_name
     return fop

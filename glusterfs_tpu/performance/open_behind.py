@@ -11,27 +11,44 @@ from ..core.options import Option
 
 
 class _ObCtx:
-    __slots__ = ("loc", "flags", "real_fd")
+    __slots__ = ("loc", "flags", "real_fd", "anon_fd")
 
     def __init__(self, loc: Loc, flags: int):
         self.loc = loc
         self.flags = flags
         self.real_fd: FdObj | None = None
+        # ONE anonymous stand-in per open: downstream layers key per-fd
+        # state (read-ahead windows, EC fd ctx) off the fd object — a
+        # fresh FdObj per read would reset them every call
+        self.anon_fd: FdObj | None = None
 
 
 @register("performance/open-behind")
 class OpenBehindLayer(Layer):
     OPTIONS = (
         Option("lazy-open", "bool", default="on"),
+        Option("use-anonymous-fd", "bool", default="on",
+               description="serve reads on a never-opened fd through an "
+                           "anonymous (gfid-addressed) fd instead of "
+                           "forcing the deferred open (the reference's "
+                           "open-behind option of the same name): an "
+                           "open/read/close pass never pays open or "
+                           "release round trips"),
     )
 
     async def open(self, loc: Loc, flags: int = 0, xdata: dict | None = None):
         if not self.opts["lazy-open"]:
             return await self.children[0].open(loc, flags, xdata)
-        # validate existence cheaply, defer the real open
-        ia, _ = await self.children[0].lookup(loc)
-        fd = FdObj(ia.gfid, flags, path=loc.path)
-        fd.ctx_set(self, _ObCtx(Loc(loc.path, gfid=ia.gfid), flags))
+        if loc.gfid:
+            # already resolved (the api walks the path before open):
+            # no validation round trip — a vanished file surfaces
+            # ESTALE/ENOENT on first use, same as a raced open
+            gfid = loc.gfid
+        else:
+            ia, _ = await self.children[0].lookup(loc)
+            gfid = ia.gfid
+        fd = FdObj(gfid, flags, path=loc.path)
+        fd.ctx_set(self, _ObCtx(Loc(loc.path, gfid=gfid), flags))
         return fd
 
     async def _real(self, fd: FdObj) -> FdObj:
@@ -41,6 +58,28 @@ class OpenBehindLayer(Layer):
         if ctx.real_fd is None:
             ctx.real_fd = await self.children[0].open(ctx.loc, ctx.flags)
         return ctx.real_fd
+
+    def _anon(self, fd: FdObj) -> FdObj | None:
+        """Anonymous stand-in for a read on a still-unopened lazy fd."""
+        ctx: _ObCtx | None = fd.ctx_get(self)
+        if ctx is None or ctx.real_fd is not None or \
+                not self.opts["use-anonymous-fd"]:
+            return None
+        import os as _os
+
+        if ctx.flags & (_os.O_WRONLY | _os.O_RDWR):
+            return None  # writes need the real fd (wb/locks semantics)
+        if ctx.anon_fd is None:
+            ctx.anon_fd = FdObj(ctx.loc.gfid, ctx.flags,
+                                path=ctx.loc.path, anonymous=True)
+        return ctx.anon_fd
+
+    async def flush(self, fd: FdObj, xdata: dict | None = None):
+        ctx: _ObCtx | None = fd.ctx_get(self)
+        if ctx is not None and ctx.real_fd is None:
+            return {}  # never materialized, never wrote: nothing to push
+        real = await self._real(fd)
+        return await self.children[0].flush(real, xdata)
 
     async def release(self, fd: FdObj):
         ctx: _ObCtx | None = fd.ctx_del(self)
@@ -54,8 +93,13 @@ class OpenBehindLayer(Layer):
         return {"lazy_open": self.opts["lazy-open"]}
 
 
-def _lazy(op_name: str):
+def _lazy(op_name: str, anon_ok: bool = False):
     async def fop(self, fd: FdObj, *args, **kwargs):
+        if anon_ok:
+            anon = self._anon(fd)
+            if anon is not None:
+                return await getattr(self.children[0], op_name)(
+                    anon, *args, **kwargs)
         real = await self._real(fd)
         return await getattr(self.children[0], op_name)(real, *args,
                                                         **kwargs)
@@ -63,8 +107,13 @@ def _lazy(op_name: str):
     return fop
 
 
-for _op in ("readv", "writev", "fstat", "fsync", "flush", "ftruncate",
-            "fgetxattr", "fsetxattr", "fxattrop", "fremovexattr", "seek",
-            "fallocate", "discard", "zerofill", "rchecksum", "lk",
-            "fsetattr"):
+# read-class fops ride anonymous fds (no open/release round trips for
+# an open/read/close pass); write-class and lock fops force the real
+# open — write-behind flushing and posix lock-loss semantics need a
+# stable fd identity
+for _op in ("readv", "fstat", "fgetxattr", "seek", "rchecksum"):
+    setattr(OpenBehindLayer, _op, _lazy(_op, anon_ok=True))
+for _op in ("writev", "fsync", "ftruncate",
+            "fsetxattr", "fxattrop", "fremovexattr",
+            "fallocate", "discard", "zerofill", "lk", "fsetattr"):
     setattr(OpenBehindLayer, _op, _lazy(_op))

@@ -28,11 +28,17 @@ class QuickReadLayer(Layer):
             collections.OrderedDict()
         self._bytes = 0
         self.hits = 0
+        # gfids known to exceed max-file-size (TTL'd): a large file
+        # must not pay a size probe on EVERY read just to learn, again,
+        # that it doesn't qualify (the reference learns size from the
+        # lookup it piggybacks content on)
+        self._too_big: dict[bytes, float] = {}
 
     def _invalidate(self, gfid: bytes) -> None:
         ent = self._files.pop(gfid, None)
         if ent is not None:
             self._bytes -= len(ent[1])
+        self._too_big.pop(gfid, None)
 
     async def readv(self, fd: FdObj, size: int, offset: int,
                     xdata: dict | None = None):
@@ -43,9 +49,24 @@ class QuickReadLayer(Layer):
             self.hits += 1
             self._files.move_to_end(fd.gfid)
             return ent[1][offset: offset + size]
+        big = self._too_big.get(fd.gfid)
+        if big is not None and \
+                time.monotonic() - big < self.opts["cache-timeout"]:
+            return await self.children[0].readv(fd, size, offset, xdata)
+        if size > maxsz:
+            # a request larger than any qualifying file needs no size
+            # probe — but it says nothing about the FILE's size (the
+            # kernel reads small files with big buffers), so no
+            # blacklisting here
+            return await self.children[0].readv(fd, size, offset, xdata)
         ia = await self.children[0].fstat(fd)
+        if ia.size > maxsz:
+            self._too_big[fd.gfid] = time.monotonic()
         if ia.size <= maxsz:
-            content = await self.children[0].readv(fd, maxsz + 1, 0)
+            # bytes() copy: a memoryview off the wire blob lane would
+            # pin its whole RPC frame for the cache's lifetime
+            content = bytes(
+                await self.children[0].readv(fd, maxsz + 1, 0))
             self._files[fd.gfid] = (time.monotonic(), content)
             self._bytes += len(content)
             while self._bytes > self.opts["cache-size"] and self._files:

@@ -37,18 +37,24 @@ class ReadAheadLayer(Layer):
         return ctx
 
     async def _prefetch(self, fd: FdObj, start_page: int) -> None:
+        """Fetch the whole look-ahead window in ONE child readv (the
+        reference pipelines its pages; issuing them as serial fops
+        would pay the cluster read-txn latency page-count times)."""
         psz = self.opts["page-size"]
+        count = self.opts["page-count"]
         ctx = self._ctx(fd)
-        for i in range(self.opts["page-count"]):
-            idx = start_page + i
-            if idx in ctx.pages:
-                continue
-            try:
-                page = await self.children[0].readv(fd, psz, idx * psz)
-            except Exception:
-                return
-            ctx.pages[idx] = page
-            if len(ctx.pages) > 4 * self.opts["page-count"]:
+        while start_page in ctx.pages:
+            start_page += 1
+        try:
+            data = await self.children[0].readv(fd, count * psz,
+                                                start_page * psz)
+        except Exception:
+            return
+        data = bytes(data) if not isinstance(data, bytes) else data
+        for i in range(count):
+            page = data[i * psz:(i + 1) * psz]
+            ctx.pages[start_page + i] = page
+            if len(ctx.pages) > 4 * count:
                 ctx.pages.pop(min(ctx.pages))
             if len(page) < psz:
                 return

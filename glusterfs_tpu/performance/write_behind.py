@@ -22,6 +22,7 @@ class _WbFd:
         self.error: FopError | None = None
         self.lock = asyncio.Lock()
         self.last_iatt = None
+        self.logical_end = 0  # high-water mark incl. absorbed writes
 
 
 @register("performance/write-behind")
@@ -84,12 +85,21 @@ class WriteBehindLayer(Layer):
         self._raise_deferred(ctx)
         async with ctx.lock:
             self._absorb(ctx, bytes(data), offset)
+            ctx.logical_end = max(ctx.logical_end, offset + len(data))
         if ctx.bytes >= self.opts["window-size"]:
             await self._drain(fd, ctx)
             self._raise_deferred(ctx)
         ia = ctx.last_iatt
         if ia is None:
             ia = await self.children[0].fstat(fd)
+        # the postbuf must reflect absorbed-but-unflushed bytes too:
+        # upper caches (md-cache) absorb this iatt, and a stale size
+        # there would corrupt a stat-after-write
+        if hasattr(ia, "size") and ia.size < ctx.logical_end:
+            from ..core.iatt import Iatt
+
+            ia = Iatt(**{**ia.__dict__})
+            ia.size = ctx.logical_end
         return ia
 
     async def readv(self, fd: FdObj, size: int, offset: int,
@@ -125,6 +135,7 @@ class WriteBehindLayer(Layer):
         ctx = self._ctx(fd)
         await self._drain(fd, ctx)
         self._raise_deferred(ctx)
+        ctx.logical_end = size
         return await self.children[0].ftruncate(fd, size, xdata)
 
     async def release(self, fd: FdObj):

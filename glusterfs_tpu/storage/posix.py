@@ -659,6 +659,14 @@ class PosixLayer(Layer):
     async def lookup(self, loc: Loc, xdata: dict | None = None):
         path = self._loc_path(loc)
         ia = self._iatt(path)
+        if (xdata or {}).get("get-xattrs"):
+            # xdata piggyback (the reference's dict_t request keys on
+            # lookup): the reply carries the inode's xattrs so cluster
+            # layers fold their metadata fan-out into the lookup wave
+            try:
+                return ia, dict(await self.getxattr(loc, None))
+            except FopError:
+                pass
         return ia, {}
 
     async def stat(self, loc: Loc, xdata: dict | None = None):
