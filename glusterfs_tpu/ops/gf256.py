@@ -252,3 +252,62 @@ def ref_decode(frags: np.ndarray, rows, k: int) -> np.ndarray:
     x = frags_to_planes(frags, k)  # (S, k*8, 64)
     y = _xor_matmul_planes(bbits, x)  # (S, k*8, 64)
     return y.reshape(x.shape[0] * k * CHUNK_SIZE).copy()
+
+
+@functools.lru_cache(maxsize=64)
+def xor_program(abits_key: tuple) -> tuple[tuple, tuple]:
+    """Greedy common-subexpression elimination over a GF(2) bit-matrix
+    (Paar's algorithm): returns a straight-line XOR program computing
+    ``y = abits @ x mod 2`` with shared intermediates.
+
+    Reed-Solomon bit-matrices are dense (tens of terms per output
+    plane) but massively share pair subexpressions; the raw per-row
+    XOR chains the reference JITs (ec-code-avx.c unrolled chains) redo
+    each shared pair per row.  The returned program cuts total XOR
+    count ~2-3x, which is the whole game for the VPU-bound wide-k
+    kernels.
+
+    Returns ``(ops, outs)``: ``ops`` is a tuple of ``(dst, a, b)``
+    meaning ``t[dst] = t[a] ^ t[b]`` (``t[0..C-1]`` are the input
+    planes, new ids from C up); ``outs[r]`` is the tuple of var ids
+    whose XOR is output row r (often a single shared id).
+    """
+    a = np.array(abits_key, dtype=np.uint8)
+    r, c = a.shape
+    # incidence (rows, vars), preallocated for intermediates; the pair
+    # co-occurrence matrix M is maintained INCREMENTALLY — extracting
+    # pair (i, j) only changes M's rows/columns i, j and the new var's
+    # (other pairs' co-occurrence is untouched), so each iteration
+    # recomputes 3 mat-vecs instead of the full C^2 matmul (which made
+    # the 16+4 build take minutes)
+    cap = c + int(a.sum())
+    cols = np.zeros((r, cap), dtype=bool)
+    cols[:, :c] = a.astype(bool)
+    m = np.zeros((cap, cap), dtype=np.int32)
+    live = c
+    m[:c, :c] = cols[:, :c].T.astype(np.int32) @ \
+        cols[:, :c].astype(np.int32)
+    np.fill_diagonal(m, 0)
+    ops: list[tuple[int, int, int]] = []
+    while True:
+        sub = m[:live, :live]
+        best = int(sub.argmax())
+        i, j = divmod(best, live)
+        if sub[i, j] < 2:
+            break  # no pair shared by 2+ rows: chains are optimal now
+        new = live
+        both = cols[:, i] & cols[:, j]
+        cols[both, i] = False
+        cols[both, j] = False
+        cols[:, new] = both
+        live += 1
+        ci = cols[:, :live].astype(np.int32)
+        for v in (i, j, new):
+            mv = ci.T @ ci[:, v]
+            mv[v] = 0
+            m[v, :live] = mv
+            m[:live, v] = mv
+        ops.append((new, int(i), int(j)))
+    outs = tuple(tuple(int(v) for v in np.nonzero(row[:live])[0])
+                 for row in cols)
+    return tuple(ops), outs

@@ -250,80 +250,88 @@ def apply_bitmatrix(
 # (ec-method.c:393-433): fragment f = its 512-byte chunk from every stripe.
 # ---------------------------------------------------------------------------
 
-_FUSED_TS = 128  # stripes per grid step (measured best on v5e, k=4)
+_FUSED_TS = 256  # stripes per grid step (measured best on v5e)
 
-# Per-config tiles from an on-chip sweep (v5e, 64 MiB batches, quiet
-# host, best of ts in {16,32,48,64,96,128}): wide-k kernels have much
-# larger per-step working sets, so SMALLER stripe tiles pipeline
-# better — encode 8+3: 46.7 GiB/s @16 vs 38.3 @128; 16+4 encode
-# 28.2 @16 vs 20.2 @128; 16+4 decode 92.5 @32 vs 62.5 @128.
+# Per-config tiles from an on-chip sweep of the TRANSPOSED program
+# kernels (v5e, best of ts in {64,128,256,512}): encode/decode 4+2
+# 109-118 GiB/s @256-512, 8+4 111/123 @256; k=16's larger per-step
+# working set needs ts=128 (256 exceeds scoped VMEM).
 
 
 def _enc_ts(k: int) -> int:
-    return 16 if k >= 8 else _FUSED_TS
+    return 128 if k >= 16 else _FUSED_TS
 
 
-def _dec_ts(k: int) -> int:
-    if k >= 8:
-        return 64 if k == 8 else 32
-    return _FUSED_TS
+_dec_ts = _enc_ts
 
 
-def _fused_encode_kernel(sels: tuple[tuple[int, ...], ...], k: int, n: int):
+def _program_encode_kernel(ops: tuple, outs: tuple, k: int, n: int):
+    """Straight-line XOR program body (gf256.xor_program): shared
+    subexpressions are computed ONCE per grid step instead of once per
+    output plane — these kernels are VPU-throughput-bound, so the
+    ~2.7x XOR-count cut is ~the speedup.
+
+    Transposed geometry: the wire layout's 64-byte bit-plane words
+    sliced stripe-major are (ts, 64) values — HALF of every 128-lane
+    vreg idle.  One in-VMEM transpose per block turns every program
+    variable into a (64, ts) full-lane tile, doubling VPU utilization
+    (measured: 16+4 encode 38 -> 79 GiB/s)."""
+
     def kernel(x_ref, o_ref):
-        x = x_ref[:]  # (ts, k*512) stripe-major
-        planes = [x[:, j * 64:(j + 1) * 64] for j in range(k * 8)]
+        xt = x_ref[:].T  # (k*512, ts): planes are (64, ts) full tiles
+        t = [xt[j * 64:(j + 1) * 64, :] for j in range(k * 8)]
+        for dst, a, b in ops:
+            t.append(t[a] ^ t[b])  # dst ids are dense: dst == len(t)
         for f in range(n):
             accs = []
             for b in range(8):
-                sel = sels[f * 8 + b]
-                acc = planes[sel[0]]
-                for j in sel[1:]:
-                    acc = acc ^ planes[j]
+                o = outs[f * 8 + b]
+                acc = t[o[0]]
+                for v in o[1:]:
+                    acc = acc ^ t[v]
                 accs.append(acc)
-            o_ref[f] = jnp.concatenate(accs, axis=1)  # (ts, 512)
+            o_ref[f] = jnp.concatenate(accs, axis=0).T  # (ts, 512)
 
     return kernel
 
 
-def _fused_decode_kernel(sels: tuple[tuple[int, ...], ...], k: int,
-                         ncols: int | None = None):
-    ncols = k if ncols is None else ncols
+def _program_decode_kernel(ops: tuple, outs: tuple, k: int):
+    """Decode body, same transposed program geometry as encode."""
 
     def kernel(x_ref, o_ref):
         # one wide value first: lane-slicing from k separate (ts, 512)
         # block values generates markedly slower code
-        x = jnp.concatenate([x_ref[f] for f in range(k)], axis=1)
-        planes = [x[:, j * 64:(j + 1) * 64] for j in range(k * 8)]
-        for c in range(ncols):
-            accs = []
+        xt = jnp.concatenate([x_ref[f] for f in range(k)], axis=1).T
+        t = [xt[j * 64:(j + 1) * 64, :] for j in range(k * 8)]
+        for dst, a, b in ops:
+            t.append(t[a] ^ t[b])
+        cols = []
+        for c in range(k):
             for b in range(8):
-                sel = sels[c * 8 + b]
-                acc = planes[sel[0]]
-                for j in sel[1:]:
-                    acc = acc ^ planes[j]
-                accs.append(acc)
-            o_ref[:, c * 512:(c + 1) * 512] = jnp.concatenate(accs, axis=1)
+                o = outs[c * 8 + b]
+                acc = t[o[0]]
+                for v in o[1:]:
+                    acc = acc ^ t[v]
+                cols.append(acc)
+        o_ref[:] = jnp.concatenate(cols, axis=0).T  # (ts, k*512)
 
     return kernel
 
 
-# past this many unrolled XOR selections per kernel body the TPU
-# compiler keels over (observed: 16+4 fails, 8+4 fine) — split the
-# output fragments across multiple pallas calls instead
-_MAX_SELS_PER_KERNEL = 100
-
-
 @functools.lru_cache(maxsize=64)
 def _fused_encode_fn(k: int, n: int, interpret: bool):
-    """jitted: flat stripe-major bytes (S*k*512,) -> fragments (n, S*512)."""
-    sels = _sels_from_bits(gf256.expand_bitmatrix(gf256.encode_matrix(k, n)))
+    """jitted: flat stripe-major bytes (S*k*512,) -> fragments (n, S*512).
+
+    The kernel body executes the CSE'd straight-line XOR program
+    (gf256.xor_program, ~0.4x the naive chain count) in ONE pallas
+    call: shared intermediates span every output fragment, so the old
+    wide-k group split (one call per fragment group, each re-reading
+    the input because the naive unroll blew the compiler's appetite)
+    would forfeit most of the sharing."""
+    abits = gf256.expand_bitmatrix(gf256.encode_matrix(k, n))
+    ops, outs = gf256.xor_program(tuple(map(tuple, abits.tolist())))
     ts = _enc_ts(k)
-    group = max(1, _MAX_SELS_PER_KERNEL // (8 * max(1, k // 8)))
-    groups = [(f0, min(f0 + group, n)) for f0 in range(0, n, group)] \
-        if k > 8 else [(0, n)]
-    kernels = [(_fused_encode_kernel(sels[f0 * 8:f1 * 8], k, f1 - f0),
-                f0, f1) for f0, f1 in groups]
+    kernel = _program_encode_kernel(ops, outs, k, n)
 
     @jax.jit
     def run(flat):
@@ -332,22 +340,17 @@ def _fused_encode_fn(k: int, n: int, interpret: bool):
         x = flat.reshape(s, k * gf256.CHUNK_SIZE)
         if sp != s:
             x = jnp.pad(x, ((0, sp - s), (0, 0)))
-        parts = []
-        for kernel, f0, f1 in kernels:
-            g = f1 - f0
-            parts.append(pl.pallas_call(
-                kernel,
-                out_shape=jax.ShapeDtypeStruct((g, sp, 512), jnp.uint8),
-                grid=(sp // ts,),
-                in_specs=[pl.BlockSpec((ts, k * 512), lambda i: (i, 0),
-                                       memory_space=pltpu.VMEM)],
-                out_specs=pl.BlockSpec((g, ts, 512),
-                                       lambda i: (0, i, 0),
-                                       memory_space=pltpu.VMEM),
-                interpret=interpret,
-            )(x))
-        out = parts[0] if len(parts) == 1 else \
-            jnp.concatenate(parts, axis=0)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n, sp, 512), jnp.uint8),
+            grid=(sp // ts,),
+            in_specs=[pl.BlockSpec((ts, k * 512), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((n, ts, 512),
+                                   lambda i: (0, i, 0),
+                                   memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(x)
         return out[:, :s, :].reshape(n, s * gf256.CHUNK_SIZE)
 
     return run
@@ -358,14 +361,13 @@ def _fused_decode_fn(k: int, rows: tuple[int, ...], interpret: bool):
     """jitted: survivors (k, S*512) fragment-major -> flat bytes (S*k*512,).
 
     One jitted decoder per surviving mask (the LRU here mirrors the
-    reference's LRU of inverted matrices, ec-method.c:200-245)."""
-    sels = _sels_from_bits(gf256.decode_bits_cached(k, rows))
+    reference's LRU of inverted matrices, ec-method.c:200-245); the
+    body runs the CSE'd XOR program in one pallas call (see
+    _fused_encode_fn)."""
+    bbits = gf256.decode_bits_cached(k, rows)
+    ops, outs = gf256.xor_program(tuple(map(tuple, bbits.tolist())))
     ts = _dec_ts(k)
-    group = max(1, _MAX_SELS_PER_KERNEL // (8 * max(1, k // 8)))
-    groups = [(c0, min(c0 + group, k)) for c0 in range(0, k, group)] \
-        if k > 8 else [(0, k)]
-    kernels = [(_fused_decode_kernel(sels[c0 * 8:c1 * 8], k, c1 - c0),
-                c0, c1) for c0, c1 in groups]
+    kernel = _program_decode_kernel(ops, outs, k)
 
     @jax.jit
     def run(frags):
@@ -374,22 +376,17 @@ def _fused_decode_fn(k: int, rows: tuple[int, ...], interpret: bool):
         x = frags.reshape(k, s, 512)
         if sp != s:
             x = jnp.pad(x, ((0, 0), (0, sp - s), (0, 0)))
-        parts = []
-        for kernel, c0, c1 in kernels:
-            g = c1 - c0
-            parts.append(pl.pallas_call(
-                kernel,
-                out_shape=jax.ShapeDtypeStruct((sp, g * 512), jnp.uint8),
-                grid=(sp // ts,),
-                in_specs=[pl.BlockSpec((k, ts, 512),
-                                       lambda i: (0, i, 0),
-                                       memory_space=pltpu.VMEM)],
-                out_specs=pl.BlockSpec((ts, g * 512), lambda i: (i, 0),
-                                       memory_space=pltpu.VMEM),
-                interpret=interpret,
-            )(x))
-        out = parts[0] if len(parts) == 1 else \
-            jnp.concatenate(parts, axis=1)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((sp, k * 512), jnp.uint8),
+            grid=(sp // ts,),
+            in_specs=[pl.BlockSpec((k, ts, 512),
+                                   lambda i: (0, i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((ts, k * 512), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(x)
         return out[:s].reshape(s * k * gf256.CHUNK_SIZE)
 
     return run
@@ -454,17 +451,6 @@ def _decode_fn(k: int, formulation: str, interpret: bool,
         bb = np.array(static_bbits, dtype=np.uint8)
         return jax.jit(lambda frags: run(frags, bb))
     return jax.jit(run)
-
-
-# Wide-k encode is better served by the MXU than by unrolled XOR
-# chains: at k=16 the XOR form is compute-bound (~160 output bit-planes
-# x ~64 terms each on the VPU, split over 4 pallas calls that each
-# re-read the input because the unroll exceeds the compiler's
-# appetite), while the (n*8, k*8) binary matmul is nearly free on the
-# MXU even paying the transpose sandwich — measured 38 vs 28 GiB/s for
-# 16+4 on v5e.  The ROUTING decision lives in ops/codec.py's auto
-# path; an explicit formulation request here is honored as written.
-_ENC_MXU_MIN_K = 16
 
 
 def encode(data, k: int, n: int, formulation: str = "fused",
