@@ -58,6 +58,7 @@ class ChangelogLayer(Layer):
         self._seq = 0
         self._fh = None
         self._opened_at = 0.0
+        self._start_ts = 0.0
         self.records = 0
 
     async def init(self):
@@ -74,8 +75,64 @@ class ChangelogLayer(Layer):
         self._seq = max((int(n.rsplit(".", 1)[1])
                          for n in os.listdir(self._dir)
                          if n.startswith("CHANGELOG.")), default=0)
+        # journal coverage epoch (the HTIME marker analog): history
+        # queries report it so a consumer asking about a window that
+        # predates the journal knows to fall back to a namespace crawl
+        htime = os.path.join(self._dir, "HTIME")
+        if not os.path.exists(htime):
+            with open(htime, "w") as f:
+                f.write(repr(time.time()))
+        with open(htime) as f:
+            self._start_ts = float(f.read().strip() or 0)
         self._roll()  # fresh segment per process lifetime
         await super().init()
+
+    # -- history API (gf-history-changelog.c + changelog-rpc.c: a
+    # bounded time-window query served to consumers over the brick's
+    # RPC — a remote glusterfind/gsyncd can follow a brick it can only
+    # reach over the wire) --------------------------------------------
+
+    async def changelog_history(self, since: float, until: float,
+                                max_records: int = 100000) -> dict:
+        """Records with since < ts <= until, time-ordered, capped at
+        ``max_records`` (``truncated`` tells the consumer to re-query
+        from the last record's ts).  ``start_ts`` is the journal's
+        coverage epoch — a ``since`` before it means the window is NOT
+        fully covered by changelogs (changelog_history() in the
+        reference returns ENOENT for such windows)."""
+
+        def scan():
+            recs: list[dict] = []
+            truncated = False
+            names = sorted(
+                (n for n in os.listdir(self._dir)
+                 if n.startswith("CHANGELOG.")),
+                key=lambda n: int(n.rsplit(".", 1)[1]))
+            for name in names:
+                try:
+                    with open(os.path.join(self._dir, name)) as f:
+                        for line in f:
+                            try:
+                                r = json.loads(line)
+                            except ValueError:
+                                continue
+                            if since < r.get("ts", 0) <= until:
+                                if len(recs) >= max_records:
+                                    truncated = True
+                                    break
+                                recs.append(r)
+                except OSError:
+                    continue
+                if truncated:
+                    break
+            recs.sort(key=lambda r: r.get("ts", 0))
+            return recs, truncated
+
+        import asyncio
+
+        recs, truncated = await asyncio.to_thread(scan)
+        return {"start_ts": self._start_ts, "records": recs,
+                "truncated": truncated}
 
     async def fini(self):
         if self._fh is not None:

@@ -1416,7 +1416,31 @@ class Glusterd:
                     dht = next(
                         (l for l in client.graph.by_name.values()
                          if isinstance(l, DistributeLayer)), None)
-                    out = await dht.rebalance("/") if dht else {}
+                    if dht is not None:
+                        # publish LIVE defrag progress while the walk
+                        # runs (the reference's rebalance process
+                        # reports through the defrag status op)
+                        task = asyncio.ensure_future(
+                            dht.rebalance("/"))
+                        try:
+                            while not task.done():
+                                rb["progress"] = dict(dht.rebal_status)
+                                await asyncio.sleep(0.2)
+                            out = task.result()
+                        finally:
+                            # a cancelled poll must not orphan the
+                            # walk: its migrations would keep running
+                            # against the client we unmount below
+                            if not task.done():
+                                task.cancel()
+                                try:
+                                    await task
+                                except (asyncio.CancelledError,
+                                        Exception):
+                                    pass
+                        rb["progress"] = dict(dht.rebal_status)
+                    else:
+                        out = {}
                 finally:
                     await client.unmount()
                 rb["moved"] = len(out.get("moved", ()))
@@ -2224,9 +2248,15 @@ class Glusterd:
         local = [b for b in vol["bricks"] if b["node"] == self.uuid]
         if not local:
             return  # no journals on this node
-        dirs = ",".join(
-            os.path.join(b["path"], ".glusterfs_tpu", "changelog")
-            for b in local)
+        # per-brick worker monitor (monitor.py:63-85): brick specs as
+        # name=index=path; the subvolume group size drives the
+        # Active/Passive election inside replica/disperse sets
+        bricks = ",".join(
+            f"{b['name']}={b['index']}={b['path']}" for b in local)
+        if vol["type"] in ("replicate", "disperse"):
+            gsize = int(vol.get("group-size") or len(vol["bricks"]))
+        else:
+            gsize = 1
         state = os.path.join(self.workdir, f"gsync-{name}.state")
         statusfile = os.path.join(self.workdir, f"gsync-{name}.json")
         interval = float(vol.get("options", {}).get(
@@ -2240,7 +2270,8 @@ class Glusterd:
                 [sys.executable, "-m", "glusterfs_tpu.mgmt.gsyncd",
                  "--primary", f"{self.host}:{self.port}:{name}",
                  "--secondary", geo["secondary"],
-                 "--changelogs", dirs, "--state", state,
+                 "--bricks", bricks, "--group-size", str(gsize),
+                 "--state", state,
                  "--interval", str(interval),
                  "--statusfile", statusfile],
                 env=env, stdout=subprocess.DEVNULL, stderr=logf)
@@ -2309,6 +2340,16 @@ class Glusterd:
             "online": proc is not None and proc.poll() is None,
             "last_ts": last_ts,
         }
+        # per-brick worker states from the monitor (monitor.py model:
+        # Active / Passive / Faulty / Offline per brick)
+        try:
+            with open(os.path.join(self.workdir,
+                                   f"gsync-{name}.json")) as f:
+                mon = json.load(f)
+            if mon.get("workers"):
+                sess["workers"] = mon["workers"]
+        except (FileNotFoundError, ValueError):
+            pass
         cp = geo.get("checkpoint")
         if cp:
             sess["checkpoint"] = cp

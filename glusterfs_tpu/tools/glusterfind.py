@@ -82,6 +82,121 @@ def _brick_journal_dirs(vol: dict) -> list[str]:
     return out
 
 
+async def _brick_history(vol: dict, brick: dict, since: float,
+                         until: float) -> dict | None:
+    """Query one brick's changelog history over its RPC (the
+    gf-history-changelog.c consumer contract served by
+    changelog-rpc.c): handshake with the volume's generated
+    credentials, call ``changelog_history``, return its payload.
+    None when the brick is unreachable (caller falls back to reading
+    the journal directory locally, if it can)."""
+    from ..rpc import wire
+
+    port = brick.get("port")
+    if not port:
+        return None
+    host = brick.get("host", "127.0.0.1")
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), 5)
+    except (OSError, asyncio.TimeoutError):
+        return None
+    try:
+        auth = vol.get("auth") or {}
+        creds = {"username": auth.get("mgmt-username",
+                                      auth.get("username", "")),
+                 "password": auth.get("mgmt-password",
+                                      auth.get("password", ""))}
+        writer.write(wire.pack(1, wire.MT_CALL, [
+            "__handshake__", [b"glusterfind", brick.get("name", ""),
+                              creds], {}]))
+        await writer.drain()
+        rec = await asyncio.wait_for(wire.read_frame(reader), 5)
+        _, mtype, payload = wire.unpack(rec)
+        if mtype != wire.MT_REPLY or not payload.get("ok"):
+            return None
+        writer.write(wire.pack(2, wire.MT_CALL, [
+            "changelog_history", [since, until], {}]))
+        await writer.drain()
+        rec = await asyncio.wait_for(wire.read_frame(reader), 30)
+        _, mtype, payload = wire.unpack(rec)
+        if mtype != wire.MT_REPLY:
+            return None
+        return payload
+    except (OSError, asyncio.TimeoutError, wire.WireError):
+        return None
+    finally:
+        try:
+            writer.close()
+        except Exception:
+            pass
+
+
+async def _collect(server: str, vol: dict, since: float,
+                   until: float) -> tuple[list[dict], bool]:
+    """(records, covered): per-brick history via RPC first — a brick on
+    another node is reachable over the wire only — falling back to
+    reading its journal directory when the brick process is down but
+    its path is local.  ``covered`` is False when any brick's journal
+    epoch postdates ``since`` (window not fully recorded: the caller
+    must full-crawl, reference brickfind.py)."""
+    recs: list[dict] = []
+    covered = True
+    for b in vol.get("bricks", []):
+        payload = await _brick_history(vol, b, since, until)
+        if payload is not None:
+            recs.extend(payload.get("records", ()))
+            start = payload.get("start_ts")
+            if start is None or start > since:
+                covered = False
+            while payload.get("truncated"):
+                last = payload["records"][-1]["ts"]
+                payload = await _brick_history(vol, b, last, until)
+                if payload is None:
+                    break
+                recs.extend(payload.get("records", ()))
+            continue
+        d = os.path.join(b["path"], ".glusterfs_tpu", "changelog")
+        if os.path.isdir(d):
+            recs.extend(_scan([d], since, until))
+            htime = os.path.join(d, "HTIME")
+            try:
+                with open(htime) as f:
+                    if float(f.read().strip() or 0) > since:
+                        covered = False
+            except (OSError, ValueError):
+                covered = False
+        else:
+            covered = False
+    recs.sort(key=lambda r: r.get("ts", 0))
+    return recs, covered
+
+
+async def _full_crawl(server: str, volume: str) -> list[tuple[str, ...]]:
+    """Namespace walk emitting NEW for every entry (the brickfind.py
+    fallback for sessions/windows predating changelogs) — done through
+    a mounted client so distribution/EC layouts are walked exactly
+    once, not once per brick."""
+    from ..mgmt.glusterd import mount_volume
+
+    host, _, port = server.partition(":")
+    client = await mount_volume(host or "127.0.0.1", int(port or 24007),
+                                volume)
+    out: list[tuple[str, ...]] = []
+    try:
+        stack = ["/"]
+        while stack:
+            d = stack.pop()
+            for name, ia in await client.listdir_with_stat(d):
+                path = (d if d != "/" else "") + "/" + name
+                out.append(("NEW", path))
+                if getattr(ia.ia_type, "name", "") == "DIR":
+                    stack.append(path)
+    finally:
+        await client.unmount()
+    return out
+
+
 def _scan(dirs: list[str], since: float, until: float) -> list[dict]:
     """All journal records with since < ts <= until, time-ordered."""
     recs: list[dict] = []
@@ -211,12 +326,20 @@ async def cmd_pre(args) -> dict:
         raise SystemExit(f"session {args.session!r} not created for "
                          f"{args.volume!r} (run create first)")
     now = time.time()
-    recs = _scan(_brick_journal_dirs(vol), since, now)
-    changes = coalesce(recs)
+    recs, covered = await _collect(args.server, vol, since, now)
+    if covered:
+        changes = coalesce(recs)
+        mode = "changelog"
+    else:
+        # window predates the journals (session created after data
+        # already existed, or changelog enabled late): full namespace
+        # crawl, everything NEW (reference brickfind fallback)
+        changes = await _full_crawl(args.server, args.volume)
+        mode = "full-crawl"
     _emit(args.outfile, changes)
     _write_ts(os.path.join(sp, "pending"), now)
     return {"changes": len(changes), "outfile": args.outfile,
-            "since": since}
+            "since": since, "mode": mode}
 
 
 async def cmd_post(args) -> dict:
@@ -231,10 +354,17 @@ async def cmd_post(args) -> dict:
 
 async def cmd_query(args) -> dict:
     vol = await _volinfo(args.server, args.volume)
-    recs = _scan(_brick_journal_dirs(vol), args.since_time, time.time())
-    changes = coalesce(recs)
+    recs, covered = await _collect(args.server, vol, args.since_time,
+                                   time.time())
+    if covered or not args.full_fallback:
+        changes = coalesce(recs)
+        mode = "changelog"
+    else:
+        changes = await _full_crawl(args.server, args.volume)
+        mode = "full-crawl"
     _emit(args.outfile, changes)
-    return {"changes": len(changes), "outfile": args.outfile}
+    return {"changes": len(changes), "outfile": args.outfile,
+            "mode": mode}
 
 
 async def cmd_list(args) -> dict:
@@ -274,6 +404,9 @@ def main(argv=None) -> int:
             sp.add_argument("outfile")
         if name == "query":
             sp.add_argument("--since-time", type=float, required=True)
+            sp.add_argument("--full-fallback", action="store_true",
+                            help="namespace-crawl when the window "
+                                 "predates the changelogs")
     args = p.parse_args(argv)
     fn = globals()[f"cmd_{args.cmd}"]
     out = asyncio.run(fn(args))

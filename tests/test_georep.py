@@ -43,6 +43,8 @@ def _records(brick):
     d = _cl_dir(brick)
     out = []
     for n in sorted(os.listdir(d)):
+        if not n.startswith("CHANGELOG."):
+            continue  # HTIME coverage marker etc.
         with open(os.path.join(d, n)) as f:
             out += [json.loads(l) for l in f.read().splitlines()]
     return out
@@ -260,6 +262,96 @@ def test_e2e_georep_through_glusterd(tmp_path):
                             break
                         await asyncio.sleep(0.5)
                     assert done, "checkpoint never completed"
+            finally:
+                await pc.unmount()
+                await sc.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_georep_per_brick_failover(tmp_path):
+    """Monitor model (reference monitor.py:63-85,299): one worker per
+    local brick, one ACTIVE per replica set.  Kill the active worker's
+    brick mid-replication — a peer brick's worker takes over and the
+    secondary converges on changes made after the failover."""
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="pri",
+                             vtype="replicate",
+                             bricks=[{"path": str(tmp_path / f"pb{i}")}
+                                     for i in range(3)], group_size=3)
+                await c.call("volume-create", name="sec",
+                             vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "sb")}],
+                             redundancy=0)
+                await c.call("volume-set", name="pri",
+                             key="georep.sync-interval", value="0.5")
+                await c.call("volume-start", name="pri")
+                await c.call("volume-start", name="sec")
+                await c.call("georep-create", name="pri",
+                             secondary=f"{d.host}:{d.port}:sec")
+                await c.call("georep-start", name="pri")
+
+            pc = await mount_volume(d.host, d.port, "pri")
+            sc = await mount_volume(d.host, d.port, "sec")
+            try:
+                await pc.write_file("/before", b"pre-failover")
+                for _ in range(120):
+                    try:
+                        if await sc.read_file("/before") == \
+                                b"pre-failover":
+                            break
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.5)
+                else:
+                    raise AssertionError("never synced pre-failover")
+
+                # exactly one Active worker in the replica set
+                async with MgmtClient(d.host, d.port) as c:
+                    st = await c.call("georep-status", name="pri")
+                workers = st["sessions"][0].get("workers") or {}
+                active = [n for n, w in workers.items()
+                          if w["state"] == "Active"]
+                assert len(active) == 1, workers
+                victim = active[0]
+
+                # kill the ACTIVE brick's process (not via glusterd
+                # stop: a real crash)
+                proc = d.bricks[victim]
+                proc.terminate()
+                proc.wait(timeout=10)
+
+                # volume stays writable (2/3 replicas); the monitor
+                # must fail replication over to a surviving brick
+                await asyncio.sleep(1.0)
+                await pc.write_file("/after", b"post-failover")
+                for _ in range(120):
+                    try:
+                        if await sc.read_file("/after") == \
+                                b"post-failover":
+                            break
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.5)
+                else:
+                    raise AssertionError("no failover: post-failover "
+                                         "write never synced")
+                async with MgmtClient(d.host, d.port) as c:
+                    st = await c.call("georep-status", name="pri")
+                workers = st["sessions"][0].get("workers") or {}
+                active2 = [n for n, w in workers.items()
+                           if w["state"] == "Active"]
+                assert active2 and active2[0] != victim, workers
             finally:
                 await pc.unmount()
                 await sc.unmount()

@@ -140,3 +140,113 @@ def test_coalesce_rename_replica_echo():
     # echoed rename of a pre-existing file stays one RENAME
     recs = [_r("rename", "/x", 1, "/y"), _r("rename", "/x", 1.01, "/y")]
     assert coalesce(recs) == [("RENAME", "/x", "/y")]
+
+
+@pytest.mark.slow
+def test_glusterfind_history_over_rpc_only(tmp_path, monkeypatch):
+    """Changelog history reaches glusterfind through the brick RPC (the
+    gf-history-changelog.c + changelog-rpc.c contract): with local
+    journal reading disabled entirely, pre still lists the changes."""
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+    from glusterfs_tpu.tools import glusterfind as gf
+    import argparse
+
+    async def run():
+        gd = Glusterd(str(tmp_path / "gd"))
+        await gd.start()
+        async with MgmtClient(gd.host, gd.port) as c:
+            bricks = [{"path": str(tmp_path / f"b{i}")} for i in range(2)]
+            await c.call("volume-create", name="rv", vtype="replicate",
+                         bricks=bricks, group_size=2)
+            await c.call("volume-start", name="rv")
+
+        def ns(**kw):
+            return argparse.Namespace(
+                server=f"{gd.host}:{gd.port}",
+                session_dir=str(tmp_path / "sessions"), **kw)
+
+        await gf.cmd_create(ns(session="s", volume="rv"))
+        cl = await mount_volume(gd.host, gd.port, "rv")
+        from glusterfs_tpu.core.layer import walk
+        subs = [l for l in walk(cl.graph.top)
+                if l.type_name == "protocol/client"]
+        for _ in range(150):
+            if all(l.connected for l in subs):
+                break
+            await asyncio.sleep(0.1)
+        await cl.write_file("/wire-only", b"x")
+        await asyncio.sleep(0.05)
+
+        # sever the local path: any attempt to read a journal from disk
+        # blows up — the records can only have crossed the brick RPC
+        def boom(*a, **k):
+            raise AssertionError("local journal read attempted")
+        monkeypatch.setattr(gf, "_scan", boom)
+
+        out = str(tmp_path / "pre.txt")
+        r = await gf.cmd_pre(ns(session="s", volume="rv", outfile=out))
+        assert r["mode"] == "changelog"
+        assert "NEW /wire-only" in open(out).read().splitlines()
+
+        await cl.unmount()
+        await gd.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_glusterfind_full_crawl_fallback(tmp_path):
+    """A session created AFTER data already exists (changelog enabled
+    late) cannot be served from the journals — pre falls back to the
+    namespace crawl and lists everything as NEW (reference
+    tools/glusterfind/src/brickfind.py)."""
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+    from glusterfs_tpu.tools import glusterfind as gf
+    import argparse
+
+    async def run():
+        gd = Glusterd(str(tmp_path / "gd"))
+        await gd.start()
+        async with MgmtClient(gd.host, gd.port) as c:
+            bricks = [{"path": str(tmp_path / f"b{i}")} for i in range(2)]
+            await c.call("volume-create", name="cv", vtype="replicate",
+                         bricks=bricks, group_size=2)
+            await c.call("volume-start", name="cv")
+
+        # data lands BEFORE the session (and before changelog exists)
+        cl = await mount_volume(gd.host, gd.port, "cv")
+        from glusterfs_tpu.core.layer import walk
+        subs = [l for l in walk(cl.graph.top)
+                if l.type_name == "protocol/client"]
+        for _ in range(150):
+            if all(l.connected for l in subs):
+                break
+            await asyncio.sleep(0.1)
+        await cl.write_file("/old-one", b"1")
+        await cl.mkdir("/olddir")
+        await cl.write_file("/olddir/old-two", b"2")
+        await cl.unmount()
+
+        def ns(**kw):
+            return argparse.Namespace(
+                server=f"{gd.host}:{gd.port}",
+                session_dir=str(tmp_path / "sessions"), **kw)
+
+        await gf.cmd_create(ns(session="late", volume="cv"))
+        # the session's epoch is "now", but the journals started even
+        # later (create enabled them): force the uncovered window by
+        # rewinding the committed timestamp to before the volume's data
+        sp = gf._session_path(str(tmp_path / "sessions"), "late", "cv")
+        gf._write_ts(os.path.join(sp, "status"), 1.0)
+
+        out = str(tmp_path / "pre.txt")
+        r = await gf.cmd_pre(ns(session="late", volume="cv", outfile=out))
+        assert r["mode"] == "full-crawl", r
+        lines = set(open(out).read().splitlines())
+        assert {"NEW /old-one", "NEW /olddir", "NEW /olddir/old-two"} \
+            <= lines, lines
+        await gd.stop()
+
+    asyncio.run(run())
