@@ -14,6 +14,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -103,7 +104,8 @@ def _timed_call(fn, arg) -> float:
     return time.perf_counter() - t0
 
 
-def _on_mounted_volume(body, backend: str, groups: int = 1):
+def _on_mounted_volume(body, backend: str, groups: int = 1,
+                       extra_options: dict | None = None):
     """Shared bench harness: build a (possibly distributed-) 4+2
     volume with the stripe-cache window on, mount, run ``body(c)``,
     tear down.  One copy of the scaffolding for every volume bench."""
@@ -117,7 +119,8 @@ def _on_mounted_volume(body, backend: str, groups: int = 1):
 
     base = tempfile.mkdtemp(prefix="ecbench")
     spec = ec_volfile(base, N, R, options={
-        "cpu-extensions": backend, "stripe-cache": "on"}, groups=groups)
+        "cpu-extensions": backend, "stripe-cache": "on",
+        **(extra_options or {})}, groups=groups)
 
     async def run():
         c = Client(Graph.construct(spec))
@@ -135,7 +138,8 @@ def _on_mounted_volume(body, backend: str, groups: int = 1):
 
 def volume_bench(n_clients: int = 16, file_mib: int = 1,
                  backend: str = "auto", prefix: str = "volume",
-                 passes: int = 2) -> dict:
+                 passes: int = 2,
+                 extra_options: dict | None = None) -> dict:
     """e2e served-data-path number: n concurrent clients writing then
     reading 1 MiB files on an in-process 4+2 volume with the stripe-cache
     batching window on — measures the coalesced regime the north star
@@ -173,9 +177,11 @@ def volume_bench(n_clients: int = 16, file_mib: int = 1,
             stats[key] -= warm.get(key, 0)
         return t_w, t_r, stats
 
-    t_w, t_r, stats = _on_mounted_volume(body, backend)
+    t_w, t_r, stats = _on_mounted_volume(body, backend,
+                                         extra_options=extra_options)
     for _ in range(max(1, passes) - 1):
-        w2, r2, s2 = _on_mounted_volume(body, backend)
+        w2, r2, s2 = _on_mounted_volume(body, backend,
+                                        extra_options=extra_options)
         if w2 + r2 < t_w + t_r:
             t_w, t_r, stats = w2, r2, s2
     total = n_clients * file_mib
@@ -322,6 +328,17 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1) -> dict:
                              key="disperse.stripe-cache", value="on")
             cl = await mount_volume(d.host, d.port, "bw")
             try:
+                # calibrate the stripe-cache router OFF the clock: its
+                # first device probe pays jax imports + kernel compiles
+                # that would otherwise monopolize the shared core inside
+                # the measured window
+                from glusterfs_tpu.core.layer import walk
+
+                for layer in walk(cl.graph.top):
+                    cal = getattr(getattr(layer, "codec", None),
+                                  "ensure_calibrated", None)
+                    if cal is not None:
+                        await cal()
                 await cl.write_file("/warm", payload)  # jit + fd warm
                 await cl.read_file("/warm")
                 t0 = time.perf_counter()
@@ -402,17 +419,25 @@ def main() -> None:
     on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
     backend = "pallas-xor" if on_tpu else "xla"
 
-    # The device/tunnel is sometimes cold or contended for a whole
-    # measurement pass (observed: 28x slow for ~1 min after idle, then
-    # normal).  Take the best of several passes, separated by short
-    # sleeps, so one bad window cannot tank the recorded number.
-    def best_of(measure, passes: int = 3, settle_s: float = 3.0) -> float:
-        best = measure()
+    # The device/tunnel is POOL-SHARED: measured kernel rates swing ~2x
+    # on minute timescales with identical code (r3->r4 bisect: the
+    # kernel diff between the 180 GB/s and 98 GB/s decode recordings
+    # was a comment; an 8-pass probe on one quiet host spanned
+    # 40-118 GB/s encode / 46-146 GB/s decode).  Take the best of
+    # several spaced passes — contention is not the kernel's property —
+    # and RECORD the per-pass spread so a future "regression" can be
+    # told apart from an unlucky window (VERDICT r3 weak #1).
+    pass_log: dict[str, list[float]] = {}
+
+    def best_of(measure, passes: int = 3, settle_s: float = 3.0,
+                tag: str | None = None) -> float:
+        times = [measure()]
         for _ in range(passes - 1):
             time.sleep(settle_s)
-            t = measure()
-            best = min(best, t)
-        return best
+            times.append(measure())
+        if tag is not None:
+            pass_log[tag] = sorted(times)
+        return min(times)
 
     # --- TPU path: device-resident batches -------------------------------
     if on_tpu:
@@ -421,7 +446,8 @@ def main() -> None:
         enc_fn = gf256_xla._encode_fn(K, N, "matmul")
     ddata = jnp.asarray(data)
     frags_dev = jax.block_until_ready(enc_fn(ddata))
-    enc_t = best_of(lambda: device_loop_seconds(enc_fn, ddata))
+    enc_t = best_of(lambda: device_loop_seconds(enc_fn, ddata), 4,
+                    tag="encode")
     enc_mibs = DATA_BYTES / MIB / enc_t
 
     frags_np = np.asarray(frags_dev)
@@ -438,7 +464,8 @@ def main() -> None:
         dec_fn = lambda s: raw(s, bbits_d)
     out_np = np.asarray(dec_fn(surv))
     assert np.array_equal(out_np, data), "decode parity failure"
-    dec_t = best_of(lambda: device_loop_seconds(dec_fn, surv))
+    dec_t = best_of(lambda: device_loop_seconds(dec_fn, surv), 4,
+                    tag="decode")
     dec_mibs = DATA_BYTES / MIB / dec_t
 
     # --- AVX baseline ----------------------------------------------------
@@ -467,12 +494,9 @@ def main() -> None:
         for sk, sr in ((8, 3), (8, 4), (16, 4)):
             sn = sk + sr
             if on_tpu:
-                # the PRODUCTION routing: wide k rides the MXU sandwich,
-                # narrow k the fused XOR kernels (gf256_pallas.encode)
-                if sk >= gf256_pallas._ENC_MXU_MIN_K:
-                    efn = gf256_pallas._encode_fn(sk, sn, "mxu", False)
-                else:
-                    efn = gf256_pallas._fused_encode_fn(sk, sn, False)
+                # the PRODUCTION path at every geometry: transposed
+                # CSE'd XOR program kernels (gf256.xor_program)
+                efn = gf256_pallas._fused_encode_fn(sk, sn, False)
             else:
                 efn = gf256_xla._encode_fn(sk, sn, "matmul")
             sd = jnp.asarray(sdata)
@@ -499,9 +523,7 @@ def main() -> None:
                 "encode_vs_avx_model": round(
                     sweep_bytes / MIB / et /
                     (model_avx_bytes_per_s(sn, sk) / MIB), 2),
-                "encode_form": (
-                    ("mxu" if sk >= gf256_pallas._ENC_MXU_MIN_K
-                     else "xor") if on_tpu else "matmul"),
+                "encode_form": "xor-cse" if on_tpu else "matmul",
             }
         if on_tpu:
             # pallas-mxu validated ON SILICON at the headline config:
@@ -562,6 +584,13 @@ def main() -> None:
     try:
         vol = volume_bench()
         vol.update(volume_bench(backend="native", prefix="volume_native"))
+        # the north-star served-TPU number, ON THE RECORD every round
+        # (VERDICT r3 #4): routing pinned to the device (min-batch 0)
+        # so the tunnel-fed path is measured, not routed around
+        if on_tpu:
+            vol.update(volume_bench(
+                prefix="volume_device", passes=1,
+                extra_options={"stripe-cache-min-batch": "0"}))
     except Exception as e:  # volume bench is auxiliary; never sink the run
         vol["volume_bench_error"] = str(e)[:200]
     try:
@@ -569,15 +598,27 @@ def main() -> None:
     except Exception as e:
         vol["randrw_bench_error"] = str(e)[:200]
     try:
+        # the measured break-even router under mixed load (auto must
+        # not cost vs native when it routes everything to native)
+        ra = randrw_bench(backend="auto")
+        vol["randrw_auto_MiB_s"] = ra["randrw_2x4p2_MiB_s"]
+    except Exception as e:
+        vol["randrw_auto_bench_error"] = str(e)[:200]
+    try:
         vol.update(smallfile_bench())
     except Exception as e:
         vol["smallfile_bench_error"] = str(e)[:200]
+    try:
+        sa = smallfile_bench(backend="auto", passes=1)
+        vol["smallfile_auto_create_per_s"] = sa["smallfile_create_per_s"]
+    except Exception as e:
+        vol["smallfile_auto_bench_error"] = str(e)[:200]
     try:
         vol.update(fullstack_bench())
     except Exception as e:
         vol["fullstack_bench_error"] = str(e)[:200]
 
-    print(json.dumps({
+    result = {
         "metric": "ec_encode_4p2_1MiB_stripes",
         "value": round(enc_mibs, 1),
         "unit": "MiB/s",
@@ -589,9 +630,67 @@ def main() -> None:
         "baseline_encode_MiB_s": round(enc_base, 1),
         "baseline_decode_MiB_s": round(dec_base, 1),
         **{k: round(v, 1) for k, v in base.items()},
+        # per-pass spread of the headline kernel timings: the shared
+        # device swings ~2x between passes — min/median/max lets a
+        # recorded drop be attributed (kernel vs window) after the fact
+        "headline_pass_MiB_s": {
+            tag: {"min": round(DATA_BYTES / MIB / max(times), 1),
+                  "median": round(
+                      DATA_BYTES / MIB / times[len(times) // 2], 1),
+                  "max": round(DATA_BYTES / MIB / min(times), 1)}
+            for tag, times in pass_log.items()},
         "sweep": sweep,
         **vol,
-    }))
+    }
+    result["regressions"] = _regression_gate(result)
+    print(json.dumps(result))
+
+
+def _prev_bench() -> dict | None:
+    """Latest committed BENCH_r*.json parsed row, if any."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")),
+                   key=lambda p: int(re.search(r"r(\d+)", p).group(1)))
+    if not paths:
+        return None
+    try:
+        with open(paths[-1]) as f:
+            doc = json.load(f)
+        return doc.get("parsed") or None
+    except (OSError, ValueError):
+        return None
+
+
+def _regression_gate(result: dict) -> list[dict]:
+    """Flag headline/sweep rows that dropped >10% vs the previous
+    round's recording (VERDICT r3 #1: silent round-over-round kernel
+    regressions).  Informational — the flags land in the recorded JSON
+    where the next round's first look sees them."""
+    prev = _prev_bench()
+    if not prev:
+        return []
+    flags: list[dict] = []
+
+    def check(name: str, new, old) -> None:
+        if isinstance(new, (int, float)) and isinstance(old, (int, float)) \
+                and old > 0 and new < 0.9 * old:
+            flags.append({"row": name, "prev": old, "now": new,
+                          "drop_pct": round(100 * (1 - new / old), 1)})
+
+    check("encode", result.get("value"), prev.get("value"))
+    check("decode", result.get("decode_MiB_s"), prev.get("decode_MiB_s"))
+    psweep = prev.get("sweep") or {}
+    for key, row in (result.get("sweep") or {}).items():
+        prow = psweep.get(key)
+        if isinstance(row, dict) and isinstance(prow, dict):
+            for sub in ("encode_MiB_s", "decode_MiB_s"):
+                check(f"sweep.{key}.{sub}", row.get(sub), prow.get(sub))
+        elif isinstance(row, (int, float)):
+            check(f"sweep.{key}", row, prow)
+    return flags
 
 
 if __name__ == "__main__":
