@@ -92,6 +92,80 @@ def split_gfid_record(content: str) -> tuple[str, str]:
     return inokey, relpath
 
 
+def fold_journal(root: str) -> None:
+    """Materialize a (quiesced/copied) brick store's sidecar journal:
+    xattr records into the per-gfid JSON files, binding records into
+    gfid pointer files.  Only safe on a store no live brick process is
+    appending to (a snapshot copy, a restore target)."""
+    xattr_dir = os.path.join(root, META_DIR, "xattr")
+    gfid_dir = os.path.join(root, META_DIR, "gfid")
+    journal = os.path.join(xattr_dir, "journal.jsonl")
+    if not os.path.exists(journal):
+        return
+    with open(journal) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if "b" in rec:
+                ghex, key, rel = rec["b"]
+                gp = os.path.join(gfid_dir, ghex)
+                # surrogateescape like _gfid_set: non-UTF-8 filenames
+                # round-trip the journal as surrogates and a strict
+                # text write would crash the fold mid-journal
+                fd = os.open(gp + ".tmp",
+                             os.O_CREAT | os.O_WRONLY | os.O_TRUNC,
+                             0o644)
+                try:
+                    os.write(fd, (key + "\n" + rel)
+                             .encode("utf-8", "surrogateescape"))
+                finally:
+                    os.close(fd)
+                os.replace(gp + ".tmp", gp)
+                continue
+            if "u" in rec:
+                try:
+                    os.unlink(os.path.join(gfid_dir, rec["u"]))
+                except OSError:
+                    pass
+                continue
+            p = os.path.join(xattr_dir, rec["g"] + ".json")
+            if rec["x"] is None:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            else:
+                with open(p + ".tmp", "w") as g:
+                    json.dump(rec["x"], g)
+                os.replace(p + ".tmp", p)
+    os.unlink(journal)
+
+
+def _journal_ino_map(xattr_dir: str) -> dict[str, str]:
+    """dev:ino -> gfid hex from a journal's binding records (read-only:
+    for indexing a LIVE source store whose journal we must not fold)."""
+    out: dict[str, str] = {}
+    try:
+        with open(os.path.join(xattr_dir, "journal.jsonl")) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if "b" in rec:
+                    ghex, key, _rel = rec["b"]
+                    out[key] = ghex
+                elif "u" in rec:
+                    dead = rec["u"]
+                    for k in [k for k, v in out.items() if v == dead]:
+                        del out[k]
+    except FileNotFoundError:
+        pass
+    return out
+
+
 def rebuild_identity(root: str) -> int:
     """Re-key a brick store's identity after a file-level copy (snapshot
     restore): the dev:ino sidecars and the handle hardlink farm both
@@ -106,27 +180,12 @@ def rebuild_identity(root: str) -> int:
     handle_dir = os.path.join(root, META_DIR, "handle")
     if not os.path.isdir(gfid_dir):
         return 0
-    # fold any xattr journal into the JSON files first, so the orphan
-    # sweep below sees (and prunes) the real final state
-    journal = os.path.join(xattr_dir, "journal.jsonl")
-    if os.path.exists(journal):
-        with open(journal) as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                p = os.path.join(xattr_dir, rec["g"] + ".json")
-                if rec["x"] is None:
-                    try:
-                        os.unlink(p)
-                    except OSError:
-                        pass
-                else:
-                    with open(p + ".tmp", "w") as g:
-                        json.dump(rec["x"], g)
-                    os.replace(p + ".tmp", p)
-        os.unlink(journal)
+    # fold any sidecar journal into the materialized files first, so
+    # the rebinding walk below sees the real final state.  Binding
+    # records ("b"/"u") materialize as gfid pointer files — the ino-
+    # sidecars they'd also produce are about to be wiped and rebuilt
+    # against the copied inodes anyway.
+    fold_journal(root)
     for d, pred in ((xattr_dir, lambda n: n.startswith("ino-")),
                     (handle_dir, lambda n: True)):
         if os.path.isdir(d):
@@ -191,10 +250,16 @@ def snapshot_copy(src_root: str, dst_root: str) -> None:
 
     shutil.copytree(src_root, dst_root, ignore=_skip_handles,
                     symlinks=True)
+    # the copy carries the source's journal: materialize it in the COPY
+    # (ours to mutate) so the pointer records below exist even for
+    # journal-only bindings; the live source's journal is only INDEXED
+    # in memory for the ino walk
+    fold_journal(dst_root)
     xattr_dir = os.path.join(src_root, META_DIR, "xattr")
     gfid_dir = os.path.join(dst_root, META_DIR, "gfid")
     if not os.path.isdir(xattr_dir) or not os.path.isdir(gfid_dir):
         return
+    ino_map = _journal_ino_map(xattr_dir)
     for dirpath, dirnames, filenames in os.walk(src_root):
         if dirpath == src_root and META_DIR in dirnames:
             dirnames.remove(META_DIR)
@@ -202,12 +267,17 @@ def snapshot_copy(src_root: str, dst_root: str) -> None:
             ap = os.path.join(dirpath, nm)
             try:
                 st = os.lstat(ap)
-                with open(os.path.join(
-                        xattr_dir, f"ino-{st.st_dev}:{st.st_ino}"),
-                        "rb") as f:
-                    hexg = f.read(16).hex()
             except OSError:
                 continue
+            key = f"{st.st_dev}:{st.st_ino}"
+            hexg = ino_map.get(key)
+            if hexg is None:
+                try:
+                    with open(os.path.join(xattr_dir, "ino-" + key),
+                              "rb") as f:
+                        hexg = f.read(16).hex()
+                except OSError:
+                    continue
             rec = os.path.join(gfid_dir, hexg)
             rel = "/" + os.path.relpath(ap, src_root)
             try:
@@ -276,6 +346,14 @@ class PosixLayer(Layer):
         self._xa_cache: dict[bytes, dict] = {}
         self._xa_dirty: set[bytes] = set()
         self._ino_cache: dict[str, bytes] = {}  # "dev:ino" -> gfid
+        # gfid bindings ride the SAME journal (this host's open(2) is
+        # sandbox-priced at ~175us, so the old two-files-per-create
+        # binding dominated the smallfile budget): journal-only until
+        # compaction materializes the ino-/pointer files.  _gfid_mem
+        # holds uncompacted bindings (bounded by the compaction
+        # interval); files stay authoritative for everything older.
+        self._gfid_mem: dict[bytes, tuple[str, str]] = {}
+        self._bind_dirty: set[bytes] = set()
         self._xa_journal_path = os.path.join(self._xattr_dir,
                                              "journal.jsonl")
         self._xa_journal_fd: int | None = None
@@ -404,14 +482,23 @@ class PosixLayer(Layer):
         """Write the gfid pointer file: line 1 = the dev:ino binding key
         (so _gfid_del can clean up the ino- sidecar and inode-number
         reuse can't resurrect a deleted gfid), rest = relpath verbatim
-        (paths may legally contain newlines, so the path goes last)."""
+        (paths may legally contain newlines, so the path goes last).
+        Raw os.open: this sits on the per-create hot path and a
+        buffered file object costs ~3x the syscalls."""
         tmp = self._gfid_path(gfid) + ".tmp"
-        with open(tmp, "w") as f:
-            f.write((inokey or "") + "\n" + relpath)
+        fd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+        try:
+            os.write(fd, ((inokey or "") + "\n" + relpath)
+                     .encode("utf-8", "surrogateescape"))
+        finally:
+            os.close(fd)
         os.replace(tmp, self._gfid_path(gfid))
 
     def _gfid_read(self, gfid: bytes) -> tuple[str, str]:
         """-> (inokey, relpath); raises ESTALE when the gfid is unknown."""
+        ent = self._gfid_mem.get(gfid)
+        if ent is not None:
+            return ent  # journal-only binding (not yet compacted)
         try:
             with open(self._gfid_path(gfid)) as f:
                 return split_gfid_record(f.read())
@@ -451,6 +538,10 @@ class PosixLayer(Layer):
                 os.unlink(os.path.join(self._xattr_dir, "ino-" + inokey))
         except (FopError, FileNotFoundError):
             pass
+        if self._gfid_mem.pop(gfid, None) is not None or \
+                gfid in self._bind_dirty:
+            self._bind_dirty.add(gfid)
+            self._journal_rec({"u": gfid.hex()})
         for p in (self._handle_path(gfid), self._gfid_path(gfid)):
             try:
                 os.unlink(p)
@@ -472,16 +563,27 @@ class PosixLayer(Layer):
             return g
         p = os.path.join(self._xattr_dir, "ino-" + key)
         try:
-            with open(p, "rb") as f:
-                g = f.read(16)
+            fd = os.open(p, os.O_RDONLY)
         except FileNotFoundError:
             return None
+        try:
+            g = os.read(fd, 16)
+        finally:
+            os.close(fd)
         if len(g) != 16:  # torn record from a crash mid-write
             return None
         if len(self._ino_cache) >= self.INO_CACHE_MAX:
-            # shed an arbitrary half: every entry is re-derivable
-            for k in list(self._ino_cache)[: self.INO_CACHE_MAX // 2]:
+            # shed an arbitrary half — but never a journal-only binding
+            # (its ino- file doesn't exist yet; dropping the cache entry
+            # would read as 'unbound' until compaction)
+            shed = 0
+            for k in list(self._ino_cache):
+                if self._ino_cache[k] in self._bind_dirty:
+                    continue
                 del self._ino_cache[k]
+                shed += 1
+                if shed >= self.INO_CACHE_MAX // 2:
+                    break
         self._ino_cache[key] = g
         return g
 
@@ -492,14 +594,15 @@ class PosixLayer(Layer):
         except OSError as e:
             raise _fop_errno(e)
         key = f"{st.st_dev}:{st.st_ino}"
-        p = os.path.join(self._xattr_dir, "ino-" + key)
-        # single 16-byte write: a torn record reads short and is treated
-        # as unbound (then re-healed), so the tmp+replace dance is waste
-        with open(p, "wb") as f:
-            f.write(gfid)
+        rel = path if path.startswith("/") else "/" + path
+        # journal-only binding (ONE appended record on the already-open
+        # journal fd): the ino- and pointer files materialize at
+        # compaction — creating two files per bind priced every create
+        # at 2x open(2) on this sandboxed host
         self._ino_cache[key] = gfid
-        self._gfid_set(gfid, path if path.startswith("/") else "/" + path,
-                       inokey=key)
+        self._gfid_mem[gfid] = (key, rel)
+        self._bind_dirty.add(gfid)
+        self._journal_rec({"b": [gfid.hex(), key, rel]})
         # handle hardlink for anything hardlinkable (reference
         # posix_handle_hard); directories keep the text record only
         if not os.path.isdir(ap):
@@ -554,6 +657,22 @@ class PosixLayer(Layer):
                         rec = json.loads(line)
                     except ValueError:
                         continue  # torn tail record from a kill
+                    if "b" in rec:  # gfid binding
+                        ghex, key, rel = rec["b"]
+                        g = bytes.fromhex(ghex)
+                        self._gfid_mem[g] = (key, rel)
+                        self._ino_cache[key] = g
+                        self._bind_dirty.add(g)
+                        self._xa_records += 1
+                        continue
+                    if "u" in rec:  # unbind
+                        g = bytes.fromhex(rec["u"])
+                        ent = self._gfid_mem.pop(g, None)
+                        if ent is not None:
+                            self._ino_cache.pop(ent[0], None)
+                        self._bind_dirty.add(g)
+                        self._xa_records += 1
+                        continue
                     g = bytes.fromhex(rec["g"])
                     if rec["x"] is None:
                         self._xa_cache.pop(g, None)
@@ -568,21 +687,23 @@ class PosixLayer(Layer):
         except FileNotFoundError:
             return
 
-    def _xa_append(self, gfid: bytes, xattrs: dict | None) -> None:
+    def _journal_rec(self, rec: dict) -> None:
         if self._xa_journal_fd is None:
             self._xa_journal_fd = os.open(
                 self._xa_journal_path,
                 os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
-        os.write(self._xa_journal_fd,
-                 (json.dumps({"g": gfid.hex(), "x": xattrs}) + "\n")
-                 .encode())
-        self._xa_dirty.add(gfid)
+        os.write(self._xa_journal_fd, (json.dumps(rec) + "\n").encode())
         self._xa_records += 1
         if self._xa_records >= self.XATTR_COMPACT_EVERY:
             self._xa_compact()
 
+    def _xa_append(self, gfid: bytes, xattrs: dict | None) -> None:
+        self._xa_dirty.add(gfid)
+        self._journal_rec({"g": gfid.hex(), "x": xattrs})
+
     def _xa_compact(self) -> None:
-        """Fold the journal into the per-gfid JSON files and truncate."""
+        """Fold the journal into the per-gfid JSON files (xattrs) and
+        the ino-/pointer files (bindings), then truncate."""
         for g in self._xa_dirty:
             p = self._xattr_path(g)
             cur = self._xa_cache.get(g)
@@ -596,6 +717,25 @@ class PosixLayer(Layer):
                 json.dump(cur, f)
             os.replace(p + ".tmp", p)
         self._xa_dirty.clear()
+        for g in self._bind_dirty:
+            ent = self._gfid_mem.pop(g, None)
+            if ent is None:
+                # unbound since: drop any materialized remnants
+                for p in (self._handle_path(g), self._gfid_path(g)):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+                continue
+            key, rel = ent
+            fd = os.open(os.path.join(self._xattr_dir, "ino-" + key),
+                         os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+            try:
+                os.write(fd, g)
+            finally:
+                os.close(fd)
+            self._gfid_set(g, rel, inokey=key)
+        self._bind_dirty.clear()
         self._xa_records = 0
         if self._xa_journal_fd is not None:
             os.close(self._xa_journal_fd)
@@ -606,16 +746,22 @@ class PosixLayer(Layer):
             pass
 
     def drop_caches(self) -> None:
-        """Forget all in-memory sidecar state.  For tooling/tests that
+        """Forget all in-memory sidecar state and re-read the store —
+        exactly what a kill + respawn does.  For tooling/tests that
         mutate the brick backend out-of-band under a live layer (a real
-        brick replacement respawns the process, making this implicit)."""
+        brick replacement respawns the process, making this implicit).
+        Nothing is written: the store may have been wiped/replaced, and
+        compacting stale memory into it would resurrect dead state."""
         self._xa_cache.clear()
         self._xa_dirty.clear()
         self._ino_cache.clear()
+        self._gfid_mem.clear()
+        self._bind_dirty.clear()
         if self._xa_journal_fd is not None:
             os.close(self._xa_journal_fd)
             self._xa_journal_fd = None
         self._xa_records = 0
+        self._xa_replay_journal()  # whatever journal the store now has
 
     def _xa_evict(self) -> None:
         """Bound the cache: shed clean entries once past the cap (dirty
@@ -724,6 +870,10 @@ class PosixLayer(Layer):
             # as the create — one wave instead of create + setxattr
             self._xattr_store(gfid,
                               {k: _hex_val(v) for k, v in init.items()})
+        else:
+            # a just-bound gfid has no sidecar JSON: seed the cache so
+            # the first getxattr doesn't pay a guaranteed-miss open
+            self._xa_cache.setdefault(gfid, {})
         fd = FdObj(gfid, flags, path=path)
         fd.ctx_set(self, fdno)
         return fd, self._iatt(path)

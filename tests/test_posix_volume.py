@@ -122,9 +122,13 @@ def test_unlink_cleans_ino_binding(client, tmp_path):
     reuse resolves a fresh file to the dead gfid (advisor r1 finding)."""
     client.write_file("/doomed", b"bytes")
     xattr_dir = tmp_path / "brick0" / ".glusterfs_tpu" / "xattr"
+    # bindings are journal-only until compaction: materialize them so
+    # the on-disk invariant is observable
+    client.graph.by_name["brick0"]._xa_compact()
     before = {p.name for p in xattr_dir.iterdir() if p.name.startswith("ino-")}
     assert before, "expected an ino- binding after create"
     client.unlink("/doomed")
+    client.graph.by_name["brick0"]._xa_compact()
     after = {p.name for p in xattr_dir.iterdir() if p.name.startswith("ino-")}
     assert after == set() or after < before
     # a new file must get a FRESH gfid even if the OS reuses the inode
@@ -140,6 +144,7 @@ def test_rename_keeps_ino_binding_consistent(client, tmp_path):
     assert client.stat("/b").gfid == g_before  # gfid survives rename
     client.unlink("/b")
     xattr_dir = tmp_path / "brick0" / ".glusterfs_tpu" / "xattr"
+    client.graph.by_name["brick0"]._xa_compact()
     stale = [p.name for p in xattr_dir.iterdir() if p.name.startswith("ino-")]
     assert stale == []
 
@@ -164,6 +169,7 @@ def test_rename_over_existing_cleans_dst_identity(client, tmp_path):
     client.rename("/src", "/dst")
     assert client.stat("/dst").gfid == g_src
     meta = tmp_path / "brick0" / ".glusterfs_tpu"
+    client.graph.by_name["brick0"]._xa_compact()
     gfids = [p.name for p in (meta / "gfid").iterdir()
              if p.name != "0" * 31 + "1"]  # exclude ROOT_GFID
     inos = [p.name for p in (meta / "xattr").iterdir()
