@@ -794,6 +794,8 @@ class DisperseLayer(Layer):
                      xdata: dict | None = None):
         from ..core.iatt import gfid_new
 
+        import os as _os
+
         xdata = dict(xdata or {})
         xdata.setdefault("gfid-req", gfid_new())
         # counters ride the create itself (storage/posix init-xattrs):
@@ -802,14 +804,50 @@ class DisperseLayer(Layer):
             XA_VERSION: _pack_u64x2(0, 0),
             XA_SIZE: struct.pack(">Q", 0),
             XA_DIRTY: _pack_u64x2(0, 0)}
+        # compound lock-on-create (O_EXCL only: the file and its fresh
+        # gfid are born with this fop, so the non-blocking grant cannot
+        # conflict): the eager window opens WITH the create — the first
+        # write then pays only the fragment wave
+        # only once brick-side locks are KNOWN present (first txn
+        # probes them): on a lockless graph the compound key would pass
+        # through storage untouched and the window would believe in
+        # locks nobody holds
+        owner = None
+        if self.opts["eager-lock"] and flags & _os.O_EXCL and \
+                self._locks_supported:
+            owner = gfid_new()
+            xdata["lock-inodelk"] = ["ec.transaction", "wr", 0, -1,
+                                     owner]
         idxs = self._up_idx()
         res = await self._dispatch(idxs, "create",
                                    lambda i: ((loc, flags, mode, xdata), {}))
-        good = self._combine(res, min_ok=self._write_quorum())
+        try:
+            good = self._combine(res, min_ok=self._write_quorum())
+        except BaseException:
+            if owner is not None:
+                # below quorum: the bricks whose create DID land hold
+                # our compound-granted whole-file lock — unwind it or
+                # it outlives this failed create forever (the winner of
+                # a racing O_EXCL create would then hang on it)
+                ok = [i for i, r in res.items()
+                      if not isinstance(r, BaseException)]
+                await self._inodelk_unwind(
+                    Loc(loc.path, gfid=xdata["gfid-req"]), ok, owner)
+            raise
         child_fds = {i: r[0] for i, r in good.items()}
         ia = next(iter(good.values()))[1]
         fd = FdObj(ia.gfid, flags, path=loc.path)
         fd.ctx_set(self, ECFdCtx(child_fds, flags))
+        if owner is not None:
+            gfid = ia.gfid
+            async with self._lock(gfid):
+                if gfid not in self._eager:
+                    locked = sorted(good)
+                    self._eager[gfid] = _EagerState(
+                        owner, locked, locked, 0, set(good),
+                        asyncio.get_running_loop().time())
+                    await self._eager_end(Loc(loc.path, gfid=gfid),
+                                          gfid)
         return fd, ia
 
     async def open(self, loc: Loc, flags: int = 0, xdata: dict | None = None):
@@ -822,11 +860,12 @@ class DisperseLayer(Layer):
         return fd
 
     async def flush(self, fd: FdObj, xdata: dict | None = None):
-        await self._eager_drain_fd(fd)  # durability point: commit post-op
-        idxs = self._up_idx()
-        res = await self._dispatch(
-            idxs, "flush", lambda i: ((self._child_fd(fd, i),), {}))
-        self._combine(res)
+        """Drain the eager window (the commit wave: version/size/dirty
+        xattrop + unlock) — that IS the flush.  No brick flush fan-out:
+        posix flush is a no-op on both sides (reference posix_flush
+        returns 0 unconditionally), so the wave would carry zero
+        information for a full round trip per brick."""
+        await self._eager_drain_fd(fd)
         return {}
 
     async def fsync(self, fd: FdObj, datasync: int = 0,
@@ -842,13 +881,17 @@ class DisperseLayer(Layer):
         await self._eager_drain_fd(fd)
         ctx: ECFdCtx | None = fd.ctx_del(self)
         if ctx:
-            for i, cfd in ctx.child_fds.items():
+            # one parallel wave, not one round trip per child
+            async def one(i, cfd):
                 rel = getattr(self.children[i], "release", None)
                 if rel:
                     try:
                         await rel(cfd)
                     except Exception:
                         pass
+
+            await asyncio.gather(*(one(i, cfd)
+                                   for i, cfd in ctx.child_fds.items()))
 
     # -- the data path -----------------------------------------------------
 

@@ -182,6 +182,27 @@ class LocksLayer(Layer):
         return await self._do(self._inodelk, (fd.gfid, domain), cmd,
                               _Lock(self._owner(xdata), ltype, start, end))
 
+    async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
+                     xdata: dict | None = None):
+        """Compound lock-on-create: a ``lock-inodelk`` payload takes the
+        caller's transaction lock right after the create commits — the
+        mirror of xattrop's compound unlock, saving EC's eager window
+        its opening lock wave on the create-first write path.  Callers
+        only attach it to O_EXCL creates: the file (and its fresh gfid)
+        is born with this fop, so the non-blocking grant cannot
+        conflict with anyone."""
+        grant = (xdata or {}).get("lock-inodelk")
+        if grant:
+            xdata = {k: v for k, v in xdata.items()
+                     if k != "lock-inodelk"}
+        ret = await self.children[0].create(loc, flags, mode, xdata)
+        if grant:
+            domain, ltype, start, end, owner = grant
+            fd = ret[0] if isinstance(ret, tuple) else ret
+            await self._do(self._inodelk, (fd.gfid, domain), "lock-nb",
+                           _Lock(owner, ltype, start, end))
+        return ret
+
     async def xattrop(self, loc: Loc, op: str, xattrs: dict,
                       xdata: dict | None = None):
         """Compound post-op: an ``unlock-inodelk`` payload releases the

@@ -627,10 +627,11 @@ def _scope_owner(args, kwargs, identity: bytes) -> None:
             continue
         if "lk-owner" in container:
             container["lk-owner"] = identity + b"/" + container["lk-owner"]
-        unlock = container.get("unlock-inodelk")
-        if isinstance(unlock, (list, tuple)) and len(unlock) == 5:
-            container["unlock-inodelk"] = [
-                *unlock[:4], identity + b"/" + unlock[4]]
+        for key in ("unlock-inodelk", "lock-inodelk"):
+            compound = container.get(key)
+            if isinstance(compound, (list, tuple)) and len(compound) == 5:
+                container[key] = [*compound[:4],
+                                  identity + b"/" + compound[4]]
 
 
 def _jsonable(v):
