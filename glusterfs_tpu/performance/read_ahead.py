@@ -14,18 +14,19 @@ from ..core.options import Option
 
 
 class _RaFd:
-    __slots__ = ("next_offset", "pages", "task")
+    __slots__ = ("next_offset", "pages", "task", "task_range")
 
     def __init__(self):
         self.next_offset = 0
         self.pages: dict[int, bytes] = {}
         self.task: asyncio.Task | None = None
+        self.task_range = (0, 0)  # [first, last] page of the in-flight fetch
 
 
 @register("performance/read-ahead")
 class ReadAheadLayer(Layer):
     OPTIONS = (
-        Option("page-count", "int", default=4, min=1, max=16),
+        Option("page-count", "int", default=8, min=1, max=64),
         Option("page-size", "size", default="128KB", min=4096),
     )
 
@@ -68,7 +69,29 @@ class ReadAheadLayer(Layer):
         # serve from prefetched pages when fully covered
         idx = offset // psz
         end = offset + size
-        covered = all((i in ctx.pages) for i in range(idx, (end - 1) // psz + 1))
+
+        def _covered():
+            return all(i in ctx.pages
+                       for i in range(idx, (end - 1) // psz + 1))
+
+        covered = _covered()
+        last = (end - 1) // psz
+        if not covered and ctx.task is not None and \
+                not ctx.task.done() and \
+                idx <= ctx.task_range[1] and last >= ctx.task_range[0]:
+            # an in-flight prefetch is fetching (part of) this range:
+            # wait for it instead of issuing a DUPLICATE cluster read
+            # (the reference parks readers on the page's wait queue,
+            # page.c ioc/ra waitq semantics).  Non-overlapping reads
+            # (a seek elsewhere) don't wait — they'd pay the whole
+            # window's latency for zero hit-rate benefit.
+            try:
+                await asyncio.shield(ctx.task)
+            except asyncio.CancelledError:
+                raise  # OUR fop was cancelled: honor it
+            except Exception:
+                pass
+            covered = _covered()
         if covered:
             out = bytearray()
             pos = offset
@@ -89,6 +112,8 @@ class ReadAheadLayer(Layer):
         if sequential and len(data) == size:
             nxt = (end + psz - 1) // psz
             if ctx.task is None or ctx.task.done():
+                ctx.task_range = (nxt,
+                                  nxt + self.opts["page-count"] - 1)
                 ctx.task = asyncio.create_task(self._prefetch(fd, nxt))
         return data
 
