@@ -140,7 +140,132 @@ OPTION_MAP = {
     "cluster.nufa-local-volume-name": ("cluster/nufa",
                                        "local-volume-name"),
     "cluster.switch-pattern": ("cluster/switch", "pattern-switch-case"),
+    # ------------------------------------------------------------------
+    # the operable long tail (glusterd-volume-set.c maps ~400 keys; the
+    # framework half — typed options, live reconfigure, op-version
+    # gating — existed before this block, which adds the tunables an
+    # operator actually turns: cache geometry, thread counts, timeouts,
+    # heal/lock behavior, debug injection).  Every key lands on a real
+    # consumed Option of a real layer.
+    # distribute
+    "cluster.lookup-optimize": ("cluster/distribute", "lookup-optimize"),
+    # disperse
+    "disperse.eager-lock-max-hold": ("cluster/disperse",
+                                     "eager-lock-max-hold"),
+    # replicate (favorite-child-policy already mapped above)
+    "cluster.data-self-heal-window-size": ("cluster/replicate",
+                                           "self-heal-window-size"),
+    # locks
+    "features.locks-trace": ("features/locks", "trace"),
+    "features.locks-lock-timeout": ("features/locks", "lock-timeout"),
+    # quota tuning
+    "features.default-soft-limit": ("features/quota",
+                                    "default-soft-limit"),
+    "features.hard-timeout": ("features/quota", "hard-timeout"),
+    "features.quota-usage-scale": ("features/quota", "usage-scale"),
+    "features.simple-quota-flush-interval": ("features/simple-quota",
+                                             "flush-interval"),
+    # trash (enable keys for read-only/worm/leases/upcall exist above)
+    "features.trash-max-filesize": ("features/trash",
+                                    "trash-max-filesize"),
+    # snapview / uss
+    "features.snapshot-directory-refresh": ("features/snapview",
+                                            "refresh-interval"),
+    # changelog
+    "changelog.changelog-dir": ("features/changelog", "changelog-dir"),
+    # io-stats diagnostics
+    "diagnostics.count-fop-hits": ("debug/io-stats", "count-fop-hits"),
+    "diagnostics.fd-hard-limit": ("debug/io-stats", "fd-hard-limit"),
+    # debug fault injection (tests/operators drive these live); the
+    # presence keys insert the layer, the -fops keys pick which fops
+    # it bites ('enable' is a comma fop list in both layers)
+    "debug.error-gen": ("debug/error-gen", "__enable__"),
+    "debug.error-fops": ("debug/error-gen", "enable"),
+    "debug.error-failure": ("debug/error-gen", "failure"),
+    "debug.error-number": ("debug/error-gen", "error-no"),
+    "debug.random-failure-seed": ("debug/error-gen", "seed"),
+    "debug.delay-gen": ("debug/delay-gen", "__enable__"),
+    "debug.delay-fops": ("debug/delay-gen", "enable"),
+    "debug.delay-duration": ("debug/delay-gen", "delay-duration"),
+    "debug.delay-percent": ("debug/delay-gen", "delay-percentage"),
+    "debug.trace": ("debug/trace", "__enable__"),
+    "debug.trace-log-history": ("debug/trace", "log-history"),
+    "debug.trace-exclude-ops": ("debug/trace", "exclude-ops"),
+    # io-threads queue geometry
+    "performance.high-prio-threads": ("performance/io-threads",
+                                      "high-prio-threads"),
+    "performance.low-prio-threads": ("performance/io-threads",
+                                     "low-prio-threads"),
+    "performance.least-prio-threads": ("performance/io-threads",
+                                       "least-prio-threads"),
+    # client-side cache geometry
+    "performance.cache-timeout": ("performance/io-cache",
+                                  "cache-timeout"),
+    "performance.io-cache-page-size": ("performance/io-cache",
+                                       "page-size"),
+    "performance.read-ahead-page-size": ("performance/read-ahead",
+                                         "page-size"),
+    "performance.md-cache-cache-xattrs": ("performance/md-cache",
+                                          "cache-xattrs"),
+    "performance.nl-cache-timeout": ("performance/nl-cache",
+                                     "nl-cache-timeout"),
+    "performance.nl-cache-limit": ("performance/nl-cache",
+                                   "nl-cache-limit"),
+    "performance.lazy-open": ("performance/open-behind", "lazy-open"),
+    "performance.use-anonymous-fd": ("performance/open-behind",
+                                     "use-anonymous-fd"),
+    "performance.quick-read-max-file-size": ("performance/quick-read",
+                                             "max-file-size"),
+    "performance.quick-read-cache-size": ("performance/quick-read",
+                                          "cache-size"),
+    "performance.quick-read-cache-timeout": ("performance/quick-read",
+                                             "cache-timeout"),
+    "performance.rda-request-size": ("performance/readdir-ahead",
+                                     "rda-request-size"),
+    "performance.flush-behind": ("performance/write-behind",
+                                 "flush-behind"),
+    "performance.trickling-writes": ("performance/write-behind",
+                                     "trickling-writes"),
+    # network
+    "network.ping-interval": ("protocol/client", "ping-interval"),
+    # storage
+    "storage.o-direct": ("storage/posix", "o-direct"),
+    "storage.update-link-count-parent": ("storage/posix",
+                                         "update-link-count-parent"),
 }
+
+# the option long tail above shipped at op-version 3: an older member
+# would store these keys but build volfiles without their effect (the
+# exact divergence the gate exists to prevent)
+_V3_KEYS = (
+    "cluster.lookup-optimize", "disperse.eager-lock",
+    "disperse.other-eager-lock", "disperse.eager-lock-timeout",
+    "disperse.eager-lock-max-hold", "cluster.rebal-throttle",
+    "cluster.data-self-heal-window-size", "features.locks-trace",
+    "features.locks-lock-timeout", "features.default-soft-limit",
+    "features.hard-timeout", "features.quota-usage-scale",
+    "features.simple-quota-flush-interval", "features.trash-max-filesize",
+    "features.snapshot-directory-refresh", "changelog.changelog-dir",
+    "diagnostics.count-fop-hits", "diagnostics.fd-hard-limit",
+    "debug.error-gen", "debug.error-fops", "debug.error-failure",
+    "debug.error-number", "debug.random-failure-seed",
+    "debug.delay-gen", "debug.delay-fops", "debug.delay-duration",
+    "debug.delay-percent", "debug.trace", "debug.trace-log-history",
+    "debug.trace-exclude-ops", "performance.high-prio-threads",
+    "performance.low-prio-threads", "performance.least-prio-threads",
+    "performance.cache-timeout", "performance.io-cache-page-size",
+    "performance.read-ahead-page-size",
+    "performance.md-cache-cache-xattrs", "performance.nl-cache-timeout",
+    "performance.nl-cache-limit", "performance.lazy-open",
+    "performance.use-anonymous-fd",
+    "performance.quick-read-max-file-size",
+    "performance.quick-read-cache-size",
+    "performance.quick-read-cache-timeout",
+    "performance.rda-request-size", "performance.flush-behind",
+    "performance.trickling-writes", "network.ping-interval",
+    "storage.o-direct", "storage.update-link-count-parent",
+)
+OPTION_MIN_OPVERSION.update({k: 3 for k in _V3_KEYS})
 
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key
@@ -223,7 +348,8 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
     if _enabled(volinfo, "features.sdfs", False):
         out.append(_emit(f"{name}-sdfs", "features/sdfs", {}, [top]))
         top = f"{name}-sdfs"
-    out.append(_emit(f"{name}-locks", "features/locks", {}, [top]))
+    out.append(_emit(f"{name}-locks", "features/locks",
+                     layer_options(volinfo, "features/locks"), [top]))
     top = f"{name}-locks"
     if _enabled(volinfo, "features.leases", False):
         out.append(_emit(f"{name}-leases", "features/leases",
@@ -262,9 +388,11 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
         qopts["limits"] = _json.dumps(
             volinfo.get("quota", {}).get("limits", {}),
             separators=(",", ":")).replace("#", "\\u0023")
-        if volinfo["type"] == "disperse":
+        if volinfo["type"] == "disperse" and "usage-scale" not in qopts:
             # a disperse brick holds 1/K of every file: scale backend
-            # bytes to logical so limits are volume-type independent
+            # bytes to logical so limits are volume-type independent.
+            # An explicit features.quota-usage-scale wins (the operator
+            # override must not be silently clobbered).
             g = volinfo.get("group-size") or len(volinfo["bricks"])
             qopts["usage-scale"] = g - volinfo.get("redundancy", 2)
         out.append(_emit(f"{name}-quota", "features/quota", qopts, [top]))
@@ -284,8 +412,26 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
         out.append(_emit(f"{name}-worm", "features/worm", {}, [top]))
         top = f"{name}-worm"
     if _enabled(volinfo, "features.trash", False):
-        out.append(_emit(f"{name}-trash", "features/trash", {}, [top]))
+        out.append(_emit(f"{name}-trash", "features/trash",
+                         layer_options(volinfo, "features/trash"), [top]))
         top = f"{name}-trash"
+    # fault injection on demand (debug.error-gen / debug.delay-gen:
+    # the reference volgen inserts these the same way for its .t tests
+    # and operators debugging latency/fault behavior live)
+    if _enabled(volinfo, "debug.error-gen", False):
+        out.append(_emit(f"{name}-error-gen", "debug/error-gen",
+                         layer_options(volinfo, "debug/error-gen"),
+                         [top]))
+        top = f"{name}-error-gen"
+    if _enabled(volinfo, "debug.delay-gen", False):
+        out.append(_emit(f"{name}-delay-gen", "debug/delay-gen",
+                         layer_options(volinfo, "debug/delay-gen"),
+                         [top]))
+        top = f"{name}-delay-gen"
+    if _enabled(volinfo, "debug.trace", False):
+        out.append(_emit(f"{name}-trace", "debug/trace",
+                         layer_options(volinfo, "debug/trace"), [top]))
+        top = f"{name}-trace"
     out.append(_emit(name, "debug/io-stats",
                      layer_options(volinfo, "debug/io-stats"), [top]))
     top = name
@@ -455,10 +601,40 @@ def build_client_volfile(volinfo: dict,
         # user-serviceable snapshots: /.snaps browse (snapview-client)
         out.append(_emit(f"{volinfo['name']}-snapview",
                          "features/snapview",
-                         {"mgmt-server": mgmt,
+                         {**layer_options(volinfo, "features/snapview"),
+                          "mgmt-server": mgmt,
                           "volume": volinfo["name"]}, [top]))
         top = f"{volinfo['name']}-snapview"
     # virtual /.meta introspection at the very top (the reference
     # autoloads meta on every fuse graph; tests read it like statedump)
     out.append(_emit(volinfo["name"], "meta", {}, [top]))
     return "\n".join(out)
+
+
+def options_doc() -> str:
+    """The docs/volume_options.md content, generated from OPTION_MAP.
+    test_option_map_integrity pins the committed file to this output,
+    so the operator-facing table cannot drift from the map."""
+    lines = [
+        "# `volume set` options",
+        "",
+        "Generated from `mgmt/volgen.py`'s OPTION_MAP (the",
+        "glusterd-volume-set.c analog) by `volgen.options_doc()`; the",
+        "committed file is pinned to that output by",
+        "`tests/test_reconfigure.py::test_option_map_integrity`.  Every",
+        "key lands on a declared, consumed option of a live layer;",
+        "`(enable)` keys insert/remove the layer in the generated",
+        "graphs.  Keys with an op-version need the whole cluster at",
+        "that version (mixed-version skew guard).",
+        "",
+        "| key | target | option | op-ver |",
+        "|---|---|---|---|",
+    ]
+    for key in sorted(OPTION_MAP):
+        ltype, opt = OPTION_MAP[key]
+        ver = OPTION_MIN_OPVERSION.get(key, 1)
+        o = "(enable)" if opt == "__enable__" else opt
+        lines.append(f"| {key} | {ltype} | {o} | {ver} |")
+    lines.append("")
+    lines.append(f"{len(OPTION_MAP)} keys total.")
+    return "\n".join(lines) + "\n"

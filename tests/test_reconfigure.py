@@ -138,3 +138,154 @@ def test_e2e_volume_set_applies_live(tmp_path):
             await d.stop()
 
     asyncio.run(run())
+
+
+def test_option_map_integrity():
+    """Every `volume set` key lands on a REAL declared option of a
+    registered layer type (glusterd-volume-set.c keeps the same
+    contract via its option tables): a key pointing at a typo'd or
+    removed option would store silently and configure nothing."""
+    import importlib
+    import pkgutil
+
+    import glusterfs_tpu
+    from glusterfs_tpu.core.layer import _REGISTRY
+    from glusterfs_tpu.mgmt import volgen
+
+    for pkg in ("cluster", "features", "performance", "protocol",
+                "storage", "debug", "system", "meta"):
+        p = importlib.import_module(f"glusterfs_tpu.{pkg}")
+        for m in pkgutil.iter_modules(p.__path__):
+            importlib.import_module(f"glusterfs_tpu.{pkg}.{m.name}")
+
+    # pseudo-targets consumed by daemons, not graph layers
+    pseudo = {"__ssl__", "mgmt/glusterd", "mgmt/shd", "mgmt/gsyncd",
+              "mgmt/bitd"}
+    missing = []
+    for key, (ltype, opt) in volgen.OPTION_MAP.items():
+        if ltype in pseudo:
+            continue
+        cls = _REGISTRY.get(ltype)
+        if cls is None:
+            missing.append(f"{key} -> unknown layer {ltype}")
+            continue
+        if opt == "__enable__":
+            continue  # presence key: inserts the layer
+        if not any(o.name == opt for o in getattr(cls, "OPTIONS", ())):
+            missing.append(f"{key} -> {ltype} has no option {opt!r}")
+    assert not missing, missing
+    # every op-version-gated key must exist (typo guard on _V3_KEYS)
+    for k in volgen.OPTION_MIN_OPVERSION:
+        assert k in volgen.OPTION_MAP, f"gated ghost key {k!r}"
+    # breadth floor: the operable long tail must not silently shrink
+    assert len(volgen.OPTION_MAP) >= 120, len(volgen.OPTION_MAP)
+    # the operator-facing table is generated output, not prose: pin it
+    import os
+    doc = os.path.join(os.path.dirname(__file__), "..", "docs",
+                       "volume_options.md")
+    with open(doc) as f:
+        assert f.read() == volgen.options_doc(), \
+            "docs/volume_options.md drifted: regenerate with " \
+            "volgen.options_doc()" 
+
+
+def test_new_long_tail_options_apply_live(tmp_path):
+    """Sampled new keys reach running layers through `volume set`."""
+    import asyncio
+
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="ov",
+                             vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "b0")}],
+                             redundancy=0)
+                await c.call("volume-start", name="ov")
+                for key, val in (
+                        ("performance.cache-timeout", "3"),
+                        ("performance.flush-behind", "off"),
+                        ("features.locks-lock-timeout", "7"),
+                        ("diagnostics.count-fop-hits", "on"),
+                        ("cluster.lookup-optimize", "off"),
+                        ("performance.lazy-open", "off")):
+                    await c.call("volume-set", name="ov", key=key,
+                                 value=val)
+                info = await c.call("volume-info", name="ov")
+                opts = info["ov"]["options"]
+                assert opts["features.locks-lock-timeout"] == "7"
+            # the client graph generated from the options carries them
+            cl = await mount_volume(d.host, d.port, "ov")
+            try:
+                from glusterfs_tpu.core.layer import walk
+                vals = {}
+                for layer in walk(cl.graph.top):
+                    if layer.type_name == "performance/io-cache":
+                        vals["ct"] = layer.opts["cache-timeout"]
+                    if layer.type_name == "performance/open-behind":
+                        vals["lo"] = layer.opts["lazy-open"]
+                assert vals.get("ct") == 3.0, vals
+                assert vals.get("lo") is False, vals
+            finally:
+                await cl.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+
+
+def test_debug_fault_injection_via_volume_set(tmp_path):
+    """debug.error-gen inserted live through `volume set` (the
+    reference volgen inserts error-gen the same way): writes start
+    failing with the configured errno, and disabling restores I/O."""
+    import asyncio
+    import errno as errno_mod
+
+    from glusterfs_tpu.core.fops import FopError
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="fv",
+                             vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "b0")}],
+                             redundancy=0)
+                await c.call("volume-start", name="fv")
+            cl = await mount_volume(d.host, d.port, "fv")
+            await cl.write_file("/ok", b"fine")
+            await cl.unmount()
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-set", name="fv",
+                             key="debug.error-fops", value="writev")
+                await c.call("volume-set", name="fv",
+                             key="debug.error-failure", value="100")
+                await c.call("volume-set", name="fv",
+                             key="debug.error-number", value="ENOSPC")
+                await c.call("volume-set", name="fv",
+                             key="debug.error-gen", value="on")
+            cl = await mount_volume(d.host, d.port, "fv")
+            try:
+                await cl.write_file("/boom", b"x" * 8192)
+                raise AssertionError("write should have failed")
+            except FopError as e:
+                assert e.err == errno_mod.ENOSPC, e
+            await cl.unmount()
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-set", name="fv",
+                             key="debug.error-gen", value="off")
+            cl = await mount_volume(d.host, d.port, "fv")
+            await cl.write_file("/fine-again", b"y")
+            assert await cl.read_file("/fine-again") == b"y"
+            await cl.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
