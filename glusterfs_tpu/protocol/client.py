@@ -450,11 +450,21 @@ class ClientLayer(Layer):
         self._held_locks = {k: v for k, v in self._held_locks.items()
                             if k[1] != id(fd)}
         h = fd.ctx_del(self)
-        if h is not None and self.connected:
+        if h is not None and self.connected and self._writer is not None:
+            # fire-and-forget, but ON THE WIRE NOW: release carries no
+            # status the caller can observe (close() already returned
+            # flush's) and the server reaps fd tables on disconnect —
+            # yet the frame must hit the transport before any later
+            # fop's, or a subsequent lock request could reach the brick
+            # ahead of the release that frees the range it wants.  The
+            # reply (matched by xid) finds no pending future and is
+            # dropped by the read loop.
+            xid = next(self._xid)
             try:
-                await self._call("release", (h,), {})
-            except FopError:
-                pass
+                self._writer.writelines(wire.pack_frames(
+                    xid, wire.MT_CALL, ["release", [h], {}]))
+            except (ConnectionError, RuntimeError):
+                pass  # teardown race: the server reaps on disconnect
 
     # remote admin/heal entry points (separate RPC programs in reference)
     async def remote(self, method: str, *args, **kwargs) -> Any:
