@@ -80,7 +80,8 @@ class _EagerState:
     commits at window close."""
 
     __slots__ = ("owner", "locked", "pre", "good", "candidates", "size",
-                 "delta", "timer", "opened")
+                 "delta", "timer", "opened", "inflight", "idle",
+                 "pre_landed", "ranges", "rseq")
 
     def __init__(self, owner: bytes, locked: list[int],
                  candidates: list[int], size: int, good: set[int],
@@ -94,6 +95,34 @@ class _EagerState:
         self.delta = 0                # pending data-version increments
         self.timer = None             # deferred-release handle
         self.opened = opened          # loop time: bounds total hold
+        # parallel-writes state (ec_is_range_conflict, ec-common.c:185):
+        # non-conflicting write waves run outside the local gfid lock
+        self.inflight = 0             # write-class waves mid-dispatch
+        self.idle = asyncio.Event()   # set while inflight == 0
+        self.idle.set()
+        self.pre_landed = asyncio.Event()  # dirty+1 is ON the bricks
+        self.ranges: dict[int, tuple[int, int, asyncio.Future]] = {}
+        self.rseq = 0
+
+    def conflict(self, a_off: int, a_end: int) -> "asyncio.Future | None":
+        """Completion future of an overlapping in-flight write, if any."""
+        for off, end, fut in self.ranges.values():
+            if off < a_end and a_off < end:
+                return fut
+        return None
+
+    def add_range(self, a_off: int, a_end: int) -> int:
+        self.rseq += 1
+        fut = asyncio.get_running_loop().create_future()
+        self.ranges[self.rseq] = (a_off, a_end, fut)
+        return self.rseq
+
+    def del_range(self, token: int) -> None:
+        """Lock-free on purpose: waiters may hold the gfid lock while
+        they wait for us (quiesce), so removal must not need it."""
+        ent = self.ranges.pop(token, None)
+        if ent is not None and not ent[2].done():
+            ent[2].set_result(None)
 
 
 @register("cluster/disperse")
@@ -108,6 +137,22 @@ class DisperseLayer(Layer):
                            " multi-chip sharded data plane)"),
         Option("read-policy", "enum", default="round-robin",
                values=("round-robin", "gfid-hash", "first-k")),
+        Option("ec-read-mask", "str", default="",
+               description="comma-separated child indices allowed to "
+                           "serve reads (ec_assign_read_mask, "
+                           "ec.c:717-775): keeps a slow or suspect "
+                           "brick out of the read set.  Strict, like "
+                           "the reference (fop->mask &= read_mask, "
+                           "ec-inode-read.c:1375): a masked-out brick "
+                           "never serves reads, even degraded.  Must "
+                           "name at least K ids; invalid masks log and "
+                           "clear"),
+        Option("parallel-writes", "bool", default="on",
+               description="writes touching disjoint stripe ranges of "
+                           "one inode dispatch concurrently inside the "
+                           "eager window instead of serializing "
+                           "(disperse.parallel-writes, ec.c:284,868 + "
+                           "ec_is_range_conflict ec-common.c:185)"),
         Option("quorum-count", "int", default=0, min=0,
                description="extra write quorum (0 = K)"),
         Option("self-heal-window-size", "size", default="1M"),
@@ -174,6 +219,7 @@ class DisperseLayer(Layer):
         self._locks_supported: bool | None = None  # lazily probed
         self._eager: dict[bytes, _EagerState] = {}  # gfid -> held window
         self._bg: set[asyncio.Task] = set()  # strong refs to drain tasks
+        self._read_mask = self._parse_read_mask()
 
     def reconfigure(self, options: dict) -> None:
         """Live option apply (ec_reconfigure, ec.c:254): codec backend /
@@ -197,6 +243,30 @@ class DisperseLayer(Layer):
                 window=self.opts["stripe-cache-window"] / 1e6,
                 min_batch=self.opts["stripe-cache-min-batch"])
         self._batching = self.opts["stripe-cache"]
+        self._read_mask = self._parse_read_mask()
+
+    def _parse_read_mask(self) -> frozenset[int] | None:
+        """ec_assign_read_mask (ec.c:717-775): parse + validate — every
+        id a real child index, at least K ids total.  The reference
+        fails the option set; our reconfigure path logs and clears."""
+        raw = str(self.opts["ec-read-mask"] or "").strip()
+        if not raw:
+            return None
+        try:
+            ids = frozenset(int(p) for p in raw.split(",") if p.strip())
+        except ValueError:
+            log.warning(3, "%s: ec-read-mask %r has a non-integer id; "
+                        "ignoring mask", self.name, raw)
+            return None
+        if any(i < 0 or i >= self.n for i in ids):
+            log.warning(3, "%s: ec-read-mask %r id out of range [0-%d]; "
+                        "ignoring mask", self.name, raw, self.n - 1)
+            return None
+        if len(ids) < self.k:
+            log.warning(3, "%s: ec-read-mask %r names fewer than K=%d "
+                        "ids; ignoring mask", self.name, raw, self.k)
+            return None
+        return ids
 
     # -- child state -------------------------------------------------------
 
@@ -467,17 +537,31 @@ class DisperseLayer(Layer):
         async with self._lock(gfid):
             await self._eager_flush(loc, gfid)
 
+    async def _quiesce_writes(self, st: _EagerState) -> None:
+        """Wait out in-flight parallel write waves.  Callers hold the
+        local gfid lock, so no NEW wave can register while we wait
+        (registration needs that lock); completion is lock-free."""
+        while st.ranges:
+            await next(iter(st.ranges.values()))[2]
+        while st.inflight:
+            await st.idle.wait()
+
     async def _eager_flush(self, loc: Loc, gfid: bytes) -> None:
         """Commit the delayed post-op in ONE mixed xattrop (version
         add64 + size set + dirty release, atomic on each brick) and drop
         the cluster lock.  Dirty is released only when every brick took
         every write in the window.  Caller holds the local gfid lock."""
-        st = self._eager.pop(gfid, None)
+        st = self._eager.get(gfid)
         if st is None:
             return
         if st.timer is not None:
             st.timer.cancel()
             st.timer = None
+        # quiesce parallel-writes waves first: the post-op must describe
+        # a settled window.  New waves can't start — registration needs
+        # the gfid lock we hold; removal is lock-free so they can drain.
+        await self._quiesce_writes(st)
+        self._eager.pop(gfid, None)
         unlocked: set[int] = set()
         try:
             post: dict = {}
@@ -931,9 +1015,16 @@ class DisperseLayer(Layer):
                 if (m["version"], m["size"]) == best]
         return rows, best[1]
 
-    def _read_children(self, candidates: list[int],
-                       gfid: bytes = b"") -> list[int]:
-        """Pick K children per read-policy (ec.c read-policy option)."""
+    def _read_children(self, candidates: list[int], gfid: bytes = b"",
+                       mask: bool = False) -> list[int]:
+        """Pick K children per read-policy (ec.c read-policy option).
+        With ``mask`` the operator's read-mask restricts the set
+        (strict, like fop->mask &= ec->read_mask at dispatch) — but
+        only inode-READ fops pass it (ec-inode-read.c:1375): a write's
+        internal RMW reads and heal reconstruction must never be
+        failed by a read-tuning knob."""
+        if mask and self._read_mask is not None:
+            candidates = [i for i in candidates if i in self._read_mask]
         if len(candidates) < self.k:
             raise FopError(errno.ENOTCONN,
                            f"only {len(candidates)}/{self.n} consistent "
@@ -950,9 +1041,11 @@ class DisperseLayer(Layer):
         return sorted(rot[: self.k])
 
     async def _read_aligned(self, fd: FdObj, a_off: int, a_len: int,
-                            candidates: list[int] | None = None) -> np.ndarray:
+                            candidates: list[int] | None = None,
+                            mask: bool = False) -> np.ndarray:
         """Read+decode an aligned region [a_off, a_off+a_len); fragment
-        files shorter than the range zero-fill (sparse tails)."""
+        files shorter than the range zero-fill (sparse tails).  ``mask``
+        only for user-facing reads (see _read_children)."""
         if a_len == 0:
             return np.zeros(0, dtype=np.uint8)
         f_off = a_off // self.k
@@ -963,7 +1056,7 @@ class DisperseLayer(Layer):
         last_err: FopError | None = None
         for _ in range(1 + self.r):  # retry with failing bricks excluded
             avail = [i for i in candidates if i not in excluded]
-            rows = self._read_children(avail, fd.gfid)
+            rows = self._read_children(avail, fd.gfid, mask=mask)
             res = await self._dispatch(
                 rows, "readv",
                 lambda i: ((self._child_fd(fd, i), f_len, f_off), {}))
@@ -995,7 +1088,7 @@ class DisperseLayer(Layer):
         end = offset + size
         a_end = (end + self.stripe - 1) // self.stripe * self.stripe
         data = await self._read_aligned(fd, a_off, a_end - a_off,
-                                        list(candidates))
+                                        list(candidates), mask=True)
         return data[offset - a_off: offset - a_off + size].tobytes()
 
     async def readv(self, fd: FdObj, size: int, offset: int,
@@ -1009,13 +1102,23 @@ class DisperseLayer(Layer):
             # lock + meta + unlock waves of pure latency.  Same-inode
             # ops serialize on the local gfid lock (the reference
             # chains same-inode fops on the lock owner too).
-            async with self._lock(fd.gfid):
-                st = await self._eager_begin(loc, fd.gfid)
-                try:
-                    return await self._readv_window(
-                        fd, size, offset, st.candidates, st.size)
-                finally:
-                    await self._eager_end(loc, fd.gfid)
+            while True:
+                async with self._lock(fd.gfid):
+                    st = await self._eager_begin(loc, fd.gfid)
+                    # a parallel write mid-dispatch over our range could
+                    # hand us a torn stripe (half old, half new
+                    # fragments) — wait it out like a conflicting write
+                    a_off = offset // self.stripe * self.stripe
+                    a_end = (offset + size + self.stripe - 1) \
+                        // self.stripe * self.stripe
+                    blocker = st.conflict(a_off, a_end)
+                    if blocker is None:
+                        try:
+                            return await self._readv_window(
+                                fd, size, offset, st.candidates, st.size)
+                        finally:
+                            await self._eager_end(loc, fd.gfid)
+                await blocker
         async with self._Txn(self, loc, fd.gfid, "rd",
                              fetch=True) as txn:
             candidates, true_size = await self._txn_meta(txn)
@@ -1048,13 +1151,28 @@ class DisperseLayer(Layer):
                 await self._xattrop(pre_targets, loc,
                                     {XA_DIRTY: _pack_u64x2(1, 0)})
             st.pre = set(pre_targets)
-        prev_good = st.good
-        st.good = set()
-        res = await self._dispatch(targets, op, argfn)
-        ok = {i for i, r in res.items() if not isinstance(r, BaseException)}
-        # a brick that missed ANY wave in the window stays out: it is
-        # inconsistent until healed
-        st.good = prev_good & ok
+        st.inflight += 1
+        st.idle.clear()
+        ok: set[int] | None = None
+        try:
+            res = await self._dispatch(targets, op, argfn)
+            ok = {i for i, r in res.items()
+                  if not isinstance(r, BaseException)}
+        finally:
+            # a brick that missed ANY wave in the window stays out: it
+            # is inconsistent until healed (down bricks miss the wave
+            # too — they were never targeted).  A torn-off wave
+            # (cancel) poisons its whole target set — the serial path
+            # got the same protection by clearing good across the
+            # dispatch, but expressed per-wave it survives concurrent
+            # parallel-writes waves without clobbering their tracking
+            if ok is None:
+                st.good -= set(targets)
+            else:
+                st.good &= ok
+            st.inflight -= 1
+            if st.inflight == 0:
+                st.idle.set()
         if len(ok) < self._write_quorum():
             # surface the bricks' dominant errno (ec_fop_prepare_answer
             # groups answers and picks the most common op_errno) so
@@ -1066,6 +1184,10 @@ class DisperseLayer(Layer):
                            f"{op} quorum lost ({len(ok)}/{self.n})")
         st.delta += 1
         st.candidates = sorted(st.good)
+        if st.pre:
+            # the dirty mark is committed on the bricks: parallel-writes
+            # followers may now dispatch outside the serial first wave
+            st.pre_landed.set()
         return {i: r for i, r in res.items() if i in ok}
 
     async def _writev_in_window(self, fd: FdObj, loc: Loc, st: _EagerState,
@@ -1095,7 +1217,9 @@ class DisperseLayer(Layer):
             fd, loc, st, "writev",
             lambda i: ((self._child_fd(fd, i),
                         frags[i].tobytes(), f_off), {}))
-        st.size = max(true_size, end)
+        # re-read st.size (not the wave-start snapshot): a concurrent
+        # parallel write past our range may have grown it meanwhile
+        st.size = max(st.size, end)
         ia = next(iter(good.values()))
         ia = Iatt(**{**ia.__dict__})
         ia.size = st.size
@@ -1106,14 +1230,50 @@ class DisperseLayer(Layer):
         """Write under the eager window: first fop on an inode pays
         inodelk + metadata + pre-op; followers pay only the fragment
         write wave; the combined post-op commits at window close
-        (ec-inode-write.c:2141 + ec-common.c:2176,2377)."""
+        (ec-inode-write.c:2141 + ec-common.c:2176,2377).
+
+        parallel-writes (ec.c:284 + ec_is_range_conflict,
+        ec-common.c:185): once the window's dirty pre-op has landed,
+        writes touching disjoint aligned stripe ranges dispatch
+        concurrently — the local gfid lock covers only window
+        bookkeeping, not the RMW/encode/write wave itself."""
         loc = Loc(fd.path, gfid=fd.gfid)
-        async with self._lock(fd.gfid):
-            st = await self._eager_begin(loc, fd.gfid)
-            try:
-                return await self._writev_in_window(fd, loc, st, data,
-                                                    offset)
-            finally:
+        if not self.opts["parallel-writes"]:
+            async with self._lock(fd.gfid):
+                st = await self._eager_begin(loc, fd.gfid)
+                # waves registered before a live parallel-writes->off
+                # reconfigure may still be dispatching: settle them
+                await self._quiesce_writes(st)
+                try:
+                    return await self._writev_in_window(fd, loc, st,
+                                                        data, offset)
+                finally:
+                    await self._eager_end(loc, fd.gfid)
+        end = offset + len(data)
+        a_off = offset // self.stripe * self.stripe
+        a_end = (end + self.stripe - 1) // self.stripe * self.stripe
+        while True:
+            async with self._lock(fd.gfid):
+                st = await self._eager_begin(loc, fd.gfid)
+                if not st.pre_landed.is_set():
+                    # the window's first write runs solo under the lock:
+                    # it carries the compound pre-op, and dirty+1 must
+                    # be ON the bricks before any concurrent data wave
+                    try:
+                        return await self._writev_in_window(
+                            fd, loc, st, data, offset)
+                    finally:
+                        await self._eager_end(loc, fd.gfid)
+                blocker = st.conflict(a_off, a_end)
+                if blocker is None:
+                    token = st.add_range(a_off, a_end)
+                    break
+            await blocker  # overlapping write in flight: wait, retry
+        try:
+            return await self._writev_in_window(fd, loc, st, data, offset)
+        finally:
+            st.del_range(token)  # lock-free: wakes conflict waiters
+            async with self._lock(fd.gfid):
                 await self._eager_end(loc, fd.gfid)
 
     # -- allocation-class fops (ec-inode-write.c fallocate/discard/
@@ -1150,6 +1310,7 @@ class DisperseLayer(Layer):
         loc = Loc(fd.path, gfid=fd.gfid)
         async with self._lock(fd.gfid):
             st = await self._eager_begin(loc, fd.gfid)
+            await self._quiesce_writes(st)  # settle parallel waves
             try:
                 end = offset + length
                 f_off = offset // self.stripe * CHUNK
@@ -1177,6 +1338,7 @@ class DisperseLayer(Layer):
         loc = Loc(fd.path, gfid=fd.gfid)
         async with self._lock(fd.gfid):
             st = await self._eager_begin(loc, fd.gfid)
+            await self._quiesce_writes(st)  # settle parallel waves
             try:
                 end = min(offset + length, st.size)
                 if end > offset:
@@ -1211,6 +1373,7 @@ class DisperseLayer(Layer):
         loc = Loc(fd.path, gfid=fd.gfid)
         async with self._lock(fd.gfid):
             st = await self._eager_begin(loc, fd.gfid)
+            await self._quiesce_writes(st)  # settle parallel waves
             try:
                 if length > 0:
                     await self._zero_in_window(fd, loc, st, offset, length)
@@ -1233,7 +1396,7 @@ class DisperseLayer(Layer):
                 raise FopError(errno.ENXIO, "offset beyond EOF")
             f_off = offset // self.stripe * CHUNK
             last: FopError | None = None
-            for i in self._read_children(candidates, fd.gfid):
+            for i in self._read_children(candidates, fd.gfid, mask=True):
                 try:
                     r = await self.children[i].seek(
                         self._child_fd(fd, i), f_off, what)

@@ -40,6 +40,8 @@ OPTION_MIN_OPVERSION = {
 # volume-set key -> (layer type, option name)  (glusterd-volume-set.c map)
 OPTION_MAP = {
     "auth.allow": ("protocol/server", "auth-allow"),
+    "server.outstanding-rpc-limit": ("protocol/server",
+                                     "outstanding-rpc-limit"),
     "auth.reject": ("protocol/server", "auth-reject"),
     "server.ssl": ("protocol/server", "ssl"),
     "client.ssl": ("protocol/client", "ssl"),
@@ -63,6 +65,8 @@ OPTION_MAP = {
                                     "eager-lock-timeout"),
     "disperse.self-heal-window-size": ("cluster/disperse",
                                        "self-heal-window-size"),
+    "disperse.ec-read-mask": ("cluster/disperse", "ec-read-mask"),
+    "disperse.parallel-writes": ("cluster/disperse", "parallel-writes"),
     "cluster.quorum-count": ("cluster/replicate", "quorum-count"),
     # consumed by glusterd's shd spawner, not a graph layer
     "cluster.heal-timeout": ("mgmt/shd", "interval"),
@@ -266,6 +270,13 @@ _V3_KEYS = (
     "storage.o-direct", "storage.update-link-count-parent",
 )
 OPTION_MIN_OPVERSION.update({k: 3 for k in _V3_KEYS})
+
+# round-5 additions ship at op-version 4
+_V4_KEYS = (
+    "disperse.ec-read-mask", "disperse.parallel-writes",
+    "server.outstanding-rpc-limit",
+)
+OPTION_MIN_OPVERSION.update({k: 4 for k in _V4_KEYS})
 
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key

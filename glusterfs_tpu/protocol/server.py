@@ -81,6 +81,18 @@ class ServerLayer(Layer):
         Option("ssl-ca", "str", default="",
                description="PEM CA bundle; when set, client certificates "
                            "are required and verified (ssl-ca-list)"),
+        Option("outstanding-rpc-limit", "int", default=64, min=0,
+               max=65536,
+               description="per-client cap on in-flight requests: at the "
+                           "limit the brick stops reading that client's "
+                           "connection until replies drain, so one "
+                           "misbehaving or merely fast client cannot "
+                           "balloon brick memory or starve others "
+                           "(rpcsvc_request_outstanding, rpcsvc.c:211-250; "
+                           "default rpcsvc.h:38).  0 = unlimited.  Lock "
+                           "fops are exempt from the count — a limit full "
+                           "of blocked locks would otherwise never admit "
+                           "the unlock that frees them (rpcsvc.c:183-208)"),
     )
 
     _TRANSPORT_OPTS = ("ssl", "ssl-cert", "ssl-key", "ssl-ca")
@@ -111,6 +123,11 @@ def _ct_eq(a, b) -> bool:
                                b.encode("utf-8", "surrogateescape"))
 
 _FOPS = {f.value for f in Fop}
+# lock-class fops never count against outstanding-rpc-limit
+# (rpcsvc_can_outstanding_req_be_ignored, rpcsvc.c:183-208): a limit
+# full of blocked lock requests would stop the connection being read,
+# and the unlock that would unblock them could then never arrive
+_THROTTLE_EXEMPT = {"inodelk", "finodelk", "entrylk", "fentrylk", "lk"}
 # non-wire-fop methods a client may invoke remotely (heal entry points,
 # introspection — the reference exposes these via separate RPC programs)
 _RPC_EXTRAS = {"heal_info", "heal_file", "heal_entry", "rebalance",
@@ -368,12 +385,15 @@ class BrickServer:
     # an unauthenticated peer must complete SETVOLUME within this long,
     # or the transport is dropped (no fd squatting / pre-auth probing)
     HANDSHAKE_DEADLINE = 10.0
-
-    # concurrent in-flight requests per connection (the io-threads queue
-    # depth analog): bounds memory under a flood while letting fops that
-    # block (a waiting inodelk, a slow disk op) overlap with pings and
-    # other traffic on the same transport
-    MAX_INFLIGHT = 128
+    # rpcsvc.h:38 RPCSVC_DEFAULT_OUTSTANDING_RPC_LIMIT (used when the
+    # served top carries no protocol/server options, e.g. bare graphs)
+    DEFAULT_RPC_LIMIT = 64
+    # lock-class fops are exempt from the limit (deadlock hack,
+    # rpcsvc.c:183-208) but a hostile flood of them must still not OOM
+    # the brick: a wide separate cap bounds parked lock tasks.  The
+    # reference leaves these genuinely unbounded; we keep the exemption
+    # property for any sane workload and cap the pathological one
+    EXEMPT_HARD_CAP = 16384
 
     async def _serve(self, reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
@@ -388,8 +408,24 @@ class BrickServer:
         conn.peer_addr = str(peer[0])
         self.connections.add(conn)
         tasks: set[asyncio.Task] = set()
-        sem = asyncio.Semaphore(self.MAX_INFLIGHT)
         wlock = asyncio.Lock()
+        # inbound backpressure (server.outstanding-rpc-limit;
+        # rpcsvc_request_outstanding rpcsvc.c:211-250): when this client
+        # has `limit` unanswered requests, stop reading its connection —
+        # TCP flow control then bounds its queue to the socket buffers.
+        # The limit is read per-admission so reconfigure applies live.
+        inflight = 0
+        exempt_inflight = 0
+        gate = asyncio.Event()
+        gate.set()
+
+        def _limit() -> int:
+            top = conn.top if conn.top is not None else self.top
+            try:
+                return int(self._opts_of(top).get(
+                    "outstanding-rpc-limit", self.DEFAULT_RPC_LIMIT))
+            except (TypeError, ValueError):
+                return self.DEFAULT_RPC_LIMIT
 
         async def send(xid: int, resp_type, resp) -> None:
             async with wlock:
@@ -403,7 +439,8 @@ class BrickServer:
                                                        resp))
                 await writer.drain()
 
-        async def serve_one(xid: int, payload):
+        async def serve_one(xid: int, payload, kind: str):
+            nonlocal inflight, exempt_inflight
             try:
                 try:
                     resp_type, resp = await self._dispatch(conn, payload)
@@ -422,7 +459,12 @@ class BrickServer:
                     except Exception:
                         pass
             finally:
-                sem.release()
+                if kind == "throttled":
+                    inflight -= 1
+                    gate.set()
+                elif kind == "exempt":
+                    exempt_inflight -= 1
+                    gate.set()
 
         try:
             while True:
@@ -441,10 +483,10 @@ class BrickServer:
                     continue
                 if conn.authed and isinstance(payload, list) and payload \
                         and payload[0] == "__ping__":
-                    # reserved heartbeat lane: pings bypass the inflight
-                    # semaphore, else 128 fops blocked on a held lock
-                    # would starve the very liveness probe this
-                    # concurrency exists to protect
+                    # reserved heartbeat lane: pings bypass the
+                    # outstanding-rpc gate, else a limit's worth of
+                    # fops blocked on a held lock would starve the very
+                    # liveness probe the concurrency exists to protect
                     try:
                         await send(xid, wire.MT_REPLY, "pong")
                     except ConnectionError:
@@ -462,8 +504,24 @@ class BrickServer:
                     if not conn.authed:
                         break  # refused SETVOLUME: drop the transport
                     continue
-                await sem.acquire()
-                t = asyncio.create_task(serve_one(xid, payload))
+                fop = payload[0] if isinstance(payload, list) and payload \
+                    else None
+                limit = _limit()
+                if limit <= 0:
+                    kind = "free"  # operator chose unlimited
+                elif fop in _THROTTLE_EXEMPT:
+                    while exempt_inflight >= self.EXEMPT_HARD_CAP:
+                        gate.clear()
+                        await gate.wait()
+                    exempt_inflight += 1
+                    kind = "exempt"
+                else:
+                    while inflight >= limit:  # stop reading this client
+                        gate.clear()
+                        await gate.wait()
+                    inflight += 1
+                    kind = "throttled"
+                t = asyncio.create_task(serve_one(xid, payload, kind))
                 tasks.add(t)
                 t.add_done_callback(tasks.discard)
         finally:
