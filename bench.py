@@ -662,8 +662,18 @@ def main() -> None:
         # (VERDICT r3 #4): routing pinned to the device (min-batch 0)
         # so the tunnel-fed path is measured, not routed around
         if on_tpu:
+            # systematic on: the tpu-first fragment layout for serving
+            # through a bandwidth-bound link (healthy reads decode-free,
+            # encode ships parity only — gf256.systematic_matrix); the
+            # non-systematic (reference-format) row stays on the record
+            # for comparison
             vol.update(volume_bench(
-                prefix="volume_device", passes=1,
+                prefix="volume_device", passes=3,
+                extra_options={"stripe-cache-min-batch": "0",
+                               "systematic": "on"}))
+            vol["volume_device_systematic"] = True
+            vol.update(volume_bench(
+                prefix="volume_device_nonsys", passes=1,
                 extra_options={"stripe-cache-min-batch": "0"}))
     except Exception as e:  # volume bench is auxiliary; never sink the run
         vol["volume_bench_error"] = str(e)[:200]

@@ -155,6 +155,19 @@ class DisperseLayer(Layer):
                            "ec_is_range_conflict ec-common.c:185)"),
         Option("quorum-count", "int", default=0, min=0,
                description="extra write quorum (0 = K)"),
+        Option("systematic", "bool", default="off",
+               description="systematic generator matrix "
+                           "(gf256.systematic_matrix): data fragments "
+                           "are raw stripe chunks, so healthy reads "
+                           "skip decode entirely, encode ships only "
+                           "parity to the device, and degraded reads "
+                           "reconstruct only missing rows — the "
+                           "tpu-first layout when the accelerator sits "
+                           "behind a bandwidth-bound link.  The "
+                           "reference's code is non-systematic "
+                           "(ec-method.c:393-433; every read decodes). "
+                           "Fragment formats are incompatible: fixed "
+                           "at volume create, immutable live"),
         Option("self-heal-window-size", "size", default="1M"),
         Option("stripe-cache", "bool", default="on",
                description="coalesce concurrent fop codec work into one "
@@ -207,7 +220,8 @@ class DisperseLayer(Layer):
         self.codec = BatchingCodec(
             self.k, self.r, self.opts["cpu-extensions"],
             window=self.opts["stripe-cache-window"] / 1e6,
-            min_batch=self.opts["stripe-cache-min-batch"])
+            min_batch=self.opts["stripe-cache-min-batch"],
+            systematic=self.opts["systematic"])
         self._batching = self.opts["stripe-cache"]
         self.stripe = self.k * CHUNK
         self.up = [True] * self.n  # xl_up bitmask (ec.c:571 notify)
@@ -232,6 +246,12 @@ class DisperseLayer(Layer):
                         "ignored)", self.name, self.r,
                         self.opts["redundancy"])
             self.opts["redundancy"] = self.r
+        if self.opts["systematic"] != old["systematic"]:
+            # the fragment format on the bricks: flipping it live would
+            # make every existing file decode to garbage
+            log.warning(3, "%s: systematic is immutable live (ignored)",
+                        self.name)
+            self.opts["systematic"] = old["systematic"]
         codec_keys = ("cpu-extensions", "stripe-cache-window",
                       "stripe-cache-min-batch")
         if any(self.opts[k] != old[k] for k in codec_keys):
@@ -241,7 +261,8 @@ class DisperseLayer(Layer):
             self.codec = BatchingCodec(
                 self.k, self.r, self.opts["cpu-extensions"],
                 window=self.opts["stripe-cache-window"] / 1e6,
-                min_batch=self.opts["stripe-cache-min-batch"])
+                min_batch=self.opts["stripe-cache-min-batch"],
+                systematic=self.opts["systematic"])
         self._batching = self.opts["stripe-cache"]
         self._read_mask = self._parse_read_mask()
 
@@ -1029,6 +1050,16 @@ class DisperseLayer(Layer):
             raise FopError(errno.ENOTCONN,
                            f"only {len(candidates)}/{self.n} consistent "
                            f"children, need {self.k}")
+        if self.opts["systematic"]:
+            # data rows ARE the bytes: when all k survive, the read is
+            # a pure reassembly — no decode on any backend, no device
+            # round trip on the TPU route.  Spreading load over parity
+            # bricks (read-policy) would buy balance at the price of a
+            # reconstruction per read; the systematic layout exists to
+            # avoid exactly that
+            data_rows = [i for i in candidates if i < self.k]
+            if len(data_rows) == self.k:
+                return data_rows
         policy = self.opts["read-policy"]
         if policy == "first-k":
             return candidates[: self.k]

@@ -164,13 +164,18 @@ async def _run(args) -> Any:
                 vtype = "replicate"
                 group = int(rest[1])
                 rest = rest[2:]
-            arbiter = thin = 0
+            arbiter = thin = systematic = 0
             if rest and rest[0] == "arbiter":
                 arbiter = int(rest[1])
                 rest = rest[2:]
             if rest and rest[0] == "thin-arbiter":
                 thin = int(rest[1])
                 rest = rest[2:]
+            if rest and rest[0] == "systematic":
+                # fragment format flag (create-time only; see
+                # cluster/disperse "systematic")
+                systematic = 1
+                rest = rest[1:]
             bricks = [{"path": b.split(":", 1)[-1],
                        "host": "127.0.0.1"} for b in rest]
             async with MgmtClient(host, port) as c:
@@ -178,7 +183,8 @@ async def _run(args) -> Any:
                                     vtype=vtype, bricks=bricks,
                                     redundancy=redundancy,
                                     group_size=group, arbiter=arbiter,
-                                    thin_arbiter=thin)
+                                    thin_arbiter=thin,
+                                    systematic=systematic)
         if sub in ("start", "stop", "delete", "status"):
             async with MgmtClient(host, port) as c:
                 return await c.call(f"volume-{sub}", name=args.name)

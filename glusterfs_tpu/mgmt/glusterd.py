@@ -743,7 +743,8 @@ class Glusterd:
                                bricks: list, redundancy: int = 2,
                                group_size: int = 0,
                                arbiter: int = 0,
-                               thin_arbiter: int = 0) -> dict:
+                               thin_arbiter: int = 0,
+                               systematic: int = 0) -> dict:
         """bricks: list of {host, port(optional: mgmt node), path} or
         'host:/path' strings; host must match a node's host:port mgmt id
         or 'localhost'."""
@@ -781,6 +782,20 @@ class Glusterd:
                 raise MgmtError("thin-arbiter needs replica 2 + one "
                                 "tie-breaker brick (3 bricks)")
             volinfo["thin-arbiter"] = 1
+        if systematic:
+            if vtype != "disperse":
+                raise MgmtError("systematic applies to disperse volumes")
+            # mixed-version guard (same gate volume-set keys get): an
+            # older peer's volgen has no systematic branch and would
+            # hand clients non-systematic volfiles for this volume —
+            # writes through them would corrupt the fragment format
+            if self.cluster_op_version() < 4:
+                raise MgmtError(
+                    "systematic volumes need cluster op-version >= 4 "
+                    f"(cluster is at {self.cluster_op_version()})")
+            # fragment format on the bricks: create-time only (flipping
+            # it on existing fragments decodes to garbage)
+            volinfo["systematic"] = 1
         if vtype == "disperse":
             n = len(parsed)
             g = group_size or n

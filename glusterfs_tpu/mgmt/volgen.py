@@ -506,6 +506,10 @@ def build_client_volfile(volinfo: dict,
         if vtype == "disperse":
             lname = f"{vname}-disperse-{idx}"
             opts = {"redundancy": volinfo.get("redundancy", 2)}
+            if volinfo.get("systematic"):
+                # fragment format, chosen at volume-create (immutable
+                # live — see cluster/disperse "systematic")
+                opts["systematic"] = "on"
             opts.update(layer_options(volinfo, "cluster/disperse"))
             out.append(_emit(lname, "cluster/disperse", opts, children))
         elif vtype == "replicate":

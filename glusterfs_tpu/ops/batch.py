@@ -123,8 +123,9 @@ class BatchingCodec(Codec):
 
     def __init__(self, k: int, r: int, backend: str = "auto", *,
                  window: float = 0.0, min_batch: int = 256 * 1024,
-                 max_batch_bytes: int = 256 << 20):
-        super().__init__(k, r, backend)
+                 max_batch_bytes: int = 256 << 20,
+                 systematic: bool = False):
+        super().__init__(k, r, backend, systematic=systematic)
         self.window = window
         self.min_batch = min_batch
         self.max_batch_bytes = max_batch_bytes
@@ -177,9 +178,11 @@ class BatchingCodec(Codec):
         if self._cpu is None:
             if self.backend in _DEVICE_BACKENDS:
                 try:
-                    self._cpu = Codec(self.k, self.r, "native")
+                    self._cpu = Codec(self.k, self.r, "native",
+                                      systematic=self.systematic)
                 except RuntimeError:
-                    self._cpu = Codec(self.k, self.r, "ref")
+                    self._cpu = Codec(self.k, self.r, "ref",
+                                      systematic=self.systematic)
             else:
                 self._cpu = self  # already a CPU ladder backend
         return self._cpu

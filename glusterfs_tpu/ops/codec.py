@@ -89,6 +89,11 @@ def detect(requested: str = "auto") -> str:
 
 
 @functools.cache
+def _encode_bits_sys(k: int, n: int) -> np.ndarray:
+    return gf256.expand_bitmatrix(gf256.systematic_matrix(k, n))
+
+
+@functools.cache
 def _encode_bits(k: int, n: int) -> np.ndarray:
     return gf256.expand_bitmatrix(gf256.encode_matrix(k, n))
 
@@ -107,7 +112,8 @@ class Codec:
     ec-inode-write.c in the reference.
     """
 
-    def __init__(self, k: int, r: int, backend: str = "auto"):
+    def __init__(self, k: int, r: int, backend: str = "auto",
+                 systematic: bool = False):
         if k < 1 or r < 0 or k > gf256.MAX_FRAGMENTS:
             raise ValueError(f"bad k={k}, r={r} (k <= {gf256.MAX_FRAGMENTS})")
         self.k = k
@@ -121,6 +127,17 @@ class Codec:
         # encode rides the MXU); an EXPLICIT backend is honored as-is
         self._auto = backend == "auto"
         self.backend = detect(backend)
+        # systematic generator (gf256.systematic_matrix): data rows are
+        # raw stripe chunks — healthy reads need no math, encode ships
+        # only parity off-device, degraded reads reconstruct only the
+        # missing rows.  Incompatible fragment format with the default
+        # (reference-parity) code: fixed per volume at create.
+        self.systematic = systematic
+        if systematic and self.backend == "mesh":
+            if backend == "mesh":
+                raise ValueError(
+                    "mesh backend has no systematic mode yet")
+            self.backend = "pallas-xor"  # auto on multi-chip: serve 1-chip
 
     # -- encode ------------------------------------------------------------
 
@@ -130,6 +147,8 @@ class Codec:
             raise ValueError(
                 f"data length {data.size} not a multiple of stripe "
                 f"{self.stripe_size}")
+        if self.systematic:
+            return self._encode_systematic(data)
         b = self.backend
         if b == "ref":
             return gf256.ref_encode(data, self.k, self.n)
@@ -168,6 +187,8 @@ class Codec:
         if any(x < 0 or x >= self.n for x in rows):
             raise ValueError("fragment index out of range")
         frags = np.ascontiguousarray(frags, dtype=np.uint8)
+        if self.systematic:
+            return self._decode_systematic(frags, rows)
         b = self.backend
         if b == "ref":
             return gf256.ref_decode(frags, rows, self.k)
@@ -191,6 +212,78 @@ class Codec:
 
         form = "fused" if b == "pallas-xor" else "mxu"
         return gf256_pallas.decode(frags, rows, self.k, form)
+
+    # -- systematic paths (disperse.systematic) ----------------------------
+
+    def _data_rows(self, data: np.ndarray) -> np.ndarray:
+        """Data fragments of the systematic code: a pure host reshape of
+        the stripe-major bytes (fragment j = chunk j of every stripe)."""
+        s = data.size // self.stripe_size
+        c = self.fragment_chunk
+        return np.ascontiguousarray(
+            data.reshape(s, self.k, c).transpose(1, 0, 2)).reshape(
+                self.k, s * c)
+
+    def _encode_systematic(self, data: np.ndarray) -> np.ndarray:
+        b = self.backend
+        if b in ("pallas-xor", "pallas-mxu"):
+            # the device computes (and the link carries) ONLY parity
+            from . import gf256_pallas
+
+            s = data.size // self.stripe_size
+            out = np.empty((self.n, s * self.fragment_chunk),
+                           dtype=np.uint8)
+            out[: self.k] = self._data_rows(data)
+            out[self.k:] = gf256_pallas.parity(data, self.k, self.n)
+            return out
+        if b == "native":
+            from glusterfs_tpu import native
+
+            return native.encode(data, self.k, self.n,
+                                 _encode_bits_sys(self.k, self.n))
+        if b in ("xla", "xla-xor"):
+            from . import gf256_xla
+
+            form = "xor" if b == "xla-xor" else "matmul"
+            return gf256_xla.encode(data, self.k, self.n, form,
+                                    systematic=True)
+        return gf256.ref_encode(data, self.k, self.n, systematic=True)
+
+    def _decode_systematic(self, frags: np.ndarray, rows) -> np.ndarray:
+        k, c = self.k, self.fragment_chunk
+        s = frags.shape[1] // c
+        missing = [j for j in range(k) if j not in rows]
+        if not missing:
+            # healthy read: every data row survived — pure host assembly
+            out = np.empty((s, k, c), dtype=np.uint8)
+            for idx, row in enumerate(rows):
+                out[:, row, :] = frags[idx].reshape(s, c)
+            return out.reshape(-1)
+        b = self.backend
+        if b in ("pallas-xor", "pallas-mxu"):
+            # degraded: reconstruct ONLY the missing data rows on device
+            from . import gf256_pallas
+
+            rec = gf256_pallas.reconstruct(frags, tuple(rows),
+                                           tuple(missing), k)
+            out = np.empty((s, k, c), dtype=np.uint8)
+            for idx, row in enumerate(rows):
+                if row < k:
+                    out[:, row, :] = frags[idx].reshape(s, c)
+            for i, j in enumerate(missing):
+                out[:, j, :] = rec[i].reshape(s, c)
+            return out.reshape(-1)
+        if b == "native":
+            from glusterfs_tpu import native
+
+            return native.decode(
+                frags, k, gf256.decode_bits_cached(k, tuple(rows), True))
+        if b in ("xla", "xla-xor"):
+            from . import gf256_xla
+
+            form = "xor" if b == "xla-xor" else "matmul"
+            return gf256_xla.decode(frags, rows, k, form, systematic=True)
+        return gf256.ref_decode(frags, rows, k, systematic=True)
 
     # -- convenience -------------------------------------------------------
 

@@ -122,6 +122,37 @@ def encode_matrix(k: int, n: int) -> np.ndarray:
     return out
 
 
+@functools.lru_cache(maxsize=64)
+def systematic_matrix(k: int, n: int) -> np.ndarray:
+    """Systematic generator: ``V @ inv(V[:k])`` for the Vandermonde V of
+    :func:`encode_matrix` — rows 0..k-1 are the identity (data fragments
+    ARE the raw stripe chunks), rows k.. are parity.  Any k rows stay
+    invertible (each is ``V[rows] @ inv(V[:k])`` with both factors
+    invertible).
+
+    The reference's code is non-systematic (every fragment is a codeword;
+    reads always decode, ec-method.c:393-433) — fine when decode is a
+    cheap local AVX pass.  On a TPU behind a bandwidth-bound link the
+    systematic form is the tpu-first layout: healthy reads touch no
+    device at all, encode ships back only the parity rows, degraded
+    reads reconstruct only the missing rows.  Selected per volume via
+    ``disperse.systematic``."""
+    v = encode_matrix(k, n).astype(np.int64)
+    inv = invert_matrix(encode_matrix(k, k)).astype(np.int64)
+    out = np.zeros((n, k), dtype=np.uint8)
+    for i in range(n):
+        for j in range(k):
+            acc = 0
+            for t in range(k):
+                acc ^= gf_mul(int(v[i, t]), int(inv[t, j]))
+            out[i, j] = acc
+    return out
+
+
+def generator_matrix(k: int, n: int, systematic: bool = False) -> np.ndarray:
+    return systematic_matrix(k, n) if systematic else encode_matrix(k, n)
+
+
 def invert_matrix(a: np.ndarray) -> np.ndarray:
     """Gauss-Jordan inverse over GF(256)."""
     a = a.astype(np.int32)
@@ -150,21 +181,40 @@ def invert_matrix(a: np.ndarray) -> np.ndarray:
     return inv.astype(np.uint8)
 
 
-def decode_matrix(k: int, rows: np.ndarray | list[int]) -> np.ndarray:
-    """Inverse of the encode-matrix rows `rows` (surviving fragment indices)."""
+def decode_matrix(k: int, rows: np.ndarray | list[int],
+                  systematic: bool = False) -> np.ndarray:
+    """Inverse of the generator-matrix rows `rows` (surviving indices)."""
     rows = np.asarray(rows, dtype=np.int64)
     if len(rows) != k:
         raise ValueError(f"need exactly {k} surviving fragments, got {len(rows)}")
-    sub = encode_matrix(k, int(rows.max()) + 1)[rows]
+    sub = generator_matrix(k, int(rows.max()) + 1, systematic)[rows]
     return invert_matrix(sub)
 
 
 @functools.lru_cache(maxsize=256)
-def decode_bits_cached(k: int, rows: tuple[int, ...]) -> np.ndarray:
+def decode_bits_cached(k: int, rows: tuple[int, ...],
+                       systematic: bool = False) -> np.ndarray:
     """Per-surviving-mask cached decode bit-matrix — the one LRU shared by
     every backend (the reference keeps an equivalent LRU of inverted
     matrices keyed by fragment bitmask, ec-method.c:200-245)."""
-    return expand_bitmatrix(decode_matrix(k, list(rows)))
+    return expand_bitmatrix(decode_matrix(k, list(rows), systematic))
+
+
+@functools.lru_cache(maxsize=64)
+def parity_bits_cached(k: int, n: int) -> np.ndarray:
+    """Bit-matrix of the systematic generator's parity rows only
+    ((n-k)*8, k*8): the device work of a systematic encode."""
+    return expand_bitmatrix(systematic_matrix(k, n)[k:])
+
+
+@functools.lru_cache(maxsize=256)
+def reconstruct_bits_cached(k: int, rows: tuple[int, ...],
+                            wanted: tuple[int, ...]) -> np.ndarray:
+    """Bit-matrix mapping k systematic survivors (indices ``rows``) to
+    just the ``wanted`` data rows (len(wanted)*8, k*8): a degraded
+    systematic read reconstructs ONLY what is missing."""
+    m = decode_matrix(k, list(rows), systematic=True)
+    return expand_bitmatrix(m[list(wanted)])
 
 
 def expand_bitmatrix(coeff: np.ndarray) -> np.ndarray:
@@ -207,7 +257,8 @@ def _xor_matmul_planes(abits: np.ndarray, x: np.ndarray) -> np.ndarray:
     return out
 
 
-def ref_encode(data: np.ndarray, k: int, n: int) -> np.ndarray:
+def ref_encode(data: np.ndarray, k: int, n: int,
+               systematic: bool = False) -> np.ndarray:
     """Encode `data` (length multiple of k*512) into n fragments.
 
     Returns (n, S*512) uint8 — fragment i is the concatenation of its chunk
@@ -217,7 +268,7 @@ def ref_encode(data: np.ndarray, k: int, n: int) -> np.ndarray:
     data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
     if data.size % (k * CHUNK_SIZE):
         raise ValueError("data length must be a multiple of k*512")
-    abits = expand_bitmatrix(encode_matrix(k, n))
+    abits = expand_bitmatrix(generator_matrix(k, n, systematic))
     x = _to_planes(data, k)  # (S, k*8, 64)
     y = _xor_matmul_planes(abits, x)  # (S, n*8, 64)
     s = x.shape[0]
@@ -246,9 +297,10 @@ def frags_to_planes(frags: np.ndarray, k: int) -> np.ndarray:
     )
 
 
-def ref_decode(frags: np.ndarray, rows, k: int) -> np.ndarray:
+def ref_decode(frags: np.ndarray, rows, k: int,
+               systematic: bool = False) -> np.ndarray:
     """Decode k fragments (k, S*512) given their indices `rows` -> (S*k*512,)."""
-    bbits = expand_bitmatrix(decode_matrix(k, rows))
+    bbits = expand_bitmatrix(decode_matrix(k, rows, systematic))
     x = frags_to_planes(frags, k)  # (S, k*8, 64)
     y = _xor_matmul_planes(bbits, x)  # (S, k*8, 64)
     return y.reshape(x.shape[0] * k * CHUNK_SIZE).copy()

@@ -393,6 +393,150 @@ def _fused_decode_fn(k: int, rows: tuple[int, ...], interpret: bool):
 
 
 # ---------------------------------------------------------------------------
+# Systematic serving kernels (disperse.systematic): over a bandwidth-
+# bound host<->device link (the dev tunnel moves ~10 MiB/s/direction)
+# the transfer, not the XOR math, is the cost — so the device computes
+# and ships ONLY what the host cannot reshape for itself: parity rows on
+# encode, missing data rows on degraded decode.  gf256.systematic_matrix
+# documents the design choice vs the reference's non-systematic code.
+# ---------------------------------------------------------------------------
+
+
+def _program_reconstruct_kernel(ops: tuple, outs: tuple, k: int, m: int):
+    """Fragment-major survivors in -> fragment-major wanted rows out
+    (decode-style input, encode-style output; same transposed CSE'd
+    program geometry as _program_encode_kernel)."""
+
+    def kernel(x_ref, o_ref):
+        xt = jnp.concatenate([x_ref[f] for f in range(k)], axis=1).T
+        t = [xt[j * 64:(j + 1) * 64, :] for j in range(k * 8)]
+        for dst, a, b in ops:
+            t.append(t[a] ^ t[b])
+        for f in range(m):
+            accs = []
+            for b in range(8):
+                o = outs[f * 8 + b]
+                acc = t[o[0]]
+                for v in o[1:]:
+                    acc = acc ^ t[v]
+                accs.append(acc)
+            o_ref[f] = jnp.concatenate(accs, axis=0).T  # (ts, 512)
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=64)
+def _fused_parity_fn(k: int, n: int, interpret: bool):
+    """jitted: flat stripe-major bytes (S*k*512,) -> parity fragments
+    ONLY ((n-k), S*512) of the systematic code — D2H is r/k of the data
+    instead of n/k."""
+    abits = gf256.parity_bits_cached(k, n)
+    ops, outs = gf256.xor_program(tuple(map(tuple, abits.tolist())))
+    ts = _enc_ts(k)
+    r = n - k
+    kernel = _program_encode_kernel(ops, outs, k, r)
+
+    @jax.jit
+    def run(flat):
+        s = flat.shape[0] // (k * gf256.CHUNK_SIZE)
+        sp = (s + ts - 1) // ts * ts
+        x = flat.reshape(s, k * gf256.CHUNK_SIZE)
+        if sp != s:
+            x = jnp.pad(x, ((0, sp - s), (0, 0)))
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((r, sp, 512), jnp.uint8),
+            grid=(sp // ts,),
+            in_specs=[pl.BlockSpec((ts, k * 512), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((r, ts, 512), lambda i: (0, i, 0),
+                                   memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(x)
+        return out[:, :s, :].reshape(r, s * gf256.CHUNK_SIZE)
+
+    return run
+
+
+@functools.lru_cache(maxsize=256)
+def _fused_reconstruct_fn(k: int, rows: tuple[int, ...],
+                          wanted: tuple[int, ...], interpret: bool):
+    """jitted: systematic survivors (k, S*512) fragment-major ->
+    ONLY the ``wanted`` missing data rows (len(wanted), S*512) — D2H is
+    missing/k of the data instead of all of it."""
+    bbits = gf256.reconstruct_bits_cached(k, rows, wanted)
+    ops, outs = gf256.xor_program(tuple(map(tuple, bbits.tolist())))
+    ts = _dec_ts(k)
+    m = len(wanted)
+    kernel = _program_reconstruct_kernel(ops, outs, k, m)
+
+    @jax.jit
+    def run(frags):
+        s = frags.shape[1] // gf256.CHUNK_SIZE
+        sp = (s + ts - 1) // ts * ts
+        x = frags.reshape(k, s, 512)
+        if sp != s:
+            x = jnp.pad(x, ((0, 0), (0, sp - s), (0, 0)))
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((m, sp, 512), jnp.uint8),
+            grid=(sp // ts,),
+            in_specs=[pl.BlockSpec((k, ts, 512), lambda i: (0, i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((m, ts, 512), lambda i: (0, i, 0),
+                                   memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(x)
+        return out[:, :s, :].reshape(m, s * gf256.CHUNK_SIZE)
+
+    return run
+
+
+# Pipelined-launch threshold.  Measured on the dev tunnel (16 MiB of
+# data, 4+2): one whole launch 24 MiB/s vs 4 MiB chunks 16.7 — the
+# per-call floor costs more than launch-ahead overlap buys at serving
+# sizes, so only genuinely huge batches split (bounds device memory for
+# them too).  The probe that motivated chunking measured a different
+# link window; the tunnel swings 3x (docs/perf_variance.md).
+_PARITY_CHUNK_BYTES = 64 << 20
+
+
+def parity(data: np.ndarray, k: int, n: int,
+           interpret: bool = False) -> np.ndarray:
+    """Systematic parity rows ((n-k), S*512) for stripe-major bytes.
+
+    Large inputs are split into fixed-shape chunks that are ALL
+    launched before any result is fetched — the link, not the kernel,
+    is the cost, and this pipelines its two directions."""
+    data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
+    stripe = k * gf256.CHUNK_SIZE
+    s = data.size // stripe
+    cs = max(1, _PARITY_CHUNK_BYTES // stripe)
+    fn = _fused_parity_fn(k, n, interpret)
+    if s <= cs:
+        return np.asarray(fn(jnp.asarray(data)))
+    launches = []
+    for off in range(0, s, cs):
+        w = min(cs, s - off)
+        chunk = data[off * stripe:(off + w) * stripe]
+        if w < cs:  # pad the tail so every launch shares one jit shape
+            chunk = np.concatenate(
+                [chunk, np.zeros((cs - w) * stripe, dtype=np.uint8)])
+        launches.append((fn(jnp.asarray(chunk)), w))
+    return np.concatenate(
+        [np.asarray(d)[:, : w * gf256.CHUNK_SIZE] for d, w in launches],
+        axis=1)
+
+
+def reconstruct(frags: np.ndarray, rows, wanted, k: int,
+                interpret: bool = False) -> np.ndarray:
+    """Missing systematic data rows from k survivors (fragment-major)."""
+    fn = _fused_reconstruct_fn(k, tuple(int(x) for x in rows),
+                               tuple(int(x) for x in wanted), interpret)
+    return np.asarray(fn(jnp.asarray(frags)))
+
+
+# ---------------------------------------------------------------------------
 # Stripe-major wrappers (same API as gf256_xla): transpose sandwich.
 # ---------------------------------------------------------------------------
 

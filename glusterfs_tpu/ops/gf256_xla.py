@@ -77,8 +77,9 @@ def _apply_xor(abits_np: np.ndarray, x: jnp.ndarray) -> jnp.ndarray:
 
 
 @functools.lru_cache(maxsize=64)
-def _encode_fn(k: int, n: int, formulation: str):
-    abits_np = gf256.expand_bitmatrix(gf256.encode_matrix(k, n))
+def _encode_fn(k: int, n: int, formulation: str, systematic: bool = False):
+    abits_np = gf256.expand_bitmatrix(gf256.generator_matrix(k, n,
+                                                             systematic))
 
     def run(data: jnp.ndarray) -> jnp.ndarray:
         s = data.shape[0] // (k * gf256.CHUNK_SIZE)
@@ -115,21 +116,24 @@ def _decode_fn(k: int, formulation: str, static_bbits: tuple | None):
     return jax.jit(run)
 
 
-def encode(data: np.ndarray, k: int, n: int, formulation: str = "matmul") -> np.ndarray:
+def encode(data: np.ndarray, k: int, n: int, formulation: str = "matmul",
+           systematic: bool = False) -> np.ndarray:
     """Encode bytes (len multiple of k*512) -> (n, S*512) fragments."""
     data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
     if data.size % (k * gf256.CHUNK_SIZE):
         raise ValueError("data length must be a multiple of k*512")
-    out = _encode_fn(k, n, formulation)(jnp.asarray(data))
+    out = _encode_fn(k, n, formulation, systematic)(jnp.asarray(data))
     return np.asarray(out)
 
 
 def decode(
-    frags: np.ndarray, rows, k: int, formulation: str = "matmul"
+    frags: np.ndarray, rows, k: int, formulation: str = "matmul",
+    systematic: bool = False
 ) -> np.ndarray:
     """Decode k fragments (k, S*512) with indices `rows` -> original bytes."""
     frags = np.ascontiguousarray(frags, dtype=np.uint8)
-    bbits_np = gf256.decode_bits_cached(k, tuple(int(x) for x in rows))
+    bbits_np = gf256.decode_bits_cached(k, tuple(int(x) for x in rows),
+                                        systematic)
     if formulation == "xor":
         fn = _decode_fn(k, "xor", tuple(map(tuple, bbits_np)))
         out = fn(jnp.asarray(frags), None)

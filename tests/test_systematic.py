@@ -1,0 +1,286 @@
+"""disperse.systematic — the systematic generator (data fragments are
+raw stripe chunks; gf256.systematic_matrix).  The reference's code is
+non-systematic (ec-method.c:393-433: every fragment is a codeword, every
+read decodes); the systematic form is this framework's tpu-first layout
+for device-behind-a-link serving: healthy reads skip decode, encode
+ships only parity off-device, degraded reads reconstruct only the
+missing rows."""
+
+import random
+
+import numpy as np
+import pytest
+
+from glusterfs_tpu.api.glfs import SyncClient
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.ops import gf256, gf256_pallas
+from glusterfs_tpu.ops.codec import Codec
+from glusterfs_tpu.utils.volspec import ec_volfile
+
+K, R = 4, 2
+N = K + R
+STRIPE = K * 512
+
+
+def _rand(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+# -- matrix ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k,n", [(1, 2), (2, 3), (4, 6), (8, 12),
+                                 (16, 20)])
+def test_systematic_matrix_properties(k, n):
+    m = np.asarray(gf256.systematic_matrix(k, n))
+    assert np.array_equal(m[:k], np.eye(k, dtype=np.uint8))
+    rnd = random.Random(k * n)
+    for _ in range(8):
+        rows = sorted(rnd.sample(range(n), k))
+        gf256.decode_matrix(k, rows, systematic=True)  # raises if singular
+
+
+def test_ref_systematic_round_trip_any_rows():
+    data = _rand(5 * STRIPE)
+    fr = gf256.ref_encode(data, K, N, systematic=True)
+    s = data.size // STRIPE
+    chunks = data.reshape(s, K, 512).transpose(1, 0, 2).reshape(K, -1)
+    assert np.array_equal(fr[:K], chunks)  # data rows ARE the chunks
+    rnd = random.Random(7)
+    for _ in range(6):
+        rows = sorted(rnd.sample(range(N), K))
+        out = gf256.ref_decode(fr[rows], rows, K, systematic=True)
+        assert np.array_equal(out, data), rows
+
+
+def test_formats_are_incompatible():
+    """Guard against silently mixing the two fragment formats."""
+    data = _rand(2 * STRIPE, seed=1)
+    sys_fr = gf256.ref_encode(data, K, N, systematic=True)
+    ref_fr = gf256.ref_encode(data, K, N)
+    assert not np.array_equal(sys_fr, ref_fr)
+
+
+# -- codec backends ----------------------------------------------------
+
+
+def _backends():
+    out = ["ref"]
+    try:
+        from glusterfs_tpu import native
+
+        if native.available():
+            out.append("native")
+    except Exception:
+        pass
+    out += ["xla", "xla-xor"]
+    return out
+
+
+@pytest.mark.parametrize("backend", _backends())
+def test_codec_backends_byte_exact(backend):
+    data = _rand(6 * STRIPE, seed=2)
+    oracle = gf256.ref_encode(data, K, N, systematic=True)
+    c = Codec(K, R, backend, systematic=True)
+    fr = c.encode(data)
+    assert np.array_equal(fr, oracle), backend
+    rnd = random.Random(3)
+    for _ in range(4):
+        rows = sorted(rnd.sample(range(N), K))
+        out = c.decode(fr[rows], rows)
+        assert np.array_equal(out, data), (backend, rows)
+
+
+def test_identity_decode_is_host_only():
+    """All-data-rows decode must be pure assembly: byte-exact and never
+    touching any math backend (we use ref and compare to raw chunks)."""
+    data = _rand(3 * STRIPE, seed=4)
+    c = Codec(K, R, "ref", systematic=True)
+    fr = c.encode(data)
+    out = c.decode(fr[: K], list(range(K)))
+    assert np.array_equal(out, data)
+    # shuffled survivor order too
+    order = [2, 0, 3, 1]
+    out = c.decode(fr[order], order)
+    assert np.array_equal(out, data)
+
+
+# -- pallas kernels (interpret; silicon variant below) -----------------
+
+
+@pytest.mark.parametrize("k,r", [(4, 2), (8, 4), (16, 4)])
+def test_pallas_parity_and_reconstruct_interpret(k, r):
+    n = k + r
+    data = _rand(3 * k * 512, seed=5 + k)
+    full = gf256.ref_encode(data, k, n, systematic=True)
+    par = gf256_pallas.parity(data, k, n, interpret=True)
+    assert np.array_equal(par, full[k:])
+    rnd = random.Random(6)
+    for _ in range(3):
+        rows = tuple(sorted(rnd.sample(range(n), k)))
+        missing = tuple(j for j in range(k) if j not in rows)
+        if not missing:
+            continue
+        rec = gf256_pallas.reconstruct(full[list(rows)], rows, missing,
+                                       k, interpret=True)
+        assert np.array_equal(rec, full[list(missing)]), rows
+
+
+def _tpu():
+    try:
+        import jax
+
+        return any(d.platform in ("tpu", "axon") for d in jax.devices())
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _tpu(), reason="needs a real TPU")
+@pytest.mark.parametrize("k,r", [(4, 2), (16, 4)])
+def test_pallas_systematic_on_silicon(k, r):
+    n = k + r
+    data = _rand(300 * k * 512, seed=9)
+    full = gf256.ref_encode(data, k, n, systematic=True)
+    assert np.array_equal(gf256_pallas.parity(data, k, n), full[k:])
+    rows = tuple(range(1, k + 1))
+    missing = (0,)
+    rec = gf256_pallas.reconstruct(full[list(rows)], rows, missing, k)
+    assert np.array_equal(rec, full[:1])
+
+
+# -- volume-level ------------------------------------------------------
+
+
+def _mount(tmp_path, options=None):
+    g = Graph.construct(ec_volfile(
+        tmp_path, N, R,
+        options={"systematic": "on", **(options or {})}))
+    c = SyncClient(g)
+    c.mount()
+    return c, g.top
+
+
+def test_systematic_volume_round_trip_and_read_rows(tmp_path):
+    """Healthy reads on a systematic volume come from the K data bricks
+    only (no decode) and the bytes are exact."""
+    c, ec = _mount(tmp_path)
+    try:
+        data = _rand(4 * STRIPE, seed=11).tobytes()
+        c.write_file("/f", data)
+
+        def counts():
+            return [ec.children[i].stats["readv"].count
+                    if "readv" in ec.children[i].stats else 0
+                    for i in range(N)]
+
+        before = counts()
+        assert c.read_file("/f") == data
+        after = counts()
+        assert after[4] == before[4] and after[5] == before[5], \
+            "parity bricks served a healthy systematic read"
+    finally:
+        c.close()
+
+
+def test_systematic_degraded_read_and_unaligned_write(tmp_path):
+    c, ec = _mount(tmp_path)
+    try:
+        data = _rand(4 * STRIPE, seed=12).tobytes()
+        c.write_file("/g", data)
+        ec.up[0] = False  # lose a data brick: reads must reconstruct
+        assert c.read_file("/g") == data
+        f = c.open("/g")
+        f.write(b"Q" * 777, 100)  # unaligned RMW while degraded
+        f.close()
+        exp = bytearray(data)
+        exp[100:877] = b"Q" * 777
+        assert c.read_file("/g") == bytes(exp)
+    finally:
+        c.close()
+
+
+def test_systematic_fragments_on_bricks_match_oracle(tmp_path):
+    c, ec = _mount(tmp_path)
+    try:
+        data = _rand(2 * STRIPE, seed=13)
+        c.write_file("/h", data.tobytes())
+    finally:
+        c.close()
+    import os
+
+    oracle = gf256.ref_encode(data, K, N, systematic=True)
+    for i in range(N):
+        frag = open(os.path.join(str(tmp_path), f"brick{i}", "h"),
+                    "rb").read()
+        assert frag == oracle[i].tobytes(), f"brick {i}"
+
+
+def test_systematic_is_immutable_live(tmp_path):
+    c, ec = _mount(tmp_path)
+    try:
+        ec.reconfigure({"systematic": "off"})
+        assert ec.opts["systematic"] is True
+        assert ec.codec.systematic is True
+    finally:
+        c.close()
+
+
+def test_systematic_managed_volume_over_wire(tmp_path):
+    """volume-create ... systematic through glusterd: the flag rides
+    volinfo into the client volfile, fragments on the real bricks are
+    the systematic oracle's bytes, and wire reads are exact."""
+    import asyncio
+    import glob
+    import os
+
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    data = _rand(2 * STRIPE, seed=21)
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="sv", vtype="disperse",
+                             bricks=[{"path": str(tmp_path / f"b{i}")}
+                                     for i in range(N)],
+                             redundancy=R, systematic=1)
+                await c.call("volume-start", name="sv")
+            cl = await mount_volume(d.host, d.port, "sv")
+            try:
+                await cl.write_file("/x", data.tobytes())
+                assert await cl.read_file("/x") == data.tobytes()
+            finally:
+                await cl.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+    oracle = gf256.ref_encode(data, K, N, systematic=True)
+    for i in range(N):
+        frag = open(str(tmp_path / f"b{i}" / "x"), "rb").read()
+        assert frag == oracle[i].tobytes(), f"brick {i}"
+
+
+def test_systematic_heal_rebuilds_reference_bytes(tmp_path):
+    """Kill a brick, overwrite, revive, heal: the healed fragment must
+    be the systematic oracle's bytes for the new content."""
+    import os
+
+    c, ec = _mount(tmp_path)
+    try:
+        data1 = _rand(2 * STRIPE, seed=14)
+        c.write_file("/z", data1.tobytes())
+        ec.set_child_up(2, False)
+        data2 = _rand(2 * STRIPE, seed=15)
+        c.write_file("/z", data2.tobytes())
+        ec.set_child_up(2, True)
+        c._run(ec.heal_file("/z"))
+        assert c.read_file("/z") == data2.tobytes()
+    finally:
+        c.close()
+    oracle = gf256.ref_encode(data2, K, N, systematic=True)
+    frag = open(os.path.join(str(tmp_path), "brick2", "z"), "rb").read()
+    assert frag == oracle[2].tobytes()
