@@ -82,6 +82,49 @@ def available() -> bool:
         return False
 
 
+_WIREC = None
+_WIREC_ERROR: str | None = None
+
+
+def wirec_module():
+    """Build-on-demand CPython extension for the wire codec (the XDR
+    layer's C analog — src/wirec.c).  Returns the module or raises
+    RuntimeError; rpc/wire.py falls back to its pure-Python codec."""
+    global _WIREC, _WIREC_ERROR
+    with _LOCK:
+        if _WIREC is not None:
+            return _WIREC
+        if _WIREC_ERROR is not None:
+            raise RuntimeError(_WIREC_ERROR)
+        try:
+            import importlib.machinery
+            import importlib.util
+            import sysconfig
+
+            src = os.path.join(_DIR, "src", "wirec.c")
+            h = hashlib.sha256()
+            with open(src, "rb") as f:
+                h.update(f.read())
+            tag = h.hexdigest()[:16]
+            so = os.path.join(_DIR, f"_wirec_{tag}.so")
+            if not os.path.exists(so):
+                tmp = f"{so}.{os.getpid()}.tmp"
+                cmd = ["gcc", "-O2", "-fPIC", "-shared",
+                       "-I", sysconfig.get_paths()["include"],
+                       src, "-o", tmp]
+                subprocess.run(cmd, check=True, capture_output=True)
+                os.replace(tmp, so)
+            loader = importlib.machinery.ExtensionFileLoader("_wirec", so)
+            spec = importlib.util.spec_from_loader("_wirec", loader)
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+            _WIREC = mod
+            return mod
+        except Exception as e:
+            _WIREC_ERROR = f"wirec build failed: {e}"
+            raise RuntimeError(_WIREC_ERROR) from e
+
+
 def _u8p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 

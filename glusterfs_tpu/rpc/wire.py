@@ -24,6 +24,7 @@ adds no copy beyond the socket read itself.
 
 from __future__ import annotations
 
+import os
 import struct
 from typing import Any
 
@@ -267,10 +268,50 @@ def decode_value(buf: memoryview, pos: int,
     raise WireError(f"bad tag {tag}")
 
 
-def pack(xid: int, mtype: int, payload: Any) -> bytes:
+# ---------------------------------------------------------------------
+# C codec (native/src/wirec.c — the XDR-is-generated-C analog): same
+# format bit-for-bit, built on demand; this module is the fallback.
+# Disable with GFTPU_NO_WIREC=1.
+# ---------------------------------------------------------------------
+
+_wirec = None
+if not os.environ.get("GFTPU_NO_WIREC"):
+    try:
+        from glusterfs_tpu import native as _native
+
+        _wirec = _native.wirec_module()
+        _wirec.register(
+            Iatt, Loc, FdHandle, FopError, Blob,
+            lambda v: Iatt(gfid=v[0], ia_type=IAType(v[1]), mode=v[2],
+                           nlink=v[3], uid=v[4], gid=v[5], size=v[6],
+                           blocks=v[7], atime=v[8], mtime=v[9],
+                           ctime=v[10], rdev=v[11], blksize=v[12]),
+            lambda v: Loc(v[0], gfid=v[1], parent=v[2], name=v[3]),
+            lambda v: FdHandle(v[0], v[1], v[2]),
+            lambda v: FopError(v[0], v[1]),
+            WireError, blob_stats)
+    except Exception:  # no toolchain: pure-Python codec serves
+        _wirec = None
+
+
+def _encode_body(payload: Any, blobs: list | None) -> bytes:
+    if _wirec is not None:
+        return _wirec.encode(payload, blobs if blobs is not None
+                             else None)
     body = bytearray()
-    encode_value(payload, body)
-    rec = _HDR.pack(xid, mtype, 0) + bytes(body)
+    encode_value(payload, body, blobs)
+    return bytes(body)
+
+
+def _decode_body(buf, pos: int, blobs: list | None = None):
+    if _wirec is not None and \
+            (blobs is None or isinstance(blobs[0], memoryview)):
+        return _wirec.decode(buf, pos, blobs)
+    return decode_value(buf, pos, blobs)
+
+
+def pack(xid: int, mtype: int, payload: Any) -> bytes:
+    rec = _HDR.pack(xid, mtype, 0) + _encode_body(payload, None)
     return struct.pack(">I", len(rec)) + rec
 
 
@@ -281,17 +322,16 @@ def pack_frames(xid: int, mtype: int, payload: Any) -> list:
     prefix (length, header, body-length, body) followed by the blob
     buffers THEMSELVES — file data crosses into the transport without
     ever being copied into the frame."""
-    body = bytearray()
     blobs: list = []
-    encode_value(payload, body, blobs)
+    body = _encode_body(payload, blobs)
     if not blobs:
-        rec = _HDR.pack(xid, mtype, 0) + bytes(body)
+        rec = _HDR.pack(xid, mtype, 0) + body
         return [struct.pack(">I", len(rec)) + rec]
     blob_len = sum(len(b) for b in blobs)
     rec_len = _HDR.size + 4 + len(body) + blob_len
     prefix = (struct.pack(">I", rec_len)
               + _HDR.pack(xid, mtype, FL_BLOBS)
-              + struct.pack(">I", len(body)) + bytes(body))
+              + struct.pack(">I", len(body)) + body)
     blob_stats["tx_blobs"] += len(blobs)
     blob_stats["tx_bytes"] += blob_len
     return [prefix, *blobs]
@@ -322,22 +362,23 @@ def unpack(rec: bytes) -> tuple[int, int, Any]:
         if start + body_len > len(rec):
             raise WireError("blob record body overruns frame")
         blobs = [mv[start + body_len:], 0]
-        payload, _ = decode_value(mv[:start + body_len], start, blobs)
+        payload, _ = _decode_body(mv[:start + body_len], start, blobs)
         return xid, mtype, payload
-    payload, _ = decode_value(mv, _HDR.size)
+    payload, _ = _decode_body(mv, _HDR.size)
     return xid, mtype, payload
 
 
 def pack_z(xid: int, mtype: int, payload: Any,
-           min_size: int = 512) -> bytes:
+           min_size: int = 512, level: int = 1) -> bytes:
     """Compressed pack: deflate the whole record when it is worth it
-    (small frames ship plain — zlib would grow them)."""
+    (small frames ship plain — zlib would grow them).  ``level`` is the
+    cdc xlator's compression-level (-1 = zlib default)."""
     import zlib
 
     plain = pack(xid, mtype, payload)
     if len(plain) < min_size:
         return plain
-    body = zlib.compress(plain, 1)
+    body = zlib.compress(plain, level)
     rec = _HDR.pack(xid, MT_ZLIB, 0) + body
     return struct.pack(">I", len(rec)) + rec
 

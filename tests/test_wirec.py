@@ -1,0 +1,125 @@
+"""The C wire codec (native/src/wirec.c) must emit and accept the SAME
+bytes as the pure-Python codec in rpc/wire.py — peers may mix them (one
+side without a toolchain falls back), so format drift is a wire break."""
+
+import random
+
+import pytest
+
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.iatt import IAType, Iatt
+from glusterfs_tpu.core.layer import Loc
+from glusterfs_tpu.rpc import wire
+
+pytestmark = pytest.mark.skipif(wire._wirec is None,
+                                reason="no C toolchain for wirec")
+
+CASES = [
+    None, True, False, 0, 1, -5, 2 ** 40, -(2 ** 40), 3.25, -0.0,
+    b"", b"\x00\xff" * 100, "héllo", "", "\x7f",
+    b"caf\xe9".decode("utf-8", "surrogateescape"),  # raw fs name
+    [1, [2, b"x"], "y"], {"a": 1, "b": [True, None], "": {}},
+    Iatt(gfid=b"\x01" * 16, ia_type=IAType.REG, size=42, mtime=1.5),
+    Loc("/a/b", gfid=b"\x02" * 16, parent=b"\x03" * 16),
+    wire.FdHandle(7, b"\x04" * 16, "/f"),
+    FopError(2, "gone"),
+    ["writev", (wire.FdHandle(3, b"\x05" * 16, "/x"), b"d" * 512, 4096),
+     {"xdata": {"pre-xattrop": {"trusted.ec.dirty": b"\0" * 16}}}],
+]
+
+
+def _py_encode(v, blobs=None):
+    out = bytearray()
+    wire.encode_value(v, out, blobs)
+    return bytes(out)
+
+
+def _canon(v):
+    """Identity-compared wire classes -> comparable tuples."""
+    if isinstance(v, wire.FdHandle):
+        return ("fd", v.fdid, v.gfid, v.path)
+    if isinstance(v, Loc):
+        return ("loc", v.path, v.gfid, v.parent, v.name)
+    if isinstance(v, Iatt):
+        return ("iatt", v.gfid, v.ia_type, v.size, v.mode, v.mtime)
+    if isinstance(v, FopError):
+        return ("err", v.err, v.args[1] if len(v.args) > 1 else "")
+    if isinstance(v, (list, tuple)):
+        return [_canon(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _canon(x) for k, x in v.items()}
+    return v
+
+
+@pytest.mark.parametrize("idx", range(len(CASES)))
+def test_same_bytes_and_round_trip(idx):
+    v = CASES[idx]
+    c = wire._wirec.encode(v, None)
+    assert c == _py_encode(v)
+    got_c, pos_c = wire._wirec.decode(c, 0, None)
+    got_p, pos_p = wire.decode_value(memoryview(c), 0, None)
+    assert pos_c == pos_p == len(c)
+    assert _canon(got_c) == _canon(got_p)
+
+
+def test_fuzz_trees_match():
+    rnd = random.Random(7)
+
+    def ch():
+        while True:
+            c = rnd.randrange(32, 0x2FFFF)
+            if not 0xD800 <= c <= 0xDFFF:
+                return chr(c)
+
+    def gen(d=0):
+        t = rnd.randrange(8 if d < 3 else 6)
+        if t == 0:
+            return None
+        if t == 1:
+            return rnd.choice([True, False])
+        if t == 2:
+            return rnd.randrange(-2 ** 50, 2 ** 50)
+        if t == 3:
+            return rnd.random()
+        if t == 4:
+            return bytes(rnd.randrange(256)
+                         for _ in range(rnd.randrange(20)))
+        if t == 5:
+            return "".join(ch() for _ in range(rnd.randrange(10)))
+        if t == 6:
+            return [gen(d + 1) for _ in range(rnd.randrange(5))]
+        return {str(i): gen(d + 1) for i in range(rnd.randrange(5))}
+
+    for _ in range(300):
+        v = gen()
+        c = wire._wirec.encode(v, None)
+        assert c == _py_encode(v)
+        got, _ = wire._wirec.decode(c, 0, None)
+        exp, _ = wire.decode_value(memoryview(c), 0, None)
+        assert got == exp
+
+
+def test_blob_lane_cross_codec():
+    payload = {"data": wire.Blob(b"Z" * 4096), "n": 1}
+    blobs_c: list = []
+    c = wire._wirec.encode(payload, blobs_c)
+    blobs_p: list = []
+    p = _py_encode(payload, blobs_p)
+    assert c == p
+    assert [bytes(b) for b in blobs_c] == [bytes(b) for b in blobs_p]
+    # full frame through pack_frames/unpack (C on both sides)
+    frames = wire.pack_frames(9, wire.MT_REPLY, payload)
+    rec = b"".join(bytes(f) for f in frames)[4:]
+    xid, mtype, out = wire.unpack(rec)
+    assert xid == 9 and bytes(out["data"]) == b"Z" * 4096
+
+
+def test_mixed_codecs_interoperate(monkeypatch):
+    """A C-encoded frame decodes on a Python-only peer and vice versa."""
+    payload = ["lookup", (Loc("/p", gfid=b"\x06" * 16),), {}]
+    c_frame = wire.pack(5, wire.MT_CALL, payload)
+    monkeypatch.setattr(wire, "_wirec", None)
+    xid, mtype, out = wire.unpack(c_frame[4:])  # python decode
+    assert out[0] == "lookup" and out[1][0].path == "/p"
+    py_frame = wire.pack(6, wire.MT_CALL, payload)  # python encode
+    assert py_frame[4 + 8:] == c_frame[4 + 8:]
