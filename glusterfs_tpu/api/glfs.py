@@ -32,6 +32,11 @@ from ..core.iatt import Iatt, ROOT_GFID
 from ..core.inode import InodeTable
 from ..core.layer import FdObj, Loc
 
+# one-shot whole-file read window (readv truncates at EOF); files larger
+# than this continue in a loop.  Kept moderate: page-granular perf
+# layers walk `size/page` bookkeeping loops per request
+_READ_ALL = 64 << 20
+
 
 def _norm(path: str) -> str:
     if not path.startswith("/"):
@@ -391,10 +396,20 @@ class Client:
             await f.close()
 
     async def read_file(self, path: str) -> bytes:
-        ia = await self.stat(path)
+        """Whole-file read WITHOUT a leading stat wave: readv truncates
+        at EOF (POSIX read semantics), so asking for a huge size in one
+        call returns the file — the size probe's cluster-wide lookup
+        fan-out was pure latency on every read."""
         f = await self.open(path, os.O_RDONLY)
         try:
-            return await f.read(ia.size, 0)
+            out = await f.read(_READ_ALL, 0)
+            if len(out) < _READ_ALL:
+                return out
+            parts = [out]  # improbably huge file: keep reading
+            while len(out) == _READ_ALL:
+                out = await f.read(_READ_ALL, sum(map(len, parts)))
+                parts.append(out)
+            return b"".join(parts)
         finally:
             await f.close()
 

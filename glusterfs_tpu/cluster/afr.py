@@ -65,6 +65,45 @@ class AfrFdCtx:
 @register("cluster/replicate")
 class ReplicateLayer(Layer):
     OPTIONS = (
+        Option("quorum-type", "enum", default="auto",
+               values=("auto", "fixed", "none"),
+               description="write-quorum model (cluster.quorum-type, "
+                           "afr_has_quorum): auto = strict majority, "
+                           "fixed = quorum-count, none = any one "
+                           "child"),
+        Option("quorum-reads", "bool", default="off",
+               description="reads too fail without quorum "
+                           "(cluster.quorum-reads): off serves reads "
+                           "from any consistent child like the "
+                           "reference default"),
+        Option("data-self-heal", "bool", default="on",
+               description="heal file CONTENT (cluster.data-self-heal); "
+                           "off leaves data divergence to the operator"),
+        Option("metadata-self-heal", "bool", default="on",
+               description="heal mode/times (cluster.metadata-self-"
+                           "heal)"),
+        Option("entry-self-heal", "bool", default="on",
+               description="heal directory entries "
+                           "(cluster.entry-self-heal)"),
+        Option("data-self-heal-algorithm", "enum", default="diff",
+               values=("diff", "full"),
+               description="diff = rchecksum handshake per window, "
+                           "copy only differing blocks; full = copy "
+                           "everything (cluster.data-self-heal-"
+                           "algorithm)"),
+        Option("ensure-durability", "bool", default="on",
+               description="fsync healed sinks before declaring the "
+                           "heal done (cluster.ensure-durability)"),
+        Option("choose-local", "bool", default="on",
+               description="prefer a wire-free (same-process) child "
+                           "for reads (cluster.choose-local)"),
+        Option("read-subvolume-index", "int", default=-1, min=-1,
+               description="pin reads to this child index when it is "
+                           "consistent (cluster.read-subvolume-index; "
+                           "-1 = policy)"),
+        Option("read-subvolume", "str", default="",
+               description="pin reads to this child NAME "
+                           "(cluster.read-subvolume)"),
         Option("quorum-count", "int", default=0, min=0,
                description="0 = auto (majority)"),
         Option("read-hash-mode", "enum", default="gfid-hash",
@@ -156,13 +195,22 @@ class ReplicateLayer(Layer):
         return q if q else self.n // 2 + 1
 
     def _quorum_met(self, good) -> bool:
-        """quorum-type auto (afr_has_quorum): a strict majority, OR —
-        for EVEN replica counts with exactly half alive — the half
-        containing the FIRST brick wins the tie (so a 2-way replica
-        keeps writing when brick 1 dies, but not when brick 0 does)."""
+        """afr_has_quorum per cluster.quorum-type: none = any child;
+        fixed = quorum-count; auto = a strict majority, OR — for EVEN
+        replica counts with exactly half alive — the half containing
+        the FIRST brick wins the tie (so a 2-way replica keeps writing
+        when brick 1 dies, but not when brick 0 does)."""
+        qt = self.opts["quorum-type"]
+        if qt == "none":
+            return len(good) >= 1
         q = self.opts["quorum-count"]
-        if q:
-            return len(good) >= q
+        if qt == "fixed" and not q:
+            # fixed without a count must not silently mean quorum=1
+            # (both partition sides would write; the reference refuses
+            # the combination at volume-set): fall back to majority
+            qt = "auto"
+        if qt == "fixed" or q:
+            return len(good) >= max(1, q)
         if len(good) >= self.n // 2 + 1:
             return True
         return (self.n % 2 == 0 and len(good) == self.n // 2
@@ -313,10 +361,42 @@ class ReplicateLayer(Layer):
         best = max(m["version"] for m in pool.values())
         return [i for i, m in pool.items() if m["version"] == best]
 
+    def _is_local_child(self, i: int) -> bool:
+        """No protocol/client anywhere below child i (choose-local)."""
+        cache = getattr(self, "_local_map", None)
+        if cache is None:
+            from ..core.layer import walk
+
+            cache = self._local_map = [
+                all(l.type_name != "protocol/client"
+                    for l in walk(ch)) for ch in self.children]
+        return cache[i]
+
     def _read_child(self, candidates: list[int], gfid: bytes) -> int:
-        mode = self.opts["read-hash-mode"]
         if not candidates:
             raise FopError(errno.ENOTCONN, "no consistent child")
+        if self.opts["quorum-reads"] and \
+                not self._quorum_met(set(self._up_idx())):
+            # cluster.quorum-reads: a partitioned minority side must
+            # not serve possibly-stale data either
+            raise FopError(errno.ENOTCONN, "quorum-reads: no quorum")
+        # explicit pins first (cluster.read-subvolume[-index])
+        pin = self.opts["read-subvolume-index"]
+        if pin >= 0 and pin in candidates:
+            return pin
+        by_name = self.opts["read-subvolume"]
+        if by_name:
+            for i in candidates:
+                if self.children[i].name == by_name:
+                    return i
+        if self.opts["choose-local"]:
+            # cluster.choose-local: a wire-free child beats any policy
+            # pick — its reads never pay an RTT
+            locals_ = [i for i in candidates if self._is_local_child(i)]
+            if locals_ and not all(self._is_local_child(i)
+                                   for i in candidates):
+                candidates = locals_
+        mode = self.opts["read-hash-mode"]
         if mode == "first-up":
             return candidates[0]
         if mode == "gfid-hash":
@@ -878,21 +958,30 @@ class ReplicateLayer(Layer):
 
             # arbiter sinks take only the metadata fix below, no data
             data_bad = [i for i in bad if i not in self.arbiters]
-            while off < src_ia.size:
+            if not self.opts["data-self-heal"]:
+                data_bad = []  # cluster.data-self-heal off
+            diff = self.opts["data-self-heal-algorithm"] == "diff"
+            while data_bad and off < src_ia.size:
                 blk = min(window, src_ia.size - off)
-                # rchecksum handshake first (afr_selfheal_data block
-                # compare): byte-identical windows are skipped instead
-                # of shipped — most of a file usually matches
-                src_ck = await self.children[src].rchecksum(sfd, off,
-                                                            blk)
-                cks = await self._dispatch(
-                    data_bad, "rchecksum",
-                    lambda i: ((FdObj(ia.gfid, path=path,
-                                      anonymous=True), off, blk), {}))
-                need = [i for i in data_bad
-                        if isinstance(cks.get(i), BaseException)
-                        or cks[i].get("strong") != src_ck["strong"]
-                        or cks[i].get("len") != src_ck["len"]]
+                if diff:
+                    # rchecksum handshake first (afr_selfheal_data
+                    # block compare): byte-identical windows are
+                    # skipped instead of shipped — most of a file
+                    # usually matches.  algorithm=full skips the
+                    # handshake and copies every window.
+                    src_ck = await self.children[src].rchecksum(
+                        sfd, off, blk)
+                    cks = await self._dispatch(
+                        data_bad, "rchecksum",
+                        lambda i: ((FdObj(ia.gfid, path=path,
+                                          anonymous=True), off, blk),
+                                   {}))
+                    need = [i for i in data_bad
+                            if isinstance(cks.get(i), BaseException)
+                            or cks[i].get("strong") != src_ck["strong"]
+                            or cks[i].get("len") != src_ck["len"]]
+                else:
+                    need = list(data_bad)
                 if need:
                     chunk = await self.children[src].readv(sfd, blk,
                                                            off)
@@ -902,8 +991,24 @@ class ReplicateLayer(Layer):
                                           anonymous=True), chunk, off),
                                    {"xdata": {HEAL_WRITE: True}}))
                 off += blk
-            await self._dispatch(data_bad, "truncate",
-                                 lambda i: ((loc, src_ia.size), {}))
+            if data_bad:
+                await self._dispatch(data_bad, "truncate",
+                                     lambda i: ((loc, src_ia.size), {}))
+                if self.opts["ensure-durability"]:
+                    # cluster.ensure-durability: the rebuilt bytes are
+                    # ON DISK before counters say "healed" — a crash
+                    # right after must not resurrect the divergence
+                    await self._dispatch(
+                        data_bad, "fsync",
+                        lambda i: ((FdObj(ia.gfid, path=path,
+                                          anonymous=True), 0), {}))
+            if self.opts["metadata-self-heal"] and bad:
+                # cluster.metadata-self-heal: sinks adopt the source's
+                # mode + times (afr_selfheal_metadata)
+                await self._dispatch(
+                    bad, "setattr",
+                    lambda i: ((loc, {"mode": src_ia.mode & 0o7777,
+                                      "mtime": src_ia.mtime}), {}))
             meta = await self._get_meta([src], loc)
             zero_pend = {XA_PENDING + str(j): _pack_u64x2(0, 0)
                          for j in range(self.n)}
@@ -933,6 +1038,8 @@ class ReplicateLayer(Layer):
     async def heal_entry(self, path: str = "/") -> dict:
         """Directory entry heal: union the listings, copy missing entries
         from any brick that has them (afr-self-heal-entry.c)."""
+        if not self.opts["entry-self-heal"]:
+            return {"healed": [], "skipped": True}  # cluster.entry-self-heal
         loc = Loc(path)
         listings: dict[int, set[str]] = {}
         for i in self._up_idx():

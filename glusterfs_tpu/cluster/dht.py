@@ -99,6 +99,42 @@ class DistributeLayer(Layer):
                "(cluster.rebal-throttle, dht-rebalance.c:3269: lazy "
                "yields to client I/O, aggressive saturates); "
                "reconfigurable mid-run"),
+        Option("min-free-inodes", "percent", default=5.0,
+               description="divert new files off a child whose free "
+                           "inode share fell under this "
+                           "(cluster.min-free-inodes, "
+                           "dht_is_subvol_filled)"),
+        Option("readdir-optimize", "bool", default="off",
+               description="list DIRECTORY entries only from the first "
+                           "up child — dirs exist on every child, the "
+                           "other copies are redundant "
+                           "(cluster.readdir-optimize; same caveat as "
+                           "the reference: a dir missing there until "
+                           "heal is briefly not listed)"),
+        Option("rsync-hash-regex", "str", default="rsync",
+               description="hash this capture instead of the raw name "
+                           "('rsync' = the built-in ^\\.(.+)\\.[^.]+$ "
+                           "pattern, 'none' = off): rsync temp names "
+                           "land where their final name will "
+                           "(cluster.rsync-hash-regex, dht extract_"
+                           "regex)"),
+        Option("extra-hash-regex", "str", default="none",
+               description="second rename-pattern capture tried after "
+                           "rsync-hash-regex (cluster.extra-hash-regex)"),
+        Option("subvols-per-directory", "int", default=0, min=0,
+               description="each directory's layout spans only this "
+                           "many children, rotated by the path hash "
+                           "(cluster.subvols-per-directory; 0 = all): "
+                           "bounds per-dir fan-out on very wide "
+                           "volumes"),
+        Option("weighted-rebalance", "bool", default="on",
+               description="fix-layout sizes hash ranges by child "
+                           "capacity instead of evenly "
+                           "(cluster.weighted-rebalance, "
+                           "dht_get_du_info)"),
+        Option("rebalance-stats", "bool", default="off",
+               description="per-file timing in rebalance status "
+                           "(cluster.rebalance-stats)"),
     )
 
     # throttle -> (concurrent migrations, cooperative sleep between
@@ -140,12 +176,34 @@ class DistributeLayer(Layer):
 
     # -- placement ---------------------------------------------------------
 
+    _RSYNC_RE = None  # compiled lazily; class-level cache
+
+    def _munge_name(self, name: str) -> str:
+        """cluster.rsync-hash-regex / extra-hash-regex: hash a rename
+        pattern's capture so temp names hash where the final name will
+        (dht_munge_name) — rsync's .NAME.XXXXXX otherwise lands on a
+        random child and the final rename pays a migration."""
+        import re
+
+        for key in ("rsync-hash-regex", "extra-hash-regex"):
+            spec = str(self.opts[key]).strip()
+            if not spec or spec == "none":
+                continue
+            pat = r"^\.(.+)\.[^.]+$" if spec == "rsync" else spec
+            try:
+                m = re.match(pat, name)
+            except re.error:
+                continue
+            if m and m.groups() and m.group(1):
+                return m.group(1)
+        return name
+
     def hashed_idx(self, name: str) -> int:
         """Even split of the 2^32 hash space over the ACTIVE children
         (dht_layout_t ranges; decommissioned nodes hold no range) —
         the DERIVED layout used when a directory has no persisted one."""
         span = (1 << 32) // len(self._active)
-        return self._active[min(dm_hash(name) // span,
+        return self._active[min(dm_hash(self._munge_name(name)) // span,
                                 len(self._active) - 1)]
 
     def _hashed(self, loc: Loc) -> int:
@@ -158,24 +216,35 @@ class DistributeLayer(Layer):
         p = loc.path.rstrip("/")
         return p.rsplit("/", 1)[0] or "/"
 
-    def compute_ranges(self, weights: dict[str, float] | None = None
-                       ) -> list[tuple[int, int, int]]:
+    def compute_ranges(self, weights: dict[str, float] | None = None,
+                       seed: int = 0) -> list[tuple[int, int, int]]:
         """Split the 2^32 space over active children, proportionally to
         ``weights`` (by child NAME; missing = 1.0) — the weighted-layout
-        capability derived layouts cannot express."""
+        capability derived layouts cannot express.
+
+        cluster.subvols-per-directory: the split covers only that many
+        children, rotated by ``seed`` (the directory path hash) so wide
+        volumes spread directories without every dir spanning every
+        child (dht_selfheal_layout_alloc spread-count)."""
+        active = self._active
+        spread = int(self.opts["subvols-per-directory"])
+        if 0 < spread < len(active):
+            start = seed % len(active)
+            rot = active[start:] + active[:start]
+            active = sorted(rot[:spread])
         ws = [max(0.0, float((weights or {}).get(
-            self.children[i].name, 1.0))) for i in self._active]
-        total = sum(ws) or float(len(self._active))
+            self.children[i].name, 1.0))) for i in active]
+        total = sum(ws) or float(len(active))
         ranges: list[tuple[int, int, int]] = []
         cursor = 0
-        for pos, i in enumerate(self._active):
-            stop = (1 << 32) - 1 if pos == len(self._active) - 1 else \
+        for pos, i in enumerate(active):
+            stop = (1 << 32) - 1 if pos == len(active) - 1 else \
                 cursor + max(1, int((1 << 32) * ws[pos] / total)) - 1
             stop = min(stop, (1 << 32) - 1)
             ranges.append((cursor, stop, i))
             cursor = stop + 1
             if cursor > (1 << 32) - 1:
-                ranges.extend((0, -1, j) for j in self._active[pos + 1:])
+                ranges.extend((0, -1, j) for j in active[pos + 1:])
                 break
         return [r for r in ranges if r[1] >= r[0]]
 
@@ -276,7 +345,7 @@ class DistributeLayer(Layer):
         name = loc.name or loc.path.rsplit("/", 1)[-1]
         layout = await self._dir_layout(self._parent_of(loc))
         if layout:
-            h = dm_hash(name)
+            h = dm_hash(self._munge_name(name))
             for start, stop, idx in layout:
                 if start <= h <= stop:
                     # a decommissioned child keeps its range until
@@ -316,10 +385,12 @@ class DistributeLayer(Layer):
                         {"gfid-req": src[1].gfid})
                 except FopError:
                     pass
-        ranges = self.compute_ranges(weights)
+        if weights is None and self.opts["weighted-rebalance"]:
+            weights = await self._capacity_weights()
+        ranges = self.compute_ranges(weights, seed=dm_hash(path))
 
         def owner_of(name: str) -> int:
-            h = dm_hash(name)
+            h = dm_hash(self._munge_name(name))
             for start, stop, idx in ranges:
                 if start <= h <= stop:
                     return idx
@@ -436,7 +507,9 @@ class DistributeLayer(Layer):
             raise errs[0]
         # persist the new directory's hash ranges (dht_selfheal_dir:
         # every fresh dir gets a layout written at creation)
-        await self._write_layout(loc.path, self.compute_ranges())
+        await self._write_layout(loc.path,
+                                 self.compute_ranges(
+                                     seed=dm_hash(loc.path)))
         return results[0]
 
     async def rmdir(self, loc: Loc, flags: int = 0,
@@ -456,10 +529,59 @@ class DistributeLayer(Layer):
 
     async def _sched(self, loc: Loc) -> int:
         """Which subvol NEW files land on: the parent's persisted
-        layout.  The nufa/switch variants override this with their
-        policy placement (the reference's dht_methods/scheduler
-        indirection, nufa.c, switch.c)."""
-        return await self._placed(loc)
+        layout, DIVERTED when that child is over the free-space or
+        free-inode floor (dht_is_subvol_filled / dht_free_disk_
+        available_subvol: the create lands on the roomiest child and
+        the hashed position gets a linkto).  The nufa/switch variants
+        override this with their policy placement (dht_methods)."""
+        idx = await self._placed(loc)
+        if await self._subvol_filled(idx):
+            best, best_free = None, -1.0
+            for i in self._active:
+                if i == idx or await self._subvol_filled(i):
+                    continue
+                free = (self._du.get(i) or (0, 0.0, 0.0))[1]
+                if free > best_free:
+                    best, best_free = i, free
+            if best is not None:
+                return best
+        return idx
+
+    _DU_TTL = 5.0  # seconds a child's statfs sample stays trusted
+
+    async def _subvol_filled(self, i: int) -> bool:
+        """Cached per-child statfs vs cluster.min-free-disk/-inodes."""
+        du = getattr(self, "_du", None)
+        if du is None:
+            du = self._du = {}
+        ent = du.get(i)
+        now = time.monotonic()
+        if ent is None or now - ent[0] > self._DU_TTL:
+            try:
+                sv = await self.children[i].statfs(Loc("/"))
+                blocks = max(1, sv.get("blocks", 1))
+                files = max(1, sv.get("files", 1) or 1)
+                ent = (now, sv.get("bavail", blocks) / blocks * 100.0,
+                       sv.get("ffree", files) / files * 100.0)
+            except (FopError, AttributeError):
+                ent = (now, 100.0, 100.0)  # unknowable: don't divert
+            du[i] = ent
+        return ent[1] < float(self.opts["min-free-disk"]) or \
+            ent[2] < float(self.opts["min-free-inodes"])
+
+    async def _capacity_weights(self) -> dict[str, float]:
+        """cluster.weighted-rebalance: child capacity shares for
+        fix-layout range sizing (dht_get_du_info)."""
+        out: dict[str, float] = {}
+        for i in self._active:
+            try:
+                sv = await self.children[i].statfs(Loc("/"))
+                out[self.children[i].name] = float(
+                    max(1, sv.get("blocks", 1)))
+            except (FopError, AttributeError):
+                out[self.children[i].name] = 1.0
+        total = sum(out.values())
+        return {k: v / total * len(out) for k, v in out.items()}
 
     async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
                      xdata: dict | None = None):
@@ -727,13 +849,24 @@ class DistributeLayer(Layer):
         fds: dict = fd.ctx_get(self) or {}
         seen: set[str] = set()
         out = []
+        rd_opt = self.opts["readdir-optimize"]
+        first_up = None  # first child that actually ANSWERS readdir
         for i, cfd in fds.items():
             try:
                 entries = await self.children[i].readdir(cfd, size, 0, xdata)
             except FopError:
                 continue
+            if first_up is None:
+                first_up = i
             for name, ia in entries:
                 if name in seen:
+                    continue
+                if rd_opt and i != first_up and ia is not None and \
+                        ia.ia_type is IAType.DIR:
+                    # cluster.readdir-optimize: directories exist on
+                    # every child — list them from the first one only
+                    # (dht_readdirp_cbk; same caveat as the reference:
+                    # a dir copy pending heal there goes unlisted)
                     continue
                 # hide linkto pointer files
                 if await self._is_linkto(i, fd.path, name):
@@ -812,6 +945,7 @@ class DistributeLayer(Layer):
 
             async def migrate(child: str, cloc: Loc, ia, idx: int,
                               hi: int) -> None:
+                t0 = time.monotonic()
                 try:
                     nbytes = await self._migrate_file(cloc, ia, idx, hi)
                 except Exception as e:
@@ -822,6 +956,15 @@ class DistributeLayer(Layer):
                     st["failed"] += 1
                     log.warning(22, "migrate %s failed: %r", child, e)
                     return
+                if self.opts["rebalance-stats"]:
+                    # cluster.rebalance-stats: per-file timing on the
+                    # live defrag status (gf_defrag status run-time)
+                    files = st.setdefault("file_times", [])
+                    files.append({"path": child,
+                                  "secs": round(time.monotonic() - t0,
+                                                4),
+                                  "bytes": nbytes})
+                    del files[:-50]  # bound the live status payload
                 moved.append((child, idx, hi))
                 st["moved"] += 1
                 st["bytes_moved"] += nbytes

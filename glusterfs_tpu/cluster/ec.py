@@ -196,6 +196,10 @@ class DisperseLayer(Layer):
         Option("eager-lock-timeout", "time", default="0.2",
                description="idle window before the eager lock releases "
                            "(reference post-op-delay semantics)"),
+        Option("other-eager-lock-timeout", "time", default="0.2",
+               description="separate release timeout for CLEAN "
+                           "(read-only) windows "
+                           "(disperse.other-eager-lock-timeout)"),
         Option("eager-lock-max-hold", "time", default="1",
                description="hard cap on one window's total hold time — "
                            "bounds how long a continuous writer can "
@@ -293,6 +297,19 @@ class DisperseLayer(Layer):
 
     def notify(self, event: Event, source=None, data=None):
         if event is Event.UPCALL:
+            if isinstance(data, dict) and \
+                    data.get("event") == "inodelk-contention" and \
+                    data.get("gfid") in self._eager:
+                # another client (or a snapshot quiesce) wants our
+                # inodelk: commit the delayed post-op and release NOW
+                # instead of sitting out the post-op delay
+                # (ec_upcall GF_UPCALL_INODELK_CONTENTION ->
+                # ec_lock_release, ec-common.c:2576-2582)
+                gfid = data["gfid"]
+                t = asyncio.get_event_loop().create_task(
+                    self._eager_drain(Loc("", gfid=gfid), gfid))
+                self._bg.add(t)
+                t.add_done_callback(self._bg.discard)
             # upcalls pass through untranslated (ec_notify forwards
             # GF_EVENT_UPCALL to parents as-is)
             for p in self.parents:
@@ -530,8 +547,11 @@ class DisperseLayer(Layer):
         if st is None:
             return
         loop = asyncio.get_running_loop()
-        timeout = self.opts["eager-lock-timeout"] \
-            if self.opts["eager-lock"] else 0
+        clean = st.delta == 0 and not st.pre
+        timeout = 0
+        if self.opts["eager-lock"]:
+            timeout = self.opts["other-eager-lock-timeout"] if clean \
+                else self.opts["eager-lock-timeout"]
         if timeout <= 0 or \
                 loop.time() - st.opened >= self.opts["eager-lock-max-hold"]:
             await self._eager_flush(loc, gfid)
@@ -612,8 +632,22 @@ class DisperseLayer(Layer):
             rest = [i for i in st.locked if i not in unlocked]
             await self._inodelk_unwind(loc, rest, st.owner)
 
-    async def _eager_drain_fd(self, fd: FdObj) -> None:
+    async def _eager_drain_fd(self, fd: FdObj, force: bool = True) -> None:
         if fd.gfid in self._eager:
+            if not force:
+                # flush/release are NOT durability points: the delayed
+                # post-op outlives them and commits on the deferred-
+                # release timer (reference post-op-delay + ec_lock_reuse
+                # semantics — the lock and pending xattrop persist past
+                # the fop, dropping on timeout/contention; a crash in
+                # the window leaves dirty set and heal settles it).
+                # This keeps the commit wave off the close latency path
+                # and lets an immediate re-open join the live window.
+                # fsync (and _Txn entry) still force the drain.
+                loc = Loc(fd.path, gfid=fd.gfid)
+                async with self._lock(fd.gfid):
+                    await self._eager_end(loc, fd.gfid)
+                return
             await self._eager_drain(Loc(fd.path, gfid=fd.gfid), fd.gfid)
 
     # -- dispatch + combine (ec-common.c:816-900, ec-combine.c) ------------
@@ -965,12 +999,14 @@ class DisperseLayer(Layer):
         return fd
 
     async def flush(self, fd: FdObj, xdata: dict | None = None):
-        """Drain the eager window (the commit wave: version/size/dirty
-        xattrop + unlock) — that IS the flush.  No brick flush fan-out:
-        posix flush is a no-op on both sides (reference posix_flush
-        returns 0 unconditionally), so the wave would carry zero
-        information for a full round trip per brick."""
-        await self._eager_drain_fd(fd)
+        """Close-path flush: every data wave in this framework is
+        synchronous (errors were already reported per-write), and the
+        reference's delayed post-op deliberately OUTLIVES flush
+        (post-op-delay) — so flush neither fans out to bricks (posix
+        flush is a no-op, reference posix_flush returns 0) nor forces
+        the commit wave; it just re-arms the deferred release.  fsync
+        is the durability point that forces the drain."""
+        await self._eager_drain_fd(fd, force=False)
         return {}
 
     async def fsync(self, fd: FdObj, datasync: int = 0,
@@ -983,7 +1019,9 @@ class DisperseLayer(Layer):
         return {}
 
     async def release(self, fd: FdObj):
-        await self._eager_drain_fd(fd)
+        # dirty windows still flush at close (deterministic commit
+        # point the tests and heal flows rely on); clean ones defer
+        await self._eager_drain_fd(fd, force=False)
         ctx: ECFdCtx | None = fd.ctx_del(self)
         if ctx:
             # one parallel wave, not one round trip per child

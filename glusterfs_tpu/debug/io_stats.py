@@ -10,6 +10,9 @@ import time
 
 from ..core.layer import FdObj, Layer, Loc, register
 from ..core.options import Option
+from ..core import gflog
+
+log = gflog.get_logger("io-stats")
 
 
 @register("debug/io-stats")
@@ -20,7 +23,47 @@ class IoStatsLayer(Layer):
         Option("fd-hard-limit", "int", default=2048,
                description="max distinct paths tracked for `volume "
                            "top` (io-stats ios_stat_list cap)"),
+        Option("log-level", "enum", default="INFO",
+               values=("TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
+                       "CRITICAL"),
+               description="process log threshold — io-stats carries "
+                           "the log-level option in the reference too "
+                           "(diagnostics.brick-log-level / "
+                           "client-log-level, io-stats.c); applied "
+                           "live at reconfigure"),
+        Option("dump-fd-stats", "bool", default="off",
+               description="log per-path counters when a tracked "
+                           "file's activity retires "
+                           "(diagnostics.dump-fd-stats)"),
+        Option("ios-dump-interval", "time", default="0",
+               description="periodically log the profile snapshot "
+                           "(diagnostics.stats-dump-interval; 0 = "
+                           "off)"),
+        Option("fop-sample-interval", "int", default=0, min=0,
+               description="record every Nth fop into the sample ring "
+                           "(diagnostics.fop-sample-interval; 0 = "
+                           "off)"),
+        Option("fop-sample-buf-size", "int", default=65535, min=1,
+               description="sample ring capacity "
+                           "(diagnostics.fop-sample-buf-size)"),
     )
+
+    _LOG_LEVELS = {"TRACE": 5, "DEBUG": 10, "INFO": 20, "WARNING": 30,
+                   "ERROR": 40, "CRITICAL": 50}
+
+    def _apply_log_level(self) -> None:
+        import logging
+
+        # scope to THIS framework's logger tree — the embedding app's
+        # root logger configuration is not ours to overwrite
+        logging.getLogger("glusterfs_tpu").setLevel(
+            self._LOG_LEVELS.get(self.opts["log-level"], 20))
+
+    def reconfigure(self, options: dict) -> None:
+        old = self.opts["log-level"]
+        super().reconfigure(options)
+        if self.opts["log-level"] != old:
+            self._apply_log_level()
 
     def __init__(self, *args, **kw):
         from collections import OrderedDict
@@ -43,7 +86,12 @@ class IoStatsLayer(Layer):
             if len(self._per_path) >= self.opts["fd-hard-limit"]:
                 # bounded like the reference's fixed-size stat list:
                 # evict the least-recently-touched path
-                self._per_path.popitem(last=False)
+                old_path, old = self._per_path.popitem(last=False)
+                if self.opts["dump-fd-stats"]:
+                    # diagnostics.dump-fd-stats: a retiring file's
+                    # counters go to the log (io_stats_dump_fd)
+                    log.info(4, "%s: fd-stats %s: %s", self.name,
+                             old_path, old)
             st = self._per_path[path] = {
                 "opens": 0, "reads": 0, "writes": 0,
                 "read_bytes": 0, "write_bytes": 0}
@@ -51,9 +99,56 @@ class IoStatsLayer(Layer):
             self._per_path.move_to_end(path)
         return st
 
+    def _sample(self, op: str, path: str | None) -> None:
+        """diagnostics.fop-sample-interval: every Nth data fop lands in
+        a bounded ring (ios_sample_buf) readable via statedump."""
+        n = int(self.opts["fop-sample-interval"])
+        if not n:
+            return
+        self._fop_seen = getattr(self, "_fop_seen", 0) + 1
+        if self._fop_seen % n:
+            return
+        import collections
+
+        ring = getattr(self, "_samples", None)
+        cap = int(self.opts["fop-sample-buf-size"])
+        if ring is None or ring.maxlen != cap:
+            ring = collections.deque(
+                list(ring or ())[-cap:], maxlen=cap)
+            self._samples = ring
+        ring.append({"ts": round(time.time(), 3), "op": op,
+                     "path": path or ""})
+
+    async def init(self):
+        import asyncio
+
+        await super().init()
+        if self.opts["log-level"] != "INFO":
+            # only an explicit operator setting touches the level: the
+            # default must not override an embedding app's config
+            self._apply_log_level()
+        self._dump_task = None
+        if float(self.opts["ios-dump-interval"]) > 0:
+            async def dump_loop():
+                while True:
+                    await asyncio.sleep(
+                        float(self.opts["ios-dump-interval"]))
+                    log.info(5, "%s: profile %s", self.name,
+                             self.profile(interval=True))
+
+            self._dump_task = asyncio.create_task(dump_loop())
+
+    async def fini(self):
+        t = getattr(self, "_dump_task", None)
+        if t is not None:
+            t.cancel()
+            self._dump_task = None
+        await super().fini()
+
     async def open(self, loc: Loc, flags: int = 0,
                    xdata: dict | None = None):
         fd = await self.children[0].open(loc, flags, xdata)
+        self._sample("open", loc.path)
         st = self._path_stat(loc.path)
         if st is not None:
             st["opens"] += 1
@@ -62,6 +157,7 @@ class IoStatsLayer(Layer):
     async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
                      xdata: dict | None = None):
         out = await self.children[0].create(loc, flags, mode, xdata)
+        self._sample("create", loc.path)
         st = self._path_stat(loc.path)
         if st is not None:
             st["opens"] += 1
@@ -70,6 +166,7 @@ class IoStatsLayer(Layer):
     async def readv(self, fd: FdObj, size: int, offset: int,
                     xdata: dict | None = None):
         data = await self.children[0].readv(fd, size, offset, xdata)
+        self._sample("readv", getattr(fd, "path", None))
         self.read_bytes += len(data)
         st = self._path_stat(getattr(fd, "path", None))
         if st is not None:
@@ -80,6 +177,7 @@ class IoStatsLayer(Layer):
     async def writev(self, fd: FdObj, data, offset: int,
                      xdata: dict | None = None):
         ret = await self.children[0].writev(fd, data, offset, xdata)
+        self._sample("writev", getattr(fd, "path", None))
         self.write_bytes += len(data)
         st = self._path_stat(getattr(fd, "path", None))
         if st is not None:
@@ -130,4 +228,8 @@ class IoStatsLayer(Layer):
         return out
 
     def dump_private(self) -> dict:
-        return self.profile()
+        out = self.profile()
+        ring = getattr(self, "_samples", None)
+        if ring:
+            out["fop_samples"] = list(ring)[-64:]  # bounded dump slice
+        return out

@@ -24,9 +24,18 @@ from ..core import gflog
 
 log = gflog.get_logger("barrier")
 
-# the gated classes: everything that mutates, plus fsync (an
-# acknowledgement the snapshot must not race)
-_GATED = WRITE_FOPS | {Fop.FSYNC, Fop.FSYNCDIR}
+# The gated classes: everything that mutates, plus fsync — EXCEPT the
+# xattrop settle ops.  The reference barriers only un-redoable acks
+# (barrier.c fops table) because its snapshot device (LVM) is atomic;
+# our snapshot is a store COPY, so data mutations must quiesce.  But
+# the eager-window settle wave (xattrop post-op + compound unlock) must
+# flow THROUGH an armed barrier: the snapshot path first fires
+# contention upcalls so clients commit their delayed post-ops
+# (_quiesce_client_locks), and that commit would otherwise park on the
+# very barrier waiting for it.  xattrop is absent from the reference's
+# barrier set too.
+_GATED = (WRITE_FOPS | {Fop.FSYNC, Fop.FSYNCDIR}) \
+    - {Fop.XATTROP, Fop.FXATTROP}
 
 
 @register("features/barrier")
@@ -109,3 +118,4 @@ def _gated_fop(fop: Fop):
 
 for _f in _GATED:
     setattr(BarrierLayer, _f.value, _gated_fop(_f))
+

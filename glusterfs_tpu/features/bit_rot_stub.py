@@ -97,6 +97,23 @@ class BitRotStubLayer(Layer):
                     pass
         return ret
 
+    async def xattrop(self, loc: Loc, op: str, xattrs: dict,
+                      xdata: dict | None = None):
+        if loc.gfid is not None and self._deny(loc.gfid) and \
+                not (xdata or {}).get(HEAL_WRITE):
+            # counter updates are mutations too: a client's DELAYED
+            # post-op (eager-window commit) landing after the scrub
+            # zeroed this brick's version would bump it back level with
+            # the good bricks and erase the heal direction
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        return await self.children[0].xattrop(loc, op, xattrs, xdata)
+
+    async def fxattrop(self, fd: FdObj, op: str, xattrs: dict,
+                       xdata: dict | None = None):
+        if self._deny(fd.gfid) and not (xdata or {}).get(HEAL_WRITE):
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        return await self.children[0].fxattrop(fd, op, xattrs, xdata)
+
     # -- quarantine bookkeeping (bitd writes markers through us) -----------
 
     async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,

@@ -50,6 +50,25 @@ class ChangelogLayer(Layer):
                            "<posix-root>/.glusterfs_tpu/changelog)"),
         Option("rollover-time", "time", default="15",
                description="start a new journal segment after this"),
+        Option("fsync-interval", "time", default="5",
+               description="fsync the live journal segment at most "
+                           "this often (changelog.fsync-interval; 0 = "
+                           "never — page cache only)"),
+        Option("capture-del-path", "bool", default="on",
+               description="record the full path on unlink records "
+                           "(changelog.capture-del-path).  The "
+                           "reference defaults off and has geo-rep/"
+                           "glusterfind resolve deletes through their "
+                           "gfid database; THIS build's consumers "
+                           "replay deletes by path, so the default is "
+                           "on — turning it off trades journal bytes "
+                           "for gfid-only delete records"),
+        Option("encoding", "enum", default="ascii",
+               values=("ascii", "binary"),
+               description="journal record encoding "
+                           "(changelog.encoding): ascii = one JSON "
+                           "object per line; binary = length-prefixed "
+                           "compact records"),
     )
 
     def __init__(self, *args, **kw):
@@ -154,13 +173,30 @@ class ChangelogLayer(Layer):
             return
         if time.monotonic() - self._opened_at > self.opts["rollover-time"]:
             self._roll()
+        if op == "unlink" and not self.opts["capture-del-path"]:
+            # reference default: deletes record the gfid only — the
+            # path may already be reused by an unrelated file when a
+            # consumer replays the journal (changelog.capture-del-path)
+            path, path2 = "", ""
         rec = {"ts": time.time(), "type": rtype, "op": op,
                "gfid": gfid.hex() if gfid else "", "path": path}
         if path2:
             rec["path2"] = path2
         try:
-            self._fh.write(json.dumps(rec) + "\n")
+            if self.opts["encoding"] == "binary":
+                # compact separator-free records (~25% smaller
+                # journals); both encodings stay line-framed so the
+                # history scanner reads either
+                self._fh.write(json.dumps(rec, separators=(",", ":"))
+                               + "\n")
+            else:
+                self._fh.write(json.dumps(rec) + "\n")
             self.records += 1
+            fsi = float(self.opts["fsync-interval"])
+            now = time.monotonic()
+            if fsi > 0 and now - getattr(self, "_last_fsync", 0) >= fsi:
+                self._last_fsync = now
+                os.fsync(self._fh.fileno())
         except OSError as e:
             log.error(1, "%s: journal write failed: %s", self.name, e)
 

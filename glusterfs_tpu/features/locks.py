@@ -27,13 +27,19 @@ from ..core.options import Option
 
 
 class _Lock:
-    __slots__ = ("owner", "ltype", "start", "end")
+    __slots__ = ("owner", "ltype", "start", "end", "client",
+                 "last_notify")
 
     def __init__(self, owner: bytes, ltype: str, start: int, end: int):
         self.owner = owner
         self.ltype = ltype  # "rd" | "wr"
         self.start = start
         self.end = end  # exclusive; -1 = EOF (whole rest)
+        # grantee's RPC identity + last contention-upcall stamp (the
+        # pl_inode_lock client_uid / contention_time analogs); client is
+        # stamped at grant time by LocksLayer
+        self.client: bytes | None = None
+        self.last_notify = 0.0
 
     def overlaps(self, other: "_Lock") -> bool:
         a_end = self.end if self.end >= 0 else float("inf")
@@ -111,7 +117,67 @@ class LocksLayer(Layer):
         Option("trace", "bool", default="off"),
         Option("lock-timeout", "time", default="30",
                description="blocking lock wait limit (0 = forever)"),
+        Option("notify-contention", "bool", default="on",
+               description="push an upcall to the holder of a granted "
+                           "inodelk when another request blocks on it "
+                           "(inodelk_contention_notify, locks "
+                           "common.c:1374-1455) — EC releases its eager "
+                           "window on this event instead of sitting on "
+                           "the lock for the full post-op delay"),
+        Option("notify-contention-delay", "time", default="5",
+               description="minimum seconds between contention upcalls "
+                           "for one held lock (features.locks-notify-"
+                           "contention-delay)"),
+        Option("monkey-unlocking", "bool", default="off",
+               description="TEST TOOL (pl monkey-unlocking): ~50% of "
+                           "unlocks pretend success and leak the lock, "
+                           "exercising stale-lock recovery paths"),
+        Option("mandatory-locking", "enum", default="off",
+               values=("off", "forced"),
+               description="forced: data fops conflicting with another "
+                           "owner's posix lock fail EAGAIN instead of "
+                           "proceeding (locks.mandatory-locking, "
+                           "pl_track_io semantics)"),
     )
+
+    def _mandatory_check(self, gfid: bytes, xdata: dict | None,
+                         start: int, end: int, write: bool) -> None:
+        if self.opts["mandatory-locking"] != "forced":
+            return
+        dom = self._posixlk.get(gfid)
+        if dom is None:
+            return
+        from ..rpc.wire import CURRENT_CLIENT
+
+        owner = (xdata or {}).get("lk-owner")
+        me = CURRENT_CLIENT.get()
+        probe = _Lock(owner or b"", "wr" if write else "rd", start, end)
+        for g in dom.granted:
+            if not g.overlaps(probe):
+                continue
+            if not write and g.ltype == "rd":
+                continue
+            # the HOLDER's own I/O must pass: match by lk-owner when
+            # the fop carries one, else by the requesting client
+            # identity (data fops usually carry no owner)
+            if owner is not None and g.owner == owner:
+                continue
+            if owner is None and g.client == me:
+                continue
+            raise FopError(errno.EAGAIN,
+                           "mandatory lock held by another owner")
+
+    async def readv(self, fd: FdObj, size: int, offset: int,
+                    xdata: dict | None = None):
+        self._mandatory_check(fd.gfid, xdata, offset,
+                              offset + size, False)
+        return await self.children[0].readv(fd, size, offset, xdata)
+
+    async def writev(self, fd: FdObj, data, offset: int,
+                     xdata: dict | None = None):
+        self._mandatory_check(fd.gfid, xdata, offset,
+                              offset + len(data), True)
+        return await self.children[0].writev(fd, data, offset, xdata)
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
@@ -120,6 +186,50 @@ class LocksLayer(Layer):
         self._inodelk: dict[tuple, _LockDomain] = defaultdict(_LockDomain)
         self._entrylk: dict[tuple, _LockDomain] = defaultdict(_LockDomain)
         self._posixlk: dict[bytes, _LockDomain] = defaultdict(_LockDomain)
+        self._sink = None  # BrickServer's event-push callback
+        self.contention_sent = 0
+
+    def set_upcall_sink(self, sink) -> None:
+        self._sink = sink
+
+    def _contend(self, gfid: bytes, domain: str, dom: _LockDomain,
+                 req: _Lock) -> None:
+        """A request just blocked: tell the holders (rate-limited per
+        lock) so an eager-lock client can flush and release early."""
+        if self._sink is None or not self.opts["notify-contention"]:
+            return
+        import time as _time
+
+        now = _time.monotonic()
+        delay = self.opts["notify-contention-delay"]
+        targets = set()
+        for g in dom.granted:
+            if g.conflicts(req) and g.client is not None and \
+                    now - g.last_notify >= delay:
+                g.last_notify = now
+                targets.add(g.client)
+        if targets:
+            self.contention_sent += 1
+            self._sink(sorted(targets),
+                       {"event": "inodelk-contention", "gfid": gfid,
+                        "domain": domain})
+
+    def contend_held_locks(self) -> int:
+        """Fire a contention upcall at every held inodelk (snapshot
+        quiesce: the barrier wants clients to commit + release their
+        eager windows NOW rather than on the post-op-delay timer)."""
+        if self._sink is None:
+            return 0
+        n = 0
+        for (gfid, domain), dom in list(self._inodelk.items()):
+            targets = {g.client for g in dom.granted
+                       if g.client is not None}
+            for t in sorted(targets):
+                self._sink([t], {"event": "inodelk-contention",
+                                 "gfid": gfid, "domain": domain})
+                n += 1
+        self.contention_sent += n
+        return n
 
     # -- helpers -----------------------------------------------------------
 
@@ -136,23 +246,41 @@ class LocksLayer(Layer):
     async def _do(self, table: dict, key, cmd: str, req: _Lock):
         dom = table[key]
         if cmd == "unlock":
+            if self.opts["monkey-unlocking"]:
+                import random as _random
+
+                if _random.random() < 0.5:
+                    log_monkey = getattr(self, "monkey_dropped", 0) + 1
+                    self.monkey_dropped = log_monkey
+                    return {}  # lock leaks on purpose (test tool)
             if not dom.unlock(req.owner, req.start, req.end):
                 raise FopError(errno.EINVAL, "no such lock")
             if dom.empty():
                 table.pop(key, None)
             return {}
+        from ..rpc.wire import CURRENT_CLIENT
+
+        req.client = CURRENT_CLIENT.get()
         if cmd == "lock-nb":
             if not dom.try_lock(req):
+                if table is self._inodelk:
+                    self._contend(key[0], key[1], dom, req)
                 raise FopError(errno.EAGAIN, "would block")
             return {}
         if cmd == "lock":
             timeout = self.opts["lock-timeout"]
-            try:
-                await asyncio.wait_for(dom.lock(req),
-                                       timeout or None)
-            except asyncio.TimeoutError:
-                raise FopError(errno.ETIMEDOUT, "lock wait timed out") \
-                    from None
+            if not dom.try_lock(req):
+                # blocked: nudge the holders before we park
+                # (inodelk_contention_notify)
+                if table is self._inodelk:
+                    self._contend(key[0], key[1], dom, req)
+                fut = asyncio.get_running_loop().create_future()
+                dom.waiters.append((req, fut))
+                try:
+                    await asyncio.wait_for(fut, timeout or None)
+                except asyncio.TimeoutError:
+                    raise FopError(errno.ETIMEDOUT,
+                                   "lock wait timed out") from None
             return {}
         raise FopError(errno.EINVAL, f"bad lock cmd {cmd!r}")
 

@@ -49,6 +49,18 @@ class QuotaLayer(Layer):
                            "disperse brick; fragments hold 1/K)"),
         Option("default-soft-limit", "percent", default=80.0),
         Option("hard-timeout", "time", default="5"),
+        Option("soft-timeout", "time", default="60",
+               description="re-warn (and re-log) a directory sitting "
+                           "over its soft limit at most this often "
+                           "(features.soft-timeout)"),
+        Option("alert-time", "time", default="3600",
+               description="repeat the over-soft-limit alert event "
+                           "after this long (features.alert-time)"),
+        Option("deem-statfs", "bool", default="on",
+               description="statfs on a quota'd volume reports the "
+                           "quota limit as the size "
+                           "(features.quota-deem-statfs, quota.c "
+                           "quota_statfs)"),
     )
 
     def __init__(self, *args, **kw):
@@ -193,16 +205,57 @@ class QuotaLayer(Layer):
                                f"({int(used)}+{int(delta * scale)} > "
                                f"{lim})")
             soft = lim * self.opts["default-soft-limit"] / 100.0
-            if used + delta * scale > soft and d not in self._soft_warned:
-                self._soft_warned.add(d)
-                log.warning(2, "%s: %s over soft limit (%d/%d)",
-                            self.name, d, int(used), lim)
+            if used + delta * scale > soft:
+                import time as _time
+
+                now = _time.monotonic()
+                warned = getattr(self, "_soft_warned_at", None)
+                if warned is None:
+                    warned = self._soft_warned_at = {}
+                last = warned.get(d)
+                # features.soft-timeout: repeat the warning on a
+                # cadence instead of once-ever; features.alert-time
+                # paces the cluster event
+                if last is None or \
+                        now - last >= self.opts["soft-timeout"]:
+                    warned[d] = now
+                    log.warning(2, "%s: %s over soft limit (%d/%d)",
+                                self.name, d, int(used), lim)
+                alerts = getattr(self, "_alerted_at", None)
+                if alerts is None:
+                    alerts = self._alerted_at = {}
+                if alerts.get(d) is None or \
+                        now - alerts[d] >= self.opts["alert-time"]:
+                    alerts[d] = now
+                    from ..core.events import gf_event
+
+                    gf_event("QUOTA_SOFT_LIMIT", path=d,
+                             used=int(used), limit=int(lim))
 
     async def _account(self, path: str, delta: int) -> None:
         for d in self._covering(path):
             if d in self._usage:
                 self._usage[d] = max(0, self._usage[d] + delta)
                 await self._persist(d)
+
+    async def statfs(self, loc: Loc, xdata: dict | None = None):
+        """features.quota-deem-statfs (quota_statfs): when the volume
+        root carries a limit, df reports the QUOTA as the filesystem
+        size — the operator promised the tenant that much, not the
+        whole backing disk."""
+        out = await self.children[0].statfs(loc, xdata)
+        if not self.opts["deem-statfs"]:
+            return out
+        lim = self.limits.get("/")
+        if not lim:
+            return out
+        scale = self.opts["usage-scale"]
+        used = (await self._use("/")) * scale
+        bsize = max(1, out.get("bsize", 4096))
+        out = dict(out)
+        out["blocks"] = lim // bsize
+        out["bfree"] = out["bavail"] = max(0, (lim - used)) // bsize
+        return out
 
     # -- enforced fops -----------------------------------------------------
 

@@ -23,6 +23,16 @@ XA_SIZE = "trusted.glusterfs.shard.file-size"
 class ShardLayer(Layer):
     OPTIONS = (
         Option("shard-block-size", "size", default="64MB", min=4096),
+        Option("shard-lru-limit", "int", default=16384, min=64,
+               description="cached per-inode shard metadata entries "
+                           "(features.shard-lru-limit, shard.c inode "
+                           "LRU)"),
+        Option("shard-deletion-rate", "int", default=100, min=1,
+               description="shards removed per batch when a sharded "
+                           "file is unlinked (features.shard-deletion-"
+                           "rate): paces the background cleanup so a "
+                           "huge file's delete doesn't monopolize the "
+                           "brick"),
     )
 
     async def init(self):
@@ -41,22 +51,48 @@ class ShardLayer(Layer):
     def _shard_path(self, gfid: bytes, idx: int) -> str:
         return f"/{SHARD_DIR}/{gfid.hex()}.{idx}"
 
+    def _size_cache(self):
+        import collections
+
+        c = getattr(self, "_sizes", None)
+        if c is None:
+            c = self._sizes = collections.OrderedDict()
+        return c
+
+    def _size_cache_put(self, gfid: bytes, size: int) -> None:
+        c = self._size_cache()
+        c[gfid] = size
+        c.move_to_end(gfid)
+        while len(c) > int(self.opts["shard-lru-limit"]):
+            c.popitem(last=False)  # features.shard-lru-limit
+
     async def _true_size(self, loc_or_fd) -> int:
+        gfid = getattr(loc_or_fd, "gfid", None)
+        cache = self._size_cache()
+        if gfid is not None and gfid in cache:
+            cache.move_to_end(gfid)
+            return cache[gfid]
         try:
             if isinstance(loc_or_fd, FdObj):
                 out = await self.children[0].fgetxattr(loc_or_fd, XA_SIZE)
             else:
                 out = await self.children[0].getxattr(loc_or_fd, XA_SIZE)
-            return int(out[XA_SIZE].decode())
+            size = int(out[XA_SIZE].decode())
         except FopError:
             # unsharded legacy file: base size is the size
             if isinstance(loc_or_fd, FdObj):
-                return (await self.children[0].fstat(loc_or_fd)).size
-            return (await self.children[0].stat(loc_or_fd)).size
+                size = (await self.children[0].fstat(loc_or_fd)).size
+            else:
+                size = (await self.children[0].stat(loc_or_fd)).size
+        if gfid is not None:
+            self._size_cache_put(gfid, size)
+        return size
 
     async def _set_size(self, fd: FdObj, size: int) -> None:
         await self.children[0].fsetxattr(
             fd, {XA_SIZE: str(size).encode()})
+        if fd.gfid is not None:
+            self._size_cache_put(fd.gfid, size)
 
     async def _shard_write(self, gfid: bytes, idx: int, data: bytes,
                            offset: int, base_fd: FdObj) -> None:
@@ -200,15 +236,24 @@ class ShardLayer(Layer):
 
     async def unlink(self, loc: Loc, xdata: dict | None = None):
         try:
+            import asyncio
+
             ia, _ = await self.children[0].lookup(loc)
             bs = self._bs()
             true_size = await self._true_size(loc)
-            for idx in range(1, (true_size + bs - 1) // bs):
-                try:
-                    await self.children[0].unlink(
-                        Loc(self._shard_path(ia.gfid, idx)))
-                except FopError:
-                    pass
+            rate = int(self.opts["shard-deletion-rate"])
+            nshards = (true_size + bs - 1) // bs
+            for batch_start in range(1, nshards, rate):
+                for idx in range(batch_start,
+                                 min(batch_start + rate, nshards)):
+                    try:
+                        await self.children[0].unlink(
+                            Loc(self._shard_path(ia.gfid, idx)))
+                    except FopError:
+                        pass
+                # features.shard-deletion-rate: yield between batches
+                # so a huge delete interleaves with client fops
+                await asyncio.sleep(0)
         except FopError:
             pass
         return await self.children[0].unlink(loc, xdata)

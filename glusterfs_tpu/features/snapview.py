@@ -46,7 +46,18 @@ class SnapviewLayer(Layer):
                description="parent volume whose snapshots to serve"),
         Option("refresh-interval", "time", default="2",
                description="snapshot-list cache lifetime"),
+        Option("snapshot-directory", "str", default=".snaps",
+               description="name of the snapshot entry directory "
+                           "(features.snapshot-directory)"),
+        Option("show-snapshot-directory", "bool", default="off",
+               description="list the snapshot directory in readdir of "
+                           "/ (features.show-snapshot-directory); off "
+                           "keeps it enter-by-name only like the "
+                           "reference default"),
     )
+
+    def _snapdir(self) -> str:
+        return "/" + str(self.opts["snapshot-directory"]).strip("/")
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
@@ -122,9 +133,9 @@ class SnapviewLayer(Layer):
 
     # -- path splitting ----------------------------------------------------
 
-    @staticmethod
-    def _split(path: str | None):
-        """None if not under /.snaps, else (snap|None, inner path)."""
+    def _split(self, path: str | None):
+        """None if not under the snap dir, else (snap|None, inner)."""
+        SNAPS = self._snapdir()
         if not path or not (path == SNAPS or
                             path.startswith(SNAPS + "/")):
             return None
@@ -147,7 +158,8 @@ class SnapviewLayer(Layer):
     async def _proxy(self, snap: str, op: str, inner_first, *rest):
         snaps = await self._snapshots()
         if snap not in snaps:
-            raise FopError(errno.ENOENT, f"{SNAPS}/{snap}")
+            raise FopError(errno.ENOENT,
+                           f"{self._snapdir()}/{snap}")
         cl = await self._snap_client(snap)
         return await getattr(cl.graph.top, op)(inner_first, *rest)
 
@@ -248,11 +260,18 @@ class SnapviewLayer(Layer):
                       xdata: dict | None = None):
         ctx = self._inner_fd(fd)
         if ctx is None:
-            if fd.path == SNAPS:
+            if fd.path == self._snapdir():
                 return [(n, None) for n in
                         sorted(await self._snapshots())]
-            return await self.children[0].readdir(fd, size, offset,
-                                                  xdata)
+            out = await self.children[0].readdir(fd, size, offset,
+                                                 xdata)
+            if fd.path == "/" and self.opts["show-snapshot-directory"]:
+                # features.show-snapshot-directory: surface the entry
+                # in / listings (default hidden, enter-by-name only)
+                name = self._snapdir().lstrip("/")
+                if all(n != name for n, _ in out):
+                    out = list(out) + [(name, None)]
+            return out
         snap, inner = ctx
         return await self._proxy(snap, "readdir", inner, size, offset,
                                  xdata)
@@ -261,8 +280,8 @@ class SnapviewLayer(Layer):
                        xdata: dict | None = None):
         ctx = self._inner_fd(fd)
         if ctx is None:
-            if fd.path == SNAPS:
-                return [(n, self._root_iatt(SNAPS + "/" + n))
+            if fd.path == self._snapdir():
+                return [(n, self._root_iatt(self._snapdir() + "/" + n))
                         for n in sorted(await self._snapshots())]
             return await self.children[0].readdirp(fd, size, offset,
                                                    xdata)

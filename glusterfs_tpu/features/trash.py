@@ -14,6 +14,9 @@ from ..core.layer import Layer, Loc, register
 from ..core.options import Option
 
 TRASH_DIR = ".trashcan"
+# xdata flag internal engines (heal, rebalance, gsyncd) set on their
+# own unlinks: those bypass the trash hold (trash.c internal_op)
+INTERNAL_OP = "glusterfs_tpu.internal-op"
 
 
 @register("features/trash")
@@ -21,18 +24,44 @@ class TrashLayer(Layer):
     OPTIONS = (
         Option("trash", "bool", default="on"),
         Option("trash-max-filesize", "size", default="5MB"),
+        Option("trash-dir", "str", default=TRASH_DIR,
+               description="name of the hold directory "
+                           "(features.trash-dir)"),
+        Option("eliminate-path", "str", default="",
+               description="comma-separated path patterns deleted "
+                           "directly, never trashed "
+                           "(features.trash-eliminate-path)"),
+        Option("internal-op", "bool", default="off",
+               description="trash INTERNAL unlinks too (heal/"
+                           "rebalance cleanup; features.trash-"
+                           "internal-op) — default skips them like "
+                           "the reference"),
     )
+
+    def _dir(self) -> str:
+        return str(self.opts["trash-dir"] or TRASH_DIR).strip("/")
+
+    def _eliminated(self, path: str) -> bool:
+        import fnmatch
+
+        spec = str(self.opts["eliminate-path"])
+        return any(fnmatch.fnmatch(path, p.strip())
+                   for p in spec.split(",") if p.strip())
 
     async def init(self):
         await super().init()
         try:
-            await self.children[0].mkdir(Loc("/" + TRASH_DIR), 0o700)
+            await self.children[0].mkdir(Loc("/" + self._dir()), 0o700)
         except FopError as e:
             if e.err != errno.EEXIST:
                 raise
 
     async def unlink(self, loc: Loc, xdata: dict | None = None):
-        if not self.opts["trash"] or loc.path.startswith("/" + TRASH_DIR):
+        tdir = self._dir()
+        internal = bool((xdata or {}).get(INTERNAL_OP))
+        if not self.opts["trash"] or loc.path.startswith("/" + tdir) \
+                or self._eliminated(loc.path) \
+                or (internal and not self.opts["internal-op"]):
             return await self.children[0].unlink(loc, xdata)
         try:
             ia, _ = await self.children[0].lookup(loc)
@@ -41,10 +70,10 @@ class TrashLayer(Layer):
         except FopError:
             return await self.children[0].unlink(loc, xdata)
         stamp = time.strftime("%Y-%m-%d-%H%M%S")
-        dest = f"/{TRASH_DIR}/{loc.path.strip('/').replace('/', '_')}" \
+        dest = f"/{tdir}/{loc.path.strip('/').replace('/', '_')}" \
                f"_{stamp}"
         await self.children[0].rename(loc, Loc(dest))
         return {}
 
     def dump_private(self) -> dict:
-        return {"trash_dir": "/" + TRASH_DIR}
+        return {"trash_dir": "/" + self._dir()}

@@ -21,13 +21,34 @@ FRAME_TIME = "frame-time"
 
 @register("features/utime")
 class UtimeLayer(Layer):
-    pass
+    from ..core.options import Option as _Opt
+
+    OPTIONS = (
+        _Opt("ctime", "bool", default="on",
+             description="stamp the CLIENT clock into mutating fops "
+                         "(features.ctime); off = each brick stamps "
+                         "its own clock and times may diverge across "
+                         "copies"),
+        _Opt("noatime", "bool", default="on",
+             description="skip access-time stamping on reads "
+                         "(ctime.noatime); off stamps reads too, one "
+                         "utime per read wave"),
+    )
+
+    async def readv(self, fd, size, offset, xdata=None):
+        if self.opts["ctime"] and not self.opts["noatime"]:
+            xdata = dict(xdata or {})
+            xdata[FRAME_TIME + "-atime"] = time.time()
+        return await self.children[0].readv(fd, size, offset, xdata)
 
 
 def _stamping(op_name: str):
     async def impl(self, *args, **kwargs):
         from ..core.virtfs import call_with_xdata
 
+        if not self.opts["ctime"]:  # features.ctime off: brick clocks
+            return await getattr(self.children[0], op_name)(*args,
+                                                            **kwargs)
         # callers pass xdata positionally as often as by keyword:
         # bind against the child's signature and merge there
         return await call_with_xdata(self.children[0], op_name, args,

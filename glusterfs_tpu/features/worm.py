@@ -1,54 +1,150 @@
 """features/worm — write-once-read-many enforcement.
 
-Reference: xlators/features/read-only/worm.c: files may be created and
-written once; after that, overwrites/truncates/unlinks are denied with
-EROFS.  Appends (writes at EOF) are allowed, matching the reference's
-O_APPEND carve-out."""
+Reference: xlators/features/read-only/worm.c.  Two modes:
+
+* volume-level (``worm on``): files may be created and written once;
+  overwrites/truncates/unlinks deny with EROFS.
+* file-level (``worm-file-level on``, worm.c worm_state_transition):
+  a file left unmodified for ``auto-commit-period`` transitions to a
+  RETAINED state (persisted in a ``trusted.worm.state`` xattr holding
+  {start, period}); retained files deny every mutation until
+  ``start + period`` passes, after which ``worm-files-deletable``
+  decides whether unlink (alone) is allowed.  ``retention-mode``
+  enterprise refuses to shorten a live retention; relax allows it.
+"""
 
 from __future__ import annotations
 
 import errno
+import json
+import time
 
 from ..core.fops import FopError
 from ..core.layer import FdObj, Layer, Loc, register
 from ..core.options import Option
+
+XA_STATE = "trusted.worm.state"
 
 
 @register("features/worm")
 class WormLayer(Layer):
     OPTIONS = (
         Option("worm", "bool", default="on"),
+        Option("worm-file-level", "bool", default="off",
+               description="per-file WORM with retention (worm.c "
+                           "worm_state_transition) instead of the "
+                           "whole-volume write-once gate"),
+        Option("worm-files-deletable", "bool", default="on",
+               description="expired-retention files may be unlinked "
+                           "(features.worm-files-deletable)"),
+        Option("default-retention-period", "time", default="120",
+               description="retention seconds stamped at the WORM "
+                           "transition (features.default-retention-"
+                           "period)"),
+        Option("auto-commit-period", "time", default="180",
+               description="idle seconds after the last modification "
+                           "before a file turns WORM "
+                           "(features.auto-commit-period)"),
+        Option("retention-mode", "enum", default="relax",
+               values=("relax", "enterprise"),
+               description="enterprise: a live retention can only be "
+                           "extended (features.retention-mode)"),
     )
 
     def _on(self) -> bool:
-        return bool(self.opts["worm"])
+        return bool(self.opts["worm"]) and \
+            not self.opts["worm-file-level"]
+
+    def _file_level(self) -> bool:
+        return bool(self.opts["worm-file-level"])
+
+    async def _state(self, loc: Loc):
+        """(retained, expired) after a lazy state transition."""
+        try:
+            x = await self.children[0].getxattr(loc, XA_STATE)
+            st = json.loads(bytes(x[XA_STATE]))
+        except (FopError, ValueError, KeyError):
+            st = None
+        now = time.time()
+        if st is None:
+            try:
+                ia, _ = await self.children[0].lookup(loc)
+            except FopError:
+                return False, False
+            if now - ia.mtime < self.opts["auto-commit-period"]:
+                return False, False  # still in its commit window
+            st = {"start": now,
+                  "period": float(self.opts["default-retention-period"])}
+            try:  # the lazy transition (worm_state_transition)
+                await self.children[0].setxattr(
+                    loc, {XA_STATE: json.dumps(st).encode()})
+            except FopError:
+                pass
+        return True, now >= st["start"] + st["period"]
+
+    async def _deny_file_level(self, loc: Loc, unlinking: bool = False):
+        retained, expired = await self._state(loc)
+        if not retained:
+            return
+        if unlinking and expired and self.opts["worm-files-deletable"]:
+            return
+        raise FopError(errno.EROFS, "worm: file retained")
 
     async def writev(self, fd: FdObj, data, offset: int,
                      xdata: dict | None = None):
-        if self._on():
+        if self._file_level():
+            await self._deny_file_level(Loc(fd.path, gfid=fd.gfid))
+        elif self._on():
             ia = await self.children[0].fstat(fd)
             if offset < ia.size:
                 raise FopError(errno.EROFS, "worm: overwrite denied")
         return await self.children[0].writev(fd, data, offset, xdata)
 
     async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
-        if self._on():
+        if self._file_level():
+            await self._deny_file_level(loc)
+        elif self._on():
             raise FopError(errno.EROFS, "worm: truncate denied")
         return await self.children[0].truncate(loc, size, xdata)
 
     async def ftruncate(self, fd: FdObj, size: int,
                         xdata: dict | None = None):
-        if self._on():
+        if self._file_level():
+            await self._deny_file_level(Loc(fd.path, gfid=fd.gfid))
+        elif self._on():
             raise FopError(errno.EROFS, "worm: truncate denied")
         return await self.children[0].ftruncate(fd, size, xdata)
 
     async def unlink(self, loc: Loc, xdata: dict | None = None):
-        if self._on():
+        if self._file_level():
+            await self._deny_file_level(loc, unlinking=True)
+        elif self._on():
             raise FopError(errno.EROFS, "worm: unlink denied")
         return await self.children[0].unlink(loc, xdata)
 
     async def rename(self, oldloc: Loc, newloc: Loc,
                      xdata: dict | None = None):
-        if self._on():
+        if self._file_level():
+            await self._deny_file_level(oldloc)
+        elif self._on():
             raise FopError(errno.EROFS, "worm: rename denied")
         return await self.children[0].rename(oldloc, newloc, xdata)
+
+    async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
+                       xdata: dict | None = None):
+        if self._file_level() and XA_STATE in xattrs:
+            # manual retention adjust: enterprise mode only extends
+            try:
+                cur = await self.children[0].getxattr(loc, XA_STATE)
+                old = json.loads(bytes(cur[XA_STATE]))
+                new = json.loads(bytes(xattrs[XA_STATE]))
+                if self.opts["retention-mode"] == "enterprise" and \
+                        new.get("start", 0) + new.get("period", 0) < \
+                        old.get("start", 0) + old.get("period", 0):
+                    raise FopError(errno.EPERM,
+                                   "worm: enterprise retention may "
+                                   "only extend")
+            except (FopError, ValueError, KeyError) as e:
+                if isinstance(e, FopError) and e.err == errno.EPERM:
+                    raise
+        return await self.children[0].setxattr(loc, xattrs, flags, xdata)

@@ -215,7 +215,11 @@ class BrickBitd:
             # source group (zero version) and land in the pending index
             # (raise dirty)
             for ns in ("trusted.ec.", "trusted.afr."):
-                if ns + "version" in x:
+                # any counter in the namespace marks this as a cluster
+                # object; with a delayed post-op the version xattr may
+                # not exist YET (only the pre-op dirty does) — zero it
+                # anyway so this brick can never join the source group
+                if any(k.startswith(ns) for k in x):
                     marks[ns + "version"] = struct.pack(">QQ", 0, 0)
                     marks[ns + "dirty"] = struct.pack(">QQ", 1, 0)
             try:
@@ -263,7 +267,11 @@ async def _amain(args) -> None:
             for w in workers:
                 try:
                     await w.sign_pass()
-                    await w.scrub_pass()
+                    if not args.no_scrub:
+                        # features.scrub off/pause stops SCRUBBING only;
+                        # signing continues so the pause window stays
+                        # verifiable once scrubbing resumes
+                        await w.scrub_pass()
                 except Exception as e:
                     log.error(4, "bitd pass failed: %r", e)
             if args.statusfile:
@@ -294,6 +302,8 @@ def main(argv=None) -> int:
     from . import svcutil
     svcutil.add_ssl_args(p)
     p.add_argument("--quiesce", type=float, default=120.0)
+    p.add_argument("--no-scrub", action="store_true",
+                   help="sign only (features.scrub off/pause)")
     p.add_argument("--scrub-interval", type=float, default=60.0)
     p.add_argument("--scrub-throttle", type=float,
                    default=DEFAULT_SCRUB_THROTTLE,

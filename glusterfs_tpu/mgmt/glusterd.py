@@ -924,6 +924,11 @@ class Glusterd:
             if not opts.get("ssl.cert"):
                 raise MgmtError("server.ssl needs ssl.cert set first "
                                 "(bricks would fail to start)")
+        if key == "config.transport" and value not in ("tcp",):
+            # the one transport this build speaks (rdma is a descope;
+            # see docs/volume_options.md)
+            raise MgmtError(f"unsupported transport {value!r} "
+                            "(this build speaks tcp)")
         results = await self._cluster_txn(
             "volume-set", {"name": name, "key": key, "value": value})
         return {"ok": True,
@@ -1641,9 +1646,42 @@ class Glusterd:
         if on:
             await self._set_barrier(vol, True)
             await self._await_barrier_drain(vol)
+            # eager-window quiesce: clients hold inodelks with DELAYED
+            # post-ops (post-op-delay semantics) — data is on the bricks
+            # but size/version commit on a timer.  Fire a contention
+            # upcall at every held lock (the same signal a conflicting
+            # locker sends, ec_lock_release on INODELK_CONTENTION) and
+            # wait for the holders to commit + release, so the snapshot
+            # captures settled counters, not a crash image needing heal.
+            await self._quiesce_client_locks(vol)
         else:
             await self._set_barrier(vol, False, strict=False)
         return {"barriered": on}
+
+    async def _quiesce_client_locks(self, vol: dict,
+                                    timeout: float = 5.0) -> None:
+        deadline = time.monotonic() + timeout
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid or b["name"] not in self.bricks:
+                continue
+            port = self.ports.get(b["name"])
+            if not port:
+                continue
+            try:
+                await self._brick_call(vol, port, "contend_held_locks",
+                                       [], subvol=b["name"] + "-server")
+            except Exception:
+                continue  # old/bare brick: crash-consistent copy
+            while time.monotonic() < deadline:
+                dump = await self._brick_statedump(
+                    vol, port, subvol=b["name"] + "-server")
+                layers = (dump or {}).get("layers", {})
+                granted = [l["private"].get("granted", 0)
+                           for l in layers.values()
+                           if l.get("type") == "features/locks"]
+                if granted and sum(granted) == 0:
+                    break
+                await asyncio.sleep(0.05)
 
     def stage_snapshot_create(self, name: str, volume: str) -> None:
         # per-node duplicate check: snapshot state is per-node, and a
@@ -2175,6 +2213,22 @@ class Glusterd:
         from . import svcutil
 
         opts = vol.get("options", {})
+        scrub_off = str(opts.get("features.scrub", "on")).lower() in (
+            "off", "false", "no", "0", "pause")
+        # features.scrub-freq maps onto the sweep interval (hourly/
+        # daily/... in the reference; seconds here, names accepted)
+        freq = opts.get("features.scrub-freq",
+                        opts.get("bitrot.scrub-interval", 60))
+        freq = {"hourly": 3600, "daily": 86400, "weekly": 604800,
+                "biweekly": 1209600, "monthly": 2592000}.get(
+                    str(freq).lower(), freq)
+        thr = opts.get("features.scrub-throttle",
+                       opts.get("bitrot.scrub-throttle",
+                                DEFAULT_SCRUB_THROTTLE))
+        thr = {"lazy": DEFAULT_SCRUB_THROTTLE / 4,
+               "normal": DEFAULT_SCRUB_THROTTLE,
+               "aggressive": DEFAULT_SCRUB_THROTTLE * 8}.get(
+                   str(thr).lower(), thr)
         env = svcutil.spawn_env(vol, "GFTPU_BITD")
         statusfile = os.path.join(self.workdir, f"bitd-{name}.json")
         with open(os.path.join(self.workdir, f"bitd-{name}.log"),
@@ -2183,12 +2237,14 @@ class Glusterd:
                 [sys.executable, "-m", "glusterfs_tpu.mgmt.bitd",
                  "--bricks", ",".join(f"{n}:{p}" for n, p in local),
                  *svcutil.spawn_ssl_argv(opts),
-                 "--quiesce", str(opts.get("bitrot.signer-quiesce", 120)),
-                 "--scrub-interval",
-                 str(opts.get("bitrot.scrub-interval", 60)),
-                 "--scrub-throttle",
-                 str(opts.get("bitrot.scrub-throttle",
-                              DEFAULT_SCRUB_THROTTLE)),
+                 # features.expiry-time: the signer's quiesce window
+                 "--quiesce", str(opts.get("features.expiry-time",
+                                           opts.get(
+                                               "bitrot.signer-quiesce",
+                                               120))),
+                 "--scrub-interval", str(freq),
+                 "--scrub-throttle", str(thr),
+                 *(["--no-scrub"] if scrub_off else []),
                  "--statusfile", statusfile],
                 env=env, stdout=subprocess.DEVNULL, stderr=logf)
 
@@ -2578,12 +2634,27 @@ class Glusterd:
         """One shd per started heal-capable volume on this node."""
         if vol["type"] not in ("disperse", "replicate"):
             return
+        opts = vol.get("options", {})
+        gate = "cluster.disperse-self-heal-daemon" \
+            if vol["type"] == "disperse" else "cluster.self-heal-daemon"
+        if str(opts.get(gate, "on")).lower() in ("off", "false", "no",
+                                                 "0", "disable"):
+            return  # operator turned the healer off for this volume
         name = vol["name"]
         proc = self.shd.get(name)
         if proc is not None and proc.poll() is None:
             return
-        interval = float(vol.get("options", {}).get(
-            "cluster.heal-timeout", 10))
+        interval = float(opts.get("cluster.heal-timeout", 10))
+        prefix = "disperse." if vol["type"] == "disperse" else "cluster."
+        max_heals = int(opts.get(prefix + "shd-max-threads",
+                                 opts.get("cluster.background-self-heal-"
+                                          "count",
+                                          opts.get("disperse.background-"
+                                                   "heals", 1))))
+        qlen = int(opts.get(prefix + "shd-wait-qlength",
+                            opts.get("cluster.heal-wait-queue-length",
+                                     opts.get("disperse.heal-wait-"
+                                              "qlength", 1024))))
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
@@ -2594,6 +2665,8 @@ class Glusterd:
                 [sys.executable, "-m", "glusterfs_tpu.mgmt.shd",
                  "--glusterd", f"{self.host}:{self.port}",
                  "--volname", name, "--interval", str(interval),
+                 "--max-heals", str(max_heals),
+                 "--wait-qlength", str(qlen),
                  "--statefile", statefile],
                 env=env, stdout=subprocess.DEVNULL, stderr=logf)
 

@@ -125,43 +125,68 @@ async def full_crawl(client) -> dict:
     return report
 
 
-async def crawl_once(client) -> dict:
-    """One full index sweep; returns a heal report."""
-    report = {"healed": [], "skipped": [], "failed": [], "pruned": []}
+async def crawl_once(client, max_heals: int = 1,
+                     wait_qlength: int = 1024) -> dict:
+    """One full index sweep; returns a heal report.
+
+    ``max_heals`` concurrent file heals (cluster/disperse
+    shd-max-threads: the reference scales healer threads); entries past
+    ``max_heals + wait_qlength`` defer to the next sweep
+    (heal-wait-queue-length: bound the in-memory heal backlog)."""
+    report = {"healed": [], "skipped": [], "failed": [], "pruned": [],
+              "deferred": 0}
+    sem = asyncio.Semaphore(max(1, max_heals))
     for layer in _heal_layers(client.graph):
         pending = await list_pending(layer)
-        for hexgfid, holders in pending.items():
-            gfid = bytes.fromhex(hexgfid)
-            path = await _resolve(layer, gfid)
-            if path is None:
-                # object is gone everywhere: stale entry, prune it
-                for child in holders:
+        cap = max(1, max_heals) + max(0, wait_qlength)
+        items = list(pending.items())
+        if len(items) > cap:
+            report["deferred"] += len(items) - cap
+            items = items[:cap]
+        tasks = []
+        for hexgfid, holders in items:
+            async def one(hexgfid=hexgfid, holders=holders,
+                          layer=layer) -> None:
+                async with sem:
+                    gfid = bytes.fromhex(hexgfid)
+                    path = await _resolve(layer, gfid)
+                    if path is None:
+                        # object is gone everywhere: stale entry, prune
+                        for child in holders:
+                            try:
+                                await child.setxattr(
+                                    Loc("/"),
+                                    {XA_INDEX_PRUNE: hexgfid.encode()})
+                            except FopError:
+                                pass
+                        report["pruned"].append(hexgfid)
+                        return
                     try:
-                        await child.setxattr(
-                            Loc("/"), {XA_INDEX_PRUNE: hexgfid.encode()})
-                    except FopError:
-                        pass
-                report["pruned"].append(hexgfid)
-                continue
-            try:
-                ia, _ = await layer.lookup(Loc(path))
-                if ia.ia_type is IAType.DIR and \
-                        callable(getattr(layer, "heal_entry", None)):
-                    await layer.heal_entry(path)
-                    res = {"healed": [], "skipped": False}
-                else:
-                    res = await layer.heal_file(path)
-            except FopError as e:
-                report["failed"].append({"path": path, "error": str(e)})
-                continue
-            key = "skipped" if res.get("skipped") else "healed"
-            report[key].append({"path": path, "gfid": hexgfid,
-                                "bricks": res.get("healed", [])})
-            if key == "healed":
-                from ..core.events import gf_event
+                        ia, _ = await layer.lookup(Loc(path))
+                        if ia.ia_type is IAType.DIR and \
+                                callable(getattr(layer, "heal_entry",
+                                                 None)):
+                            await layer.heal_entry(path)
+                            res = {"healed": [], "skipped": False}
+                        else:
+                            res = await layer.heal_file(path)
+                    except FopError as e:
+                        report["failed"].append({"path": path,
+                                                 "error": str(e)})
+                        return
+                    key = "skipped" if res.get("skipped") else "healed"
+                    report[key].append({"path": path, "gfid": hexgfid,
+                                        "bricks": res.get("healed", [])})
+                    if key == "healed":
+                        from ..core.events import gf_event
 
-                gf_event("HEAL_COMPLETE", path=path, gfid=hexgfid,
-                         bricks=res.get("healed", []))
+                        gf_event("HEAL_COMPLETE", path=path,
+                                 gfid=hexgfid,
+                                 bricks=res.get("healed", []))
+
+            tasks.append(asyncio.ensure_future(one()))
+        if tasks:
+            await asyncio.gather(*tasks)
     return report
 
 
@@ -189,9 +214,12 @@ async def gather_heal_info(client) -> dict:
 class SelfHealDaemon:
     """Periodic index healer over one mounted client graph."""
 
-    def __init__(self, client, interval: float = 10.0):
+    def __init__(self, client, interval: float = 10.0,
+                 max_heals: int = 1, wait_qlength: int = 1024):
         self.client = client
         self.interval = interval
+        self.max_heals = max_heals
+        self.wait_qlength = wait_qlength
         self.sweeps = 0
         self.last_report: dict = {}
         self._task: asyncio.Task | None = None
@@ -203,7 +231,8 @@ class SelfHealDaemon:
             # not be lost — it means damage this sweep may have missed
             self._wake.clear()
             try:
-                self.last_report = await crawl_once(self.client)
+                self.last_report = await crawl_once(
+                    self.client, self.max_heals, self.wait_qlength)
             except Exception as e:  # a sweep must never kill the daemon
                 log.error(1, "shd sweep failed: %r", e)
             self.sweeps += 1
@@ -244,7 +273,8 @@ async def _amain(args) -> None:
         with open(args.statefile + ".tmp", "w") as f:
             json.dump({"pid": os.getpid(), "volume": args.volname}, f)
         os.replace(args.statefile + ".tmp", args.statefile)
-    shd = SelfHealDaemon(client, args.interval)
+    shd = SelfHealDaemon(client, args.interval,
+                         args.max_heals, args.wait_qlength)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -260,6 +290,8 @@ def main(argv=None) -> int:
     p.add_argument("--glusterd", required=True, help="host:port")
     p.add_argument("--volname", required=True)
     p.add_argument("--interval", type=float, default=10.0)
+    p.add_argument("--max-heals", type=int, default=1)
+    p.add_argument("--wait-qlength", type=int, default=1024)
     p.add_argument("--statefile", default="")
     args = p.parse_args(argv)
     asyncio.run(_amain(args))

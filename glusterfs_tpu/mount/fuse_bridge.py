@@ -78,7 +78,8 @@ class FuseBridge:
     """Serve one mountpoint from one mounted :class:`api.glfs.Client`."""
 
     def __init__(self, client: Client, mountpoint: str,
-                 volname: str = "gftpu", keep_cache: bool = False):
+                 volname: str = "gftpu", keep_cache: bool = False,
+                 writeback_cache: bool = True):
         self.client = client
         self.mountpoint = os.path.abspath(mountpoint)
         self.volname = volname
@@ -87,6 +88,13 @@ class FuseBridge:
         # the reference: safe for single-writer mounts, stale for
         # multi-client files unless upcall invalidation is on
         self.keep_cache = keep_cache
+        # FUSE_WRITEBACK_CACHE (fuse-bridge.c kernel-writeback-cache +
+        # INIT tuning, :5178): the kernel aggregates dirty pages and
+        # sends up-to-max_write writes instead of one request per
+        # ≤128KiB chunk, and absorbs rewrites entirely.  Default ON —
+        # a mount is typically this machine's one writer; multi-mount
+        # workloads needing write-through turn it off
+        self.writeback_cache = writeback_cache
         self.dev_fd = -1
         self.proto_minor = 0
         self._nodes: dict[int, _Node] = {}
@@ -341,10 +349,12 @@ class FuseBridge:
     async def _op_init(self, nodeid: int, payload: bytes) -> bytes:
         major, minor, _ra, kflags = fp.INIT_IN.unpack_from(payload)
         self.proto_minor = min(minor, fp.FUSE_KERNEL_MINOR_VERSION)
-        flags = (fp.FUSE_ASYNC_READ | fp.FUSE_BIG_WRITES
-                 | fp.FUSE_PARALLEL_DIROPS | fp.FUSE_MAX_PAGES
-                 | fp.FUSE_DO_READDIRPLUS | fp.FUSE_READDIRPLUS_AUTO
-                 ) & kflags  # never claim a flag the kernel didn't offer
+        want = (fp.FUSE_ASYNC_READ | fp.FUSE_BIG_WRITES
+                | fp.FUSE_PARALLEL_DIROPS | fp.FUSE_MAX_PAGES
+                | fp.FUSE_DO_READDIRPLUS | fp.FUSE_READDIRPLUS_AUTO)
+        if self.writeback_cache:
+            want |= fp.FUSE_WRITEBACK_CACHE
+        flags = want & kflags  # never claim a flag the kernel didn't offer
         return fp.INIT_OUT.pack(
             fp.FUSE_KERNEL_VERSION, self.proto_minor, 1 << 20, flags,
             64, 48, _MAX_WRITE, 1, _MAX_WRITE // 4096, 0, 0
@@ -698,7 +708,8 @@ async def _amain(args) -> int:
     client = await mount_volume(host or "127.0.0.1", int(port),
                                 args.volume)
     bridge = FuseBridge(client, args.mountpoint, args.volume,
-                        keep_cache=args.fopen_keep_cache)
+                        keep_cache=args.fopen_keep_cache,
+                        writeback_cache=not args.no_writeback_cache)
     bridge.mount()
     if args.readyfile:
         with open(args.readyfile + ".tmp", "w") as f:
@@ -732,6 +743,10 @@ def main(argv=None) -> int:
     p.add_argument("--fopen-keep-cache", action="store_true",
                    help="keep kernel page cache across opens "
                         "(glusterfs --fopen-keep-cache)")
+    p.add_argument("--no-writeback-cache", action="store_true",
+                   help="write-through: disable FUSE_WRITEBACK_CACHE "
+                        "(glusterfs --kernel-writeback-cache=off); "
+                        "needed when several mounts write one file")
     p.add_argument("mountpoint")
     args = p.parse_args(argv)
     return asyncio.run(_amain(args))

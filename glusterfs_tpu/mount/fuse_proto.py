@@ -80,6 +80,7 @@ FOPEN_KEEP_CACHE = 1 << 1
 FUSE_DO_READDIRPLUS = 1 << 13
 FUSE_READDIRPLUS_AUTO = 1 << 14
 FUSE_PARALLEL_DIROPS = 1 << 18
+FUSE_WRITEBACK_CACHE = 1 << 16
 FUSE_MAX_PAGES = 1 << 22
 
 # -- SETATTR valid bits ----------------------------------------------------

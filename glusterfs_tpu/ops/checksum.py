@@ -107,10 +107,11 @@ def adler32_batch(blocks: np.ndarray, backend: str = "auto"):
     return adler32_batch_np(blocks)
 
 
-def rchecksum(data: bytes) -> dict:
+def rchecksum(data: bytes, fips: bool = True) -> dict:
     """One block's weak+strong checksum (the posix rchecksum fop
-    payload)."""
+    payload).  fips (storage.fips-mode-rchecksum): sha256; off = the
+    reference's legacy md5 strong sum."""
     import hashlib
 
-    return {"weak": adler32_ref(data),
-            "strong": hashlib.sha256(data).hexdigest()}
+    strong = hashlib.sha256(data) if fips else hashlib.md5(data)
+    return {"weak": adler32_ref(data), "strong": strong.hexdigest()}

@@ -19,6 +19,21 @@ class IoCacheLayer(Layer):
         Option("cache-size", "size", default="32MB", min=4096),
         Option("page-size", "size", default="128KB", min=4096),
         Option("cache-timeout", "time", default="1"),
+        Option("max-file-size", "size", default="0", min=0,
+               description="pages at offsets past this are never "
+                           "cached (performance.cache-max-file-size; "
+                           "0 = unlimited): one huge streaming file "
+                           "must not wash the cache"),
+        Option("min-file-size", "size", default="0", min=0,
+               description="files KNOWN (from their EOF page) to be "
+                           "smaller than this are not cached "
+                           "(performance.cache-min-file-size; 0 = no "
+                           "floor — quick-read owns tiny files)"),
+        Option("priority", "str", default="",
+               description="comma list of pattern:level pairs "
+                           "(performance.cache-priority, ioc_priority): "
+                           "higher-level paths evict LAST — e.g. "
+                           "'*.db:3,*.tmp:0'"),
     )
 
     def __init__(self, *args, **kw):
@@ -33,12 +48,44 @@ class IoCacheLayer(Layer):
         # (ioc_cache_validate; local writes invalidate directly and
         # upcall events invalidate remotely-changed inodes)
         self._seen: dict[bytes, tuple[float, float]] = {}
+        self._prio: dict[bytes, int] = {}  # gfid -> cache-priority level
         self.hits = 0
         self.misses = 0
         self.validations = 0
 
+    def _priority_of(self, path: str) -> int:
+        """performance.cache-priority (ioc_get_priority): first
+        matching pattern's level; unmatched paths are level 1."""
+        spec = str(self.opts["priority"]).strip()
+        if not spec:
+            return 1
+        import fnmatch
+        import os as _os
+
+        base = _os.path.basename(path or "")
+        for part in spec.split(","):
+            pat, _, lvl = part.strip().rpartition(":")
+            if pat and fnmatch.fnmatch(base, pat):
+                try:
+                    return int(lvl)
+                except ValueError:
+                    return 1
+        return 1
+
     def _evict(self) -> None:
         limit = self.opts["cache-size"]
+        if self._bytes <= limit:
+            return
+        # evict lowest priority level first, LRU within a level
+        # (ioc_prune walks the per-priority page lists in order)
+        levels = sorted({self._prio.get(g, 1)
+                         for g, _ in self._pages}) if self._prio else [1]
+        for lvl in levels:
+            for key in [k for k in self._pages
+                        if self._prio.get(k[0], 1) == lvl]:
+                if self._bytes <= limit:
+                    return
+                self._bytes -= len(self._pages.pop(key))
         while self._bytes > limit and self._pages:
             _, page = self._pages.popitem(last=False)
             self._bytes -= len(page)
@@ -46,6 +93,7 @@ class IoCacheLayer(Layer):
     def _invalidate(self, gfid: bytes) -> None:
         for key in [k for k in self._pages if k[0] == gfid]:
             self._bytes -= len(self._pages.pop(key))
+        self._prio.pop(gfid, None)
         self._seen.pop(gfid, None)
 
     def notify(self, event, source=None, data=None):
@@ -109,6 +157,13 @@ class IoCacheLayer(Layer):
                 self.hits += 1
                 self._pages.move_to_end((fd.gfid, i))
                 pages[i] = page
+                if len(page) < psz:
+                    # short page = EOF as of cache time (revalidation
+                    # drops it if the file grew): pages past it do not
+                    # exist — a big-buffer read must not treat them as
+                    # misses and re-fetch the whole span
+                    missing = [m for m in missing if m < i]
+                    break
         if missing:
             self.misses += len(missing)
             m0, m1 = missing[0], missing[-1]
@@ -117,11 +172,23 @@ class IoCacheLayer(Layer):
             data = await self.children[0].readv(
                 fd, (m1 - m0 + 1) * psz, m0 * psz, xdata)
             data = bytes(data) if not isinstance(data, bytes) else data
+            maxsz = self.opts["max-file-size"]
+            minsz = self.opts["min-file-size"]
+            self._prio.setdefault(fd.gfid,
+                                  self._priority_of(fd.path))
             for i in range(m0, m1 + 1):
                 page = data[(i - m0) * psz: (i - m0 + 1) * psz]
                 pages[i] = page
-                self._store(fd.gfid, i, page)
+                if not maxsz or (i + 1) * psz <= maxsz:
+                    # cache-max-file-size: the tail of a huge file
+                    # streams through without washing the cache
+                    self._store(fd.gfid, i, page)
                 if len(page) < psz:
+                    if minsz and i * psz + len(page) < minsz:
+                        # whole file is under the floor: tiny files
+                        # belong to quick-read, not page cache
+                        self._invalidate(fd.gfid)
+                        pages = dict(pages)  # serve this read, drop cache
                     break  # EOF: later pages don't exist
             self._evict()
             if fd.gfid not in self._seen:

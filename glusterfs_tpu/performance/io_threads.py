@@ -58,15 +58,24 @@ class IoThreadsLayer(Layer):
     OPTIONS = (
         Option("thread-count", "int", default=16, min=1, max=64),
         Option("high-prio-threads", "int", default=16, min=1, max=64),
+        Option("normal-prio-threads", "int", default=16, min=1, max=64,
+               description="concurrency of the normal queue "
+                           "(performance.normal-prio-threads; the pool "
+                           "itself stays thread-count wide)"),
         Option("low-prio-threads", "int", default=8, min=1, max=64),
         Option("least-prio-threads", "int", default=1, min=1, max=64),
+        Option("enable-least-priority", "bool", default="on",
+               description="off: least-priority fops (readdirp, "
+                           "rchecksum scrub reads) ride the normal "
+                           "queue instead of the starvable one "
+                           "(performance.enable-least-priority)"),
     )
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         self._gates = [
             asyncio.Semaphore(self.opts["high-prio-threads"]),
-            asyncio.Semaphore(self.opts["thread-count"]),
+            asyncio.Semaphore(self.opts["normal-prio-threads"]),
             asyncio.Semaphore(self.opts["low-prio-threads"]),
             asyncio.Semaphore(self.opts["least-prio-threads"]),
         ]
@@ -110,13 +119,16 @@ def _gated(fop: Fop):
     name = fop.value
 
     async def fop_impl(self, *args, **kwargs):
-        self.queued[pri] += 1
+        p = pri
+        if p == 3 and not self.opts["enable-least-priority"]:
+            p = 1  # least-priority disabled: ride the normal queue
+        self.queued[p] += 1
         try:
-            async with self._gates[pri]:
-                self.executed[pri] += 1
+            async with self._gates[p]:
+                self.executed[p] += 1
                 return await getattr(self.children[0], name)(*args, **kwargs)
         finally:
-            self.queued[pri] -= 1
+            self.queued[p] -= 1
     fop_impl.__name__ = name
     return fop_impl
 

@@ -19,15 +19,62 @@ class MdCacheLayer(Layer):
     OPTIONS = (
         Option("timeout", "time", default="1", min=0),
         Option("cache-xattrs", "bool", default="on"),
+        # xattr-family toggles (mdc_key_load_set, md-cache.c): only
+        # known-safe families are cached; each toggle admits its set
+        Option("cache-swift-metadata", "bool", default="off",
+               description="cache user.swift.metadata "
+                           "(performance.cache-swift-metadata)"),
+        Option("cache-samba-metadata", "bool", default="off",
+               description="cache user.DOSATTRIB + security.NTACL "
+                           "(performance.cache-samba-metadata)"),
+        Option("cache-capability-xattrs", "bool", default="on",
+               description="cache security.capability "
+                           "(performance.cache-capability-xattrs)"),
+        Option("cache-ima-xattrs", "bool", default="on",
+               description="cache security.ima "
+                           "(performance.cache-ima-xattrs)"),
+        Option("xattr-cache-list", "str", default="",
+               description="extra comma-separated fnmatch patterns of "
+                           "cacheable xattr names "
+                           "(performance.xattr-cache-list)"),
+        Option("md-cache-statfs", "bool", default="off",
+               description="cache statfs replies for one timeout "
+                           "(performance.md-cache-statfs)"),
+        Option("cache-invalidation", "bool", default="on",
+               description="react to server upcalls by dropping the "
+                           "entry (performance.cache-invalidation); "
+                           "off = pure-TTL cache"),
+    )
+
+    _FAMILIES = (
+        ("cache-swift-metadata", ("user.swift.metadata",)),
+        ("cache-samba-metadata", ("user.DOSATTRIB", "security.NTACL")),
+        ("cache-capability-xattrs", ("security.capability",)),
+        ("cache-ima-xattrs", ("security.ima",)),
     )
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         self._iatt: dict[bytes, tuple[float, object]] = {}
         self._xattr: dict[bytes, tuple[float, dict]] = {}
+        self._statfs: tuple[float, object] | None = None
         self.hits = 0
         self.misses = 0
         self.invalidations = 0  # upcall-driven (not TTL, not local fop)
+
+    def _xattr_cacheable(self, name: str) -> bool:
+        """Internal (trusted.*/glusterfs.*) names always cache; user/
+        security families by toggle; extra patterns by option list."""
+        if not name.startswith(("user.", "security.")):
+            return True
+        for opt, names in self._FAMILIES:
+            if name in names:
+                return bool(self.opts[opt])
+        import fnmatch
+
+        return any(fnmatch.fnmatch(name, p.strip())
+                   for p in str(self.opts["xattr-cache-list"]).split(",")
+                   if p.strip())
 
     def invalidate(self, gfid: bytes) -> None:
         self._iatt.pop(gfid, None)
@@ -38,7 +85,7 @@ class MdCacheLayer(Layer):
         a server-pushed invalidation drops the entry immediately instead
         of waiting out the TTL."""
         if event is Event.UPCALL and isinstance(data, dict) and \
-                data.get("gfid"):
+                data.get("gfid") and self.opts["cache-invalidation"]:
             self.invalidations += 1
             self.invalidate(data["gfid"])
         super().notify(event, source, data)
@@ -81,7 +128,8 @@ class MdCacheLayer(Layer):
 
     async def getxattr(self, loc: Loc, name: str | None = None,
                        xdata: dict | None = None):
-        if self.opts["cache-xattrs"] and loc.gfid and name is not None:
+        if self.opts["cache-xattrs"] and loc.gfid and name is not None \
+                and self._xattr_cacheable(name):
             entry = self._xattr.get(loc.gfid)
             if self._fresh(entry) and name in entry[1]:
                 self.hits += 1
@@ -90,9 +138,20 @@ class MdCacheLayer(Layer):
         if self.opts["cache-xattrs"] and loc.gfid:
             t, cur = self._xattr.get(loc.gfid, (0, {}))
             cur = dict(cur)
-            cur.update(out)
+            cur.update({k: v for k, v in out.items()
+                        if self._xattr_cacheable(k)})
             self._xattr[loc.gfid] = (time.monotonic(), cur)
         return out
+
+    async def statfs(self, loc: Loc, xdata: dict | None = None):
+        if self.opts["md-cache-statfs"]:
+            if self._fresh(self._statfs):
+                self.hits += 1
+                return self._statfs[1]
+            out = await self.children[0].statfs(loc, xdata)
+            self._statfs = (time.monotonic(), out)
+            return out
+        return await self.children[0].statfs(loc, xdata)
 
     def dump_private(self) -> dict:
         return {"iatts": len(self._iatt), "hits": self.hits,

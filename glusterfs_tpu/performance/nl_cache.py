@@ -19,11 +19,17 @@ class NlCacheLayer(Layer):
     OPTIONS = (
         Option("nl-cache-timeout", "time", default="60"),
         Option("nl-cache-limit", "int", default=65536),
+        Option("positive-entry", "bool", default="off",
+               description="cache successful lookups too "
+                           "(performance.nl-cache-positive-entry): "
+                           "repeated path walks skip the wire until "
+                           "timeout or a mutation under the parent"),
     )
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         self._neg: dict[str, float] = {}
+        self._pos: dict[str, tuple[float, object]] = {}
         self.hits = 0
 
     def _key(self, loc: Loc) -> str:
@@ -31,6 +37,7 @@ class NlCacheLayer(Layer):
 
     def _invalidate_parent(self, path: str) -> None:
         self._neg.pop(path, None)
+        self._pos.pop(path, None)
 
     async def lookup(self, loc: Loc, xdata: dict | None = None):
         key = self._key(loc)
@@ -40,13 +47,23 @@ class NlCacheLayer(Layer):
                 self.hits += 1
                 raise FopError(errno.ENOENT, f"{key} (cached)")
             del self._neg[key]
+        if self.opts["positive-entry"]:
+            ent = self._pos.get(key)
+            if ent is not None and time.monotonic() - ent[0] < \
+                    self.opts["nl-cache-timeout"]:
+                self.hits += 1
+                return ent[1]
         try:
-            return await self.children[0].lookup(loc, xdata)
+            ret = await self.children[0].lookup(loc, xdata)
         except FopError as e:
             if e.err == errno.ENOENT:
                 if len(self._neg) < self.opts["nl-cache-limit"]:
                     self._neg[key] = time.monotonic()
             raise
+        if self.opts["positive-entry"] and \
+                len(self._pos) < self.opts["nl-cache-limit"]:
+            self._pos[key] = (time.monotonic(), ret)
+        return ret
 
     def dump_private(self) -> dict:
         return {"negative_entries": len(self._neg), "hits": self.hits}
@@ -55,14 +72,18 @@ class NlCacheLayer(Layer):
 def _creating(op_name: str, loc_arg: int):
     async def fop(self, *args, **kwargs):
         ret = await getattr(self.children[0], op_name)(*args, **kwargs)
-        loc = args[loc_arg]
-        if isinstance(loc, Loc):
-            self._invalidate_parent(loc.path)
+        # every Loc involved goes stale (rename touches BOTH names)
+        for a in args:
+            if isinstance(a, Loc):
+                self._invalidate_parent(a.path)
         return ret
     fop.__name__ = op_name
     return fop
 
 
 for _op, _idx in (("create", 0), ("mkdir", 0), ("mknod", 0),
-                  ("symlink", 1), ("link", 1), ("rename", 1)):
+                  ("symlink", 1), ("link", 1), ("rename", 1),
+                  # removals: the positive entry (and, for rename's
+                  # source, both sides) must drop immediately
+                  ("unlink", 0), ("rmdir", 0)):
     setattr(NlCacheLayer, _op, _creating(_op, _idx))

@@ -34,6 +34,12 @@ class OpenBehindLayer(Layer):
                            "open-behind option of the same name): an "
                            "open/read/close pass never pays open or "
                            "release round trips"),
+        Option("read-after-open", "bool", default="off",
+               description="the first read materializes the REAL open "
+                           "instead of riding an anonymous fd "
+                           "(performance.read-after-open): apps that "
+                           "read-then-write want the fd identity "
+                           "stable from the first byte"),
     )
 
     async def open(self, loc: Loc, flags: int = 0, xdata: dict | None = None):
@@ -63,7 +69,8 @@ class OpenBehindLayer(Layer):
         """Anonymous stand-in for a read on a still-unopened lazy fd."""
         ctx: _ObCtx | None = fd.ctx_get(self)
         if ctx is None or ctx.real_fd is not None or \
-                not self.opts["use-anonymous-fd"]:
+                not self.opts["use-anonymous-fd"] or \
+                self.opts["read-after-open"]:
             return None
         import os as _os
 

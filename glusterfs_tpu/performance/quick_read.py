@@ -20,7 +20,19 @@ class QuickReadLayer(Layer):
         Option("max-file-size", "size", default="64KB", min=0),
         Option("cache-size", "size", default="16MB"),
         Option("cache-timeout", "time", default="1"),
+        Option("cache-invalidation", "bool", default="on",
+               description="drop a cached file on a server upcall "
+                           "(performance.quick-read-cache-invalidation) "
+                           "instead of waiting out the timeout"),
     )
+
+    def notify(self, event, source=None, data=None):
+        from ..core.layer import Event
+
+        if event is Event.UPCALL and isinstance(data, dict) and \
+                data.get("gfid") and self.opts["cache-invalidation"]:
+            self._invalidate(data["gfid"])
+        super().notify(event, source, data)
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
@@ -56,9 +68,20 @@ class QuickReadLayer(Layer):
         if size > maxsz:
             # a request larger than any qualifying file needs no size
             # probe — but it says nothing about the FILE's size (the
-            # kernel reads small files with big buffers), so no
-            # blacklisting here
-            return await self.children[0].readv(fd, size, offset, xdata)
+            # kernel and read_file read small files with big buffers),
+            # so no blacklisting here.  If the EOF-truncated answer
+            # turns out to BE a whole small file, cache it in passing.
+            data = await self.children[0].readv(fd, size, offset, xdata)
+            if offset == 0 and len(data) <= maxsz:
+                content = bytes(data)
+                self._invalidate(fd.gfid)  # replace, don't double-count
+                self._files[fd.gfid] = (time.monotonic(), content)
+                self._bytes += len(content)
+                while self._bytes > self.opts["cache-size"] \
+                        and self._files:
+                    _, (_, old) = self._files.popitem(last=False)
+                    self._bytes -= len(old)
+            return data
         ia = await self.children[0].fstat(fd)
         if ia.size > maxsz:
             self._too_big[fd.gfid] = time.monotonic()
@@ -67,6 +90,7 @@ class QuickReadLayer(Layer):
             # pin its whole RPC frame for the cache's lifetime
             content = bytes(
                 await self.children[0].readv(fd, maxsz + 1, 0))
+            self._invalidate(fd.gfid)  # replace, don't double-count
             self._files[fd.gfid] = (time.monotonic(), content)
             self._bytes += len(content)
             while self._bytes > self.opts["cache-size"] and self._files:

@@ -13,16 +13,52 @@ from ..core.options import Option
 class ReaddirAheadLayer(Layer):
     OPTIONS = (
         Option("rda-request-size", "size", default="128KB"),
+        Option("rda-cache-limit", "size", default="10MB",
+               description="total bytes of buffered listings across "
+                           "open dir fds (performance.rda-cache-limit): "
+                           "past it new opendirs stop prefetching"),
     )
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        import collections
+
+        # fd-id -> (fd, weight), LRU: rda-cache-limit evicts the oldest
+        # buffered listings (the reference prunes per-fd rda buffers
+        # against its global cache limit the same way)
+        self._lru: "collections.OrderedDict[int, tuple]" = \
+            collections.OrderedDict()
+        self._cached_bytes = 0
+
+    @staticmethod
+    def _weight(entries) -> int:
+        # rough per-entry footprint (name + iatt) for the cache budget
+        return sum(64 + len(getattr(e, "name", "") or "")
+                   for e in entries) if entries else 0
 
     async def opendir(self, loc: Loc, xdata: dict | None = None):
         fd = await self.children[0].opendir(loc, xdata)
         try:
             entries = await self.children[0].readdir(fd, 0, 0)
             fd.ctx_set(self, entries)
+            w = self._weight(entries)
+            self._lru[id(fd)] = (fd, w)
+            self._cached_bytes += w
+            limit = self.opts["rda-cache-limit"]
+            while self._cached_bytes > limit and self._lru:
+                _, (ofd, ow) = self._lru.popitem(last=False)
+                ofd.ctx_del(self)
+                self._cached_bytes -= ow
         except Exception:
             pass
         return fd
+
+    async def release(self, fd: FdObj):
+        ent = self._lru.pop(id(fd), None)
+        if ent is not None:
+            fd.ctx_del(self)
+            self._cached_bytes -= ent[1]
+        await super().release(fd)
 
     async def readdir(self, fd: FdObj, size: int = 0, offset: int = 0,
                       xdata: dict | None = None):

@@ -31,6 +31,23 @@ class WriteBehindLayer(Layer):
         Option("window-size", "size", default="1MB", min=512),
         Option("flush-behind", "bool", default="on"),
         Option("trickling-writes", "bool", default="on"),
+        Option("aggregate-size", "size", default="0", min=0,
+               description="flush once a single coalesced chunk reaches "
+                           "this size (performance.aggregate-size; "
+                           "reference default 128KB): bounds how large "
+                           "one merged child writev grows.  0 = only "
+                           "the window bounds it (this framework's "
+                           "historical behavior — EC mounts want whole "
+                           "stripes aggregated)"),
+        Option("strict-o-direct", "bool", default="off",
+               description="O_DIRECT fds bypass the window entirely "
+                           "(performance.strict-o-direct): the app asked "
+                           "for unbuffered semantics"),
+        Option("strict-write-ordering", "bool", default="off",
+               description="never acknowledge a write before every "
+                           "prior one reached the child: each write "
+                           "drains the window first "
+                           "(performance.strict-write-ordering)"),
     )
 
     def _ctx(self, fd: FdObj) -> _WbFd:
@@ -81,12 +98,27 @@ class WriteBehindLayer(Layer):
 
     async def writev(self, fd: FdObj, data, offset: int,
                      xdata: dict | None = None):
+        import os as _os
+
         ctx = self._ctx(fd)
         self._raise_deferred(ctx)
+        if self.opts["strict-o-direct"] and \
+                getattr(fd, "flags", 0) & getattr(_os, "O_DIRECT", 0):
+            # unbuffered semantics: drain anything pending, then write
+            # through (wb_enqueue bypass on O_DIRECT)
+            if ctx.chunks:
+                await self._drain(fd, ctx)
+                self._raise_deferred(ctx)
+            return await self.children[0].writev(fd, data, offset, xdata)
+        if self.opts["strict-write-ordering"] and ctx.chunks:
+            await self._drain(fd, ctx)
+            self._raise_deferred(ctx)
         async with ctx.lock:
             self._absorb(ctx, bytes(data), offset)
             ctx.logical_end = max(ctx.logical_end, offset + len(data))
-        if ctx.bytes >= self.opts["window-size"]:
+        agg = self.opts["aggregate-size"]
+        if ctx.bytes >= self.opts["window-size"] or \
+                (agg and any(len(b) >= agg for _, b in ctx.chunks)):
             await self._drain(fd, ctx)
             self._raise_deferred(ctx)
         ia = ctx.last_iatt

@@ -66,6 +66,23 @@ class ClientLayer(Layer):
                            "replies after the handshake"),
         Option("compression-min-size", "size", default="512",
                description="frames below this ship uncompressed"),
+        Option("compression-level", "int", default=1, min=-1, max=9,
+               description="zlib level for on-wire compression "
+                           "(network.compression.compression-level)"),
+        # socket.c transport knobs (0 = kernel default)
+        Option("tcp-user-timeout", "time", default="0",
+               description="TCP_USER_TIMEOUT: cap on unacked-data "
+                           "linger before the kernel declares the "
+                           "peer dead (client.tcp-user-timeout)"),
+        Option("keepalive-time", "time", default="20",
+               description="TCP_KEEPIDLE (client.keepalive-time)"),
+        Option("keepalive-interval", "time", default="2",
+               description="TCP_KEEPINTVL (client.keepalive-interval)"),
+        Option("keepalive-count", "int", default=9, min=0,
+               description="TCP_KEEPCNT (client.keepalive-count)"),
+        Option("tcp-window-size", "size", default="0",
+               description="SO_RCVBUF/SO_SNDBUF "
+                           "(network.tcp-window-size)"),
     )
 
     def __init__(self, *args, **kw):
@@ -126,6 +143,14 @@ class ClientLayer(Layer):
         self._tasks = [t for t in self._tasks if not t.done()]
         reader, writer = await asyncio.open_connection(
             host, port, ssl=self._ssl_context())
+        from ..rpc.socktune import tune_socket
+
+        tune_socket(writer.get_extra_info("socket"),
+                    keepalive_time=self.opts["keepalive-time"],
+                    keepalive_interval=self.opts["keepalive-interval"],
+                    keepalive_count=self.opts["keepalive-count"],
+                    user_timeout=self.opts["tcp-user-timeout"],
+                    window_size=self.opts["tcp-window-size"])
         self._reader, self._writer = reader, writer
         self._tasks.append(asyncio.create_task(self._read_loop(reader)))
         # handshake = SETVOLUME (client-handshake.c) with auth/login
@@ -297,9 +322,10 @@ class ClientLayer(Layer):
         try:
             body = [fop, list(args), kwargs or {}]
             if self.opts["compression"]:
-                writer.write(wire.pack_z(xid, wire.MT_CALL, body,
-                                         int(self.opts[
-                                             "compression-min-size"])))
+                writer.write(wire.pack_z(
+                    xid, wire.MT_CALL, body,
+                    int(self.opts["compression-min-size"]),
+                    self.opts["compression-level"]))
             else:
                 # payload blobs ride out-of-band and writelines hands
                 # the ORIGINAL buffers to the transport — a writev

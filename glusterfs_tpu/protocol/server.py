@@ -34,6 +34,7 @@ import asyncio
 import errno
 import fnmatch
 import hmac
+import socket
 import ssl as ssl_mod
 from typing import Any
 
@@ -81,6 +82,32 @@ class ServerLayer(Layer):
         Option("ssl-ca", "str", default="",
                description="PEM CA bundle; when set, client certificates "
                            "are required and verified (ssl-ca-list)"),
+        Option("listen-backlog", "int", default=1024, min=0,
+               description="accept-queue depth for the brick listener "
+                           "(transport.listen-backlog; socket.c default "
+                           "1024 — a connect storm at volume start must "
+                           "not see ECONNREFUSED)"),
+        Option("address-family", "enum", default="inet",
+               values=("inet", "inet6"),
+               description="listener address family "
+                           "(transport.address-family)"),
+        Option("allow-insecure", "bool", default="on",
+               description="accept client connections from unprivileged "
+                           "(>1023) source ports (server.allow-insecure; "
+                           "rpcsvc auth model).  Off = classic secure-"
+                           "port check"),
+        Option("tcp-user-timeout", "time", default="0",
+               description="TCP_USER_TIMEOUT on accepted connections "
+                           "(server.tcp-user-timeout)"),
+        Option("keepalive-time", "time", default="20",
+               description="TCP_KEEPIDLE (server.keepalive-time)"),
+        Option("keepalive-interval", "time", default="2",
+               description="TCP_KEEPINTVL (server.keepalive-interval)"),
+        Option("keepalive-count", "int", default=9, min=0,
+               description="TCP_KEEPCNT (server.keepalive-count)"),
+        Option("tcp-window-size", "size", default="0",
+               description="SO_RCVBUF/SO_SNDBUF on accepted "
+                           "connections (network.tcp-window-size)"),
         Option("outstanding-rpc-limit", "int", default=64, min=0,
                max=65536,
                description="per-client cap on in-flight requests: at the "
@@ -132,7 +159,7 @@ _THROTTLE_EXEMPT = {"inodelk", "finodelk", "entrylk", "fentrylk", "lk"}
 # introspection — the reference exposes these via separate RPC programs)
 _RPC_EXTRAS = {"heal_info", "heal_file", "heal_entry", "rebalance",
                "release", "getactivelk", "quota_usage", "top_stats",
-               "changelog_history"}
+               "changelog_history", "contend_held_locks"}
 
 
 class _ClientConn:
@@ -344,8 +371,15 @@ class BrickServer:
         return True
 
     async def start(self) -> int:
+        opts = self._opts_of(self.top)
+        backlog = int(opts.get("listen-backlog", 1024) or 1024)
+        family = {"inet": socket.AF_INET,
+                  "inet6": socket.AF_INET6}.get(
+                      str(opts.get("address-family", "inet")),
+                      socket.AF_UNSPEC)
         self._server = await asyncio.start_server(
-            self._serve, self.host, self.port, ssl=self._ssl_context())
+            self._serve, self.host, self.port, ssl=self._ssl_context(),
+            backlog=backlog, family=family)
         self.port = self._server.sockets[0].getsockname()[1]
         # hand the event-push callback to any upcall layer in the graph
         # (the reference's upcall xlator calls back through rpcsvc the
@@ -404,6 +438,22 @@ class BrickServer:
         not starve heartbeats behind it; serial dispatch also capped
         wire throughput at one fop round-trip at a time."""
         peer = writer.get_extra_info("peername") or ("?",)
+        opts = self._opts_of(self.top)
+        if not opts.get("allow-insecure", True) and len(peer) > 1 and \
+                isinstance(peer[1], int) and peer[1] > 1023:
+            # classic secure-port check (server.allow-insecure off):
+            # only root-bound source ports may talk to the brick
+            log.warning(7, "refusing unprivileged port %s:%s", *peer[:2])
+            writer.close()
+            return
+        from ..rpc.socktune import tune_socket
+
+        tune_socket(writer.get_extra_info("socket"),
+                    keepalive_time=opts.get("keepalive-time", 20),
+                    keepalive_interval=opts.get("keepalive-interval", 2),
+                    keepalive_count=opts.get("keepalive-count", 9),
+                    user_timeout=opts.get("tcp-user-timeout", 0),
+                    window_size=opts.get("tcp-window-size", 0))
         conn = _ClientConn(self, writer)
         conn.peer_addr = str(peer[0])
         self.connections.add(conn)
@@ -516,9 +566,17 @@ class BrickServer:
                     exempt_inflight += 1
                     kind = "exempt"
                 else:
-                    while inflight >= limit:  # stop reading this client
+                    # re-read the limit each pass, with a bounded wait:
+                    # a live volume-set raising the limit must unpark an
+                    # already-throttled connection even if none of its
+                    # parked requests ever completes (nothing else would
+                    # set the gate)
+                    while 0 < _limit() <= inflight:  # stop reading
                         gate.clear()
-                        await gate.wait()
+                        try:
+                            await asyncio.wait_for(gate.wait(), 1.0)
+                        except asyncio.TimeoutError:
+                            pass
                     inflight += 1
                     kind = "throttled"
                 t = asyncio.create_task(serve_one(xid, payload, kind))

@@ -316,6 +316,39 @@ class PosixLayer(Layer):
                description="seconds between backend probes (0 = off); "
                "a failing backend marks the brick down "
                "(posix_health_check_thread_proc)"),
+        Option("health-check-timeout", "time", default="10",
+               description="a single probe hanging past this (D-state "
+                           "disk) counts as failure "
+                           "(storage.health-check-timeout)"),
+        Option("create-mask", "str", default="0777",
+               description="octal AND-mask on file create modes "
+                           "(storage.create-mask, posix-metadata)"),
+        Option("create-directory-mask", "str", default="0777",
+               description="octal AND-mask on mkdir modes "
+                           "(storage.create-directory-mask)"),
+        Option("force-create-mode", "str", default="0000",
+               description="octal bits OR-ed onto every created file "
+                           "(storage.force-create-mode)"),
+        Option("force-directory-mode", "str", default="0000",
+               description="octal bits OR-ed onto every mkdir "
+                           "(storage.force-directory-mode)"),
+        Option("max-hardlinks", "int", default=100, min=0,
+               description="EMLINK past this many links to one inode "
+                           "(storage.max-hardlinks; 0 = unlimited)"),
+        Option("reserve", "percent", default="1",
+               description="refuse writes/creates when free space falls "
+                           "under this percent (storage.reserve; reads "
+                           "and deletes still pass so the operator can "
+                           "recover)"),
+        Option("owner-uid", "int", default=-1, min=-1,
+               description="chown the brick root at init "
+                           "(storage.owner-uid; -1 = leave)"),
+        Option("owner-gid", "int", default=-1, min=-1,
+               description="storage.owner-gid; -1 = leave"),
+        Option("fips-mode-rchecksum", "bool", default="on",
+               description="sha256 strong checksums (FIPS-allowed); "
+                           "off = legacy md5 "
+                           "(storage.fips-mode-rchecksum)"),
     )
 
     # journal records between sidecar compactions (the xattr write-path
@@ -382,10 +415,72 @@ class PosixLayer(Layer):
         # root of the brick always has the fixed ROOT_GFID
         if not os.path.exists(self._gfid_path(ROOT_GFID)):
             self._gfid_set(ROOT_GFID, "/")
+        if self.opts["owner-uid"] >= 0 or self.opts["owner-gid"] >= 0:
+            try:  # storage.owner-uid/-gid: brand the brick root
+                os.chown(self.root, self.opts["owner-uid"],
+                         self.opts["owner-gid"])
+            except OSError as e:
+                log.warning(9, "%s: owner-uid/gid chown failed: %s",
+                            self.name, e)
+        self._mode_opts()
+        self._reserve_checked = 0.0
+        self._reserve_full = False
         self._failed_health: str | None = None
         if float(self.opts["health-check-interval"]) > 0:
             self._health_task = asyncio.create_task(self._health_loop())
         await super().init()
+
+    def _mode_opts(self) -> None:
+        """Parse the octal mode-mask options once (hot create path)."""
+
+        def octal(key: str, dflt: int) -> int:
+            try:
+                return int(str(self.opts[key]), 8) & 0o7777
+            except ValueError:
+                log.warning(9, "%s: %s=%r is not octal; using %o",
+                            self.name, key, self.opts[key], dflt)
+                return dflt
+
+        self._fmask = octal("create-mask", 0o777)
+        self._dmask = octal("create-directory-mask", 0o777)
+        self._fforce = octal("force-create-mode", 0)
+        self._dforce = octal("force-directory-mode", 0)
+
+    def _file_mode(self, mode: int) -> int:
+        return (mode & self._fmask) | self._fforce
+
+    def _dir_mode(self, mode: int) -> int:
+        return (mode & self._dmask) | self._dforce
+
+    @property
+    def _mode_policy_active(self) -> bool:
+        # with masks/forced bits configured the EXACT mode must land —
+        # chmod after create, because the process umask (which the
+        # reference's brick daemon zeroes at startup) filters open(2)'s
+        # mode argument
+        return (self._fforce or self._dforce or self._fmask != 0o777
+                or self._dmask != 0o777)
+
+    def _check_reserve(self) -> None:
+        """storage.reserve: writes/creates fail with ENOSPC below the
+        floor; reads and deletes pass (the operator's way out).  The
+        statvfs is cached ~2s — this sits on the data hot path."""
+        pct = float(self.opts["reserve"])
+        if pct <= 0:
+            return
+        now = time.monotonic()
+        if now - self._reserve_checked > 2.0:
+            self._reserve_checked = now
+            try:
+                st = os.statvfs(self.root)
+                free = st.f_bavail / max(1, st.f_blocks) * 100.0
+                self._reserve_full = free < pct
+            except OSError:
+                self._reserve_full = False
+        if self._reserve_full:
+            raise FopError(errno.ENOSPC,
+                           f"brick under storage.reserve floor "
+                           f"({self.opts['reserve']}%)")
 
     async def fini(self):
         t = getattr(self, "_health_task", None)
@@ -407,6 +502,8 @@ class PosixLayer(Layer):
     def reconfigure(self, options: dict) -> None:
         old = float(self.opts["health-check-interval"])
         super().reconfigure(options)
+        self._mode_opts()
+        self._reserve_checked = 0.0  # re-probe under the new floor
         new = float(self.opts["health-check-interval"])
         if new == old or getattr(self, "_failed_health", None):
             return  # a failed brick stays down until respawn
@@ -443,10 +540,12 @@ class PosixLayer(Layer):
                         f.flush()
                         os.fsync(f.fileno())
 
-                await asyncio.to_thread(check)
+                to = float(self.opts["health-check-timeout"])
+                await asyncio.wait_for(asyncio.to_thread(check),
+                                       to if to > 0 else None)
             except asyncio.CancelledError:
                 raise
-            except OSError as e:
+            except (OSError, asyncio.TimeoutError) as e:
                 self._failed_health = str(e)
                 log.error(9, "%s: backend health check failed: %s — "
                           "marking brick down", self.name, e)
@@ -823,9 +922,12 @@ class PosixLayer(Layer):
 
     async def mkdir(self, loc: Loc, mode: int = 0o755,
                     xdata: dict | None = None):
+        self._check_reserve()
         path = self._loc_path(loc)
         try:
-            os.mkdir(self._abs(path), mode)
+            os.mkdir(self._abs(path), self._dir_mode(mode))
+            if self._mode_policy_active:
+                os.chmod(self._abs(path), self._dir_mode(mode))
         except OSError as e:
             raise _fop_errno(e)
         gfid = (xdata or {}).get("gfid-req") or gfid_new()
@@ -838,7 +940,10 @@ class PosixLayer(Layer):
         try:
             # regular files only (block/char nodes are out of scope)
             fdno = os.open(self._abs(path),
-                           os.O_CREAT | os.O_EXCL | os.O_WRONLY, mode)
+                           os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                           self._file_mode(mode))
+            if self._mode_policy_active:
+                os.fchmod(fdno, self._file_mode(mode))
             os.close(fdno)
         except OSError as e:
             raise _fop_errno(e)
@@ -848,7 +953,9 @@ class PosixLayer(Layer):
 
     async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
                      xdata: dict | None = None):
+        self._check_reserve()
         path = self._loc_path(loc)
+        mode = self._file_mode(mode)
         try:
             # brick fds are always RDWR regardless of the client's access
             # mode (blindly OR-ing O_RDWR onto O_WRONLY yields the
@@ -860,6 +967,8 @@ class PosixLayer(Layer):
             fdno = os.open(self._abs(path),
                            (flags & ~(os.O_ACCMODE | os.O_APPEND))
                            | os.O_CREAT | os.O_RDWR, mode)
+            if self._mode_policy_active:
+                os.fchmod(fdno, mode)
         except OSError as e:
             raise _fop_errno(e)
         gfid = (xdata or {}).get("gfid-req") or gfid_new()
@@ -896,6 +1005,14 @@ class PosixLayer(Layer):
 
     async def link(self, oldloc: Loc, newloc: Loc, xdata: dict | None = None):
         oldp, newp = self._loc_path(oldloc), self._loc_path(newloc)
+        maxl = self.opts["max-hardlinks"]
+        if maxl:
+            try:
+                if os.stat(self._abs(oldp)).st_nlink >= maxl:
+                    raise FopError(errno.EMLINK,
+                                   f"storage.max-hardlinks ({maxl})")
+            except OSError as e:
+                raise _fop_errno(e)
         try:
             os.link(self._abs(oldp), self._abs(newp))
         except OSError as e:
@@ -1006,12 +1123,18 @@ class PosixLayer(Layer):
                     xdata: dict | None = None):
         fdno = self._os_fd(fd)  # resolve on the loop (may open-on-demand)
         try:
-            return await self._io(os.pread, fdno, size, offset)
+            out = await self._io(os.pread, fdno, size, offset)
+            at = (xdata or {}).get("frame-time-atime")
+            if at is not None:  # ctime.noatime off: stamp client atime
+                st = await self._io(os.fstat, fdno)
+                await self._io(os.utime, fdno, (at, st.st_mtime))
+            return out
         except OSError as e:
             raise _fop_errno(e)
 
     async def writev(self, fd: FdObj, data: bytes, offset: int,
                      xdata: dict | None = None):
+        self._check_reserve()
         pre = (xdata or {}).get("pre-xattrop")
         if pre:
             # fallback for graphs with no features/index above (which
@@ -1330,7 +1453,8 @@ class PosixLayer(Layer):
         from ..ops.checksum import rchecksum as _rck
 
         data = await self.readv(fd, length, offset)
-        return {**_rck(data), "len": len(data)}
+        return {**_rck(data, fips=self.opts["fips-mode-rchecksum"]),
+                "len": len(data)}
 
     async def ipc(self, op: int = 0, xdata: dict | None = None):
         return {}

@@ -107,6 +107,8 @@ def test_crash_between_write_and_postop_heals(vol):
     c.write_file("/cr", data)
     newstripe = _rand(STRIPE, seed=4).tobytes()
     f = c.open("/cr")
+    f.fsync()  # durability point: commit the baseline post-op (close
+    # alone defers it, reference post-op-delay semantics)
     f.write(newstripe, 0)
 
     async def crash():

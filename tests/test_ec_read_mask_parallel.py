@@ -24,6 +24,12 @@ def _rand(n, seed=0):
         0, 256, n, dtype=np.uint8).tobytes()
 
 
+def _settle(c, path):
+    f = c.open(path)
+    f.fsync()
+    f.close()
+
+
 def _mount(tmp_path, options=None):
     g = Graph.construct(ec_volfile(tmp_path, N, R, options=options or {}))
     c = SyncClient(g)
@@ -61,6 +67,8 @@ def test_read_mask_honored_in_degraded_read(tmp_path):
     try:
         data = _rand(4 * STRIPE, seed=1)
         c.write_file("/g", data)
+        _settle(c, "/g")  # close the write window (its cached
+        # candidate set predates the degrade below)
         ec.up[1] = False  # degrade inside the mask
         before = _readv_counts(ec)
         assert c.read_file("/g") == data
@@ -78,6 +86,7 @@ def test_read_mask_is_strict_like_reference(tmp_path):
     try:
         data = _rand(2 * STRIPE, seed=2)
         c.write_file("/h", data)
+        _settle(c, "/h")
         ec.up[3] = False  # only 3 masked candidates remain, K=4
         with pytest.raises(FopError):
             c.read_file("/h")

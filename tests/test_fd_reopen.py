@@ -73,6 +73,10 @@ def test_fd_write_through_bounced_brick_managed(tmp_path):
                 # write through the SAME fd: must hit all six bricks
                 patch = os.urandom(stripe)
                 await f.write(patch, stripe)
+                await f.fsync()  # commit the delayed post-op NOW:
+                # heal info right after a bare close would transiently
+                # show the open window's dirty (reference post-op-delay
+                # shows the same "possibly healing" entries)
                 await f.close()
                 async with MgmtClient(d.host, d.port) as c:
                     info = await c.call("volume-heal", name="rv",

@@ -50,9 +50,19 @@ def vol(tmp_path):
     c.close()
 
 
+def _settle(c, path):
+    """Force the deferred post-op commit (close re-arms the release
+    timer instead of flushing — reference post-op-delay semantics);
+    fsync is the explicit durability point."""
+    f = c.open(path)
+    f.fsync()
+    f.close()
+
+
 def test_clean_write_leaves_no_index(vol):
     c, ec, base = vol
     c.write_file("/clean", _rand(2 * STRIPE).tobytes())
+    _settle(c, "/clean")  # commit the deferred post-op
     for i in range(N):
         assert _index_entries(base, i) == []
 
@@ -151,6 +161,7 @@ def test_quorum_lost_write_reconverges_not_just_unmarks(vol):
     # 3 of 6 bricks die -> quorum (K=4) lost -> write fails after data
     # landed on the 3 survivors, dirty left behind, versions untouched
     f = c.open("/q")
+    f.fsync()  # commit the baseline post-op before losing quorum
     for i in (3, 4, 5):
         ec.set_child_up(i, False)
     with pytest.raises(FopError):

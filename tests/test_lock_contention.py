@@ -1,0 +1,61 @@
+"""Inodelk contention upcalls (locks common.c:1374-1455
+inodelk_contention_notify -> ec-common.c:2576 ec_lock_release): a
+blocked locker nudges the eager-lock holder, which commits its delayed
+post-op and releases instead of sitting out the hold timer.  Also the
+snapshot quiesce path (contend_held_locks) built on the same signal."""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient, mount_volume
+
+N, R = 6, 2
+
+
+@pytest.mark.slow
+def test_contention_upcall_releases_eager_window(tmp_path):
+    data = np.random.default_rng(0).integers(
+        0, 256, 1 << 18, dtype=np.uint8).tobytes()
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="cv", vtype="disperse",
+                             bricks=[{"path": str(tmp_path / f"b{i}")}
+                                     for i in range(N)],
+                             redundancy=R)
+                await c.call("volume-start", name="cv")
+                # a long hold: without contention upcalls the second
+                # client would wait out (almost) this entire timer
+                await c.call("volume-set", name="cv",
+                             key="disperse.eager-lock-timeout",
+                             value="20")
+            a = await mount_volume(d.host, d.port, "cv")
+            b = await mount_volume(d.host, d.port, "cv")
+            try:
+                fa = await a.create("/shared")
+                await fa.write(data, 0)
+                # A's window is live: post-op deferred, inodelk held.
+                # B's write must trigger contention -> A commits and
+                # releases -> B proceeds in round-trip time, not 20s.
+                t0 = time.perf_counter()
+                fb = await b.open("/shared", os.O_RDWR)
+                await asyncio.wait_for(fb.write(b"takeover", 0), 15)
+                elapsed = time.perf_counter() - t0
+                await fb.close()
+                await fa.close()
+                assert elapsed < 10, \
+                    f"blocked {elapsed:.1f}s: contention upcall dead"
+            finally:
+                await a.unmount()
+                await b.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())

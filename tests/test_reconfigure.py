@@ -161,6 +161,14 @@ def test_option_map_integrity():
     # pseudo-targets consumed by daemons, not graph layers
     pseudo = {"__ssl__", "mgmt/glusterd", "mgmt/shd", "mgmt/gsyncd",
               "mgmt/bitd"}
+    # both-end transport keys must exist on BOTH protocol layers
+    for key, (ltype, opt) in volgen.OPTION_MAP.items():
+        if ltype == "__transport__":
+            for t in ("protocol/client", "protocol/server"):
+                cls = _REGISTRY[t]
+                assert any(o.name == opt for o in cls.OPTIONS), \
+                    f"{key}: {t} lacks option {opt!r}"
+    pseudo.add("__transport__")
     missing = []
     for key, (ltype, opt) in volgen.OPTION_MAP.items():
         if ltype in pseudo:
@@ -169,8 +177,8 @@ def test_option_map_integrity():
         if cls is None:
             missing.append(f"{key} -> unknown layer {ltype}")
             continue
-        if opt == "__enable__":
-            continue  # presence key: inserts the layer
+        if opt in ("__enable__", "__passthrough__"):
+            continue  # presence keys: insert/omit the layer
         if not any(o.name == opt for o in getattr(cls, "OPTIONS", ())):
             missing.append(f"{key} -> {ltype} has no option {opt!r}")
     assert not missing, missing
@@ -178,7 +186,7 @@ def test_option_map_integrity():
     for k in volgen.OPTION_MIN_OPVERSION:
         assert k in volgen.OPTION_MAP, f"gated ghost key {k!r}"
     # breadth floor: the operable long tail must not silently shrink
-    assert len(volgen.OPTION_MAP) >= 120, len(volgen.OPTION_MAP)
+    assert len(volgen.OPTION_MAP) >= 220, len(volgen.OPTION_MAP)
     # the operator-facing table is generated output, not prose: pin it
     import os
     doc = os.path.join(os.path.dirname(__file__), "..", "docs",

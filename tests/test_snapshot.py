@@ -31,7 +31,11 @@ def test_barrier_holds_and_releases(tmp_path):
     async def run():
         await g.activate()
         top = g.top
-        fd, _ = await top.create(Loc("/f"), 0, 0o644)
+        import os as _os
+
+        # O_SYNC: plain writes pass a barrier (reference barrier.c fops
+        # table); durability-acknowledged ones hold
+        fd, _ = await top.create(Loc("/f"), _os.O_SYNC, 0o644)
         bar = g.by_name["barrier"]
         bar.reconfigure({"barrier": "on", "barrier-timeout": "30"})
 
@@ -68,7 +72,10 @@ def test_barrier_armed_from_volfile(tmp_path):
         done = asyncio.Event()
 
         async def writer():
+            # unlink-class fops are the barriered set (barrier.c);
+            # create flows through an armed barrier
             await top.create(Loc("/f"), 0, 0o644)
+            await top.unlink(Loc("/f"))
             done.set()
 
         t = asyncio.get_running_loop().create_task(writer())
@@ -120,7 +127,9 @@ def test_barrier_timeout_auto_releases(tmp_path):
     async def run():
         await g.activate()
         top = g.top
-        fd, _ = await top.create(Loc("/t"), 0, 0o644)
+        import os as _os
+
+        fd, _ = await top.create(Loc("/t"), _os.O_SYNC, 0o644)
         bar = g.by_name["barrier"]
         bar.reconfigure({"barrier": "on", "barrier-timeout": "0.3"})
         # nobody releases: the timeout must (a wedged snapshot flow
