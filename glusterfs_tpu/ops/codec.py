@@ -46,24 +46,78 @@ BACKENDS = ("ref", "native", "xla", "xla-xor", "pallas-xor", "pallas-mxu",
 MESH_RING_DECODE_BYTES = 64 << 20
 
 
-@functools.cache
+# probe cache: (expires_monotonic|None, present, wedged).  A CLEAN
+# answer caches forever; a TIMEOUT caches for _PROBE_RETRY_S so a
+# transient slow init (staggered multi-host pod join) can recover
+# instead of demoting the whole process lifetime to the CPU ladder.
+_probe_state: list = []
+_PROBE_RETRY_S = 300.0
+
+
+def probe_wedged() -> bool:
+    """True while the LAST device probe timed out (transport wedged):
+    jax-touching backends must not be entered — backend init holds a
+    global lock the abandoned probe thread may be stuck under."""
+    return bool(_probe_state) and _probe_state[0][2]
+
+
 def _tpu_present() -> bool:
+    """Device probe with a DEADLINE: a wedged accelerator transport
+    (the pool tunnel hanging inside backend init) must degrade the
+    codec to the CPU ladder, not wedge every volume mount that builds
+    a codec.  The probe thread is daemonic — if the runtime never
+    answers, it is abandoned."""
+    import os
+    import threading
+    import time as _time
+
+    if _probe_state:
+        expires, present, _w = _probe_state[0]
+        if expires is None or _time.monotonic() < expires:
+            return present
+    box: list = []
+
+    def probe() -> None:
+        try:
+            import jax
+
+            box.append(any(d.platform in ("tpu", "axon")
+                           for d in jax.devices()))
+        except Exception:
+            box.append(False)
+
+    # a plain DAEMON thread: executor pools are non-daemonic and the
+    # interpreter joins them at exit — an abandoned wedged probe would
+    # turn every process exit into a hang
+    t = threading.Thread(target=probe, daemon=True,
+                         name="gftpu-tpu-probe")
+    t.start()
     try:
-        import jax
+        timeout = float(os.environ.get("GFTPU_TPU_PROBE_TIMEOUT", "45"))
+    except ValueError:
+        timeout = 45.0
+    t.join(max(1.0, timeout))
+    if t.is_alive():
+        import warnings
 
-        return any(d.platform in ("tpu", "axon") for d in jax.devices())
-    except Exception:
+        warnings.warn("TPU probe timed out (wedged device transport?); "
+                      "using the CPU codec ladder")
+        _probe_state[:] = [(_time.monotonic() + _PROBE_RETRY_S, False,
+                            True)]
         return False
+    _probe_state[:] = [(None, bool(box and box[0]), False)]
+    return _probe_state[0][1]
 
 
-@functools.cache
 def detect(requested: str = "auto") -> str:
     """Resolve a requested backend name to an available one.
 
     Mirrors ec_code_detect's fall-forward: an unavailable explicit request
     raises (the reference logs + falls back; we prefer loud), ``auto``
     walks the ladder mesh (multi-chip) -> pallas-xor (one chip) ->
-    native -> xla.
+    native -> xla.  Uncached on purpose: the probe result can change
+    (a transient timeout re-probes after _PROBE_RETRY_S) and the probe
+    itself memoizes the expensive part.
     """
     if requested != "auto":
         if requested not in BACKENDS:
@@ -85,7 +139,14 @@ def detect(requested: str = "auto") -> str:
         return "mesh" if len(accels) > 1 else "pallas-xor"
     from glusterfs_tpu import native
 
-    return "native" if native.available() else "xla"
+    if native.available():
+        return "native"
+    if probe_wedged():
+        # the xla path would import jax and block on the SAME wedged
+        # backend-init lock the abandoned probe thread sits under —
+        # the bit-sliced numpy oracle is slow but cannot hang
+        return "ref"
+    return "xla"
 
 
 @functools.cache
