@@ -256,7 +256,7 @@ def randrw_bench(n_clients: int = 64, backend: str = "auto") -> dict:
 
 
 def smallfile_bench(n_files: int = 200, backend: str = "native",
-                    passes: int = 2) -> dict:
+                    passes: int = 3) -> dict:
     """glfs-bm analog (extras/benchmarking): small-file metadata rate —
     create+write+close, stat, read, unlink over many 4 KiB files on a
     4+2 volume; reports ops/s per phase.  Best of ``passes`` runs: the
