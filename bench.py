@@ -364,29 +364,57 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1) -> dict:
             # kernel mount over the same served volume
             mnt = os.path.join(base, "mnt")
             os.makedirs(mnt)
-            ready = os.path.join(base, "ready")
             env = dict(os.environ)
             env.pop("PALLAS_AXON_POOL_IPS", None)
             env["JAX_PLATFORMS"] = "cpu"
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "glusterfs_tpu.mount.fuse_bridge",
-                 "--server", f"127.0.0.1:{d.port}", "--volume", "bw",
-                 "--readyfile", ready, mnt],
-                env=env, stderr=subprocess.DEVNULL)
-            try:
-                # 180s deadline: the bridge pays python + package imports
-                # + a full client graph build on a single shared core
-                # that is also running glusterd and six bricks — 60s
-                # proved flaky under driver load (r5 dev run)
+
+            async def spawn_bridge(attempt: int):
+                """One bridge attempt: spawn, wait for the ready file
+                (180s: the bridge pays python + package imports + a full
+                client graph build on a single shared core that is also
+                running glusterd and six bricks — 60s proved flaky under
+                driver load, r5 dev run).  Returns (proc, ok)."""
+                ready = os.path.join(base, f"ready{attempt}")
+                p = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "glusterfs_tpu.mount.fuse_bridge",
+                     "--server", f"127.0.0.1:{d.port}", "--volume", "bw",
+                     "--readyfile", ready, mnt],
+                    env=env, stderr=subprocess.DEVNULL)
                 for _ in range(1800):
-                    if os.path.exists(ready) or proc.poll() is not None:
+                    if os.path.exists(ready) or p.poll() is not None:
                         break
                     await asyncio.sleep(0.1)
-                if not os.path.exists(ready):
-                    # a dead mount must not discard the wire rows
-                    # already measured above on this (expensive) run
-                    out["fuse_bench_error"] = \
-                        f"fuse mount not ready (bridge rc={proc.poll()})"
+                return p, os.path.exists(ready)
+
+            # "fuse mount not ready" gets a BOUNDED retry (a loaded host
+            # can miss one 180s window; r4/r5 lost every wire/fuse row
+            # to a single miss) — then gives up loudly, keeping the wire
+            # rows already measured above on this (expensive) run
+            proc = mounted = None
+            last_rc = None
+            for attempt in range(2):
+                out["fuse_mount_attempts"] = attempt + 1
+                proc, mounted = await spawn_bridge(attempt)
+                if mounted:
+                    break
+                last_rc = proc.poll()
+                if last_rc is None:
+                    proc.kill()
+                proc.wait()
+                # the dead bridge may have completed mount(2) before
+                # failing (readyfile is written after) — a stale FUSE
+                # mount would make the retry's own mount(2) fail with
+                # ENOTCONN, so clear it before respawning
+                subprocess.run(["umount", "-l", mnt],
+                               capture_output=True, timeout=30)
+            if not mounted:
+                out["fuse_bench_error"] = (
+                    f"fuse mount not ready after "
+                    f"{out['fuse_mount_attempts']} attempts "
+                    f"(bridge rc={last_rc})")
+            try:
+                if not mounted:
                     return
                 # kernel-mount I/O is blocking: a wedged FUSE request
                 # would hang the whole bench run forever.  Run each
@@ -460,7 +488,132 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1) -> dict:
     return out
 
 
+#: Geometries on the sweep record (BASELINE.md 8+3 / 8+4 / 16+4 plus the
+#: 4+2 headline config, so decode-vs-encode is comparable per geometry).
+SWEEP_GEOMETRIES = ((4, 2), (8, 3), (8, 4), (16, 4))
+
+
+def _native_sweep_row(sk: int, sr: int, sdata: np.ndarray) -> dict:
+    """Jax-free native-ladder rows for one geometry: encode, decode via
+    the CSE'd per-mask compiled program (gf_decode_prog), and decode via
+    the old row-select walk — the program-vs-rowselect pair is what makes
+    the decode catch-up driver-visible on hosts with no usable device."""
+    from glusterfs_tpu import native
+    from glusterfs_tpu.ops import gf256
+
+    sn = sk + sr
+    abits = gf256.expand_bitmatrix(gf256.encode_matrix(sk, sn))
+    et = time_it(lambda: native.encode(sdata, sk, sn, abits), 1, 3)
+    sfr = native.encode(sdata, sk, sn, abits)
+    srows = tuple(range(sr, sn))  # first R fragments lost
+    surv = np.ascontiguousarray(sfr[list(srows)])
+    prog = gf256.decode_program(sk, srows)
+    out = native.decode_program(surv, sk, prog)
+    assert np.array_equal(out, sdata), f"{sk}+{sr} native program parity"
+    dt = time_it(lambda: native.decode_program(surv, sk, prog), 1, 3)
+    bbits = gf256.decode_bits_cached(sk, srows)
+    rt = time_it(lambda: native.decode(surv, sk, bbits), 1, 3)
+    mib = sdata.size / MIB
+    return {
+        "native_encode_MiB_s": round(mib / et, 1),
+        "native_decode_MiB_s": round(mib / dt, 1),
+        "native_decode_rowselect_MiB_s": round(mib / rt, 1),
+        # program CSE quality: word-XORs per stripe, program vs the
+        # naive per-row chains the bit-matrix implies
+        "decode_prog_xors": prog.xor_count,
+        "decode_naive_xors": int(bbits.sum()) - bbits.shape[0],
+    }
+
+
+def _wedged_main() -> None:
+    """The TPU probe timed out: the transport is wedged, and ANY jax call
+    from this thread would block on the same backend-init lock the
+    abandoned probe thread is stuck under.  Emit the headline (and the
+    geometry sweep) from the jax-free native/ref ladder so the driver
+    still captures a parseable record with "backend" telling the truth
+    (VERDICT r5 "Next round" #1)."""
+    from glusterfs_tpu import native
+    from glusterfs_tpu.ops import gf256
+
+    rng = np.random.default_rng(0)
+    rows = [1, 3, 4, 5]
+    base = {"avx_model_encode_MiB_s": model_avx_bytes_per_s(N, K) / MIB,
+            "avx_model_decode_MiB_s": model_avx_bytes_per_s(K, K) / MIB}
+    have_native = native.available()
+    backend = "native" if have_native else "ref"
+    nbytes = (8 if have_native else 2) * MIB
+    data = rng.integers(0, 256, nbytes, dtype=np.uint8)
+    abits = gf256.expand_bitmatrix(gf256.encode_matrix(K, N))
+    if have_native:
+        et = time_it(lambda: native.encode(data, K, N, abits), 1, 3)
+        frags = native.encode(data, K, N, abits)
+        surv = np.ascontiguousarray(frags[rows])
+        prog = gf256.decode_program(K, tuple(rows))
+        out = native.decode_program(surv, K, prog)
+        assert np.array_equal(out, data), "wedged native decode parity"
+        dt = time_it(lambda: native.decode_program(surv, K, prog), 1, 3)
+        base["native_encode_MiB_s"] = nbytes / MIB / et
+        base["native_decode_MiB_s"] = nbytes / MIB / dt
+    else:
+        et = time_it(lambda: gf256.ref_encode(data, K, N), 1, 2)
+        frags = gf256.ref_encode(data, K, N)
+        out = gf256.ref_decode(frags[rows], rows, K)
+        assert np.array_equal(out, data), "wedged ref decode parity"
+        dt = time_it(lambda: gf256.ref_decode(frags[rows], rows, K), 1, 2)
+    enc_mibs = nbytes / MIB / et
+    dec_mibs = nbytes / MIB / dt
+    # the headline here IS the CPU-ladder measurement, so the baseline
+    # must not include it (that would cap vs_baseline at 1.0 by
+    # construction): compare against the analytical AVX model only
+    enc_base = base["avx_model_encode_MiB_s"]
+    dec_base = base["avx_model_decode_MiB_s"]
+    sweep: dict = {"sweep_note": "tpu probe timed out; native ladder only"}
+    if have_native:
+        try:
+            sdata = rng.integers(0, 256, 8 * MIB, dtype=np.uint8)
+            for sk, sr in SWEEP_GEOMETRIES:
+                row = _native_sweep_row(sk, sr, sdata)
+                row["encode_MiB_s"] = row["native_encode_MiB_s"]
+                row["decode_MiB_s"] = row["native_decode_MiB_s"]
+                sweep[f"{sk}+{sr}"] = row
+        except Exception as e:  # auxiliary
+            sweep["sweep_error"] = str(e)[:200]
+    result = {
+        "metric": "ec_encode_4p2_1MiB_stripes",
+        "value": round(enc_mibs, 1),
+        "unit": "MiB/s",
+        "vs_baseline": round(enc_mibs / enc_base, 2),
+        "decode_MiB_s": round(dec_mibs, 1),
+        "decode_vs_baseline": round(dec_mibs / dec_base, 2),
+        "backend": backend,
+        "device": "none (tpu probe timed out; transport wedged)",
+        "baseline_encode_MiB_s": round(enc_base, 1),
+        "baseline_decode_MiB_s": round(dec_base, 1),
+        **{k: round(v, 1) for k, v in base.items()},
+        "sweep": sweep,
+        # the volume/fullstack benches are not run in wedged mode (they
+        # would import jax via the codec router); the rows must still be
+        # explicit skips, never silence
+        **{row: "skipped: tpu transport wedged (kernel ladder only)"
+           for row in ("wire_write_MiB_s", "wire_read_MiB_s",
+                       "fuse_write_MiB_s", "fuse_read_MiB_s")},
+    }
+    result["regressions"] = _regression_gate(result)
+    print(emit(result))
+
+
 def main() -> None:
+    from glusterfs_tpu.ops import codec as _codec
+
+    # the TPU decision goes through the codec's DEADLINE probe
+    # (codec.py:57-110), never a bare jax.devices(): a wedged pool
+    # transport hangs backend init forever and r4/r5 both lost their
+    # records to exactly that (VERDICT r5 "Next round" #1)
+    on_tpu = _codec._tpu_present()
+    if _codec.probe_wedged():
+        _wedged_main()
+        return
+
     import jax
     import jax.numpy as jnp
 
@@ -471,7 +624,6 @@ def main() -> None:
     data = rng.integers(0, 256, DATA_BYTES, dtype=np.uint8)
     rows = [1, 3, 4, 5]  # degraded: fragments 0 and 2 lost
 
-    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
     backend = "pallas-xor" if on_tpu else "xla"
 
     # The device/tunnel is POOL-SHARED: measured kernel rates swing ~2x
@@ -484,8 +636,19 @@ def main() -> None:
     # told apart from an unlucky window (VERDICT r3 weak #1).
     pass_log: dict[str, tuple[list[float], int]] = {}
 
-    def best_of(measure, passes: int = 3, settle_s: float = 3.0,
+    # the pool-shared-tunnel variance treatment (many spaced passes,
+    # long dependent chains) is for the DEVICE path; on the CPU ladder
+    # dispatch overhead is ~ms and the host is not pool-shared, so the
+    # same treatment just multiplies wall-clock ~30x (the r6 dev run
+    # timed out at 50 min before reaching the volume rows)
+    hl_passes = 6 if on_tpu else 2
+    hl_iters = 51 if on_tpu else 7
+    settle_default = 3.0 if on_tpu else 0.5
+
+    def best_of(measure, passes: int = 3, settle_s: float | None = None,
                 tag: str | None = None, nbytes: int = DATA_BYTES) -> float:
+        if settle_s is None:
+            settle_s = settle_default
         times = [measure()]
         for _ in range(passes - 1):
             time.sleep(settle_s)
@@ -503,8 +666,8 @@ def main() -> None:
     frags_dev = jax.block_until_ready(enc_fn(ddata))
     # 6 spaced passes (r4's 4 let an unlucky window record a 7.7x min;
     # VERDICT r4 weak #7) — the spread lands in headline_pass_MiB_s
-    enc_t = best_of(lambda: device_loop_seconds(enc_fn, ddata), 6,
-                    tag="encode")
+    enc_t = best_of(lambda: device_loop_seconds(enc_fn, ddata, hl_iters),
+                    hl_passes, tag="encode")
     enc_mibs = DATA_BYTES / MIB / enc_t
 
     frags_np = np.asarray(frags_dev)
@@ -521,8 +684,8 @@ def main() -> None:
         dec_fn = lambda s: raw(s, bbits_d)
     out_np = np.asarray(dec_fn(surv))
     assert np.array_equal(out_np, data), "decode parity failure"
-    dec_t = best_of(lambda: device_loop_seconds(dec_fn, surv), 6,
-                    tag="decode")
+    dec_t = best_of(lambda: device_loop_seconds(dec_fn, surv, hl_iters),
+                    hl_passes, tag="decode")
     dec_mibs = DATA_BYTES / MIB / dec_t
 
     # --- AVX baseline ----------------------------------------------------
@@ -548,7 +711,7 @@ def main() -> None:
     try:
         sweep_bytes = 16 * MIB
         sdata = rng.integers(0, 256, sweep_bytes, dtype=np.uint8)
-        for sk, sr in ((8, 3), (8, 4), (16, 4)):
+        for sk, sr in SWEEP_GEOMETRIES:
             sn = sk + sr
             if on_tpu:
                 # the PRODUCTION path at every geometry: transposed
@@ -562,7 +725,7 @@ def main() -> None:
                 f"{sk}+{sr} encode parity"
             # best-of like the headline: a cold/contended tunnel
             # window must not record a bogus low for a config
-            et = best_of(lambda: device_loop_seconds(efn, sd), 2, 2.0)
+            et = best_of(lambda: device_loop_seconds(efn, sd, hl_iters), 2)
             srows = tuple(range(sr, sn))  # first R fragments lost
             if on_tpu:
                 dfn = gf256_pallas._fused_decode_fn(sk, srows, False)
@@ -573,15 +736,26 @@ def main() -> None:
             sv = jnp.asarray(sfr[list(srows)])
             assert np.array_equal(np.asarray(dfn(sv)), sdata), \
                 f"{sk}+{sr} decode parity"
-            dt = best_of(lambda: device_loop_seconds(dfn, sv), 2, 2.0)
-            sweep[f"{sk}+{sr}"] = {
+            dt = best_of(lambda: device_loop_seconds(dfn, sv, hl_iters), 2)
+            row = {
                 "encode_MiB_s": round(sweep_bytes / MIB / et, 1),
                 "decode_MiB_s": round(sweep_bytes / MIB / dt, 1),
                 "encode_vs_avx_model": round(
                     sweep_bytes / MIB / et /
                     (model_avx_bytes_per_s(sn, sk) / MIB), 2),
                 "encode_form": "xor-cse" if on_tpu else "matmul",
+                # decode rides the per-mask compiled-program LRU on TPU
+                # (gf256.DECODE_PROGRAMS -> fused kernel); the matmul
+                # form takes the bit-matrix as a traced operand
+                "decode_form": "xor-cse" if on_tpu else "matmul",
             }
+            if native.available():
+                # the jax-free ladder on the same geometry: program
+                # decode vs the old row-select walk, so the decode
+                # catch-up is visible even when the device record is
+                # a contended-tunnel number
+                row.update(_native_sweep_row(sk, sr, sdata[:8 * MIB]))
+            sweep[f"{sk}+{sr}"] = row
         if on_tpu:
             # pallas-mxu validated ON SILICON at the headline config:
             # byte-exact encode+decode parity plus its measured rate
@@ -625,8 +799,9 @@ def main() -> None:
         import zlib as _zlib
 
         assert out[0] == _zlib.adler32(blocks_np[0].tobytes())
-        ct = best_of(lambda: device_loop_seconds(ckm.adler32_batch_jax, jb),
-                     3, 2.0, tag="rchecksum", nbytes=32 * MIB)
+        ct = best_of(lambda: device_loop_seconds(ckm.adler32_batch_jax, jb,
+                                                 hl_iters),
+                     3, tag="rchecksum", nbytes=32 * MIB)
         zt = time_it(lambda: [_zlib.adler32(b.tobytes())
                               for b in blocks_np[:64]], 1, 3)
         sweep["rchecksum_MiB_s"] = round(32 * MIB / MIB / ct, 1)
@@ -701,6 +876,15 @@ def main() -> None:
         vol.update(fullstack_bench())
     except Exception as e:
         vol["fullstack_bench_error"] = str(e)[:200]
+    # a missing wire/fuse row is an EXPLICIT "skipped: <reason>" entry,
+    # never silence (r5's detail lost all four rows without a trace)
+    for row in ("wire_write_MiB_s", "wire_read_MiB_s",
+                "fuse_write_MiB_s", "fuse_read_MiB_s"):
+        if row not in vol:
+            reason = vol.get("fuse_bench_error" if row.startswith("fuse")
+                             else "fullstack_bench_error") \
+                or vol.get("fullstack_bench_error") or "not measured"
+            vol[row] = f"skipped: {reason}"[:200]
 
     result = {
         "metric": "ec_encode_4p2_1MiB_stripes",
@@ -802,6 +986,13 @@ def _regression_gate(result: dict) -> list[dict]:
     prev = _prev_bench()
     if not prev:
         return []
+    if prev.get("backend") != result.get("backend"):
+        # different measurement era (e.g. a committed CPU-ladder record
+        # vs a TPU run): the rows are not comparable quantities, and
+        # numeric comparison would either flag everything or silently
+        # re-baseline the gate — record the era change itself instead
+        return [{"row": "backend-changed", "prev": prev.get("backend"),
+                 "now": result.get("backend")}]
     flags: list[dict] = []
 
     def check(name: str, new, old) -> None:

@@ -10,6 +10,7 @@ is: does the toolchain exist and does the library build.
 from __future__ import annotations
 
 import ctypes
+import functools
 import hashlib
 import os
 import subprocess
@@ -67,6 +68,10 @@ def _lib() -> ctypes.CDLL:
         lib.gf_encode.argtypes = [
             u8p, u8p, u8p, ctypes.c_int, ctypes.c_int, ctypes.c_size_t]
         lib.gf_decode.argtypes = [u8p, u8p, u8p, ctypes.c_int, ctypes.c_size_t]
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.gf_decode_prog.argtypes = [
+            u8p, u8p, i32p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_size_t]
         lib.adler32_batch.argtypes = [
             u8p, ctypes.c_size_t, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_uint32)]
@@ -157,6 +162,46 @@ def decode(frags: np.ndarray, k: int, bbits: np.ndarray) -> np.ndarray:
     bbits = np.ascontiguousarray(bbits, dtype=np.uint8)
     out = np.empty(s * k * CHUNK, dtype=np.uint8)
     _lib().gf_decode(_u8p(frags), _u8p(out), _u8p(bbits), k, s)
+    return out
+
+
+@functools.lru_cache(maxsize=256)
+def _prog_schedule(prog):
+    """Register-allocated instruction stream of an XorProgram (hashable
+    NamedTuple) — one scheduling pass per cached program instead of one
+    per decode call."""
+    from glusterfs_tpu.ops import gf256
+
+    return gf256.schedule_program(prog)
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def decode_program(frags: np.ndarray, k: int, prog) -> np.ndarray:
+    """Fragment-major (k, S*512) + a gf256.XorProgram (the per-mask
+    compiled decode schedule) -> bytes (S*k*512).  The CSE'd schedule
+    cuts the word-XOR count ~2-3x vs :func:`decode`'s row-select walk;
+    the slot-reusing schedule keeps its working set cache-resident."""
+    frags = np.ascontiguousarray(frags, dtype=np.uint8)
+    if not 1 <= k <= MAX_K:
+        raise ValueError(f"k must be in [1, {MAX_K}]")
+    if frags.shape[0] != k or frags.shape[1] % CHUNK:
+        raise ValueError("need (k, S*512) fragments")
+    if prog.n_inputs != k * BITS or len(prog.outs) != k * BITS:
+        raise ValueError(
+            f"program shape {prog.n_inputs}->{len(prog.outs)} does not "
+            f"match a k={k} decode")
+    s = frags.shape[1] // CHUNK
+    code, n_slots = _prog_schedule(prog)
+    # 8-stripe blocks amortize per-instruction dispatch; with the
+    # transposed live-range schedule the slab stays small enough that 8
+    # wins (or ties within noise) at every geometry on a block scan
+    # (1/2/4/8/16 measured; 16+4: 443/499/651/696/678 MiB/s)
+    out = np.empty(s * k * CHUNK, dtype=np.uint8)
+    _lib().gf_decode_prog(_u8p(frags), _u8p(out), _i32p(code),
+                          len(code), n_slots, 8, k, s)
     return out
 
 

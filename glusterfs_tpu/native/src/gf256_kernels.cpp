@@ -30,6 +30,21 @@ inline void xor_word(uint8_t* __restrict dst, const uint8_t* __restrict src) {
   for (int b = 0; b < kWord; ++b) dst[b] ^= src[b];
 }
 
+// Variable-width helpers for the program walk.  The __restrict is what
+// lets the compiler emit straight YMM loads/xor/stores: the pointers all
+// point into one var slab, so without it every op pays an aliasing check
+// (program destinations are always fresh vars, so the promise holds by
+// construction).
+inline void xor2_w(uint8_t* __restrict dst, const uint8_t* __restrict a,
+                   const uint8_t* __restrict b, int w) {
+  for (int i = 0; i < w; ++i) dst[i] = a[i] ^ b[i];
+}
+
+inline void xor_accum_w(uint8_t* __restrict dst,
+                        const uint8_t* __restrict src, int w) {
+  for (int i = 0; i < w; ++i) dst[i] ^= src[i];
+}
+
 // Per output row, the list of selected input planes (built once per call).
 // cols = k*8 <= 128 (k <= 16 data fragments); rows = n*8 can exceed that
 // (n up to 255), so the row table is heap-allocated.
@@ -101,6 +116,102 @@ void gf_encode(const uint8_t* __restrict data, uint8_t* __restrict out,
       uint8_t* frag = out + (f * s + t) * (size_t)kChunk;
       for (int p = 0; p < kBits; ++p)
         apply_row(sels[f * kBits + p], x, frag + p * kWord);
+    }
+  }
+}
+
+// Decode via a CSE'd straight-line XOR program, register-allocated by
+// ops/gf256.py schedule_program (TRANSPOSED: output rows are fixed
+// accumulator slots, values scatter into them as computed, slots recycle
+// at last use).  frags is fragment-major (k, s*512), out stripe-major
+// bytes (s*k*512).  code is a flat int32 instruction stream over n_slots
+// reusable variable slots:
+//   [0, dst, a, b]      slot dst = slot a ^ slot b
+//   [1, row, nv, v...]  emit output plane row = XOR of nv slots (0 -> 0s)
+//   [2, slot, f, p]     load plane p of surviving fragment f into slot
+//   [3, src, n, s...]   slot s_i ^= slot src (scatter into accumulators)
+//   [4, src, n, s...]   slot s_i = slot src  (first touch of those accs)
+// Shared subexpressions are computed once per block instead of once per
+// output row (~2-3x fewer word-XORs than the row-select kernel below —
+// the same programs the TPU kernels unroll); the transposed schedule
+// keeps the slab at peak-LIVE size so it stays cache-resident.  An
+// unscheduled flat slab (one var per op, ~550 KiB at 16+4) measured
+// SLOWER than row-select (133 vs 277 MiB/s) from cache thrash alone;
+// see bench.py's native sweep rows (native_decode vs
+// native_decode_rowselect) for the live numbers per geometry.
+//
+// The walk is blocked over `block` stripes: each slot holds `block`
+// consecutive 64-byte words, so per-instruction dispatch (index loads,
+// pointer math, loop overhead) amortizes across the block; the slab
+// grows linearly with it, so the block stays caller-tunable (the python
+// binding passes 8 — best or within noise at every geometry on a
+// 1/2/4/8/16 scan once the schedule keeps the slab live-range-sized).
+constexpr int kProgBlockMax = 16;
+
+void gf_decode_prog(const uint8_t* __restrict frags, uint8_t* __restrict out,
+                    const int32_t* __restrict code, int n_code, int n_slots,
+                    int block, int k, size_t s) {
+  if (block < 1) block = 1;
+  if (block > kProgBlockMax) block = kProgBlockMax;
+  const int vw = block * kWord;  // bytes per slot per block
+  std::vector<uint8_t> slab((size_t)n_slots * vw);
+  uint8_t* t = slab.data();
+  uint8_t acc[kProgBlockMax * kWord];
+  for (size_t st = 0; st < s; st += block) {
+    const int nb = (s - st) < (size_t)block ? (int)(s - st) : block;
+    const int w = nb * kWord;
+    const int32_t* pc = code;
+    const int32_t* end = code + n_code;
+    while (pc < end) {
+      switch (pc[0]) {
+        case 0:
+          xor2_w(t + (size_t)pc[1] * vw, t + (size_t)pc[2] * vw,
+                 t + (size_t)pc[3] * vw, w);
+          pc += 4;
+          break;
+        case 1: {
+          const int row = pc[1], nv = pc[2];
+          if (nv == 0) {
+            std::memset(acc, 0, w);
+          } else {
+            std::memcpy(acc, t + (size_t)pc[3] * vw, w);
+            for (int i = 1; i < nv; ++i)
+              xor_accum_w(acc, t + (size_t)pc[3 + i] * vw, w);
+          }
+          // scatter plane `row` back to stripe-major output
+          for (int b = 0; b < nb; ++b)
+            std::memcpy(out + (st + b) * (size_t)k * kChunk + row * kWord,
+                        acc + b * kWord, kWord);
+          pc += 3 + nv;
+          break;
+        }
+        case 2: {  // gather one input plane, nb stripes
+          uint8_t* dst = t + (size_t)pc[1] * vw;
+          const int f = pc[2], p = pc[3];
+          for (int b = 0; b < nb; ++b)
+            std::memcpy(dst + b * kWord,
+                        frags + (f * s + st + b) * (size_t)kChunk + p * kWord,
+                        kWord);
+          pc += 4;
+          break;
+        }
+        case 3: {  // scatter: acc slots ^= src
+          const uint8_t* src = t + (size_t)pc[1] * vw;
+          const int n = pc[2];
+          for (int i = 0; i < n; ++i)
+            xor_accum_w(t + (size_t)pc[3 + i] * vw, src, w);
+          pc += 3 + n;
+          break;
+        }
+        default: {  // 4: first touch: acc slots = src
+          const uint8_t* src = t + (size_t)pc[1] * vw;
+          const int n = pc[2];
+          for (int i = 0; i < n; ++i)
+            std::memcpy(t + (size_t)pc[3 + i] * vw, src, w);
+          pc += 3 + n;
+          break;
+        }
+      }
     }
   }
 }

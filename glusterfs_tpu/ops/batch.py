@@ -9,8 +9,10 @@ coalesced into HBM-resident batches" — is a batching window:
   tick (plus ``window`` seconds) queue into a pending list;
 * one flush concatenates the queued stripe-aligned payloads and makes ONE
   kernel launch for the whole batch (encode; decodes group by surviving
-  mask — one launch per mask, same keying as the reference's LRU of
-  inverted matrices);
+  mask — one launch per mask, the same ``(k, rows)`` keying as the
+  per-mask compiled-program LRU every backend decodes through
+  (gf256.DECODE_PROGRAMS), so a flush group always lands on one cached
+  program/kernel);
 * flushes run OFF the event loop in a small thread pool, so batch N+1
   keeps filling (and can dispatch) while batch N is on the device — fop
   latency never serializes on a device round trip;

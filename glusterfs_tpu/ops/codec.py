@@ -24,9 +24,12 @@ backend        implementation
 =============  =================================================
 
 All backends are byte-exact against ``ref`` (the ``ec-cpu-extensions.t``
-oracle, reproduced by tests/test_codec.py).  Decode matrices are cached per
+oracle, reproduced by tests/test_codec.py).  Decode work is cached per
 surviving-fragment mask exactly like the reference's LRU of inverted
-matrices (ec-method.c:200-245).
+matrices (ec-method.c:200-245) — but one level further compiled: the
+shared LRU (gf256.DECODE_PROGRAMS) holds the CSE'd straight-line XOR
+*program* per mask, which the pallas/xla kernels unroll into their
+traces and the native ladder executes directly (gf_decode_prog).
 """
 
 from __future__ import annotations
@@ -61,43 +64,62 @@ def probe_wedged() -> bool:
     return bool(_probe_state) and _probe_state[0][2]
 
 
+def probe_with_deadline(fn, default, default_timeout_s: float = 45.0):
+    """Run ``fn()`` on an abandonable DAEMON thread with a deadline
+    (``GFTPU_TPU_PROBE_TIMEOUT`` overrides): returns ``(value,
+    timed_out)`` — ``(default, True)`` if fn never answers.
+
+    The wedge-safe probe primitive shared by every driver entry point
+    that must ask jax about devices: a wedged accelerator transport
+    hangs ``jax.devices()`` forever inside backend init, and an
+    unguarded in-process call there eats the caller's whole timeout.  A
+    plain daemon thread on purpose — executor pools are non-daemonic
+    and the interpreter joins them at exit, so an abandoned wedged
+    probe would turn every process exit into a hang."""
+    import os
+    import threading
+
+    box: list = []
+
+    def probe() -> None:
+        try:
+            box.append(fn())
+        except Exception:
+            box.append(default)
+
+    t = threading.Thread(target=probe, daemon=True,
+                         name="gftpu-deadline-probe")
+    t.start()
+    try:
+        timeout = float(os.environ.get("GFTPU_TPU_PROBE_TIMEOUT",
+                                       default_timeout_s))
+    except ValueError:
+        timeout = default_timeout_s
+    t.join(max(1.0, timeout))
+    if t.is_alive():
+        return default, True
+    return (box[0] if box else default), False
+
+
 def _tpu_present() -> bool:
     """Device probe with a DEADLINE: a wedged accelerator transport
     (the pool tunnel hanging inside backend init) must degrade the
     codec to the CPU ladder, not wedge every volume mount that builds
-    a codec.  The probe thread is daemonic — if the runtime never
-    answers, it is abandoned."""
-    import os
-    import threading
+    a codec."""
     import time as _time
 
     if _probe_state:
         expires, present, _w = _probe_state[0]
         if expires is None or _time.monotonic() < expires:
             return present
-    box: list = []
 
-    def probe() -> None:
-        try:
-            import jax
+    def probe() -> bool:
+        import jax
 
-            box.append(any(d.platform in ("tpu", "axon")
-                           for d in jax.devices()))
-        except Exception:
-            box.append(False)
+        return any(d.platform in ("tpu", "axon") for d in jax.devices())
 
-    # a plain DAEMON thread: executor pools are non-daemonic and the
-    # interpreter joins them at exit — an abandoned wedged probe would
-    # turn every process exit into a hang
-    t = threading.Thread(target=probe, daemon=True,
-                         name="gftpu-tpu-probe")
-    t.start()
-    try:
-        timeout = float(os.environ.get("GFTPU_TPU_PROBE_TIMEOUT", "45"))
-    except ValueError:
-        timeout = 45.0
-    t.join(max(1.0, timeout))
-    if t.is_alive():
+    present, timed_out = probe_with_deadline(probe, False)
+    if timed_out:
         import warnings
 
         warnings.warn("TPU probe timed out (wedged device transport?); "
@@ -105,7 +127,7 @@ def _tpu_present() -> bool:
         _probe_state[:] = [(_time.monotonic() + _PROBE_RETRY_S, False,
                             True)]
         return False
-    _probe_state[:] = [(None, bool(box and box[0]), False)]
+    _probe_state[:] = [(None, bool(present), False)]
     return _probe_state[0][1]
 
 
@@ -157,9 +179,6 @@ def _encode_bits_sys(k: int, n: int) -> np.ndarray:
 @functools.cache
 def _encode_bits(k: int, n: int) -> np.ndarray:
     return gf256.expand_bitmatrix(gf256.encode_matrix(k, n))
-
-
-_decode_bits = gf256.decode_bits_cached
 
 
 class Codec:
@@ -262,8 +281,8 @@ class Codec:
         if b == "native":
             from glusterfs_tpu import native
 
-            return native.decode(frags, self.k,
-                                 _decode_bits(self.k, tuple(rows)))
+            return native.decode_program(
+                frags, self.k, gf256.decode_program(self.k, tuple(rows)))
         if b in ("xla", "xla-xor"):
             from . import gf256_xla
 
@@ -337,8 +356,8 @@ class Codec:
         if b == "native":
             from glusterfs_tpu import native
 
-            return native.decode(
-                frags, k, gf256.decode_bits_cached(k, tuple(rows), True))
+            return native.decode_program(
+                frags, k, gf256.decode_program(k, tuple(rows), True))
         if b in ("xla", "xla-xor"):
             from . import gf256_xla
 

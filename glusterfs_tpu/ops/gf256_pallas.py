@@ -328,10 +328,9 @@ def _fused_encode_fn(k: int, n: int, interpret: bool):
     wide-k group split (one call per fragment group, each re-reading
     the input because the naive unroll blew the compiler's appetite)
     would forfeit most of the sharing."""
-    abits = gf256.expand_bitmatrix(gf256.encode_matrix(k, n))
-    ops, outs = gf256.xor_program(tuple(map(tuple, abits.tolist())))
+    prog = gf256.encode_program(k, n)
     ts = _enc_ts(k)
-    kernel = _program_encode_kernel(ops, outs, k, n)
+    kernel = _program_encode_kernel(prog.ops, prog.outs, k, n)
 
     @jax.jit
     def run(flat):
@@ -360,14 +359,14 @@ def _fused_encode_fn(k: int, n: int, interpret: bool):
 def _fused_decode_fn(k: int, rows: tuple[int, ...], interpret: bool):
     """jitted: survivors (k, S*512) fragment-major -> flat bytes (S*k*512,).
 
-    One jitted decoder per surviving mask (the LRU here mirrors the
-    reference's LRU of inverted matrices, ec-method.c:200-245); the
-    body runs the CSE'd XOR program in one pallas call (see
-    _fused_encode_fn)."""
-    bbits = gf256.decode_bits_cached(k, rows)
-    ops, outs = gf256.xor_program(tuple(map(tuple, bbits.tolist())))
+    One jitted decoder per surviving mask (this LRU of compiled kernels
+    sits on top of gf256.DECODE_PROGRAMS, the shared per-mask LRU of
+    compiled XOR programs — together the compiled-program analog of the
+    reference's inverted-matrix LRU, ec-method.c:200-245); the body runs
+    the CSE'd XOR program in one pallas call (see _fused_encode_fn)."""
+    prog = gf256.decode_program(k, rows)
     ts = _dec_ts(k)
-    kernel = _program_decode_kernel(ops, outs, k)
+    kernel = _program_decode_kernel(prog.ops, prog.outs, k)
 
     @jax.jit
     def run(frags):
@@ -430,11 +429,10 @@ def _fused_parity_fn(k: int, n: int, interpret: bool):
     """jitted: flat stripe-major bytes (S*k*512,) -> parity fragments
     ONLY ((n-k), S*512) of the systematic code — D2H is r/k of the data
     instead of n/k."""
-    abits = gf256.parity_bits_cached(k, n)
-    ops, outs = gf256.xor_program(tuple(map(tuple, abits.tolist())))
+    prog = gf256.parity_program(k, n)
     ts = _enc_ts(k)
     r = n - k
-    kernel = _program_encode_kernel(ops, outs, k, r)
+    kernel = _program_encode_kernel(prog.ops, prog.outs, k, r)
 
     @jax.jit
     def run(flat):
@@ -464,11 +462,10 @@ def _fused_reconstruct_fn(k: int, rows: tuple[int, ...],
     """jitted: systematic survivors (k, S*512) fragment-major ->
     ONLY the ``wanted`` missing data rows (len(wanted), S*512) — D2H is
     missing/k of the data instead of all of it."""
-    bbits = gf256.reconstruct_bits_cached(k, rows, wanted)
-    ops, outs = gf256.xor_program(tuple(map(tuple, bbits.tolist())))
+    prog = gf256.reconstruct_program(k, rows, wanted)
     ts = _dec_ts(k)
     m = len(wanted)
-    kernel = _program_reconstruct_kernel(ops, outs, k, m)
+    kernel = _program_reconstruct_kernel(prog.ops, prog.outs, k, m)
 
     @jax.jit
     def run(frags):
@@ -571,9 +568,13 @@ def _encode_fn(k: int, n: int, formulation: str, interpret: bool):
     return run
 
 
-@functools.lru_cache(maxsize=64)
+@functools.lru_cache(maxsize=256)
 def _decode_fn(k: int, formulation: str, interpret: bool,
-               static_bbits: tuple | None):
+               rows: tuple[int, ...] | None):
+    """Transpose-sandwich decode; static (xor/xor3) forms are cached per
+    surviving mask ``rows`` — matching the per-mask program LRU keying —
+    instead of per bit-matrix tuple (mxu passes rows=None: its bbits is
+    a traced operand, one compile serves every mask)."""
     def run(frags, bbits_np):
         s = frags.shape[1] // gf256.CHUNK_SIZE
         sp = _pad_w(s)
@@ -592,7 +593,7 @@ def _decode_fn(k: int, formulation: str, interpret: bool,
         )
 
     if formulation in ("xor", "xor3"):
-        bb = np.array(static_bbits, dtype=np.uint8)
+        bb = gf256.decode_bits_cached(k, rows)
         return jax.jit(lambda frags: run(frags, bb))
     return jax.jit(run)
 
@@ -614,10 +615,9 @@ def decode(frags, rows, k: int, formulation: str = "fused",
     if formulation == "fused":
         fn = _fused_decode_fn(k, rows, interpret)
         return np.asarray(fn(jnp.asarray(frags)))
-    bbits_np = gf256.decode_bits_cached(k, rows)
     if formulation in ("xor", "xor3"):
-        fn = _decode_fn(k, formulation, interpret,
-                        tuple(map(tuple, bbits_np)))
+        fn = _decode_fn(k, formulation, interpret, rows)
         return np.asarray(fn(jnp.asarray(frags)))
+    bbits_np = gf256.decode_bits_cached(k, rows)
     fn = _decode_fn(k, "mxu", interpret, None)
     return np.asarray(fn(jnp.asarray(frags), jnp.asarray(bbits_np, jnp.int8)))

@@ -8,14 +8,17 @@ reference: xlators/cluster/ec/src/ec-method.c:393-433):
   One matmul per stripe batch — the TPU-native replacement for the
   reference's JIT-emitted XOR chains (ec-code.c).
 * ``xor``: keep bytes packed and XOR-accumulate plane words on the VPU,
-  selecting terms by the static bit-matrix (the literal analog of the
-  reference's AVX XOR chains, traded for XLA fusion instead of hand JIT).
+  unrolling the CSE'd straight-line XOR program (gf256.build_xor_program)
+  into the trace — shared subexpressions are computed once per batch
+  instead of once per output plane (the analog of the reference's AVX XOR
+  chains, but ~2-3x fewer XORs and traded for XLA fusion instead of
+  hand JIT).
 
 ``matmul`` takes the coefficient bit-matrix as a traced argument, so decode
-does not retrace per surviving-fragment mask; ``xor`` bakes the matrix into
-the trace (one compile per mask, like the reference's per-matrix JIT).
-Decode matrices come from the shared per-mask LRU
-(gf256.decode_bits_cached).
+does not retrace per surviving-fragment mask; ``xor`` bakes the program
+into the trace (one compile per mask, like the reference's per-matrix
+JIT).  Decode programs come from the shared per-mask compiled-program LRU
+(gf256.DECODE_PROGRAMS), the jitted fns from a cache keyed the same way.
 """
 
 from __future__ import annotations
@@ -63,29 +66,40 @@ def _apply_matmul(abits: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     return _pack_bits((y & 1).astype(jnp.uint8))
 
 
-def _apply_xor(abits_np: np.ndarray, x: jnp.ndarray) -> jnp.ndarray:
-    """Same contraction, packed bytes on the VPU; abits must be static."""
-    outs = []
+def _apply_program(prog: gf256.XorProgram, x: jnp.ndarray) -> jnp.ndarray:
+    """Same contraction, packed bytes on the VPU, via the CSE'd
+    straight-line program: each op is one (S, 64) XOR shared by every
+    output row that references it."""
+    t = [x[:, j, :] for j in range(prog.n_inputs)]
+    for _dst, a, b in prog.ops:
+        t.append(t[a] ^ t[b])
     zero = jnp.zeros(x.shape[::2], dtype=jnp.uint8)  # (S, 64)
-    for i in range(abits_np.shape[0]):
-        sel = np.nonzero(abits_np[i])[0]
-        acc = zero
-        for j in sel:
-            acc = acc ^ x[:, j, :]
+    outs = []
+    for o in prog.outs:
+        if not o:
+            outs.append(zero)
+            continue
+        acc = t[o[0]]
+        for v in o[1:]:
+            acc = acc ^ t[v]
         outs.append(acc)
     return jnp.stack(outs, axis=1)  # (S, R, 64)
 
 
 @functools.lru_cache(maxsize=64)
 def _encode_fn(k: int, n: int, formulation: str, systematic: bool = False):
-    abits_np = gf256.expand_bitmatrix(gf256.generator_matrix(k, n,
-                                                             systematic))
+    if formulation == "xor":
+        prog = gf256.encode_program(k, n, systematic)
+        abits_np = None
+    else:
+        abits_np = gf256.expand_bitmatrix(gf256.generator_matrix(
+            k, n, systematic))
 
     def run(data: jnp.ndarray) -> jnp.ndarray:
         s = data.shape[0] // (k * gf256.CHUNK_SIZE)
         x = data.reshape(s, k * 8, gf256.WORD_SIZE)
         if formulation == "xor":
-            y = _apply_xor(abits_np, x)
+            y = _apply_program(prog, x)
         else:
             y = _apply_matmul(jnp.asarray(abits_np), x)
         # (S, n*8, 64) -> fragment-major (n, S*512)
@@ -98,8 +112,16 @@ def _encode_fn(k: int, n: int, formulation: str, systematic: bool = False):
     return jax.jit(run)
 
 
-@functools.lru_cache(maxsize=64)
-def _decode_fn(k: int, formulation: str, static_bbits: tuple | None):
+@functools.lru_cache(maxsize=256)
+def _decode_fn(k: int, formulation: str, rows: tuple[int, ...] | None,
+               systematic: bool = False):
+    """One jitted decoder per surviving mask for the static ``xor``
+    form (keyed exactly like gf256.DECODE_PROGRAMS, whose compiled
+    program it unrolls); ``matmul`` passes rows=None — its bit-matrix
+    is a traced operand, one compile serves every mask."""
+    prog = gf256.decode_program(k, rows, systematic) \
+        if formulation == "xor" else None
+
     def run(frags: jnp.ndarray, bbits: jnp.ndarray | None) -> jnp.ndarray:
         s = frags.shape[1] // gf256.CHUNK_SIZE
         x = (
@@ -108,7 +130,7 @@ def _decode_fn(k: int, formulation: str, static_bbits: tuple | None):
             .reshape(s, k * 8, gf256.WORD_SIZE)
         )
         if formulation == "xor":
-            y = _apply_xor(np.array(static_bbits, dtype=np.uint8), x)
+            y = _apply_program(prog, x)
         else:
             y = _apply_matmul(bbits, x)
         return y.reshape(s * k * gf256.CHUNK_SIZE)
@@ -132,12 +154,12 @@ def decode(
 ) -> np.ndarray:
     """Decode k fragments (k, S*512) with indices `rows` -> original bytes."""
     frags = np.ascontiguousarray(frags, dtype=np.uint8)
-    bbits_np = gf256.decode_bits_cached(k, tuple(int(x) for x in rows),
-                                        systematic)
+    rows = tuple(int(x) for x in rows)
     if formulation == "xor":
-        fn = _decode_fn(k, "xor", tuple(map(tuple, bbits_np)))
+        fn = _decode_fn(k, "xor", rows, systematic)
         out = fn(jnp.asarray(frags), None)
     else:
+        bbits_np = gf256.decode_bits_cached(k, rows, systematic)
         fn = _decode_fn(k, "matmul", None)
         out = fn(jnp.asarray(frags), jnp.asarray(bbits_np))
     return np.asarray(out)
