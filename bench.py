@@ -293,7 +293,91 @@ def smallfile_bench(n_files: int = 200, backend: str = "native",
             for k, v in best.items()}
 
 
-def fullstack_bench(n_clients: int = 8, file_mib: int = 1) -> dict:
+def smallfile_wire_bench(n_files: int = 150) -> dict:
+    """Small-file metadata rate over REAL TCP, compound on vs off —
+    the workload the compound-fop pipeline exists for (ISSUE 2): a
+    glusterd-managed single-brick distribute volume, create+write+
+    close / stat / read / unlink phases, with the measured RPC
+    round-trips per create recorded alongside the rates so the wire
+    fusion is driver-visible even when wall-clock is noisy."""
+    import asyncio
+    import os
+    import shutil
+    import tempfile
+
+    from glusterfs_tpu.core.layer import walk
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+    from glusterfs_tpu.protocol.client import ClientLayer
+
+    payload = b"s" * 4096
+    base = tempfile.mkdtemp(prefix="sfwire")
+    out: dict = {}
+
+    async def one_mode(tag: str, compound: str) -> None:
+        d = Glusterd(os.path.join(base, f"gd-{tag}"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="sf",
+                             vtype="distribute",
+                             bricks=[{"path":
+                                      os.path.join(base, f"b-{tag}")}])
+                await c.call("volume-set", name="sf",
+                             key="cluster.use-compound-fops",
+                             value=compound)
+                await c.call("volume-start", name="sf")
+            cl = await mount_volume(d.host, d.port, "sf")
+            try:
+                prot = [l for l in walk(cl.graph.top)
+                        if isinstance(l, ClientLayer)]
+                await cl.write_file("/warm", payload)
+                rt0 = sum(p.rpc_roundtrips for p in prot)
+                t0 = time.perf_counter()
+                for i in range(n_files):
+                    await cl.write_file(f"/s{i:04d}", payload)
+                out[f"smallfile_wire_create_{tag}_per_s"] = round(
+                    n_files / (time.perf_counter() - t0), 1)
+                out[f"smallfile_wire_rpc_per_create_{tag}"] = round(
+                    (sum(p.rpc_roundtrips for p in prot) - rt0)
+                    / n_files, 2)
+                t0 = time.perf_counter()
+                for i in range(n_files):
+                    await cl.stat(f"/s{i:04d}")
+                out[f"smallfile_wire_stat_{tag}_per_s"] = round(
+                    n_files / (time.perf_counter() - t0), 1)
+                t0 = time.perf_counter()
+                for i in range(n_files):
+                    await cl.read_file(f"/s{i:04d}")
+                out[f"smallfile_wire_read_{tag}_per_s"] = round(
+                    n_files / (time.perf_counter() - t0), 1)
+                t0 = time.perf_counter()
+                for i in range(n_files):
+                    await cl.unlink(f"/s{i:04d}")
+                out[f"smallfile_wire_unlink_{tag}_per_s"] = round(
+                    n_files / (time.perf_counter() - t0), 1)
+            finally:
+                await cl.unmount()
+        finally:
+            await d.stop()
+
+    try:
+        # per-mode isolation: a failed singles pass must not discard
+        # the measured compound rows (or vice versa) — the failure
+        # lands as that mode's explicit error row instead
+        for tag, val in (("compound", "on"), ("singles", "off")):
+            try:
+                asyncio.run(one_mode(tag, val))
+            except Exception as e:  # noqa: BLE001 - record, keep rows
+                out[f"smallfile_wire_{tag}_error"] = str(e)[:200]
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
+def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
+                    compound: str = "on", fuse: bool = True,
+                    prefix: str = "") -> dict:
     """Through-the-wire AND through-the-mount numbers (the reference's
     baseline workloads — dd/iozone/glfs-bm, extras/benchmarking/README —
     all run through the full stack, never in-process):
@@ -302,6 +386,10 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1) -> dict:
       protocol/client <-> protocol/server TCP with the stripe-cache on;
     * fuse_*: the same served volume mounted through the kernel via
       /dev/fuse, driven with plain file I/O.
+
+    ``compound`` sets cluster.use-compound-fops on the served volume
+    (write-behind window flushes then ride fused chains); ``fuse=False``
+    + a ``prefix`` gives a cheap wire-only comparison pass.
     """
     import asyncio
     import os
@@ -330,6 +418,9 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1) -> dict:
                 await c.call("volume-start", name="bw")
                 await c.call("volume-set", name="bw",
                              key="disperse.stripe-cache", value="on")
+                await c.call("volume-set", name="bw",
+                             key="cluster.use-compound-fops",
+                             value=compound)
             cl = await mount_volume(d.host, d.port, "bw")
             try:
                 # calibrate the stripe-cache router OFF the clock: its
@@ -358,8 +449,10 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1) -> dict:
             finally:
                 await cl.unmount()
             total = n_clients * file_mib
-            out["wire_write_MiB_s"] = round(total / t_w, 1)
-            out["wire_read_MiB_s"] = round(total / t_r, 1)
+            out[f"{prefix}wire_write_MiB_s"] = round(total / t_w, 1)
+            out[f"{prefix}wire_read_MiB_s"] = round(total / t_r, 1)
+            if not fuse:
+                return
 
             # kernel mount over the same served volume
             mnt = os.path.join(base, "mnt")
@@ -873,17 +966,44 @@ def main() -> None:
     except Exception as e:
         vol["smallfile_auto_bench_error"] = str(e)[:200]
     try:
-        vol.update(fullstack_bench())
+        vol.update(smallfile_wire_bench())
+    except Exception as e:
+        vol["smallfile_wire_bench_error"] = str(e)[:200]
+    try:
+        vol.update(fullstack_bench())  # cluster.use-compound-fops on
     except Exception as e:
         vol["fullstack_bench_error"] = str(e)[:200]
-    # a missing wire/fuse row is an EXPLICIT "skipped: <reason>" entry,
-    # never silence (r5's detail lost all four rows without a trace)
+    try:
+        # wire-only comparison pass with compound off: the on/off pair
+        # makes the chain fusion driver-visible on the record
+        vol.update(fullstack_bench(compound="off", fuse=False,
+                                   prefix="nocompound_"))
+    except Exception as e:
+        vol["nocompound_wire_bench_error"] = str(e)[:200]
+    # a missing wire/fuse/smallfile-wire row is an EXPLICIT
+    # "skipped: <reason>" entry, never silence (r5's detail lost all
+    # four rows without a trace)
     for row in ("wire_write_MiB_s", "wire_read_MiB_s",
-                "fuse_write_MiB_s", "fuse_read_MiB_s"):
+                "fuse_write_MiB_s", "fuse_read_MiB_s",
+                "nocompound_wire_write_MiB_s",
+                "nocompound_wire_read_MiB_s",
+                "smallfile_wire_create_compound_per_s",
+                "smallfile_wire_create_singles_per_s",
+                "smallfile_wire_rpc_per_create_compound",
+                "smallfile_wire_rpc_per_create_singles"):
         if row not in vol:
-            reason = vol.get("fuse_bench_error" if row.startswith("fuse")
-                             else "fullstack_bench_error") \
-                or vol.get("fullstack_bench_error") or "not measured"
+            if row.startswith("fuse"):
+                reason = vol.get("fuse_bench_error")
+            elif row.startswith("smallfile_wire"):
+                mode = "compound" if "compound" in row else "singles"
+                reason = vol.get(f"smallfile_wire_{mode}_error") \
+                    or vol.get("smallfile_wire_bench_error")
+            elif row.startswith("nocompound"):
+                reason = vol.get("nocompound_wire_bench_error")
+            else:
+                reason = vol.get("fullstack_bench_error")
+            reason = reason or vol.get("fullstack_bench_error") \
+                or "not measured"
             vol[row] = f"skipped: {reason}"[:200]
 
     result = {

@@ -365,6 +365,34 @@ class Client:
 
     # -- file ops ------------------------------------------------------------
 
+    def _use_compound(self) -> bool:
+        """Is any layer of the mounted graph carrying
+        ``compound-fops on``?  (volgen writes the key onto
+        protocol/client and write-behind when
+        cluster.use-compound-fops is set; re-checked per call so a
+        live volume-set flips the fusers immediately.)"""
+        from ..core.layer import walk
+
+        for layer in walk(self.graph.top):
+            v = layer.opts.get("compound-fops")
+            if isinstance(v, str):
+                v = v.strip().lower() in ("1", "on", "yes", "true",
+                                          "enable", "enabled")
+            if v:
+                return True
+        return False
+
+    def _lazy_open_graph(self) -> bool:
+        """Lazy open-behind makes plain open() ZERO round trips; the
+        fused lookup+open (one round trip, real fd) would regress it."""
+        from ..core.layer import walk
+
+        for layer in walk(self.graph.top):
+            if layer.type_name == "performance/open-behind" and \
+                    layer.opts.get("lazy-open"):
+                return True
+        return False
+
     async def create(self, path: str, flags: int = os.O_RDWR,
                      mode: int = 0o644) -> File:
         loc = await self._parent_loc(path)
@@ -373,6 +401,22 @@ class Client:
         return File(self, fd, loc.path)
 
     async def open(self, path: str, flags: int = os.O_RDWR) -> File:
+        if self._use_compound() and _norm(path) != "/" and \
+                not self._lazy_open_graph():
+            # lookup+open fused: the uncached leaf resolve and the open
+            # ride one frame (two waves become one)
+            loc = await self._parent_loc(path)
+            replies = await self.graph.top.compound([
+                ("lookup", (loc,), {}),
+                ("open", (loc, flags), {})])
+            from ..rpc import compound as cfop
+
+            lk, fd = cfop.unwrap(replies)
+            ia = lk[0] if isinstance(lk, (list, tuple)) else lk
+            if hasattr(ia, "gfid"):
+                self.itable.link(loc.parent, loc.name, ia.gfid,
+                                 ia.ia_type, ia)
+            return File(self, fd, loc.path)
         loc = await self.resolve(path)
         fd = await self.graph.top.open(loc, flags)
         return File(self, fd, loc.path)
@@ -382,14 +426,44 @@ class Client:
 
         Create-first (O_EXCL): the common fresh-file case pays no
         existence probe; an existing file falls back to the
-        truncate+open overwrite path on EEXIST."""
-        try:
-            f = await self.create(path, os.O_RDWR | os.O_EXCL)
-        except FopError as e:
-            if e.err != errno.EEXIST:
-                raise
+        truncate+open overwrite path on EEXIST.
+
+        With compound fops on, the fresh-file case is ONE chain —
+        create+writev+flush+release fused into a single round trip
+        where the graph carries it (the smallfile-create hot path)."""
+        if self._use_compound():
+            from ..rpc import compound as cfop
+
+            loc = await self._parent_loc(path)
+            replies = await self.graph.top.compound([
+                ("create", (loc, os.O_RDWR | os.O_EXCL, 0o644), {}),
+                ("writev", (cfop.FdRef(0), bytes(data), 0), {}),
+                ("flush", (cfop.FdRef(0),), {}),
+                ("release", (cfop.FdRef(0),), {})])
+            err = cfop.first_error(replies)
+            if err is None:
+                created = replies[0][1]
+                ia = created[1] if isinstance(created, (list, tuple)) \
+                    and len(created) > 1 else None
+                if hasattr(ia, "gfid"):
+                    self.itable.link(loc.parent, loc.name, ia.gfid,
+                                     ia.ia_type, ia)
+                return len(data)
+            if err.err != errno.EEXIST:
+                raise err
+            # existing file: straight to the truncate+open overwrite —
+            # the chain already proved EEXIST, re-probing would waste
+            # a round trip
             await self.truncate(path, 0)
             f = await self.open(path)
+        else:
+            try:
+                f = await self.create(path, os.O_RDWR | os.O_EXCL)
+            except FopError as e:
+                if e.err != errno.EEXIST:
+                    raise
+                await self.truncate(path, 0)
+                f = await self.open(path)
         try:
             return await f.write(data, 0)
         finally:

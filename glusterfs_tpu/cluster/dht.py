@@ -766,8 +766,14 @@ class DistributeLayer(Layer):
         return await self.children[i].seek(cfd, offset, what, xdata)
 
     async def release(self, fd: FdObj):
-        ctx: DhtFdCtx | None = fd.ctx_del(self)
-        if ctx:
+        ctx = fd.ctx_del(self)
+        if isinstance(ctx, dict):
+            # directory fd (opendir fans out): one child fd per subvol
+            for i, cfd in ctx.items():
+                rel = getattr(self.children[i], "release", None)
+                if rel:
+                    await rel(cfd)
+        elif ctx:
             rel = getattr(self.children[ctx.idx], "release", None)
             if rel:
                 await rel(ctx.child_fd)
@@ -1010,6 +1016,45 @@ class DistributeLayer(Layer):
             st["elapsed"] = round(time.time() - st["started"], 3)
         return {"moved": moved, "scanned": st["scanned"],
                 "status": dict(st)}
+
+    async def compound(self, links, xdata: dict | None = None) -> list:
+        """Single-subvolume fast path: on a one-brick distribute volume
+        a self-contained chain (every fd it creates is released by a
+        later link of the same chain) forwards intact — there is no
+        alternative placement, no linkto bookkeeping, and no dht fd
+        context can leak.  Everything else decomposes through the
+        normal routed fops."""
+        from ..rpc import compound as cfop
+
+        if len(self.children) == 1 and len(self._active) == 1:
+            produced = set()
+            released = set()
+            for i, (fop, args, _kw) in enumerate(links):
+                if fop in cfop.FD_PRODUCERS:
+                    produced.add(i)
+                elif fop == "release" and args and \
+                        isinstance(args[0], cfop.FdRef):
+                    released.add(args[0].index)
+            if produced <= released:
+                # translate caller-owned fds to the CHILD fd (the
+                # per-fop _fd_target step) — forwarding the dht-level
+                # FdObj would silently degrade every fused write to an
+                # anonymous gfid-addressed fd re-opened per op
+                fwd = []
+                for fop, args, kwargs in links:
+                    nargs = []
+                    for a in args:
+                        if isinstance(a, FdObj):
+                            _idx, a = await self._fd_target(a)
+                        nargs.append(a)
+                    nkw = {}
+                    for k, v in kwargs.items():
+                        if isinstance(v, FdObj):
+                            _idx, v = await self._fd_target(v)
+                        nkw[k] = v
+                    fwd.append((fop, tuple(nargs), nkw))
+                return await self.children[0].compound(fwd, xdata)
+        return await cfop.decompose(self, links, xdata)
 
     def dump_private(self) -> dict:
         span = (1 << 32) // len(self._active)

@@ -16,8 +16,12 @@ import errno as _errno
 
 
 class Fop(enum.Enum):
-    """Fop vocabulary (reference glusterfs-fops.h:17-76, same set minus the
-    compound/getspec RPC-internal entries)."""
+    """Fop vocabulary (reference glusterfs-fops.h:17-76, same set minus
+    the getspec RPC-internal entry).  COMPOUND (the reference's
+    GF_FOP_COMPOUND fused-chain carrier) is a real member here: its
+    argument is an ordered link chain executed brick-side in one round
+    trip (rpc/compound.py defines the envelope and the graph
+    semantics)."""
 
     STAT = "stat"
     READLINK = "readlink"
@@ -72,6 +76,7 @@ class Fop(enum.Enum):
     ICREATE = "icreate"
     NAMELINK = "namelink"
     COPY_FILE_RANGE = "copy_file_range"
+    COMPOUND = "compound"
 
 
 #: Fops that modify data or metadata (drive version/dirty accounting in the

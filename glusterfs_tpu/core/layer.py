@@ -144,6 +144,9 @@ def _make_default(op_name: str) -> Callable:
 
     default.__name__ = op_name
     default.__doc__ = f"Default {op_name}: pass through to first child."
+    # rpc/compound.py keys chain transparency off this mark: a layer
+    # serving a fop with the generated default adds no behavior to it
+    default._gf_default = True  # type: ignore[attr-defined]
     return default
 
 
@@ -264,6 +267,18 @@ class Layer:
             rel = getattr(self.children[0], "release", None)
             if rel is not None:
                 await rel(fd)
+
+    async def compound(self, links, xdata: dict | None = None) -> list:
+        """Compound fop (rpc/compound.py): forward the chain intact when
+        this layer adds no behavior to any fop it contains, otherwise
+        decompose — each link then runs through this layer's own fop
+        methods, preserving its exact per-fop semantics.  Returns the
+        per-link reply vector (never raises for link failures)."""
+        from ..rpc import compound as _compound
+
+        if self.children and _compound.transparent_for(type(self), links):
+            return await self.children[0].compound(links, xdata)
+        return await _compound.decompose(self, links, xdata)
 
     # -- introspection -----------------------------------------------------
 

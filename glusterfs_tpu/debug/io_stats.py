@@ -185,6 +185,36 @@ class IoStatsLayer(Layer):
             st["write_bytes"] += len(data)
         return ret
 
+    async def compound(self, links, xdata: dict | None = None) -> list:
+        """Forward chains intact (accounting is side-effect-free) and
+        replay the per-fop byte/open counters from the reply vector —
+        fused traffic must not vanish from `volume profile`."""
+        replies = await self.children[0].compound(links, xdata)
+        for (fop, args, _kw), (st, _val) in zip(links, replies):
+            if st != "ok":
+                continue
+            path = None
+            for a in args:
+                if isinstance(a, Loc):
+                    path = a.path
+                    break
+                if isinstance(a, FdObj):
+                    path = getattr(a, "path", None)
+                    break
+            self._sample(fop, path)
+            st_rec = self._path_stat(path)
+            if fop in ("open", "create") and st_rec is not None:
+                st_rec["opens"] += 1
+            elif fop == "writev":
+                data = args[1] if len(args) > 1 else b""
+                n = len(data) if isinstance(
+                    data, (bytes, bytearray, memoryview)) else 0
+                self.write_bytes += n
+                if st_rec is not None:
+                    st_rec["writes"] += 1
+                    st_rec["write_bytes"] += n
+        return replies
+
     # -- `volume top` backend (io-stats ios_stat_list) ---------------------
 
     def top(self, metric: str = "open", count: int = 10) -> list:

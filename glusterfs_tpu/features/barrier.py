@@ -36,6 +36,7 @@ log = gflog.get_logger("barrier")
 # barrier set too.
 _GATED = (WRITE_FOPS | {Fop.FSYNC, Fop.FSYNCDIR}) \
     - {Fop.XATTROP, Fop.FXATTROP}
+_GATED_NAMES = {f.value for f in _GATED}
 
 
 @register("features/barrier")
@@ -95,6 +96,21 @@ class BarrierLayer(Layer):
             self._release.set()
         finally:
             self._held -= 1
+
+    async def compound(self, links, xdata: dict | None = None) -> list:
+        """A chain carrying any gated fop waits at the barrier ONCE as a
+        unit, then forwards intact — identical quiesce semantics to its
+        links arriving singly (all-or-nothing past the gate), and the
+        in-flight count covers the whole chain so a snapshot still
+        waits for it."""
+        if any(f in _GATED_NAMES for f, _a, _k in links):
+            await self._gate()
+            self._inflight += 1
+            try:
+                return await self.children[0].compound(links, xdata)
+            finally:
+                self._inflight -= 1
+        return await self.children[0].compound(links, xdata)
 
     def dump_private(self) -> dict:
         return {"barrier": self.opts["barrier"], "held": self._held,

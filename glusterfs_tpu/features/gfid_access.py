@@ -68,5 +68,10 @@ def _rewriting(op_name: str):
 
 
 for _f in Fop:
-    if _f.value not in GfidAccessLayer.__dict__:  # keep custom lookup
+    # keep custom lookup; COMPOUND stays on Layer.compound so chains
+    # DECOMPOSE here and each link's /.gfid/<uuid> Loc is rewritten —
+    # the _rewriting wrapper would forward a chain intact with raw
+    # virtual paths inside its links
+    if _f.value not in GfidAccessLayer.__dict__ and \
+            _f is not Fop.COMPOUND:
         setattr(GfidAccessLayer, _f.value, _rewriting(_f.value))

@@ -48,4 +48,10 @@ def _tagging(op_name: str):
 
 
 for _f in Fop:
-    setattr(NamespaceLayer, _f.value, _tagging(_f.value))
+    # COMPOUND stays on the inherited Layer.compound: this layer's
+    # per-fop overrides make it non-transparent, so chains decompose
+    # and every link gets its namespace tag — the _tagging wrapper
+    # would forward the chain intact and untagged (its args are links,
+    # not a Loc)
+    if _f is not Fop.COMPOUND:
+        setattr(NamespaceLayer, _f.value, _tagging(_f.value))

@@ -244,6 +244,22 @@ class SnapviewLayer(Layer):
             return {}
         return await self.children[0].flush(fd, xdata)
 
+    async def compound(self, links, xdata: dict | None = None) -> list:
+        """Chains touching neither /.snaps paths nor snapshot fds
+        forward intact (this layer is pure passthrough for the live
+        volume); anything virtual decomposes so the read-only guards
+        and snapshot proxies apply per fop."""
+        from ..rpc import compound as cfop
+
+        for _fop, args, kwargs in links:
+            for a in list(args) + list((kwargs or {}).values()):
+                if (isinstance(a, Loc) and self._split(a.path)
+                        is not None) or \
+                        (isinstance(a, FdObj)
+                         and a.ctx_get(self) is not None):
+                    return await cfop.decompose(self, links, xdata)
+        return await self.children[0].compound(links, xdata)
+
     async def opendir(self, loc: Loc, xdata: dict | None = None):
         sp = self._split(loc.path)
         if sp is None:

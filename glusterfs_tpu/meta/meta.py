@@ -210,6 +210,19 @@ class MetaLayer(Layer):
             return {}
         return await self.children[0].getxattr(loc, name, xdata)
 
+    async def compound(self, links, xdata: dict | None = None) -> list:
+        """Chains touching nothing under /.meta forward intact (this
+        layer is pure passthrough for real files); a /.meta link makes
+        the whole chain decompose so the virtual tree keeps serving."""
+        from ..rpc import compound as cfop
+
+        for _fop, args, kwargs in links:
+            for a in list(args) + list((kwargs or {}).values()):
+                if (isinstance(a, Loc) and self._is_meta(a.path)) or \
+                        (isinstance(a, FdObj) and self._is_meta(a.path)):
+                    return await cfop.decompose(self, links, xdata)
+        return await self.children[0].compound(links, xdata)
+
     def dump_private(self) -> dict:
         return {"layers": sorted(self._layers())}
 

@@ -40,6 +40,11 @@ OPTION_MIN_OPVERSION = {
 # volume-set key -> (layer type, option name)  (glusterd-volume-set.c map)
 OPTION_MAP = {
     "auth.allow": ("protocol/server", "auth-allow"),
+    "auth.ssl-allow": ("protocol/server", "ssl-allow"),
+    # compound fop chains (rpc/compound.py): one key arms all three
+    # ends — protocol/client (wire fusion), performance/write-behind
+    # (window flush chains) and protocol/server (serve + advertise)
+    "cluster.use-compound-fops": ("__compound__", "compound-fops"),
     "server.outstanding-rpc-limit": ("protocol/server",
                                      "outstanding-rpc-limit"),
     "auth.reject": ("protocol/server", "auth-reject"),
@@ -577,6 +582,15 @@ _V4_KEYS = (
 )
 OPTION_MIN_OPVERSION.update({k: 4 for k in _V4_KEYS})
 
+# round-6 additions ship at op-version 5: compound fop chains and TLS
+# CN allow-listing — both change what peers must understand (a v4
+# member would neither serve chains nor enforce CN lists)
+_V5_KEYS = (
+    "cluster.use-compound-fops",
+    "auth.ssl-allow",
+)
+OPTION_MIN_OPVERSION.update({k: 5 for k in _V5_KEYS})
+
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key
 DEFAULT_PERF_STACK = [
@@ -752,6 +766,7 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
     # TLS (server xlator at the top of every reference brick volfile)
     sopts = dict(layer_options(volinfo, "protocol/server"))
     sopts.update(_ssl_options(volinfo))
+    sopts.update(_compound_options(volinfo))
     auth = volinfo.get("auth") or {}
     if auth:
         sopts["auth-user"] = auth["username"]
@@ -772,6 +787,13 @@ def _ssl_options(volinfo: dict) -> dict[str, Any]:
         if m and m[0] in ("__ssl__", "__transport__"):
             out[m[1]] = val
     return out
+
+
+def _compound_options(volinfo: dict) -> dict[str, Any]:
+    """cluster.use-compound-fops lands on every fusion end: the wire
+    client, the window flusher, and the serving brick top."""
+    val = volinfo.get("options", {}).get("cluster.use-compound-fops")
+    return {} if val is None else {"compound-fops": val}
 
 
 def build_client_volfile(volinfo: dict,
@@ -797,6 +819,7 @@ def build_client_volfile(volinfo: dict,
             opts["password"] = auth["password"]
         opts.update(layer_options(volinfo, "protocol/client"))
         opts.update(_ssl_options(volinfo))
+        opts.update(_compound_options(volinfo))
         # a TLS brick implies TLS clients (admins set server.ssl once)
         if _enabled(volinfo, "server.ssl", False):
             opts["ssl"] = "on"
@@ -912,8 +935,11 @@ def build_client_volfile(volinfo: dict,
                                  True)
         if on and not _enabled(volinfo, pt, False):
             lname = f"{volinfo['name']}-{ltype.split('/')[1]}"
-            out.append(_emit(lname, ltype, layer_options(volinfo, ltype),
-                             [top]))
+            lopts = layer_options(volinfo, ltype)
+            if ltype == "performance/write-behind":
+                # the window flusher is a compound emission site
+                lopts.update(_compound_options(volinfo))
+            out.append(_emit(lname, ltype, lopts, [top]))
             top = lname
     if _enabled(volinfo, "performance.client-io-threads", False) and \
             not _enabled(volinfo, "performance.iot-pass-through", False):
@@ -1011,8 +1037,6 @@ DESCOPED_KEYS = {
     "client.send-gids": "no per-request uid/gid credential model",
     "server.dynamic-auth": "auth re-checks at reconnect; live "
                            "disconnect-on-revoke not implemented",
-    "auth.ssl-allow": "TLS peer CN allow-listing not implemented "
-                      "(certificate auth is all-or-nothing)",
     "client.bind-insecure": "clients always bind ephemeral ports; the "
                             "brick-side allow-insecure check is the "
                             "operative half",
@@ -1070,9 +1094,6 @@ DESCOPED_KEYS = {
                                    "from)",
     "cluster.heal-wait-queue-length/disperse": "mapped via mgmt/shd "
                                                "wait-qlength",
-    "cluster.use-compound-fops": "removed upstream; compounding here "
-                                 "rides xdata (lock-on-create, "
-                                 "pre-xattrop piggyback)",
     "cluster.use-anonymous-inode": "heal resolves by gfid handle "
                                    "directly",
     "cluster.read-freq-threshold": "no tiering",

@@ -383,11 +383,6 @@ class FuseBridge:
             payload)
         node = self._node(nodeid)
         loc = self._loc(node)
-        if valid & fp.FATTR_SIZE:
-            if valid & fp.FATTR_FH and fh in self._fhs:
-                await self._top.ftruncate(self._fhs[fh], size)
-            else:
-                await self._top.truncate(loc, size)
         attrs: dict = {}
         if valid & fp.FATTR_MODE:
             attrs["mode"] = stat_mod.S_IMODE(mode)
@@ -404,6 +399,24 @@ class FuseBridge:
         if valid & (fp.FATTR_MTIME | fp.FATTR_MTIME_NOW):
             attrs["mtime"] = (None
                               if valid & fp.FATTR_MTIME_NOW else mtime)
+        truncating = bool(valid & fp.FATTR_SIZE)
+        if truncating and attrs and self.client._use_compound():
+            # truncate+chmod/chown/utimes arrive as ONE kernel SETATTR;
+            # send them as one fused chain instead of two graph waves
+            from ..rpc import compound as cfop
+
+            if valid & fp.FATTR_FH and fh in self._fhs:
+                first = ("ftruncate", (self._fhs[fh], size), {})
+            else:
+                first = ("truncate", (loc, size), {})
+            replies = await self._top.compound(
+                [first, ("setattr", (loc, attrs, valid), {})])
+            return self._attr_out(cfop.unwrap(replies)[-1])
+        if truncating:
+            if valid & fp.FATTR_FH and fh in self._fhs:
+                await self._top.ftruncate(self._fhs[fh], size)
+            else:
+                await self._top.truncate(loc, size)
         if attrs:
             ia = await self._top.setattr(loc, attrs, valid)
         else:

@@ -229,6 +229,15 @@ class IoCacheLayer(Layer):
         self._invalidate(ia.gfid)
         return ia
 
+    async def compound(self, links, xdata: dict | None = None) -> list:
+        """Forward chains intact; replay the page-cache invalidation
+        the per-fop write overrides would have done."""
+        from ..rpc import compound as cfop
+
+        replies = await self.children[0].compound(links, xdata)
+        cfop.replay_write_invalidation(links, replies, self._invalidate)
+        return replies
+
     def dump_private(self) -> dict:
         return {"pages": len(self._pages), "bytes": self._bytes,
                 "hits": self.hits, "misses": self.misses,

@@ -31,7 +31,10 @@ NORMAL = {Fop.READV, Fop.WRITEV, Fop.FLUSH, Fop.FSYNC, Fop.CREATE,
           Fop.MKDIR, Fop.UNLINK, Fop.RMDIR, Fop.RENAME, Fop.LINK,
           Fop.SYMLINK, Fop.MKNOD, Fop.TRUNCATE, Fop.FTRUNCATE,
           Fop.SETXATTR, Fop.FSETXATTR, Fop.XATTROP, Fop.FXATTROP,
-          Fop.SETATTR, Fop.FSETATTR}
+          Fop.SETATTR, Fop.FSETATTR,
+          # fused chains are data-path work (create+writev+flush);
+          # the slow queue would invert their priority vs their links
+          Fop.COMPOUND}
 # everything else -> slow; readdirp/rchecksum explicitly least
 LEAST = {Fop.READDIRP, Fop.RCHECKSUM}
 # Lock fops are NEVER admission-gated: an inodelk can legitimately

@@ -159,6 +159,40 @@ class MdCacheLayer(Layer):
                 "upcall_invalidations": self.invalidations}
 
 
+_WRITE_NAMES = {f.value for f in WRITE_FOPS}
+
+
+async def _mdc_compound(self, links, xdata: dict | None = None) -> list:
+    """Forward chains intact and replay the cache maintenance the
+    per-fop overrides would have done: write links invalidate their
+    target's cached iatt, successful replies donate their postbufs
+    (a fused create+writev still leaves the size a following stat
+    expects)."""
+    replies = await self.children[0].compound(links, xdata)
+    now = time.monotonic()
+    for (fop, args, _kw), (st, val) in zip(links, replies):
+        if fop in _WRITE_NAMES:
+            for a in args:
+                gfid = a.gfid if isinstance(a, (Loc, FdObj)) else None
+                if gfid:
+                    self.invalidate(gfid)
+        if st != "ok":
+            continue
+        ia = val
+        if isinstance(ia, (tuple, list)):
+            # composite replies park the iatt at either end: create is
+            # (fd, iatt), lookup is (iatt, xdata) — take the first
+            # element that actually is one
+            ia = next((x for x in ia if hasattr(x, "gfid")
+                       and hasattr(x, "size")), None)
+        if hasattr(ia, "gfid") and hasattr(ia, "size") and ia.gfid:
+            self._iatt[ia.gfid] = (now, ia)
+    return replies
+
+
+MdCacheLayer.compound = _mdc_compound
+
+
 def _invalidating(op_name: str):
     async def fop(self, *args, **kwargs):
         ret = await getattr(self.children[0], op_name)(*args, **kwargs)

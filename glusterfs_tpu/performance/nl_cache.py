@@ -69,6 +69,22 @@ class NlCacheLayer(Layer):
         return {"negative_entries": len(self._neg), "hits": self.hits}
 
 
+async def _nlc_compound(self, links, xdata: dict | None = None) -> list:
+    """Forward chains intact; stale every parent entry a namespace link
+    touches (the per-fop _creating overrides' job)."""
+    replies = await self.children[0].compound(links, xdata)
+    for (fop, args, _kw), _entry in zip(links, replies):
+        if fop in ("create", "mkdir", "mknod", "symlink", "link",
+                   "rename", "unlink", "rmdir"):
+            for a in args:
+                if isinstance(a, Loc):
+                    self._invalidate_parent(a.path)
+    return replies
+
+
+NlCacheLayer.compound = _nlc_compound
+
+
 def _creating(op_name: str, loc_arg: int):
     async def fop(self, *args, **kwargs):
         ret = await getattr(self.children[0], op_name)(*args, **kwargs)

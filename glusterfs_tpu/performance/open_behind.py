@@ -96,6 +96,18 @@ class OpenBehindLayer(Layer):
             return
         await super().release(fd)
 
+    async def compound(self, links, xdata: dict | None = None) -> list:
+        """Chains whose fds are all chain-internal (FdRef) or foreign
+        forward intact; a lazy fd of OURS in the chain decomposes so
+        the per-fop materialization/anonymous routing applies."""
+        from ..rpc import compound as cfop
+
+        for _fop, args, kwargs in links:
+            for a in list(args) + list((kwargs or {}).values()):
+                if isinstance(a, FdObj) and a.ctx_get(self) is not None:
+                    return await cfop.decompose(self, links, xdata)
+        return await self.children[0].compound(links, xdata)
+
     def dump_private(self) -> dict:
         return {"lazy_open": self.opts["lazy-open"]}
 

@@ -128,3 +128,16 @@ class ReadAheadLayer(Layer):
         if ctx is not None and ctx.task is not None:
             ctx.task.cancel()
         await super().release(fd)
+
+    async def compound(self, links, xdata: dict | None = None) -> list:
+        """Forward chains intact; drop the read-ahead pages of any fd a
+        write link touches (the per-fop writev override's job)."""
+        for fop, args, _kw in links:
+            if fop in ("writev", "ftruncate", "discard", "zerofill",
+                       "fallocate"):
+                for a in args:
+                    if isinstance(a, FdObj):
+                        ctx = a.ctx_get(self)
+                        if ctx is not None:
+                            ctx.pages.clear()
+        return await self.children[0].compound(links, xdata)

@@ -48,6 +48,12 @@ class WriteBehindLayer(Layer):
                            "prior one reached the child: each write "
                            "drains the window first "
                            "(performance.strict-write-ordering)"),
+        Option("compound-fops", "bool", default="off",
+               description="emit flushed windows as compound chains "
+                           "(cluster.use-compound-fops): a multi-chunk "
+                           "drain is one fused writev chain, and flush "
+                           "rides the same frame as the final drain "
+                           "instead of its own round trip"),
     )
 
     def _ctx(self, fd: FdObj) -> _WbFd:
@@ -80,9 +86,36 @@ class WriteBehindLayer(Layer):
         ctx.chunks = rest
         ctx.bytes = sum(len(b) for _, b in ctx.chunks)
 
-    async def _drain(self, fd: FdObj, ctx: _WbFd) -> None:
+    async def _drain(self, fd: FdObj, ctx: _WbFd,
+                     tail: tuple = ()) -> list | None:
+        """Flush the window.  With compound-fops on, a multi-chunk
+        window (or any window with a ``tail`` of extra links, e.g. the
+        flush that triggered the drain) goes down as ONE fused chain;
+        otherwise the historical per-chunk writev loop runs and the
+        tail is the caller's business.  Returns the tail's reply
+        entries when a chain carried them, else None."""
         async with ctx.lock:
             chunks, ctx.chunks, ctx.bytes = ctx.chunks, [], 0
+            if self.opts["compound-fops"] and chunks and \
+                    (len(chunks) + len(tail)) > 1:
+                links = [("writev", (fd, bytes(buf), off), {})
+                         for off, buf in sorted(chunks)]
+                try:
+                    replies = await self.children[0].compound(
+                        links + list(tail))
+                except FopError as e:
+                    # transport-level failure (ENOTCONN mid-drain): the
+                    # window is already popped — defer like the singles
+                    # loop would, never let it escape an absorbing
+                    # writev as a spurious hard error
+                    ctx.error = e
+                    return [("err", e)] if tail else None
+                for st, val in replies[:len(links)]:
+                    if st == "ok" and val is not None:
+                        ctx.last_iatt = val
+                    elif st == "err":
+                        ctx.error = val  # deferred (wb_fd error analog)
+                return replies[len(links):]
             for off, buf in sorted(chunks):
                 try:
                     ctx.last_iatt = await self.children[0].writev(
@@ -90,6 +123,7 @@ class WriteBehindLayer(Layer):
                 except FopError as e:
                     ctx.error = e  # deferred error (wb_fd error analog)
                     break
+            return None
 
     def _raise_deferred(self, ctx: _WbFd) -> None:
         if ctx.error is not None:
@@ -144,6 +178,19 @@ class WriteBehindLayer(Layer):
 
     async def flush(self, fd: FdObj, xdata: dict | None = None):
         ctx = self._ctx(fd)
+        if self.opts["compound-fops"] and ctx.chunks:
+            # the flush rides the drain's frame: window + flush is one
+            # chain (one round trip) instead of N writevs + a flush
+            tail = await self._drain(
+                fd, ctx, tail=(("flush", (fd,),
+                                {"xdata": xdata} if xdata else {}),))
+            self._raise_deferred(ctx)
+            if tail:  # ("ok", ret) | ("skip", None) — err raised above
+                st, val = tail[0]
+                if st == "err":
+                    raise val
+                return val
+            return await self.children[0].flush(fd, xdata)
         await self._drain(fd, ctx)
         self._raise_deferred(ctx)
         return await self.children[0].flush(fd, xdata)
@@ -168,7 +215,41 @@ class WriteBehindLayer(Layer):
         await self._drain(fd, ctx)
         self._raise_deferred(ctx)
         ctx.logical_end = size
-        return await self.children[0].ftruncate(fd, size, xdata)
+        ia = await self.children[0].ftruncate(fd, size, xdata)
+        # refresh the cached postbuf: the drain's predates the truncate
+        # and a later absorbed write would reply with the stale size
+        ctx.last_iatt = ia if hasattr(ia, "size") else None
+        return ia
+
+    async def compound(self, links, xdata: dict | None = None) -> list:
+        """Chains pass through write-through: any involved fd's pending
+        window drains first (ordering), its deferred error surfaces,
+        then the chain forwards INTACT — the point of a fused
+        create+writev is that it skips the window entirely.  FdRef
+        links (fds the chain itself creates) have no window by
+        definition."""
+        for _fop, args, kwargs in links:
+            for a in list(args) + list((kwargs or {}).values()):
+                if isinstance(a, FdObj):
+                    ctx: _WbFd | None = a.ctx_get(self)
+                    if ctx is not None:
+                        if ctx.chunks:
+                            await self._drain(a, ctx)
+                        self._raise_deferred(ctx)
+        replies = await self.children[0].compound(links, xdata)
+        # replay the per-fop bookkeeping the forwarded links skipped:
+        # a fused ftruncate must reset the absorbed-bytes high-water
+        # mark or later write replies inflate a shrunk file's size
+        for (fop, args, _kw), (st, val) in zip(links, replies):
+            if fop == "ftruncate" and st == "ok" and \
+                    isinstance(args[0], FdObj) and len(args) > 1:
+                ctx = args[0].ctx_get(self)
+                if ctx is not None:
+                    ctx.logical_end = args[1]
+                    # the drain's postbuf predates the truncate: keep
+                    # the truncated iatt or later writes reply stale
+                    ctx.last_iatt = val if hasattr(val, "size") else None
+        return replies
 
     async def release(self, fd: FdObj):
         ctx: _WbFd | None = fd.ctx_get(self)

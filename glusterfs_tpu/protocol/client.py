@@ -60,6 +60,12 @@ class ClientLayer(Layer):
         Option("ssl-cert", "str", default="",
                description="client certificate (mutual TLS)"),
         Option("ssl-key", "str", default=""),
+        Option("compound-fops", "bool", default="off",
+               description="fuse chained fops into single wire frames "
+                           "(cluster.use-compound-fops); only engages "
+                           "when the brick advertised compound support "
+                           "at SETVOLUME — otherwise chains decompose "
+                           "into singles (mixed-version fallback)"),
         Option("compression", "bool", default="off",
                description="zlib on-wire frames (the cdc/compress "
                            "xlator analog); the brick mirrors it on "
@@ -96,6 +102,11 @@ class ClientLayer(Layer):
         self._closing = False
         self.identity = gfid_new()
         self._last_pong = 0.0
+        # did the peer advertise compound support at SETVOLUME?
+        self._peer_compound = False
+        # fop round-trips awaited on this transport (handshake/ping
+        # excluded; the wire-frame-counting tests read this)
+        self.rpc_roundtrips = 0
         # reopen bookkeeping (client-handshake.c reopen_fd_count):
         # live fds with server-side handles (value = (fd, reopen fop)),
         # and locks granted through this connection, replayed on
@@ -175,6 +186,9 @@ class ClientLayer(Layer):
             await self._drop_connection(notify=False)
             raise FopError(errno.EACCES,
                            res.get("error", "handshake rejected"))
+        # per-peer capability (mixed-version clusters): a brick that
+        # doesn't advertise compound gets singles from this client
+        self._peer_compound = bool(res.get("compound"))
         # re-open tracked fds and re-acquire held locks BEFORE CHILD_UP
         # (client_child_up_reopen_done): parents must never see an "up"
         # child whose fd handles are stale
@@ -334,6 +348,8 @@ class ClientLayer(Layer):
         writer = self._writer
         if writer is None:
             raise FopError(errno.ENOTCONN, f"{self.name}: not connected")
+        if fop == "__compound__" or not fop.startswith("__"):
+            self.rpc_roundtrips += 1
         xid = next(self._xid)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[xid] = fut
@@ -404,17 +420,7 @@ class ClientLayer(Layer):
             raise
         out = self._absorb(ret, args)
         if name in ("open", "create", "opendir"):
-            # remember the fd (+ flags and the fop that re-creates it)
-            # for the reconnect re-open; create returns (fd, iatt) so
-            # walk one level of the absorbed result
-            flat = out if isinstance(out, (list, tuple)) else (out,)
-            for fd in flat:
-                if isinstance(fd, FdObj) and fd.ctx_get(self) is not None:
-                    if name != "opendir":
-                        fd.flags = next((a for a in args[1:]
-                                         if isinstance(a, int)), fd.flags)
-                    self._fds[id(fd)] = (
-                        fd, "opendir" if name == "opendir" else "open")
+            self._note_fd_result(name, out, args)
         elif name in ("inodelk", "finodelk", "entrylk", "fentrylk", "lk"):
             self._track_lock(name, args, kwargs)
         elif name in ("xattrop", "fxattrop"):
@@ -433,6 +439,63 @@ class ClientLayer(Layer):
                 for lkname in ("inodelk", "finodelk"):
                     self._held_locks.pop(
                         (lkname, ident, domain, okey, start, end), None)
+        return out
+
+    def _note_fd_result(self, name: str, out: Any, args: tuple) -> None:
+        """Remember a just-opened fd (+ flags and the fop that re-creates
+        it) for the reconnect re-open; create returns (fd, iatt) so walk
+        one level of the absorbed result."""
+        flat = out if isinstance(out, (list, tuple)) else (out,)
+        for fd in flat:
+            if isinstance(fd, FdObj) and fd.ctx_get(self) is not None:
+                if name != "opendir":
+                    fd.flags = next((a for a in args[1:]
+                                     if isinstance(a, int)), fd.flags)
+                self._fds[id(fd)] = (
+                    fd, "opendir" if name == "opendir" else "open")
+
+    async def compound(self, links, xdata: dict | None = None) -> list:
+        """Ship a whole chain as ONE wire frame (the tentpole fusion:
+        create+writev+flush+release of a small file is a single round
+        trip).  Decomposes into ordinary wired fops when the volume key
+        is off, the peer didn't advertise compound at SETVOLUME, or the
+        chain carries lock fops (their reconnect-replay bookkeeping
+        lives in fop_call)."""
+        from ..rpc import compound as cfop
+
+        links = cfop.validate(links)
+        if not (self.connected and self.opts["compound-fops"]
+                and self._peer_compound) or \
+                any(l[0] in self._LOCK_FOPS for l in links):
+            return await cfop.decompose(self, links, xdata)
+        wire_links = []
+        for fop, args, kwargs in links:
+            wargs = [{cfop.FD_LINK_KEY: a.index}
+                     if isinstance(a, cfop.FdRef) else a
+                     for a in self._wire_args(args)]
+            wkw = {k: ({cfop.FD_LINK_KEY: v.index}
+                       if isinstance(v, cfop.FdRef) else v)
+                   for k, v in kwargs.items()}
+            wire_links.append([fop, wargs, wkw])
+        try:
+            replies = await self._call(
+                "__compound__", (wire_links,),
+                {"xdata": xdata} if xdata else {})
+        except FopError as e:
+            if e.err in (errno.ENOSYS, errno.EOPNOTSUPP):
+                # the brick was downgraded/reconfigured under us:
+                # remember and fall back to singles for this connection
+                self._peer_compound = False
+                return await cfop.decompose(self, links, xdata)
+            raise
+        out = []
+        for entry, (fop, args, _kw) in zip(replies, links):
+            st, val = entry[0], entry[1]
+            if st == "ok":
+                val = self._absorb(val, args)
+                if fop in cfop.FD_PRODUCERS:
+                    self._note_fd_result(fop, val, args)
+            out.append([st, val])
         return out
 
     def _track_lock(self, name: str, args: tuple, kwargs: dict,
@@ -536,4 +599,7 @@ def _make_wire_fop(op_name: str):
 
 
 for _fop in Fop:
-    setattr(ClientLayer, _fop.value, _make_wire_fop(_fop.value))
+    # explicit methods (compound: capability-gated fusion + fallback)
+    # keep their implementation; everything else is a plain wired fop
+    if _fop.value not in vars(ClientLayer):
+        setattr(ClientLayer, _fop.value, _make_wire_fop(_fop.value))

@@ -82,6 +82,19 @@ class ServerLayer(Layer):
         Option("ssl-ca", "str", default="",
                description="PEM CA bundle; when set, client certificates "
                            "are required and verified (ssl-ca-list)"),
+        Option("ssl-allow", "str", default="",
+               description="comma-separated certificate CN patterns "
+                           "allowed to SETVOLUME (auth.ssl-allow, "
+                           "server.c:1857): per-identity TLS auth on "
+                           "top of CA verification.  Empty = any "
+                           "verified cert.  Requires ssl + ssl-ca "
+                           "(without a verified peer cert every "
+                           "handshake is refused)"),
+        Option("compound-fops", "bool", default="on",
+               description="serve compound fop chains and advertise "
+                           "the capability at SETVOLUME "
+                           "(cluster.use-compound-fops server half); "
+                           "off = clients fall back to single fops"),
         Option("listen-backlog", "int", default=1024, min=0,
                description="accept-queue depth for the brick listener "
                            "(transport.listen-backlog; socket.c default "
@@ -142,6 +155,16 @@ def _addr_match(addr: str, patterns: str) -> bool:
                for p in patterns.split(",") if p.strip())
 
 
+def _peer_cn(cert) -> str | None:
+    """commonName from a parsed TLS peer certificate (ssl module's
+    getpeercert() dict shape), or None when absent/unverified."""
+    for rdn in (cert or {}).get("subject", ()):
+        for key, value in rdn:
+            if key == "commonName":
+                return value
+    return None
+
+
 def _ct_eq(a, b) -> bool:
     """Constant-time credential comparison (timing side-channel)."""
     if not isinstance(a, str) or not isinstance(b, str):
@@ -173,6 +196,7 @@ class _ClientConn:
         self.authed = False
         self.is_mgmt = False
         self.peer_addr = "?"
+        self.peercert = None  # parsed TLS peer cert (CN allow-listing)
         self.compress = False  # mirror zlib frames after handshake
         # the brick this transport bound to at SETVOLUME (multiplexed
         # processes serve several; glusterfsd-mgmt.c ATTACH model)
@@ -303,6 +327,27 @@ class BrickServer:
                                opts["auth-mgmt-user"])
                     and _ct_eq(creds.get("password"),
                                opts["auth-mgmt-password"]))
+
+    def _ssl_cn_ok(self, conn: "_ClientConn",
+                   top: Layer | None = None) -> bool:
+        """auth.ssl-allow: when the brick carries a CN allow-list, the
+        peer must have presented a VERIFIED certificate whose CN
+        matches one pattern (reference server.c:1857 ssl_allow — a
+        valid cert with the wrong identity is still refused)."""
+        opts = self._opts_of(top if top is not None else self.top)
+        allow = opts.get("ssl-allow", "") if opts else ""
+        if not allow:
+            return True
+        cn = _peer_cn(conn.peercert)
+        return cn is not None and _addr_match(cn, allow)
+
+    def _compound_on(self, top: Layer | None = None) -> bool:
+        """Serve/advertise compound chains?  Read per-use so a live
+        volume-set of cluster.use-compound-fops applies immediately."""
+        opts = self._opts_of(top if top is not None else self.top)
+        if not opts:
+            return True  # bare graphs (tests): capability always on
+        return bool(opts.get("compound-fops", True))
 
     def _login_ok(self, creds: dict, top: Layer | None = None) -> bool:
         """auth/login: when the brick carries credentials, the client
@@ -456,6 +501,9 @@ class BrickServer:
                     window_size=opts.get("tcp-window-size", 0))
         conn = _ClientConn(self, writer)
         conn.peer_addr = str(peer[0])
+        # TLS identity for auth.ssl-allow: only present when the
+        # listener verified a client certificate (ssl + ssl-ca)
+        conn.peercert = writer.get_extra_info("peercert")
         self.connections.add(conn)
         tasks: set[asyncio.Task] = set()
         wlock = asyncio.Lock()
@@ -635,7 +683,8 @@ class BrickServer:
                 is_mgmt = self._is_mgmt(creds or {}, top)
                 ok = is_mgmt or (
                     self._addr_ok(conn.peer_addr, top)
-                    and self._login_ok(creds or {}, top))
+                    and self._login_ok(creds or {}, top)
+                    and self._ssl_cn_ok(conn, top))
                 if not ok:
                     log.warning(7, "handshake refused from %s (%r)",
                                 conn.peer_addr, args[0])
@@ -647,7 +696,9 @@ class BrickServer:
                 conn.is_mgmt = is_mgmt
                 conn.top, conn.graph = top, graph
                 conn.compress = bool((creds or {}).get("compress"))
-                return wire.MT_REPLY, {"volume": top.name, "ok": True}
+                return wire.MT_REPLY, {"volume": top.name, "ok": True,
+                                       "compound":
+                                           self._compound_on(top)}
             if not conn.authed:
                 # SETVOLUME gates everything — pings included (no
                 # pre-auth liveness probing; server.c refuses requests
@@ -690,6 +741,42 @@ class BrickServer:
                                            "reason": "no graph handle"}
                 ok = graph.apply_volfile(args[0])
                 return wire.MT_REPLY, {"ok": ok}
+            if fop_name in ("__compound__", "compound"):
+                # the compound dispatcher: the whole chain executes
+                # through the brick graph inside THIS request's single
+                # backpressure slot (it was admitted as one fop), and
+                # the client gets one reply frame carrying the per-link
+                # vector.  A brick with compound-fops off refuses with
+                # EOPNOTSUPP, which the client treats as "peer speaks
+                # singles only" (mixed-version fallback).
+                from ..rpc import compound as cfop
+
+                if not self._compound_on(top):
+                    raise FopError(errno.EOPNOTSUPP,
+                                   "compound fops disabled")
+                links = cfop.validate(conn.resolve(args[0] if args
+                                                   else []))
+                for _lf, largs, lkw in links:
+                    _scope_owner(largs, lkw, conn.identity)
+                wire.CURRENT_CLIENT.set(conn.identity)
+                # one handle-farm transaction per chain: batch the
+                # posix sidecar journal around the WHOLE dispatch, so
+                # the syscall coalescing holds even when a mid-graph
+                # layer (locks, a cluster layer) decomposed the chain
+                from contextlib import ExitStack
+
+                from ..core.layer import walk
+
+                with ExitStack() as stack:
+                    for layer in walk(top):
+                        jb = getattr(layer, "journal_batch", None)
+                        if jb is not None:
+                            stack.enter_context(jb())
+                    replies = await top.compound(
+                        links, (kwargs or {}).get("xdata"))
+                return wire.MT_REPLY, [
+                    [st, conn.wrap(val)] if st == "ok" else [st, val]
+                    for st, val in replies]
             if fop_name not in _FOPS and fop_name not in _RPC_EXTRAS:
                 raise FopError(95, f"unknown fop {fop_name!r}")
             fn = getattr(top, fop_name, None)

@@ -152,6 +152,91 @@ def test_tls_brick(tmp_path, tls_cert):
     asyncio.run(run())
 
 
+@pytest.fixture(scope="module")
+def tls_pki(tmp_path_factory):
+    """A CA, a CA-signed brick cert, and two CA-signed client certs
+    with different CNs — the auth.ssl-allow test matrix."""
+    d = tmp_path_factory.mktemp("pki")
+    ca_key, ca_cert = str(d / "ca.key"), str(d / "ca.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", ca_key, "-out", ca_cert, "-days", "2", "-subj",
+         "/CN=gftpu-ca"], check=True, capture_output=True)
+
+    def signed(cn: str) -> tuple[str, str]:
+        key, csr = str(d / f"{cn}.key"), str(d / f"{cn}.csr")
+        crt = str(d / f"{cn}.pem")
+        subprocess.run(
+            ["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", csr, "-subj", f"/CN={cn}"],
+            check=True, capture_output=True)
+        subprocess.run(
+            ["openssl", "x509", "-req", "-in", csr, "-CA", ca_cert,
+             "-CAkey", ca_key, "-CAcreateserial", "-out", crt,
+             "-days", "2"], check=True, capture_output=True)
+        return crt, key
+
+    return {"ca": ca_cert, "brick": signed("brick"),
+            "good": signed("good-client"), "evil": signed("evil-client")}
+
+
+def test_tls_cn_allow_list(tmp_path, tls_pki):
+    """auth.ssl-allow (reference server.c:1857): a VALID CA-signed cert
+    with the wrong CN is refused at SETVOLUME; the allowed CN gets full
+    fop access over the same listener."""
+    bcert, bkey = tls_pki["brick"]
+
+    async def run():
+        server = await serve_brick(_auth_brick(**{
+            "ssl": "on", "ssl-cert": bcert, "ssl-key": bkey,
+            "ssl-ca": tls_pki["ca"],
+            "ssl-allow": "good-*"}).format(dir=tmp_path / "b"))
+
+        def tls_client(cert, key):
+            return _mk_client(server.port, ssl="on",
+                              **{"ssl-ca": tls_pki["ca"],
+                                 "ssl-cert": cert, "ssl-key": key})
+
+        # valid certificate, wrong identity: transport refused
+        g0 = tls_client(*tls_pki["evil"])
+        c0 = Client(g0)
+        await c0.mount()
+        assert not await _wait(lambda: g0.top.connected, timeout=1.5)
+        await c0.unmount()
+        # allow-listed CN: full access
+        g1 = tls_client(*tls_pki["good"])
+        c1 = Client(g1)
+        await c1.mount()
+        assert await _wait(lambda: g1.top.connected)
+        await c1.write_file("/cn", b"identified")
+        assert await c1.read_file("/cn") == b"identified"
+        await c1.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_tls_cn_allow_list_requires_verified_cert(tmp_path, tls_pki):
+    """ssl-allow with NO client-cert verification configured (no
+    ssl-ca) fails closed: without a verified peer identity nothing
+    matches the list."""
+    bcert, bkey = tls_pki["brick"]
+
+    async def run():
+        server = await serve_brick(_auth_brick(**{
+            "ssl": "on", "ssl-cert": bcert, "ssl-key": bkey,
+            "ssl-allow": "good-*"}).format(dir=tmp_path / "b"))
+        g = _mk_client(server.port, ssl="on",
+                       **{"ssl-ca": tls_pki["ca"]})
+        c = Client(g)
+        await c.mount()
+        assert not await _wait(lambda: g.top.connected, timeout=1.5)
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
 def test_unknown_remote_subvolume_explicit_error(tmp_path):
     """A handshake naming a subvolume that exists nowhere in the brick
     graph fails with an explicit unknown-remote-subvolume error

@@ -1,0 +1,421 @@
+"""Compound-fop pipeline: fused chains on the wire, reply-vector
+semantics, short-circuit fd hygiene, mixed-version fallback, and the
+volume key (rpc/compound.py; ISSUE 2 tentpole).
+
+The headline here is the wire-frame-counting proof: a small-file
+create+write costs ~4 RPC round trips as singles (create, fstat,
+writev, flush) and ONE as a chain with cluster.use-compound-fops on.
+"""
+
+import asyncio
+import errno
+import os
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc, walk
+from glusterfs_tpu.daemon import serve_brick
+from glusterfs_tpu.rpc import compound as cfop
+
+from .harness import BRICK_VOLFILE
+
+CLIENT_VOLFILE = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume locks
+    option compound-fops {cf}
+end-volume
+
+volume wb
+    type performance/write-behind
+    option compound-fops {cf}
+    subvolumes c0
+end-volume
+"""
+
+
+async def _wait_connected(layer, timeout=10.0):
+    for _ in range(int(timeout / 0.05)):
+        if layer.connected:
+            return True
+        await asyncio.sleep(0.05)
+    return layer.connected
+
+
+async def _mounted(tmp_path, cf="on", brick_opts=""):
+    brick = BRICK_VOLFILE.format(dir=tmp_path / "b")
+    if brick_opts:
+        brick += ("\nvolume srv\n    type protocol/server\n"
+                  f"{brick_opts}    subvolumes locks\nend-volume\n")
+    server = await serve_brick(brick)
+    g = Graph.construct(CLIENT_VOLFILE.format(port=server.port, cf=cf)
+                        .replace("remote-subvolume locks",
+                                 "remote-subvolume srv")
+                        if brick_opts else
+                        CLIENT_VOLFILE.format(port=server.port, cf=cf))
+    c = Client(g)
+    await c.mount()
+    cl = next(l for l in walk(g.top)
+              if l.type_name == "protocol/client")
+    assert await _wait_connected(cl)
+    return server, c, cl
+
+
+def test_create_write_roundtrips(tmp_path):
+    """ISSUE 2 acceptance bar: small-file create+write drops from ~4
+    RPC round trips to <=2 (measured: 1) with compound fops on."""
+    async def run():
+        server, c, cl = await _mounted(tmp_path, cf="on")
+        base = cl.rpc_roundtrips
+        await c.write_file("/one", b"z" * 4096)
+        fused = cl.rpc_roundtrips - base
+        assert await c.read_file("/one") == b"z" * 4096
+        await c.unmount()
+        await server.stop()
+
+        server, c, cl = await _mounted(tmp_path / "off", cf="off")
+        base = cl.rpc_roundtrips
+        await c.write_file("/one", b"z" * 4096)
+        singles = cl.rpc_roundtrips - base
+        assert await c.read_file("/one") == b"z" * 4096
+        await c.unmount()
+        await server.stop()
+
+        assert fused <= 2, f"compound path took {fused} round trips"
+        assert singles >= 3, \
+            f"singles baseline took only {singles} round trips"
+        assert fused < singles
+
+    asyncio.run(run())
+
+
+def test_reply_vector_maps_links_one_to_one(tmp_path):
+    """Every link gets exactly one vector entry, in order, with the
+    chain-released fd stripped to None (it no longer exists)."""
+    async def run():
+        server, c, cl = await _mounted(tmp_path)
+        replies = await c.graph.top.compound([
+            ("create", (Loc("/v"), os.O_RDWR | os.O_EXCL, 0o644), {}),
+            ("writev", (cfop.FdRef(0), b"vector" * 800, 0), {}),
+            ("flush", (cfop.FdRef(0),), {}),
+            ("release", (cfop.FdRef(0),), {}),
+        ])
+        assert len(replies) == 4
+        assert [st for st, _ in replies] == ["ok"] * 4
+        created = replies[0][1]
+        assert created[0] is None  # released in-chain: never escapes
+        assert created[1].size == 0 or hasattr(created[1], "gfid")
+        postbuf = replies[1][1]
+        assert postbuf.size == 4800  # writev postbuf reflects the write
+        # no fd-table entry survived the chain on the brick (checked
+        # BEFORE read_file, whose own release is fire-and-forget)
+        assert all(not conn.fds for conn in server.connections)
+        assert await c.read_file("/v") == b"vector" * 800
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_short_circuit_leaves_no_orphan_fd(tmp_path):
+    """A mid-chain error skips the rest, reports per-link status, and
+    releases every fd the chain created — brick fd tables stay empty
+    and the client sees no half-open handle."""
+    async def run():
+        server, c, cl = await _mounted(tmp_path)
+        replies = await c.graph.top.compound([
+            ("create", (Loc("/sc"), os.O_RDWR | os.O_EXCL, 0o644), {}),
+            ("open", (Loc("/definitely-missing"), os.O_RDONLY), {}),
+            ("writev", (cfop.FdRef(0), b"never", 0), {}),
+        ])
+        assert [st for st, _ in replies] == ["ok", "err", "skip"]
+        assert isinstance(replies[1][1], FopError)
+        assert replies[1][1].err == errno.ENOENT
+        # the created fd was cleaned up server-side: stripped from the
+        # reply AND retired from the per-connection fd table
+        assert cfop.fd_of(replies[0][1]) is None
+        assert all(not conn.fds for conn in server.connections)
+        # the create itself applied (POSIX partial application), but
+        # the skipped writev did not
+        f = await c.open("/sc", os.O_RDONLY)
+        try:
+            assert await f.read(64, 0) == b""
+        finally:
+            await f.close()
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_mixed_version_fallback_to_singles(tmp_path):
+    """A brick that doesn't advertise compound (compound-fops off =
+    the downgraded-peer stand-in) gets plain single fops from a
+    compound-enabled client — same results, more round trips."""
+    async def run():
+        server, c, cl = await _mounted(
+            tmp_path, cf="on",
+            brick_opts="    option compound-fops off\n")
+        assert not cl._peer_compound
+        base = cl.rpc_roundtrips
+        await c.write_file("/fb", b"fallback")
+        assert cl.rpc_roundtrips - base >= 3  # decomposed into singles
+        assert await c.read_file("/fb") == b"fallback"
+        # direct chains decompose client-side too, same reply contract
+        replies = await c.graph.top.compound([
+            ("create", (Loc("/fb2"), os.O_RDWR | os.O_EXCL, 0o644), {}),
+            ("writev", (cfop.FdRef(0), b"fb2", 0), {}),
+            ("release", (cfop.FdRef(0),), {}),
+        ])
+        assert [st for st, _ in replies] == ["ok"] * 3
+        assert await c.read_file("/fb2") == b"fb2"
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_peer_downgrade_mid_connection(tmp_path):
+    """A brick reconfigured to refuse chains mid-connection answers
+    EOPNOTSUPP once; the client remembers and decomposes from then on
+    (graceful per-peer fallback, no error surfaces to the caller)."""
+    async def run():
+        server, c, cl = await _mounted(
+            tmp_path, cf="on",
+            brick_opts="    option compound-fops on\n")
+        assert cl._peer_compound
+        # flip the server off underneath the live connection (the
+        # protocol/server top re-reads the option per request)
+        server.top.opts["compound-fops"] = False
+        await c.write_file("/after-downgrade", b"still works")
+        assert await c.read_file("/after-downgrade") == b"still works"
+        assert not cl._peer_compound  # remembered the refusal
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_chain_validation():
+    """Malformed chains are refused up front with EINVAL."""
+    with pytest.raises(FopError):
+        cfop.validate([])
+    with pytest.raises(FopError):
+        cfop.validate([("writev", (cfop.FdRef(0), b"x", 0), {})])  # fwd ref
+    with pytest.raises(FopError):
+        cfop.validate([("not-a-fop", (), {})])
+    with pytest.raises(FopError):
+        cfop.validate([("compound", ([],), {})])  # no nesting
+    with pytest.raises(FopError):
+        # release may only target an in-chain fd
+        cfop.validate([("release", ("something",), {})])
+    with pytest.raises(FopError):
+        cfop.validate([("stat", (Loc("/x"),), {})] * (cfop.MAX_LINKS + 1))
+
+
+def test_lock_fops_never_fused(tmp_path):
+    """Chains carrying lock fops decompose at the client so the
+    reconnect lock-replay bookkeeping in fop_call stays authoritative."""
+    async def run():
+        server, c, cl = await _mounted(tmp_path)
+        await c.write_file("/lk", b"data")
+        base = cl.rpc_roundtrips
+        replies = await c.graph.top.compound([
+            ("inodelk", ("dom", Loc("/lk"), "lock"),
+             {"xdata": {"lk-owner": b"o1"}}),
+            ("inodelk", ("dom", Loc("/lk"), "unlock"),
+             {"xdata": {"lk-owner": b"o1"}}),
+        ])
+        assert [st for st, _ in replies] == ["ok", "ok"]
+        assert cl.rpc_roundtrips - base == 2  # one frame per lock fop
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_posix_journal_batching(tmp_path):
+    """Brick-side: a chained create+writev+fsetattr lands as ONE
+    journal append (one handle-farm transaction), and the journaled
+    state survives a cold restart (drop_caches replay)."""
+    from glusterfs_tpu.storage.posix import PosixLayer
+
+    async def run():
+        posix = PosixLayer("p", {"directory": str(tmp_path / "pb")})
+        await posix.init()
+        try:
+            writes = []
+            real_write = os.write
+
+            def counting_write(fd, data):
+                if fd == posix._xa_journal_fd:
+                    writes.append(bytes(data))
+                return real_write(fd, data)
+
+            import glusterfs_tpu.storage.posix as posix_mod
+
+            posix_mod.os.write = counting_write
+            try:
+                replies = await posix.compound([
+                    ("create",
+                     (Loc("/j"), os.O_RDWR | os.O_EXCL, 0o644),
+                     {"xdata": {"init-xattrs": {"trusted.v": b"\x01"}}}),
+                    ("writev", (cfop.FdRef(0), b"journal", 0), {}),
+                    ("fsetattr", (cfop.FdRef(0), {"mode": 0o600}), {}),
+                    ("release", (cfop.FdRef(0),), {}),
+                ])
+            finally:
+                posix_mod.os.write = real_write
+            assert [st for st, _ in replies] == ["ok"] * 4
+            journal_appends = [w for w in writes if b'"' in w]
+            assert len(journal_appends) == 1, \
+                f"expected one batched append, saw {len(journal_appends)}"
+            assert journal_appends[0].count(b"\n") >= 2  # bind + xattrs
+            # the batched journal replays to the same state
+            posix.drop_caches()
+            ia = await posix.stat(Loc("/j"))
+            assert ia.mode & 0o777 == 0o600
+            xa = await posix.getxattr(Loc("/j"), "trusted.v")
+            assert xa["trusted.v"] == b"\x01"
+        finally:
+            await posix.fini()
+
+    asyncio.run(run())
+
+
+def test_server_batches_journal_around_dispatch(tmp_path):
+    """The brick wraps every compound dispatch in the posix journal
+    batch, so the handle-farm coalescing holds even though the locks
+    layer above posix decomposes the chain."""
+    from glusterfs_tpu.storage.posix import PosixLayer
+
+    async def run():
+        server, c, cl = await _mounted(tmp_path)
+        posix = next(l for l in walk(server.top)
+                     if isinstance(l, PosixLayer))
+        entered = []
+        orig = PosixLayer.journal_batch
+
+        def spying(self):
+            entered.append(True)
+            return orig(self)
+
+        PosixLayer.journal_batch = spying
+        try:
+            await c.write_file("/jb", b"batched")
+        finally:
+            PosixLayer.journal_batch = orig
+        assert entered, "server did not enter the posix journal batch"
+        assert posix._jrnl_batch is None  # batch closed after dispatch
+        assert await c.read_file("/jb") == b"batched"
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_compound_on_managed_graph_parity(tmp_path):
+    """End-to-end through a full managed client stack (perf layers +
+    cluster) on an in-process disperse volume: chains decompose where
+    layers demand it and results stay byte-identical."""
+    from glusterfs_tpu.utils.volspec import ec_volfile
+
+    async def run():
+        spec = ec_volfile(str(tmp_path), 6, 2)
+        # arm compound at the graph edge the way volgen would
+        spec = spec.replace("type cluster/disperse",
+                            "type cluster/disperse\n"
+                            "    option cpu-extensions native")
+        g = Graph.construct(spec + """
+volume wbtop
+    type performance/write-behind
+    option compound-fops on
+    subvolumes disp
+end-volume
+""")
+        c = Client(g)
+        await c.mount()
+        for i in range(4):
+            await c.write_file(f"/m{i}", os.urandom(3000 + i))
+        datas = [await c.read_file(f"/m{i}") for i in range(4)]
+        assert [len(d) for d in datas] == [3000, 3001, 3002, 3003]
+        st = await c.stat("/m3")
+        assert st.size == 3003
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_volgen_compound_key_reaches_all_ends():
+    """cluster.use-compound-fops lands on protocol/client,
+    performance/write-behind and protocol/server alike."""
+    from glusterfs_tpu.mgmt import volgen
+
+    volinfo = {
+        "name": "cv", "type": "distribute",
+        "bricks": [{"name": "cv-brick-0", "host": "127.0.0.1",
+                    "path": "/tmp/cvb", "index": 0, "port": 0}],
+        "options": {"cluster.use-compound-fops": "on"},
+    }
+    cvol = volgen.build_client_volfile(volinfo)
+    bvol = volgen.build_brick_volfile(volinfo, volinfo["bricks"][0])
+    client_stanza = cvol.split("volume cv-client-0")[1] \
+                        .split("end-volume")[0]
+    wb_stanza = cvol.split("volume cv-write-behind")[1] \
+                    .split("end-volume")[0]
+    srv_stanza = bvol.split("volume cv-brick-0-server")[1] \
+                     .split("end-volume")[0]
+    for stanza in (client_stanza, wb_stanza, srv_stanza):
+        assert "compound-fops on" in stanza
+    # and it is op-version gated like every cross-version key
+    assert volgen.OPTION_MIN_OPVERSION["cluster.use-compound-fops"] == 5
+
+
+def test_wb_fused_ftruncate_resets_logical_end(tmp_path):
+    """A fused ftruncate through write-behind must reset the absorbed-
+    bytes high-water mark — otherwise later write replies inflate a
+    shrunk file's size and upper caches serve the stale length."""
+    async def run():
+        server, c, cl = await _mounted(tmp_path)
+        f = await c.create("/le", os.O_RDWR)
+        await f.write(b"x" * 100_000, 0)   # logical_end = 100000
+        # the fuse SETATTR shape: ftruncate+setattr as one chain
+        replies = await c.graph.top.compound([
+            ("ftruncate", (f.fd, 10), {}),
+            ("setattr", (Loc("/le"), {"mode": 0o600}), {})])
+        assert [st for st, _ in replies] == ["ok", "ok"]
+        ia = await c.graph.top.writev(f.fd, b"tiny", 0)
+        assert ia.size == 10, ia.size  # not inflated back to 100000
+        await f.close()
+        st = await c.stat("/le")
+        assert st.size == 10
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_wb_window_flush_is_one_chain(tmp_path):
+    """A multi-chunk write-behind window + the flush that drains it
+    ride one compound frame (flushed windows as chains)."""
+    async def run():
+        server, c, cl = await _mounted(tmp_path)
+        f = await c.create("/win", os.O_RDWR)
+        # two DISJOINT chunks so the window holds two entries
+        await f.write(b"a" * 100, 0)
+        await f.write(b"b" * 100, 5000)
+        base = cl.rpc_roundtrips
+        await f.close()  # flush drains the window
+        assert cl.rpc_roundtrips - base == 1
+        got = await c.read_file("/win")
+        assert got[:100] == b"a" * 100
+        assert got[5000:5100] == b"b" * 100
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())

@@ -169,6 +169,17 @@ def test_option_map_integrity():
                 assert any(o.name == opt for o in cls.OPTIONS), \
                     f"{key}: {t} lacks option {opt!r}"
     pseudo.add("__transport__")
+    # the compound key must exist on every fusion end it arms
+    for key, (ltype, opt) in volgen.OPTION_MAP.items():
+        if ltype == "__compound__":
+            from glusterfs_tpu.core.layer import lookup_type
+
+            for t in ("protocol/client", "protocol/server",
+                      "performance/write-behind"):
+                cls = lookup_type(t)
+                assert any(o.name == opt for o in cls.OPTIONS), \
+                    f"{key}: {t} lacks option {opt!r}"
+    pseudo.add("__compound__")
     missing = []
     for key, (ltype, opt) in volgen.OPTION_MAP.items():
         if ltype in pseudo:

@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# flake_gate.sh — the standing deflake check (VERDICT r5 "Next round" #5):
+# run the tier-1 suite twice back-to-back and diff the failure sets.
+#
+#   tests failing in BOTH runs   -> real breakage (reported, exit 1)
+#   tests failing in ONE run only -> flakes (reported, exit 2)
+#   identical green runs          -> exit 0
+#
+# Usage:  tools/flake_gate.sh [extra pytest args...]
+# The tier-1 invocation mirrors ROADMAP.md's "Tier-1 verify" line.
+
+set -u
+cd "$(dirname "$0")/.."
+
+run_dir=$(mktemp -d /tmp/flake_gate.XXXXXX)
+trap 'rm -rf "$run_dir"' EXIT
+
+tier1() {
+    local log="$1"; shift
+    timeout -k 10 870 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly "$@" 2>&1 | tee "$log" >/dev/null
+}
+
+fails() {  # FAILED/ERROR node ids from a pytest -q log, sorted
+    grep -aE '^(FAILED|ERROR) ' "$1" | awk '{print $2}' | sort -u
+}
+
+echo "flake gate: run 1/2..."
+tier1 "$run_dir/run1.log" "$@"
+echo "flake gate: run 2/2..."
+tier1 "$run_dir/run2.log" "$@"
+
+fails "$run_dir/run1.log" > "$run_dir/f1"
+fails "$run_dir/run2.log" > "$run_dir/f2"
+
+stable=$(comm -12 "$run_dir/f1" "$run_dir/f2")
+flaky=$(comm -3 "$run_dir/f1" "$run_dir/f2" | tr -d '\t' | sort -u)
+
+for log in 1 2; do
+    tail -1 "$run_dir/run$log.log" | sed "s/^/run $log: /"
+done
+
+rc=0
+if [ -n "$stable" ]; then
+    echo "STABLE FAILURES (both runs):"
+    echo "$stable" | sed 's/^/  /'
+    rc=1
+fi
+if [ -n "$flaky" ]; then
+    echo "FLAKY (failed in exactly one run):"
+    echo "$flaky" | sed 's/^/  /'
+    [ $rc -eq 0 ] && rc=2
+fi
+[ $rc -eq 0 ] && echo "flake gate: two consecutive identical green runs"
+exit $rc
