@@ -179,6 +179,10 @@ def volume_bench(n_clients: int = 16, file_mib: int = 1,
         stats = ec.codec.dump_stats()
         for key in ("launches", "batched_fops", "cpu_launches"):
             stats[key] -= warm.get(key, 0)
+        # read fan-out split (ISSUE 3): fast > 0 is the on-record proof
+        # that the zero-staging reassembly lane served the reads (only
+        # systematic volumes qualify; the default format stays staged)
+        stats["read_fanout"] = dict(ec.read_fanout)
         return t_w, t_r, stats
 
     t_w, t_r, stats = _on_mounted_volume(body, backend,
@@ -200,6 +204,9 @@ def volume_bench(n_clients: int = 16, file_mib: int = 1,
         out[f"{prefix}_break_even_KiB"] = stats["break_even_bytes"] // 1024
     if stats.get("cpu_launches") is not None:
         out[f"{prefix}_cpu_routed_flushes"] = stats["cpu_launches"]
+    fo = stats.get("read_fanout") or {}
+    out[f"{prefix}_read_fanout_fast"] = fo.get("fast", 0)
+    out[f"{prefix}_read_fanout_staged"] = fo.get("staged", 0)
     return out
 
 
@@ -377,7 +384,7 @@ def smallfile_wire_bench(n_files: int = 150) -> dict:
 
 def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
                     compound: str = "on", fuse: bool = True,
-                    prefix: str = "") -> dict:
+                    prefix: str = "", zero_copy: str = "on") -> dict:
     """Through-the-wire AND through-the-mount numbers (the reference's
     baseline workloads — dd/iozone/glfs-bm, extras/benchmarking/README —
     all run through the full stack, never in-process):
@@ -388,8 +395,11 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
       /dev/fuse, driven with plain file I/O.
 
     ``compound`` sets cluster.use-compound-fops on the served volume
-    (write-behind window flushes then ride fused chains); ``fuse=False``
-    + a ``prefix`` gives a cheap wire-only comparison pass.
+    (write-behind window flushes + read-ahead demand/window chains ride
+    fused frames); ``zero_copy`` sets network.zero-copy-reads
+    (scatter-gather reply frames, ISSUE 3 — together with ``compound``
+    this is the read-pipeline on/off switch); ``fuse=False`` + a
+    ``prefix`` gives a cheap wire-only comparison pass.
     """
     import asyncio
     import os
@@ -421,6 +431,9 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
                 await c.call("volume-set", name="bw",
                              key="cluster.use-compound-fops",
                              value=compound)
+                await c.call("volume-set", name="bw",
+                             key="network.zero-copy-reads",
+                             value=zero_copy)
             cl = await mount_volume(d.host, d.port, "bw")
             try:
                 # calibrate the stripe-cache router OFF the clock: its
@@ -441,11 +454,32 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
                     cl.write_file(f"/w{i}", payload)
                     for i in range(n_clients)))
                 t_w = time.perf_counter() - t0
+                from glusterfs_tpu.rpc import wire as _wire
+
+                blobs0 = dict(_wire.blob_stats)
                 t0 = time.perf_counter()
                 datas = await asyncio.gather(*(
                     cl.read_file(f"/w{i}") for i in range(n_clients)))
                 t_r = time.perf_counter() - t0
                 assert all(x == payload for x in datas), "wire parity"
+                # lane-volume rows: fragment bytes that arrived on the
+                # blob lane during the read phase (nothing crawled the
+                # tagged codec), and the EC fan-out split.  NOTE: not
+                # an on/off discriminator — single-blob replies ride
+                # the lane either way, and this volume's default
+                # (non-systematic) format always stages; the fast-lane
+                # engagement proof is volume_sys_native_read_fanout_*
+                # and the chain proof is the RT-counting tests
+                out[f"{prefix}wire_read_blob_MiB"] = round(
+                    (_wire.blob_stats["rx_bytes"]
+                     - blobs0["rx_bytes"]) / MIB, 1)
+                for layer in walk(cl.graph.top):
+                    fo = getattr(layer, "read_fanout", None)
+                    if fo is not None:
+                        out[f"{prefix}wire_read_fanout_fast"] = fo["fast"]
+                        out[f"{prefix}wire_read_fanout_staged"] = \
+                            fo["staged"]
+                        break
             finally:
                 await cl.unmount()
             total = n_clients * file_mib
@@ -943,6 +977,15 @@ def main() -> None:
             vol.update(volume_bench(
                 prefix="volume_device_nonsys", passes=1,
                 extra_options={"stripe-cache-min-batch": "0"}))
+        else:
+            # no device on this host: the systematic serving numbers
+            # still go on the record through the native ladder (healthy
+            # reads are pure reassembly — the zero-staging fan-out),
+            # so the device-pinned bar has a comparable CPU floor row
+            vol.update(volume_bench(
+                backend="native", prefix="volume_sys_native", passes=1,
+                extra_options={"systematic": "on"}))
+            vol["volume_device_systematic"] = False
     except Exception as e:  # volume bench is auxiliary; never sink the run
         vol["volume_bench_error"] = str(e)[:200]
     try:
@@ -974,10 +1017,12 @@ def main() -> None:
     except Exception as e:
         vol["fullstack_bench_error"] = str(e)[:200]
     try:
-        # wire-only comparison pass with compound off: the on/off pair
-        # makes the chain fusion driver-visible on the record
+        # wire-only comparison pass with the whole read/write pipeline
+        # off (no chains, no scatter-gather): the on/off pair makes the
+        # fusion + zero-copy lanes driver-visible on the record
         vol.update(fullstack_bench(compound="off", fuse=False,
-                                   prefix="nocompound_"))
+                                   prefix="nocompound_",
+                                   zero_copy="off"))
     except Exception as e:
         vol["nocompound_wire_bench_error"] = str(e)[:200]
     # a missing wire/fuse/smallfile-wire row is an EXPLICIT

@@ -473,7 +473,46 @@ class Client:
         """Whole-file read WITHOUT a leading stat wave: readv truncates
         at EOF (POSIX read semantics), so asking for a huge size in one
         call returns the file — the size probe's cluster-wide lookup
-        fan-out was pure latency on every read."""
+        fan-out was pure latency on every read.
+
+        With compound fops on (and no lazy open-behind, whose open is
+        already zero round trips), the whole pass is ONE chain —
+        lookup+open+readv+release fused into a single round trip where
+        the graph carries it (the smallfile-read hot path, the read
+        mirror of write_file's create chain)."""
+        if self._use_compound() and _norm(path) != "/" and \
+                not self._lazy_open_graph():
+            from ..rpc import compound as cfop
+
+            loc = await self._parent_loc(path)
+            replies = await self.graph.top.compound([
+                ("lookup", (loc,), {}),
+                ("open", (loc, os.O_RDONLY), {}),
+                ("readv", (cfop.FdRef(1), _READ_ALL, 0), {}),
+                ("release", (cfop.FdRef(1),), {})])
+            err = cfop.first_error(replies)
+            if err is not None:
+                raise err
+            lk = replies[0][1]
+            ia = lk[0] if isinstance(lk, (list, tuple)) else lk
+            if hasattr(ia, "gfid"):
+                self.itable.link(loc.parent, loc.name, ia.gfid,
+                                 ia.ia_type, ia)
+            data = replies[2][1]
+            out = data if isinstance(data, bytes) else bytes(data)
+            if len(out) < _READ_ALL:
+                return out
+            # improbably huge file: keep the chain's window and read
+            # on past it (re-reading from 0 would double the traffic)
+            f = await self.open(path, os.O_RDONLY)
+            try:
+                parts = [out]
+                while len(out) == _READ_ALL:
+                    out = await f.read(_READ_ALL, sum(map(len, parts)))
+                    parts.append(out)
+                return b"".join(parts)
+            finally:
+                await f.close()
         f = await self.open(path, os.O_RDONLY)
         try:
             out = await f.read(_READ_ALL, 0)

@@ -125,7 +125,9 @@ class ShardLayer(Layer):
             raise
         try:
             out = await self.children[0].readv(sfd, size, offset)
-            return out.ljust(size, b"\0")
+            # readv results may be views (EC decode buffers, wire blob
+            # lane) — own them before padding
+            return bytes(out).ljust(size, b"\0")
         finally:
             await self.children[0].release(sfd)
 
@@ -175,7 +177,7 @@ class ShardLayer(Layer):
             within = pos - idx * bs
             take = min(bs - within, end - pos)
             chunk = await self._shard_read(fd.gfid, idx, take, within, fd)
-            out += chunk.ljust(take, b"\0")  # holes read as zeros
+            out += bytes(chunk).ljust(take, b"\0")  # holes read as zeros
             pos += take
         return bytes(out)
 

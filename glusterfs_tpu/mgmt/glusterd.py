@@ -48,9 +48,10 @@ log = gflog.get_logger("mgmt")
 # this build's management op-version (xlator.h:758 / GD_OP_VERSION):
 # peers advertise theirs at probe time and the cluster operates at the
 # minimum, gating newer volume-set keys until every member upgrades
-OP_VERSION = 5  # 5: compound fops + auth.ssl-allow (volgen._V5_KEYS);
-# 4: round-5 keys (volgen._V4_KEYS); 3: the round-4
-                # option long tail (volgen._V3_KEYS)
+OP_VERSION = 6  # 6: zero-copy read pipeline + strict-locks
+                # (volgen._V6_KEYS); 5: compound fops + auth.ssl-allow
+                # (volgen._V5_KEYS); 4: round-5 keys (volgen._V4_KEYS);
+                # 3: the round-4 option long tail (volgen._V3_KEYS)
 
 
 def _new_volinfo(state: dict, name: str, vtype: str, bricks: list,

@@ -41,10 +41,18 @@ OPTION_MIN_OPVERSION = {
 OPTION_MAP = {
     "auth.allow": ("protocol/server", "auth-allow"),
     "auth.ssl-allow": ("protocol/server", "ssl-allow"),
-    # compound fop chains (rpc/compound.py): one key arms all three
+    # compound fop chains (rpc/compound.py): one key arms all four
     # ends — protocol/client (wire fusion), performance/write-behind
-    # (window flush chains) and protocol/server (serve + advertise)
+    # (window flush chains), performance/read-ahead (demand+prefetch
+    # read chains) and protocol/server (serve + advertise)
     "cluster.use-compound-fops": ("__compound__", "compound-fops"),
+    # zero-copy read pipeline (ISSUE 3): scatter-gather reply frames on
+    # both transport ends — client requests at SETVOLUME, server
+    # honors per-connection
+    "network.zero-copy-reads": ("__sg__", "sg-replies"),
+    "client.strict-locks": ("protocol/client", "strict-locks"),
+    "performance.read-ahead-adaptive": ("performance/read-ahead",
+                                        "adaptive-window"),
     "server.outstanding-rpc-limit": ("protocol/server",
                                      "outstanding-rpc-limit"),
     "auth.reject": ("protocol/server", "auth-reject"),
@@ -591,6 +599,16 @@ _V5_KEYS = (
 )
 OPTION_MIN_OPVERSION.update({k: 5 for k in _V5_KEYS})
 
+# round-7 additions ship at op-version 6: the zero-copy read pipeline
+# (scatter-gather frames change what peers must decode) plus the
+# read-side knobs that ride it
+_V6_KEYS = (
+    "network.zero-copy-reads",
+    "client.strict-locks",
+    "performance.read-ahead-adaptive",
+)
+OPTION_MIN_OPVERSION.update({k: 6 for k in _V6_KEYS})
+
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key
 DEFAULT_PERF_STACK = [
@@ -767,6 +785,7 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
     sopts = dict(layer_options(volinfo, "protocol/server"))
     sopts.update(_ssl_options(volinfo))
     sopts.update(_compound_options(volinfo))
+    sopts.update(_sg_options(volinfo))
     auth = volinfo.get("auth") or {}
     if auth:
         sopts["auth-user"] = auth["username"]
@@ -791,9 +810,17 @@ def _ssl_options(volinfo: dict) -> dict[str, Any]:
 
 def _compound_options(volinfo: dict) -> dict[str, Any]:
     """cluster.use-compound-fops lands on every fusion end: the wire
-    client, the window flusher, and the serving brick top."""
+    client, the window flusher, the read-ahead chain issuer, and the
+    serving brick top."""
     val = volinfo.get("options", {}).get("cluster.use-compound-fops")
     return {} if val is None else {"compound-fops": val}
+
+
+def _sg_options(volinfo: dict) -> dict[str, Any]:
+    """network.zero-copy-reads lands on both transport ends (client
+    requests scatter-gather replies at SETVOLUME, server honors)."""
+    val = volinfo.get("options", {}).get("network.zero-copy-reads")
+    return {} if val is None else {"sg-replies": val}
 
 
 def build_client_volfile(volinfo: dict,
@@ -820,6 +847,7 @@ def build_client_volfile(volinfo: dict,
         opts.update(layer_options(volinfo, "protocol/client"))
         opts.update(_ssl_options(volinfo))
         opts.update(_compound_options(volinfo))
+        opts.update(_sg_options(volinfo))
         # a TLS brick implies TLS clients (admins set server.ssl once)
         if _enabled(volinfo, "server.ssl", False):
             opts["ssl"] = "on"
@@ -922,6 +950,14 @@ def build_client_volfile(volinfo: dict,
         out.append(_emit(f"{vname}-acl", "system/posix-acl", {}, [top]))
         top = f"{vname}-acl"
 
+    # EC stripe geometry: page-granular read layers must issue their
+    # windows in whole stripes, or every window edge pays a
+    # partial-stripe decode (the read-side RMW analog, ISSUE 3)
+    ec_stripe = 0
+    if vtype == "disperse":
+        g = volinfo.get("group-size") or len(bricks)
+        ec_stripe = (g - volinfo.get("redundancy", 2)) * 512
+
     for ltype, key, default in DEFAULT_PERF_STACK:
         # performance.<x>-pass-through (the reference's per-xlator
         # pass_through flag): the layer is simply not built into the
@@ -936,9 +972,19 @@ def build_client_volfile(volinfo: dict,
         if on and not _enabled(volinfo, pt, False):
             lname = f"{volinfo['name']}-{ltype.split('/')[1]}"
             lopts = layer_options(volinfo, ltype)
-            if ltype == "performance/write-behind":
-                # the window flusher is a compound emission site
+            if ltype in ("performance/write-behind",
+                         "performance/read-ahead"):
+                # window flusher + demand/prefetch reader are the
+                # compound emission sites
                 lopts.update(_compound_options(volinfo))
+            if ec_stripe and ltype in ("performance/read-ahead",
+                                       "performance/io-cache") and \
+                    "page-size" not in lopts:
+                # largest stripe multiple <= the 128KB default: windows
+                # land on stripe boundaries, so EC decodes whole
+                # stripes instead of partial edges
+                lopts["page-size"] = str(
+                    max(ec_stripe, (128 << 10) // ec_stripe * ec_stripe))
             out.append(_emit(lname, ltype, lopts, [top]))
             top = lname
     if _enabled(volinfo, "performance.client-io-threads", False) and \

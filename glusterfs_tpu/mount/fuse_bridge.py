@@ -36,6 +36,7 @@ from ..core import gflog
 from ..core.fops import FopError
 from ..core.iatt import IAType, Iatt
 from ..core.layer import FdObj, Loc
+from ..rpc.wire import SGBuf
 from . import fuse_proto as fp
 
 log = gflog.get_logger("fuse")
@@ -196,10 +197,14 @@ class FuseBridge:
                                  -error, unique)
         try:
             # vectored: read payloads arrive as memoryviews into the
-            # RPC frame (wire blob lane) — writev ships them to the
-            # kernel without a concat copy (and bytes+memoryview would
-            # TypeError anyway)
-            os.writev(self.dev_fd, (hdr, data))
+            # RPC frame (wire blob lane) or as scatter-gather segment
+            # vectors (SGBuf) — writev ships them to the kernel without
+            # a concat copy (and bytes+memoryview would TypeError
+            # anyway)
+            if isinstance(data, SGBuf):
+                os.writev(self.dev_fd, (hdr, *data.segments))
+            else:
+                os.writev(self.dev_fd, (hdr, data))
         except OSError:
             pass  # request raced an unmount/interrupt
 

@@ -329,6 +329,39 @@ class Codec:
                                     systematic=True)
         return gf256.ref_encode(data, self.k, self.n, systematic=True)
 
+    def reassemble(self, bufs, rows, frag_len: int) -> np.ndarray | None:
+        """Healthy systematic fast path straight from fragment BUFFERS
+        (the zero-staging lane of the read fan-out, ISSUE 3): when every
+        data row survived, the answer is a pure interleave — each
+        received buffer is written once, directly into its chunk
+        positions of the output, with no intermediate ``frags`` staging
+        array.  Buffers shorter than ``frag_len`` zero-fill (sparse
+        tails, mirroring the EC layer's staging semantics).
+
+        Returns the assembled stripe-major bytes, or None when this
+        codec/row-set doesn't qualify (non-systematic, or a data row is
+        missing) — the caller then stages and decodes."""
+        if not self.systematic or sorted(int(r) for r in rows) != \
+                list(range(self.k)):
+            return None
+        k, c = self.k, self.fragment_chunk
+        if frag_len % c:
+            raise ValueError(f"frag_len {frag_len} not a multiple of {c}")
+        s = frag_len // c
+        out = np.empty((s, k, c), dtype=np.uint8)
+        for row, buf in zip(rows, bufs):
+            a = np.frombuffer(buf, dtype=np.uint8)
+            dst = out[:, int(row), :]
+            whole = a.size // c
+            rem = a.size % c
+            if whole:
+                dst[:whole] = a[: whole * c].reshape(whole, c)
+            if rem:
+                dst[whole, :rem] = a[whole * c:]
+                dst[whole, rem:] = 0
+            dst[whole + (1 if rem else 0):] = 0
+        return out.reshape(-1)
+
     def _decode_systematic(self, frags: np.ndarray, rows) -> np.ndarray:
         k, c = self.k, self.fragment_chunk
         s = frags.shape[1] // c

@@ -11,6 +11,7 @@ import collections
 
 from ..core.layer import FdObj, Layer, Loc, register
 from ..core.options import Option
+from ..rpc.wire import as_single_buffer, serve_pages
 
 
 @register("performance/io-cache")
@@ -169,15 +170,19 @@ class IoCacheLayer(Layer):
             m0, m1 = missing[0], missing[-1]
             # one span read covering every missing page (holes between
             # cached pages re-read cheaply vs extra round trips)
-            data = await self.children[0].readv(
+            raw = await self.children[0].readv(
                 fd, (m1 - m0 + 1) * psz, m0 * psz, xdata)
-            data = bytes(data) if not isinstance(data, bytes) else data
+            # per-page bytes() copies give the cache OWNED pages (a
+            # memoryview off the wire blob lane would pin its whole RPC
+            # frame for the cache's lifetime); the serve path below
+            # references these pages zero-copy
+            data = memoryview(as_single_buffer(raw))
             maxsz = self.opts["max-file-size"]
             minsz = self.opts["min-file-size"]
             self._prio.setdefault(fd.gfid,
                                   self._priority_of(fd.path))
             for i in range(m0, m1 + 1):
-                page = data[(i - m0) * psz: (i - m0 + 1) * psz]
+                page = bytes(data[(i - m0) * psz: (i - m0 + 1) * psz])
                 pages[i] = page
                 if not maxsz or (i + 1) * psz <= maxsz:
                     # cache-max-file-size: the tail of a huge file
@@ -197,22 +202,11 @@ class IoCacheLayer(Layer):
                 import time
 
                 self._seen[fd.gfid] = (None, time.monotonic())
-        out = bytearray()
-        pos = offset
-        while pos < end:
-            idx = pos // psz
-            page = pages.get(idx)
-            if page is None:
-                break  # EOF
-            start = pos - idx * psz
-            if start >= len(page):
-                break  # EOF inside this page
-            take = page[start: min(len(page), start + (end - pos))]
-            out += take
-            if len(page) < psz:  # short page = EOF
-                break
-            pos += len(take)
-        return bytes(out)
+        # serve as a scatter-gather vector of page VIEWS: pages are
+        # immutable bytes, so segments stay valid past eviction and the
+        # reply crosses the stack (and the wire, and /dev/fuse) without
+        # ever being joined here (ioc_frame_fill builds the same iovec)
+        return serve_pages(pages, offset, end, psz)
 
     async def writev(self, fd: FdObj, data, offset: int,
                      xdata: dict | None = None):

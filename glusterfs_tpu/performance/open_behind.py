@@ -63,6 +63,18 @@ class OpenBehindLayer(Layer):
             return fd  # not ours (e.g. create path)
         if ctx.real_fd is None:
             ctx.real_fd = await self.children[0].open(ctx.loc, ctx.flags)
+            if ctx.anon_fd is not None:
+                # retire the anonymous stand-in BEFORE the first fop on
+                # the real fd: downstream per-fd state keyed on it — a
+                # read-ahead window with an in-flight prefetch task —
+                # must not keep racing I/O against the now-materialized
+                # open (stale prefetched pages would otherwise survive
+                # a write that only invalidates the REAL fd's window)
+                anon, ctx.anon_fd = ctx.anon_fd, None
+                try:
+                    await self.children[0].release(anon)
+                except Exception:  # advisory cleanup: never fail the fop
+                    pass
         return ctx.real_fd
 
     def _anon(self, fd: FdObj) -> FdObj | None:
@@ -91,6 +103,15 @@ class OpenBehindLayer(Layer):
     async def release(self, fd: FdObj):
         ctx: _ObCtx | None = fd.ctx_del(self)
         if ctx is not None:
+            if ctx.anon_fd is not None:
+                # the anonymous stand-in accumulated downstream per-fd
+                # state (read-ahead pages, running prefetch tasks) —
+                # release it or every lazy open/read/close pass leaks
+                # that state and its in-flight I/O
+                try:
+                    await super().release(ctx.anon_fd)
+                except Exception:
+                    pass
             if ctx.real_fd is not None:
                 await super().release(ctx.real_fd)
             return

@@ -66,6 +66,22 @@ class ClientLayer(Layer):
                            "when the brick advertised compound support "
                            "at SETVOLUME — otherwise chains decompose "
                            "into singles (mixed-version fallback)"),
+        Option("sg-replies", "bool", default="on",
+               description="request scatter-gather reply payloads at "
+                           "SETVOLUME (network.zero-copy-reads): a "
+                           "reply held brick-side as several buffers "
+                           "arrives as a blob vector decoded into "
+                           "segment views — no join copy on either "
+                           "end.  Off = the brick joins before "
+                           "framing (pre-sg wire behavior)"),
+        Option("strict-locks", "bool", default="off",
+               description="fds holding posix locks must not be "
+                           "reached through anonymous (gfid-addressed) "
+                           "fds after a reconnect dropped their "
+                           "server-side handle (client.strict-locks, "
+                           "reference client.c:2438): lock-protected "
+                           "I/O fails with EBADFD instead of silently "
+                           "bypassing the lock's fd identity"),
         Option("compression", "bool", default="off",
                description="zlib on-wire frames (the cdc/compress "
                            "xlator analog); the brick mirrors it on "
@@ -172,6 +188,10 @@ class ClientLayer(Layer):
                      "password": self.opts["password"]}
         if self.opts["compression"]:
             creds["compress"] = True
+        if self.opts["sg-replies"] and not self.opts["compression"]:
+            # sg only pays off on the blob lane; compressed frames
+            # inline everything anyway
+            creds["sg-replies"] = True
         try:
             res = await self._call("__handshake__",
                                    (self.identity,
@@ -384,6 +404,32 @@ class ClientLayer(Layer):
     # it the tagged codec's inline copy is cheaper than a second iovec
     BLOB_MIN = 4096
 
+    def _fd_holds_locks(self, fd: FdObj) -> bool:
+        """Does this fd hold posix locks granted through this
+        connection?  (lk / fd-addressed inodelk-class grants are keyed
+        by the fd's identity in the replay table.)  id() keys cannot
+        alias a recycled object: every entry's value tuple holds the
+        fd itself (args), so the fd outlives its keys."""
+        return any(k[1] == id(fd) for k in self._held_locks)
+
+    def _strict_lock_check(self, args: tuple) -> None:
+        """client.strict-locks (client.c:2438): an fd whose server-side
+        handle is gone but which holds posix locks must NOT be silently
+        served via an anonymous fd — the anon route bypasses the fd
+        identity the lock protects (another client could have been
+        granted the range while we were away).  Lock fops themselves
+        are exempt: the unlock that clears the record must always be
+        able to go out."""
+        if not self.opts["strict-locks"]:
+            return
+        for a in args:
+            if isinstance(a, FdObj) and not a.anonymous and \
+                    a.ctx_get(self) is None and self._fd_holds_locks(a):
+                raise FopError(
+                    errno.EBADFD,
+                    "fd holds locks but lost its remote handle "
+                    "(strict-locks)")
+
     def _wire_args(self, args: tuple) -> tuple:
         out = []
         for a in args:
@@ -412,6 +458,8 @@ class ClientLayer(Layer):
                 # reconnect would pin a lock nobody will ever drop
                 self._track_lock(name, args, kwargs, failed=True)
             raise FopError(errno.ENOTCONN, f"{self.name}: child down")
+        if name not in self._LOCK_FOPS:
+            self._strict_lock_check(args)
         try:
             ret = await self._call(name, self._wire_args(args), kwargs)
         except FopError:
@@ -470,6 +518,7 @@ class ClientLayer(Layer):
             return await cfop.decompose(self, links, xdata)
         wire_links = []
         for fop, args, kwargs in links:
+            self._strict_lock_check(args)
             wargs = [{cfop.FD_LINK_KEY: a.index}
                      if isinstance(a, cfop.FdRef) else a
                      for a in self._wire_args(args)]
@@ -545,11 +594,18 @@ class ClientLayer(Layer):
             pass  # unexpected call shape: tracking must never break fops
 
     def _absorb(self, ret: Any, args: tuple) -> Any:
-        """Turn returned FdHandles into local FdObjs."""
+        """Turn returned FdHandles into local FdObjs and scatter-gather
+        vectors into SGBufs (segments are memoryviews into the reply
+        frame — the payload is never joined on this side either)."""
         if isinstance(ret, wire.FdHandle):
             fd = FdObj(ret.gfid, path=ret.path)
             fd.ctx_set(self, ret)
             return fd
+        if isinstance(ret, dict) and len(ret) == 1 and \
+                isinstance(ret.get(wire.SG_KEY), list):
+            # the segment list shape is part of the marker: a user
+            # xattr dict that merely has the key must pass untouched
+            return wire.SGBuf(ret[wire.SG_KEY])
         if isinstance(ret, list):
             return [self._absorb(x, args) for x in ret]
         return ret

@@ -95,6 +95,14 @@ class ServerLayer(Layer):
                            "the capability at SETVOLUME "
                            "(cluster.use-compound-fops server half); "
                            "off = clients fall back to single fops"),
+        Option("sg-replies", "bool", default="on",
+               description="serve scatter-gather reply payloads: a "
+                           "readv (or chain-link) reply held as several "
+                           "buffers rides the frame as a blob VECTOR "
+                           "(one gathered send, no join copy) to "
+                           "clients that advertised sg at SETVOLUME "
+                           "(network.zero-copy-reads server half); "
+                           "off = replies are joined to single blobs"),
         Option("listen-backlog", "int", default=1024, min=0,
                description="accept-queue depth for the brick listener "
                            "(transport.listen-backlog; socket.c default "
@@ -198,6 +206,7 @@ class _ClientConn:
         self.peer_addr = "?"
         self.peercert = None  # parsed TLS peer cert (CN allow-listing)
         self.compress = False  # mirror zlib frames after handshake
+        self.sg = False  # peer understands scatter-gather replies
         # the brick this transport bound to at SETVOLUME (multiplexed
         # processes serve several; glusterfsd-mgmt.c ATTACH model)
         self.top: Layer | None = None
@@ -231,6 +240,19 @@ class _ClientConn:
     def wrap(self, v: Any) -> Any:
         if isinstance(v, FdObj):
             return self.register_fd(v)
+        if isinstance(v, wire.SGBuf):
+            # scatter-gather reply (readv served from several buffers):
+            # each segment becomes its own trailing blob — writelines
+            # gathers them into one send with no join copy.  A peer
+            # that didn't advertise sg (or a disabled brick) gets the
+            # joined single buffer it expects.
+            if self.sg and len(v.segments) > 1:
+                return {wire.SG_KEY: [
+                    wire.Blob(s) if len(s) >= self.BLOB_MIN else bytes(s)
+                    for s in v.segments]}
+            one = v.segments[0] if len(v.segments) == 1 else v.tobytes()
+            return wire.Blob(one) if len(one) >= self.BLOB_MIN \
+                else bytes(one)
         if isinstance(v, (bytes, bytearray, memoryview)) and \
                 len(v) >= self.BLOB_MIN:
             return wire.Blob(v)
@@ -348,6 +370,14 @@ class BrickServer:
         if not opts:
             return True  # bare graphs (tests): capability always on
         return bool(opts.get("compound-fops", True))
+
+    def _sg_on(self, top: Layer | None = None) -> bool:
+        """Serve scatter-gather replies?  Read per-use so a live
+        volume-set of network.zero-copy-reads applies immediately."""
+        opts = self._opts_of(top if top is not None else self.top)
+        if not opts:
+            return True  # bare graphs (tests): capability always on
+        return bool(opts.get("sg-replies", True))
 
     def _login_ok(self, creds: dict, top: Layer | None = None) -> bool:
         """auth/login: when the brick carries credentials, the client
@@ -696,9 +726,14 @@ class BrickServer:
                 conn.is_mgmt = is_mgmt
                 conn.top, conn.graph = top, graph
                 conn.compress = bool((creds or {}).get("compress"))
+                # sg replies only flow to peers that asked for them
+                # (mixed-version: an old client never sees an sg dict)
+                conn.sg = bool((creds or {}).get("sg-replies")) and \
+                    self._sg_on(top)
                 return wire.MT_REPLY, {"volume": top.name, "ok": True,
                                        "compound":
-                                           self._compound_on(top)}
+                                           self._compound_on(top),
+                                       "sg": conn.sg}
             if not conn.authed:
                 # SETVOLUME gates everything — pings included (no
                 # pre-auth liveness probing; server.c refuses requests

@@ -64,8 +64,11 @@ _T_BLOBREF = 14
 
 # observability: how many payload bytes rode the zero-copy lane vs were
 # inlined through the tagged codec (bench asserts the lane is actually
-# taken; the reference counts iobref hits the same way in io-stats)
-blob_stats = {"tx_blobs": 0, "tx_bytes": 0, "inline_bytes": 0}
+# taken; the reference counts iobref hits the same way in io-stats).
+# rx_* mirror the receive side: blob bytes decoded as views into the
+# frame (the read pipeline's zero-copy proof counter).
+blob_stats = {"tx_blobs": 0, "tx_bytes": 0, "inline_bytes": 0,
+              "rx_frames": 0, "rx_bytes": 0}
 
 
 class Blob:
@@ -88,6 +91,96 @@ class Blob:
 
 class WireError(Exception):
     pass
+
+
+#: wire spelling of a scatter-gather payload: a one-key dict whose value
+#: is the ordered segment list.  A plain dict (not a new value tag) so
+#: both codecs — and any recorded frame — stay format-compatible.
+SG_KEY = "__sg__"
+
+
+class SGBuf:
+    """A scatter-gather payload: an ordered vector of buffer segments
+    (the iovec/iobref-list analog).  Produced by layers that already
+    hold the reply as several buffers — cached pages, per-link chain
+    replies, EC fragment windows — so the bytes are never joined just
+    to cross the wire: each segment rides the blob lane as its own
+    trailing buffer (``pack_frames`` + ``writelines`` = one gathered
+    send) and decodes back into segment memoryviews on the far side.
+
+    Joining happens exactly once, at a boundary that demands plain
+    bytes (``bytes(sg)``: the glfs API edge); ``os.writev`` consumers
+    (the fuse bridge) hand the segments straight to the kernel."""
+
+    __slots__ = ("segments",)
+
+    def __init__(self, segments):
+        self.segments = [s if isinstance(s, memoryview) else memoryview(s)
+                         for s in segments]
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self.segments)
+
+    def __bytes__(self) -> bytes:
+        return b"".join(self.segments)
+
+    def tobytes(self) -> bytes:
+        return b"".join(self.segments)
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, SGBuf):
+            return self.tobytes() == other.tobytes()
+        if isinstance(other, (bytes, bytearray, memoryview)):
+            return self.tobytes() == bytes(other)
+        return NotImplemented
+
+    __hash__ = None  # eq without hash: segments are mutable
+
+    def __repr__(self):  # pragma: no cover
+        return f"SGBuf({len(self.segments)} segs, {len(self)}B)"
+
+
+def as_single_buffer(data):
+    """A buffer-protocol view of any readv result shape (bytes,
+    memoryview, SGBuf) — what np.frombuffer / os.pwrite consumers call
+    before touching payload bytes.  Single-segment SGBufs stay
+    zero-copy; multi-segment ones pay their one join here."""
+    if isinstance(data, SGBuf):
+        if len(data.segments) == 1:
+            return data.segments[0]
+        return data.tobytes()
+    return data
+
+
+def serve_pages(pages, offset: int, end: int, psz: int):
+    """Assemble [offset, end) from a page map as zero-copy views — the
+    shared serve loop of the page-granular read caches (io-cache,
+    read-ahead).  Pages are immutable bytes keyed by index; a missing
+    or short page is EOF.  Returns b'' / a single bytes-or-view / an
+    SGBuf, never joining multi-page answers (small single-page answers
+    come back as owned bytes: the view wrapper costs more than it
+    saves)."""
+    segs = []
+    pos = offset
+    while pos < end:
+        idx = pos // psz
+        page = pages.get(idx)
+        if page is None:
+            break  # EOF
+        start = pos - idx * psz
+        if start >= len(page):
+            break  # EOF inside this page
+        take = memoryview(page)[start: min(len(page),
+                                           start + (end - pos))]
+        segs.append(take)
+        if len(page) < psz:  # short page = EOF
+            break
+        pos += len(take)
+    if not segs:
+        return b""
+    if len(segs) == 1:
+        return bytes(segs[0]) if len(segs[0]) < 4096 else segs[0]
+    return SGBuf(segs)
 
 
 class FdHandle:
@@ -362,6 +455,8 @@ def unpack(rec: bytes) -> tuple[int, int, Any]:
         if start + body_len > len(rec):
             raise WireError("blob record body overruns frame")
         blobs = [mv[start + body_len:], 0]
+        blob_stats["rx_frames"] += 1
+        blob_stats["rx_bytes"] += len(blobs[0])
         payload, _ = _decode_body(mv[:start + body_len], start, blobs)
         return xid, mtype, payload
     payload, _ = _decode_body(mv, _HDR.size)

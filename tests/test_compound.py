@@ -400,6 +400,134 @@ def test_wb_fused_ftruncate_resets_logical_end(tmp_path):
     asyncio.run(run())
 
 
+def test_read_file_chain_roundtrips(tmp_path):
+    """ISSUE 3 read mirror of the create chain: a small-file read_file
+    (lookup+open+readv+release) costs ONE round trip fused and >= 3 as
+    singles."""
+    async def run():
+        server, c, cl = await _mounted(tmp_path, cf="on")
+        await c.write_file("/rf", b"r" * 9000)
+        base = cl.rpc_roundtrips
+        assert await c.read_file("/rf") == b"r" * 9000
+        fused = cl.rpc_roundtrips - base
+        await c.unmount()
+        await server.stop()
+
+        server, c, cl = await _mounted(tmp_path / "off", cf="off")
+        await c.write_file("/rf", b"r" * 9000)
+        c.itable = type(c.itable)()  # cold dentry cache, like run 1
+        base = cl.rpc_roundtrips
+        assert await c.read_file("/rf") == b"r" * 9000
+        singles = cl.rpc_roundtrips - base
+        await c.unmount()
+        await server.stop()
+
+        assert fused == 1, f"read chain took {fused} round trips"
+        assert singles >= 3, \
+            f"singles baseline took only {singles} round trips"
+
+    asyncio.run(run())
+
+
+def test_read_chain_mixed_version_fallback(tmp_path):
+    """A brick that doesn't advertise compound serves the read chain as
+    decomposed singles — byte-identical result, more round trips."""
+    async def run():
+        server, c, cl = await _mounted(
+            tmp_path, cf="on",
+            brick_opts="    option compound-fops off\n")
+        assert not cl._peer_compound
+        payload = bytes(range(256)) * 64
+        await c.write_file("/mv", payload)
+        base = cl.rpc_roundtrips
+        assert await c.read_file("/mv") == payload
+        assert cl.rpc_roundtrips - base >= 3  # decomposed into singles
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_read_chain_decomposes_through_nontransparent_layer(tmp_path):
+    """A layer with its own readv (and no compound forward override)
+    forces decomposition — the chain's links run through that layer's
+    fop methods and the result stays byte-identical."""
+    from glusterfs_tpu.core.layer import Layer, register
+
+    @register("test/readv-tap")
+    class ReadvTap(Layer):
+        taps = 0
+
+        async def readv(self, fd, size, offset, xdata=None):
+            type(self).taps += 1
+            return await self.children[0].readv(fd, size, offset, xdata)
+
+    async def run():
+        g = Graph.construct(f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+
+volume tap
+    type test/readv-tap
+    subvolumes posix
+end-volume
+""")
+        c = Client(g)
+        await c.mount()
+        payload = b"tapped" * 2000
+        await c.write_file("/t", payload)
+        replies = await g.top.compound([
+            ("lookup", (Loc("/t"),), {}),
+            ("open", (Loc("/t"), os.O_RDONLY), {}),
+            ("readv", (cfop.FdRef(1), 1 << 20, 0), {}),
+            ("release", (cfop.FdRef(1),), {})])
+        assert [st for st, _ in replies] == ["ok"] * 4
+        assert bytes(replies[2][1]) == payload
+        assert ReadvTap.taps >= 1  # the link went THROUGH the layer
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_ec_read_chain_byte_identical(tmp_path):
+    """Read chains through an EC 4+2 graph (where cluster/disperse
+    decomposes them) return exactly what the unchained path returns —
+    healthy AND degraded."""
+    from glusterfs_tpu.cluster.ec import DisperseLayer
+    from glusterfs_tpu.utils.volspec import ec_volfile
+
+    async def run():
+        spec = ec_volfile(str(tmp_path), 6, 2)
+        g = Graph.construct(spec + """
+volume wbtop
+    type performance/write-behind
+    option compound-fops on
+    subvolumes disp
+end-volume
+""")
+        c = Client(g)
+        await c.mount()
+        ec = next(l for l in walk(g.top)
+                  if isinstance(l, DisperseLayer))
+        payload = bytes(range(256)) * 300  # multi-stripe, odd tail
+        await c.write_file("/ec", payload + b"tail")
+        chained = await c.read_file("/ec")
+        f = await c.open("/ec", os.O_RDONLY)
+        unchained = await f.read(1 << 20, 0)
+        await f.close()
+        assert chained == unchained == payload + b"tail"
+        # degraded: two children down -> read-mask/decode path
+        ec.up[0] = ec.up[4] = False
+        degraded = await c.read_file("/ec")
+        assert degraded == payload + b"tail"
+        ec.up[0] = ec.up[4] = True
+        await c.unmount()
+
+    asyncio.run(run())
+
+
 def test_wb_window_flush_is_one_chain(tmp_path):
     """A multi-chunk write-behind window + the flush that drains it
     ride one compound frame (flushed windows as chains)."""

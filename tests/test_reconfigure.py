@@ -175,11 +175,20 @@ def test_option_map_integrity():
             from glusterfs_tpu.core.layer import lookup_type
 
             for t in ("protocol/client", "protocol/server",
-                      "performance/write-behind"):
+                      "performance/write-behind",
+                      "performance/read-ahead"):
                 cls = lookup_type(t)
                 assert any(o.name == opt for o in cls.OPTIONS), \
                     f"{key}: {t} lacks option {opt!r}"
     pseudo.add("__compound__")
+    # the scatter-gather key must exist on both transport ends
+    for key, (ltype, opt) in volgen.OPTION_MAP.items():
+        if ltype == "__sg__":
+            for t in ("protocol/client", "protocol/server"):
+                cls = _REGISTRY[t]
+                assert any(o.name == opt for o in cls.OPTIONS), \
+                    f"{key}: {t} lacks option {opt!r}"
+    pseudo.add("__sg__")
     missing = []
     for key, (ltype, opt) in volgen.OPTION_MAP.items():
         if ltype in pseudo:

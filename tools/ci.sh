@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# ci.sh — the one-command pre-merge gate (ISSUE 3 satellite; the
+# regression signal ROADMAP's tier-1 bar depends on):
+#
+#   1. tools/flake_gate.sh      tier-1 twice, diffing the failure sets
+#                               (stable failures -> exit 1, flakes -> 2)
+#   2. bench contract test      the driver-facing reporting contract
+#                               (compact parseable headline + detail
+#                               file) — a broken emit() loses a whole
+#                               round's record, so it gates merges even
+#                               though the full bench doesn't
+#
+# Usage:  tools/ci.sh [extra pytest args for the tier-1 runs...]
+# Exit: first failing stage's code; 0 = mergeable.
+
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== ci: flake gate (tier-1 x2) =="
+tools/flake_gate.sh "$@"
+gate_rc=$?
+if [ $gate_rc -eq 1 ]; then
+    echo "ci: STABLE tier-1 failures — not mergeable"
+    exit 1
+fi
+
+echo "== ci: bench reporting contract =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_bench_contract.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+bench_rc=$?
+if [ $bench_rc -ne 0 ]; then
+    echo "ci: bench contract broken — not mergeable"
+    exit $bench_rc
+fi
+
+if [ $gate_rc -eq 2 ]; then
+    echo "ci: green, but flaky tests were seen (flake gate exit 2)"
+    exit 2
+fi
+echo "ci: mergeable (two identical green tier-1 runs + bench contract)"
+exit 0
