@@ -384,7 +384,8 @@ def smallfile_wire_bench(n_files: int = 150) -> dict:
 
 def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
                     compound: str = "on", fuse: bool = True,
-                    prefix: str = "", zero_copy: str = "on") -> dict:
+                    prefix: str = "", zero_copy: str = "on",
+                    metrics: str = "on") -> dict:
     """Through-the-wire AND through-the-mount numbers (the reference's
     baseline workloads — dd/iozone/glfs-bm, extras/benchmarking/README —
     all run through the full stack, never in-process):
@@ -400,6 +401,12 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
     (scatter-gather reply frames, ISSUE 3 — together with ``compound``
     this is the read-pipeline on/off switch); ``fuse=False`` + a
     ``prefix`` gives a cheap wire-only comparison pass.
+
+    ``metrics="off"`` darkens the observability layer (ISSUE 4) on BOTH
+    sides: the in-process client's span/histogram hot paths, and — via
+    the ``GFTPU_NO_OBSERVABILITY`` env the brick subprocesses inherit —
+    the bricks' too.  The on/off wire pair is the accounting-overhead
+    proof row.
     """
     import asyncio
     import os
@@ -408,8 +415,22 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
     import sys
     import tempfile
 
+    from glusterfs_tpu.core import layer as layer_mod
+    from glusterfs_tpu.core import tracing
     from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
                                              mount_volume)
+
+    obs_off = str(metrics).lower() in ("off", "0", "no", "false")
+    saved_obs = (tracing.ENABLED, layer_mod.HISTOGRAMS_ENABLED,
+                 tracing.DARK, os.environ.get("GFTPU_NO_OBSERVABILITY"))
+    if obs_off:
+        # DARK first: it outranks the io-stats latency-measurement
+        # default, which would otherwise re-arm histograms when the
+        # pass mounts its volume
+        tracing.DARK = True
+        tracing.ENABLED = False
+        layer_mod.HISTOGRAMS_ENABLED = False
+        os.environ["GFTPU_NO_OBSERVABILITY"] = "1"
 
     base = tempfile.mkdtemp(prefix="fullstack")
     payload = np.random.default_rng(5).integers(
@@ -480,6 +501,28 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
                         out[f"{prefix}wire_read_fanout_staged"] = \
                             fo["staged"]
                         break
+                # percentile rows (ISSUE 4): per-fop wire round-trip
+                # latency from the protocol/client histograms, merged
+                # across the volume's brick connections — the evidence
+                # row for the wire-bar variance story (a p99/p50 gap
+                # attributes the swing to tail stalls, not uniform
+                # slowdown)
+                if not obs_off:
+                    from glusterfs_tpu.core.metrics import LogHistogram
+                    from glusterfs_tpu.protocol.client import ClientLayer
+
+                    for op in ("readv", "writev"):
+                        h = LogHistogram()
+                        for layer in walk(cl.graph.top):
+                            if isinstance(layer, ClientLayer):
+                                st = layer.stats.get(op)
+                                if st is not None:
+                                    h.merge(st.hist)
+                        if h.total:
+                            out[f"{prefix}wire_{op}_p50_ms"] = round(
+                                h.percentile(50) * 1e3, 3)
+                            out[f"{prefix}wire_{op}_p99_ms"] = round(
+                                h.percentile(99) * 1e3, 3)
             finally:
                 await cl.unmount()
             total = n_clients * file_mib
@@ -611,6 +654,13 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
     try:
         asyncio.run(run())
     finally:
+        if obs_off:
+            tracing.ENABLED, layer_mod.HISTOGRAMS_ENABLED = saved_obs[:2]
+            tracing.DARK = saved_obs[2]
+            if saved_obs[3] is None:
+                os.environ.pop("GFTPU_NO_OBSERVABILITY", None)
+            else:
+                os.environ["GFTPU_NO_OBSERVABILITY"] = saved_obs[3]
         shutil.rmtree(base, ignore_errors=True)
     return out
 
@@ -1025,6 +1075,15 @@ def main() -> None:
                                    zero_copy="off"))
     except Exception as e:
         vol["nocompound_wire_bench_error"] = str(e)[:200]
+    try:
+        # metrics-off wire pass (ISSUE 4): same pipeline config as the
+        # primary run but with histograms + trace spans darkened on
+        # both ends — the pair proves the accounting overhead is
+        # within run-to-run noise
+        vol.update(fullstack_bench(fuse=False, prefix="metrics_off_",
+                                   metrics="off"))
+    except Exception as e:
+        vol["metrics_off_wire_bench_error"] = str(e)[:200]
     # a missing wire/fuse/smallfile-wire row is an EXPLICIT
     # "skipped: <reason>" entry, never silence (r5's detail lost all
     # four rows without a trace)
@@ -1032,6 +1091,10 @@ def main() -> None:
                 "fuse_write_MiB_s", "fuse_read_MiB_s",
                 "nocompound_wire_write_MiB_s",
                 "nocompound_wire_read_MiB_s",
+                "metrics_off_wire_write_MiB_s",
+                "metrics_off_wire_read_MiB_s",
+                "wire_readv_p50_ms", "wire_readv_p99_ms",
+                "wire_writev_p50_ms", "wire_writev_p99_ms",
                 "smallfile_wire_create_compound_per_s",
                 "smallfile_wire_create_singles_per_s",
                 "smallfile_wire_rpc_per_create_compound",
@@ -1045,6 +1108,8 @@ def main() -> None:
                     or vol.get("smallfile_wire_bench_error")
             elif row.startswith("nocompound"):
                 reason = vol.get("nocompound_wire_bench_error")
+            elif row.startswith("metrics_off"):
+                reason = vol.get("metrics_off_wire_bench_error")
             else:
                 reason = vol.get("fullstack_bench_error")
             reason = reason or vol.get("fullstack_bench_error") \

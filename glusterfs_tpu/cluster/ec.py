@@ -38,6 +38,16 @@ import numpy as np
 from ..core.fops import FopError
 from ..core.iatt import IAType, Iatt, gfid_new
 from ..core.layer import Event, FdObj, Layer, Loc, register
+from ..core import metrics as _metrics
+
+#: live disperse layers, scraped (not owned) by the unified registry —
+#: weak so a retired graph's layers age out with the GC
+_LIVE_EC_LAYERS = _metrics.REGISTRY.register_objects(
+    "gftpu_ec_read_fanout_total", "counter",
+    "EC readv fan-outs by mode (fast = zero-staging systematic "
+    "reassembly, staged = decode through the frags array)",
+    lambda l: [({"layer": l.name, "mode": m}, v)
+               for m, v in l.read_fanout.items()])
 from ..core.options import Option
 from ..core import gflog
 from ..ops import codec as codec_mod
@@ -243,6 +253,7 @@ class DisperseLayer(Layer):
         # reassembly straight from fragment buffers (no staging copy),
         # "staged" = the decode path through the frags array
         self.read_fanout = {"fast": 0, "staged": 0}
+        _LIVE_EC_LAYERS.add(self)  # unified-registry scrape target
 
     def reconfigure(self, options: dict) -> None:
         """Live option apply (ec_reconfigure, ec.c:254): codec backend /

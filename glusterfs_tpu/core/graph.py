@@ -197,8 +197,15 @@ class Graph:
         """Full-graph introspection (the SIGUSR1 statedump / .meta analog,
         reference statedump.c:831; tests read this like volume.rc parses
         statedumps)."""
+        from . import tracing
+
         return {
             "top": self.top.name,
             "layers": {name: l.statedump() for name, l in self.by_name.items()},
             "recent_logs": gflog.recent_messages(50),
+            # newest spans from the per-process ring: over the wire a
+            # brick's __statedump__ shows the same trace ids the client
+            # minted (protocol/server re-arms them), so the two dumps
+            # join into one per-request tree
+            "trace_spans": tracing.recent_spans(200),
         }

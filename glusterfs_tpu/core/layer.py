@@ -26,10 +26,21 @@ from typing import Any, Callable, ClassVar
 
 from .fops import Fop, FopError
 from .iatt import Iatt
+from .metrics import LogHistogram
 from .options import Option, validate_options
-from . import gflog
+from . import gflog, tracing
 
 log = gflog.get_logger("core")
+
+# Per-fop latency histograms on every layer (io-stats
+# `latency-measurement`, applied process-wide by IoStatsLayer
+# init/reconfigure; GFTPU_NO_OBSERVABILITY pre-darkens subprocesses
+# for the bench's metrics-off pair).  The count/avg/max accounting is
+# NOT gated — it predates the histograms and `volume profile` always
+# carried it.
+import os as _os  # noqa: E402
+
+HISTOGRAMS_ENABLED = _os.environ.get("GFTPU_NO_OBSERVABILITY", "") != "1"
 
 
 class Event(enum.Enum):
@@ -88,20 +99,30 @@ class FdObj:
 
 
 class _FopStats:
-    __slots__ = ("count", "errors", "latency_sum", "latency_max")
+    __slots__ = ("count", "errors", "latency_sum", "latency_max", "hist")
 
     def __init__(self):
         self.count = 0
         self.errors = 0
         self.latency_sum = 0.0
         self.latency_max = 0.0
+        # preallocated log2 buckets: the record path is two int ops and
+        # a list increment — nothing allocates per fop
+        self.hist = LogHistogram()
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "count": self.count, "errors": self.errors,
             "latency_avg": self.latency_sum / self.count if self.count else 0.0,
             "latency_max": self.latency_max,
         }
+        if self.hist.total:
+            # percentiles are DERIVED on read (profile/statedump/.meta
+            # are cold paths); conservative bucket upper bounds
+            out["latency_p50"] = self.hist.percentile(50)
+            out["latency_p90"] = self.hist.percentile(90)
+            out["latency_p99"] = self.hist.percentile(99)
+        return out
 
 
 def _timed(op_name: str, fn: Callable) -> Callable:
@@ -109,11 +130,18 @@ def _timed(op_name: str, fn: Callable) -> Callable:
 
     async def wrapper(self, *args, **kwargs):
         st = self.stats.setdefault(op_name, _FopStats())
+        # span bracket: the outermost timed call on a graph mints the
+        # trace id, nested layers join it (core/tracing.py); one gate
+        # check keeps the dark path at a single global read
+        span = tracing.enter(self.name, op_name) if tracing.ENABLED \
+            else None
+        err = False
         t0 = time.perf_counter()
         try:
             return await fn(self, *args, **kwargs)
         except FopError:
             st.errors += 1
+            err = True
             raise
         finally:
             dt = time.perf_counter() - t0
@@ -121,6 +149,10 @@ def _timed(op_name: str, fn: Callable) -> Callable:
             st.latency_sum += dt
             if dt > st.latency_max:
                 st.latency_max = dt
+            if HISTOGRAMS_ENABLED:
+                st.hist.record(dt)
+            if span is not None:
+                tracing.exit_span(span, dt, err)
 
     wrapper.__name__ = fn.__name__
     wrapper.__qualname__ = fn.__qualname__

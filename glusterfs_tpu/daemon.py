@@ -42,6 +42,49 @@ async def serve_brick(volfile_text: str, host: str = "127.0.0.1",
     return server
 
 
+async def serve_metrics(host: str = "127.0.0.1",
+                        port: int = 0) -> asyncio.AbstractServer:
+    """Prometheus-style scrape endpoint (OFF by default — armed by
+    ``--metrics-port``): a minimal HTTP/1.0 responder serving the
+    unified registry's text dump at ``/metrics``.  Read-only and
+    allocation-light; scraping is a cold path by design."""
+    from .core.metrics import REGISTRY
+
+    async def handle(reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            try:
+                head = await asyncio.wait_for(
+                    reader.readuntil(b"\r\n\r\n"), 5)
+            except (asyncio.IncompleteReadError, asyncio.LimitOverrunError,
+                    asyncio.TimeoutError, ConnectionError):
+                return
+            line = head.split(b"\r\n", 1)[0].split()
+            path = line[1].decode("latin-1") if len(line) > 1 else "/"
+            if path.split("?", 1)[0] not in ("/metrics", "/"):
+                writer.write(b"HTTP/1.0 404 Not Found\r\n"
+                             b"Content-Length: 0\r\n\r\n")
+                return
+            body = REGISTRY.render().encode()
+            writer.write(b"HTTP/1.0 200 OK\r\n"
+                         b"Content-Type: text/plain; version=0.0.4\r\n"
+                         + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                         + body)
+            await writer.drain()
+        except ConnectionError:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    srv = await asyncio.start_server(handle, host, port)
+    log.info(6, "metrics endpoint on %s:%d", host,
+             srv.sockets[0].getsockname()[1])
+    return srv
+
+
 def _dump_state(server: BrickServer, volfile: str) -> None:
     """SIGUSR1 statedump (reference glusterfsd.c:2230 wiring +
     statedump.c:831): full graph dump to a timestamped file next to
@@ -66,6 +109,9 @@ async def _amain(args) -> None:
         text = f.read()
     server = await serve_brick(text, args.host, args.listen,
                                args.top or None, args.portfile or None)
+    metrics_srv = None
+    if getattr(args, "metrics_port", 0):
+        metrics_srv = await serve_metrics(args.host, args.metrics_port)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -73,6 +119,8 @@ async def _amain(args) -> None:
     loop.add_signal_handler(signal.SIGUSR1, _dump_state, server,
                             args.volfile)
     await stop.wait()
+    if metrics_srv is not None:
+        metrics_srv.close()
     await server.stop()
 
 
@@ -86,6 +134,10 @@ def main(argv=None) -> int:
                    help="TCP port (0 = ephemeral)")
     p.add_argument("--portfile", default="",
                    help="write the bound port here (for ephemeral ports)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve the unified metrics registry as a "
+                        "Prometheus text endpoint on this port "
+                        "(0 = off, the default)")
     args = p.parse_args(argv)
     asyncio.run(_amain(args))
     return 0

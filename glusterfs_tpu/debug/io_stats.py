@@ -46,6 +46,16 @@ class IoStatsLayer(Layer):
         Option("fop-sample-buf-size", "int", default=65535, min=1,
                description="sample ring capacity "
                            "(diagnostics.fop-sample-buf-size)"),
+        Option("slow-fop-threshold", "time", default="0",
+               description="log the full span tree of any fop slower "
+                           "than this (diagnostics.slow-fop-threshold; "
+                           "0 = off).  Applied process-wide: a slow "
+                           "wire readv's log names the layer the time "
+                           "went to (core/tracing.py)"),
+        Option("span-ring-size", "int", default=4096, min=64,
+               max=1 << 20,
+               description="bound on the per-process trace-span ring "
+                           "(diagnostics.span-ring-size)"),
     )
 
     _LOG_LEVELS = {"TRACE": 5, "DEBUG": 10, "INFO": 20, "WARNING": 30,
@@ -59,11 +69,48 @@ class IoStatsLayer(Layer):
         logging.getLogger("glusterfs_tpu").setLevel(
             self._LOG_LEVELS.get(self.opts["log-level"], 20))
 
+    def _apply_observability(self) -> None:
+        """Push the process-wide observability knobs this layer owns
+        (io-stats carries the diagnostics.* options in the reference
+        too): histogram gate, slow-fop threshold, span-ring bound.
+        A darkened process (GFTPU_NO_OBSERVABILITY / bench metrics-off)
+        wins over the option defaults: latency-measurement's default
+        'on' must not re-arm histograms at mount time."""
+        from ..core import layer as layer_mod
+        from ..core import tracing
+
+        layer_mod.HISTOGRAMS_ENABLED = bool(
+            self.opts["latency-measurement"]) and not tracing.DARK
+        tracing.SLOW_FOP_THRESHOLD = float(
+            self.opts["slow-fop-threshold"])
+        tracing.set_ring_size(int(self.opts["span-ring-size"]))
+
+    def _restart_dump_task(self) -> None:
+        """Cancel + respawn the periodic profile dump so a live
+        ``diagnostics.stats-dump-interval`` change takes effect (the
+        old task would sleep on the stale interval forever)."""
+        import asyncio
+
+        t = getattr(self, "_dump_task", None)
+        if t is not None:
+            t.cancel()
+            self._dump_task = None
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (offline reconfigure): init will arm it
+        if float(self.opts["ios-dump-interval"]) > 0:
+            self._dump_task = asyncio.create_task(self._dump_loop())
+
     def reconfigure(self, options: dict) -> None:
         old = self.opts["log-level"]
+        old_interval = float(self.opts["ios-dump-interval"])
         super().reconfigure(options)
         if self.opts["log-level"] != old:
             self._apply_log_level()
+        self._apply_observability()
+        if float(self.opts["ios-dump-interval"]) != old_interval:
+            self._restart_dump_task()
 
     def __init__(self, *args, **kw):
         from collections import OrderedDict
@@ -119,6 +166,14 @@ class IoStatsLayer(Layer):
         ring.append({"ts": round(time.time(), 3), "op": op,
                      "path": path or ""})
 
+    async def _dump_loop(self):
+        import asyncio
+
+        while True:
+            await asyncio.sleep(float(self.opts["ios-dump-interval"]))
+            log.info(5, "%s: profile %s", self.name,
+                     self.profile(interval=True))
+
     async def init(self):
         import asyncio
 
@@ -127,16 +182,10 @@ class IoStatsLayer(Layer):
             # only an explicit operator setting touches the level: the
             # default must not override an embedding app's config
             self._apply_log_level()
+        self._apply_observability()
         self._dump_task = None
         if float(self.opts["ios-dump-interval"]) > 0:
-            async def dump_loop():
-                while True:
-                    await asyncio.sleep(
-                        float(self.opts["ios-dump-interval"]))
-                    log.info(5, "%s: profile %s", self.name,
-                             self.profile(interval=True))
-
-            self._dump_task = asyncio.create_task(dump_loop())
+            self._dump_task = asyncio.create_task(self._dump_loop())
 
     async def fini(self):
         t = getattr(self, "_dump_task", None)
@@ -189,18 +238,35 @@ class IoStatsLayer(Layer):
         """Forward chains intact (accounting is side-effect-free) and
         replay the per-fop byte/open counters from the reply vector —
         fused traffic must not vanish from `volume profile`."""
-        replies = await self.children[0].compound(links, xdata)
-        for (fop, args, _kw), (st, _val) in zip(links, replies):
-            if st != "ok":
-                continue
-            path = None
+        from ..rpc import compound as cfop
+
+        def link_path(args) -> str | None:
+            """Best path for a link's per-path counters: its own
+            Loc/FdObj, or — for FdRef links (the fd is minted BY the
+            chain) — the producer link's Loc.  Resolving through the
+            reply vector would miss: released chain fds are stripped
+            from the replies before they get here."""
             for a in args:
                 if isinstance(a, Loc):
-                    path = a.path
-                    break
+                    return a.path
                 if isinstance(a, FdObj):
-                    path = getattr(a, "path", None)
-                    break
+                    return getattr(a, "path", None)
+                ref = a if isinstance(a, cfop.FdRef) else None
+                if ref is None and isinstance(a, dict) and \
+                        len(a) == 1 and cfop.FD_LINK_KEY in a:
+                    ref = cfop.FdRef(a[cfop.FD_LINK_KEY])
+                if ref is not None and 0 <= ref.index < len(links):
+                    for pa in links[ref.index][1]:
+                        if isinstance(pa, Loc):
+                            return pa.path
+                    return None
+            return None
+
+        replies = await self.children[0].compound(links, xdata)
+        for (fop, args, _kw), (st, val) in zip(links, replies):
+            if st != "ok":
+                continue
+            path = link_path(args)
             self._sample(fop, path)
             st_rec = self._path_stat(path)
             if fop in ("open", "create") and st_rec is not None:
@@ -213,6 +279,18 @@ class IoStatsLayer(Layer):
                 if st_rec is not None:
                     st_rec["writes"] += 1
                     st_rec["write_bytes"] += n
+            elif fop == "readv":
+                # reply-value bytes: PR 3's fused read chains must not
+                # vanish from `volume profile` — the reply is bytes, a
+                # frame memoryview, or an SGBuf, all sized by len()
+                try:
+                    n = len(val) if val is not None else 0
+                except TypeError:
+                    n = 0
+                self.read_bytes += n
+                if st_rec is not None:
+                    st_rec["reads"] += 1
+                    st_rec["read_bytes"] += n
         return replies
 
     # -- `volume top` backend (io-stats ios_stat_list) ---------------------
@@ -235,6 +313,15 @@ class IoStatsLayer(Layer):
         """RPC surface for ``gluster volume top`` (the brick server
         resolves this by graph walk, like quota_usage)."""
         return self.top(metric, count)
+
+    async def metrics_dump(self) -> dict:
+        """RPC surface for ``gftpu volume metrics`` (resolved by graph
+        walk like top_stats): this process's unified-registry scrape —
+        counters/gauges/histograms from every subsystem that registered
+        (core/metrics.py)."""
+        from ..core.metrics import REGISTRY
+
+        return REGISTRY.snapshot()
 
     # -- profile API (volume profile incremental/cumulative analog) --------
 

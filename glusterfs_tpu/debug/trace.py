@@ -21,9 +21,19 @@ class TraceLayer(Layer):
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         self.history: list[str] = []
-        self._excluded = {s.strip()
-                         for s in self.opts["exclude-ops"].split(",")
-                         if s.strip()}
+        self._excluded = self._parse_excluded()
+
+    def _parse_excluded(self) -> set[str]:
+        return {s.strip()
+                for s in self.opts["exclude-ops"].split(",")
+                if s.strip()}
+
+    def reconfigure(self, options: dict) -> None:
+        """A live ``volume set ... exclude-ops`` must take effect: the
+        set is derived state of the option, so re-derive it (it was
+        computed once in __init__ and silently ignored changes)."""
+        super().reconfigure(options)
+        self._excluded = self._parse_excluded()
 
     def _record(self, line: str):
         log.debug(1, "%s", line)

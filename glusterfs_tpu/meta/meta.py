@@ -54,7 +54,7 @@ class MetaLayer(Layer):
         ("file", bytes) or None."""
         parts = [p for p in path.split("/") if p]
         if not parts:
-            return "dir", ["version", "logging", "graphs"]
+            return "dir", ["version", "logging", "metrics", "graphs"]
         if parts == ["version"]:
             from .. import __version__
 
@@ -63,6 +63,12 @@ class MetaLayer(Layer):
         if parts == ["logging"]:
             return "file", "\n".join(
                 gflog.recent_messages(200)).encode() + b"\n"
+        if parts == ["metrics"]:
+            # the unified registry's Prometheus text dump (same bytes
+            # the daemon's --metrics-port endpoint serves)
+            from ..core.metrics import REGISTRY
+
+            return "file", REGISTRY.render().encode()
         if parts[0] != "graphs":
             return None
         if len(parts) == 1:

@@ -11,6 +11,7 @@ Command surface kept (cli-cmd-volume.c vocabulary):
     gftpu volume quota NAME enable|disable|list|limit-usage PATH BYTES|remove PATH
     gftpu volume rebalance NAME
     gftpu volume profile NAME
+    gftpu volume metrics NAME
     gftpu peer probe HOST:PORT | peer status
 
 Talks to glusterd over the mgmt wire RPC (--server host:port, default
@@ -311,6 +312,11 @@ async def _run(args) -> Any:
             # mounted client's own io-stats would be empty
             async with MgmtClient(host, port) as c:
                 return await c.call("volume-profile", name=args.name)
+        if sub == "metrics":
+            # per-brick unified-registry scrape (counters/gauges/
+            # histograms from every subsystem; core/metrics.py)
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-metrics", name=args.name)
         if sub == "top":
             # volume top NAME [open|read|write|read-bytes|write-bytes]
             # [COUNT] — ranked per-path counters from each BRICK's
@@ -424,8 +430,8 @@ def main(argv=None) -> int:
     vol = sp.add_parser("volume")
     vol.add_argument("sub", choices=["create", "start", "stop", "delete",
                                      "info", "status", "set", "heal",
-                                     "rebalance", "profile", "quota",
-                                     "bitrot", "add-brick",
+                                     "rebalance", "profile", "metrics",
+                                     "quota", "bitrot", "add-brick",
                                      "remove-brick", "replace-brick",
                                      "top"])
     vol.add_argument("name", nargs="?", default="")

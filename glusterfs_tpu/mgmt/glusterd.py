@@ -48,10 +48,12 @@ log = gflog.get_logger("mgmt")
 # this build's management op-version (xlator.h:758 / GD_OP_VERSION):
 # peers advertise theirs at probe time and the cluster operates at the
 # minimum, gating newer volume-set keys until every member upgrades
-OP_VERSION = 6  # 6: zero-copy read pipeline + strict-locks
-                # (volgen._V6_KEYS); 5: compound fops + auth.ssl-allow
-                # (volgen._V5_KEYS); 4: round-5 keys (volgen._V4_KEYS);
-                # 3: the round-4 option long tail (volgen._V3_KEYS)
+OP_VERSION = 7  # 7: observability layer — trace propagation + slow-fop
+                # diagnostics (volgen._V7_KEYS); 6: zero-copy read
+                # pipeline + strict-locks (volgen._V6_KEYS); 5: compound
+                # fops + auth.ssl-allow (volgen._V5_KEYS); 4: round-5
+                # keys (volgen._V4_KEYS); 3: the round-4 option long
+                # tail (volgen._V3_KEYS)
 
 
 def _new_volinfo(state: dict, name: str, vtype: str, bricks: list,
@@ -1138,6 +1140,38 @@ class Glusterd:
                          and "fops" in (l.get("private") or {})), None)
             if prof is not None:
                 out[b["name"]] = prof
+        return {"bricks": out}
+
+    async def op_volume_metrics(self, name: str) -> dict:
+        """``gftpu volume metrics <v>`` — each brick process's unified
+        metrics-registry scrape (core/metrics.py): decode-program cache
+        hit/miss, wire blob lanes, io-threads queue depth, write-behind
+        occupancy, codec probe state... resolved per brick by graph
+        walk like top_stats."""
+        vol = self._vol(name)
+        if vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        bricks = await self._gather_bricks("volume-metrics-local",
+                                           name=name)
+        return {"volume": name, "bricks": bricks}
+
+    async def op_volume_metrics_local(self, name: str) -> dict:
+        """One node's share of volume-metrics: its local bricks."""
+        vol = self._vol(name)
+        out: dict[str, dict] = {}
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid:
+                continue
+            port = self.ports.get(b["name"])
+            if not port:
+                continue
+            try:
+                snap = await self._brick_call(
+                    vol, port, "metrics_dump", [],
+                    subvol=b["name"] + "-server")
+            except Exception:
+                snap = None  # dead brick: report empty, not an error
+            out[b["name"]] = snap or {}
         return {"bricks": out}
 
     async def op_volume_top(self, name: str, metric: str = "open",

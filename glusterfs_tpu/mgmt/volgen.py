@@ -50,6 +50,13 @@ OPTION_MAP = {
     # both transport ends — client requests at SETVOLUME, server
     # honors per-connection
     "network.zero-copy-reads": ("__sg__", "sg-replies"),
+    # end-to-end trace propagation (core/tracing.py): one key arms both
+    # transport ends — the client ships the trailing trace-id frame
+    # field, the server advertises + re-arms it for the brick graph
+    "diagnostics.trace-propagation": ("__trace__", "trace-fops"),
+    "diagnostics.slow-fop-threshold": ("debug/io-stats",
+                                       "slow-fop-threshold"),
+    "diagnostics.span-ring-size": ("debug/io-stats", "span-ring-size"),
     "client.strict-locks": ("protocol/client", "strict-locks"),
     "performance.read-ahead-adaptive": ("performance/read-ahead",
                                         "adaptive-window"),
@@ -609,6 +616,16 @@ _V6_KEYS = (
 )
 OPTION_MIN_OPVERSION.update({k: 6 for k in _V6_KEYS})
 
+# round-8 additions ship at op-version 7: the observability layer —
+# trace propagation adds a wire-frame field peers must tolerate, and
+# the span/slow-fop knobs ride it
+_V7_KEYS = (
+    "diagnostics.trace-propagation",
+    "diagnostics.slow-fop-threshold",
+    "diagnostics.span-ring-size",
+)
+OPTION_MIN_OPVERSION.update({k: 7 for k in _V7_KEYS})
+
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key
 DEFAULT_PERF_STACK = [
@@ -786,6 +803,7 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
     sopts.update(_ssl_options(volinfo))
     sopts.update(_compound_options(volinfo))
     sopts.update(_sg_options(volinfo))
+    sopts.update(_trace_options(volinfo))
     auth = volinfo.get("auth") or {}
     if auth:
         sopts["auth-user"] = auth["username"]
@@ -823,6 +841,13 @@ def _sg_options(volinfo: dict) -> dict[str, Any]:
     return {} if val is None else {"sg-replies": val}
 
 
+def _trace_options(volinfo: dict) -> dict[str, Any]:
+    """diagnostics.trace-propagation lands on both transport ends (the
+    server advertises + re-arms, the client ships the frame field)."""
+    val = volinfo.get("options", {}).get("diagnostics.trace-propagation")
+    return {} if val is None else {"trace-fops": val}
+
+
 def build_client_volfile(volinfo: dict,
                          ports: dict[str, int] | None = None,
                          mgmt: str | None = None) -> str:
@@ -848,6 +873,7 @@ def build_client_volfile(volinfo: dict,
         opts.update(_ssl_options(volinfo))
         opts.update(_compound_options(volinfo))
         opts.update(_sg_options(volinfo))
+        opts.update(_trace_options(volinfo))
         # a TLS brick implies TLS clients (admins set server.ssl once)
         if _enabled(volinfo, "server.ssl", False):
             opts["ssl"] = "on"

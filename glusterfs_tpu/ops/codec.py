@@ -56,6 +56,40 @@ MESH_RING_DECODE_BYTES = 64 << 20
 _probe_state: list = []
 _PROBE_RETRY_S = 300.0
 
+# -- unified-registry scrape (core/metrics.py): which backends the
+# live codecs resolved to, and what the device probe last said --------
+import weakref as _weakref  # noqa: E402
+
+from ..core import metrics as _metrics  # noqa: E402
+
+_LIVE_CODECS: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def _codec_backend_samples():
+    from collections import Counter as _Counter
+
+    counts = _Counter(c.backend for c in list(_LIVE_CODECS))
+    return [({"backend": b}, n) for b, n in counts.items()]
+
+
+def _probe_samples():
+    if not _probe_state:
+        state = "unprobed"
+    elif _probe_state[0][2]:
+        state = "wedged"
+    else:
+        state = "present" if _probe_state[0][1] else "absent"
+    return [({"state": s}, 1 if s == state else 0)
+            for s in ("unprobed", "present", "absent", "wedged")]
+
+
+_metrics.REGISTRY.register(
+    "gftpu_codec_instances", "gauge",
+    "live Codec objects by resolved backend", _codec_backend_samples)
+_metrics.REGISTRY.register(
+    "gftpu_codec_device_probe", "gauge",
+    "device-probe cache state (1 on the active row)", _probe_samples)
+
 
 def probe_wedged() -> bool:
     """True while the LAST device probe timed out (transport wedged):
@@ -218,6 +252,7 @@ class Codec:
                 raise ValueError(
                     "mesh backend has no systematic mode yet")
             self.backend = "pallas-xor"  # auto on multi-chip: serve 1-chip
+        _LIVE_CODECS.add(self)  # unified-registry scrape target
 
     # -- encode ------------------------------------------------------------
 

@@ -581,6 +581,36 @@ DECODE_PROGRAMS = ProgramLRU(_build_decode_program, maxsize=128)
 RECONSTRUCT_PROGRAMS = ProgramLRU(_build_reconstruct_program, maxsize=128)
 
 
+def _program_cache_samples():
+    """Unified-registry collector over both program LRUs (their
+    cache_info counters stay where the decode hot path wants them)."""
+    out = []
+    for cache, lru in (("decode", DECODE_PROGRAMS),
+                       ("reconstruct", RECONSTRUCT_PROGRAMS)):
+        info = lru.cache_info()
+        for event in ("hits", "misses", "evictions"):
+            out.append(({"cache": cache, "event": event}, info[event]))
+    return out
+
+
+def _program_cache_sizes():
+    return [({"cache": cache}, lru.cache_info()["size"])
+            for cache, lru in (("decode", DECODE_PROGRAMS),
+                               ("reconstruct", RECONSTRUCT_PROGRAMS))]
+
+
+from ..core import metrics as _metrics  # noqa: E402
+
+_metrics.REGISTRY.register(
+    "gftpu_decode_program_cache_events_total", "counter",
+    "compiled XOR-program LRU hits/misses/evictions per cache",
+    _program_cache_samples)
+_metrics.REGISTRY.register(
+    "gftpu_decode_program_cache_size", "gauge",
+    "compiled XOR-programs resident per LRU",
+    _program_cache_sizes)
+
+
 def decode_program(k: int, rows, systematic: bool = False) -> XorProgram:
     """Compiled decode program for the surviving-fragment mask ``rows``."""
     return DECODE_PROGRAMS(k, tuple(int(x) for x in rows), systematic)

@@ -23,6 +23,24 @@ from concurrent.futures import ThreadPoolExecutor
 from ..core.fops import Fop
 from ..core.layer import Layer, register
 from ..core.options import Option
+from ..core import metrics as _metrics
+
+_PRIO_NAMES = ("fast", "normal", "slow", "least")
+
+#: live io-threads layers, scraped by the unified registry (weak: a
+#: retired graph's layers age out with the GC); both families hang off
+#: the one population
+_LIVE_IOT_LAYERS = _metrics.REGISTRY.register_objects(
+    "gftpu_io_threads_queued", "gauge",
+    "fops currently queued or executing per priority class",
+    lambda l: [({"layer": l.name, "prio": _PRIO_NAMES[i]}, v)
+               for i, v in enumerate(l.queued)])
+_metrics.REGISTRY.register_objects(
+    "gftpu_io_threads_executed_total", "counter",
+    "fops admitted through each priority gate",
+    lambda l: [({"layer": l.name, "prio": _PRIO_NAMES[i]}, v)
+               for i, v in enumerate(l.executed)],
+    live=_LIVE_IOT_LAYERS)
 
 # fop -> priority class (io-threads.c:64-89)
 FAST = {Fop.LOOKUP, Fop.STAT, Fop.FSTAT, Fop.ACCESS, Fop.READLINK,
@@ -85,6 +103,7 @@ class IoThreadsLayer(Layer):
         self.queued = [0, 0, 0, 0]
         self.executed = [0, 0, 0, 0]
         self._pool: ThreadPoolExecutor | None = None
+        _LIVE_IOT_LAYERS.add(self)
 
     async def init(self):
         self._pool = ThreadPoolExecutor(

@@ -13,6 +13,13 @@ import asyncio
 from ..core.fops import FopError
 from ..core.layer import FdObj, Layer, register
 from ..core.options import Option
+from ..core import metrics as _metrics
+
+#: live write-behind layers, scraped by the unified registry
+_LIVE_WB_LAYERS = _metrics.REGISTRY.register_objects(
+    "gftpu_write_behind_window_bytes", "gauge",
+    "bytes absorbed into write-behind windows and not yet drained",
+    lambda l: [({"layer": l.name}, l.window_bytes)])
 
 
 class _WbFd:
@@ -56,6 +63,14 @@ class WriteBehindLayer(Layer):
                            "instead of its own round trip"),
     )
 
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        # window occupancy across all fds (registry gauge + statedump):
+        # maintained by delta in _absorb/_drain, never recomputed by
+        # walking fd contexts
+        self.window_bytes = 0
+        _LIVE_WB_LAYERS.add(self)
+
     def _ctx(self, fd: FdObj) -> _WbFd:
         ctx = fd.ctx_get(self)
         if ctx is None:
@@ -84,7 +99,9 @@ class WriteBehindLayer(Layer):
         merged[offset - start: end - start] = data
         rest.append((start, merged))
         ctx.chunks = rest
+        before = ctx.bytes
         ctx.bytes = sum(len(b) for _, b in ctx.chunks)
+        self.window_bytes += ctx.bytes - before
 
     async def _drain(self, fd: FdObj, ctx: _WbFd,
                      tail: tuple = ()) -> list | None:
@@ -95,6 +112,7 @@ class WriteBehindLayer(Layer):
         tail is the caller's business.  Returns the tail's reply
         entries when a chain carried them, else None."""
         async with ctx.lock:
+            self.window_bytes -= ctx.bytes
             chunks, ctx.chunks, ctx.bytes = ctx.chunks, [], 0
             if self.opts["compound-fops"] and chunks and \
                     (len(chunks) + len(tail)) > 1:
@@ -259,4 +277,5 @@ class WriteBehindLayer(Layer):
         await super().release(fd)
 
     def dump_private(self) -> dict:
-        return {"window_size": self.opts["window-size"]}
+        return {"window_size": self.opts["window-size"],
+                "window_bytes": self.window_bytes}

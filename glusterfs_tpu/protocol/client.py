@@ -32,7 +32,7 @@ from ..core.fops import Fop, FopError
 from ..core.iatt import gfid_new
 from ..core.layer import Event, FdObj, Layer, Loc, register
 from ..core.options import Option
-from ..core import gflog
+from ..core import gflog, tracing
 from ..rpc import wire
 
 log = gflog.get_logger("protocol.client")
@@ -74,6 +74,14 @@ class ClientLayer(Layer):
                            "segment views — no join copy on either "
                            "end.  Off = the brick joins before "
                            "framing (pre-sg wire behavior)"),
+        Option("trace-fops", "bool", default="on",
+               description="ship the current trace id as a trailing "
+                           "wire-frame field so brick-side spans join "
+                           "the client's trace "
+                           "(diagnostics.trace-propagation); only "
+                           "engages when the brick advertised trace "
+                           "support at SETVOLUME — a live-downgraded "
+                           "peer simply never sees the field"),
         Option("strict-locks", "bool", default="off",
                description="fds holding posix locks must not be "
                            "reached through anonymous (gfid-addressed) "
@@ -120,6 +128,8 @@ class ClientLayer(Layer):
         self._last_pong = 0.0
         # did the peer advertise compound support at SETVOLUME?
         self._peer_compound = False
+        # did the peer advertise trace-span re-arming at SETVOLUME?
+        self._peer_trace = False
         # fop round-trips awaited on this transport (handshake/ping
         # excluded; the wire-frame-counting tests read this)
         self.rpc_roundtrips = 0
@@ -209,6 +219,11 @@ class ClientLayer(Layer):
         # per-peer capability (mixed-version clusters): a brick that
         # doesn't advertise compound gets singles from this client
         self._peer_compound = bool(res.get("compound"))
+        # did the peer advertise trace re-arming?  The local trace-fops
+        # option is read per-call (not folded in here) so a live
+        # volume-set of diagnostics.trace-propagation applies without
+        # a reconnect — same pattern as compound-fops
+        self._peer_trace = bool(res.get("trace"))
         # re-open tracked fds and re-acquire held locks BEFORE CHILD_UP
         # (client_child_up_reopen_done): parents must never see an "up"
         # child whose fd handles are stale
@@ -375,6 +390,16 @@ class ClientLayer(Layer):
         self._pending[xid] = fut
         try:
             body = [fop, list(args), kwargs or {}]
+            if self._peer_trace and tracing.ENABLED and \
+                    self.opts["trace-fops"]:
+                # trailing trace-id element (the wire twin of the
+                # reference's frame->root): the server re-arms it so
+                # brick-graph spans carry THIS request's trace id.
+                # Handshake/ping frames predate _peer_trace or carry no
+                # fop context worth attributing.
+                tid = tracing.current_id()
+                if tid is not None:
+                    body.append(tid)
             if self.opts["compression"]:
                 writer.write(wire.pack_z(
                     xid, wire.MT_CALL, body,
@@ -654,8 +679,15 @@ def _make_wire_fop(op_name: str):
     return wired
 
 
+from ..core.layer import _timed as _layer_timed  # noqa: E402
+
 for _fop in Fop:
     # explicit methods (compound: capability-gated fusion + fallback)
-    # keep their implementation; everything else is a plain wired fop
+    # keep their implementation; everything else is a plain wired fop.
+    # Wrapped with the layer timer: protocol/client's per-fop stats ARE
+    # the wire round-trip latency (the p50/p99 the bench records), and
+    # the timed bracket is what mints/joins the trace span here when
+    # this layer is the graph top.
     if _fop.value not in vars(ClientLayer):
-        setattr(ClientLayer, _fop.value, _make_wire_fop(_fop.value))
+        setattr(ClientLayer, _fop.value,
+                _layer_timed(_fop.value, _make_wire_fop(_fop.value)))

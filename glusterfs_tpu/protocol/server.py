@@ -41,7 +41,7 @@ from typing import Any
 from ..core.fops import Fop, FopError
 from ..core.layer import FdObj, Layer, register
 from ..core.options import Option
-from ..core import gflog
+from ..core import gflog, tracing
 from ..rpc import wire
 
 log = gflog.get_logger("protocol.server")
@@ -95,6 +95,15 @@ class ServerLayer(Layer):
                            "the capability at SETVOLUME "
                            "(cluster.use-compound-fops server half); "
                            "off = clients fall back to single fops"),
+        Option("trace-fops", "bool", default="on",
+               description="advertise trace-span re-arming at SETVOLUME "
+                           "and adopt the client's trailing trace-id "
+                           "frame field before dispatching into the "
+                           "brick graph, so brick-side spans join the "
+                           "client's trace "
+                           "(diagnostics.trace-propagation server "
+                           "half); off = the field is ignored and "
+                           "clients stop sending it"),
         Option("sg-replies", "bool", default="on",
                description="serve scatter-gather reply payloads: a "
                            "readv (or chain-link) reply held as several "
@@ -190,7 +199,8 @@ _THROTTLE_EXEMPT = {"inodelk", "finodelk", "entrylk", "fentrylk", "lk"}
 # introspection — the reference exposes these via separate RPC programs)
 _RPC_EXTRAS = {"heal_info", "heal_file", "heal_entry", "rebalance",
                "release", "getactivelk", "quota_usage", "top_stats",
-               "changelog_history", "contend_held_locks"}
+               "metrics_dump", "changelog_history",
+               "contend_held_locks"}
 
 
 class _ClientConn:
@@ -378,6 +388,14 @@ class BrickServer:
         if not opts:
             return True  # bare graphs (tests): capability always on
         return bool(opts.get("sg-replies", True))
+
+    def _trace_on(self, top: Layer | None = None) -> bool:
+        """Re-arm client trace ids?  Read per-use so a live volume-set
+        of diagnostics.trace-propagation applies immediately."""
+        opts = self._opts_of(top if top is not None else self.top)
+        if not opts:
+            return True  # bare graphs (tests): capability always on
+        return bool(opts.get("trace-fops", True))
 
     def _login_ok(self, creds: dict, top: Layer | None = None) -> bool:
         """auth/login: when the brick carries credentials, the client
@@ -694,7 +712,11 @@ class BrickServer:
 
     async def _dispatch(self, conn: _ClientConn, payload: Any):
         try:
-            fop_name, args, kwargs = payload
+            # a trailing 4th element is the client's trace id (only sent
+            # when this brick advertised trace at SETVOLUME; a payload
+            # from an older client is the bare 3-element triple)
+            fop_name, args, kwargs = payload[0], payload[1], payload[2]
+            trace_id = payload[3] if len(payload) > 3 else None
             if fop_name == "__handshake__":
                 creds = args[2] if len(args) > 2 else {}
                 want = args[1] if len(args) > 1 else ""
@@ -733,7 +755,8 @@ class BrickServer:
                 return wire.MT_REPLY, {"volume": top.name, "ok": True,
                                        "compound":
                                            self._compound_on(top),
-                                       "sg": conn.sg}
+                                       "sg": conn.sg,
+                                       "trace": self._trace_on(top)}
             if not conn.authed:
                 # SETVOLUME gates everything — pings included (no
                 # pre-auth liveness probing; server.c refuses requests
@@ -741,6 +764,11 @@ class BrickServer:
                 raise FopError(13, "handshake required")  # EACCES
             top = conn.top if conn.top is not None else self.top
             graph = conn.graph if conn.top is not None else self.graph
+            if trace_id and tracing.ENABLED and self._trace_on(top):
+                # re-arm the client's trace for this request's context:
+                # every brick-graph span below carries the client's id
+                # (frame->root across the wire)
+                tracing.arm(str(trace_id))
             if fop_name == "__ping__":
                 return wire.MT_REPLY, "pong"
             if fop_name == "__attach__":

@@ -70,6 +70,15 @@ _T_BLOBREF = 14
 blob_stats = {"tx_blobs": 0, "tx_bytes": 0, "inline_bytes": 0,
               "rx_frames": 0, "rx_bytes": 0}
 
+# absorbed into the unified registry (core/metrics.py): the dict stays
+# the hot-path counter store, the registry reads it at scrape time
+from ..core import metrics as _metrics  # noqa: E402
+
+_metrics.REGISTRY.register(
+    "gftpu_wire_blob_stats", "counter",
+    "payload bytes/frames by wire lane (blob vs inline, tx vs rx)",
+    lambda: _metrics.labeled(blob_stats))
+
 
 class Blob:
     """A bulk payload shipped out-of-band (iobuf analog).

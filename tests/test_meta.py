@@ -39,7 +39,10 @@ def test_meta_tree(tmp_path):
         assert await c.read_file("/real") == b"data"
         # the virtual tree
         assert sorted(await c.listdir("/.meta")) == \
-            ["graphs", "logging", "version"]
+            ["graphs", "logging", "metrics", "version"]
+        # the unified-registry dump serves as a file
+        metrics = await c.read_file("/.meta/metrics")
+        assert b"gftpu_wire_blob_stats" in metrics
         assert await c.listdir("/.meta/graphs") == ["active"]
         assert sorted(await c.listdir("/.meta/graphs/active")) == \
             ["locks", "posix"]

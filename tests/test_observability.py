@@ -1,0 +1,625 @@
+"""End-to-end observability (ISSUE 4): log-bucket latency histograms +
+percentile math, wire-propagated trace spans (client -> server ->
+posix), compound-chain span nesting, slow-fop span-tree logging,
+live-downgrade peers ignoring the trace wire field, and the unified
+metrics registry (families, monotonicity, .meta/metrics, the daemon
+endpoint, the per-brick metrics_dump RPC)."""
+
+import asyncio
+import os
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core import tracing
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.metrics import (HIST_BUCKETS, LogHistogram,
+                                        REGISTRY)
+from glusterfs_tpu.daemon import serve_brick
+from glusterfs_tpu.core import gflog
+
+from .harness import BRICK_VOLFILE
+
+CLIENT_VOLFILE = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume locks
+end-volume
+"""
+
+# brick graph with a protocol/server top so capability options
+# (trace-fops) are enforceable, plus io-stats for the RPC extras
+SERVER_TOP_VOLFILE = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+volume stats
+    type debug/io-stats
+    subvolumes locks
+end-volume
+volume srv
+    type protocol/server
+    option trace-fops {trace}
+    subvolumes stats
+end-volume
+"""
+
+SRV_CLIENT_VOLFILE = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume stats
+end-volume
+"""
+
+
+async def _connect(port, volfile=CLIENT_VOLFILE):
+    g = Graph.construct(volfile.format(port=port))
+    c = Client(g)
+    await c.mount()
+    for _ in range(200):
+        if g.top.connected:
+            break
+        await asyncio.sleep(0.05)
+    assert g.top.connected
+    return c, g
+
+
+# -- histogram math --------------------------------------------------------
+
+def test_histogram_percentiles_known_samples():
+    """Percentile math against a known sample set: bucket i holds
+    [2^(i-1), 2^i) µs and percentile() reports the bucket's UPPER
+    bound in seconds."""
+    h = LogHistogram()
+    # 90 samples of ~3µs (bucket 2: (2,4]µs upper bound 4µs) and 10 of
+    # ~1000µs (bucket 10: (512,1024]µs upper bound 1024µs)
+    for _ in range(90):
+        h.record(3e-6)
+    for _ in range(10):
+        h.record(1000e-6)
+    assert h.total == 100
+    assert h.percentile(50) == pytest.approx(4e-6)
+    assert h.percentile(90) == pytest.approx(4e-6)
+    assert h.percentile(99) == pytest.approx(1024e-6)
+    # empty histogram: percentiles are 0, not a crash
+    assert LogHistogram().percentile(50) == 0.0
+
+
+def test_histogram_bucket_edges_and_merge():
+    h = LogHistogram()
+    h.record(0.0)            # sub-µs -> bucket 0
+    h.record(1e-6)           # 1µs -> bit_length(1)=1 -> bucket 1
+    h.record(1e6)            # absurdly slow -> clamped to last bucket
+    assert h.buckets[0] == 1 and h.buckets[1] == 1
+    assert h.buckets[HIST_BUCKETS - 1] == 1
+    other = LogHistogram()
+    other.record(3e-6)
+    h.merge(other)
+    assert h.total == 4 and h.buckets[2] == 1
+
+
+def test_fop_stats_percentiles_surface(tmp_path):
+    """p50/p90/p99 show up in layer stats -> statedump -> io-stats
+    profile (the volume-profile feed)."""
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume stats
+    type debug/io-stats
+    subvolumes posix
+end-volume
+"""
+    async def run():
+        g = Graph.construct(vf)
+        c = Client(g)
+        await c.mount()
+        await c.write_file("/f", b"x" * 1000)
+        st = g.by_name["stats"]
+        prof = st.profile()
+        assert "latency_p50" in prof["fops"]["writev"]
+        assert prof["fops"]["writev"]["latency_p99"] >= \
+            prof["fops"]["writev"]["latency_p50"] > 0
+        dump = g.by_name["posix"].statedump()
+        assert "latency_p50" in dump["stats"]["writev"]
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_latency_measurement_gates_histograms(tmp_path):
+    """io-stats latency-measurement off: count/avg/max keep counting,
+    the histograms stop (and the option re-arms live)."""
+    from glusterfs_tpu.core import layer as layer_mod
+
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume stats
+    type debug/io-stats
+    option latency-measurement off
+    subvolumes posix
+end-volume
+"""
+    async def run():
+        g = Graph.construct(vf)
+        c = Client(g)
+        await c.mount()
+        try:
+            assert layer_mod.HISTOGRAMS_ENABLED is False
+            await c.write_file("/f", b"x")
+            st = g.by_name["posix"].stats["writev"]
+            assert st.count > 0 and st.hist.total == 0
+            assert "latency_p50" not in st.to_dict()
+            g.by_name["stats"].reconfigure({"latency-measurement": "on"})
+            assert layer_mod.HISTOGRAMS_ENABLED is True
+            await c.write_file("/g", b"x")
+            assert g.by_name["posix"].stats["writev"].hist.total > 0
+        finally:
+            layer_mod.HISTOGRAMS_ENABLED = True
+            await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_dark_process_survives_iostats_init(tmp_path):
+    """GFTPU_NO_OBSERVABILITY darkening must WIN over io-stats init:
+    latency-measurement defaults 'on', and mounting a graph with an
+    io-stats layer must not re-arm histograms on a darkened process
+    (the bench metrics-off pass mounts volumes mid-pass)."""
+    from glusterfs_tpu.core import layer as layer_mod
+
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume stats
+    type debug/io-stats
+    subvolumes posix
+end-volume
+"""
+    async def run():
+        tracing.DARK = True
+        layer_mod.HISTOGRAMS_ENABLED = False
+        g = Graph.construct(vf)
+        c = Client(g)
+        await c.mount()
+        try:
+            assert layer_mod.HISTOGRAMS_ENABLED is False
+            await c.write_file("/f", b"x")
+            assert g.by_name["posix"].stats["writev"].hist.total == 0
+        finally:
+            tracing.DARK = False
+            layer_mod.HISTOGRAMS_ENABLED = True
+            await c.unmount()
+
+    asyncio.run(run())
+
+
+# -- trace propagation -----------------------------------------------------
+
+def test_trace_propagation_client_server_posix(tmp_path):
+    """One wire readv = ONE trace id spanning the client graph, the
+    brick dispatch and storage/posix (>= 3 spans), visible in
+    statedump."""
+    async def run():
+        server = await serve_brick(
+            BRICK_VOLFILE.format(dir=tmp_path / "b"))
+        c, g = await _connect(server.port)
+        try:
+            await c.write_file("/x", b"payload" * 1024)
+            tracing.SPANS.clear()
+            assert await c.read_file("/x") == b"payload" * 1024
+            spans = list(tracing.SPANS)
+            readv = [s for s in spans if s[3] == "readv"]
+            tids = {s[0] for s in readv}
+            assert len(tids) == 1, readv
+            layers = {s[2] for s in readv}
+            # client graph (c0), brick graph (locks), storage (posix)
+            assert {"c0", "locks", "posix"} <= layers
+            assert len(readv) >= 3
+            # the root is the client layer; brick spans nest deeper
+            by_layer = {s[2]: s[1] for s in readv}
+            assert by_layer["c0"] == 0
+            assert by_layer["posix"] > by_layer["locks"] > 0
+            # statedump surfaces the ring
+            dumped = g.statedump()["trace_spans"]
+            assert any(d["op"] == "readv" and d["layer"] == "posix"
+                       for d in dumped)
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_compound_chain_single_trace(tmp_path):
+    """One compound chain = one trace: the chain's outermost compound
+    call is the root span and every link is a child span under the
+    same id."""
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume stats
+    type debug/io-stats
+    subvolumes posix
+end-volume
+"""
+    async def run():
+        from glusterfs_tpu.core.layer import Loc
+        from glusterfs_tpu.rpc import compound as cfop
+
+        g = Graph.construct(vf)
+        c = Client(g)
+        await c.mount()
+        try:
+            tracing.SPANS.clear()
+            replies = await g.top.compound([
+                ("create", (Loc("/f"), os.O_RDWR, 0o644), {}),
+                ("writev", (cfop.FdRef(0), b"abc", 0), {}),
+                ("flush", (cfop.FdRef(0),), {}),
+                ("release", (cfop.FdRef(0),), {})])
+            assert cfop.first_error(replies) is None
+            spans = list(tracing.SPANS)
+            roots = [s for s in spans if s[1] == 0]
+            assert len(roots) == 1 and roots[0][3] == "compound"
+            tid = roots[0][0]
+            assert all(s[0] == tid for s in spans), spans
+            link_ops = {s[3] for s in spans if s[1] > 0}
+            assert {"create", "writev", "flush"} <= link_ops
+        finally:
+            await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_slow_fop_threshold_logs_tree(tmp_path):
+    """A root fop slower than diagnostics.slow-fop-threshold logs its
+    full span tree (and bumps the slow-fop counter)."""
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume slow
+    type debug/delay-gen
+    option delay-duration 20000
+    option delay-percentage 100
+    option enable writev
+    subvolumes posix
+end-volume
+volume stats
+    type debug/io-stats
+    option slow-fop-threshold 0.005
+    subvolumes slow
+end-volume
+"""
+    async def run():
+        g = Graph.construct(vf)
+        c = Client(g)
+        await c.mount()
+        try:
+            before = tracing.SLOW_FOPS.value
+            await c.write_file("/f", b"x")
+            assert tracing.SLOW_FOPS.value > before
+            logs = "\n".join(gflog.recent_messages(50))
+            assert "slow fop" in logs
+            # the logged tree names the layer below (where time went)
+            assert "slow.writev" in logs or "posix.writev" in logs
+        finally:
+            tracing.SLOW_FOP_THRESHOLD = 0.0
+            await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_live_downgrade_peer_ignores_trace_field(tmp_path):
+    """A brick with diagnostics.trace-propagation off never advertises
+    trace at SETVOLUME: the client sends bare 3-element frames, I/O
+    keeps working, and brick-side spans mint their OWN ids instead of
+    joining the client's."""
+    async def run():
+        server = await serve_brick(SERVER_TOP_VOLFILE.format(
+            dir=tmp_path / "b", trace="off"))
+        c, g = await _connect(server.port, SRV_CLIENT_VOLFILE)
+        try:
+            assert g.top._peer_trace is False
+            await c.write_file("/x", b"data" * 2048)
+            tracing.SPANS.clear()
+            assert await c.read_file("/x") == b"data" * 2048
+            readv = [s for s in list(tracing.SPANS) if s[3] == "readv"]
+            client_tids = {s[0] for s in readv if s[2] == "c0"}
+            brick_tids = {s[0] for s in readv if s[2] == "posix"}
+            assert client_tids and brick_tids
+            assert not (client_tids & brick_tids)
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_trace_enabled_peer_joins(tmp_path):
+    """Counter-case to the downgrade test: with the server option on
+    (the default) the brick's posix spans carry the client's id."""
+    async def run():
+        server = await serve_brick(SERVER_TOP_VOLFILE.format(
+            dir=tmp_path / "b", trace="on"))
+        c, g = await _connect(server.port, SRV_CLIENT_VOLFILE)
+        try:
+            assert g.top._peer_trace is True
+            await c.write_file("/x", b"data" * 2048)
+            tracing.SPANS.clear()
+            await c.read_file("/x")
+            readv = [s for s in list(tracing.SPANS) if s[3] == "readv"]
+            client_tids = {s[0] for s in readv if s[2] == "c0"}
+            brick_tids = {s[0] for s in readv if s[2] == "posix"}
+            assert client_tids & brick_tids
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_trace_fops_toggles_live(tmp_path):
+    """The client's trace-fops option is read per-call: a live
+    volume-set of diagnostics.trace-propagation off stops the wire
+    field without a reconnect (the compound-fops pattern)."""
+    async def run():
+        server = await serve_brick(SERVER_TOP_VOLFILE.format(
+            dir=tmp_path / "b", trace="on"))
+        c, g = await _connect(server.port, SRV_CLIENT_VOLFILE)
+        try:
+            await c.write_file("/x", b"live" * 2048)
+            g.top.reconfigure({"trace-fops": "off"})
+            tracing.SPANS.clear()
+            await c.read_file("/x")
+            readv = [s for s in list(tracing.SPANS) if s[3] == "readv"]
+            client_tids = {s[0] for s in readv if s[2] == "c0"}
+            brick_tids = {s[0] for s in readv if s[2] == "posix"}
+            assert client_tids and brick_tids
+            assert not (client_tids & brick_tids)  # field stopped
+            g.top.reconfigure({"trace-fops": "on"})
+            tracing.SPANS.clear()
+            await c.read_file("/x")
+            readv = [s for s in list(tracing.SPANS) if s[3] == "readv"]
+            assert {s[0] for s in readv if s[2] == "c0"} & \
+                {s[0] for s in readv if s[2] == "posix"}
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_span_ring_bounded():
+    tracing.set_ring_size(64)
+    try:
+        for i in range(500):
+            tracing.SPANS.append(("t", 0, "l", "op", 0.0, 0.0, False))
+        assert len(tracing.SPANS) == 64
+    finally:
+        tracing.set_ring_size(4096)
+
+
+# -- unified metrics registry ----------------------------------------------
+
+def test_registry_families_present_and_monotonic(tmp_path):
+    """The acceptance families: decode-program cache events and
+    wire.blob_stats, present in the render and monotonic across wire
+    traffic."""
+    from glusterfs_tpu.ops import gf256
+
+    # touch the decode-program cache so the family has real counts
+    gf256.decode_program(4, (0, 1, 2, 4))
+    gf256.decode_program(4, (0, 1, 2, 4))
+
+    def family_value(snap, name, **labels):
+        total = 0
+        for lbl, v in snap[name]["samples"]:
+            if all(lbl.get(k) == val for k, val in labels.items()):
+                total += v
+        return total
+
+    async def run():
+        server = await serve_brick(
+            BRICK_VOLFILE.format(dir=tmp_path / "b"))
+        c, _g = await _connect(server.port)
+        try:
+            snap0 = REGISTRY.snapshot()
+            assert "gftpu_wire_blob_stats" in snap0
+            assert "gftpu_decode_program_cache_events_total" in snap0
+            assert family_value(
+                snap0, "gftpu_decode_program_cache_events_total",
+                cache="decode", event="hits") >= 1
+            await c.write_file("/m", b"z" * 65536)
+            await c.read_file("/m")
+            snap1 = REGISTRY.snapshot()
+            b0 = family_value(snap0, "gftpu_wire_blob_stats",
+                              counter="tx_bytes")
+            b1 = family_value(snap1, "gftpu_wire_blob_stats",
+                              counter="tx_bytes")
+            assert b1 > b0
+            text = REGISTRY.render()
+            assert "# TYPE gftpu_wire_blob_stats counter" in text
+            assert 'counter="tx_bytes"' in text
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_registry_collector_isolation():
+    """A raising collector loses only its own family."""
+    REGISTRY.register("gftpu_test_bad", "gauge", "boom",
+                      lambda: (_ for _ in ()).throw(RuntimeError()))
+    try:
+        snap = REGISTRY.snapshot()
+        assert "gftpu_test_bad" not in snap
+        assert "gftpu_wire_blob_stats" in snap
+    finally:
+        REGISTRY.unregister("gftpu_test_bad")
+
+
+def test_metrics_dump_rpc_and_daemon_endpoint(tmp_path):
+    """metrics_dump resolves by graph walk over the wire (the `gftpu
+    volume metrics` backend), and the daemon's opt-in HTTP endpoint
+    serves the same text dump."""
+    async def run():
+        from glusterfs_tpu.daemon import serve_metrics
+
+        server = await serve_brick(SERVER_TOP_VOLFILE.format(
+            dir=tmp_path / "b", trace="on"))
+        c, g = await _connect(server.port, SRV_CLIENT_VOLFILE)
+        msrv = await serve_metrics("127.0.0.1", 0)
+        try:
+            snap = await g.top.remote("metrics_dump")
+            assert "gftpu_wire_blob_stats" in snap
+            assert snap["gftpu_wire_blob_stats"]["type"] == "counter"
+            mport = msrv.sockets[0].getsockname()[1]
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", mport)
+            writer.write(b"GET /metrics HTTP/1.0\r\n\r\n")
+            await writer.drain()
+            body = await reader.read()
+            writer.close()
+            assert b"200 OK" in body
+            assert b"gftpu_wire_blob_stats" in body
+        finally:
+            msrv.close()
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- satellite regressions -------------------------------------------------
+
+def test_iostats_compound_readv_replay(tmp_path):
+    """Fused read chains must not vanish from `volume profile`: an ok
+    readv link's reply bytes land in read_bytes + the per-path reads
+    counters (writev was handled, readv was not)."""
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume stats
+    type debug/io-stats
+    subvolumes posix
+end-volume
+"""
+    async def run():
+        from glusterfs_tpu.core.layer import Loc
+        from glusterfs_tpu.rpc import compound as cfop
+
+        g = Graph.construct(vf)
+        c = Client(g)
+        await c.mount()
+        try:
+            await c.write_file("/f", b"0123456789")
+            st = g.by_name["stats"]
+            st.read_bytes = 0
+            replies = await g.top.compound([
+                ("lookup", (Loc("/f"),), {}),
+                ("open", (Loc("/f"), os.O_RDONLY), {}),
+                ("readv", (cfop.FdRef(1), 1 << 20, 0), {}),
+                ("release", (cfop.FdRef(1),), {})])
+            assert cfop.first_error(replies) is None
+            assert st.read_bytes == 10
+            rows = st.top("read")
+            assert rows and rows[0]["path"] == "/f"
+            assert rows[0]["read_bytes"] == 10
+        finally:
+            await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_trace_layer_exclude_ops_reconfigure(tmp_path):
+    """Live `volume set ... exclude-ops` takes effect: the excluded set
+    is re-derived in reconfigure (it was frozen at init)."""
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume tr
+    type debug/trace
+    subvolumes posix
+end-volume
+"""
+    async def run():
+        g = Graph.construct(vf)
+        c = Client(g)
+        await c.mount()
+        try:
+            tr = g.by_name["tr"]
+            await c.write_file("/a", b"x")
+            assert any("writev" in line for line in tr.history)
+            tr.reconfigure({"exclude-ops": "writev,flush"})
+            assert tr._excluded == {"writev", "flush"}
+            tr.history.clear()
+            await c.write_file("/b", b"x")
+            assert not any("writev(" in line for line in tr.history)
+        finally:
+            await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_iostats_dump_interval_restarts_live(tmp_path):
+    """A live diagnostics.stats-dump-interval change cancels the old
+    dump task and arms one on the new interval."""
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume stats
+    type debug/io-stats
+    subvolumes posix
+end-volume
+"""
+    async def run():
+        g = Graph.construct(vf)
+        c = Client(g)
+        await c.mount()
+        try:
+            st = g.by_name["stats"]
+            assert st._dump_task is None
+            st.reconfigure({"ios-dump-interval": "0.05"})
+            task = st._dump_task
+            assert task is not None
+            for _ in range(40):  # EXPECT_WITHIN: loaded-host tolerant
+                if any("stats: profile" in line
+                       for line in gflog.recent_messages(50)):
+                    break
+                await asyncio.sleep(0.1)
+            logs = "\n".join(gflog.recent_messages(50))
+            assert "stats: profile" in logs
+            st.reconfigure({"ios-dump-interval": "0"})
+            assert st._dump_task is None
+            await asyncio.sleep(0)
+            assert task.cancelled() or task.done()
+        finally:
+            await c.unmount()
+
+    asyncio.run(run())

@@ -9,6 +9,11 @@
 #                               file) — a broken emit() loses a whole
 #                               round's record, so it gates merges even
 #                               though the full bench doesn't
+#   3. metrics smoke            start a 1-brick volume, drive two fops,
+#                               scrape the unified registry and assert
+#                               the required families are present and
+#                               monotonic (ISSUE 4: a silently-empty
+#                               metrics dump must not merge)
 #
 # Usage:  tools/ci.sh [extra pytest args for the tier-1 runs...]
 # Exit: first failing stage's code; 0 = mergeable.
@@ -34,9 +39,85 @@ if [ $bench_rc -ne 0 ]; then
     exit $bench_rc
 fi
 
+echo "== ci: metrics smoke (1-brick volume, scrape + monotonicity) =="
+timeout -k 10 120 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, os, tempfile
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.metrics import REGISTRY
+from glusterfs_tpu.daemon import serve_brick
+
+BRICK = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+volume stats
+    type debug/io-stats
+    subvolumes locks
+end-volume
+"""
+CLIENT = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume stats
+end-volume
+"""
+REQUIRED = ("gftpu_wire_blob_stats",
+            "gftpu_decode_program_cache_events_total",
+            "gftpu_codec_device_probe",
+            "gftpu_slow_fops_total")
+
+def tx_bytes(snap):
+    return sum(v for l, v in snap["gftpu_wire_blob_stats"]["samples"]
+               if l.get("counter") == "tx_bytes")
+
+async def main():
+    base = tempfile.mkdtemp(prefix="metrics-smoke")
+    server = await serve_brick(BRICK.format(dir=os.path.join(base, "b")))
+    g = Graph.construct(CLIENT.format(port=server.port))
+    c = Client(g)
+    await c.mount()
+    for _ in range(200):
+        if g.top.connected:
+            break
+        await asyncio.sleep(0.05)
+    assert g.top.connected, "client never connected"
+    snap0 = REGISTRY.snapshot()
+    for fam in REQUIRED:
+        assert fam in snap0, f"missing metrics family {fam}"
+    await c.write_file("/smoke", b"m" * 65536)      # fop 1
+    assert await c.read_file("/smoke") == b"m" * 65536  # fop 2
+    snap1 = REGISTRY.snapshot()
+    assert tx_bytes(snap1) > tx_bytes(snap0), \
+        "wire blob counters not monotonic across fops"
+    rpc = await g.top.remote("metrics_dump")
+    assert "gftpu_wire_blob_stats" in rpc, "metrics_dump RPC empty"
+    text = REGISTRY.render()
+    assert "# TYPE gftpu_wire_blob_stats counter" in text
+    await c.unmount()
+    await server.stop()
+    print("metrics smoke: families present, counters monotonic")
+
+asyncio.run(main())
+EOF
+smoke_rc=$?
+if [ $smoke_rc -ne 0 ]; then
+    echo "ci: metrics smoke failed — not mergeable"
+    exit $smoke_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
 fi
-echo "ci: mergeable (two identical green tier-1 runs + bench contract)"
+echo "ci: mergeable (two identical green tier-1 runs + bench contract"
+echo "    + metrics smoke)"
 exit 0
