@@ -159,6 +159,9 @@ class ReplicateLayer(Layer):
         self._rr = 0
         self._lk_owner = gfid_new()
         self._locks_supported: bool | None = None
+        # last announced quorum state (events.h EVENT_AFR_QUORUM_MET /
+        # EVENT_AFR_QUORUM_FAIL fire only on the TRANSITION)
+        self._quorum_ok = True
 
     # -- membership --------------------------------------------------------
 
@@ -176,9 +179,19 @@ class ReplicateLayer(Layer):
                 self.up[idx] = False
             elif event is Event.CHILD_UP:
                 self.up[idx] = True
-            ev = Event.CHILD_UP if self._quorum_met(
-                {i for i, u in enumerate(self.up) if u}) else \
-                Event.CHILD_DOWN
+            ok = self._quorum_met(
+                {i for i, u in enumerate(self.up) if u})
+            if ok != self._quorum_ok:
+                # quorum edge (afr_notify, events.h): LOST means this
+                # replica set stopped accepting writes — the cluster's
+                # pulse, not a per-fop error someone may never read
+                self._quorum_ok = ok
+                from ..core.events import gf_event
+
+                gf_event("AFR_QUORUM_MET" if ok else "AFR_QUORUM_FAIL",
+                         subvol=self.name, up=sum(self.up),
+                         children=self.n)
+            ev = Event.CHILD_UP if ok else Event.CHILD_DOWN
             for p in self.parents:
                 p.notify(ev, self, data)
             return

@@ -253,6 +253,9 @@ class DisperseLayer(Layer):
         # reassembly straight from fragment buffers (no staging copy),
         # "staged" = the decode path through the frags array
         self.read_fanout = {"fast": 0, "staged": 0}
+        # last announced "≥K children up" state (events.h
+        # EVENT_EC_MIN_BRICKS_UP / _NOT_UP fire on the transition)
+        self._min_up_ok = True
         _LIVE_EC_LAYERS.add(self)  # unified-registry scrape target
 
     def reconfigure(self, options: dict) -> None:
@@ -339,6 +342,17 @@ class DisperseLayer(Layer):
                             source.name, sum(self.up), self.n)
             elif event is Event.CHILD_UP:
                 self.up[idx] = True
+            ok = sum(self.up) >= self.k
+            if ok != self._min_up_ok:
+                # read-quorum edge (ec_notify, ec.c:571): below K the
+                # disperse set can neither read nor write
+                self._min_up_ok = ok
+                from ..core.events import gf_event
+
+                gf_event("EC_MIN_BRICKS_UP" if ok
+                         else "EC_MIN_BRICKS_NOT_UP",
+                         subvol=self.name, up=sum(self.up), k=self.k,
+                         children=self.n)
             if sum(self.up) >= self.k:
                 for p in self.parents:
                     p.notify(Event.CHILD_UP if event is Event.CHILD_UP

@@ -20,6 +20,19 @@ import time
 _endpoint: tuple[str, int] | None = None
 _sock: socket.socket | None = None
 
+# emission accounting (absorbed by the unified registry the same way
+# wire.blob_stats is): how many lifecycle events this process fired,
+# dropped on a send error, or suppressed because no eventsd is
+# configured — the event plane's own health must be observable too
+emit_stats = {"sent": 0, "send_failed": 0, "unconfigured": 0}
+
+from . import metrics as _metrics  # noqa: E402
+
+_metrics.REGISTRY.register(
+    "gftpu_events_emitted_total", "counter",
+    "gf_event emissions by outcome (sent / send_failed / unconfigured)",
+    lambda: _metrics.labeled(emit_stats))
+
 
 def configure(endpoint: str | None) -> None:
     """'host:port' enables emission in this process; None disables."""
@@ -52,11 +65,14 @@ def gf_event(event: str, **fields) -> bool:
     """Emit one event; returns whether a datagram was sent."""
     target = _resolve()
     if target is None:
+        emit_stats["unconfigured"] += 1
         return False
     payload = {"event": event, "ts": time.time(), "pid": os.getpid()}
     payload.update(fields)
     try:
         _sock.sendto(json.dumps(payload).encode(), target)
+        emit_stats["sent"] += 1
         return True
     except OSError:
+        emit_stats["send_failed"] += 1
         return False

@@ -122,8 +122,13 @@ class InodeTable:
 
     def dump(self) -> dict:
         with self._lock:
+            # active = referenced (nlookup > 0) inodes; everything in
+            # the LRU is by construction unreferenced (the reference's
+            # itable dump splits active/lru the same way, inode.c
+            # inode_table_dump)
             return {
                 "inodes": len(self._by_gfid),
+                "active": len(self._by_gfid) - len(self._lru),
                 "dentries": len(self._dentries),
                 "lru": len(self._lru),
                 "lru_limit": self.lru_limit,

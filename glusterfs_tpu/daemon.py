@@ -105,6 +105,13 @@ def _dump_state(server: BrickServer, volfile: str) -> None:
 
 
 async def _amain(args) -> None:
+    if getattr(args, "eventsd", ""):
+        # arm gf_event emission for this process (CLIENT_CONNECT /
+        # POSIX_HEALTH_CHECK_FAILED ...); same effect as GFTPU_EVENTSD
+        # in the environment, but explicit per-daemon
+        from .core import events
+
+        events.configure(args.eventsd)
     with open(args.volfile) as f:
         text = f.read()
     server = await serve_brick(text, args.host, args.listen,
@@ -138,6 +145,10 @@ def main(argv=None) -> int:
                    help="serve the unified metrics registry as a "
                         "Prometheus text endpoint on this port "
                         "(0 = off, the default)")
+    p.add_argument("--eventsd", default="",
+                   help="host:port of the local gftpu-eventsd: arms "
+                        "gf_event lifecycle emission in this process "
+                        "(same as the GFTPU_EVENTSD env var)")
     args = p.parse_args(argv)
     asyncio.run(_amain(args))
     return 0

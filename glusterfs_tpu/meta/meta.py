@@ -11,6 +11,9 @@ Virtual tree served here:
 
     /.meta/version                       package version
     /.meta/logging                       recent in-memory log ring
+    /.meta/connections                   protocol/client transports +
+                                         wire byte accounting
+    /.meta/metrics                       unified registry text dump
     /.meta/graphs/active/<layer>/type    layer type name
     /.meta/graphs/active/<layer>/options validated live option values
     /.meta/graphs/active/<layer>/private dump_private() JSON
@@ -54,7 +57,17 @@ class MetaLayer(Layer):
         ("file", bytes) or None."""
         parts = [p for p in path.split("/") if p]
         if not parts:
-            return "dir", ["version", "logging", "metrics", "graphs"]
+            return "dir", ["version", "logging", "metrics",
+                           "connections", "graphs"]
+        if parts == ["connections"]:
+            # every protocol/client transport below: connection state +
+            # wire accounting (the client half of `volume status
+            # clients` — same counters, read from this end)
+            rows = [{"layer": l.name, **l.dump_private()}
+                    for l in self._layers().values()
+                    if hasattr(l, "rpc_roundtrips")]
+            return "file", json.dumps(rows, indent=1,
+                                      default=repr).encode()
         if parts == ["version"]:
             from .. import __version__
 

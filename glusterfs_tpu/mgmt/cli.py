@@ -5,9 +5,10 @@ Command surface kept (cli-cmd-volume.c vocabulary):
 
     gftpu volume create NAME [disperse N | replica N] BRICK...
     gftpu volume start|stop|delete NAME
-    gftpu volume info [NAME] | status NAME
+    gftpu volume info [NAME]
+    gftpu volume status NAME [detail|clients|fds|inodes|callpool|mem]
     gftpu volume set NAME KEY VALUE
-    gftpu volume heal NAME [info] [PATH]
+    gftpu volume heal NAME [info] [PATH] | statistics heal-count
     gftpu volume quota NAME enable|disable|list|limit-usage PATH BYTES|remove PATH
     gftpu volume rebalance NAME
     gftpu volume profile NAME
@@ -27,6 +28,7 @@ import json
 import sys
 from typing import Any
 
+from ..protocol.server import STATUS_KINDS
 from .glusterd import MgmtClient, mount_volume
 
 
@@ -36,6 +38,89 @@ def _fmt(v: Any, as_json: bool, as_xml: bool = False) -> str:
     if as_json:
         return json.dumps(v, indent=1, default=repr)
     return _pretty(v)
+
+
+def _table(headers: list[str], rows: list[list]) -> str:
+    """Fixed-width text table (cli-cmd-volume.c's human status
+    rendering analog)."""
+    cells = [[str(c) for c in r] for r in rows]
+    widths = [max(len(h), *(len(r[i]) for r in cells)) if cells
+              else len(h) for i, h in enumerate(headers)]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(c.ljust(w) for c, w in zip(r, widths))
+              for r in cells]
+    return "\n".join(lines)
+
+
+def _human_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def _status_human(what: str, out: dict) -> str:
+    """Human tables for the deep-status kinds that are naturally
+    tabular; the rest fall back to the generic tree rendering."""
+    parts = []
+    if out.get("partial"):
+        parts.append("WARNING: partial answer — missing nodes: "
+                     + ", ".join(out["partial"]))
+    bricks = out.get("bricks", {})
+    if what == "clients":
+        rows = []
+        for bname in sorted(bricks):
+            payload = bricks[bname] or {}
+            for c in payload.get("clients", ()):
+                rows.append([bname, c["client"][:16], c["addr"],
+                             f"{c['uptime']:.0f}s",
+                             _human_bytes(c["bytes_rx"]),
+                             _human_bytes(c["bytes_tx"]),
+                             c["fops"], c["opened_fds"],
+                             "mgmt" if c.get("mgmt") else
+                             f"op-{c.get('op_version', 0)}"])
+            if payload.get("offline"):
+                rows.append([bname, "-", "-", "-", "-", "-", "-", "-",
+                             "OFFLINE"])
+        parts.append(_table(["BRICK", "CLIENT", "ADDR", "UPTIME", "RX",
+                             "TX", "FOPS", "FDS", "KIND"], rows))
+        return "\n".join(parts)
+    if what == "fds":
+        rows = []
+        for bname in sorted(bricks):
+            payload = bricks[bname] or {}
+            for tab in payload.get("fd_tables", ()):
+                for fd in tab["fds"]:
+                    rows.append([bname, tab["client"][:16], fd["fd"],
+                                 fd["path"] or fd["gfid"][:16],
+                                 fd["flags"]])
+            if payload.get("offline"):
+                rows.append([bname, "-", "-", "OFFLINE", "-"])
+        parts.append(_table(["BRICK", "CLIENT", "FD", "PATH", "FLAGS"],
+                            rows))
+        return "\n".join(parts)
+    if what == "detail":
+        rows = []
+        for bname in sorted(bricks):
+            payload = bricks[bname] or {}
+            for be in payload.get("backends", ()):
+                bs = be.get("block_size", 0)
+                rows.append([
+                    bname, be["path"], be["health"],
+                    _human_bytes(be.get("blocks_avail", 0) * bs),
+                    _human_bytes(be.get("blocks_total", 0) * bs),
+                    be.get("inodes_free", "-"),
+                    "yes" if be.get("reserve_limited") else "no"])
+            if payload.get("offline"):
+                rows.append([bname, "-", "OFFLINE", "-", "-", "-", "-"])
+        parts.append(_table(["BRICK", "PATH", "HEALTH", "FREE", "TOTAL",
+                             "INODES-FREE", "RESERVE-LIMITED"], rows))
+        return "\n".join(parts)
+    parts.append(_pretty(out))
+    return "\n".join(parts)
 
 
 _NCNAME = None
@@ -186,7 +271,19 @@ async def _run(args) -> Any:
                                     group_size=group, arbiter=arbiter,
                                     thin_arbiter=thin,
                                     systematic=systematic)
-        if sub in ("start", "stop", "delete", "status"):
+        if sub == "status":
+            # volume status NAME [detail|clients|fds|inodes|callpool|mem]
+            what = args.args[0] if args.args else ""
+            async with MgmtClient(host, port) as c:
+                if not what:
+                    return await c.call("volume-status", name=args.name)
+                if what not in STATUS_KINDS:
+                    raise SystemExit(
+                        "usage: volume status NAME "
+                        "[detail|clients|fds|inodes|callpool|mem]")
+                return await c.call("volume-status-deep",
+                                    name=args.name, what=what)
+        if sub in ("start", "stop", "delete"):
             async with MgmtClient(host, port) as c:
                 return await c.call(f"volume-{sub}", name=args.name)
         if sub == "info":
@@ -198,6 +295,16 @@ async def _run(args) -> Any:
                 return await c.call("volume-set", name=args.name,
                                     key=args.args[0], value=args.args[1])
         if sub == "heal":
+            if args.args and args.args[0] == "statistics":
+                # volume heal NAME statistics heal-count — answered
+                # from the bricks' index counters through glusterd, no
+                # temporary client graph mounted
+                if len(args.args) > 1 and args.args[1] != "heal-count":
+                    raise SystemExit("usage: volume heal NAME "
+                                     "statistics heal-count")
+                async with MgmtClient(host, port) as c:
+                    return await c.call("volume-heal-count",
+                                        name=args.name)
             client = await mount_volume(host, port, args.name)
             try:
                 top = _find_cluster_layer(client.graph)
@@ -478,6 +585,11 @@ def main(argv=None) -> int:
         else:
             print(f"error: {e}", file=sys.stderr)
         return 1
+    if not args.json and not args.xml and args.cmd == "volume" and \
+            args.sub == "status" and args.args and \
+            args.args[0] in STATUS_KINDS and isinstance(out, dict):
+        print(_status_human(args.args[0], out))
+        return 0
     print(_fmt(out, args.json, args.xml))
     return 0
 

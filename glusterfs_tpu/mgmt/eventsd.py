@@ -24,9 +24,25 @@ from urllib.parse import urlparse
 
 from ..core.fops import FopError
 from ..core import gflog
+from ..core import metrics as _metrics
 from ..rpc import wire
 
 log = gflog.get_logger("eventsd")
+
+# event-plane health in the unified registry (weakref: a stopped
+# daemon's families age out) — `eventsapi status` answers humans, these
+# answer the scraper
+_LIVE_EVENTSD = _metrics.REGISTRY.register_objects(
+    "gftpu_events_received_total", "counter",
+    "gf_event datagrams ingested by this eventsd",
+    lambda d: [({}, d.received)])
+_metrics.REGISTRY.register_objects(
+    "gftpu_events_webhook_total", "counter",
+    "webhook delivery outcomes per registered url",
+    lambda d: [({"url": url, "result": k}, v)
+               for url, st in d.webhooks.items()
+               for k, v in st.items()],
+    live=_LIVE_EVENTSD)
 
 
 class _UdpSink(asyncio.DatagramProtocol):
@@ -53,6 +69,7 @@ class EventsDaemon:
         self._transport = None
         self._ctl: asyncio.AbstractServer | None = None
         self._bg: set[asyncio.Task] = set()
+        _LIVE_EVENTSD.add(self)
 
     async def start(self) -> tuple[int, int]:
         loop = asyncio.get_running_loop()
@@ -165,6 +182,13 @@ class EventsDaemon:
 async def _amain(args) -> None:
     d = EventsDaemon(args.host, args.udp_port, args.ctl_port)
     await d.start()
+    metrics_srv = None
+    if args.metrics_port:
+        # the received/webhook counter families above, in Prometheus
+        # text form (shares daemon.serve_metrics with brick processes)
+        from ..daemon import serve_metrics
+
+        metrics_srv = await serve_metrics(args.host, args.metrics_port)
     if args.portfile:
         with open(args.portfile + ".tmp", "w") as f:
             json.dump({"udp": d.udp_port, "ctl": d.ctl_port}, f)
@@ -174,6 +198,8 @@ async def _amain(args) -> None:
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if metrics_srv is not None:
+        metrics_srv.close()
     await d.stop()
 
 
@@ -183,6 +209,10 @@ def main(argv=None) -> int:
     p.add_argument("--udp-port", type=int, default=24009)
     p.add_argument("--ctl-port", type=int, default=24010)
     p.add_argument("--portfile", default="")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve the unified metrics registry (event "
+                        "received/delivered/failed counters) as a "
+                        "Prometheus endpoint (0 = off)")
     args = p.parse_args(argv)
     asyncio.run(_amain(args))
     return 0

@@ -40,20 +40,17 @@ from ..core import gflog
 from ..core.events import gf_event
 from .bitd import DEFAULT_SCRUB_THROTTLE
 from ..core.fops import FopError
+from ..protocol.server import STATUS_KINDS
 from ..rpc import wire
 from . import volgen
 
 log = gflog.get_logger("mgmt")
 
 # this build's management op-version (xlator.h:758 / GD_OP_VERSION):
-# peers advertise theirs at probe time and the cluster operates at the
-# minimum, gating newer volume-set keys until every member upgrades
-OP_VERSION = 7  # 7: observability layer — trace propagation + slow-fop
-                # diagnostics (volgen._V7_KEYS); 6: zero-copy read
-                # pipeline + strict-locks (volgen._V6_KEYS); 5: compound
-                # fops + auth.ssl-allow (volgen._V5_KEYS); 4: round-5
-                # keys (volgen._V4_KEYS); 3: the round-4 option long
-                # tail (volgen._V3_KEYS)
+# the constant lives at the package root so client processes can
+# advertise it without importing the mgmt plane; re-exported here for
+# the historical import path
+from .. import OP_VERSION  # noqa: F401
 
 
 def _new_volinfo(state: dict, name: str, vtype: str, bricks: list,
@@ -1048,9 +1045,31 @@ class Glusterd:
                 "online": proc is not None and proc.poll() is None,
             })
         shd = self.shd.get(name)
-        return {"volume": name, "status": vol["status"], "bricks": bricks,
-                "shd": {"online": shd is not None and shd.poll() is None,
-                        "pid": shd.pid if shd is not None else 0}}
+        out = {"volume": name, "status": vol["status"], "bricks": bricks,
+               "shd": {"online": shd is not None and shd.poll() is None,
+                       "pid": shd.pid if shd is not None else 0}}
+        tasks = self._volume_tasks(vol)
+        if tasks:
+            out["tasks"] = tasks
+        return out
+
+    @staticmethod
+    def _volume_tasks(vol: dict) -> list[dict]:
+        """Active background task state for the status "tasks" section
+        (the reference appends rebalance/remove-brick task rows to
+        every status answer, glusterd-op-sm.c _add_task_to_dict) — the
+        data already lives in volinfo, it just wasn't surfaced."""
+        tasks = []
+        rb = vol.get("remove-brick")
+        if rb:
+            row = {"type": "remove-brick",
+                   "status": rb.get("status", "unknown"),
+                   "bricks": rb.get("bricks", [])}
+            for k in ("progress", "moved", "scanned", "error"):
+                if k in rb:
+                    row[k] = rb[k]
+            tasks.append(row)
+        return tasks
 
     async def op_volume_heal(self, name: str, action: str = "info",
                              path: str = "") -> dict:
@@ -1089,26 +1108,152 @@ class Glusterd:
         finally:
             await client.unmount()
 
+    # -- deep volume status (GF_CLI_STATUS_{DETAIL,CLIENTS,INODE,FD,
+    # CALLPOOL,MEM}, glusterd-op-sm.c) -------------------------------------
+
+    STATUS_KINDS = STATUS_KINDS  # the protocol/server op family
+
+    async def op_volume_status_deep(self, name: str,
+                                    what: str = "clients") -> dict:
+        """``gftpu volume status <v> detail|clients|fds|inodes|
+        callpool|mem`` — per-brick deep state gathered from every
+        node's live brick processes and merged, with a ``partial``
+        field naming unreachable nodes (never a fake-complete merge)."""
+        if what not in self.STATUS_KINDS:
+            raise MgmtError(f"unknown status kind {what!r} "
+                            f"(one of {', '.join(self.STATUS_KINDS)})")
+        vol = self._vol(name)
+        if vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        bricks, partial = await self._gather_bricks(
+            "volume-status-local", nodes=self._vol_nodes(vol),
+            name=name, what=what)
+        return self._merge_partial(
+            {"volume": name, "what": what, "bricks": bricks}, partial)
+
+    async def op_volume_status_local(self, name: str,
+                                     what: str = "clients") -> dict:
+        """One node's share of deep status: its local bricks' __status__
+        RPC (the brick half lives in protocol/server._status_of)."""
+        vol = self._vol(name)
+        out: dict[str, Any] = {}
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid:
+                continue
+            port = self.ports.get(b["name"])
+            proc = self.bricks.get(b["name"])
+            if not port or proc is None or proc.poll() is not None:
+                # a dead LOCAL brick is still reported — as offline,
+                # not silently dropped from the merge
+                out[b["name"]] = {"offline": True}
+                continue
+            try:
+                payload = await self._brick_call(
+                    vol, port, "__status__", [what],
+                    subvol=b["name"] + "-server")
+            except Exception as e:
+                out[b["name"]] = {"offline": True,
+                                  "error": repr(e)[:200]}
+                continue
+            # None = the brick ANSWERED with an error (a pre-__status__
+            # build, or an EINVAL kind): it is live and serving, so
+            # report the refusal — never mislabel it offline
+            out[b["name"]] = payload if payload is not None \
+                else {"error": "__status__ refused "
+                               "(older brick build?)"}
+        return {"bricks": out}
+
+    async def op_volume_heal_count(self, name: str) -> dict:
+        """``volume heal <v> statistics heal-count`` — pending-heal
+        entry counts straight from each brick's index layer
+        (XA_INDEX_COUNT virtual xattr), no temporary client graph
+        mounted (the reference answers from shd counters the same
+        way, glusterd-volume-ops.c heal statistics)."""
+        vol = self._vol(name)
+        if vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        bricks, partial = await self._gather_bricks(
+            "volume-heal-count-local", nodes=self._vol_nodes(vol),
+            name=name)
+        total = sum(v.get("count", 0) for v in bricks.values()
+                    if isinstance(v, dict))
+        return self._merge_partial(
+            {"volume": name, "bricks": bricks, "total": total}, partial)
+
+    async def op_volume_heal_count_local(self, name: str) -> dict:
+        """One node's share of heal-count: each local brick's pending
+        index entry count via one authenticated getxattr."""
+        from ..core.layer import Loc
+        from ..features.index import XA_INDEX_COUNT
+
+        vol = self._vol(name)
+        out: dict[str, dict] = {}
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid:
+                continue
+            port = self.ports.get(b["name"])
+            if not port:
+                out[b["name"]] = {"offline": True, "count": 0}
+                continue
+            try:
+                r = await self._brick_call(
+                    vol, port, "getxattr", [Loc("/"), XA_INDEX_COUNT],
+                    subvol=b["name"] + "-server")
+                out[b["name"]] = {
+                    "count": int((r or {}).get(XA_INDEX_COUNT, b"0"))}
+            except Exception as e:
+                out[b["name"]] = {"offline": True, "count": 0,
+                                  "error": repr(e)[:200]}
+        return {"bricks": out}
+
     _TOP_METRICS = ("open", "read", "write", "read-bytes",
                     "write-bytes")
 
-    async def _gather_bricks(self, local_op: str, **kw) -> dict:
-        """Fan a per-node brick query out to every node CONCURRENTLY
-        (bounded per node) and merge the 'bricks' maps — shared by
-        volume top / profile; a hung peer costs one timeout, not a
-        serial wait, and never hides the other nodes' answers."""
+    def _vol_nodes(self, vol: dict) -> list[dict]:
+        """The nodes actually hosting this volume's bricks (fan-out
+        targets: a peer with no brick of the volume can neither answer
+        nor meaningfully be 'missing' from the merge)."""
+        want = {b["node"] for b in vol["bricks"]}
+        return [n for n in self._all_nodes() if n["uuid"] in want]
+
+    async def _gather_bricks(self, local_op: str, nodes=None,
+                             **kw) -> tuple[dict, list[str]]:
+        """Fan a per-node brick query out CONCURRENTLY (bounded per
+        node) and merge the 'bricks' maps — shared by volume status /
+        top / profile / metrics / heal-count; a hung peer costs one
+        timeout, not a serial wait, and never hides the other nodes'
+        answers.
+
+        Returns ``(bricks, partial)``: a dead or hung peer no longer
+        vanishes into an empty merge — it is NAMED in ``partial`` so
+        every consumer can say which nodes are missing instead of
+        pretending full coverage (the silent-{} bug of ISSUE 5)."""
+        targets = list(nodes) if nodes is not None else self._all_nodes()
+
         async def one(node):
             try:
                 return await asyncio.wait_for(
                     self._node_call(node, local_op, **kw), 30)
-            except Exception:
-                return {}
+            except Exception as e:
+                log.warning(22, "node %s missing from %s fan-out: %r",
+                            node["uuid"][:8], local_op, e)
+                return None
 
-        parts = await asyncio.gather(
-            *(one(n) for n in self._all_nodes()))
+        parts = await asyncio.gather(*(one(n) for n in targets))
         out: dict[str, dict] = {}
-        for part in parts:
+        partial: list[str] = []
+        for node, part in zip(targets, parts):
+            if part is None:
+                partial.append(f"{node['uuid'][:8]}"
+                               f"@{node['host']}:{node['port']}")
+                continue
             out.update(part.get("bricks", {}))
+        return out, partial
+
+    @staticmethod
+    def _merge_partial(out: dict, partial: list[str]) -> dict:
+        if partial:
+            out["partial"] = partial
         return out
 
     async def op_volume_profile(self, name: str) -> dict:
@@ -1119,9 +1264,11 @@ class Glusterd:
         vol = self._vol(name)
         if vol["status"] != "started":
             raise MgmtError(f"volume {name} not started")
-        bricks = await self._gather_bricks("volume-profile-local",
-                                           name=name)
-        return {"volume": name, "bricks": bricks}
+        bricks, partial = await self._gather_bricks(
+            "volume-profile-local", nodes=self._vol_nodes(vol),
+            name=name)
+        return self._merge_partial(
+            {"volume": name, "bricks": bricks}, partial)
 
     async def op_volume_profile_local(self, name: str) -> dict:
         vol = self._vol(name)
@@ -1151,9 +1298,11 @@ class Glusterd:
         vol = self._vol(name)
         if vol["status"] != "started":
             raise MgmtError(f"volume {name} not started")
-        bricks = await self._gather_bricks("volume-metrics-local",
-                                           name=name)
-        return {"volume": name, "bricks": bricks}
+        bricks, partial = await self._gather_bricks(
+            "volume-metrics-local", nodes=self._vol_nodes(vol),
+            name=name)
+        return self._merge_partial(
+            {"volume": name, "bricks": bricks}, partial)
 
     async def op_volume_metrics_local(self, name: str) -> dict:
         """One node's share of volume-metrics: its local bricks."""
@@ -1188,10 +1337,12 @@ class Glusterd:
         vol = self._vol(name)
         if vol["status"] != "started":
             raise MgmtError(f"volume {name} not started")
-        bricks = await self._gather_bricks(
-            "volume-top-local", name=name, metric=metric,
-            count=int(count))
-        return {"volume": name, "metric": metric, "bricks": bricks}
+        bricks, partial = await self._gather_bricks(
+            "volume-top-local", nodes=self._vol_nodes(vol), name=name,
+            metric=metric, count=int(count))
+        return self._merge_partial(
+            {"volume": name, "metric": metric, "bricks": bricks},
+            partial)
 
     async def op_volume_top_local(self, name: str, metric: str = "open",
                                   count: int = 10) -> dict:

@@ -109,6 +109,14 @@ async def full_crawl(client) -> dict:
         key = "skipped" if res.get("skipped") else "healed"
         report[key].append({"path": path,
                             "bricks": res.get("healed", [])})
+        if key == "healed" and res.get("healed"):
+            # the index sweep already announces its completions; the
+            # full sweep repairs bricks with no pending record and must
+            # show on the same event stream
+            from ..core.events import gf_event
+
+            gf_event("HEAL_COMPLETE", path=path,
+                     bricks=res.get("healed", []))
 
     async def walk(path: str) -> None:
         for layer in layers:  # directories exist in every group
@@ -138,6 +146,13 @@ async def crawl_once(client, max_heals: int = 1,
     sem = asyncio.Semaphore(max(1, max_heals))
     for layer in _heal_layers(client.graph):
         pending = await list_pending(layer)
+        if pending:
+            # events.h EVENT_HEAL_START: a sweep found damage to repair
+            # (paired with the per-file HEAL_COMPLETE below)
+            from ..core.events import gf_event
+
+            gf_event("HEAL_START", layer=layer.name,
+                     pending=len(pending))
         cap = max(1, max_heals) + max(0, wait_qlength)
         items = list(pending.items())
         if len(items) > cap:

@@ -28,14 +28,31 @@ import errno
 import itertools
 from typing import Any
 
+from ..core.events import gf_event
 from ..core.fops import Fop, FopError
 from ..core.iatt import gfid_new
 from ..core.layer import Event, FdObj, Layer, Loc, register
 from ..core.options import Option
 from ..core import gflog, tracing
+from ..core import metrics as _metrics
 from ..rpc import wire
 
 log = gflog.get_logger("protocol.client")
+
+# live client layers, scraped by the unified registry (weakref): the
+# client half of the wire accounting — per-connection bytes match the
+# brick's per-client counters from the other end of the same socket
+_LIVE_CLIENT_LAYERS = _metrics.REGISTRY.register_objects(
+    "gftpu_client_wire_bytes_total", "counter",
+    "wire bytes exchanged by each protocol/client connection",
+    lambda l: [({"layer": l.name, "dir": "tx"}, l.bytes_tx),
+               ({"layer": l.name, "dir": "rx"}, l.bytes_rx)])
+_metrics.REGISTRY.register_objects(
+    "gftpu_client_reconnects_total", "counter",
+    "successful SETVOLUME handshakes per protocol/client (first "
+    "connect counts as one)",
+    lambda l: [({"layer": l.name}, l.connects)],
+    live=_LIVE_CLIENT_LAYERS)
 
 
 @register("protocol/client")
@@ -133,6 +150,12 @@ class ClientLayer(Layer):
         # fop round-trips awaited on this transport (handshake/ping
         # excluded; the wire-frame-counting tests read this)
         self.rpc_roundtrips = 0
+        # wire accounting (client half of the brick's per-client
+        # counters): integer adds on buffers already in hand
+        self.bytes_tx = 0
+        self.bytes_rx = 0
+        self.connects = 0
+        _LIVE_CLIENT_LAYERS.add(self)
         # reopen bookkeeping (client-handshake.c reopen_fd_count):
         # live fds with server-side handles (value = (fd, reopen fop)),
         # and locks granted through this connection, replayed on
@@ -196,6 +219,14 @@ class ClientLayer(Layer):
         if self.opts["username"]:
             creds = {"username": self.opts["username"],
                      "password": self.opts["password"]}
+        # advertise this build's op-version (client_setvolume sends
+        # GD_OP_VERSION the same way) and the trace willingness, for
+        # the brick's client accounting (client_t capability column)
+        from .. import OP_VERSION
+
+        creds["op-version"] = OP_VERSION
+        if self.opts["trace-fops"]:
+            creds["trace-fops"] = True
         if self.opts["compression"]:
             creds["compress"] = True
         if self.opts["sg-replies"] and not self.opts["compression"]:
@@ -234,11 +265,18 @@ class ClientLayer(Layer):
             await self._drop_connection(notify=False)
             raise
         self.connected = True
+        self.connects += 1
         loop = asyncio.get_running_loop()
         self._last_pong = loop.time()
         self._tasks.append(asyncio.create_task(self._ping_loop()))
         log.info(4, "%s: connected to %s:%d (%s)", self.name, host, port,
                  res.get("volume"))
+        # events.h EVENT_BRICK_CONNECTED — fires on every successful
+        # SETVOLUME, so a reconnect storm is visible as a pulse train
+        gf_event("BRICK_CONNECTED", layer=self.name,
+                 brick=str(res.get("volume", "")),
+                 remote=f"{host}:{port}",
+                 subvol=self.opts["remote-subvolume"])
         self.notify(Event.CHILD_UP, None, None)
 
     async def _reopen_fds(self) -> None:
@@ -301,12 +339,17 @@ class ClientLayer(Layer):
         self._pending.clear()
         if was and notify:
             log.warning(5, "%s: disconnected", self.name)
+            gf_event("BRICK_DISCONNECTED", layer=self.name,
+                     remote=f"{self.opts['remote-host']}:"
+                            f"{self.opts['remote-port']}",
+                     subvol=self.opts["remote-subvolume"])
             self.notify(Event.CHILD_DOWN, None, None)
 
     async def _read_loop(self, reader: asyncio.StreamReader) -> None:
         try:
             while True:
                 rec = await wire.read_frame(reader)
+                self.bytes_rx += len(rec) + 4  # + the length prefix
                 xid, mtype, payload = wire.unpack(rec)
                 if mtype == wire.MT_EVENT:
                     # server-pushed upcall (cache invalidation etc.):
@@ -401,16 +444,19 @@ class ClientLayer(Layer):
                 if tid is not None:
                     body.append(tid)
             if self.opts["compression"]:
-                writer.write(wire.pack_z(
+                buf = wire.pack_z(
                     xid, wire.MT_CALL, body,
                     int(self.opts["compression-min-size"]),
-                    self.opts["compression-level"]))
+                    self.opts["compression-level"])
+                self.bytes_tx += len(buf)
+                writer.write(buf)
             else:
                 # payload blobs ride out-of-band and writelines hands
                 # the ORIGINAL buffers to the transport — a writev
                 # payload is never copied on this side (iobref submit)
-                writer.writelines(wire.pack_frames(xid, wire.MT_CALL,
-                                                   body))
+                frames = wire.pack_frames(xid, wire.MT_CALL, body)
+                self.bytes_tx += sum(len(f) for f in frames)
+                writer.writelines(frames)
             await writer.drain()
         except (ConnectionError, RuntimeError):
             self._pending.pop(xid, None)
@@ -652,8 +698,10 @@ class ClientLayer(Layer):
             # dropped by the read loop.
             xid = next(self._xid)
             try:
-                self._writer.writelines(wire.pack_frames(
-                    xid, wire.MT_CALL, ["release", [h], {}]))
+                frames = wire.pack_frames(
+                    xid, wire.MT_CALL, ["release", [h], {}])
+                self.bytes_tx += sum(len(f) for f in frames)
+                self._writer.writelines(frames)
             except (ConnectionError, RuntimeError):
                 pass  # teardown race: the server reaps on disconnect
 
@@ -668,7 +716,11 @@ class ClientLayer(Layer):
         return {"connected": self.connected,
                 "remote": f"{self.opts['remote-host']}:"
                           f"{self.opts['remote-port']}",
-                "pending_calls": len(self._pending)}
+                "pending_calls": len(self._pending),
+                "bytes_tx": self.bytes_tx,
+                "bytes_rx": self.bytes_rx,
+                "connects": self.connects,
+                "rpc_roundtrips": self.rpc_roundtrips}
 
 
 def _make_wire_fop(op_name: str):

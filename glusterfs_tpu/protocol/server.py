@@ -36,12 +36,15 @@ import fnmatch
 import hmac
 import socket
 import ssl as ssl_mod
+import time
 from typing import Any
 
+from ..core.events import gf_event
 from ..core.fops import Fop, FopError
 from ..core.layer import FdObj, Layer, register
 from ..core.options import Option
 from ..core import gflog, tracing
+from ..core import metrics as _metrics
 from ..rpc import wire
 
 log = gflog.get_logger("protocol.server")
@@ -202,6 +205,10 @@ _RPC_EXTRAS = {"heal_info", "heal_file", "heal_entry", "rebalance",
                "metrics_dump", "changelog_history",
                "contend_held_locks"}
 
+#: the deep-status op family (GF_CLI_STATUS_* brick half) — the ONE
+#: definition; glusterd's fan-out and the CLI parser import it
+STATUS_KINDS = ("detail", "clients", "fds", "inodes", "callpool", "mem")
+
 
 class _ClientConn:
     def __init__(self, server: "BrickServer", writer: asyncio.StreamWriter):
@@ -221,6 +228,38 @@ class _ClientConn:
         # processes serve several; glusterfsd-mgmt.c ATTACH model)
         self.top: Layer | None = None
         self.graph = None
+        # -- per-client accounting (the client_t dump of server.c) ----
+        # maintained inline in the frame read/write paths: integer
+        # adds on buffers the transport already holds, zero extra
+        # syscalls, no per-fop allocation
+        self.connected_at = time.time()
+        self.bytes_rx = 0
+        self.bytes_tx = 0
+        self.fop_counts: dict[str, int] = {}
+        self.caps: dict = {}  # capabilities advertised at SETVOLUME
+        self.opversion = 0    # peer build's op-version (0 = pre-8 peer)
+        # outstanding-rpc occupancy (status callpool reads these; they
+        # replace the old _serve-closure locals)
+        self.inflight = 0
+        self.exempt_inflight = 0
+
+    def info(self) -> dict:
+        """One ``volume status clients`` row (client_t dump shape)."""
+        total = sum(self.fop_counts.values())
+        return {"client": self.identity.hex(),
+                "addr": self.peer_addr,
+                "subvol": self.name,
+                "connected_since": self.connected_at,
+                "uptime": time.time() - self.connected_at,
+                "op_version": self.opversion,
+                "caps": sorted(self.caps),
+                "bytes_rx": self.bytes_rx,
+                "bytes_tx": self.bytes_tx,
+                "fops": total,
+                "fop_counts": dict(self.fop_counts),
+                "opened_fds": len(self.fds),
+                "inflight": self.inflight + self.exempt_inflight,
+                "mgmt": self.is_mgmt}
 
     def register_fd(self, fd: FdObj) -> wire.FdHandle:
         fdid = self.next_fd
@@ -275,6 +314,24 @@ class _ClientConn:
         return v
 
 
+# live brick servers, scraped by the unified registry (weakref: a
+# stopped server's families age out with the GC).  Per-client series
+# are labeled by brick + client-uid prefix so the Prometheus endpoint
+# answers "who is connected and what are they consuming" per brick.
+_LIVE_SERVERS = _metrics.REGISTRY.register_objects(
+    "gftpu_server_clients", "gauge",
+    "authenticated client connections per served brick",
+    lambda s: s._client_gauge_samples())
+_metrics.REGISTRY.register_objects(
+    "gftpu_server_client_bytes_total", "counter",
+    "wire bytes exchanged per authenticated client connection",
+    lambda s: list(s._client_byte_samples()), live=_LIVE_SERVERS)
+_metrics.REGISTRY.register_objects(
+    "gftpu_server_client_fops_total", "counter",
+    "fops dispatched per authenticated client connection",
+    lambda s: s._client_fop_samples(), live=_LIVE_SERVERS)
+
+
 class BrickServer:
     """TCP service for one brick graph top (the brick process core)."""
 
@@ -289,6 +346,45 @@ class BrickServer:
         self.attached: dict[str, tuple[Layer, Any]] = {}
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[_ClientConn] = set()
+        _LIVE_SERVERS.add(self)
+
+    # -- per-client metrics families (scraped by core/metrics.REGISTRY) ----
+
+    def _served_name(self, conn: _ClientConn) -> str:
+        return (conn.top if conn.top is not None else self.top).name
+
+    def _authed_conns(self, top: Layer | None = None) -> list[_ClientConn]:
+        return [c for c in self.connections
+                if c.authed and (top is None or
+                                 (c.top if c.top is not None
+                                  else self.top) is top)]
+
+    def _metric_conns(self) -> list[_ClientConn]:
+        """Real clients only: every mgmt poll shares the identity
+        b"glusterd", so two concurrent fan-outs would emit duplicate
+        label sets — an invalid Prometheus exposition (status rows
+        still list mgmt conns, flagged)."""
+        return [c for c in self._authed_conns() if not c.is_mgmt]
+
+    def _client_gauge_samples(self):
+        per_brick: dict[str, int] = {}
+        for c in self._metric_conns():
+            per_brick[self._served_name(c)] = \
+                per_brick.get(self._served_name(c), 0) + 1
+        return [({"brick": b}, n) for b, n in per_brick.items()]
+
+    def _client_byte_samples(self):
+        for c in self._metric_conns():
+            labels = {"brick": self._served_name(c),
+                      "client": c.identity.hex()[:8]}
+            yield {**labels, "dir": "rx"}, c.bytes_rx
+            yield {**labels, "dir": "tx"}, c.bytes_tx
+
+    def _client_fop_samples(self):
+        return [({"brick": self._served_name(c),
+                  "client": c.identity.hex()[:8]},
+                 sum(c.fop_counts.values()))
+                for c in self._metric_conns()]
 
     def _select_top(self, name: str) -> tuple[Layer, Any]:
         """SETVOLUME routing: the requested remote-subvolume picks the
@@ -560,8 +656,8 @@ class BrickServer:
         # has `limit` unanswered requests, stop reading its connection —
         # TCP flow control then bounds its queue to the socket buffers.
         # The limit is read per-admission so reconfigure applies live.
-        inflight = 0
-        exempt_inflight = 0
+        # Occupancy lives ON the conn so `volume status callpool` can
+        # read each client's outstanding count.
         gate = asyncio.Event()
         gate.set()
 
@@ -576,17 +672,19 @@ class BrickServer:
         async def send(xid: int, resp_type, resp) -> None:
             async with wlock:
                 if conn.compress:
-                    writer.write(wire.pack_z(xid, resp_type, resp))
+                    buf = wire.pack_z(xid, resp_type, resp)
+                    conn.bytes_tx += len(buf)
+                    writer.write(buf)
                 else:
                     # blob replies (readv data) go out as raw trailing
                     # buffers — no payload copy between the fop return
                     # and the socket
-                    writer.writelines(wire.pack_frames(xid, resp_type,
-                                                       resp))
+                    frames = wire.pack_frames(xid, resp_type, resp)
+                    conn.bytes_tx += sum(len(f) for f in frames)
+                    writer.writelines(frames)
                 await writer.drain()
 
         async def serve_one(xid: int, payload, kind: str):
-            nonlocal inflight, exempt_inflight
             try:
                 try:
                     resp_type, resp = await self._dispatch(conn, payload)
@@ -606,10 +704,10 @@ class BrickServer:
                         pass
             finally:
                 if kind == "throttled":
-                    inflight -= 1
+                    conn.inflight -= 1
                     gate.set()
                 elif kind == "exempt":
-                    exempt_inflight -= 1
+                    conn.exempt_inflight -= 1
                     gate.set()
 
         try:
@@ -624,6 +722,9 @@ class BrickServer:
                 except (asyncio.IncompleteReadError, ConnectionError,
                         asyncio.TimeoutError):
                     break
+                # rx accounting: record + the 4-byte length prefix —
+                # one integer add per frame already in hand
+                conn.bytes_rx += len(rec) + 4
                 xid, mtype, payload = wire.unpack(rec)
                 if mtype != wire.MT_CALL:
                     continue
@@ -643,7 +744,9 @@ class BrickServer:
                     # on its outcome
                     resp_type, resp = await self._dispatch(conn, payload)
                     try:
-                        writer.write(wire.pack(xid, resp_type, resp))
+                        buf = wire.pack(xid, resp_type, resp)
+                        conn.bytes_tx += len(buf)
+                        writer.write(buf)
                         await writer.drain()
                     except ConnectionError:
                         break
@@ -656,10 +759,10 @@ class BrickServer:
                 if limit <= 0:
                     kind = "free"  # operator chose unlimited
                 elif fop in _THROTTLE_EXEMPT:
-                    while exempt_inflight >= self.EXEMPT_HARD_CAP:
+                    while conn.exempt_inflight >= self.EXEMPT_HARD_CAP:
                         gate.clear()
                         await gate.wait()
-                    exempt_inflight += 1
+                    conn.exempt_inflight += 1
                     kind = "exempt"
                 else:
                     # re-read the limit each pass, with a bounded wait:
@@ -667,13 +770,13 @@ class BrickServer:
                     # already-throttled connection even if none of its
                     # parked requests ever completes (nothing else would
                     # set the gate)
-                    while 0 < _limit() <= inflight:  # stop reading
+                    while 0 < _limit() <= conn.inflight:  # stop reading
                         gate.clear()
                         try:
                             await asyncio.wait_for(gate.wait(), 1.0)
                         except asyncio.TimeoutError:
                             pass
-                    inflight += 1
+                    conn.inflight += 1
                     kind = "throttled"
                 t = asyncio.create_task(serve_one(xid, payload, kind))
                 tasks.add(t)
@@ -691,6 +794,15 @@ class BrickServer:
     async def _cleanup(self, conn: _ClientConn) -> None:
         """Disconnect: release fds + this client's locks (client_t reap)."""
         top = conn.top if conn.top is not None else self.top
+        if conn.authed and not conn.is_mgmt:
+            # lifecycle event with the final account (events.h
+            # EVENT_CLIENT_DISCONNECT); mgmt polls (glusterd status/
+            # profile sweeps) are excluded on both edges — they would
+            # drown the history in self-inflicted noise
+            gf_event("CLIENT_DISCONNECT", client=conn.identity.hex(),
+                     brick=top.name, server=self.top.name,
+                     bytes_rx=conn.bytes_rx, bytes_tx=conn.bytes_tx,
+                     fops=sum(conn.fop_counts.values()))
         for fd in conn.fds.values():
             rel = getattr(top, "release", None)
             if rel is not None:
@@ -709,6 +821,100 @@ class BrickServer:
                         rc(conn.identity)
                     except Exception:
                         pass
+
+    # -- deep volume status (GF_CLI_STATUS_{DETAIL,CLIENTS,INODE,FD,
+    # CALLPOOL,MEM} brick half, glusterd-op-sm.c op family) ---------------
+
+    STATUS_KINDS = STATUS_KINDS
+
+    def _status_of(self, top: Layer, kind: str) -> dict:
+        """One brick's share of ``volume status <kind>`` — everything
+        is read from live state already in memory; ``detail`` adds one
+        statvfs (cold path)."""
+        from ..core.layer import walk
+
+        if kind == "clients":
+            return {"clients": [c.info()
+                                for c in self._authed_conns(top)]}
+        if kind == "fds":
+            out = []
+            for c in self._authed_conns(top):
+                out.append({"client": c.identity.hex(),
+                            "count": len(c.fds),
+                            "fds": [{"fd": fdid, "path": fd.path,
+                                     "gfid": fd.gfid.hex(),
+                                     "flags": fd.flags}
+                                    for fdid, fd in c.fds.items()]})
+            return {"fd_tables": out,
+                    "total": sum(e["count"] for e in out)}
+        if kind == "inodes":
+            tables = {}
+            identity = {}
+            for layer in walk(top):
+                it = getattr(layer, "itable", None)
+                if it is not None and hasattr(it, "dump"):
+                    tables[layer.name] = it.dump()
+                if hasattr(layer, "_ino_cache"):
+                    # storage/posix: the brick-side identity caches are
+                    # its inode table analog (gfid handle store)
+                    identity[layer.name] = {
+                        "ino_cache": len(layer._ino_cache),
+                        "xattr_cache": len(layer._xa_cache),
+                        "uncompacted_bindings": len(layer._gfid_mem),
+                        "dirty": len(layer._xa_dirty)}
+            return {"itables": tables, "identity": identity}
+        if kind == "callpool":
+            pools = []
+            for layer in walk(top):
+                q = getattr(layer, "queued", None)
+                ex = getattr(layer, "executed", None)
+                if isinstance(q, list) and isinstance(ex, list):
+                    pools.append({"layer": layer.name,
+                                  "queued": list(q),
+                                  "executed": list(ex)})
+            return {"io_threads": pools,
+                    "outstanding": [
+                        {"client": c.identity.hex(),
+                         "inflight": c.inflight,
+                         "exempt": c.exempt_inflight}
+                        for c in self._authed_conns(top)]}
+        if kind == "mem":
+            import resource
+
+            return {"registry": _metrics.REGISTRY.snapshot(),
+                    "max_rss_kb":
+                        resource.getrusage(
+                            resource.RUSAGE_SELF).ru_maxrss}
+        if kind == "detail":
+            import os as _os
+
+            bricks = []
+            for layer in walk(top):
+                root = getattr(layer, "root", None)
+                if not isinstance(root, str) or \
+                        not hasattr(layer, "_failed_health"):
+                    continue
+                row = {"layer": layer.name, "path": root,
+                       "health": ("failed" if layer._failed_health
+                                  else "ok"),
+                       "health_error": layer._failed_health,
+                       "reserve_limited":
+                           bool(getattr(layer, "_reserve_full", False))}
+                try:
+                    sv = _os.statvfs(root)
+                    row.update(block_size=sv.f_bsize,
+                               blocks_total=sv.f_blocks,
+                               blocks_free=sv.f_bfree,
+                               blocks_avail=sv.f_bavail,
+                               inodes_total=sv.f_files,
+                               inodes_free=sv.f_ffree)
+                except OSError as e:
+                    row["statvfs_error"] = str(e)
+                bricks.append(row)
+            return {"backends": bricks}
+        raise FopError(errno.EINVAL,
+                       f"unknown status kind {kind!r} "
+                       f"(one of {', '.join(self.STATUS_KINDS)})")
 
     async def _dispatch(self, conn: _ClientConn, payload: Any):
         try:
@@ -752,6 +958,25 @@ class BrickServer:
                 # (mixed-version: an old client never sees an sg dict)
                 conn.sg = bool((creds or {}).get("sg-replies")) and \
                     self._sg_on(top)
+                # client accounting: remember what the peer advertised
+                # (the client_t dump's "capabilities" column) and stamp
+                # the connect time from NOW — the pre-auth probe window
+                # is not client lifetime
+                conn.connected_at = time.time()
+                conn.caps = {k: True for k in
+                             ("compress", "sg-replies", "trace-fops")
+                             if (creds or {}).get(k)}
+                try:
+                    conn.opversion = int((creds or {}).get(
+                        "op-version", 0))
+                except (TypeError, ValueError):
+                    conn.opversion = 0
+                if not is_mgmt:
+                    gf_event("CLIENT_CONNECT",
+                             client=conn.identity.hex(),
+                             brick=top.name, server=self.top.name,
+                             addr=conn.peer_addr, subvol=want,
+                             op_version=conn.opversion)
                 return wire.MT_REPLY, {"volume": top.name, "ok": True,
                                        "compound":
                                            self._compound_on(top),
@@ -790,6 +1015,12 @@ class BrickServer:
                                    "mgmt credential")
                 ok = await self.detach(args[0])
                 return wire.MT_REPLY, {"ok": ok}
+            if fop_name == "__status__":
+                # deep-status brick half: glusterd fans this out per
+                # node and merges (op_volume_status_local)
+                kind = args[0] if args else "clients"
+                return wire.MT_REPLY, _jsonable(
+                    self._status_of(top, str(kind)))
             if fop_name == "__statedump__":
                 # full-graph dump (has "layers") when the daemon handed
                 # us the graph; bare top-layer dump otherwise
@@ -819,7 +1050,10 @@ class BrickServer:
                                    "compound fops disabled")
                 links = cfop.validate(conn.resolve(args[0] if args
                                                    else []))
+                cnt = conn.fop_counts
+                cnt["compound"] = cnt.get("compound", 0) + 1
                 for _lf, largs, lkw in links:
+                    cnt[_lf] = cnt.get(_lf, 0) + 1
                     _scope_owner(largs, lkw, conn.identity)
                 wire.CURRENT_CLIENT.set(conn.identity)
                 # one handle-farm transaction per chain: batch the
@@ -842,6 +1076,8 @@ class BrickServer:
                     for st, val in replies]
             if fop_name not in _FOPS and fop_name not in _RPC_EXTRAS:
                 raise FopError(95, f"unknown fop {fop_name!r}")
+            conn.fop_counts[fop_name] = \
+                conn.fop_counts.get(fop_name, 0) + 1
             fn = getattr(top, fop_name, None)
             if fn is None and fop_name in _RPC_EXTRAS:
                 # extras (quota_usage, heal surfaces) live on a specific

@@ -554,6 +554,12 @@ class PosixLayer(Layer):
                 self._failed_health = str(e)
                 log.error(9, "%s: backend health check failed: %s — "
                           "marking brick down", self.name, e)
+                # events.h EVENT_POSIX_HEALTH_CHECK_FAILED: the
+                # operator's page for "this brick's disk is dying"
+                from ..core.events import gf_event
+
+                gf_event("POSIX_HEALTH_CHECK_FAILED", brick=self.name,
+                         path=self.root, error=str(e))
                 self.notify(Event.CHILD_DOWN, None, None)
                 return
 

@@ -39,10 +39,13 @@ def test_meta_tree(tmp_path):
         assert await c.read_file("/real") == b"data"
         # the virtual tree
         assert sorted(await c.listdir("/.meta")) == \
-            ["graphs", "logging", "metrics", "version"]
+            ["connections", "graphs", "logging", "metrics", "version"]
         # the unified-registry dump serves as a file
         metrics = await c.read_file("/.meta/metrics")
         assert b"gftpu_wire_blob_stats" in metrics
+        # transport accounting file: this graph has no protocol/client,
+        # so the list is present but empty
+        assert json.loads(await c.read_file("/.meta/connections")) == []
         assert await c.listdir("/.meta/graphs") == ["active"]
         assert sorted(await c.listdir("/.meta/graphs/active")) == \
             ["locks", "posix"]

@@ -1,0 +1,491 @@
+"""Cluster health & client-accounting plane (ISSUE 5): per-client wire
+accounting pinned against known transfer sizes, deep `volume status`
+fan-out (clients/fds/inodes/callpool/mem/detail) with partial-coverage
+reporting on downed nodes, heal-count from brick index counters, and
+lifecycle event coverage (CLIENT_CONNECT/DISCONNECT, POSIX health
+check, afr/ec quorum edges) landing in eventsd history."""
+
+import asyncio
+import os
+import shutil
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core import events as events_mod
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.metrics import REGISTRY
+from glusterfs_tpu.daemon import serve_brick
+from glusterfs_tpu.mgmt.eventsd import EventsDaemon
+from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient, mount_volume
+
+BRICK_VOLFILE = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+volume stats
+    type debug/io-stats
+    subvolumes locks
+end-volume
+"""
+
+CLIENT_VOLFILE = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume stats
+end-volume
+"""
+
+
+async def _connect(port):
+    g = Graph.construct(CLIENT_VOLFILE.format(port=port))
+    c = Client(g)
+    await c.mount()
+    for _ in range(200):
+        if g.top.connected:
+            break
+        await asyncio.sleep(0.05)
+    assert g.top.connected
+    return c, g
+
+
+@pytest.fixture
+def eventsd_env():
+    """In-process eventsd wired as this process's gf_event sink; the
+    daemon handle is yielded for history assertions."""
+    holder = {}
+
+    async def start():
+        d = EventsDaemon()
+        udp, _ctl = await d.start()
+        events_mod.configure(f"127.0.0.1:{udp}")
+        holder["d"] = d
+        return d
+
+    holder["start"] = start
+    yield holder
+    events_mod.configure(None)
+    os.environ.pop("GFTPU_EVENTSD", None)
+
+
+# -- per-client wire accounting --------------------------------------------
+
+def test_client_accounting_pinned_bytes(tmp_path):
+    """The brick's per-client rx/tx counters match a known transfer
+    size within protocol overhead, fop counts accumulate, and the
+    client-side counters (the other end of the same socket) agree."""
+    PAYLOAD = 65536
+
+    async def run():
+        server = await serve_brick(
+            BRICK_VOLFILE.format(dir=tmp_path / "b"))
+        c, g = await _connect(server.port)
+        await c.write_file("/acct", b"x" * PAYLOAD)
+        st = await g.top._call("__status__", ("clients",), {})
+        rows = [r for r in st["clients"] if not r["mgmt"]]
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["client"] == g.top.identity.hex()
+        # pinned: the payload rode up exactly once (+ framing/handshake
+        # overhead, well under a page)
+        assert PAYLOAD <= row["bytes_rx"] <= PAYLOAD + 4096, row
+        assert row["bytes_tx"] < 4096  # no reads yet
+        assert row["fops"] >= 2 and row["fop_counts"].get("writev", 0) >= 1
+        assert row["op_version"] >= 7  # advertised at SETVOLUME
+        assert await c.read_file("/acct") == b"x" * PAYLOAD
+        st = await g.top._call("__status__", ("clients",), {})
+        row = [r for r in st["clients"] if not r["mgmt"]][0]
+        assert PAYLOAD <= row["bytes_tx"] <= PAYLOAD + 4096, row
+        # the client half agrees with the brick half (same socket)
+        assert abs(g.top.bytes_tx - row["bytes_rx"]) < 512
+        assert abs(g.top.bytes_rx - row["bytes_tx"]) < 512
+        # per-client registry families scrape from the live server
+        snap = REGISTRY.snapshot()
+        assert any(s[0].get("client") == row["client"][:8]
+                   for s in snap["gftpu_server_client_bytes_total"]
+                   ["samples"])
+        assert "gftpu_client_wire_bytes_total" in snap
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_status_kinds_answer_and_fds_tracked(tmp_path):
+    """Every deep-status kind answers on a live brick; an open fd shows
+    in the fd table and callpool/inodes/detail/mem carry live state."""
+
+    async def run():
+        server = await serve_brick(
+            BRICK_VOLFILE.format(dir=tmp_path / "b"))
+        c, g = await _connect(server.port)
+        f = await c.create("/held")
+        await f.write(b"held open", 0)
+        fds = await g.top._call("__status__", ("fds",), {})
+        mine = [t for t in fds["fd_tables"]
+                if t["client"] == g.top.identity.hex()]
+        assert mine and mine[0]["count"] >= 1
+        assert any(fd["path"] == "/held" for fd in mine[0]["fds"])
+        ino = await g.top._call("__status__", ("inodes",), {})
+        assert ino["identity"]["posix"]["ino_cache"] >= 1
+        cp = await g.top._call("__status__", ("callpool",), {})
+        assert any(o["client"] == g.top.identity.hex()
+                   for o in cp["outstanding"])
+        mem = await g.top._call("__status__", ("mem",), {})
+        assert mem["max_rss_kb"] > 0
+        assert "gftpu_wire_blob_stats" in mem["registry"]
+        det = await g.top._call("__status__", ("detail",), {})
+        be = det["backends"][0]
+        assert be["health"] == "ok" and be["blocks_total"] > 0
+        assert be["inodes_total"] > 0
+        await f.close()
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+# -- lifecycle events ------------------------------------------------------
+
+def test_connect_disconnect_events_and_row_drop(tmp_path, eventsd_env):
+    """CLIENT_CONNECT lands in eventsd history at SETVOLUME, the
+    client's row vanishes from `status clients` on disconnect, and
+    CLIENT_DISCONNECT carries the final byte account."""
+
+    async def run():
+        ed = await eventsd_env["start"]()
+        server = await serve_brick(
+            BRICK_VOLFILE.format(dir=tmp_path / "b"))
+        c, g = await _connect(server.port)
+        uid = g.top.identity.hex()
+        await c.write_file("/f", b"y" * 8192)
+        for _ in range(40):  # UDP datagram -> same-loop eventsd
+            if any(e["event"] == "CLIENT_CONNECT" and e["client"] == uid
+                   for e in ed.recent):
+                break
+            await asyncio.sleep(0.05)
+        connect = [e for e in ed.recent
+                   if e["event"] == "CLIENT_CONNECT"
+                   and e["client"] == uid]
+        assert connect and connect[0]["brick"] == "stats"
+        await c.unmount()
+        # the server notices EOF and reaps the client_t
+        c2, g2 = await _connect(server.port)
+        for _ in range(40):
+            st = await g2.top._call("__status__", ("clients",), {})
+            if all(r["client"] != uid for r in st["clients"]):
+                break
+            await asyncio.sleep(0.05)
+        assert all(r["client"] != uid for r in st["clients"])
+        for _ in range(40):
+            if any(e["event"] == "CLIENT_DISCONNECT"
+                   and e["client"] == uid for e in ed.recent):
+                break
+            await asyncio.sleep(0.05)
+        disc = [e for e in ed.recent if e["event"] == "CLIENT_DISCONNECT"
+                and e["client"] == uid]
+        assert disc and disc[0]["bytes_rx"] >= 8192
+        # BRICK_CONNECTED fired from the client side too
+        assert any(e["event"] == "BRICK_CONNECTED" for e in ed.recent)
+        await c2.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_health_check_failure_event(tmp_path, eventsd_env):
+    """A dying backend fires POSIX_HEALTH_CHECK_FAILED into eventsd
+    (and the brick marks itself down, as before)."""
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/hb
+    option health-check-interval 0.05
+end-volume
+"""
+
+    async def run():
+        ed = await eventsd_env["start"]()
+        g = Graph.construct(vf)
+        await g.activate()
+        try:
+            shutil.rmtree(tmp_path / "hb")  # the disk "dies"
+            for _ in range(60):
+                if any(e["event"] == "POSIX_HEALTH_CHECK_FAILED"
+                       for e in ed.recent):
+                    break
+                await asyncio.sleep(0.05)
+            evs = [e for e in ed.recent
+                   if e["event"] == "POSIX_HEALTH_CHECK_FAILED"]
+            assert evs and evs[0]["brick"] == "posix"
+        finally:
+            await g.fini()
+
+    asyncio.run(run())
+
+
+def test_afr_ec_quorum_transition_events(tmp_path, eventsd_env):
+    """afr and ec emit quorum events exactly on the transition edge
+    (not once per child flap)."""
+    afr_vf = f"""
+volume p0
+    type storage/posix
+    option directory {tmp_path}/a0
+end-volume
+volume p1
+    type storage/posix
+    option directory {tmp_path}/a1
+end-volume
+volume afr
+    type cluster/replicate
+    subvolumes p0 p1
+end-volume
+"""
+
+    async def run():
+        from glusterfs_tpu.core.layer import Event
+
+        ed = await eventsd_env["start"]()
+        g = Graph.construct(afr_vf)
+        await g.activate()
+        try:
+            afr = g.top
+            # quorum-type auto on replica 2: losing brick 0 loses the
+            # first-brick tiebreak immediately
+            afr.notify(Event.CHILD_DOWN, source=afr.children[0])
+            afr.notify(Event.CHILD_DOWN, source=afr.children[1])  # no edge
+            afr.notify(Event.CHILD_UP, source=afr.children[0])
+            await asyncio.sleep(0.2)
+            fails = [e for e in ed.recent
+                     if e["event"] == "AFR_QUORUM_FAIL"]
+            mets = [e for e in ed.recent
+                    if e["event"] == "AFR_QUORUM_MET"]
+            assert len(fails) == 1 and fails[0]["up"] == 1
+            assert len(mets) == 1 and mets[0]["up"] == 1
+        finally:
+            await g.fini()
+
+    asyncio.run(run())
+
+
+def test_ec_min_bricks_events(tmp_path, eventsd_env):
+    ec_vf = f"""
+volume e0
+    type storage/posix
+    option directory {tmp_path}/e0
+end-volume
+volume e1
+    type storage/posix
+    option directory {tmp_path}/e1
+end-volume
+volume e2
+    type storage/posix
+    option directory {tmp_path}/e2
+end-volume
+volume ec
+    type cluster/disperse
+    option redundancy 1
+    subvolumes e0 e1 e2
+end-volume
+"""
+
+    async def run():
+        from glusterfs_tpu.core.layer import Event
+
+        ed = await eventsd_env["start"]()
+        g = Graph.construct(ec_vf)
+        await g.activate()
+        try:
+            ec = g.top  # k = 2 of 3
+            ec.notify(Event.CHILD_DOWN, source=ec.children[0])
+            ec.notify(Event.CHILD_DOWN, source=ec.children[1])  # < K
+            ec.notify(Event.CHILD_UP, source=ec.children[1])    # >= K
+            await asyncio.sleep(0.2)
+            down = [e for e in ed.recent
+                    if e["event"] == "EC_MIN_BRICKS_NOT_UP"]
+            up = [e for e in ed.recent
+                  if e["event"] == "EC_MIN_BRICKS_UP"]
+            assert len(down) == 1 and down[0]["up"] == 1
+            assert len(up) == 1 and up[0]["k"] == 2
+        finally:
+            await g.fini()
+
+    asyncio.run(run())
+
+
+def test_eventsd_registry_families():
+    """eventsd's received/webhook counters are registry families, so
+    the event plane itself is scrapeable."""
+
+    async def run():
+        d = EventsDaemon()
+        await d.start()
+        try:
+            d.webhooks["http://127.0.0.1:1/x"] = {"delivered": 3,
+                                                  "failed": 1}
+            d._ingest({"event": "T"})
+            snap = REGISTRY.snapshot()
+            rec = [v for l, v in
+                   snap["gftpu_events_received_total"]["samples"]]
+            assert sum(rec) >= 1
+            wh = {(l["url"], l["result"]): v for l, v in
+                  snap["gftpu_events_webhook_total"]["samples"]}
+            assert wh[("http://127.0.0.1:1/x", "delivered")] == 3
+            assert wh[("http://127.0.0.1:1/x", "failed")] == 1
+            # the emitting side counts too
+            assert "gftpu_events_emitted_total" in snap
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+
+
+# -- glusterd plane --------------------------------------------------------
+
+def test_tasks_section_in_plain_status(tmp_path):
+    """An active remove-brick shows in plain `volume status` as a task
+    row (the reference's status tasks section)."""
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        # no network needed: single-node txn runs in-process
+        await d.op_volume_create(
+            "tv", "distribute",
+            [{"path": str(tmp_path / f"b{i}")} for i in range(2)])
+        st = d.op_volume_status("tv")
+        assert "tasks" not in st
+        d.state["volumes"]["tv"]["remove-brick"] = {
+            "status": "started", "bricks": ["tv-brick-1"],
+            "progress": {"moved": 1}}
+        st = d.op_volume_status("tv")
+        assert st["tasks"] == [{"type": "remove-brick",
+                                "status": "started",
+                                "bricks": ["tv-brick-1"],
+                                "progress": {"moved": 1}}]
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_deep_status_fanout_merge_and_heal_count(tmp_path, eventsd_env):
+    """Multi-brick fan-out: every deep-status kind merges both bricks'
+    answers keyed by brick name, the mounted client appears with
+    nonzero bytes, heal-count answers without mounting a client graph,
+    and CLIENT_CONNECT reached eventsd from the brick subprocesses."""
+
+    async def run():
+        ed = await eventsd_env["start"]()
+        # brick SUBPROCESSES inherit the sink through the environment
+        os.environ["GFTPU_EVENTSD"] = f"127.0.0.1:{ed.udp_port}"
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="dv",
+                             vtype="replicate",
+                             bricks=[{"path": str(tmp_path / "b0")},
+                                     {"path": str(tmp_path / "b1")}])
+                await c.call("volume-start", name="dv")
+            m = await mount_volume(d.host, d.port, "dv")
+            try:
+                await m.write_file("/one", b"a" * 32768)
+                await m.write_file("/two", b"b" * 32768)
+                bricks = {"dv-brick-0", "dv-brick-1"}
+                for what in ("clients", "fds", "inodes", "callpool",
+                             "detail", "mem"):
+                    st = await d.op_volume_status_deep("dv", what)
+                    assert set(st["bricks"]) == bricks, (what, st)
+                    assert "partial" not in st
+                st = await d.op_volume_status_deep("dv", "clients")
+                for bname in bricks:
+                    rows = [r for r in st["bricks"][bname]["clients"]
+                            if not r["mgmt"]]
+                    assert rows, st
+                    assert any(r["bytes_rx"] >= 32768 for r in rows)
+                hc = await d.op_volume_heal_count("dv")
+                assert set(hc["bricks"]) == bricks
+                assert hc["total"] == 0  # nothing pending
+                for _ in range(60):
+                    if any(e["event"] == "CLIENT_CONNECT"
+                           for e in ed.recent):
+                        break
+                    await asyncio.sleep(0.1)
+                assert any(e["event"] == "CLIENT_CONNECT"
+                           for e in ed.recent)
+            finally:
+                await m.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_partial_fanout_on_downed_node(tmp_path):
+    """A dead peer degrades every fan-out answer to a NAMED partial —
+    not a hang, not a fake-complete merge."""
+
+    async def run():
+        d1 = Glusterd(str(tmp_path / "gd1"))
+        await d1.start()
+        d2 = Glusterd(str(tmp_path / "gd2"))
+        await d2.start()
+        try:
+            async with MgmtClient(d1.host, d1.port) as c:
+                await c.call("peer-probe", host=d2.host, port=d2.port)
+                await c.call("volume-create", name="pv",
+                             vtype="replicate",
+                             bricks=[{"node": d1.uuid,
+                                      "path": str(tmp_path / "n1b")},
+                                     {"node": d2.uuid,
+                                      "path": str(tmp_path / "n2b")}])
+                await c.call("volume-start", name="pv")
+            st = await d1.op_volume_status_deep("pv", "clients")
+            assert set(st["bricks"]) == {"pv-brick-0", "pv-brick-1"}
+            assert "partial" not in st
+            await d2.stop()  # node down: bricks AND glusterd gone
+            st = await d1.op_volume_status_deep("pv", "clients")
+            assert "pv-brick-0" in st["bricks"]
+            assert "pv-brick-1" not in st["bricks"]
+            assert st["partial"] and \
+                st["partial"][0].startswith(d2.uuid[:8])
+            prof = await d1.op_volume_profile("pv")
+            assert prof["partial"]
+            top = await d1.op_volume_top("pv", metric="write")
+            assert top["partial"]
+        finally:
+            await d2.stop()
+            await d1.stop()
+
+    asyncio.run(run())
+
+
+# -- CLI rendering ---------------------------------------------------------
+
+def test_cli_status_tables_and_partial_warning(capsys):
+    from glusterfs_tpu.mgmt.cli import _status_human
+
+    out = {"volume": "v", "what": "clients",
+           "partial": ["deadbeef@127.0.0.1:1"],
+           "bricks": {"v-brick-0": {"clients": [
+               {"client": "ab" * 16, "addr": "127.0.0.1",
+                "uptime": 12.3, "bytes_rx": 70000, "bytes_tx": 512,
+                "fops": 9, "opened_fds": 1, "mgmt": False,
+                "op_version": 8}]},
+               "v-brick-1": {"offline": True}}}
+    text = _status_human("clients", out)
+    assert "WARNING: partial answer" in text and "deadbeef" in text
+    assert "BRICK" in text and "68.4KiB" in text and "OFFLINE" in text
+    fd_out = {"bricks": {"b0": {"fd_tables": [
+        {"client": "cd" * 16, "count": 1,
+         "fds": [{"fd": 3, "path": "/x", "gfid": "00" * 16,
+                  "flags": 2}]}]}}}
+    text = _status_human("fds", fd_out)
+    assert "/x" in text and "CLIENT" in text

@@ -39,8 +39,9 @@ if [ $bench_rc -ne 0 ]; then
     exit $bench_rc
 fi
 
-echo "== ci: metrics smoke (1-brick volume, scrape + monotonicity) =="
-timeout -k 10 120 env JAX_PLATFORMS=cpu python - <<'EOF'
+echo "== ci: metrics smoke (1-brick volume, scrape + monotonicity,"
+echo "       status clients + eventsapi) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
 import asyncio, os, tempfile
 
 from glusterfs_tpu.api.glfs import Client
@@ -102,9 +103,60 @@ async def main():
     assert "gftpu_wire_blob_stats" in rpc, "metrics_dump RPC empty"
     text = REGISTRY.render()
     assert "# TYPE gftpu_wire_blob_stats counter" in text
+    # per-client accounting rode the same fops (ISSUE 5): the brick
+    # names this client and its byte counters moved
+    st = await g.top._call("__status__", ("clients",), {})
+    rows = [r for r in st["clients"] if not r["mgmt"]]
+    assert rows and rows[0]["bytes_rx"] >= 65536, \
+        "client accounting row missing or empty"
     await c.unmount()
     await server.stop()
-    print("metrics smoke: families present, counters monotonic")
+
+    # -- managed path: glusterd volume + eventsd (ISSUE 5) --------------
+    from glusterfs_tpu.mgmt.eventsd import EventsDaemon
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    ed = EventsDaemon()
+    udp, ctl = await ed.start()
+    os.environ["GFTPU_EVENTSD"] = f"127.0.0.1:{udp}"
+    os.environ["GFTPU_EVENTSD_CTL"] = f"127.0.0.1:{ctl}"
+    d = Glusterd(os.path.join(base, "gd"))
+    await d.start()
+    try:
+        async with MgmtClient(d.host, d.port) as mc:
+            await mc.call("volume-create", name="smoke",
+                          vtype="distribute",
+                          bricks=[{"path": os.path.join(base, "vb0")}])
+            await mc.call("volume-start", name="smoke")
+        m = await mount_volume(d.host, d.port, "smoke")
+        try:
+            await m.write_file("/s", b"s" * 65536)
+            st = await d.op_volume_status_deep("smoke", "clients")
+            assert "partial" not in st, st
+            rows = [r for r in
+                    st["bricks"]["smoke-brick-0"]["clients"]
+                    if not r["mgmt"]]
+            assert rows and rows[0]["bytes_rx"] >= 65536, \
+                f"volume status clients: no accounted client row: {st}"
+            ev = await d.op_eventsapi("status")
+            assert ev["nodes"], "eventsapi status empty"
+            ok = False
+            for _ in range(50):
+                recent = (await d.op_eventsapi_local("recent"))["events"]
+                if any(e.get("event") == "CLIENT_CONNECT"
+                       for e in recent):
+                    ok = True
+                    break
+                await asyncio.sleep(0.1)
+            assert ok, "no CLIENT_CONNECT in eventsd history"
+        finally:
+            await m.unmount()
+    finally:
+        await d.stop()
+        await ed.stop()
+    print("metrics smoke: families present, counters monotonic, "
+          "client accounting + CLIENT_CONNECT event observed")
 
 asyncio.run(main())
 EOF
