@@ -670,6 +670,151 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
 SWEEP_GEOMETRIES = ((4, 2), (8, 3), (8, 4), (16, 4))
 
 
+GATEWAY_LADDER = (1, 64, 512)
+
+
+def gateway_bench(obj_kib: int = 64, ladder=GATEWAY_LADDER,
+                  budget_s: float = 150.0) -> dict:
+    """Concurrency-ladder rows for the HTTP object gateway (ISSUE 6):
+    N concurrent HTTP/1.1 clients — one keep-alive TCP connection each
+    — PUT then GET distinct ``obj_kib``-KiB objects through one
+    gateway over a served 1-brick volume (compound + write-behind on,
+    so small PUTs ride the fused create chain).  This is the
+    many-small-concurrent-requests workload class no other access path
+    expresses: thousands of sockets multiplexed onto a 4-client glfs
+    pool.  Every unmeasured rung is an explicit "skipped: <reason>"
+    row (c512 is 1024+ fds — rlimit failures are a real outcome on
+    this sandbox, and the record must say so, never go silent)."""
+    import asyncio
+    import tempfile
+
+    out: dict = {}
+    rows = [f"gateway_{op}_c{n}_MiB_s"
+            for n in ladder for op in ("put", "get")]
+    t_start = time.perf_counter()
+
+    async def run():
+        from glusterfs_tpu.api.glfs import Client, wait_connected
+        from glusterfs_tpu.core.graph import Graph
+        from glusterfs_tpu.daemon import serve_brick
+        from glusterfs_tpu.gateway import ClientPool, ObjectGateway
+
+        base = tempfile.mkdtemp(prefix="gwbench")
+        server = await serve_brick(f"""
+volume posix
+    type storage/posix
+    option directory {os.path.join(base, 'b')}
+end-volume
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+""")
+        text = f"""
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {server.port}
+    option remote-subvolume locks
+    option compound-fops on
+end-volume
+volume wb
+    type performance/write-behind
+    option compound-fops on
+    subvolumes c0
+end-volume
+"""
+
+        async def factory():
+            g = Graph.construct(text)
+            c = Client(g)
+            await c.mount()
+            await wait_connected(g)
+            return c
+
+        gw = ObjectGateway(ClientPool(factory, 4),
+                           max_clients=2 * max(ladder),
+                           volume="bench")
+        await gw.start()
+        payload = np.random.default_rng(9).integers(
+            0, 256, obj_kib << 10, dtype=np.uint8).tobytes()
+
+        # the shared keep-alive client (tests + ci.sh drive the same
+        # code, so the dialect cannot drift across drivers)
+        from glusterfs_tpu.gateway.minihttp import request
+
+        r0, w0 = await asyncio.open_connection(gw.host, gw.port)
+        assert (await request(r0, w0, "PUT", "/b"))[0] == 200
+        # warm: jit/fd/pool paths off the clock
+        assert (await request(r0, w0, "PUT", "/b/warm",
+                              body=payload))[0] == 200
+        assert (await request(r0, w0, "GET", "/b/warm"))[0] == 200
+        w0.close()
+
+        try:
+            for n in ladder:
+                if time.perf_counter() - t_start > budget_s:
+                    for op in ("put", "get"):
+                        out[f"gateway_{op}_c{n}_MiB_s"] = \
+                            "skipped: gateway ladder time budget " \
+                            "exhausted"
+                    continue
+                reqs = max(1, 128 // n)  # ~128+ objects per rung
+                conns = []
+                try:
+                    for _ in range(n):
+                        conns.append(await asyncio.open_connection(
+                            gw.host, gw.port))
+
+                    async def client(i, op):
+                        cr, cw = conns[i]
+                        for j in range(reqs):
+                            target = f"/b/c{n}_{i}_{j}"
+                            st, _, _ = await request(
+                                cr, cw, "PUT" if op == "put"
+                                else "GET", target,
+                                body=payload if op == "put" else b"")
+                            assert st == 200, (op, target, st)
+
+                    total_mib = n * reqs * len(payload) / MIB
+                    t0 = time.perf_counter()
+                    await asyncio.gather(*(client(i, "put")
+                                           for i in range(n)))
+                    # record each direction AS IT LANDS: a GET-pass
+                    # failure must not discard the measured PUT row
+                    out[f"gateway_put_c{n}_MiB_s"] = round(
+                        total_mib / (time.perf_counter() - t0), 1)
+                    t0 = time.perf_counter()
+                    await asyncio.gather(*(client(i, "get")
+                                           for i in range(n)))
+                    out[f"gateway_get_c{n}_MiB_s"] = round(
+                        total_mib / (time.perf_counter() - t0), 1)
+                    out["gateway_obj_KiB"] = obj_kib
+                except Exception as e:  # rung fails, ladder continues
+                    for op in ("put", "get"):
+                        out.setdefault(f"gateway_{op}_c{n}_MiB_s",
+                                       f"skipped: {e!r}"[:200])
+                finally:
+                    for _, cw in conns:
+                        try:
+                            cw.close()
+                        except Exception:
+                            pass
+        finally:
+            await gw.stop()
+            await server.stop()
+
+    try:
+        asyncio.run(run())
+    except Exception as e:  # whole-bench failure: every row says why
+        reason = f"skipped: {e!r}"[:200]
+        for row in rows:
+            out.setdefault(row, reason)
+    for row in rows:
+        out.setdefault(row, "skipped: not measured")
+    return out
+
+
 def _native_sweep_row(sk: int, sr: int, sdata: np.ndarray) -> dict:
     """Jax-free native-ladder rows for one geometry: encode, decode via
     the CSE'd per-mask compiled program (gf_decode_prog), and decode via
@@ -773,7 +918,10 @@ def _wedged_main() -> None:
         # explicit skips, never silence
         **{row: "skipped: tpu transport wedged (kernel ladder only)"
            for row in ("wire_write_MiB_s", "wire_read_MiB_s",
-                       "fuse_write_MiB_s", "fuse_read_MiB_s")},
+                       "fuse_write_MiB_s", "fuse_read_MiB_s",
+                       *(f"gateway_{op}_c{n}_MiB_s"
+                         for n in GATEWAY_LADDER
+                         for op in ("put", "get")))},
     }
     result["regressions"] = _regression_gate(result)
     print(emit(result))
@@ -1075,6 +1223,17 @@ def main() -> None:
                                    zero_copy="off"))
     except Exception as e:
         vol["nocompound_wire_bench_error"] = str(e)[:200]
+    try:
+        # HTTP object gateway concurrency ladder (ISSUE 6): the
+        # many-client axis — gateway_bench fills every rung or records
+        # an explicit skip reason itself
+        vol.update(gateway_bench())
+    except Exception as e:
+        vol["gateway_bench_error"] = str(e)[:200]
+        for _n in GATEWAY_LADDER:
+            for _op in ("put", "get"):
+                vol.setdefault(f"gateway_{_op}_c{_n}_MiB_s",
+                               f"skipped: {str(e)[:150]}")
     try:
         # metrics-off wire pass (ISSUE 4): same pipeline config as the
         # primary run but with histograms + trace spans darkened on

@@ -30,7 +30,7 @@ from ..core.fops import FopError
 from ..core.graph import Graph
 from ..core.iatt import Iatt, ROOT_GFID
 from ..core.inode import InodeTable
-from ..core.layer import FdObj, Loc
+from ..core.layer import Event, FdObj, Loc
 
 # one-shot whole-file read window (readv truncates at EOF); files larger
 # than this continue in a loop.  Kept moderate: page-granular perf
@@ -188,6 +188,30 @@ class File:
                 await release(self.fd)
 
 
+class _UpcallSink:
+    """Top-of-graph event tap — the glfs upcall consumer (reference
+    api/src/glfs-handleops.c glfs_h_poll_upcall / the mount's
+    invalidate callbacks).  A server-pushed cache-invalidation drops
+    this client's cached dentry + inode identity so the NEXT resolve
+    refetches.  Without it, a second front door on the same volume
+    (the object gateway) deleting and recreating a path leaves this
+    client resolving the dead gfid out of its itable forever — the
+    layer caches (md-cache/io-cache) revalidate on upcall, but the
+    api-level dentry cache must too."""
+
+    __slots__ = ("itable", "invalidations")
+
+    def __init__(self, itable: InodeTable):
+        self.itable = itable
+        self.invalidations = 0
+
+    def notify(self, event, source=None, data=None) -> None:
+        if event is Event.UPCALL and isinstance(data, dict) and \
+                data.get("gfid"):
+            self.invalidations += 1
+            self.itable.invalidate(data["gfid"])
+
+
 class Client:
     """Async client over an activated graph (glfs_t analog)."""
 
@@ -196,10 +220,13 @@ class Client:
         self.itable = InodeTable()
         self.mounted = False
         self.watchers: list = []  # background tasks (volfile watcher)
+        self.upcall_sink = _UpcallSink(self.itable)
 
     async def mount(self) -> None:
         if not self.graph.active:
             await self.graph.activate()
+        if self.upcall_sink not in self.graph.top.parents:
+            self.graph.top.parents.append(self.upcall_sink)
         self.mounted = True
 
     async def unmount(self) -> None:
@@ -213,6 +240,8 @@ class Client:
             except (asyncio.CancelledError, Exception):
                 pass
         self.watchers.clear()
+        if self.upcall_sink in self.graph.top.parents:
+            self.graph.top.parents.remove(self.upcall_sink)
         if self.graph.active:
             await self.graph.fini()
         self.mounted = False
@@ -233,6 +262,11 @@ class Client:
         try:
             await wait_connected(new)
             old, self.graph = self.graph, new
+            # the upcall tap follows the live graph (same reason fds
+            # migrate: invalidations must keep landing after the swap)
+            if self.upcall_sink in old.top.parents:
+                old.top.parents.remove(self.upcall_sink)
+            new.top.parents.append(self.upcall_sink)
         except BaseException:
             # cancelled/failed mid-swap: don't leak the half-built graph
             # (shielded — the fini must run even though we were cancelled)
@@ -301,7 +335,13 @@ class Client:
 
     async def mkdir(self, path: str, mode: int = 0o755) -> Iatt:
         loc = await self._parent_loc(path)
-        return await self.graph.top.mkdir(loc, mode)
+        ia = await self.graph.top.mkdir(loc, mode)
+        if hasattr(ia, "gfid"):
+            # cache the fresh dentry like create does: the next resolve
+            # under this directory must not pay a lookup round trip
+            self.itable.link(loc.parent, loc.name, ia.gfid,
+                             ia.ia_type, ia)
+        return ia
 
     async def unlink(self, path: str) -> None:
         loc = await self.resolve(path)
@@ -318,6 +358,10 @@ class Client:
         newloc = await self._parent_loc(new)
         await self.graph.top.rename(oldloc, newloc)
         self.itable.unlink(oldloc.parent, oldloc.name)
+        # a REPLACED destination's cached dentry now names the dead
+        # gfid, and this client is the mutation's originator so no
+        # upcall will correct it — drop it here
+        self.itable.unlink(newloc.parent, newloc.name)
 
     async def symlink(self, target: str, path: str) -> Iatt:
         loc = await self._parent_loc(path)
@@ -469,7 +513,8 @@ class Client:
         finally:
             await f.close()
 
-    async def read_file(self, path: str) -> bytes:
+    async def read_file(self, path: str, offset: int = 0,
+                        size: int | None = None):
         """Whole-file read WITHOUT a leading stat wave: readv truncates
         at EOF (POSIX read semantics), so asking for a huge size in one
         call returns the file — the size probe's cluster-wide lookup
@@ -479,7 +524,50 @@ class Client:
         already zero round trips), the whole pass is ONE chain —
         lookup+open+readv+release fused into a single round trip where
         the graph carries it (the smallfile-read hot path, the read
-        mirror of write_file's create chain)."""
+        mirror of write_file's create chain).
+
+        Ranged form (``offset``/``size`` given, the glfs_pread window
+        analog): the SAME single chain carries the window, and the
+        return value is the RAW readv payload — an :class:`wire.SGBuf`
+        of wire-frame/page-cache segment views, a memoryview, or bytes
+        — NOT joined.  Callers that scatter the bytes onward (the HTTP
+        gateway's ``writelines``, os.writev consumers) keep the
+        zero-copy lane end to end; ``bytes(result)`` pays the one join
+        where plain bytes are demanded.  The default whole-file call
+        keeps returning owned ``bytes``."""
+        ranged = offset != 0 or size is not None
+        want = _READ_ALL if size is None else size
+        if want <= 0:
+            return b""
+        if ranged and size is None:
+            # open-ended tail (offset to EOF): loop _READ_ALL windows
+            # so a >64MiB tail is never silently truncated, collecting
+            # the raw windows into one unjoined segment vector
+            from ..rpc.wire import SGBuf
+
+            segs: list = []
+            f = await self.open(path, os.O_RDONLY)
+            try:
+                pos = offset
+                while True:
+                    data = await self.graph.top.readv(f.fd, _READ_ALL,
+                                                      pos)
+                    n = len(data)
+                    if n:
+                        if isinstance(data, SGBuf):
+                            segs.extend(data.segments)
+                        else:
+                            segs.append(data if isinstance(
+                                data, memoryview) else memoryview(
+                                    bytes(data)))
+                    pos += n
+                    if n < _READ_ALL:
+                        break
+            finally:
+                await f.close()
+            if not segs:
+                return b""
+            return segs[0] if len(segs) == 1 else SGBuf(segs)
         if self._use_compound() and _norm(path) != "/" and \
                 not self._lazy_open_graph():
             from ..rpc import compound as cfop
@@ -488,7 +576,7 @@ class Client:
             replies = await self.graph.top.compound([
                 ("lookup", (loc,), {}),
                 ("open", (loc, os.O_RDONLY), {}),
-                ("readv", (cfop.FdRef(1), _READ_ALL, 0), {}),
+                ("readv", (cfop.FdRef(1), want, offset), {}),
                 ("release", (cfop.FdRef(1),), {})])
             err = cfop.first_error(replies)
             if err is not None:
@@ -499,6 +587,8 @@ class Client:
                 self.itable.link(loc.parent, loc.name, ia.gfid,
                                  ia.ia_type, ia)
             data = replies[2][1]
+            if ranged:
+                return data  # raw window: segments stay unjoined
             out = data if isinstance(data, bytes) else bytes(data)
             if len(out) < _READ_ALL:
                 return out
@@ -515,6 +605,10 @@ class Client:
                 await f.close()
         f = await self.open(path, os.O_RDONLY)
         try:
+            if ranged:
+                # raw window through the graph top (File.read would
+                # join to bytes — the ranged contract is segments)
+                return await self.graph.top.readv(f.fd, want, offset)
             out = await f.read(_READ_ALL, 0)
             if len(out) < _READ_ALL:
                 return out

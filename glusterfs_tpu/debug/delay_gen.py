@@ -54,4 +54,8 @@ def _make_delayed(op_name: str):
 
 
 for _fop in Fop:
+    if _fop is Fop.COMPOUND:
+        # as in error-gen: chains must decompose through the per-fop
+        # delay wrappers, not sail past them as one forwarded frame
+        continue
     setattr(DelayGenLayer, _fop.value, _make_delayed(_fop.value))

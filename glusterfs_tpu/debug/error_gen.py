@@ -58,4 +58,9 @@ def _make_injected(op_name: str):
 
 
 for _fop in Fop:
+    if _fop is Fop.COMPOUND:
+        # keep Layer.compound's decompose-through-own-fops: a blanket
+        # "injected compound" override would forward chains INTACT and
+        # the per-fop injection would silently never bite chained fops
+        continue
     setattr(ErrorGenLayer, _fop.value, _make_injected(_fop.value))

@@ -21,7 +21,7 @@ from __future__ import annotations
 import time
 from typing import Callable
 
-from ..core.fops import Fop, WRITE_FOPS
+from ..core.fops import Fop, FopError, WRITE_FOPS
 from ..core.iatt import Iatt
 from ..core.layer import FdObj, Layer, Loc, register
 from ..core.options import Option
@@ -148,3 +148,33 @@ for _f in _CACHE_FOPS:
     setattr(UpcallLayer, _f.value, _observing(_f.value, mutates=False))
 for _f in WRITE_FOPS:
     setattr(UpcallLayer, _f.value, _observing(_f.value, mutates=True))
+
+
+async def _upcall_rename(self, oldloc: Loc, newloc: Loc,
+                         xdata: dict | None = None):
+    """Rename needs more than the generic write wrapper: a REPLACED
+    destination dies in the rename, but the args only carry the
+    source's gfid — resolve the destination's current identity first
+    (a local brick-graph lookup, no wire hop) so clients caching the
+    victim get invalidated too (upcall.c does the same via the
+    newloc inode)."""
+    victim = None
+    try:
+        ia, _ = await self.children[0].lookup(
+            Loc(newloc.path, parent=newloc.parent, name=newloc.name))
+        victim = ia.gfid
+    except FopError:
+        pass  # fresh destination: nothing to invalidate
+    ret = await self.children[0].rename(oldloc, newloc, xdata)
+    client = CURRENT_CLIENT.get(None)
+    gfids = self._gfids_of((oldloc, newloc), ret)
+    if victim:
+        gfids.add(victim)
+    for gfid in gfids:
+        self._notify_mutation(gfid, client, "rename")
+        if client is not None:
+            self._touch(gfid, client)
+    return ret
+
+
+UpcallLayer.rename = _upcall_rename

@@ -1,0 +1,17 @@
+"""S3-flavored HTTP object gateway — a second front door for
+many-client traffic (ROADMAP open item 4).
+
+The reference ships whole alternate access stacks beside the fuse
+mount (gNFS in xlators/nfs, gfapi consumers like NFS-Ganesha and
+Samba-vfs); this package is that idea for the HTTP-object workload: an
+asyncio HTTP/1.1 daemon speaking an S3-flavored dialect over pooled
+:class:`api.glfs.Client` handles, so thousands of small concurrent
+requests multiplex onto a handful of wire connections instead of one
+kernel bridge.
+
+See :mod:`glusterfs_tpu.gateway.server` for the dialect and
+docs/object_gateway.md for the API tour, the coherence model against a
+concurrent fuse client, and the GET-path copy census.
+"""
+
+from .server import ClientPool, ObjectGateway  # noqa: F401

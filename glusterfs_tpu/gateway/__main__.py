@@ -1,0 +1,112 @@
+"""Gateway daemon entry point (glusterd's spawner runs this):
+
+    python -m glusterfs_tpu.gateway --glusterd 127.0.0.1:24007 \
+        --volume vol0 --listen 0 --portfile /tmp/gw.port
+
+Each pool member is a full managed mount (GETSPEC + volfile watcher),
+so live ``volume set`` changes reconfigure the gateway's graphs the
+same way they reconfigure a fuse mount.  ``--volfile`` serves a raw
+volfile instead (tests / standalone use — no watcher, no glusterd).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import sys
+
+from ..core import events as gf_events
+from ..core import gflog
+from .server import ClientPool, ObjectGateway
+
+log = gflog.get_logger("gateway.daemon")
+
+
+async def _amain(args) -> None:
+    if args.eventsd:
+        gf_events.configure(args.eventsd)
+
+    if args.volfile:
+        with open(args.volfile) as f:
+            text = f.read()
+
+        async def factory():
+            from ..api.glfs import Client, wait_connected
+            from ..core.graph import Graph
+
+            graph = Graph.construct(text)
+            client = Client(graph)
+            await client.mount()
+            await wait_connected(graph)
+            return client
+    else:
+        host, _, port = args.glusterd.rpartition(":")
+        gd_host, gd_port = host or "127.0.0.1", int(port)
+
+        async def factory():
+            from ..mgmt.glusterd import mount_volume
+
+            return await mount_volume(gd_host, gd_port, args.volume)
+
+    gw = ObjectGateway(ClientPool(factory, args.pool),
+                       host=args.host, port=args.listen,
+                       max_clients=args.max_clients,
+                       volume=args.volume or args.volfile)
+    await gw.start()
+    if args.portfile:
+        tmp = args.portfile + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(gw.port))
+        os.replace(tmp, args.portfile)
+    metrics_srv = None
+    if args.metrics_port:
+        from ..daemon import serve_metrics
+
+        metrics_srv = await serve_metrics(args.host, args.metrics_port)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    if metrics_srv is not None:
+        metrics_srv.close()
+    await gw.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="gftpu-gateway")
+    p.add_argument("--glusterd", default="127.0.0.1:24007",
+                   help="mgmt endpoint for GETSPEC (ignored with "
+                        "--volfile)")
+    p.add_argument("--volume", default="",
+                   help="managed volume to serve")
+    p.add_argument("--volfile", default="",
+                   help="serve a raw client volfile instead of a "
+                        "managed volume")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--listen", type=int, default=0,
+                   help="HTTP port (0 = ephemeral)")
+    p.add_argument("--portfile", default="",
+                   help="write the bound port here")
+    p.add_argument("--pool", type=int, default=4,
+                   help="glfs client pool size (gateway.pool-size)")
+    p.add_argument("--max-clients", type=int, default=512,
+                   help="connection admission limit "
+                        "(gateway.max-clients)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve the unified metrics registry on this "
+                        "port (0 = off)")
+    p.add_argument("--eventsd", default="",
+                   help="host:port of gftpu-eventsd (arms GATEWAY_* "
+                        "lifecycle events; GFTPU_EVENTSD also works)")
+    args = p.parse_args(argv)
+    if not args.volume and not args.volfile:
+        p.error("one of --volume / --volfile is required")
+    asyncio.run(_amain(args))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,951 @@
+"""The object gateway daemon: asyncio HTTP/1.1 over pooled glfs.
+
+Dialect (S3-flavored; JSON where S3 speaks XML — docs/object_gateway.md
+has the full tour):
+
+    GET    /                       list buckets
+    PUT    /bucket                 create bucket (top-level directory)
+    DELETE /bucket                 remove bucket (must be empty -> 409)
+    GET    /bucket?list&prefix=&marker=&max-keys=&delimiter=
+                                   list objects (sorted, marker paging,
+                                   delimiter -> common_prefixes)
+    PUT    /bucket/key             write object (ETag: sha256 content
+                                   hash, the checksum layer's strong
+                                   digest, persisted as an xattr)
+    GET    /bucket/key             read object; ``Range: bytes=`` gives
+                                   206 served as SGBuf segments written
+                                   straight to the socket (no join)
+    HEAD   /bucket/key             stat + ETag, no body
+    DELETE /bucket/key             unlink
+
+Keys may contain ``/`` — they map to nested directories under the
+bucket, which is what makes ``delimiter=/`` listing a single readdir.
+
+Concurrency model: every HTTP connection is one asyncio task; fops
+multiplex onto a small :class:`ClientPool` of mounted
+:class:`api.glfs.Client` graphs (the pooled-glfs-handle analog of how
+NFS-Ganesha shares a few glfs_t among many NFS clients).  Admission
+control is connection-granular: past ``max_clients`` live connections
+the gateway answers 503 and emits ``GATEWAY_CLIENT_THROTTLED``.
+
+Zero-copy GET path: ranged reads ride
+:meth:`api.glfs.Client.read_file`'s raw window — wire blob views /
+io-cache page views arrive as :class:`rpc.wire.SGBuf` segments and go
+to the socket via ``StreamWriter.writelines`` with the response head
+prepended, so the payload is never joined in the gateway
+(``gftpu_gateway_body_writes_total{shape="sg"}`` counts the proof).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import errno
+import hashlib
+import itertools
+import json
+import os
+import time
+import urllib.parse
+from typing import Any, AsyncIterator, Callable
+
+from ..api.glfs import Client
+from ..core import events as gf_events
+from ..core import gflog
+from ..core.fops import FopError
+from ..core.metrics import REGISTRY, LogHistogram, labeled
+from ..rpc.wire import SGBuf
+
+log = gflog.get_logger("gateway")
+
+#: where the PUT-time content hash lives on the object (the reference
+#: stores bit-rot signatures the same way: a trusted xattr beside the
+#: data).  Plain ``user.`` namespace so fuse-side tooling can read it.
+ETAG_XATTR = "user.gftpu.etag"
+
+#: bodies up to this size are buffered and written as ONE compound
+#: create+writev+fsetxattr+flush+release chain (a single round trip on
+#: a compound-enabled volume); larger or chunked bodies stream through
+#: write-behind windows instead
+SMALL_BODY = 1 << 20
+
+#: streamed uploads land under this name in the target's directory and
+#: rename over the key on success — a torn body never replaces (or
+#: destroys) the previous object version.  Filtered from listings.
+TMP_PREFIX = ".gftpu.upload~"
+
+#: GET bodies beyond this stream as bounded read windows instead of
+#: one whole-object readv — a multi-GiB object (x a 512-client ladder)
+#: must never materialize as single frames on brick and gateway
+GET_STREAM_THRESHOLD = 8 << 20
+GET_STREAM_WINDOW = 4 << 20
+
+_READ_CHUNK = 256 << 10
+
+_REASONS = {200: "OK", 204: "No Content", 206: "Partial Content",
+            304: "Not Modified", 400: "Bad Request", 403: "Forbidden",
+            404: "Not Found", 405: "Method Not Allowed",
+            409: "Conflict", 411: "Length Required",
+            416: "Range Not Satisfiable", 500: "Internal Server Error",
+            503: "Service Unavailable", 507: "Insufficient Storage"}
+
+# one family set scraped over every live gateway instance (the
+# register_objects weak-population pattern core/metrics documents)
+_GATEWAYS = REGISTRY.register_objects(
+    "gftpu_gateway_requests_total", "counter",
+    "gateway HTTP requests by method and status",
+    lambda gw: [({"method": m, "status": str(s)}, v)
+                for (m, s), v in sorted(gw.requests.items())])
+REGISTRY.register_objects(
+    "gftpu_gateway_inflight", "gauge",
+    "in-flight gateway HTTP requests", lambda gw: [({}, gw.inflight)],
+    live=_GATEWAYS)
+REGISTRY.register_objects(
+    "gftpu_gateway_bytes_total", "counter",
+    "gateway HTTP payload bytes by direction",
+    lambda gw: [({"dir": "rx"}, gw.bytes_rx),
+                ({"dir": "tx"}, gw.bytes_tx)], live=_GATEWAYS)
+REGISTRY.register_objects(
+    "gftpu_gateway_request_seconds", "gauge",
+    "gateway request latency quantiles by method",
+    lambda gw: [({"method": m, "quantile": q},
+                 h.percentile(float(q)))
+                for m, h in sorted(gw.latency.items()) if h.total
+                for q in ("50", "99")], live=_GATEWAYS)
+REGISTRY.register_objects(
+    "gftpu_gateway_throttled_total", "counter",
+    "connections refused past gateway.max-clients",
+    lambda gw: [({}, gw.throttled)], live=_GATEWAYS)
+REGISTRY.register_objects(
+    "gftpu_gateway_body_writes_total", "counter",
+    "GET bodies by socket-write shape (sg = multi-segment writelines, "
+    "no join; joined = single-buffer write)",
+    lambda gw: [({"shape": k}, v)
+                for k, v in sorted(gw.body_writes.items())],
+    live=_GATEWAYS)
+REGISTRY.register_objects(
+    "gftpu_gateway_events_total", "counter",
+    "gateway lifecycle events emitted by kind",
+    lambda gw: labeled(gw.events), live=_GATEWAYS)
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, message: str = "",
+                 headers: dict | None = None):
+        super().__init__(message or _REASONS.get(status, ""))
+        self.status = status
+        self.headers = headers or {}
+
+
+class _Body:
+    """One request's body stream, tracking whether it was consumed to
+    the end — a response sent with body bytes still unread means the
+    connection cannot be reused (the leftovers would be parsed as the
+    next request: smuggling), so the serve loop checks ``consumed``
+    after every dispatch."""
+
+    def __init__(self, gw: "ObjectGateway", reader, headers: dict):
+        self._gw = gw
+        self._reader = reader
+        self._headers = headers
+        self._chunked = "chunked" in headers.get(
+            "transfer-encoding", "").lower()
+        self.consumed = not (self._chunked or
+                             int(headers.get("content-length") or 0))
+
+    async def chunks(self) -> AsyncIterator[bytes]:
+        reader = self._reader
+        if self._chunked:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    # EOF before the terminal 0-chunk: a torn upload
+                    # must NOT be committed as a complete object
+                    raise ConnectionError("request body truncated")
+                size = int(line.split(b";")[0].strip() or b"0", 16)
+                if size == 0:
+                    while True:  # drain trailers
+                        t = await reader.readline()
+                        if t in (b"\r\n", b"\n", b""):
+                            break
+                    self.consumed = True
+                    return
+                data = await reader.readexactly(size)
+                await reader.readexactly(2)  # chunk CRLF
+                self._gw.bytes_rx += len(data)
+                yield data
+        else:
+            n = int(self._headers.get("content-length") or 0)
+            while n > 0:
+                chunk = await reader.read(min(n, _READ_CHUNK))
+                if not chunk:
+                    raise ConnectionError("request body truncated")
+                n -= len(chunk)
+                self._gw.bytes_rx += len(chunk)
+                yield chunk
+            self.consumed = True
+
+    async def drain(self) -> None:
+        async for _ in self.chunks():
+            pass
+
+
+_ERRNO_STATUS = {errno.ENOENT: 404, errno.ESTALE: 404,
+                 errno.ENOTDIR: 404, errno.EISDIR: 400,
+                 errno.EEXIST: 409, errno.ENOTEMPTY: 409,
+                 errno.EACCES: 403, errno.EPERM: 403,
+                 errno.EROFS: 403, errno.EDQUOT: 403,
+                 errno.ENOSPC: 507,
+                 errno.EINVAL: 400, errno.ENAMETOOLONG: 400}
+
+
+def _status_of(e: FopError) -> int:
+    return _ERRNO_STATUS.get(e.err, 500)
+
+
+class ClientPool:
+    """A fixed pool of mounted glfs clients handed out round-robin.
+
+    One Client is one graph is a handful of TCP connections; pooling a
+    few of them gives the gateway parallel wire pipelines without a
+    graph per HTTP client (glfs_t is ~a mount, not ~a socket)."""
+
+    def __init__(self, factory: Callable, size: int = 4):
+        self._factory = factory  # async () -> mounted Client
+        self.size = max(1, int(size))
+        self.clients: list[Client] = []
+        self._next = 0
+
+    async def start(self) -> None:
+        for _ in range(self.size):
+            self.clients.append(await self._factory())
+
+    def acquire(self) -> Client:
+        c = self.clients[self._next % len(self.clients)]
+        self._next += 1
+        return c
+
+    async def close(self) -> None:
+        for c in self.clients:
+            try:
+                await c.unmount()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        self.clients.clear()
+
+
+class ObjectGateway:
+    """The HTTP front door (one instance per served volume)."""
+
+    def __init__(self, pool: ClientPool, host: str = "127.0.0.1",
+                 port: int = 0, max_clients: int = 512,
+                 volume: str = ""):
+        self.pool = pool
+        self.host = host
+        self.port = port
+        self.max_clients = int(max_clients)
+        self.volume = volume
+        self._server: asyncio.AbstractServer | None = None
+        self.conns = 0
+        self.inflight = 0
+        self.requests: dict[tuple[str, int], int] = {}
+        self.latency: dict[str, LogHistogram] = {}
+        self.bytes_rx = 0
+        self.bytes_tx = 0
+        self.throttled = 0
+        self.body_writes = {"sg": 0, "joined": 0}
+        self.sg_segments = 0  # segments written without a join, total
+        self.events = {"GATEWAY_START": 0, "GATEWAY_STOP": 0,
+                       "GATEWAY_CLIENT_THROTTLED": 0}
+        self._tmp_seq = itertools.count()
+        _GATEWAYS.add(self)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _event(self, kind: str, **fields) -> None:
+        self.events[kind] = self.events.get(kind, 0) + 1
+        gf_events.gf_event(kind, volume=self.volume, port=self.port,
+                           **fields)
+
+    async def start(self) -> None:
+        if not self.pool.clients:
+            await self.pool.start()
+        self._server = await asyncio.start_server(
+            self._serve_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._event("GATEWAY_START", pool=self.pool.size,
+                    max_clients=self.max_clients)
+        log.info(2, "object gateway for %s on %s:%d (pool=%d)",
+                 self.volume or "<volfile>", self.host, self.port,
+                 self.pool.size)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        await self.pool.close()
+        self._event("GATEWAY_STOP")
+
+    # -- HTTP plumbing -----------------------------------------------------
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        if self.conns >= self.max_clients:
+            # admission control: shed the CONNECTION before parsing
+            # anything (a saturated gateway must stay cheap to refuse)
+            self.throttled += 1
+            self._event("GATEWAY_CLIENT_THROTTLED",
+                        conns=self.conns, limit=self.max_clients)
+            try:
+                writer.write(b"HTTP/1.1 503 Service Unavailable\r\n"
+                             b"Connection: close\r\n"
+                             b"Retry-After: 1\r\n"
+                             b"Content-Length: 0\r\n\r\n")
+                await writer.drain()
+            except ConnectionError:
+                pass
+            finally:
+                writer.close()
+            return
+        self.conns += 1
+        try:
+            while True:
+                try:
+                    req = await self._read_request(reader)
+                except (asyncio.IncompleteReadError,
+                        asyncio.LimitOverrunError, ConnectionError,
+                        ValueError):
+                    break
+                if req is None:
+                    break
+                method, target, headers = req
+                cl = headers.get("content-length")
+                if cl is not None and not cl.strip().isdigit():
+                    # malformed framing header: 400 and drop the
+                    # connection (the body length is unknowable)
+                    await self._respond(
+                        writer, 400,
+                        {"content-type": "application/json"},
+                        b'{"error": "bad Content-Length"}')
+                    break
+                body = _Body(self, reader, headers)
+                keep = headers.get("connection", "").lower() != "close"
+                # stats key off a closed vocabulary: arbitrary client
+                # method strings must not grow the label sets unbounded
+                mkey = method if method in (
+                    "GET", "PUT", "HEAD", "DELETE", "POST",
+                    "OPTIONS") else "OTHER"
+                self.inflight += 1
+                t0 = time.perf_counter()
+                status = 500
+                try:
+                    status = await self._dispatch(
+                        method, target, headers, body, writer)
+                except ConnectionError:
+                    break
+                finally:
+                    self.inflight -= 1
+                    self.requests[(mkey, status)] = \
+                        self.requests.get((mkey, status), 0) + 1
+                    self.latency.setdefault(
+                        mkey, LogHistogram()).record(
+                            time.perf_counter() - t0)
+                if not body.consumed:
+                    # a response went out before the request body was
+                    # fully read (error mid-PUT): the leftover body
+                    # bytes MUST NOT be parsed as the next request
+                    # (request smuggling) — drop the connection
+                    break
+                if not keep:
+                    break
+        finally:
+            self.conns -= 1
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    @staticmethod
+    async def _read_request(reader):
+        line = await reader.readline()
+        if not line:
+            return None
+        parts = line.decode("latin-1").rstrip("\r\n").split()
+        if len(parts) != 3 or not parts[2].startswith("HTTP/"):
+            raise ValueError("malformed request line")
+        headers: dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode("latin-1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        return parts[0].upper(), parts[1], headers
+
+    async def _respond(self, writer, status: int,
+                       headers: dict[str, Any] | None = None,
+                       body=None, head: bool = False) -> int:
+        hdrs = dict(headers or {})
+        if body is None:
+            length = int(hdrs.pop("content-length", 0))
+        else:
+            length = len(body)
+        head_lines = [f"HTTP/1.1 {status} "
+                      f"{_REASONS.get(status, 'OK')}",
+                      f"Content-Length: {length}"]
+        head_lines += [f"{k}: {v}" for k, v in hdrs.items()]
+        prefix = ("\r\n".join(head_lines) + "\r\n\r\n").encode("latin-1")
+        if head or body is None or length == 0:
+            writer.write(prefix)
+        elif isinstance(body, SGBuf) and len(body.segments) > 1:
+            # the zero-copy lane: response head + every payload segment
+            # in ONE gathered writelines — the segments are wire-frame /
+            # page-cache views that were never joined
+            writer.writelines([prefix, *body.segments])
+            self.body_writes["sg"] += 1
+            self.sg_segments += len(body.segments)
+        else:
+            if isinstance(body, SGBuf):
+                body = body.segments[0] if body.segments else b""
+            writer.writelines([prefix, body])
+            self.body_writes["joined"] += 1
+        if not head and body is not None:
+            self.bytes_tx += length
+        await writer.drain()
+        return status
+
+    # -- request routing ---------------------------------------------------
+
+    @staticmethod
+    def _split_target(target: str) -> tuple[list[str], dict]:
+        path, _, query = target.partition("?")
+        comps = [urllib.parse.unquote(c)
+                 for c in path.split("/") if c != ""]
+        for c in comps:
+            # validated AFTER unquoting: a %2F inside a component
+            # would otherwise smuggle '..' segments past this check
+            # and normpath would walk them out of the bucket
+            if c in (".", "..") or "/" in c or "\x00" in c:
+                raise _HttpError(400, "bad path component")
+        q = urllib.parse.parse_qs(query, keep_blank_values=True)
+        return comps, {k: v[-1] for k, v in q.items()}
+
+    async def _dispatch(self, method, target, headers, body,
+                        writer) -> int:
+        try:
+            comps, query = self._split_target(target)
+            c = self.pool.acquire()
+            if not comps:
+                if method in ("GET", "HEAD"):
+                    await body.drain()
+                    return await self._list_buckets(
+                        c, writer, head=method == "HEAD")
+                raise _HttpError(405)
+            if len(comps) == 1:
+                return await self._bucket_op(c, method, comps[0],
+                                             query, headers, body,
+                                             writer)
+            bucket, key = comps[0], "/".join(comps[1:])
+            if method == "PUT":
+                return await self._put_object(c, bucket, key, headers,
+                                              body, writer)
+            await body.drain()
+            if method in ("GET", "HEAD"):
+                return await self._get_object(
+                    c, bucket, key, headers, writer,
+                    head=method == "HEAD")
+            if method == "DELETE":
+                await c.unlink(f"/{bucket}/{key}")
+                return await self._respond(writer, 204)
+            raise _HttpError(405)
+        except _HttpError as e:
+            body = json.dumps({"error": str(e) or
+                               _REASONS.get(e.status, "")}).encode()
+            return await self._respond(
+                writer, e.status,
+                {"content-type": "application/json", **e.headers},
+                b"" if e.status == 304 else body,
+                head=method == "HEAD")
+        except FopError as e:
+            status = _status_of(e)
+            body = json.dumps({"error": str(e),
+                               "errno": e.err}).encode()
+            return await self._respond(
+                writer, status, {"content-type": "application/json"},
+                body, head=method == "HEAD")
+        except (asyncio.IncompleteReadError, ConnectionError):
+            raise ConnectionError
+        except Exception as e:  # noqa: BLE001 - one request, not the daemon
+            log.error(3, "gateway request failed: %r", e)
+            return await self._respond(
+                writer, 500, {"content-type": "application/json"},
+                json.dumps({"error": repr(e)}).encode(),
+                head=method == "HEAD")
+
+    # -- buckets -----------------------------------------------------------
+
+    async def _list_buckets(self, c: Client, writer,
+                            head: bool = False) -> int:
+        out = []
+        for name, ia in sorted(await c.listdir_with_stat("/")):
+            if ia is not None and ia.is_dir():
+                out.append({"name": name,
+                            "created": getattr(ia, "ctime", 0)})
+        body = json.dumps({"buckets": out}).encode()
+        return await self._respond(
+            writer, 200, {"content-type": "application/json"}, body,
+            head=head)
+
+    async def _bucket_op(self, c: Client, method: str, bucket: str,
+                         query: dict, headers, body, writer) -> int:
+        if method == "PUT":
+            await body.drain()
+            try:
+                await c.mkdir(f"/{bucket}")
+            except FopError as e:
+                if e.err != errno.EEXIST:  # idempotent create (S3: 200)
+                    raise
+            return await self._respond(writer, 200)
+        await body.drain()
+        if method == "DELETE":
+            await c.rmdir(f"/{bucket}")
+            return await self._respond(writer, 204)
+        if method == "HEAD":
+            ia = await c.stat(f"/{bucket}")
+            if not ia.is_dir():
+                raise _HttpError(404, "not a bucket")
+            return await self._respond(writer, 200, head=True)
+        if method == "GET":
+            return await self._list_objects(c, bucket, query, writer)
+        raise _HttpError(405)
+
+    # -- listing -----------------------------------------------------------
+
+    async def _walk_keys(self, c: Client, root: str, rel: str,
+                         out: list) -> None:
+        for name, ia in sorted(await c.listdir_with_stat(root)):
+            if name.startswith(TMP_PREFIX):
+                continue  # in-flight uploads are not objects
+            child = f"{root.rstrip('/')}/{name}"
+            key = f"{rel}{name}"
+            if ia is not None and ia.is_dir():
+                await self._walk_keys(c, child, key + "/", out)
+            else:
+                out.append((key, ia))
+
+    async def _list_objects(self, c: Client, bucket: str, query: dict,
+                            writer) -> int:
+        ia = await c.stat(f"/{bucket}")  # 404 on missing bucket
+        if not ia.is_dir():
+            raise _HttpError(404, "not a bucket")
+        prefix = query.get("prefix", "")
+        # prefix flows into brick paths: the same traversal rules as
+        # path components, or '../other-bucket/' escapes the scope
+        if any(p in (".", "..") or "\x00" in p
+               for p in prefix.split("/")):
+            raise _HttpError(400, "bad prefix")
+        marker = query.get("marker", "")
+        delim = query.get("delimiter", "")
+        try:
+            max_keys = min(int(query.get("max-keys", 1000)), 100000)
+        except ValueError:
+            raise _HttpError(400, "bad max-keys")
+        walked: list = []
+        # delimiter='/' + a directory-shaped prefix is ONE readdir on
+        # the prefix directory (the nested-dir key mapping exists for
+        # exactly this); anything else pays the recursive walk
+        if delim == "/" and (prefix == "" or prefix.endswith("/")):
+            base = f"/{bucket}/{prefix}".rstrip("/") or f"/{bucket}"
+            try:
+                for name, e_ia in sorted(await c.listdir_with_stat(base)):
+                    if name.startswith(TMP_PREFIX):
+                        continue  # in-flight uploads are not objects
+                    if e_ia is not None and e_ia.is_dir():
+                        walked.append((f"{prefix}{name}/", None))
+                    else:
+                        walked.append((f"{prefix}{name}", e_ia))
+            except FopError as e:
+                if e.err not in (errno.ENOENT, errno.ESTALE,
+                                 errno.ENOTDIR):
+                    raise  # empty prefix dir -> empty listing
+        else:
+            # root the recursive walk at the prefix's directory
+            # component: O(matching subtree) round trips, not
+            # O(bucket) (a missing subtree is just an empty listing).
+            # KNOWN COST: each PAGE of a paged listing re-walks the
+            # subtree (marker/max-keys apply after the sorted walk —
+            # the unsorted depth-first order can't early-exit
+            # correctly); true incremental paging needs readdir-offset
+            # cursors, an open follow-up
+            pdir, _, _rest = prefix.rpartition("/")
+            root = f"/{bucket}/{pdir}" if pdir else f"/{bucket}"
+            try:
+                await self._walk_keys(c, root,
+                                      f"{pdir}/" if pdir else "",
+                                      walked)
+            except FopError as e:
+                if e.err not in (errno.ENOENT, errno.ESTALE,
+                                 errno.ENOTDIR):
+                    raise
+            walked = [(k, e) for k, e in walked if k.startswith(prefix)]
+            if delim:
+                grouped: list = []
+                seen: set[str] = set()
+                for k, e in walked:
+                    rest = k[len(prefix):]
+                    if delim in rest:
+                        cp = prefix + rest.split(delim)[0] + delim
+                        if cp not in seen:
+                            seen.add(cp)
+                            grouped.append((cp, None))
+                    else:
+                        grouped.append((k, e))
+                walked = grouped
+        walked.sort(key=lambda t: t[0])
+        keys, prefixes = [], []
+        truncated = False
+        next_marker = ""
+        # max-keys <= 0 is an empty NON-truncated page (S3 shape): a
+        # truncated=true answer with an empty next_marker would send
+        # paging clients into an infinite identical-request loop
+        for k, e in walked if max_keys > 0 else ():
+            if marker and k <= marker:
+                continue
+            if len(keys) + len(prefixes) >= max_keys:
+                truncated = True
+                break
+            next_marker = k
+            if e is None and (delim and k.endswith(delim)):
+                prefixes.append(k)
+            else:
+                keys.append({"key": k,
+                             "size": getattr(e, "size", 0),
+                             "mtime": getattr(e, "mtime", 0)})
+        body = json.dumps({
+            "bucket": bucket, "prefix": prefix, "marker": marker,
+            "delimiter": delim, "max_keys": max_keys, "keys": keys,
+            "common_prefixes": prefixes, "truncated": truncated,
+            "next_marker": next_marker if truncated else ""}).encode()
+        return await self._respond(
+            writer, 200, {"content-type": "application/json"}, body)
+
+    # -- objects -----------------------------------------------------------
+
+    async def _ensure_parents(self, c: Client, bucket: str,
+                              key: str) -> None:
+        """Create the key's intermediate directories — but never the
+        bucket itself: an ENOENT at the first component means the
+        bucket is missing, which is the caller's 404, not an implicit
+        bucket create."""
+        parts = key.split("/")[:-1]
+        if not parts:
+            if not await c.exists(f"/{bucket}"):
+                raise _HttpError(404, f"no such bucket {bucket!r}")
+            return
+        cur = f"/{bucket}"
+        for i, p in enumerate(parts):
+            cur = f"{cur}/{p}"
+            try:
+                await c.mkdir(cur)
+            except FopError as e:
+                if e.err in (errno.ENOENT, errno.ESTALE) and i == 0:
+                    raise _HttpError(404,
+                                     f"no such bucket {bucket!r}")
+                if e.err != errno.EEXIST:
+                    raise
+
+    async def _put_object(self, c: Client, bucket: str, key: str,
+                          headers, body, writer) -> int:
+        if "content-length" not in headers and \
+                "chunked" not in headers.get("transfer-encoding",
+                                             "").lower():
+            raise _HttpError(411)
+        # no up-front bucket probe: the create's own ENOENT tells a
+        # missing bucket apart (via _ensure_parents), so the hot PUT
+        # path pays zero extra round trips
+        length = headers.get("content-length")
+        chunks = body.chunks()
+        if length is not None and int(length) <= SMALL_BODY:
+            buf = bytearray()
+            async for chunk in chunks:
+                buf += chunk
+            etag = await self._write_small(c, bucket, key, bytes(buf))
+        else:
+            etag = await self._write_stream(c, bucket, key, chunks)
+        return await self._respond(writer, 200,
+                                   {"etag": f'"{etag}"'}, b"")
+
+    async def _write_small(self, c: Client, bucket: str, key: str,
+                           body: bytes) -> str:
+        """Whole small object in one pass; on a compound volume the
+        fresh-object case is ONE chain — create+writev+fsetxattr+flush+
+        release in a single round trip where the graph carries it (the
+        write_file chain plus the ETag xattr riding the same frame).
+        An EXISTING object (or a non-compound graph) goes through the
+        temp+rename commit so an overwrite is atomic."""
+        path = f"/{bucket}/{key}"
+        etag = hashlib.sha256(body).hexdigest()
+        xattrs = {ETAG_XATTR: etag.encode()}
+        if c._use_compound():
+            from ..rpc import compound as cfop
+
+            for attempt in (0, 1):
+                try:
+                    loc = await c._parent_loc(path)
+                except FopError as e:
+                    if e.err in (errno.ENOENT, errno.ESTALE) \
+                            and attempt == 0:
+                        await self._ensure_parents(c, bucket, key)
+                        continue
+                    raise
+                replies = await c.graph.top.compound([
+                    ("create", (loc, os.O_RDWR | os.O_EXCL, 0o644), {}),
+                    ("writev", (cfop.FdRef(0), body, 0), {}),
+                    ("fsetxattr", (cfop.FdRef(0), xattrs, 0), {}),
+                    ("flush", (cfop.FdRef(0),), {}),
+                    ("release", (cfop.FdRef(0),), {})])
+                err = cfop.first_error(replies)
+                if err is None:
+                    created = replies[0][1]
+                    ia = created[1] if isinstance(
+                        created, (list, tuple)) and len(created) > 1 \
+                        else None
+                    if hasattr(ia, "gfid"):
+                        c.itable.link(loc.parent, loc.name, ia.gfid,
+                                      ia.ia_type, ia)
+                    return etag
+                if err.err == errno.EEXIST:
+                    break  # overwrite: temp+rename path below
+                if replies and replies[0][0] == "ok":
+                    # the chain created the object but a LATER link
+                    # failed (ENOSPC mid-writev, ESTALE mid-chain...):
+                    # chains skip, they don't roll back — remove the
+                    # partial fresh object BEFORE any retry, so a
+                    # failed PUT commits nothing and a retry's create
+                    # doesn't trip over attempt 0's debris (the create
+                    # was O_EXCL, so no previous version existed here)
+                    try:
+                        await c.unlink(path)
+                    except FopError:
+                        pass
+                if err.err in (errno.ENOENT, errno.ESTALE) \
+                        and attempt == 0:
+                    await self._ensure_parents(c, bucket, key)
+                    continue
+                raise err
+
+        async def once():
+            yield body
+
+        return await self._write_stream(c, bucket, key, once())
+
+    async def _create_temp(self, c: Client, bucket: str, key: str):
+        """Create the upload's temp file in the target's directory
+        (rename stays within one dht subvolume placement step)."""
+        head, _, base = key.rpartition("/")
+        tmp_key = (f"{head}/" if head else "") + \
+            f"{TMP_PREFIX}{base}.{os.getpid()}.{next(self._tmp_seq)}"
+        path = f"/{bucket}/{tmp_key}"
+        for attempt in (0, 1):
+            try:
+                return tmp_key, await c.create(path,
+                                               os.O_RDWR | os.O_EXCL)
+            except FopError as e:
+                if e.err in (errno.ENOENT, errno.ESTALE) \
+                        and attempt == 0:
+                    await self._ensure_parents(c, bucket, key)
+                    continue
+                raise
+
+    async def _write_stream(self, c: Client, bucket: str, key: str,
+                            chunks) -> str:
+        """Multipart-style streaming PUT: request-body chunks land as
+        sequential writes that write-behind aggregates into window
+        flush chains (+flush rides the drain frame at close) — the
+        round-trip count is pinned by tests/test_gateway.py.  The
+        stream commits via temp + rename, so a torn body neither
+        replaces nor destroys the previous object version."""
+        tmp_key, f = await self._create_temp(c, bucket, key)
+        tmp = f"/{bucket}/{tmp_key}"
+        h = hashlib.sha256()
+        offset = 0
+        try:
+            async for chunk in chunks:
+                h.update(chunk)
+                await f.write(bytes(chunk), offset)
+                offset += len(chunk)
+            etag = h.hexdigest()
+            await f.fsetxattr({ETAG_XATTR: etag.encode()})
+            await f.close()
+            await c.rename(tmp, f"/{bucket}/{key}")
+        except BaseException:
+            # torn body / failed commit: remove the temp, the previous
+            # object version (if any) is untouched
+            try:
+                await f.close()
+            finally:
+                try:
+                    await c.unlink(tmp)
+                except FopError:
+                    pass
+            raise
+        return etag
+
+    @staticmethod
+    def _parse_range(spec: str, size: int) -> tuple[int, int] | None:
+        """``bytes=a-b`` -> (offset, length); None = whole body.
+        Raises 416 for a start past EOF (RFC 9110 semantics)."""
+        if not spec or not spec.startswith("bytes="):
+            return None
+        r = spec[len("bytes="):].split(",")[0].strip()  # first range
+        start_s, _, end_s = r.partition("-")
+        try:
+            if start_s == "":  # suffix form: last N bytes
+                n = int(end_s)
+                if n <= 0:
+                    raise ValueError
+                start = max(0, size - n)
+                end = size - 1
+            else:
+                start = int(start_s)
+                end = int(end_s) if end_s else size - 1
+        except ValueError:
+            raise _HttpError(400, f"bad Range {spec!r}")
+        if start >= size or start > end:
+            raise _HttpError(416, "range past EOF",
+                             {"content-range": f"bytes */{size}"})
+        end = min(end, size - 1)
+        return start, end - start + 1
+
+    async def _etag_of(self, c: Client, path: str) -> str:
+        try:
+            out = await c.getxattr(path, ETAG_XATTR)
+            val = out.get(ETAG_XATTR) if isinstance(out, dict) else out
+            if val:
+                return bytes(val).decode("latin-1")
+        except FopError:
+            pass  # written outside the gateway: no stored hash
+        return ""
+
+    async def _stream_body(self, writer, c: Client, path: str,
+                           offset: int, total: int, status: int,
+                           headers: dict) -> int:
+        """Large GET bodies: open ONCE, then bounded raw readv windows
+        on the held fd straight to the socket — segments stay unjoined
+        per window, nothing ever holds the whole object, and the held
+        fd keeps the streamed object stable against a concurrent
+        replace.  Once the head is out, ANY failure tears the
+        connection down: a second response injected mid-body would
+        desync every later request on the connection."""
+        f = await c.open(path, os.O_RDONLY)  # pre-head errors -> 4xx
+        try:
+            head_lines = [f"HTTP/1.1 {status} "
+                          f"{_REASONS.get(status, 'OK')}",
+                          f"Content-Length: {total}"]
+            head_lines += [f"{k}: {v}" for k, v in headers.items()]
+            writer.write(("\r\n".join(head_lines)
+                          + "\r\n\r\n").encode("latin-1"))
+            pos = 0
+            try:
+                while pos < total:
+                    data = await c.graph.top.readv(
+                        f.fd, min(GET_STREAM_WINDOW, total - pos),
+                        offset + pos)
+                    n = len(data)
+                    if not n:
+                        break  # short object: handled below
+                    if isinstance(data, SGBuf) and \
+                            len(data.segments) > 1:
+                        writer.writelines(data.segments)
+                        self.body_writes["sg"] += 1
+                        self.sg_segments += len(data.segments)
+                    else:
+                        if isinstance(data, SGBuf):
+                            data = data.segments[0] \
+                                if data.segments else b""
+                        writer.write(data)
+                        self.body_writes["joined"] += 1
+                    await writer.drain()
+                    pos += n
+            except ConnectionError:
+                raise
+            except Exception as e:  # noqa: BLE001 - head already sent
+                raise ConnectionError(
+                    f"mid-stream failure: {e!r}") from e
+            self.bytes_tx += pos
+            if pos != total:
+                # the object shrank mid-stream: the framed length is
+                # now a lie and the connection cannot be reused
+                raise ConnectionError("object shrank mid-GET")
+        finally:
+            try:
+                await f.close()
+            except FopError:
+                pass
+        return status
+
+    async def _get_object(self, c: Client, bucket: str, key: str,
+                          headers, writer, head: bool = False) -> int:
+        path = f"/{bucket}/{key}"
+        ia = await c.stat(path)
+        if ia.is_dir():
+            raise _HttpError(404, "key is a directory")
+        etag = await self._etag_of(c, path)
+        inm = headers.get("if-none-match", "").strip('"')
+        if etag and inm and inm == etag:
+            raise _HttpError(304, headers={"etag": f'"{etag}"'})
+        base_headers: dict[str, Any] = {
+            "content-type": "application/octet-stream",
+            "accept-ranges": "bytes",
+            "last-modified": str(getattr(ia, "mtime", 0))}
+        if etag:
+            base_headers["etag"] = f'"{etag}"'
+        rng = self._parse_range(headers.get("range", ""), ia.size)
+        if head:
+            base_headers["content-length"] = ia.size
+            return await self._respond(writer, 200, base_headers,
+                                       head=True)
+        if rng is not None:
+            offset, want = rng
+            if want > GET_STREAM_THRESHOLD:
+                base_headers["content-range"] = \
+                    f"bytes {offset}-{offset + want - 1}/{ia.size}"
+                return await self._stream_body(writer, c, path,
+                                               offset, want, 206,
+                                               base_headers)
+            # the raw ranged window: SGBuf wire/page segments, no join
+            data = await c.read_file(path, offset=offset, size=want)
+            base_headers["content-range"] = \
+                f"bytes {offset}-{offset + len(data) - 1}/{ia.size}"
+            return await self._respond(writer, 206, base_headers, data)
+        if ia.size == 0:
+            return await self._respond(writer, 200, base_headers, b"")
+        if ia.size > GET_STREAM_THRESHOLD:
+            return await self._stream_body(writer, c, path, 0,
+                                           ia.size, 200, base_headers)
+        data = await c.read_file(path, offset=0, size=ia.size)
+        if not etag:
+            # legacy object (written via fuse/glfs): hash what we are
+            # about to serve — this pays the one join the SG lane
+            # otherwise avoids, so it is the fallback, not the norm
+            etag = hashlib.sha256(
+                data if isinstance(data, (bytes, bytearray))
+                else bytes(data)).hexdigest()
+            base_headers["etag"] = f'"{etag}"'
+        return await self._respond(writer, 200, base_headers, data)
+
+    # -- introspection -----------------------------------------------------
+
+    def dump(self) -> dict:
+        return {"host": self.host, "port": self.port,
+                "volume": self.volume, "conns": self.conns,
+                "inflight": self.inflight,
+                "pool": self.pool.size,
+                "max_clients": self.max_clients,
+                "requests": {f"{m} {s}": v for (m, s), v
+                             in sorted(self.requests.items())},
+                "bytes_rx": self.bytes_rx, "bytes_tx": self.bytes_tx,
+                "throttled": self.throttled,
+                "body_writes": dict(self.body_writes),
+                "sg_segments": self.sg_segments,
+                "events": dict(self.events)}

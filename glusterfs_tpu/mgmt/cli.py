@@ -13,6 +13,7 @@ Command surface kept (cli-cmd-volume.c vocabulary):
     gftpu volume rebalance NAME
     gftpu volume profile NAME
     gftpu volume metrics NAME
+    gftpu volume gateway NAME start|stop|status
     gftpu peer probe HOST:PORT | peer status
 
 Talks to glusterd over the mgmt wire RPC (--server host:port, default
@@ -424,6 +425,13 @@ async def _run(args) -> Any:
             # histograms from every subsystem; core/metrics.py)
             async with MgmtClient(host, port) as c:
                 return await c.call("volume-metrics", name=args.name)
+        if sub == "gateway":
+            # volume gateway NAME start|stop|status — the HTTP object
+            # front door (gateway/); status reports pid + bound port
+            action = args.args[0] if args.args else "status"
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-gateway", name=args.name,
+                                    action=action)
         if sub == "top":
             # volume top NAME [open|read|write|read-bytes|write-bytes]
             # [COUNT] — ranked per-path counters from each BRICK's
@@ -540,7 +548,7 @@ def main(argv=None) -> int:
                                      "rebalance", "profile", "metrics",
                                      "quota", "bitrot", "add-brick",
                                      "remove-brick", "replace-brick",
-                                     "top"])
+                                     "top", "gateway"])
     vol.add_argument("name", nargs="?", default="")
     vol.add_argument("args", nargs="*")
 

@@ -110,6 +110,7 @@ class Glusterd:
         self.gsync: dict[str, subprocess.Popen] = {}  # volname -> gsyncd
         self.bitd: dict[str, subprocess.Popen] = {}  # volname -> bitd
         self.quotad: dict[str, subprocess.Popen] = {}  # volname -> quotad
+        self.gateway: dict[str, subprocess.Popen] = {}  # volname -> gateway
         self._server: asyncio.AbstractServer | None = None
         self._txn_lock = asyncio.Lock()
         self._txn_holder: str | None = None
@@ -179,6 +180,8 @@ class Glusterd:
                 if volgen._bool(vol.get("options", {}).get(
                         "features.quota", "off")):
                     self._spawn_quotad(vol)
+                if vol.get("gateway", {}).get("status") == "started":
+                    self._spawn_gateway(vol)
         # activated snapshots resume serving too
         for s in self.state.get("snaps", {}).values():
             vi = s.get("volinfo")
@@ -209,6 +212,8 @@ class Glusterd:
             self._kill_bitd(name)
         for name in list(self.quotad):
             self._kill_quotad(name)
+        for name in list(self.gateway):
+            self._kill_gateway(name)
         for name in list(self.shd):
             self._kill_shd(name)
         for name in list(self.bricks):
@@ -432,10 +437,15 @@ class Glusterd:
                 self._spawn_quotad(vol)
             if vol.get("georep", {}).get("status") == "started":
                 self._spawn_gsync(vol)
+            if vol.get("gateway", {}).get("status") == "started":
+                self._spawn_gateway(vol)
+            else:
+                self._kill_gateway(name)
         else:
             self._kill_shd(name)
             self._kill_bitd(name)
             self._kill_quotad(name)
+            self._kill_gateway(name)
             if deleted:
                 self._kill_gsync(name)
 
@@ -848,6 +858,8 @@ class Glusterd:
         if volgen._bool(vol.get("options", {}).get("features.quota",
                                                    "off")):
             self._spawn_quotad(vol)
+        if vol.get("gateway", {}).get("status") == "started":
+            self._spawn_gateway(vol)
         gf_event("VOLUME_START", name=name)
         await self._run_hooks("start", "post", name)
         return {"started": name,
@@ -877,6 +889,7 @@ class Glusterd:
         self._save()
         self._kill_bitd(name)
         self._kill_quotad(name)
+        self._kill_gateway(name)
         self._kill_shd(name)
         for b in vol["bricks"]:
             if b["node"] == self.uuid:
@@ -2443,6 +2456,113 @@ class Glusterd:
                 proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+    # -- HTTP object gateway (gateway/, ISSUE 6) ---------------------------
+    # Lifecycle rides the cluster txn like geo-rep: every node stores
+    # the started/stopped state and runs (or not) its own gateway
+    # daemon — the second front door scales out with the mgmt cluster.
+
+    async def op_volume_gateway(self, name: str,
+                                action: str = "status") -> dict:
+        vol = self._vol(name)
+        if action == "status":
+            return self._gateway_status(vol)
+        if action not in ("start", "stop"):
+            raise MgmtError(f"bad gateway action {action!r} "
+                            "(want start|stop|status)")
+        if action == "start" and vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        if self.cluster_op_version() < 8:
+            raise MgmtError(
+                "volume gateway needs cluster op-version >= 8 "
+                f"(cluster is at {self.cluster_op_version()})")
+        await self._cluster_txn(f"gateway-{action}", {"name": name})
+        return {"ok": True, **self._gateway_status(vol)}
+
+    def commit_gateway_start(self, name: str) -> dict:
+        vol = self._vol(name)
+        vol["gateway"] = {"status": "started"}
+        self._bump(vol)
+        self._save()
+        self._spawn_gateway(vol)
+        return {"gateway-started": name}
+
+    def commit_gateway_stop(self, name: str) -> dict:
+        vol = self._vol(name)
+        vol["gateway"] = {"status": "stopped"}
+        self._bump(vol)
+        self._save()
+        self._kill_gateway(name)
+        return {"gateway-stopped": name}
+
+    def _gateway_port(self, name: str) -> int:
+        try:
+            with open(os.path.join(self.workdir,
+                                   f"gateway-{name}.port")) as f:
+                return int(f.read())
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    def _gateway_status(self, vol: dict) -> dict:
+        name = vol["name"]
+        proc = self.gateway.get(name)
+        online = proc is not None and proc.poll() is None
+        return {"volume": name,
+                "gateway": {
+                    "status": vol.get("gateway", {}).get("status",
+                                                         "stopped"),
+                    "online": online,
+                    "pid": proc.pid if online else 0,
+                    "port": self._gateway_port(name) if online else 0}}
+
+    def _spawn_gateway(self, vol: dict) -> None:
+        from . import svcutil
+
+        name = vol["name"]
+        proc = self.gateway.get(name)
+        if proc is not None and proc.poll() is None:
+            return
+        opts = vol.get("options", {})
+        env = svcutil.spawn_env(vol, "GFTPU_GATEWAY")
+        portfile = os.path.join(self.workdir, f"gateway-{name}.port")
+        if os.path.exists(portfile):
+            os.unlink(portfile)
+        argv = [sys.executable, "-m", "glusterfs_tpu.gateway",
+                "--glusterd", f"{self.host}:{self.port}",
+                "--volume", name,
+                "--host", str(opts.get("gateway.listen-host",
+                                       "127.0.0.1")),
+                "--listen", str(opts.get("gateway.port", 0)),
+                "--pool", str(opts.get("gateway.pool-size", 4)),
+                "--max-clients", str(opts.get("gateway.max-clients",
+                                              512)),
+                "--portfile", portfile]
+        if opts.get("gateway.metrics-port"):
+            # the daemon's gftpu_gateway_* families are in ITS process:
+            # without this the managed front door is metrics-blind
+            argv += ["--metrics-port",
+                     str(opts["gateway.metrics-port"])]
+        ev = os.environ.get("GFTPU_EVENTSD")
+        if ev:
+            argv += ["--eventsd", ev]
+        with open(os.path.join(self.workdir, f"gateway-{name}.log"),
+                  "ab") as logf:
+            self.gateway[name] = subprocess.Popen(
+                argv, env=env, stdout=subprocess.DEVNULL, stderr=logf)
+
+    def _kill_gateway(self, name: str) -> None:
+        proc = self.gateway.pop(name, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        try:
+            os.unlink(os.path.join(self.workdir,
+                                   f"gateway-{name}.port"))
+        except FileNotFoundError:
+            pass
 
     # -- geo-replication (glusterd-geo-rep.c session mgmt analog) ----------
     # Session ops run through the cluster txn so every node stores the

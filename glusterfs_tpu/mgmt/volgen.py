@@ -476,6 +476,16 @@ OPTION_MAP = {
     # misc aliases the reference also carries
     "cluster.local-volume-name": ("cluster/nufa", "local-volume-name"),
     "config.transport": ("mgmt/glusterd", "transport"),
+    # ------------------------------------------------------------------
+    # S3-flavored HTTP object gateway (gateway/, ISSUE 6): keys
+    # consumed by glusterd's gateway spawner (a per-volume service
+    # daemon like bitd/quotad), not a graph layer.  Lifecycle is
+    # `gftpu volume gateway NAME start|stop|status`.
+    "gateway.port": ("mgmt/gateway", "port"),
+    "gateway.listen-host": ("mgmt/gateway", "listen-host"),
+    "gateway.pool-size": ("mgmt/gateway", "pool-size"),
+    "gateway.max-clients": ("mgmt/gateway", "max-clients"),
+    "gateway.metrics-port": ("mgmt/gateway", "metrics-port"),
 }
 
 # the option long tail above shipped at op-version 3: an older member
@@ -625,6 +635,18 @@ _V7_KEYS = (
     "diagnostics.span-ring-size",
 )
 OPTION_MIN_OPVERSION.update({k: 7 for k in _V7_KEYS})
+
+# round-9 additions ship at op-version 8: the HTTP object gateway —
+# a v7 member would store the keys but its glusterd has no gateway
+# spawner to consume them (and no `volume gateway` op to start one)
+_V8_KEYS = (
+    "gateway.port",
+    "gateway.listen-host",
+    "gateway.pool-size",
+    "gateway.max-clients",
+    "gateway.metrics-port",
+)
+OPTION_MIN_OPVERSION.update({k: 8 for k in _V8_KEYS})
 
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key

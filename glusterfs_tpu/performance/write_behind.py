@@ -148,6 +148,17 @@ class WriteBehindLayer(Layer):
             err, ctx.error = ctx.error, None
             raise err
 
+    async def create(self, loc, flags: int = 0, mode: int = 0o644,
+                     xdata: dict | None = None):
+        fd, ia = await self.children[0].create(loc, flags, mode, xdata)
+        # seed the window's postbuf with the create iatt: without it,
+        # EVERY write absorbed on a fresh fd pays a wire fstat just to
+        # fabricate its reply iatt (a streaming writer — the object
+        # gateway's chunked PUT — burned one round trip per chunk,
+        # which is exactly what the window exists to avoid)
+        self._ctx(fd).last_iatt = ia
+        return fd, ia
+
     async def writev(self, fd: FdObj, data, offset: int,
                      xdata: dict | None = None):
         import os as _os

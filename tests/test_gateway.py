@@ -1,0 +1,701 @@
+"""S3-flavored HTTP object gateway (gateway/, ISSUE 6): dialect
+round-trips, ≥64-client interleaved concurrency, ranged GET riding
+SGBuf segments into the socket with no join, multipart PUT landing as
+compound/write-behind chains (round-trip count pinned), admission
+throttling with lifecycle events, fuse-stack↔gateway coherence, and
+the registry families."""
+
+import asyncio
+import hashlib
+import json
+import os
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client, wait_connected
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import walk
+from glusterfs_tpu.core.metrics import REGISTRY
+from glusterfs_tpu.daemon import serve_brick
+from glusterfs_tpu.gateway import ClientPool, ObjectGateway
+# one request per connection (Connection: close); the SHARED client —
+# bench's ladder and the ci.sh smoke drive the same code
+from glusterfs_tpu.gateway.minihttp import fetch as http
+from glusterfs_tpu.protocol.client import ClientLayer
+
+BRICK = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+volume upcall
+    type features/upcall
+    subvolumes locks
+end-volume
+"""
+
+CLIENT = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume upcall
+{copts}end-volume
+{layers}"""
+
+
+def client_volfile(port, copts="", layers=""):
+    return CLIENT.format(port=port, copts=copts, layers=layers)
+
+
+def pool_factory(volfile_text):
+    async def factory():
+        g = Graph.construct(volfile_text)
+        c = Client(g)
+        await c.mount()
+        await wait_connected(g)
+        return c
+    return factory
+
+
+
+
+async def start_gateway(volfile_text, pool=2, max_clients=512):
+    gw = ObjectGateway(ClientPool(pool_factory(volfile_text), pool),
+                       max_clients=max_clients, volume="gwtest")
+    await gw.start()
+    return gw
+
+
+# -- dialect -----------------------------------------------------------
+
+
+def test_object_dialect_roundtrip(tmp_path):
+    """PUT/GET/HEAD/DELETE + bucket ops + ETag + conditional GET +
+    ranges: the full surface against one brick."""
+    async def run():
+        server = await serve_brick(BRICK.format(dir=tmp_path / "b"))
+        gw = await start_gateway(client_volfile(server.port))
+        H, P = gw.host, gw.port
+        payload = bytes(range(256)) * 64  # 16 KiB
+        try:
+            st, _, _ = await http(H, P, "PUT", "/bkt")
+            assert st == 200
+            st, _, _ = await http(H, P, "PUT", "/bkt")  # idempotent
+            assert st == 200
+            st, hd, _ = await http(H, P, "PUT", "/bkt/a/b/obj",
+                                   body=payload)
+            assert st == 200
+            etag = hd["etag"]
+            assert etag.strip('"') == hashlib.sha256(payload).hexdigest()
+            # missing bucket refused, not implicitly created
+            st, _, _ = await http(H, P, "PUT", "/nobkt/x", body=b"x")
+            assert st == 404
+            st, hd, data = await http(H, P, "GET", "/bkt/a/b/obj")
+            assert st == 200 and data == payload and hd["etag"] == etag
+            # conditional GET: matching ETag short-circuits the body
+            st, _, data = await http(H, P, "GET", "/bkt/a/b/obj",
+                                     headers={"if-none-match": etag})
+            assert st == 304 and data == b""
+            st, hd, data = await http(H, P, "HEAD", "/bkt/a/b/obj")
+            assert st == 200 and data == b""
+            assert int(hd["content-length"]) == len(payload)
+            assert hd["etag"] == etag
+            # ranged forms: mid-window, open end, suffix, past-EOF
+            st, hd, data = await http(
+                H, P, "GET", "/bkt/a/b/obj",
+                headers={"range": "bytes=100-299"})
+            assert st == 206 and data == payload[100:300]
+            assert hd["content-range"] == \
+                f"bytes 100-299/{len(payload)}"
+            st, _, data = await http(H, P, "GET", "/bkt/a/b/obj",
+                                     headers={"range": "bytes=16000-"})
+            assert st == 206 and data == payload[16000:]
+            st, _, data = await http(H, P, "GET", "/bkt/a/b/obj",
+                                     headers={"range": "bytes=-100"})
+            assert st == 206 and data == payload[-100:]
+            st, hd, _ = await http(H, P, "GET", "/bkt/a/b/obj",
+                                   headers={"range": "bytes=99999-"})
+            assert st == 416
+            assert hd["content-range"] == f"bytes */{len(payload)}"
+            st, _, data = await http(H, P, "GET", "/")
+            assert st == 200
+            assert [b["name"] for b in json.loads(data)["buckets"]] \
+                == ["bkt"]
+            st, _, _ = await http(H, P, "DELETE", "/bkt")
+            assert st == 409  # not empty
+            st, _, _ = await http(H, P, "DELETE", "/bkt/a/b/obj")
+            assert st == 204
+            st, _, _ = await http(H, P, "GET", "/bkt/a/b/obj")
+            assert st == 404
+        finally:
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_listing_delimiter_and_marker_paging(tmp_path):
+    """GET /bucket?list: sorted keys, prefix filter, delimiter ->
+    common_prefixes, marker paging walks the whole keyspace."""
+    async def run():
+        server = await serve_brick(BRICK.format(dir=tmp_path / "b"))
+        gw = await start_gateway(client_volfile(server.port))
+        H, P = gw.host, gw.port
+        try:
+            await http(H, P, "PUT", "/bkt")
+            keys = ["zz", "a/1", "a/2", "a/sub/3", "b/4", "top"]
+            for k in keys:
+                st, _, _ = await http(H, P, "PUT", f"/bkt/{k}",
+                                      body=k.encode())
+                assert st == 200
+            st, _, data = await http(H, P, "GET", "/bkt?list")
+            out = json.loads(data)
+            assert [k["key"] for k in out["keys"]] == sorted(keys)
+            assert out["keys"][0]["size"] == len("a/1")
+            # delimiter groups below the first separator
+            st, _, data = await http(H, P, "GET",
+                                     "/bkt?list&delimiter=/")
+            out = json.loads(data)
+            assert out["common_prefixes"] == ["a/", "b/"]
+            assert [k["key"] for k in out["keys"]] == ["top", "zz"]
+            # delimiter under a prefix directory
+            st, _, data = await http(
+                H, P, "GET", "/bkt?list&delimiter=/&prefix=a/")
+            out = json.loads(data)
+            assert out["common_prefixes"] == ["a/sub/"]
+            assert [k["key"] for k in out["keys"]] == ["a/1", "a/2"]
+            # marker paging, two per page
+            got, marker = [], ""
+            for _ in range(10):
+                st, _, data = await http(
+                    H, P, "GET",
+                    f"/bkt?list&max-keys=2&marker={marker}")
+                out = json.loads(data)
+                got += [k["key"] for k in out["keys"]]
+                if not out["truncated"]:
+                    break
+                marker = out["next_marker"]
+            assert got == sorted(keys)
+            # max-keys=0: empty NON-truncated page (a truncated answer
+            # with no marker would loop paging clients forever)
+            st, _, data = await http(H, P, "GET",
+                                     "/bkt?list&max-keys=0")
+            out = json.loads(data)
+            assert out["keys"] == [] and not out["truncated"]
+        finally:
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- concurrency -------------------------------------------------------
+
+
+def test_concurrent_64_clients_byte_identical(tmp_path):
+    """≥64 interleaved PUT/GET HTTP clients multiplexed onto a small
+    glfs pool: every round trip byte-identical, every request 200."""
+    async def run():
+        server = await serve_brick(BRICK.format(dir=tmp_path / "b"))
+        gw = await start_gateway(
+            client_volfile(server.port,
+                           copts="    option compound-fops on\n"),
+            pool=4)
+        H, P = gw.host, gw.port
+        try:
+            st, _, _ = await http(H, P, "PUT", "/c")
+            assert st == 200
+
+            async def one(i: int):
+                body = (bytes(range(256)) * 8)[i:] + bytes([i])
+                st, hd, _ = await http(H, P, "PUT", f"/c/obj{i}",
+                                       body=body)
+                assert st == 200, (i, st)
+                st, hd, data = await http(H, P, "GET", f"/c/obj{i}")
+                assert st == 200, (i, st)
+                assert data == body, f"client {i}: bytes differ"
+                assert hd["etag"].strip('"') == \
+                    hashlib.sha256(body).hexdigest()
+                return len(data)
+
+            sizes = await asyncio.gather(*(one(i) for i in range(64)))
+            assert len(sizes) == 64
+            assert gw.requests.get(("PUT", 200), 0) >= 65
+            assert gw.requests.get(("GET", 200), 0) >= 64
+        finally:
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- zero-copy GET path ------------------------------------------------
+
+
+def test_ranged_get_serves_sg_segments_without_join(tmp_path):
+    """A ranged GET whose window spans io-cache pages is written to the
+    socket as SGBuf segments via one writelines — the gateway never
+    joins the payload (body_writes['sg'] counts the proof)."""
+    async def run():
+        server = await serve_brick(BRICK.format(dir=tmp_path / "b"))
+        layers = """
+volume ioc
+    type performance/io-cache
+    option page-size 4KB
+    subvolumes c0
+end-volume
+"""
+        gw = await start_gateway(client_volfile(server.port,
+                                                layers=layers))
+        H, P = gw.host, gw.port
+        payload = bytes(range(256)) * 128  # 32 KiB = 8 pages
+        try:
+            await http(H, P, "PUT", "/z")
+            st, _, _ = await http(H, P, "PUT", "/z/obj", body=payload)
+            assert st == 200
+            # warm the page cache on every pool member (round-robin)
+            for _ in range(gw.pool.size):
+                st, _, data = await http(H, P, "GET", "/z/obj")
+                assert st == 200 and data == payload
+            before = dict(gw.body_writes)
+            segs_before = gw.sg_segments
+            st, _, data = await http(
+                H, P, "GET", "/z/obj",
+                headers={"range": "bytes=1000-20999"})
+            assert st == 206 and data == payload[1000:21000]
+            assert gw.body_writes["sg"] == before["sg"] + 1, \
+                "ranged GET did not ride the multi-segment lane"
+            assert gw.sg_segments - segs_before >= 2
+        finally:
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- multipart PUT through write chains --------------------------------
+
+
+def test_multipart_put_roundtrips_pinned(tmp_path):
+    """A chunked streaming PUT lands through write-behind windows and
+    compound chains: the wire cost is CONSTANT in the chunk count —
+    create(temp) + fsetxattr + ONE window+flush chain + the atomic
+    rename commit = 4 round trips for an 8-chunk body (release is
+    local fd retirement, and the create iatt seeds the window so no
+    per-write fstat fires), vs ≥12 unfused."""
+    async def run():
+        server = await serve_brick(BRICK.format(dir=tmp_path / "b"))
+        chunks = [bytes([i]) * 8192 for i in range(8)]
+        whole = b"".join(chunks)
+
+        async def put_once(copts, layers, path):
+            gw = await start_gateway(
+                client_volfile(server.port, copts=copts,
+                               layers=layers), pool=1)
+            H, P = gw.host, gw.port
+            try:
+                await http(H, P, "PUT", "/m")
+                cl = next(l for l in walk(
+                    gw.pool.clients[0].graph.top)
+                    if isinstance(l, ClientLayer))
+                base = cl.rpc_roundtrips
+                st, hd, _ = await http(H, P, "PUT", f"/m/{path}",
+                                       chunks=chunks)
+                assert st == 200
+                rts = cl.rpc_roundtrips - base
+                st, _, data = await http(H, P, "GET", f"/m/{path}")
+                assert st == 200 and data == whole
+                assert hd["etag"].strip('"') == \
+                    hashlib.sha256(whole).hexdigest()
+                return rts
+            finally:
+                await gw.stop()
+
+        wb = """
+volume wb
+    type performance/write-behind
+    option compound-fops on
+    option window-size 1MB
+    subvolumes c0
+end-volume
+"""
+        try:
+            fused = await put_once(
+                "    option compound-fops on\n", wb, "obj")
+            plain = await put_once("", "", "obj2")
+            # create(1) + fsetxattr(1) + window-drain-with-flush
+            # chain(1) + rename-commit(1); the 8 writevs never hit the
+            # wire individually
+            assert fused == 4, f"fused chunked PUT took {fused} RTs"
+            # unfused: create + 8 writev + fsetxattr + flush + rename
+            assert plain >= 12, f"unfused PUT took only {plain} RTs"
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_encoded_slash_traversal_rejected(tmp_path):
+    """%2F-encoded separators must not smuggle '..' segments past the
+    component check — the cross-bucket escape is refused, not served."""
+    async def run():
+        server = await serve_brick(BRICK.format(dir=tmp_path / "b"))
+        gw = await start_gateway(client_volfile(server.port))
+        H, P = gw.host, gw.port
+        try:
+            await http(H, P, "PUT", "/tenantA")
+            await http(H, P, "PUT", "/tenantB")
+            st, _, _ = await http(H, P, "PUT", "/tenantB/secret",
+                                  body=b"classified")
+            assert st == 200
+            evil = "/tenantA/x%2F..%2F..%2FtenantB%2Fsecret"
+            for method in ("GET", "DELETE"):
+                st, _, data = await http(H, P, method, evil)
+                assert st == 400, f"{method} {evil} -> {st}"
+            st, _, data = await http(H, P, "GET", "/tenantB/secret")
+            assert st == 200 and data == b"classified"
+            # plain '..' components stay rejected too
+            st, _, _ = await http(H, P, "GET", "/tenantA/../tenantB/"
+                                              "secret")
+            assert st == 400
+        finally:
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_large_get_streams_windows(tmp_path):
+    """A GET past the streaming threshold is served as bounded read
+    windows (several socket writes), byte-identical — the whole object
+    is never materialized as one frame."""
+    async def run():
+        server = await serve_brick(BRICK.format(dir=tmp_path / "b"))
+        gw = await start_gateway(client_volfile(server.port))
+        H, P = gw.host, gw.port
+        import numpy as np
+        payload = np.random.default_rng(3).integers(
+            0, 256, 12 << 20, dtype=np.uint8).tobytes()  # 12 MiB
+        try:
+            await http(H, P, "PUT", "/big")
+            st, _, _ = await http(H, P, "PUT", "/big/obj",
+                                  body=payload)
+            assert st == 200
+            before = sum(gw.body_writes.values())
+            st, hd, data = await http(H, P, "GET", "/big/obj")
+            assert st == 200 and data == payload
+            assert int(hd["content-length"]) == len(payload)
+            # 12 MiB / 4 MiB window = 3 windowed writes
+            assert sum(gw.body_writes.values()) - before >= 3
+            # a large range streams too
+            st, _, data = await http(
+                H, P, "GET", "/big/obj",
+                headers={"range": f"bytes=1000-{10 << 20}"})
+            assert st == 206 and data == payload[1000:(10 << 20) + 1]
+        finally:
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_failed_small_compound_put_commits_nothing(tmp_path):
+    """A mid-chain failure in the small-PUT compound (create ok,
+    writev ENOSPC) must not leave a partial object at the key —
+    chains skip, they don't roll back, so the gateway cleans up."""
+    async def run():
+        brick = BRICK.format(dir=tmp_path / "b") + """
+volume egen
+    type debug/error-gen
+    option enable writev
+    option failure 100
+    option error-no ENOSPC
+    subvolumes upcall
+end-volume
+"""
+        server = await serve_brick(brick)
+        gw = await start_gateway(client_volfile(
+            server.port, copts="    option compound-fops on\n")
+            .replace("remote-subvolume upcall", "remote-subvolume egen"),
+            pool=1)
+        H, P = gw.host, gw.port
+        try:
+            st, _, _ = await http(H, P, "PUT", "/e")
+            assert st == 200
+            st, _, _ = await http(H, P, "PUT", "/e/obj", body=b"data")
+            assert st == 507, f"expected 507 ENOSPC, got {st}"
+            st, _, _ = await http(H, P, "GET", "/e/obj")
+            assert st == 404, \
+                f"partial object committed by failed chain ({st})"
+        finally:
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_truncated_chunked_put_not_committed(tmp_path):
+    """A chunked PUT whose client dies before the terminal 0-chunk
+    must NOT be committed as a complete object with a valid ETag."""
+    async def run():
+        server = await serve_brick(BRICK.format(dir=tmp_path / "b"))
+        gw = await start_gateway(client_volfile(server.port))
+        H, P = gw.host, gw.port
+        try:
+            await http(H, P, "PUT", "/t")
+            r, w = await asyncio.open_connection(H, P)
+            chunk = b"x" * 8192
+            w.write(b"PUT /t/torn HTTP/1.1\r\nhost: gw\r\n"
+                    b"transfer-encoding: chunked\r\n\r\n"
+                    + f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+            await w.drain()
+            w.close()  # die before the 0-chunk
+            await asyncio.sleep(0.2)
+            st, _, _ = await http(H, P, "GET", "/t/torn")
+            assert st == 404, \
+                f"torn chunked upload was committed (GET -> {st})"
+        finally:
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- throttling + lifecycle events -------------------------------------
+
+
+def test_admission_throttle_and_events(tmp_path):
+    """Past max_clients live connections the gateway sheds load with
+    503 + GATEWAY_CLIENT_THROTTLED; start/stop emit lifecycle events
+    (datagrams observed on a stand-in eventsd socket)."""
+    import socket
+
+    from glusterfs_tpu.core import events as gf_events
+
+    async def run():
+        sink = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sink.bind(("127.0.0.1", 0))
+        sink.setblocking(False)
+        gf_events.configure(f"127.0.0.1:{sink.getsockname()[1]}")
+        server = await serve_brick(BRICK.format(dir=tmp_path / "b"))
+        gw = await start_gateway(client_volfile(server.port),
+                                 pool=1, max_clients=2)
+        H, P = gw.host, gw.port
+        try:
+            # park 2 connections mid-request (slow readers occupy the
+            # admission slots), then the 3rd is refused
+            holders = [await asyncio.open_connection(H, P)
+                       for _ in range(2)]
+            for _, w in holders:
+                w.write(b"GET / HTTP/1.1\r\n")  # incomplete: stays open
+                await w.drain()
+            await asyncio.sleep(0.1)
+            st, _, _ = await http(H, P, "GET", "/")
+            assert st == 503
+            assert gw.throttled == 1
+            assert gw.events["GATEWAY_CLIENT_THROTTLED"] == 1
+            for _, w in holders:
+                w.close()
+        finally:
+            await gw.stop()
+            await server.stop()
+            gf_events.configure(None)
+        assert gw.events["GATEWAY_START"] == 1
+        assert gw.events["GATEWAY_STOP"] == 1
+        seen = set()
+        for _ in range(16):
+            try:
+                seen.add(json.loads(sink.recv(65536))["event"])
+            except BlockingIOError:
+                break
+        sink.close()
+        assert {"GATEWAY_START", "GATEWAY_CLIENT_THROTTLED",
+                "GATEWAY_STOP"} <= seen, seen
+
+    asyncio.run(run())
+
+
+# -- registry families -------------------------------------------------
+
+
+def test_gateway_metrics_families(tmp_path):
+    """The request/latency/inflight/byte/throttle families are present
+    on the unified registry and move with traffic."""
+    async def run():
+        server = await serve_brick(BRICK.format(dir=tmp_path / "b"))
+        gw = await start_gateway(client_volfile(server.port))
+        H, P = gw.host, gw.port
+        try:
+            await http(H, P, "PUT", "/f")
+            await http(H, P, "PUT", "/f/k", body=b"x" * 4096)
+            await http(H, P, "GET", "/f/k")
+            snap = REGISTRY.snapshot()
+            for fam in ("gftpu_gateway_requests_total",
+                        "gftpu_gateway_inflight",
+                        "gftpu_gateway_bytes_total",
+                        "gftpu_gateway_request_seconds",
+                        "gftpu_gateway_throttled_total",
+                        "gftpu_gateway_body_writes_total",
+                        "gftpu_gateway_events_total"):
+                assert fam in snap, f"missing family {fam}"
+            # sum across instances: earlier tests' gateways may not be
+            # GC'd yet and the family scrapes every live one
+            reqs: dict = {}
+            for s in snap["gftpu_gateway_requests_total"]["samples"]:
+                k = (s[0]["method"], s[0]["status"])
+                reqs[k] = reqs.get(k, 0) + s[1]
+            assert reqs[("PUT", "200")] >= 2
+            assert reqs[("GET", "200")] >= 1
+            assert any(s[0]["method"] == "GET" and
+                       s[0]["quantile"] == "50" and s[1] > 0
+                       for s in snap["gftpu_gateway_request_seconds"]
+                       ["samples"])
+            rx: dict = {}
+            for s in snap["gftpu_gateway_bytes_total"]["samples"]:
+                rx[s[0]["dir"]] = rx.get(s[0]["dir"], 0) + s[1]
+            assert rx["rx"] >= 4096 and rx["tx"] >= 4096
+        finally:
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- managed lifecycle (glusterd spawner + volume gateway op) ----------
+
+
+@pytest.mark.slow
+def test_managed_gateway_lifecycle(tmp_path):
+    """`volume gateway NAME start` spawns the daemon from the volume's
+    gateway.* keys, status reports pid+port, HTTP works against the
+    managed volume, stop retires it; `volume stop` also kills it."""
+    async def run():
+        from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient
+
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="gv",
+                             vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "b0")}])
+                await c.call("volume-start", name="gv")
+                await c.call("volume-set", name="gv",
+                             key="gateway.pool-size", value="2")
+                st = await c.call("volume-gateway", name="gv",
+                                  action="start")
+                assert st["ok"]
+                port = 0
+                for _ in range(600):  # daemon pays imports + mounts
+                    st = await c.call("volume-gateway", name="gv",
+                                      action="status")
+                    if st["gateway"]["online"] and \
+                            st["gateway"]["port"]:
+                        port = st["gateway"]["port"]
+                        break
+                    await asyncio.sleep(0.1)
+                assert port, f"gateway never came up: {st}"
+                assert st["gateway"]["status"] == "started"
+                assert st["gateway"]["pid"] > 0
+                # real HTTP against the managed volume (retry while the
+                # listener's pool finishes connecting)
+                body = b"managed" * 512
+                s = 0
+                for _ in range(100):
+                    try:
+                        s, _, _ = await http("127.0.0.1", port, "PUT",
+                                             "/bkt")
+                        if s == 200:
+                            break
+                    except (ConnectionError, OSError):
+                        pass
+                    await asyncio.sleep(0.1)
+                assert s == 200, "spawned gateway unreachable"
+                s, hd, _ = await http("127.0.0.1", port, "PUT",
+                                      "/bkt/k", body=body)
+                assert s == 200
+                s, _, data = await http("127.0.0.1", port, "GET",
+                                        "/bkt/k")
+                assert s == 200 and data == body
+                st = await c.call("volume-gateway", name="gv",
+                                  action="stop")
+                for _ in range(100):
+                    st = await c.call("volume-gateway", name="gv",
+                                      action="status")
+                    if not st["gateway"]["online"]:
+                        break
+                    await asyncio.sleep(0.1)
+                assert not st["gateway"]["online"]
+                assert st["gateway"]["status"] == "stopped"
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+
+
+# -- coherence against a fuse-stack client -----------------------------
+
+
+def test_gateway_writes_invalidate_fuse_stack_client(tmp_path):
+    """The two-front-door scenario: a fuse-side client stack (md-cache
+    + io-cache over the wire) holds cached stat + pages; the gateway
+    overwrites the object over HTTP; the brick's upcall push must
+    revalidate BOTH caches — the next read sees the new bytes without
+    any TTL expiring (timeouts here are an hour)."""
+    async def run():
+        server = await serve_brick(BRICK.format(dir=tmp_path / "b"))
+        gw = await start_gateway(client_volfile(server.port), pool=1)
+        H, P = gw.host, gw.port
+        fuse_side = Graph.construct(client_volfile(server.port, layers="""
+volume ioc
+    type performance/io-cache
+    option page-size 4KB
+    option cache-timeout 3600
+    subvolumes c0
+end-volume
+volume mdc
+    type performance/md-cache
+    option timeout 3600
+    subvolumes ioc
+end-volume
+"""))
+        fc = Client(fuse_side)
+        await fc.mount()
+        await wait_connected(fuse_side)
+        v1 = b"a" * 8192
+        v2 = b"b" * 16384
+        try:
+            await http(H, P, "PUT", "/coh")
+            st, _, _ = await http(H, P, "PUT", "/coh/obj", body=v1)
+            assert st == 200
+            # fuse-side reads + stats: md-cache and io-cache now hold it
+            assert await fc.read_file("/coh/obj") == v1
+            assert (await fc.stat("/coh/obj")).size == len(v1)
+            mdc = fuse_side.by_name["mdc"]
+            inv0 = mdc.invalidations
+            # gateway overwrites through its own graph
+            st, _, _ = await http(H, P, "PUT", "/coh/obj", body=v2)
+            assert st == 200
+            for _ in range(100):  # the push, not a TTL
+                if mdc.invalidations > inv0:
+                    break
+                await asyncio.sleep(0.05)
+            assert mdc.invalidations > inv0, "no upcall reached md-cache"
+            assert (await fc.stat("/coh/obj")).size == len(v2)
+            assert await fc.read_file("/coh/obj") == v2, \
+                "io-cache served stale pages after gateway overwrite"
+            # and the reverse door: fuse-side write, gateway sees it
+            await fc.write_file("/coh/obj2", b"from-fuse")
+            st, _, data = await http(H, P, "GET", "/coh/obj2")
+            assert st == 200 and data == b"from-fuse"
+        finally:
+            await fc.unmount()
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())

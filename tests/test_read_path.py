@@ -562,6 +562,63 @@ def test_codec_reassemble_matches_decode():
         bufs, [0, 1, 2, 3], frags.shape[1]) is None
 
 
+# -- ranged read_file (object-gateway satellite, ISSUE 6) --------------
+
+
+def test_ranged_read_file_one_roundtrip(tmp_path):
+    """A ranged ``read_file(path, offset=, size=)`` window inside the
+    file is ONE fused chain — lookup+open+readv(window)+release in a
+    single wire round trip — and the payload comes back RAW (a frame
+    view / SGBuf, not joined bytes): the gateway's ranged GET hands the
+    segments straight to the socket."""
+    async def run():
+        server = await serve_brick(
+            BRICK_VOLFILE.format(dir=tmp_path / "b"))
+        payload = bytes(range(256)) * 256  # 64 KiB
+        g = Graph.construct(CLIENT_VOLFILE.format(
+            port=server.port, sub="locks",
+            opts="    option compound-fops on\n"))
+        c = Client(g)
+        await c.mount()
+        cl = g.top
+        assert await _wait_connected(cl)
+        await c.write_file("/f", payload)
+        base = cl.rpc_roundtrips
+        data = await c.read_file("/f", offset=1000, size=5000)
+        assert cl.rpc_roundtrips - base == 1, \
+            "in-window ranged read_file must be one chain frame"
+        assert not isinstance(data, bytes), \
+            "ranged window must stay raw (join is the caller's call)"
+        assert bytes(data) == payload[1000:6000]
+        # EOF truncation, still one round trip
+        base = cl.rpc_roundtrips
+        data = await c.read_file("/f", offset=len(payload) - 100,
+                                 size=4096)
+        assert cl.rpc_roundtrips - base == 1
+        assert bytes(data) == payload[-100:]
+        # degenerate windows
+        assert await c.read_file("/f", offset=0, size=0) == b""
+        # open-ended tail (no size): windowed loop to EOF, still raw
+        tail = await c.read_file("/f", offset=len(payload) - 300)
+        assert bytes(tail) == payload[-300:]
+        # whole-file default keeps returning owned bytes
+        whole = await c.read_file("/f")
+        assert isinstance(whole, bytes) and whole == payload
+        # without compound the ranged contract holds (open+readv path)
+        g2 = Graph.construct(CLIENT_VOLFILE.format(
+            port=server.port, sub="locks", opts=""))
+        c2 = Client(g2)
+        await c2.mount()
+        assert await _wait_connected(g2.top)
+        d2 = await c2.read_file("/f", offset=4096, size=4096)
+        assert bytes(d2) == payload[4096:8192]
+        await c2.unmount()
+        await c.unmount()
+        await server.stop()
+
+    asyncio.run(run())
+
+
 # -- volgen keys -------------------------------------------------------
 
 

@@ -106,6 +106,57 @@ end-volume
 """
 
 
+def test_two_graphs_itable_invalidation(tmp_path):
+    """The second-front-door scenario (ISSUE 6): client graphs A and B
+    on one volume; A deletes and recreates a path (new gfid), and B —
+    whose api-level itable still maps the old dentry — must revalidate
+    from the pushed invalidation, NOT a remount.  Without the Client
+    upcall sink, B keeps resolving the dead gfid and every fop on the
+    path fails ESTALE/ENOENT forever."""
+    import asyncio
+
+    from glusterfs_tpu.api.glfs import Client
+    from glusterfs_tpu.daemon import serve_brick
+
+    async def run():
+        server = await serve_brick(
+            UPCALL_BRICK.format(dir=tmp_path / "b"))
+        vf = CLIENT_VOLFILE.format(port=server.port)
+        ca, cb = Client(Graph.construct(vf)), Client(Graph.construct(vf))
+        await ca.mount()
+        await cb.mount()
+        try:
+            for c in (ca, cb):
+                prot = c.graph.by_name["client0"]
+                for _ in range(200):
+                    if prot.connected:
+                        break
+                    await asyncio.sleep(0.05)
+                assert prot.connected
+            await ca.write_file("/shared", b"one")
+            assert await cb.read_file("/shared") == b"one"
+            old_gfid = (await cb.stat("/shared")).gfid
+            inv0 = cb.upcall_sink.invalidations
+            # A replaces the object: the path now names a NEW gfid
+            await ca.unlink("/shared")
+            await ca.write_file("/shared", b"two!")
+            for _ in range(100):  # the push, not a TTL
+                if cb.upcall_sink.invalidations > inv0:
+                    break
+                await asyncio.sleep(0.05)
+            assert cb.upcall_sink.invalidations > inv0, \
+                "no invalidation reached B's api-level sink"
+            # B re-resolves: fresh gfid, fresh bytes — no remount
+            assert await cb.read_file("/shared") == b"two!"
+            assert (await cb.stat("/shared")).gfid != old_gfid
+        finally:
+            await ca.unmount()
+            await cb.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
 @pytest.mark.slow
 def test_two_clients_invalidate_over_wire(tmp_path):
     """Client A writes; client B's cached stat invalidates through the

@@ -14,6 +14,13 @@
 #                               the required families are present and
 #                               monotonic (ISSUE 4: a silently-empty
 #                               metrics dump must not merge)
+#   4. gateway smoke            serve a managed 1-brick volume through
+#                               the HTTP object gateway: PUT/GET/
+#                               ranged-GET/DELETE/list over real HTTP,
+#                               gateway registry families asserted, and
+#                               the glusterd-spawned daemon lifecycle
+#                               (`volume gateway start|status|stop`)
+#                               exercised end to end (ISSUE 6)
 #
 # Usage:  tools/ci.sh [extra pytest args for the tier-1 runs...]
 # Exit: first failing stage's code; 0 = mergeable.
@@ -166,10 +173,125 @@ if [ $smoke_rc -ne 0 ]; then
     exit $smoke_rc
 fi
 
+echo "== ci: gateway smoke (managed volume, real HTTP, registry"
+echo "       families, spawned-daemon lifecycle) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, json, os, tempfile
+
+from glusterfs_tpu.api.glfs import Client, wait_connected
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.metrics import REGISTRY
+from glusterfs_tpu.gateway import ClientPool, ObjectGateway
+from glusterfs_tpu.gateway.minihttp import fetch as http
+from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient
+
+async def main():
+    base = tempfile.mkdtemp(prefix="gw-smoke")
+    d = Glusterd(os.path.join(base, "gd"))
+    await d.start()
+    try:
+        async with MgmtClient(d.host, d.port) as c:
+            await c.call("volume-create", name="gwv",
+                         vtype="distribute",
+                         bricks=[{"path": os.path.join(base, "b0")}])
+            await c.call("volume-start", name="gwv")
+            spec = await c.call("getspec", name="gwv")
+
+        # in-process gateway over the managed volfile: the dialect +
+        # the registry families live in THIS process for asserting
+        async def factory():
+            g = Graph.construct(spec["volfile"])
+            cl = Client(g)
+            await cl.mount()
+            await wait_connected(g)
+            return cl
+
+        gw = ObjectGateway(ClientPool(factory, 2), volume="gwv")
+        await gw.start()
+        H, P = gw.host, gw.port
+        payload = bytes(range(256)) * 256  # 64 KiB
+        st, _, _ = await http(H, P, "PUT", "/bkt")
+        assert st == 200, st
+        st, hd, _ = await http(H, P, "PUT", "/bkt/dir/obj",
+                               body=payload)
+        assert st == 200 and hd.get("etag"), (st, hd)
+        st, _, data = await http(H, P, "GET", "/bkt/dir/obj")
+        assert st == 200 and data == payload
+        st, hd, data = await http(H, P, "GET", "/bkt/dir/obj",
+                                  headers={"range": "bytes=100-4099"})
+        assert st == 206 and data == payload[100:4100], st
+        assert hd["content-range"] == f"bytes 100-4099/{len(payload)}"
+        st, _, data = await http(H, P, "GET", "/bkt?list&delimiter=/")
+        out = json.loads(data)
+        assert st == 200 and out["common_prefixes"] == ["dir/"], out
+        st, _, _ = await http(H, P, "DELETE", "/bkt/dir/obj")
+        assert st == 204, st
+        st, _, _ = await http(H, P, "GET", "/bkt/dir/obj")
+        assert st == 404, st
+        snap = REGISTRY.snapshot()
+        for fam in ("gftpu_gateway_requests_total",
+                    "gftpu_gateway_request_seconds",
+                    "gftpu_gateway_inflight",
+                    "gftpu_gateway_bytes_total",
+                    "gftpu_gateway_body_writes_total",
+                    "gftpu_gateway_throttled_total",
+                    "gftpu_gateway_events_total"):
+            assert fam in snap, f"missing gateway family {fam}"
+        reqs = {(s[0]["method"], s[0]["status"]): s[1] for s in
+                snap["gftpu_gateway_requests_total"]["samples"]}
+        assert reqs[("GET", "200")] >= 1 and reqs[("PUT", "200")] >= 2
+        await gw.stop()
+
+        # spawned-daemon lifecycle: volume gateway start -> HTTP ->
+        # status -> stop (the CLI path, sans argparse)
+        st = await d.op_volume_gateway("gwv", "start")
+        port = 0
+        for _ in range(600):
+            st = await d.op_volume_gateway("gwv", "status")
+            if st["gateway"]["online"] and st["gateway"]["port"]:
+                port = st["gateway"]["port"]
+                break
+            await asyncio.sleep(0.1)
+        assert port, f"spawned gateway never came up: {st}"
+        s = 0
+        for _ in range(100):
+            try:
+                s, _, _ = await http("127.0.0.1", port, "PUT", "/lb")
+                if s == 200:
+                    break
+            except (ConnectionError, OSError):
+                pass
+            await asyncio.sleep(0.1)
+        assert s == 200, f"spawned gateway unreachable (last: {s})"
+        s, _, _ = await http("127.0.0.1", port, "PUT", "/lb/k",
+                             body=b"spawned")
+        assert s == 200
+        s, _, data = await http("127.0.0.1", port, "GET", "/lb/k")
+        assert s == 200 and data == b"spawned"
+        await d.op_volume_gateway("gwv", "stop")
+        for _ in range(100):
+            st = await d.op_volume_gateway("gwv", "status")
+            if not st["gateway"]["online"]:
+                break
+            await asyncio.sleep(0.1)
+        assert not st["gateway"]["online"], st
+    finally:
+        await d.stop()
+    print("gateway smoke: dialect + ranged GET + listing over real "
+          "HTTP, families present, spawned lifecycle green")
+
+asyncio.run(main())
+EOF
+gw_rc=$?
+if [ $gw_rc -ne 0 ]; then
+    echo "ci: gateway smoke failed — not mergeable"
+    exit $gw_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
 fi
 echo "ci: mergeable (two identical green tier-1 runs + bench contract"
-echo "    + metrics smoke)"
+echo "    + metrics smoke + gateway smoke)"
 exit 0
