@@ -382,10 +382,22 @@ def smallfile_wire_bench(n_files: int = 150) -> dict:
     return out
 
 
+def host_cores() -> int:
+    """Schedulable cores for THIS process (bench honesty, ISSUE 7): an
+    affinity-pinned sandbox can report 64 cpu_count cores while only 1
+    is usable — the event-threads sweep must say which world it ran
+    in."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
 def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
                     compound: str = "on", fuse: bool = True,
                     prefix: str = "", zero_copy: str = "on",
-                    metrics: str = "on") -> dict:
+                    metrics: str = "on",
+                    event_threads: str | None = None) -> dict:
     """Through-the-wire AND through-the-mount numbers (the reference's
     baseline workloads — dd/iozone/glfs-bm, extras/benchmarking/README —
     all run through the full stack, never in-process):
@@ -455,6 +467,16 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
                 await c.call("volume-set", name="bw",
                              key="network.zero-copy-reads",
                              value=zero_copy)
+                if event_threads is not None:
+                    # the concurrent event plane (ISSUE 7): size the
+                    # frame-turning pools on BOTH transport ends;
+                    # "0" = inline turning (the pre-9 serial plane)
+                    await c.call("volume-set", name="bw",
+                                 key="server.event-threads",
+                                 value=event_threads)
+                    await c.call("volume-set", name="bw",
+                                 key="client.event-threads",
+                                 value=event_threads)
             cl = await mount_volume(d.host, d.port, "bw")
             try:
                 # calibrate the stripe-cache router OFF the clock: its
@@ -674,7 +696,8 @@ GATEWAY_LADDER = (1, 64, 512)
 
 
 def gateway_bench(obj_kib: int = 64, ladder=GATEWAY_LADDER,
-                  budget_s: float = 150.0) -> dict:
+                  budget_s: float = 150.0, prefix: str = "",
+                  event_threads: int | None = None) -> dict:
     """Concurrency-ladder rows for the HTTP object gateway (ISSUE 6):
     N concurrent HTTP/1.1 clients — one keep-alive TCP connection each
     — PUT then GET distinct ``obj_kib``-KiB objects through one
@@ -689,7 +712,7 @@ def gateway_bench(obj_kib: int = 64, ladder=GATEWAY_LADDER,
     import tempfile
 
     out: dict = {}
-    rows = [f"gateway_{op}_c{n}_MiB_s"
+    rows = [f"{prefix}gateway_{op}_c{n}_MiB_s"
             for n in ladder for op in ("put", "get")]
     t_start = time.perf_counter()
 
@@ -700,7 +723,7 @@ def gateway_bench(obj_kib: int = 64, ladder=GATEWAY_LADDER,
         from glusterfs_tpu.gateway import ClientPool, ObjectGateway
 
         base = tempfile.mkdtemp(prefix="gwbench")
-        server = await serve_brick(f"""
+        brick_text = f"""
 volume posix
     type storage/posix
     option directory {os.path.join(base, 'b')}
@@ -709,7 +732,20 @@ volume locks
     type features/locks
     subvolumes posix
 end-volume
-""")
+"""
+        evt_opt = ""
+        if event_threads is not None:
+            # event-threads sweep (ISSUE 7): an explicit server layer
+            # carries the pool width; clients size the reply pool
+            brick_text += f"""
+volume srv
+    type protocol/server
+    option event-threads {event_threads}
+    subvolumes locks
+end-volume
+"""
+            evt_opt = f"    option event-threads {event_threads}\n"
+        server = await serve_brick(brick_text)
         text = f"""
 volume c0
     type protocol/client
@@ -717,7 +753,7 @@ volume c0
     option remote-port {server.port}
     option remote-subvolume locks
     option compound-fops on
-end-volume
+{evt_opt}end-volume
 volume wb
     type performance/write-behind
     option compound-fops on
@@ -755,7 +791,7 @@ end-volume
             for n in ladder:
                 if time.perf_counter() - t_start > budget_s:
                     for op in ("put", "get"):
-                        out[f"gateway_{op}_c{n}_MiB_s"] = \
+                        out[f"{prefix}gateway_{op}_c{n}_MiB_s"] = \
                             "skipped: gateway ladder time budget " \
                             "exhausted"
                     continue
@@ -782,17 +818,17 @@ end-volume
                                            for i in range(n)))
                     # record each direction AS IT LANDS: a GET-pass
                     # failure must not discard the measured PUT row
-                    out[f"gateway_put_c{n}_MiB_s"] = round(
+                    out[f"{prefix}gateway_put_c{n}_MiB_s"] = round(
                         total_mib / (time.perf_counter() - t0), 1)
                     t0 = time.perf_counter()
                     await asyncio.gather(*(client(i, "get")
                                            for i in range(n)))
-                    out[f"gateway_get_c{n}_MiB_s"] = round(
+                    out[f"{prefix}gateway_get_c{n}_MiB_s"] = round(
                         total_mib / (time.perf_counter() - t0), 1)
-                    out["gateway_obj_KiB"] = obj_kib
+                    out[f"{prefix}gateway_obj_KiB"] = obj_kib
                 except Exception as e:  # rung fails, ladder continues
                     for op in ("put", "get"):
-                        out.setdefault(f"gateway_{op}_c{n}_MiB_s",
+                        out.setdefault(f"{prefix}gateway_{op}_c{n}_MiB_s",
                                        f"skipped: {e!r}"[:200])
                 finally:
                     for _, cw in conns:
@@ -812,6 +848,64 @@ end-volume
             out.setdefault(row, reason)
     for row in rows:
         out.setdefault(row, "skipped: not measured")
+    return out
+
+
+#: sweep pool width: 4 frame turners vs 0 (inline, the pre-9 serial
+#: plane) — the on/off pair for the concurrent event plane (ISSUE 7)
+EVENT_SWEEP_THREADS = 4
+
+
+def event_threads_sweep() -> dict:
+    """The event-threads on/off pair (ISSUE 7): the same wire workload
+    with frame turning inline (event-threads 0, the old serial plane)
+    vs pooled (4 workers), plus the gateway c512 rung both ways — the
+    rung PR 6 showed flat from c1 to c512 at the single-turner floor.
+
+    Bench honesty: on a host whose affinity mask is a single core the
+    pair CANNOT diverge (there is no second core to turn frames on), so
+    the rows become an explicit ``skipped: single-core host`` analysis
+    entry instead of a misleading flat number (ROADMAP item 1's
+    measured-analysis escape hatch).  ``host_cores`` goes on the record
+    either way."""
+    cores = host_cores()
+    out: dict = {"host_cores": cores,
+                 "host_cpu_count": os.cpu_count() or 1}
+    wire_rows = [f"{p}wire_{d}_MiB_s" for p in ("evt_off_", "evt4_")
+                 for d in ("write", "read")]
+    gw_rows = [f"{p}gateway_{op}_c512_MiB_s"
+               for p in ("evt_off_", "evt4_") for op in ("put", "get")]
+    if cores < 2:
+        reason = (f"skipped: single-core host "
+                  f"(sched_getaffinity={cores}; frame-turning workers "
+                  f"have no core to run on — measured-analysis row, "
+                  f"ROADMAP item 1)")
+        for row in wire_rows + gw_rows:
+            out[row] = reason
+        out["event_threads_sweep_analysis"] = reason
+        return out
+    for tag, evt in (("evt_off_", "0"),
+                     ("evt4_", str(EVENT_SWEEP_THREADS))):
+        try:
+            out.update(fullstack_bench(fuse=False, prefix=tag,
+                                       event_threads=evt))
+        except Exception as e:  # noqa: BLE001 - rows say why
+            for row in (f"{tag}wire_write_MiB_s",
+                        f"{tag}wire_read_MiB_s"):
+                out.setdefault(row, f"skipped: {e!r}"[:200])
+        try:
+            out.update(gateway_bench(ladder=(512,), budget_s=120.0,
+                                     prefix=tag,
+                                     event_threads=int(evt)))
+        except Exception as e:  # noqa: BLE001
+            for op in ("put", "get"):
+                out.setdefault(f"{tag}gateway_{op}_c512_MiB_s",
+                               f"skipped: {e!r}"[:200])
+    out["event_threads_sweep_analysis"] = (
+        f"{cores} schedulable cores shared by brick daemons, client, "
+        f"and the bench driver; evt4 rows use "
+        f"server/client.event-threads={EVENT_SWEEP_THREADS}, evt_off "
+        f"rows pin event-threads=0 (inline frame turning)")
     return out
 
 
@@ -909,6 +1003,7 @@ def _wedged_main() -> None:
         "decode_vs_baseline": round(dec_mibs / dec_base, 2),
         "backend": backend,
         "device": "none (tpu probe timed out; transport wedged)",
+        "host_cores": host_cores(),
         "baseline_encode_MiB_s": round(enc_base, 1),
         "baseline_decode_MiB_s": round(dec_base, 1),
         **{k: round(v, 1) for k, v in base.items()},
@@ -921,7 +1016,10 @@ def _wedged_main() -> None:
                        "fuse_write_MiB_s", "fuse_read_MiB_s",
                        *(f"gateway_{op}_c{n}_MiB_s"
                          for n in GATEWAY_LADDER
-                         for op in ("put", "get")))},
+                         for op in ("put", "get")),
+                       *(f"{p}wire_{d}_MiB_s"
+                         for p in ("evt_off_", "evt4_")
+                         for d in ("write", "read")))},
     }
     result["regressions"] = _regression_gate(result)
     print(emit(result))
@@ -1243,6 +1341,13 @@ def main() -> None:
                                    metrics="off"))
     except Exception as e:
         vol["metrics_off_wire_bench_error"] = str(e)[:200]
+    try:
+        # event-threads on/off sweep (ISSUE 7): the concurrent event
+        # plane pair, or the explicit single-core analysis row
+        vol.update(event_threads_sweep())
+    except Exception as e:
+        vol["event_threads_sweep_error"] = str(e)[:200]
+        vol.setdefault("host_cores", host_cores())
     # a missing wire/fuse/smallfile-wire row is an EXPLICIT
     # "skipped: <reason>" entry, never silence (r5's detail lost all
     # four rows without a trace)
@@ -1284,6 +1389,7 @@ def main() -> None:
         "decode_vs_baseline": round(dec_mibs / dec_base, 2),
         "backend": backend,
         "device": str(jax.devices()[0]),
+        "host_cores": host_cores(),
         "baseline_encode_MiB_s": round(enc_base, 1),
         "baseline_decode_MiB_s": round(dec_base, 1),
         **{k: round(v, 1) for k, v in base.items()},

@@ -48,6 +48,14 @@ _LIVE_EC_LAYERS = _metrics.REGISTRY.register_objects(
     "reassembly, staged = decode through the frags array)",
     lambda l: [({"layer": l.name, "mode": m}, v)
                for m, v in l.read_fanout.items()])
+_metrics.REGISTRY.register_objects(
+    "gftpu_ec_readv_coalesced_total", "counter",
+    "adjacent readv chain links merged into single ranged fragment "
+    "fan-outs (chains = merged dispatches, links = member readvs "
+    "absorbed)",
+    lambda l: [({"layer": l.name, "what": m}, v)
+               for m, v in l.read_coalesced.items()],
+    live=_LIVE_EC_LAYERS)
 from ..core.options import Option
 from ..core import gflog
 from ..ops import codec as codec_mod
@@ -253,6 +261,10 @@ class DisperseLayer(Layer):
         # reassembly straight from fragment buffers (no staging copy),
         # "staged" = the decode path through the frags array
         self.read_fanout = {"fast": 0, "staged": 0}
+        # fragment-readv coalescing (ROADMAP item 7): adjacent readv
+        # links of one compound chain merged into ONE ranged brick
+        # read per fan-out
+        self.read_coalesced = {"chains": 0, "links": 0}
         # last announced "≥K children up" state (events.h
         # EVENT_EC_MIN_BRICKS_UP / _NOT_UP fire on the transition)
         self._min_up_ok = True
@@ -1240,6 +1252,100 @@ class DisperseLayer(Layer):
             return await self._readv_window(fd, size, offset, candidates,
                                             true_size)
 
+    # one coalesced fan-out must stay a sane allocation: chains whose
+    # union range exceeds this decompose normally (read-ahead windows
+    # are <= a few MiB; this is an abuse bound, not a tuning knob)
+    COALESCE_MAX = 16 << 20
+
+    def _coalescable_readvs(self, links):
+        """(fd, [(size, offset), ...], lo, hi) when every link of the
+        chain is a readv on ONE fd and their stripe-aligned ranges
+        tile a single contiguous region — else None.
+
+        This is ROADMAP item 7: the demand+window chains read-ahead
+        emits (readv+readv, one wire frame) decompose at this layer
+        into SEPARATE fragment fan-outs, so adjacent stripe reads hit
+        the same brick as two readvs.  Merged, each brick serves ONE
+        ranged fragment read per fan-out (the disperse read analog of
+        write-behind aggregation)."""
+        if len(links) < 2:
+            return None
+        fd = None
+        spans = []
+        for fop, args, kwargs in links:
+            if fop != "readv" or len(args) < 3:
+                return None
+            lfd, size, offset = args[0], args[1], args[2]
+            if not isinstance(lfd, FdObj) or \
+                    not isinstance(size, int) or \
+                    not isinstance(offset, int) or size < 0 or offset < 0:
+                return None
+            if fd is None:
+                fd = lfd
+            elif lfd is not fd and (lfd.gfid != fd.gfid or not fd.gfid):
+                return None
+            spans.append((size, offset))
+        spans_sorted = sorted(spans, key=lambda s: s[1])
+        lo = spans_sorted[0][1] // self.stripe * self.stripe
+        hi = 0
+        cur_end = lo
+        for size, offset in spans_sorted:
+            a_off = offset // self.stripe * self.stripe
+            a_end = (offset + size + self.stripe - 1) \
+                // self.stripe * self.stripe
+            if a_off > cur_end:
+                return None  # a hole: two fan-outs are cheaper
+            cur_end = max(cur_end, a_end)
+            hi = max(hi, offset + size)
+        if hi - lo > self.COALESCE_MAX:
+            return None
+        return fd, spans, lo, hi
+
+    async def compound(self, links, xdata: dict | None = None) -> list:
+        """Chains of adjacent readvs (read-ahead's demand+window frame)
+        merge into ONE ranged fragment fan-out: one lock/meta wave, one
+        readv per brick covering the union, per-link answers sliced as
+        views of the single decode.  Anything else decomposes through
+        the normal per-fop path."""
+        from ..rpc import compound as cfop
+
+        try:
+            links_v = cfop.validate(links)
+        except FopError:
+            return await super().compound(links, xdata)
+        merged = self._coalescable_readvs(links_v)
+        if merged is None:
+            return await super().compound(links, xdata)
+        fd, spans, lo, hi = merged
+        # per-link piggybacks (the demand link's xdata carries the
+        # trace span) must not vanish when the chain merges: the first
+        # link's xdata rides the union fan-out — one dispatch, one
+        # span, same propagation the decomposed path would give the
+        # demand readv
+        xd = next((kw.get("xdata") for _f, _a, kw in links_v
+                   if kw.get("xdata")), None)
+        try:
+            data = await self.readv(fd, hi - lo, lo, xdata=xd)
+        except FopError as e:
+            # decompose semantics: first link errs, the rest skip
+            return [["err", e]] + [["skip", None]] * (len(spans) - 1)
+        self.read_coalesced["chains"] += 1
+        self.read_coalesced["links"] += len(spans)
+        if isinstance(data, wire.SGBuf):  # single join, then slice
+            view = memoryview(data.tobytes())
+        elif isinstance(data, memoryview):
+            view = data
+        else:
+            view = memoryview(data)
+        out = []
+        for size, offset in spans:
+            start = offset - lo
+            if start >= len(view):
+                out.append(["ok", b""])
+            else:
+                out.append(["ok", view[start: start + size]])
+        return out
+
     async def _window_op(self, fd: FdObj, loc: Loc, st: _EagerState,
                          op: str, argfn) -> dict:
         """One write-class wave through the open eager window: pre-op
@@ -1764,6 +1870,7 @@ class DisperseLayer(Layer):
             "backend": self.codec.backend,
             "up": self.up, "up_count": sum(self.up),
             "read_fanout": dict(self.read_fanout),
+            "read_coalesced": dict(self.read_coalesced),
             "eager_windows": len(self._eager),
             "stripe_cache": self.codec.dump_stats(),
         }

@@ -126,6 +126,13 @@ REGISTRY.register_objects(
     "gftpu_gateway_events_total", "counter",
     "gateway lifecycle events emitted by kind",
     lambda gw: labeled(gw.events), live=_GATEWAYS)
+REGISTRY.register_objects(
+    "gftpu_gateway_pool", "gauge",
+    "mounted glfs clients per gateway, and the reply-turning event "
+    "workers (client.event-threads) those graphs share",
+    lambda gw: [({"what": "clients"}, len(gw.pool.clients)),
+                ({"what": "event_threads"}, gw.pool.event_threads())],
+    live=_GATEWAYS)
 
 
 class _HttpError(Exception):
@@ -224,6 +231,19 @@ class ClientPool:
         self._next += 1
         return c
 
+    def event_threads(self) -> int:
+        """Largest client.event-threads configured across the pooled
+        graphs (they all share the process-wide reply-turning pool)."""
+        from ..core.layer import walk
+        from ..protocol.client import ClientLayer
+
+        n = 0
+        for c in self.clients:
+            for layer in walk(c.graph.top):
+                if isinstance(layer, ClientLayer):
+                    n = max(n, int(layer.opts.get("event-threads", 0)))
+        return n
+
     async def close(self) -> None:
         for c in self.clients:
             try:
@@ -269,6 +289,12 @@ class ObjectGateway:
     async def start(self) -> None:
         if not self.pool.clients:
             await self.pool.start()
+        # pool-aware event plane: pre-size the shared reply-turning
+        # workers to the pooled graphs' client.event-threads so the
+        # first heavy GET doesn't pay the pool spin-up
+        from ..rpc import event_pool as _evt
+
+        _evt.client_pool(self.pool.event_threads())
         self._server = await asyncio.start_server(
             self._serve_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]

@@ -58,6 +58,11 @@ OPTION_MAP = {
                                        "slow-fop-threshold"),
     "diagnostics.span-ring-size": ("debug/io-stats", "span-ring-size"),
     "client.strict-locks": ("protocol/client", "strict-locks"),
+    # concurrent event plane (ISSUE 7; the multithreaded-epoll knobs,
+    # event-epoll.c): frame-turning worker pools on both transport
+    # ends, live-reconfigurable
+    "server.event-threads": ("protocol/server", "event-threads"),
+    "client.event-threads": ("protocol/client", "event-threads"),
     "performance.read-ahead-adaptive": ("performance/read-ahead",
                                         "adaptive-window"),
     "server.outstanding-rpc-limit": ("protocol/server",
@@ -648,6 +653,16 @@ _V8_KEYS = (
 )
 OPTION_MIN_OPVERSION.update({k: 8 for k in _V8_KEYS})
 
+# round-10 additions ship at op-version 9: the concurrent event plane
+# (server/client frame-turning pools + the reader/writer-split fuse
+# bridge) — a v8 member would store the keys but its transports have
+# no pool to size
+_V9_KEYS = (
+    "server.event-threads",
+    "client.event-threads",
+)
+OPTION_MIN_OPVERSION.update({k: 9 for k in _V9_KEYS})
+
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key
 DEFAULT_PERF_STACK = [
@@ -1075,8 +1090,9 @@ def build_client_volfile(volinfo: dict,
 #   cloudsync        — cloudsync/S3 tiering is a declared descope
 #   halo             — latency-based replica selection needs per-brick
 #                      RTT probes the asyncio transport doesn't collect yet
-#   event-threads    — epoll thread-pool sizing; this runtime is a single
-#                      asyncio loop per process (architecture, not a knob)
+#   own-thread       — a dedicated thread per transport; the event pool
+#                      (server/client.event-threads) already turns each
+#                      connection's frames with per-connection keying
 _NFS_WHY = "gNFS server is a declared descope (README)"
 _CS_WHY = "cloudsync tiering is a declared descope (README)"
 _HALO_WHY = "needs per-brick latency probes the transport does not " \
@@ -1113,11 +1129,12 @@ DESCOPED_KEYS = {
         "cluster.halo-enabled", "cluster.halo-shd-max-latency",
         "cluster.halo-nfsd-max-latency", "cluster.halo-max-latency",
         "cluster.halo-max-replicas", "cluster.halo-min-replicas")},
-    "client.event-threads": "single asyncio loop per process — epoll "
-                            "thread sizing has no analog",
-    "server.event-threads": "single asyncio loop per process",
-    "server.own-thread": "single asyncio loop per process",
-    "client.own-thread": "single asyncio loop per process",
+    "server.own-thread": "the event pool's per-connection keying is "
+                         "the own-thread dispatch property; a "
+                         "dedicated thread per transport adds "
+                         "nothing (server.event-threads is mapped)",
+    "client.own-thread": "see server.own-thread "
+                         "(client.event-threads is mapped)",
     "config.memory-accounting": "Python heap — no mem-pool accounting "
                                 "to toggle (mem-pool is a declared "
                                 "descope)",

@@ -27,8 +27,10 @@ import asyncio
 import ctypes
 import errno
 import os
+import queue
 import stat as stat_mod
 import sys
+import threading
 import time
 
 from ..api.glfs import Client
@@ -80,10 +82,31 @@ class FuseBridge:
 
     def __init__(self, client: Client, mountpoint: str,
                  volname: str = "gftpu", keep_cache: bool = False,
-                 writeback_cache: bool = True):
+                 writeback_cache: bool = True,
+                 reader_split: bool = True, max_inflight: int = 64):
         self.client = client
         self.mountpoint = os.path.abspath(mountpoint)
         self.volname = volname
+        # reader/writer-split event plane (ISSUE 7; the reference's
+        # fuse_thread_proc reader thread + --reader-thread-count): a
+        # dedicated thread blocks in read(2) on /dev/fuse and hands
+        # requests to the loop through a bounded inflight window, and
+        # a separate writer thread ships replies with writev(2) — so a
+        # slow fop never stalls kernel request intake, and a blocking
+        # device write never stalls the event loop.  Off = the legacy
+        # single-loop add_reader plane (--no-reader-split).
+        self.reader_split = reader_split
+        self.max_inflight = max(1, int(max_inflight))
+        self._intake: threading.BoundedSemaphore | None = None
+        self._wq: "queue.SimpleQueue | None" = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._io_threads: list[threading.Thread] = []
+        # split plane: each thread owns (and closes) its own fd —
+        # teardown must NOT close a device fd another thread may be
+        # blocked in read(2)/writev(2) on, or the number could be
+        # recycled under the in-flight syscall
+        self._rfd = -1
+        self._wfd = -1
         # --fopen-keep-cache (fuse-bridge.c:1617-1635): let the kernel
         # keep a file's page cache across open()s.  Off by default like
         # the reference: safe for single-writer mounts, stale for
@@ -113,6 +136,12 @@ class FuseBridge:
     # -- mount / unmount ---------------------------------------------------
 
     def mount(self) -> None:
+        # O_NONBLOCK on BOTH planes: the legacy plane needs it for
+        # add_reader, and the split reader needs select()+nonblocking
+        # reads — a reader parked in a blocking read(2) on /dev/fuse
+        # is NOT woken by an external unmount on every kernel (4.4
+        # leaves it parked forever), while select sees the dead
+        # connection as readable and the read then fails ENODEV
         self.dev_fd = os.open("/dev/fuse", os.O_RDWR | os.O_NONBLOCK)
         # default_permissions: the kernel enforces mode/uid/gid from the
         # attrs we return — without it, allow_other would let any local
@@ -130,8 +159,24 @@ class FuseBridge:
             self.dev_fd = -1
             raise OSError(err, f"mount(2) {self.mountpoint}: "
                                f"{os.strerror(err)}")
-        asyncio.get_running_loop().add_reader(self.dev_fd, self._readable)
-        log.info(1, "mounted %s on %s", self.volname, self.mountpoint)
+        self._loop = asyncio.get_running_loop()
+        if self.reader_split:
+            self._rfd = self.dev_fd
+            self._wfd = os.dup(self.dev_fd)
+            self._intake = threading.BoundedSemaphore(self.max_inflight)
+            self._wq = queue.SimpleQueue()
+            writer = threading.Thread(target=self._writer_main,
+                                      name="fuse-writer", daemon=True)
+            reader = threading.Thread(target=self._reader_main,
+                                      name="fuse-reader", daemon=True)
+            self._io_threads = [reader, writer]
+            writer.start()
+            reader.start()
+        else:
+            self._loop.add_reader(self.dev_fd, self._readable)
+        log.info(1, "mounted %s on %s (%s plane)", self.volname,
+                 self.mountpoint,
+                 "split" if self.reader_split else "loop")
 
     async def unmount(self) -> None:
         if self.dev_fd < 0:
@@ -156,15 +201,24 @@ class FuseBridge:
     def _teardown(self) -> None:
         if self.dev_fd < 0:
             return
-        try:
-            asyncio.get_running_loop().remove_reader(self.dev_fd)
-        except Exception:
-            pass
-        try:
-            os.close(self.dev_fd)
-        except OSError:
-            pass
-        self.dev_fd = -1
+        if self.reader_split:
+            # the reader/writer threads own their fds: umount2 (already
+            # issued, or issued by the kernel) aborts the connection, the
+            # reader's blocked read returns ENODEV and it closes _rfd
+            # itself; the sentinel below has the writer close _wfd
+            self.dev_fd = -1
+        else:
+            try:
+                asyncio.get_running_loop().remove_reader(self.dev_fd)
+            except Exception:
+                pass
+            try:
+                os.close(self.dev_fd)
+            except OSError:
+                pass
+            self.dev_fd = -1
+        if self._wq is not None:
+            self._wq.put(None)  # writer thread: drain and exit
         self._closed.set()
 
     async def wait_closed(self) -> None:
@@ -190,11 +244,121 @@ class FuseBridge:
             self._tasks.add(t)
             t.add_done_callback(self._tasks.discard)
 
+    # -- split plane: reader + writer threads ------------------------------
+
+    def _reader_main(self) -> None:
+        """Dedicated /dev/fuse intake (fuse_thread_proc): the device
+        read runs off the event loop, bounded by the inflight window so
+        a burst of kernel requests queues in the KERNEL (which has its
+        own congestion control) instead of ballooning bridge memory.
+        select()+nonblocking read instead of a blocking read: an
+        external unmount makes the dead connection readable (POLLERR),
+        and the read then surfaces the ENODEV a parked blocking read
+        would never see on older kernels."""
+        import select as select_mod
+
+        loop = self._loop
+        try:
+            while True:
+                # bounded handoff: don't read request N+max_inflight
+                # until an earlier one answered.  Timeout polls for
+                # teardown — a parked reader must notice the unmount
+                if not self._intake.acquire(timeout=0.5):
+                    if self.dev_fd < 0:
+                        return
+                    continue
+                buf = None
+                while buf is None:
+                    if self.dev_fd < 0:
+                        try:
+                            self._intake.release()
+                        except ValueError:
+                            pass
+                        return
+                    try:
+                        ready, _, _ = select_mod.select(
+                            [self._rfd], [], [self._rfd], 0.5)
+                    except (OSError, ValueError):
+                        ready = [self._rfd]  # fd dying: let read say so
+                    if not ready:
+                        continue
+                    try:
+                        buf = os.read(self._rfd, _READ_BUF)
+                    except BlockingIOError:
+                        continue
+                    except OSError as e:
+                        if e.errno in (errno.EINTR, errno.ENOENT):
+                            continue  # aborted request: retry, slot held
+                        # ENODEV: unmounted under us; EBADF: teardown
+                        try:
+                            self._intake.release()
+                        except ValueError:
+                            pass
+                        try:
+                            loop.call_soon_threadsafe(self._teardown)
+                        except RuntimeError:
+                            pass
+                        return
+                try:
+                    loop.call_soon_threadsafe(self._spawn_split, buf)
+                except RuntimeError:  # loop gone: process exiting
+                    return
+        finally:
+            try:
+                os.close(self._rfd)  # the reader owns the read fd
+            except OSError:
+                pass
+
+    def _spawn_split(self, buf: bytes) -> None:
+        t = self._loop.create_task(self._handle_split(buf))
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    async def _handle_split(self, buf: bytes) -> None:
+        try:
+            await self._handle(buf)
+        finally:
+            try:
+                self._intake.release()
+            except ValueError:
+                pass
+
+    def _writer_main(self) -> None:
+        """Dedicated reply writer: one writev(2) per reply (atomic at
+        the device), so a momentarily-blocking device write never
+        stalls the event loop or any other fop's reply."""
+        try:
+            while True:
+                item = self._wq.get()
+                if item is None:
+                    return
+                hdr, data = item
+                if self.dev_fd < 0:
+                    continue  # drain remaining items to the sentinel
+                try:
+                    if isinstance(data, SGBuf):
+                        os.writev(self._wfd, (hdr, *data.segments))
+                    else:
+                        os.writev(self._wfd, (hdr, data))
+                except OSError:
+                    pass  # request raced an unmount/interrupt
+        finally:
+            try:
+                os.close(self._wfd)  # the writer owns its dup
+            except OSError:
+                pass
+
     def _reply(self, unique: int, data: bytes = b"", error: int = 0) -> None:
         if self.dev_fd < 0:
             return
         hdr = fp.OUT_HEADER.pack(fp.OUT_HEADER.size + len(data),
                                  -error, unique)
+        if self._wq is not None:
+            # split plane: replies ship from the writer thread; the
+            # payload is a view into a reply frame the finished fop
+            # task no longer mutates, so the handoff is copy-free
+            self._wq.put((hdr, data))
+            return
         try:
             # vectored: read payloads arrive as memoryviews into the
             # RPC frame (wire blob lane) or as scatter-gather segment
@@ -727,7 +891,9 @@ async def _amain(args) -> int:
                                 args.volume)
     bridge = FuseBridge(client, args.mountpoint, args.volume,
                         keep_cache=args.fopen_keep_cache,
-                        writeback_cache=not args.no_writeback_cache)
+                        writeback_cache=not args.no_writeback_cache,
+                        reader_split=not args.no_reader_split,
+                        max_inflight=args.fuse_inflight)
     bridge.mount()
     if args.readyfile:
         with open(args.readyfile + ".tmp", "w") as f:
@@ -765,6 +931,14 @@ def main(argv=None) -> int:
                    help="write-through: disable FUSE_WRITEBACK_CACHE "
                         "(glusterfs --kernel-writeback-cache=off); "
                         "needed when several mounts write one file")
+    p.add_argument("--no-reader-split", action="store_true",
+                   help="serve /dev/fuse from the event loop instead "
+                        "of the dedicated reader + writer threads "
+                        "(the pre-event-plane single-loop mode)")
+    p.add_argument("--fuse-inflight", type=int, default=64,
+                   help="bounded inflight handoff: kernel requests "
+                        "admitted but not yet answered (reader-split "
+                        "plane only; excess queues in the kernel)")
     p.add_argument("mountpoint")
     args = p.parse_args(argv)
     return asyncio.run(_amain(args))

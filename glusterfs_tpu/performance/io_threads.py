@@ -13,11 +13,24 @@ engine.  Two mechanisms here:
   connection on the brick;
 * bounded-concurrency gates per priority class on the async side, so
   lookups/stats preempt bulk data (the queue-priority scheduling
-  intent)."""
+  intent).
+
+With the concurrent event plane (server.event-threads, ISSUE 7) this
+layer is the brick's real parallel dispatcher: the event pool feeds
+independent fops from different connections into the graph
+concurrently, the priority gates admit them side by side, and the
+injected executor runs their posix syscalls on parallel worker
+threads.  Both pools resize LIVE: ``reconfigure`` grows/shrinks the
+executor (a fresh pool swaps in; in-flight syscalls finish on the old
+one) and the gates (a :class:`ResizableGate` re-admits parked waiters
+when its limit grows), never dropping queued work.  ``inflight`` /
+``peak_inflight`` make the achieved parallelism observable
+(dump_private + the callpool status plane)."""
 
 from __future__ import annotations
 
 import asyncio
+import collections
 from concurrent.futures import ThreadPoolExecutor
 
 from ..core.fops import Fop
@@ -74,6 +87,57 @@ def _prio(fop: Fop) -> int:
     return 2
 
 
+class ResizableGate:
+    """A counting gate whose limit can change live (asyncio.Semaphore
+    cannot): shrink applies as holders release, grow re-admits parked
+    waiters immediately — queued fops are never dropped either way
+    (the live-reconfigure contract of performance.*-prio-threads)."""
+
+    __slots__ = ("limit", "_active", "_waiters")
+
+    def __init__(self, limit: int):
+        self.limit = int(limit)
+        self._active = 0
+        self._waiters: collections.deque = collections.deque()
+
+    def resize(self, limit: int) -> None:
+        self.limit = int(limit)
+        self._kick()
+
+    def _kick(self) -> None:
+        while self._waiters and self._active < self.limit:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                self._active += 1
+                fut.set_result(None)
+
+    async def __aenter__(self):
+        if self._active < self.limit and not self._waiters:
+            self._active += 1
+            return self
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            await fut
+        except asyncio.CancelledError:
+            if fut.done() and not fut.cancelled():
+                # granted and cancelled in the same tick: hand the
+                # slot on, or it leaks forever
+                self._active -= 1
+                self._kick()
+            else:
+                try:
+                    self._waiters.remove(fut)
+                except ValueError:
+                    pass
+            raise
+        return self
+
+    async def __aexit__(self, *exc):
+        self._active -= 1
+        self._kick()
+
+
 @register("performance/io-threads")
 class IoThreadsLayer(Layer):
     OPTIONS = (
@@ -92,22 +156,28 @@ class IoThreadsLayer(Layer):
                            "(performance.enable-least-priority)"),
     )
 
+    _GATE_KEYS = ("high-prio-threads", "normal-prio-threads",
+                  "low-prio-threads", "least-prio-threads")
+
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
-        self._gates = [
-            asyncio.Semaphore(self.opts["high-prio-threads"]),
-            asyncio.Semaphore(self.opts["normal-prio-threads"]),
-            asyncio.Semaphore(self.opts["low-prio-threads"]),
-            asyncio.Semaphore(self.opts["least-prio-threads"]),
-        ]
+        self._gates = [ResizableGate(self.opts[k])
+                       for k in self._GATE_KEYS]
         self.queued = [0, 0, 0, 0]
         self.executed = [0, 0, 0, 0]
+        # achieved parallelism (the "real parallel dispatch" proof
+        # counters): fops currently inside the gates, and the high
+        # watermark since init
+        self.inflight = 0
+        self.peak_inflight = 0
         self._pool: ThreadPoolExecutor | None = None
+        self._pool_width = 0
         _LIVE_IOT_LAYERS.add(self)
 
     async def init(self):
+        self._pool_width = self.opts["thread-count"]
         self._pool = ThreadPoolExecutor(
-            max_workers=self.opts["thread-count"],
+            max_workers=self._pool_width,
             thread_name_prefix=f"{self.name}-iot")
         # hand the worker pool to every storage/posix below us (the
         # reference's iot_worker continues the wind in a worker thread;
@@ -122,6 +192,26 @@ class IoThreadsLayer(Layer):
             self._pool = None
         await super().fini()
 
+    def reconfigure(self, options: dict) -> None:
+        """Live pool geometry (performance.io-thread-count + the
+        *-prio-threads gates): the executor is swapped — in-flight
+        syscalls complete on the retiring pool, new ones land on the
+        fresh one — and the gates resize in place, re-admitting parked
+        waiters on growth.  Nothing queued is dropped."""
+        super().reconfigure(options)
+        for gate, key in zip(self._gates, self._GATE_KEYS):
+            gate.resize(self.opts[key])
+        want = self.opts["thread-count"]
+        if self._pool is not None and want != self._pool_width:
+            old = self._pool
+            self._pool = ThreadPoolExecutor(
+                max_workers=want, thread_name_prefix=f"{self.name}-iot")
+            self._pool_width = want
+            self._set_executors(self._pool)
+            # retire without waiting: already-submitted syscalls run to
+            # completion on the old pool's threads
+            old.shutdown(wait=False)
+
     def _set_executors(self, pool) -> None:
         from ..core.layer import walk
 
@@ -133,7 +223,10 @@ class IoThreadsLayer(Layer):
     def dump_private(self) -> dict:
         return {"queued": list(self.queued),
                 "executed": list(self.executed),
-                "pool_threads": self.opts["thread-count"]}
+                "inflight": self.inflight,
+                "peak_inflight": self.peak_inflight,
+                "pool_threads": self._pool_width or
+                self.opts["thread-count"]}
 
 
 def _gated(fop: Fop):
@@ -148,7 +241,14 @@ def _gated(fop: Fop):
         try:
             async with self._gates[p]:
                 self.executed[p] += 1
-                return await getattr(self.children[0], name)(*args, **kwargs)
+                self.inflight += 1
+                if self.inflight > self.peak_inflight:
+                    self.peak_inflight = self.inflight
+                try:
+                    return await getattr(self.children[0],
+                                         name)(*args, **kwargs)
+                finally:
+                    self.inflight -= 1
         finally:
             self.queued[p] -= 1
     fop_impl.__name__ = name

@@ -36,6 +36,7 @@ from ..core.options import Option
 from ..core import gflog, tracing
 from ..core import metrics as _metrics
 from ..rpc import wire
+from ..rpc import event_pool as _evt
 
 log = gflog.get_logger("protocol.client")
 
@@ -77,6 +78,22 @@ class ClientLayer(Layer):
         Option("ssl-cert", "str", default="",
                description="client certificate (mutual TLS)"),
         Option("ssl-key", "str", default=""),
+        Option("event-threads", "int", default=2, min=0, max=64,
+               description="reply-turning workers "
+                           "(client.event-threads; the client half "
+                           "of the multithreaded-epoll analog): "
+                           "decode of large reply frames — a 4 MiB "
+                           "scatter-gather readv reply, a fat "
+                           "readdirp listing — moves off the read "
+                           "loop onto the process-wide event pool, "
+                           "so it no longer serializes behind the "
+                           "next request's encode.  The pool is "
+                           "shared by every protocol/client in the "
+                           "process (the reference's per-process "
+                           "gf-event pool); connect grows it to the "
+                           "largest configured value, reconfigure "
+                           "applies the new value exactly.  0 = "
+                           "decode inline (pre-9 behavior)"),
         Option("compound-fops", "bool", default="off",
                description="fuse chained fops into single wire frames "
                            "(cluster.use-compound-fops); only engages "
@@ -162,6 +179,16 @@ class ClientLayer(Layer):
         # reconnect before CHILD_UP
         self._fds: dict[int, tuple[FdObj, str]] = {}
         self._held_locks: dict[tuple, tuple] = {}  # key -> (fop, args, kw)
+
+    def reconfigure(self, options: dict) -> None:
+        """client.event-threads applies live: the process-wide reply
+        pool is resized to the operator's latest value exactly —
+        grow AND shrink (the connect-time path only grows it)."""
+        before = self.opts["event-threads"]
+        super().reconfigure(options)
+        after = self.opts["event-threads"]
+        if after != before:
+            _evt.client_pool_resize(after)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -350,7 +377,21 @@ class ClientLayer(Layer):
             while True:
                 rec = await wire.read_frame(reader)
                 self.bytes_rx += len(rec) + 4  # + the length prefix
-                xid, mtype, payload = wire.unpack(rec)
+                # reply turning (client.event-threads): large frames
+                # decode on the shared event pool, keyed by this layer
+                # so one connection's replies and upcalls resolve in
+                # arrival order; small frames decode inline (cheaper
+                # than the handoff).  A layer configured to 0 decodes
+                # inline even when another graph grew the shared pool
+                # (the documented escape hatch is per-volume)
+                n = self.opts["event-threads"]
+                pool = _evt.client_pool(n) \
+                    if n > 0 and len(rec) >= _evt.TURN_MIN else None
+                if pool is not None and pool.size > 0:
+                    xid, mtype, payload = await pool.turn(
+                        self, wire.unpack, rec)
+                else:
+                    xid, mtype, payload = wire.unpack(rec)
                 if mtype == wire.MT_EVENT:
                     # server-pushed upcall (cache invalidation etc.):
                     # surface as a graph notification for md-cache & co

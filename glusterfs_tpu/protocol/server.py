@@ -46,6 +46,7 @@ from ..core.options import Option
 from ..core import gflog, tracing
 from ..core import metrics as _metrics
 from ..rpc import wire
+from ..rpc.event_pool import TURN_MIN, EventPool
 
 log = gflog.get_logger("protocol.server")
 
@@ -93,6 +94,21 @@ class ServerLayer(Layer):
                            "verified cert.  Requires ssl + ssl-ca "
                            "(without a verified peer cert every "
                            "handshake is refused)"),
+        Option("event-threads", "int", default=2, min=0, max=64,
+               description="frame-turning workers for this brick's "
+                           "transport (server.event-threads; the "
+                           "multithreaded-epoll analog, "
+                           "event-epoll.c): decode, payload handling "
+                           "and reply encode of large frames move "
+                           "off the accept loop onto a keyed worker "
+                           "pool — a connection's frames are turned "
+                           "by one worker at a time (per-connection "
+                           "ordering preserved) while distinct "
+                           "connections turn in parallel.  0 = turn "
+                           "inline on the event loop (the pre-9 "
+                           "serial plane).  Live-reconfigurable: the "
+                           "pool grows/shrinks without dropping "
+                           "in-flight frames"),
         Option("compound-fops", "bool", default="on",
                description="serve compound fop chains and advertise "
                            "the capability at SETVOLUME "
@@ -208,6 +224,15 @@ _RPC_EXTRAS = {"heal_info", "heal_file", "heal_entry", "rebalance",
 #: the deep-status op family (GF_CLI_STATUS_* brick half) — the ONE
 #: definition; glusterd's fan-out and the CLI parser import it
 STATUS_KINDS = ("detail", "clients", "fds", "inodes", "callpool", "mem")
+
+#: fops whose replies are worth encoding on the event pool: bulk data
+#: (readv, compound chains with readv links) or structure-heavy tagged
+#: bodies (listings, status/statedump dumps).  Everything else encodes
+#: inline — a stat reply is ~200 bytes and the thread handoff would
+#: cost more than the encode.
+_BULKY_REPLY_FOPS = {"readv", "readdir", "readdirp", "getxattr",
+                     "fgetxattr", "compound", "__compound__",
+                     "__status__", "__statedump__"}
 
 
 class _ClientConn:
@@ -346,6 +371,10 @@ class BrickServer:
         self.attached: dict[str, tuple[Layer, Any]] = {}
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[_ClientConn] = set()
+        # the concurrent event plane (server.event-threads): keyed
+        # frame-turning workers shared by every connection (and every
+        # multiplexed brick) on this transport
+        self._pool: EventPool | None = None
         _LIVE_SERVERS.add(self)
 
     # -- per-client metrics families (scraped by core/metrics.REGISTRY) ----
@@ -574,6 +603,9 @@ class BrickServer:
         # (the reference's upcall xlator calls back through rpcsvc the
         # same way)
         self._wire_upcall(self.top)
+        # spin the event plane up with the listener so the
+        # gftpu_event_threads families are scrapable from volume start
+        self.event_pool()
         log.info(1, "brick %s serving on %s:%d", self.top.name, self.host,
                  self.port)
         return self.port
@@ -589,7 +621,38 @@ class BrickServer:
                 except Exception:
                     pass
 
+    def _event_threads(self) -> int:
+        """Configured pool width, read per-use so a live volume-set of
+        server.event-threads applies without a respawn."""
+        opts = self._auth_opts
+        if not opts:
+            return self.DEFAULT_EVENT_THREADS
+        try:
+            return int(opts.get("event-threads",
+                                self.DEFAULT_EVENT_THREADS))
+        except (TypeError, ValueError):
+            return self.DEFAULT_EVENT_THREADS
+
+    def event_pool(self) -> EventPool:
+        """The transport's frame-turning pool, reconciled to the live
+        option (one int compare on the hot path).  A stopped server's
+        pool stays in place, shut down — its size-0 state turns any
+        straggling reply inline instead of resurrecting worker threads
+        nobody would ever stop again."""
+        pool = self._pool
+        if pool is None:
+            pool = self._pool = EventPool(self._event_threads(),
+                                          name=self.top.name)
+        elif not pool.closed:
+            pool.ensure(self._event_threads())
+        return pool
+
     async def stop(self) -> None:
+        if self._pool is not None:
+            # shut down but keep the handle: an in-flight serve_one
+            # reaching send() after stop() must not construct a fresh
+            # pool (leaked threads); turn() on a closed pool is inline
+            self._pool.shutdown()
         if self._server is not None:
             self._server.close()
             # close live connections too: since py3.12 wait_closed() also
@@ -611,6 +674,10 @@ class BrickServer:
     # rpcsvc.h:38 RPCSVC_DEFAULT_OUTSTANDING_RPC_LIMIT (used when the
     # served top carries no protocol/server options, e.g. bare graphs)
     DEFAULT_RPC_LIMIT = 64
+    # server.event-threads default (the reference ships 2 since 3.8;
+    # used directly when the served top carries no protocol/server
+    # options, e.g. bare graphs in tests)
+    DEFAULT_EVENT_THREADS = 2
     # lock-class fops are exempt from the limit (deadlock hack,
     # rpcsvc.c:183-208) but a hostile flood of them must still not OOM
     # the brick: a wide separate cap bounds parked lock tasks.  The
@@ -669,26 +736,45 @@ class BrickServer:
             except (TypeError, ValueError):
                 return self.DEFAULT_RPC_LIMIT
 
-        async def send(xid: int, resp_type, resp) -> None:
-            async with wlock:
-                if conn.compress:
-                    buf = wire.pack_z(xid, resp_type, resp)
-                    conn.bytes_tx += len(buf)
-                    writer.write(buf)
+        async def send(xid: int, resp_type, resp,
+                       bulky: bool = False) -> None:
+            # reply encode: bulky replies turn on the event pool —
+            # keyed by conn, so one connection's encodes stay mutually
+            # exclusive while distinct connections encode in parallel;
+            # small replies encode inline (the handoff would cost more
+            # than the encode).  Encoding happens OUTSIDE the write
+            # lock: only the socket write serializes.
+            pool = self.event_pool()
+            turn = bulky and pool.size > 0
+            if conn.compress:
+                if turn:
+                    buf = await pool.turn(conn, wire.pack_z,
+                                          xid, resp_type, resp)
                 else:
-                    # blob replies (readv data) go out as raw trailing
-                    # buffers — no payload copy between the fop return
-                    # and the socket
+                    buf = wire.pack_z(xid, resp_type, resp)
+                frames = [buf]
+            else:
+                # blob replies (readv data) go out as raw trailing
+                # buffers — no payload copy between the fop return
+                # and the socket
+                if turn:
+                    frames = await pool.turn(conn, wire.pack_frames,
+                                             xid, resp_type, resp)
+                else:
                     frames = wire.pack_frames(xid, resp_type, resp)
-                    conn.bytes_tx += sum(len(f) for f in frames)
-                    writer.writelines(frames)
+            async with wlock:
+                conn.bytes_tx += sum(len(f) for f in frames)
+                writer.writelines(frames)
                 await writer.drain()
 
         async def serve_one(xid: int, payload, kind: str):
+            fop = payload[0] if isinstance(payload, list) and payload \
+                else None
+            bulky = fop in _BULKY_REPLY_FOPS
             try:
                 try:
                     resp_type, resp = await self._dispatch(conn, payload)
-                    await send(xid, resp_type, resp)
+                    await send(xid, resp_type, resp, bulky)
                 except (ConnectionError, RuntimeError):
                     pass
                 except Exception as e:
@@ -725,7 +811,23 @@ class BrickServer:
                 # rx accounting: record + the 4-byte length prefix —
                 # one integer add per frame already in hand
                 conn.bytes_rx += len(rec) + 4
-                xid, mtype, payload = wire.unpack(rec)
+                # frame decode: large records turn on the event pool.
+                # Awaiting the decode BEFORE the next read_frame is
+                # what preserves per-connection dispatch order — the
+                # pool's key serialization covers the encode side,
+                # where several of this connection's replies can be
+                # in flight at once.
+                pool = self.event_pool()
+                if len(rec) >= TURN_MIN and pool.size > 0:
+                    try:
+                        xid, mtype, payload = await pool.turn(
+                            conn, wire.unpack, rec)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        break  # undecodable frame: drop the transport
+                else:
+                    xid, mtype, payload = wire.unpack(rec)
                 if mtype != wire.MT_CALL:
                     continue
                 if conn.authed and isinstance(payload, list) and payload \

@@ -21,6 +21,16 @@
 #                               the glusterd-spawned daemon lifecycle
 #                               (`volume gateway start|status|stop`)
 #                               exercised end to end (ISSUE 6)
+#   5. concurrency smoke        1-brick volume served with
+#                               server.event-threads=4: interleaved
+#                               pipelined writes from one connection
+#                               dispatch in order and read back
+#                               byte-identical, a second connection
+#                               proceeds in parallel, the
+#                               gftpu_event_threads* families are
+#                               present and moving, and the managed
+#                               op-version-9 volume-set path applies
+#                               the key to a live brick (ISSUE 7)
 #
 # Usage:  tools/ci.sh [extra pytest args for the tier-1 runs...]
 # Exit: first failing stage's code; 0 = mergeable.
@@ -288,10 +298,154 @@ if [ $gw_rc -ne 0 ]; then
     exit $gw_rc
 fi
 
+echo "== ci: concurrency smoke (event-threads=4, interleaved clients,"
+echo "       ordering + gftpu_event_threads families) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, os, tempfile
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc, walk
+from glusterfs_tpu.core.metrics import REGISTRY
+from glusterfs_tpu.daemon import serve_brick
+from glusterfs_tpu.storage.posix import PosixLayer
+
+BRICK = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+volume srv
+    type protocol/server
+    option event-threads 4
+    subvolumes locks
+end-volume
+"""
+CLIENT = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume srv
+    option event-threads 2
+end-volume
+"""
+
+async def connect(port):
+    g = Graph.construct(CLIENT.format(port=port))
+    c = Client(g)
+    await c.mount()
+    for _ in range(200):
+        if g.top.connected:
+            break
+        await asyncio.sleep(0.05)
+    assert g.top.connected, "client never connected"
+    return c, g.top
+
+async def main():
+    base = tempfile.mkdtemp(prefix="evt-smoke")
+    server = await serve_brick(BRICK.format(dir=os.path.join(base, "b")))
+    assert server.event_pool().size == 4, server.event_pool().size
+    c1, cl1 = await connect(server.port)
+    c2, cl2 = await connect(server.port)
+
+    # ordering: 16 pipelined 8KiB writes from ONE connection must
+    # enter the brick graph in send order through the 4-thread plane
+    arrivals = []
+    real = PosixLayer.writev
+    async def recording(self, fd, data, offset, *a, **kw):
+        arrivals.append(offset)
+        return await real(self, fd, data, offset, *a, **kw)
+    chunk = 8192
+    fd, _ = await cl1.create(Loc("/ord"), os.O_CREAT | os.O_RDWR, 0o644)
+    PosixLayer.writev = recording
+    try:
+        await asyncio.gather(*(
+            asyncio.ensure_future(
+                cl1.writev(fd, bytes([i]) * chunk, i * chunk))
+            for i in range(16)))
+    finally:
+        PosixLayer.writev = real
+    assert arrivals == [i * chunk for i in range(16)], \
+        f"dispatch reordered: {arrivals}"
+    # interleaved second connection, byte identity on both
+    await asyncio.gather(
+        c1.write_file("/a", b"a" * 65536),
+        c2.write_file("/b", b"b" * 65536))
+    assert await c2.read_file("/a") == b"a" * 65536
+    assert await c1.read_file("/b") == b"b" * 65536
+    assert await c1.read_file("/ord") == b"".join(
+        bytes([i]) * chunk for i in range(16))
+
+    snap = REGISTRY.snapshot()
+    for fam in ("gftpu_event_threads", "gftpu_event_threads_busy",
+                "gftpu_event_frames_total"):
+        assert fam in snap, f"missing family {fam}"
+    pools = {s[0]["pool"]: s[1]
+             for s in snap["gftpu_event_threads"]["samples"]}
+    assert pools.get("srv") == 4, pools
+    turned = sum(s[1] for s in
+                 snap["gftpu_event_frames_total"]["samples"]
+                 if s[0]["pool"] == "srv")
+    assert turned > 0, "no frames turned on the brick pool"
+    await c1.unmount()
+    await c2.unmount()
+    await server.stop()
+
+    # managed path: the op-version-9 key reaches a live brick
+    # subprocess through `volume set` (glusterd gating + volgen map +
+    # live reconfigure)
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    d = Glusterd(os.path.join(base, "gd"))
+    await d.start()
+    try:
+        async with MgmtClient(d.host, d.port) as mc:
+            await mc.call("volume-create", name="evt",
+                          vtype="distribute",
+                          bricks=[{"path": os.path.join(base, "vb0")}])
+            await mc.call("volume-start", name="evt")
+            await mc.call("volume-set", name="evt",
+                          key="server.event-threads", value="4")
+            await mc.call("volume-set", name="evt",
+                          key="client.event-threads", value="2")
+        m = await mount_volume(d.host, d.port, "evt")
+        try:
+            await m.write_file("/s", b"s" * 65536)
+            assert await m.read_file("/s") == b"s" * 65536
+            g = m.graph
+            cl = next(l for l in walk(g.top)
+                      if l.type_name == "protocol/client")
+            rpc = await cl._call("metrics_dump", (), {})
+            pools = {s[0]["pool"]: s[1] for s in
+                     rpc["gftpu_event_threads"]["samples"]}
+            assert any(v == 4 for v in pools.values()), \
+                f"brick pool not resized by volume set: {pools}"
+        finally:
+            await m.unmount()
+    finally:
+        await d.stop()
+    print("concurrency smoke: ordering held through 4 frame turners, "
+          "interleaved clients byte-identical, families present, "
+          "managed volume-set applied event-threads=4 live")
+
+asyncio.run(main())
+EOF
+evt_rc=$?
+if [ $evt_rc -ne 0 ]; then
+    echo "ci: concurrency smoke failed — not mergeable"
+    exit $evt_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
 fi
 echo "ci: mergeable (two identical green tier-1 runs + bench contract"
-echo "    + metrics smoke + gateway smoke)"
+echo "    + metrics smoke + gateway smoke + concurrency smoke)"
 exit 0
