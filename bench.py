@@ -556,9 +556,9 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
             # kernel mount over the same served volume
             mnt = os.path.join(base, "mnt")
             os.makedirs(mnt)
-            env = dict(os.environ)
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            env["JAX_PLATFORMS"] = "cpu"
+            from glusterfs_tpu.ops.codec import virtual_mesh_env
+
+            env = virtual_mesh_env()
 
             async def spawn_bridge(attempt: int):
                 """One bridge attempt: spawn, wait for the ready file
@@ -906,6 +906,131 @@ def event_threads_sweep() -> dict:
         f"and the bench driver; evt4 rows use "
         f"server/client.event-threads={EVENT_SWEEP_THREADS}, evt_off "
         f"rows pin event-threads=0 (inline frame turning)")
+    return out
+
+
+MESH_LADDER = (1, 2, 8)
+
+
+def mesh_sweep(data_mib: int = 8) -> dict:
+    """Device-count ladder for the mesh codec data plane (ISSUE 8):
+    ``mesh_{enc,dec}_d{1,2,8}_MiB_s`` rows beside the native
+    single-device baseline, 4+2 at ``data_mib`` MiB per launch
+    (parallel/mesh_codec.sharded_{encode,decode} — the exact entry
+    points the BatchingCodec's mesh tier drives).
+
+    Bench honesty (PR 7 rules): rungs are measured ONLY on real
+    accelerator devices — a host with fewer devices than the rung
+    records an explicit ``skipped: single-device host`` row, never a
+    virtual-mesh number dressed as a device ladder.  The 8-way virtual
+    CPU mesh IS measured, in a subprocess, under the explicitly-virtual
+    ``mesh_virtual8_{enc,dec}_MiB_s`` names (it proves the plane turns
+    end to end; its rate is a 2-core-host artifact, not an ICI claim).
+    ``host_cores``/``n_devices`` are stamped on the record."""
+    import subprocess
+    import sys
+
+    from glusterfs_tpu.ops import codec as codec_mod
+    from glusterfs_tpu.parallel import mesh_codec
+
+    out: dict = {"host_cores": host_cores()}
+    nbytes = data_mib * MIB
+    data = np.random.default_rng(0).integers(0, 256, nbytes,
+                                             dtype=np.uint8)
+    rows = tuple(range(R, N))  # first R fragments lost
+
+    # native single-device baseline on the SAME data (jax-free)
+    try:
+        nat = _native_sweep_row(K, R, data)
+        out["mesh_native_baseline_enc_MiB_s"] = nat["native_encode_MiB_s"]
+        out["mesh_native_baseline_dec_MiB_s"] = nat["native_decode_MiB_s"]
+    except Exception as e:  # noqa: BLE001 - rows say why
+        for d in ("enc", "dec"):
+            out[f"mesh_native_baseline_{d}_MiB_s"] = \
+                f"skipped: {e!r}"[:200]
+
+    # real accelerator devices only (wedge-safe probe already ran in
+    # main; a wedged transport never reaches this sweep)
+    def accels():
+        import jax
+
+        return [d for d in jax.devices() if d.platform in ("tpu", "axon")]
+
+    devs, timed_out = codec_mod.probe_with_deadline(accels, [])
+    out["n_devices"] = len(devs)
+
+    def rung(mesh) -> tuple[float, float]:
+        frags = mesh_codec.sharded_encode(K, R, data, mesh)  # compile
+        et = time_it(lambda: mesh_codec.sharded_encode(K, R, data, mesh),
+                     1, 3)
+        surv = np.ascontiguousarray(frags[list(rows)])
+        mesh_codec.sharded_decode(K, rows, surv, mesh)
+        dt = time_it(lambda: mesh_codec.sharded_decode(K, rows, surv,
+                                                       mesh), 1, 3)
+        return data_mib / et, data_mib / dt
+
+    for d in MESH_LADDER:
+        if timed_out:
+            reason = "skipped: device probe timed out (wedged transport)"
+        elif len(devs) >= d:
+            try:
+                enc, dec = rung(mesh_codec.make_mesh(devs[:d]))
+                out[f"mesh_enc_d{d}_MiB_s"] = round(enc, 1)
+                out[f"mesh_dec_d{d}_MiB_s"] = round(dec, 1)
+                continue
+            except Exception as e:  # noqa: BLE001
+                reason = f"skipped: {e!r}"[:200]
+        else:
+            reason = (f"skipped: single-device host ({len(devs)} "
+                      f"accelerator device(s) < d={d})")
+        out[f"mesh_enc_d{d}_MiB_s"] = reason
+        out[f"mesh_dec_d{d}_MiB_s"] = reason
+
+    # the 8-way VIRTUAL cpu mesh, subprocess-pinned (XLA device-count
+    # flags must precede the jax import) — plane proof, not a device row
+    code = (
+        "import sys, json, time; sys.path.insert(0, {root!r})\n"
+        "import numpy as np\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from glusterfs_tpu.parallel import mesh_codec\n"
+        "k, r, nbytes = {k}, {r}, {nbytes}\n"
+        "data = np.random.default_rng(0).integers(0, 256, nbytes, "
+        "dtype=np.uint8)\n"
+        "mesh = mesh_codec.make_mesh()\n"
+        "frags = mesh_codec.sharded_encode(k, r, data, mesh)\n"
+        "t0 = time.perf_counter()\n"
+        "for _ in range(3): mesh_codec.sharded_encode(k, r, data, mesh)\n"
+        "et = (time.perf_counter() - t0) / 3\n"
+        "rows = tuple(range(r, k + r))\n"
+        "surv = np.ascontiguousarray(frags[list(rows)])\n"
+        "mesh_codec.sharded_decode(k, rows, surv, mesh)\n"
+        "t0 = time.perf_counter()\n"
+        "for _ in range(3): mesh_codec.sharded_decode(k, rows, surv, "
+        "mesh)\n"
+        "dt = (time.perf_counter() - t0) / 3\n"
+        "mib = nbytes / (1 << 20)\n"
+        "print(json.dumps({{'enc': round(mib / et, 1), "
+        "'dec': round(mib / dt, 1)}}))\n"
+    ).format(root=os.path.dirname(os.path.abspath(__file__)),
+             k=K, r=R, nbytes=nbytes)
+    env = codec_mod.virtual_mesh_env(8)
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            raise RuntimeError(f"rc={proc.returncode}: "
+                               f"{proc.stderr[-200:]}")
+        virt = json.loads(proc.stdout.strip().splitlines()[-1])
+        out["mesh_virtual8_enc_MiB_s"] = virt["enc"]
+        out["mesh_virtual8_dec_MiB_s"] = virt["dec"]
+    except Exception as e:  # noqa: BLE001
+        for d in ("enc", "dec"):
+            out[f"mesh_virtual8_{d}_MiB_s"] = f"skipped: {e!r}"[:200]
+    out["mesh_sweep_analysis"] = (
+        f"4+2 x {data_mib} MiB per launch; d-rungs require real "
+        f"accelerator devices (none dressed up from the virtual mesh); "
+        f"virtual8 rows run the 8-device CPU mesh in a subprocess on "
+        f"{out['host_cores']} schedulable core(s) — plane proof only")
     return out
 
 
@@ -1348,6 +1473,12 @@ def main() -> None:
     except Exception as e:
         vol["event_threads_sweep_error"] = str(e)[:200]
         vol.setdefault("host_cores", host_cores())
+    try:
+        # mesh-codec device ladder (ISSUE 8): measured rungs on real
+        # devices, explicit skips + the virtual-8 plane proof otherwise
+        vol.update(mesh_sweep())
+    except Exception as e:
+        vol["mesh_sweep_error"] = str(e)[:200]
     # a missing wire/fuse/smallfile-wire row is an EXPLICIT
     # "skipped: <reason>" entry, never silence (r5's detail lost all
     # four rows without a trace)
@@ -1362,10 +1493,14 @@ def main() -> None:
                 "smallfile_wire_create_compound_per_s",
                 "smallfile_wire_create_singles_per_s",
                 "smallfile_wire_rpc_per_create_compound",
-                "smallfile_wire_rpc_per_create_singles"):
+                "smallfile_wire_rpc_per_create_singles",
+                *(f"mesh_{op}_d{d}_MiB_s" for op in ("enc", "dec")
+                  for d in MESH_LADDER)):
         if row not in vol:
             if row.startswith("fuse"):
                 reason = vol.get("fuse_bench_error")
+            elif row.startswith("mesh_"):
+                reason = vol.get("mesh_sweep_error")
             elif row.startswith("smallfile_wire"):
                 mode = "compound" if "compound" in row else "singles"
                 reason = vol.get(f"smallfile_wire_{mode}_error") \

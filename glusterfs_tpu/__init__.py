@@ -14,11 +14,12 @@ __version__ = "0.1.0"
 # minimum, gating newer volume-set keys until every member upgrades.
 # Lives here (not in mgmt/glusterd) so protocol/client can advertise it
 # at SETVOLUME without dragging the whole management plane into every
-# client process.  Version history: 9 concurrent event plane
+# client process.  Version history: 10 mesh-sharded codec data plane
+# (cluster.mesh-codec, volgen._V10_KEYS); 9 concurrent event plane
 # (server/client.event-threads frame-turning pools + the reader/
-# writer-split fuse bridge, volgen._V9_KEYS); 8 HTTP object gateway
+# writer-split fuse bridge, _V9_KEYS); 8 HTTP object gateway
 # keys (_V8_KEYS); 7 observability (trace propagation + slow-fop
 # diagnostics, _V7_KEYS); 6 zero-copy reads + strict-locks (_V6_KEYS);
 # 5 compound fops + auth.ssl-allow (_V5_KEYS); 4 round-5 keys
 # (_V4_KEYS); 3 the round-4 option long tail (_V3_KEYS).
-OP_VERSION = 9
+OP_VERSION = 10

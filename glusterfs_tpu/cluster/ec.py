@@ -198,6 +198,17 @@ class DisperseLayer(Layer):
                            "a lone sequential writer never waits)"),
         Option("stripe-cache-min-batch", "size", default="256KB",
                description="batches below this run on the CPU ladder"),
+        Option("mesh-codec", "bool", default="off",
+               description="shard coalesced stripe batches over the "
+                           "(dp, frag) device mesh: flushes at/above "
+                           "stripe-cache-min-batch land in ONE pjit'd "
+                           "NamedSharding launch when >1 jax device is "
+                           "visible (parallel/mesh_codec — the ICI "
+                           "analog of ec_dispatch_all's socket "
+                           "fan-out).  On 1 device, below min-batch, "
+                           "or on a systematic volume the existing "
+                           "ladder is untouched; rides the "
+                           "stripe-cache batching window"),
         Option("eager-lock", "bool", default="on",
                description="hold the txn inodelk across consecutive fops "
                            "on one inode with a delayed combined post-op "
@@ -244,7 +255,8 @@ class DisperseLayer(Layer):
             self.k, self.r, self.opts["cpu-extensions"],
             window=self.opts["stripe-cache-window"] / 1e6,
             min_batch=self.opts["stripe-cache-min-batch"],
-            systematic=self.opts["systematic"])
+            systematic=self.opts["systematic"],
+            mesh=self.opts["mesh-codec"], name=self.name)
         self._batching = self.opts["stripe-cache"]
         self.stripe = self.k * CHUNK
         self.up = [True] * self.n  # xl_up bitmask (ec.c:571 notify)
@@ -288,7 +300,7 @@ class DisperseLayer(Layer):
                         self.name)
             self.opts["systematic"] = old["systematic"]
         codec_keys = ("cpu-extensions", "stripe-cache-window",
-                      "stripe-cache-min-batch")
+                      "stripe-cache-min-batch", "mesh-codec")
         if any(self.opts[k] != old[k] for k in codec_keys):
             from ..ops.batch import BatchingCodec
 
@@ -297,7 +309,8 @@ class DisperseLayer(Layer):
                 self.k, self.r, self.opts["cpu-extensions"],
                 window=self.opts["stripe-cache-window"] / 1e6,
                 min_batch=self.opts["stripe-cache-min-batch"],
-                systematic=self.opts["systematic"])
+                systematic=self.opts["systematic"],
+                mesh=self.opts["mesh-codec"], name=self.name)
         self._batching = self.opts["stripe-cache"]
         self._read_mask = self._parse_read_mask()
 
@@ -1807,8 +1820,11 @@ class DisperseLayer(Layer):
                                        "heal source read failed")
                     b = np.frombuffer(r, dtype=np.uint8)
                     frags_in[j, : b.size] = b
-                data = await self._codec_decode(frags_in, rows_sorted)
-                frags_out = await self._codec_encode(data)
+                # heal traffic is tagged so the mesh families (and the
+                # MULTICHIP dryrun) can tell shd re-encode from serving
+                data = await self._codec_decode(frags_in, rows_sorted,
+                                                origin="heal")
+                frags_out = await self._codec_encode(data, origin="heal")
                 await self._dispatch(
                     bad, "writev",
                     lambda i: ((self._child_fd(fd, i),
@@ -1844,14 +1860,15 @@ class DisperseLayer(Layer):
             return {"healed": healed, "skipped": False,
                     "size": rep2["size"], "stable": stable}
 
-    async def _codec_encode(self, buf):
+    async def _codec_encode(self, buf, origin: str = "serve"):
         if self._batching:
-            return await self.codec.encode_async(buf)
+            return await self.codec.encode_async(buf, origin=origin)
         return self.codec.encode(buf)
 
-    async def _codec_decode(self, frags, rows):
+    async def _codec_decode(self, frags, rows, origin: str = "serve"):
         if self._batching:
-            return await self.codec.decode_async(frags, rows)
+            return await self.codec.decode_async(frags, rows,
+                                                 origin=origin)
         return self.codec.decode(frags, rows)
 
     async def fini(self):

@@ -1100,8 +1100,11 @@ class Glusterd:
                 return await shd_mod.gather_heal_info(client)
             if action == "full":
                 # full namespace sweep (ec_shd_full_sweep): also heals
-                # bricks with no index record (replaced/wiped)
-                return await shd_mod.full_crawl(client)
+                # bricks with no index record (replaced/wiped); file
+                # heals run shd-max-threads wide so their re-encodes
+                # coalesce (one mesh launch on a mesh-codec volume)
+                return await shd_mod.full_crawl(
+                    client, max_heals=self._shd_max_heals(vol))
             if action == "index":
                 return await shd_mod.crawl_once(client)
             if action == "file":
@@ -1776,7 +1779,8 @@ class Glusterd:
 
             client = await mount_volume(self.host, self.port, name)
             try:
-                await shd_mod.full_crawl(client)
+                await shd_mod.full_crawl(
+                    client, max_heals=self._shd_max_heals(self._vol(name)))
             finally:
                 await client.unmount()
         except Exception as e:
@@ -2937,6 +2941,20 @@ class Glusterd:
 
     # -- self-heal daemon lifecycle (glusterd-shd-svc.c analog) -----------
 
+    @staticmethod
+    def _shd_max_heals(vol: dict) -> int:
+        """Concurrent file heals for this volume (shd-max-threads with
+        the reference's fallback ladder) — shared by the spawned shd
+        and the mounted-client heal ops so ``heal full`` coalesces the
+        same way the daemon does."""
+        opts = vol.get("options", {})
+        prefix = "disperse." if vol["type"] == "disperse" else "cluster."
+        return int(opts.get(prefix + "shd-max-threads",
+                            opts.get("cluster.background-self-heal-"
+                                     "count",
+                                     opts.get("disperse.background-"
+                                              "heals", 1))))
+
     def _spawn_shd(self, vol: dict) -> None:
         """One shd per started heal-capable volume on this node."""
         if vol["type"] not in ("disperse", "replicate"):
@@ -2953,11 +2971,7 @@ class Glusterd:
             return
         interval = float(opts.get("cluster.heal-timeout", 10))
         prefix = "disperse." if vol["type"] == "disperse" else "cluster."
-        max_heals = int(opts.get(prefix + "shd-max-threads",
-                                 opts.get("cluster.background-self-heal-"
-                                          "count",
-                                          opts.get("disperse.background-"
-                                                   "heals", 1))))
+        max_heals = self._shd_max_heals(vol)
         qlen = int(opts.get(prefix + "shd-wait-qlength",
                             opts.get("cluster.heal-wait-queue-length",
                                      opts.get("disperse.heal-wait-"

@@ -71,12 +71,23 @@ async def _resolve(layer, gfid: bytes) -> str | None:
     return None
 
 
-async def full_crawl(client) -> dict:
+async def full_crawl(client, max_heals: int = 1) -> dict:
     """``heal full``: walk the whole namespace and heal every entry —
     the reference's full sweep (ec-heald.c:418 ec_shd_full_sweep /
     afr full crawl).  Unlike the index sweep, this repairs bricks with
     NO pending record — a replaced (empty) brick, a wiped backend —
-    because heal_info re-derives good/bad from the live lookups."""
+    because heal_info re-derives good/bad from the live lookups.
+
+    ``max_heals`` file heals run CONCURRENTLY (the shd-max-threads
+    analog the index sweep already honors): directory entry-heals
+    happen in walk order (they create missing files on replaced
+    bricks); file heals stream out under one semaphore as the walk
+    discovers them, backlog-bounded.  On a
+    ``cluster.mesh-codec`` volume this is the heal half of the mesh
+    data plane — concurrent heals' window re-encodes coalesce in the
+    stripe-cache batching window, so many files' dirty stripes land in
+    ONE (dp, frag) mesh launch and heal throughput scales with the
+    mesh instead of one device (ec-heal.c:2048's rebuild, batched)."""
     from ..cluster.dht import DistributeLayer
 
     report = {"healed": [], "skipped": [], "failed": []}
@@ -118,6 +129,19 @@ async def full_crawl(client) -> dict:
             gf_event("HEAL_COMPLETE", path=path,
                      bricks=res.get("healed", []))
 
+    sem = asyncio.Semaphore(max(1, max_heals))
+    # STREAMING dispatch, not collect-then-heal: file heals start while
+    # the walk is still running (a multi-million-file namespace must
+    # not buffer O(files) jobs — and a walk error must not zero out
+    # heals already in flight), with the task backlog bounded so the
+    # pending set stays O(max_heals)
+    pending: set[asyncio.Task] = set()
+    backlog = max(4, 2 * max(1, max_heals))
+
+    async def one_file(layer, path: str) -> None:
+        async with sem:
+            await one(layer, path, False)
+
     async def walk(path: str) -> None:
         for layer in layers:  # directories exist in every group
             await one(layer, path, True)
@@ -127,9 +151,18 @@ async def full_crawl(client) -> dict:
                 await walk(child)
             else:
                 for layer in await owners(child):
-                    await one(layer, child, False)
+                    t = asyncio.ensure_future(one_file(layer, child))
+                    pending.add(t)
+                    t.add_done_callback(pending.discard)
+                while len(pending) > backlog:
+                    await asyncio.wait(
+                        pending, return_when=asyncio.FIRST_COMPLETED)
 
-    await walk("/")
+    try:
+        await walk("/")
+    finally:
+        if pending:  # drain in-flight heals even when the walk errors
+            await asyncio.gather(*pending, return_exceptions=True)
     return report
 
 

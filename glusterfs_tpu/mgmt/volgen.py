@@ -81,6 +81,9 @@ OPTION_MAP = {
                                      "stripe-cache-window"),
     "disperse.stripe-cache-min-batch": ("cluster/disperse",
                                         "stripe-cache-min-batch"),
+    # mesh-sharded codec data plane (ISSUE 8): coalesced stripe
+    # batches ride the (dp, frag) device mesh when >1 device is up
+    "cluster.mesh-codec": ("cluster/disperse", "mesh-codec"),
     "disperse.read-policy": ("cluster/disperse", "read-policy"),
     "disperse.quorum-count": ("cluster/disperse", "quorum-count"),
     "disperse.eager-lock": ("cluster/disperse", "eager-lock"),
@@ -662,6 +665,14 @@ _V9_KEYS = (
     "client.event-threads",
 )
 OPTION_MIN_OPVERSION.update({k: 9 for k in _V9_KEYS})
+
+# round-11 addition ships at op-version 10: the mesh-sharded codec
+# data plane — a v9 member's BatchingCodec has no mesh tier to route
+# coalesced stripe batches onto, so the key must not reach it
+_V10_KEYS = (
+    "cluster.mesh-codec",
+)
+OPTION_MIN_OPVERSION.update({k: 10 for k in _V10_KEYS})
 
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key

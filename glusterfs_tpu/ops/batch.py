@@ -31,6 +31,19 @@ coalesced into HBM-resident batches" — is a batching window:
   Production flush timings keep updating the models (EMA), so a drifting
   transfer latency (e.g. a congested tunnel) re-routes automatically.
 
+* the **mesh tier** (ISSUE 8, ``cluster.mesh-codec``): when the volume
+  key is on and the wedge-safe device probe saw >1 jax device, flushes
+  at/above ``stripe-cache-min-batch`` skip the single-device ladder and
+  land in ONE pjit'd ``NamedSharding(Mesh(dp, frag))`` launch
+  (parallel/mesh_codec) — many concurrent fops' stripes sharded over
+  ``dp``, the fragment dimension over ``frag``, so the encode IS the
+  scatter.  Decodes past ``MESH_RING_DECODE_BYTES`` ride the
+  ring-pipelined ppermute reduce instead of the all-gather plane.
+  Launches are counted per (op, origin) on the
+  ``gftpu_mesh_{launches,batch_stripes}_total`` families ("serve" =
+  fop traffic, "heal" = shd re-encode) and each opens a ``mesh-codec``
+  span joined to the first queued fop's trace.
+
 Correctness leans on fragment-stream concatenation: fragment ``f`` of
 ``concat(stripes_a, stripes_b)`` is ``concat(frag_f(a), frag_f(b))`` —
 stripes are independent (ec-method.c:393-408 loops stripes).
@@ -45,10 +58,31 @@ import time
 
 import numpy as np
 
+from ..core import metrics as _metrics
+from ..core import tracing as _tracing
 from . import gf256
 from .codec import Codec
 
 _DEVICE_BACKENDS = ("pallas-xor", "pallas-mxu", "xla", "xla-xor", "mesh")
+
+#: live BatchingCodecs, scraped (not owned) by the unified registry —
+#: the mesh data-plane families (ISSUE 8): launches prove coalesced
+#: traffic really lands on the (dp, frag) mesh, batch_stripes sizes it,
+#: and the origin label separates the serving path from shd heal
+_LIVE_BATCHERS = _metrics.REGISTRY.register_objects(
+    "gftpu_mesh_launches_total", "counter",
+    "pjit'd (dp, frag) mesh codec launches by owning codec, op, and "
+    "traffic origin (serve = BatchingCodec flushes from fops, heal = "
+    "shd re-encode)",
+    lambda c: [({"codec": c.name, "op": op, "origin": o}, v)
+               for (op, o), v in list(c.mesh_launches.items())])
+_metrics.REGISTRY.register_objects(
+    "gftpu_mesh_batch_stripes_total", "counter",
+    "stripes carried by mesh codec launches (post-bucket-padding) by "
+    "owning codec, op, and origin",
+    lambda c: [({"codec": c.name, "op": op, "origin": o}, v)
+               for (op, o), v in list(c.mesh_stripes.items())],
+    live=_LIVE_BATCHERS)
 
 # Shape buckets: power-of-two stripe counts with this floor.  Bounded
 # distinct shapes -> bounded jit compiles per (k, n) / (k, mask).
@@ -126,15 +160,19 @@ class BatchingCodec(Codec):
     def __init__(self, k: int, r: int, backend: str = "auto", *,
                  window: float = 0.0, min_batch: int = 256 * 1024,
                  max_batch_bytes: int = 256 << 20,
-                 systematic: bool = False):
+                 systematic: bool = False, mesh: bool = False,
+                 name: str = ""):
         super().__init__(k, r, backend, systematic=systematic)
+        # instance label on the mesh families: the owning layer's name
+        # (a distribute-over-disperse volume has one codec PER group —
+        # identical label sets would collide in the exposition)
+        self.name = name or f"{k}+{r}"
         self.window = window
         self.min_batch = min_batch
         self.max_batch_bytes = max_batch_bytes
-        self._enc_q: list[tuple[np.ndarray, asyncio.Future]] = []
+        self._enc_q: list[tuple] = []  # (data, fut, origin, trace_id)
         self._enc_task: asyncio.Task | None = None
-        self._dec_q: dict[tuple[int, ...],
-                          list[tuple[np.ndarray, asyncio.Future]]] = {}
+        self._dec_q: dict[tuple[int, ...], list[tuple]] = {}
         self._dec_task: asyncio.Task | None = None
         self._cpu = None  # lazy small-batch codec
         self.launches = 0
@@ -149,6 +187,30 @@ class BatchingCodec(Codec):
         self._dev = _PathModel()
         self._nat = _PathModel()
         self._cal_state = "idle"  # idle -> running -> done/failed
+        # mesh data plane (ISSUE 8, cluster.mesh-codec): when the key is
+        # on AND >1 device is visible, flushes at/above min_batch land
+        # in ONE pjit'd NamedSharding(Mesh(dp, frag)) launch.  The
+        # device-count probe can block 45 s on a wedged transport, so it
+        # warms OFF the event loop; until it answers "ready", flushes
+        # take the existing ladder unchanged.  No mesh systematic mode
+        # (same constraint as ops/codec): systematic volumes stay on
+        # their ladder even with the key on.
+        self.mesh_requested = mesh
+        self._mesh = None
+        self._mesh_state = "off"  # off -> warming -> ready/unavailable
+        self._mesh_stop = False   # close() retires a retrying warm loop
+        self.mesh_launches: dict[tuple[str, str], int] = {}
+        self.mesh_stripes: dict[tuple[str, str], int] = {}
+        if mesh and not systematic:
+            self._mesh_state = "warming"
+            # a dedicated daemon thread, NOT the flush pool: on a
+            # wedged transport the probe join holds its thread for the
+            # full 45 s deadline, and with calibration on the other
+            # pool worker that would queue production flushes behind
+            # it — exactly the stall the ladder fallback promises away
+            threading.Thread(target=self._mesh_warm, daemon=True,
+                             name=f"gftpu-mesh-warm-{k}+{r}").start()
+        _LIVE_BATCHERS.add(self)  # unified-registry scrape target
         # calibration is DEFERRED to an idle gap: the first device
         # encode pays jax imports + kernel compiles that monopolize the
         # GIL for seconds — run that while production flushes are
@@ -188,6 +250,116 @@ class BatchingCodec(Codec):
             else:
                 self._cpu = self  # already a CPU ladder backend
         return self._cpu
+
+    # -- mesh data plane ---------------------------------------------------
+
+    _MESH_WARM_RETRIES = 2
+
+    def _mesh_warm(self) -> None:
+        """Runs on its own daemon thread (NEVER the flush pool — see
+        the spawn site in __init__): deadline device probe, then build
+        (cache) the process mesh.  A single device parks the codec on
+        the existing ladder; a RETRYABLE 0 (probe timeout / transient
+        jax error, the window device_count caches for _COUNT_RETRY_S)
+        re-probes up to _MESH_WARM_RETRIES times after the window —
+        without this, a startup plugin-registration race would disable
+        the mesh for the codec's whole lifetime despite the probe's
+        own retry window."""
+        try:
+            from ..parallel import mesh_codec
+
+            for attempt in range(1 + self._MESH_WARM_RETRIES):
+                n = mesh_codec.device_count()
+                if n > 1:
+                    self._mesh = mesh_codec.default_mesh()
+                    self._mesh_state = "ready"
+                    return
+                if not (n == 0 and mesh_codec.device_count_transient()
+                        and attempt < self._MESH_WARM_RETRIES):
+                    break
+                wake = time.monotonic() + mesh_codec._COUNT_RETRY_S + 1.0
+                while time.monotonic() < wake and not self._mesh_stop:
+                    time.sleep(1.0)
+                if self._mesh_stop:  # codec replaced/closed: stand down
+                    break
+            self._mesh_state = "unavailable"
+        except Exception:
+            self._mesh_state = "unavailable"
+
+    async def ensure_mesh(self) -> bool:
+        """Await the mesh warm probe (tests/benches/dryrun — daemons
+        never wait); True when the mesh plane is routable."""
+        while self._mesh_state == "warming":
+            await asyncio.sleep(0.01)
+        return self._mesh_state == "ready"
+
+    def _mesh_launch(self, op: str, cat: np.ndarray, rows, batch):
+        """ONE pjit'd NamedSharding launch over the (dp, frag) mesh for
+        a whole coalesced flush (runs in the pool).  Pads to the stripe
+        bucket so the jit cache stays bounded (zero stripes encode to
+        zero fragments — sliced back off), records the launch on the
+        mesh counters, and opens a ``mesh-codec`` span joined to the
+        first queued fop's trace so slow-fop trees show the dispatch."""
+        from . import codec as codec_mod
+        from ..parallel import mesh_codec
+
+        origins = {o for _d, _f, o, _t in batch}
+        origin = origins.pop() if len(origins) == 1 else "mixed"
+        tid = next((t for _d, _f, _o, t in batch if t), None)
+        tok = _tracing.CURRENT.set((tid, 0)) \
+            if (_tracing.ENABLED and tid) else None
+        span = _tracing.enter("mesh-codec", op) if _tracing.ENABLED \
+            else None
+        t0 = time.perf_counter()
+        err = False
+        sb = 0
+        try:
+            if op == "encode":
+                s = cat.size // self.stripe_size
+                sb = _bucket_stripes(s)
+                if sb != s:
+                    cat = np.concatenate(
+                        [cat, np.zeros((sb - s) * self.stripe_size,
+                                       dtype=np.uint8)])
+                out = mesh_codec.sharded_encode(
+                    self.k, self.r, cat, self._mesh)
+                out = out[:, : s * self.fragment_chunk]
+            else:
+                w = cat.shape[1]
+                s = w // self.fragment_chunk
+                sb = _bucket_stripes(s)
+                if sb != s:
+                    cat = np.concatenate(
+                        [cat, np.zeros((cat.shape[0],
+                                        (sb - s) * self.fragment_chunk),
+                                       dtype=np.uint8)], axis=1)
+                if cat.size > codec_mod.MESH_RING_DECODE_BYTES:
+                    # the memory-bounded alternative: fragments stay
+                    # ring-sharded, an XOR accumulator ppermutes
+                    from ..parallel import ring_codec
+
+                    out = ring_codec.ring_decode(
+                        self.k, rows, cat, self._mesh)
+                else:
+                    out = mesh_codec.sharded_decode(
+                        self.k, rows, cat, self._mesh)
+                out = out[: w * self.k]
+            return out
+        except Exception:
+            err = True
+            raise
+        finally:
+            if span is not None:
+                _tracing.exit_span(span, time.perf_counter() - t0, err)
+            if tok is not None:
+                _tracing.CURRENT.reset(tok)
+            with self._lock:
+                self.launches += 1
+                key = (op, origin)
+                self.mesh_launches[key] = \
+                    self.mesh_launches.get(key, 0) + 1
+                self.mesh_stripes[key] = \
+                    self.mesh_stripes.get(key, 0) + sb
 
     # -- measured break-even routing --------------------------------------
 
@@ -266,25 +438,34 @@ class BatchingCodec(Codec):
                 return st == "done"
             await asyncio.sleep(0.01)
 
-    def _route(self, total: int) -> tuple[Codec, bool]:
-        """Pick the codec for a flush of ``total`` bytes -> (codec, device?)."""
+    def _route(self, total: int) -> tuple[Codec, str]:
+        """Pick the path for a flush of ``total`` bytes ->
+        ``(codec, kind)`` with kind in {"mesh", "device", "cpu"}.
+
+        The mesh tier outranks the calibrated single-device ladder when
+        the volume key armed it AND the warm probe saw >1 device AND
+        the flush clears min_batch (min_batch <= 0 pins the path for
+        tests) — below that, the pre-mesh ladder is untouched."""
+        if self._mesh_state == "ready" and \
+                (self.min_batch <= 0 or total >= self.min_batch):
+            return self, "mesh"
         small = self._small()
         if small is self:
-            return self, False  # CPU-ladder backend: nothing to route
+            return self, "cpu"  # CPU-ladder backend: nothing to route
         if self.min_batch <= 0:
-            return self, True  # routing disabled: force the device path
+            return self, "device"  # routing disabled: force the device
         if total < self.min_batch:
-            return small, False
+            return small, "cpu"
         with self._lock:
             st, dev, nat = self._cal_state, self._dev, self._nat
             if st != "done":
                 pass
             elif dev.predict(self._padded(total)) <= nat.predict(total):
-                return self, True
+                return self, "device"
             else:
-                return small, False
+                return small, "cpu"
         self._maybe_schedule_calibration()
-        return small, False
+        return small, "cpu"
 
     def _padded(self, total: int) -> int:
         return _bucket_stripes(total // self.stripe_size) * self.stripe_size
@@ -331,15 +512,20 @@ class BatchingCodec(Codec):
 
     # -- encode ------------------------------------------------------------
 
-    async def encode_async(self, data: np.ndarray) -> np.ndarray:
-        """Encode stripe-aligned bytes; coalesced with concurrent calls."""
+    async def encode_async(self, data: np.ndarray,
+                           origin: str = "serve") -> np.ndarray:
+        """Encode stripe-aligned bytes; coalesced with concurrent calls.
+
+        ``origin`` labels the traffic source on the mesh counters
+        ("serve" = fop data path, "heal" = shd re-encode) and rides the
+        queue so a flush can attribute its launch."""
         data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
         if data.size % self.stripe_size:
             raise ValueError("data length not a multiple of the stripe")
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._enc_q.append((data, fut))
-        if sum(d.size for d, _ in self._enc_q) >= self.max_batch_bytes:
+        self._enc_q.append((data, fut, origin, _tracing.current_id()))
+        if sum(d.size for d, *_ in self._enc_q) >= self.max_batch_bytes:
             self._flush_encodes()
         elif self._enc_task is None:
             self._enc_task = asyncio.ensure_future(self._enc_timer())
@@ -364,12 +550,12 @@ class BatchingCodec(Codec):
         self._last_flush = time.monotonic()
         self.batched_fops += len(batch)
         self.max_batch = max(self.max_batch, len(batch))
-        total = sum(d.size for d, _ in batch)
-        codec, device = self._route(total)
-        if not device and codec is not self:
+        total = sum(d.size for d, *_ in batch)
+        codec, kind = self._route(total)
+        if kind == "cpu" and codec is not self:
             self.cpu_launches += 1
         loop = asyncio.get_running_loop()
-        self._submit(self._run_encode, loop, batch, codec, device, total)
+        self._submit(self._run_encode, loop, batch, codec, kind, total)
 
     def _submit(self, fn, loop, *args) -> None:
         """Pool submit with an inline fallback: a batch still pending in
@@ -381,7 +567,7 @@ class BatchingCodec(Codec):
         except RuntimeError:  # pool shut down after close()
             fn(loop, *args)
 
-    def _run_encode(self, loop, batch, codec: Codec, device: bool,
+    def _run_encode(self, loop, batch, codec: Codec, kind: str,
                     total: int) -> None:
         """Executes in the pool: concatenate, launch, time, resolve."""
         try:
@@ -389,17 +575,24 @@ class BatchingCodec(Codec):
             if len(batch) == 1:
                 cat = batch[0][0]
             else:
-                cat = np.concatenate([d for d, _ in batch])
-            if device:
+                cat = np.concatenate([d for d, *_ in batch])
+            if kind == "mesh":
+                frags = self._mesh_launch("encode", cat, None, batch)
+            elif kind == "device":
                 frags = self._encode_bucketed(cat)
             else:
                 frags = codec.encode(cat)
-            # device samples observe the PADDED size — the launch did
-            # that much work, and _route predicts with padded bytes too
-            self._observe(device, self._padded(total) if device else total,
-                          time.perf_counter() - t0)
+            if kind != "mesh":
+                # device samples observe the PADDED size — the launch
+                # did that much work, and _route predicts padded too.
+                # Mesh launches are key-routed, not model-routed: their
+                # timings must not skew the single-device model.
+                self._observe(kind == "device",
+                              self._padded(total) if kind == "device"
+                              else total,
+                              time.perf_counter() - t0)
             results, off = [], 0
-            for d, _ in batch:
+            for d, *_ in batch:
                 flen = d.size // self.k
                 results.append(frags[:, off:off + flen].copy()
                                if len(batch) > 1 else frags)
@@ -410,7 +603,7 @@ class BatchingCodec(Codec):
 
     @staticmethod
     def _resolve(batch, results, err) -> None:
-        for i, (_, fut) in enumerate(batch):
+        for i, (_d, fut, *_rest) in enumerate(batch):
             if fut.done() or fut.cancelled():
                 continue
             if err is not None:
@@ -420,15 +613,16 @@ class BatchingCodec(Codec):
 
     # -- decode ------------------------------------------------------------
 
-    async def decode_async(self, frags: np.ndarray, rows) -> np.ndarray:
+    async def decode_async(self, frags: np.ndarray, rows,
+                           origin: str = "serve") -> np.ndarray:
         """Decode k fragments; coalesced with concurrent same-mask calls."""
         rows = tuple(int(x) for x in rows)
         frags = np.ascontiguousarray(frags, dtype=np.uint8)
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         q = self._dec_q.setdefault(rows, [])
-        q.append((frags, fut))
-        if sum(f.size for f, _ in q) >= self.max_batch_bytes:
+        q.append((frags, fut, origin, _tracing.current_id()))
+        if sum(f.size for f, *_ in q) >= self.max_batch_bytes:
             self._flush_decodes()  # same blow-up guard as the encode path
         elif self._dec_task is None:
             self._dec_task = asyncio.ensure_future(self._dec_timer())
@@ -450,29 +644,34 @@ class BatchingCodec(Codec):
         for rows, batch in queues.items():
             self.batched_fops += len(batch)
             self.max_batch = max(self.max_batch, len(batch))
-            total = sum(f.size for f, _ in batch)
-            codec, device = self._route(total)
-            if not device and codec is not self:
+            total = sum(f.size for f, *_ in batch)
+            codec, kind = self._route(total)
+            if kind == "cpu" and codec is not self:
                 self.cpu_launches += 1
             self._submit(self._run_decode, loop, rows, batch, codec,
-                         device, total)
+                         kind, total)
 
-    def _run_decode(self, loop, rows, batch, codec: Codec, device: bool,
+    def _run_decode(self, loop, rows, batch, codec: Codec, kind: str,
                     total: int) -> None:
         try:
             t0 = time.perf_counter()
             if len(batch) == 1:
                 cat = batch[0][0]
             else:
-                cat = np.concatenate([f for f, _ in batch], axis=1)
-            if device:
+                cat = np.concatenate([f for f, *_ in batch], axis=1)
+            if kind == "mesh":
+                out = self._mesh_launch("decode", cat, rows, batch)
+            elif kind == "device":
                 out = self._decode_bucketed(cat, rows)
             else:
                 out = codec.decode(cat, rows)
-            self._observe(device, self._padded(total) if device else total,
-                          time.perf_counter() - t0)
+            if kind != "mesh":
+                self._observe(kind == "device",
+                              self._padded(total) if kind == "device"
+                              else total,
+                              time.perf_counter() - t0)
             results, off = [], 0
-            for f, _ in batch:
+            for f, *_ in batch:
                 nbytes = f.shape[1] * self.k
                 results.append(out[off:off + nbytes].copy()
                                if len(batch) > 1 else out)
@@ -489,6 +688,7 @@ class BatchingCodec(Codec):
         if self._cal_timer is not None:
             self._cal_timer.cancel()
             self._cal_timer = None
+        self._mesh_stop = True  # a retrying warm loop stands down
         self._pool.shutdown(wait=False)
 
     def dump_stats(self) -> dict:
@@ -513,4 +713,12 @@ class BatchingCodec(Codec):
             "device_model": dev,
             "native_model": nat,
             "break_even_bytes": self.break_even_bytes(),
+            "mesh": {
+                "requested": self.mesh_requested,
+                "state": self._mesh_state,
+                "launches": {f"{op}:{o}": v for (op, o), v
+                             in self.mesh_launches.items()},
+                "stripes": {f"{op}:{o}": v for (op, o), v
+                            in self.mesh_stripes.items()},
+            },
         }

@@ -23,6 +23,12 @@ backend        implementation
                else native, else xla
 =============  =================================================
 
+Orthogonally to the backend, the ``cluster.mesh-codec`` volume key
+(op-version 10) arms a mesh TIER in ops/batch.BatchingCodec: coalesced
+stripe-cache flushes at/above ``stripe-cache-min-batch`` take the
+(dp, frag) sharded launch regardless of which ladder backend serves
+the small/fallback path — see docs/mesh_codec.md.
+
 All backends are byte-exact against ``ref`` (the ``ec-cpu-extensions.t``
 oracle, reproduced by tests/test_codec.py).  Decode work is cached per
 surviving-fragment mask exactly like the reference's LRU of inverted
@@ -133,6 +139,30 @@ def probe_with_deadline(fn, default, default_timeout_s: float = 45.0):
     if t.is_alive():
         return default, True
     return (box[0] if box else default), False
+
+
+def virtual_mesh_env(n_devices: int | None = None,
+                     env: dict | None = None) -> dict:
+    """A child-process environment pinned to the VIRTUAL CPU mesh:
+    CPU platform only, no pool address to dial (a wedged accelerator
+    transport must be unreachable from the child), and — when
+    ``n_devices`` is given — exactly that many forced host devices.
+    The one copy of the scrub rules every subprocess spawner shares
+    (bench, ``dryrun_multichip``): a rule added here (the
+    PALLAS_AXON_POOL_IPS lesson) reaches them all."""
+    import os
+
+    out = dict(os.environ if env is None else env)
+    out.pop("PALLAS_AXON_POOL_IPS", None)
+    out["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(
+        f for f in out.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    if n_devices is not None:
+        flags = (f"{flags} "
+                 f"--xla_force_host_platform_device_count={n_devices}")
+    out["XLA_FLAGS"] = flags.strip()
+    return out
 
 
 def _tpu_present() -> bool:

@@ -21,12 +21,15 @@ Everything is jit + NamedSharding; no data-dependent control flow.
 from __future__ import annotations
 
 import functools
+import threading as _threading
+import time as _time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import metrics as _metrics
 from ..ops import gf256
 
 
@@ -38,6 +41,109 @@ def make_mesh(devices=None) -> Mesh:
     frag = 2 if n % 2 == 0 and n > 1 else 1
     dp = n // frag
     return Mesh(np.asarray(devices).reshape(dp, frag), ("dp", "frag"))
+
+
+# -- wedge-safe device discovery + the process mesh ---------------------
+#
+# The serving path (ops/batch.BatchingCodec's mesh backend) must decide
+# per flush whether a multi-device mesh exists — but asking jax for
+# devices can hang forever on a wedged accelerator transport (the
+# pool-tunnel failure that cost MULTICHIP_r05 its record).  So device
+# discovery here is the same deadline-probe shape as ops/codec:
+#
+# * ``device_count()`` probes ONCE on an abandonable daemon thread and
+#   caches a clean answer for the process lifetime (a timeout caches a
+#   wedged 0 for _COUNT_RETRY_S, like codec._tpu_present);
+# * ``device_count_cached()`` never blocks: it reports the cached
+#   answer or 0-unprobed — the event-loop-side routing check
+#   (BatchingCodec._route) uses ONLY this, so an unprobed or wedged
+#   transport routes flushes down the existing ladder instead of
+#   stalling fops behind a 45 s join.
+
+_count_state: list = []  # [(expires_monotonic|None, count)]
+_COUNT_RETRY_S = 300.0
+
+
+def device_count(default_timeout_s: float = 45.0) -> int:
+    """Count ALL jax devices behind a deadline probe; cached."""
+    if _count_state:
+        expires, n = _count_state[0]
+        if expires is None or _time.monotonic() < expires:
+            return n
+    from ..ops.codec import probe_with_deadline
+
+    def count() -> int:
+        return len(jax.devices())
+
+    # default -1 separates "fn raised" from a real 0-device answer:
+    # both a timeout AND a transient error (plugin registration race at
+    # startup) cache 0 only for _COUNT_RETRY_S — a clean answer caches
+    # for the process lifetime
+    n, timed_out = probe_with_deadline(count, -1, default_timeout_s)
+    if timed_out or n < 0:
+        _count_state[:] = [(_time.monotonic() + _COUNT_RETRY_S, 0)]
+        return 0
+    _count_state[:] = [(None, int(n))]
+    return _count_state[0][1]
+
+
+def device_count_cached() -> int:
+    """The cached device count, 0 if never (successfully) probed.
+    Never touches jax — safe on the event loop."""
+    if _count_state:
+        expires, n = _count_state[0]
+        if expires is None or _time.monotonic() < expires:
+            return n
+    return 0
+
+
+def device_count_transient() -> bool:
+    """True while the cached answer is a RETRYABLE 0 (timeout or
+    transient error, expiring after _COUNT_RETRY_S) rather than a clean
+    for-the-process-lifetime count — warm loops key their retry on
+    this."""
+    return bool(_count_state) and _count_state[0][0] is not None
+
+
+_process_mesh: list = []  # [Mesh] once built
+
+
+def default_mesh() -> Mesh:
+    """The process-wide (dp, frag) mesh over every visible device.
+
+    Only call after ``device_count()`` answered cleanly (jax is then
+    already initialized, so ``jax.devices()`` cannot block on backend
+    init) — the BatchingCodec orders its calls exactly that way."""
+    if not _process_mesh:
+        _process_mesh.append(make_mesh())
+    return _process_mesh[0]
+
+
+def _mesh_device_samples():
+    """gftpu_mesh_devices scrape: cached state only — a registry scrape
+    must never trigger a jax probe."""
+    if _process_mesh:
+        dp, frag = _process_mesh[0].devices.shape
+        return [({"axis": "total"}, dp * frag), ({"axis": "dp"}, dp),
+                ({"axis": "frag"}, frag)]
+    return [({"axis": "total"}, device_count_cached())]
+
+
+_metrics.REGISTRY.register(
+    "gftpu_mesh_devices", "gauge",
+    "devices in the (dp, frag) codec mesh (total/dp/frag; total only "
+    "until the mesh is built)", _mesh_device_samples)
+
+# Serializes the jitted mesh-program CALLS, not just their
+# construction: jax.jit is LAZY — the real trace + compile happens at
+# the first call (and again per new input shape), so a lock released
+# before ``fn(...)`` would still let the BatchingCodec's two flush
+# workers race an encode and a decode first-compile (observed once as
+# a pybind11 instance-allocation failure under e2e load).  Holding the
+# lock across the call costs little: the backend serializes on-device
+# execution anyway, and shape bucketing (ops/batch) bounds how often a
+# call is a compile at all.
+_BUILD_LOCK = _threading.Lock()
 
 
 def _unpack_bits(x: jnp.ndarray) -> jnp.ndarray:
@@ -102,8 +208,9 @@ def run_step(k: int, r: int, batch: np.ndarray, mesh: Mesh | None = None):
     """Convenience wrapper: shard, run, return (frags, mismatches)."""
     if mesh is None:
         mesh = make_mesh()
-    fn = sharded_step_fn(k, r, mesh)
-    frags, mism = fn(jnp.asarray(batch))
+    with _BUILD_LOCK:
+        fn = sharded_step_fn(k, r, mesh)
+        frags, mism = fn(jnp.asarray(batch))
     return frags, int(mism)
 
 
@@ -136,7 +243,9 @@ def sharded_encode(k: int, r: int, data: np.ndarray,
     if pad:
         x = np.concatenate(
             [x, np.zeros((pad, *x.shape[1:]), dtype=np.uint8)], axis=0)
-    y = np.asarray(_encode_fn(k, n, mesh)(jnp.asarray(x)))  # (n*8, S', 64)
+    with _BUILD_LOCK:
+        y = np.asarray(_encode_fn(k, n, mesh)(jnp.asarray(x)))
+    # y: (n*8, S', 64)
     y = y[:, :s, :]
     # plane-major -> wire fragment-major (n, S*512): fragment f's chunk
     # for stripe s' interleaves its 8 planes (same transform as the
@@ -180,5 +289,6 @@ def sharded_decode(
     if pad:
         x = np.concatenate(
             [x, np.zeros((pad, *x.shape[1:]), dtype=np.uint8)], axis=0)
-    y = _decode_fn(k, rows, mesh)(jnp.asarray(x))
+    with _BUILD_LOCK:
+        y = _decode_fn(k, rows, mesh)(jnp.asarray(x))
     return np.asarray(y)[:s].reshape(s * k * gf256.CHUNK_SIZE)

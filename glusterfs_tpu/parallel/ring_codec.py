@@ -21,6 +21,14 @@ batch can exceed any single device's memory by the ring length — the
 long-sequence scaling story.  Comm volume is (p-1)/p of the output,
 pipelined with compute over ICI (reference analog: the fan-in of
 ``ec_dispatch_min`` network reads, ec-common.c:816-900, but streamed).
+
+Role in the data plane: this is the memory-bounded ALTERNATIVE to
+``mesh_codec.sharded_decode`` — ``ops/codec`` and the BatchingCodec's
+mesh tier route decodes past ``MESH_RING_DECODE_BYTES`` through
+:func:`ring_decode`; below the threshold the plain all-gather plane
+wins (one collective, no p-step pipeline).  Exported via
+``glusterfs_tpu.parallel``; the routing is pinned by
+tests/test_mesh_plane.py::test_ring_codec_is_the_large_decode_alternative.
 """
 
 from __future__ import annotations
@@ -128,6 +136,7 @@ def ring_decode(k: int, rows, frags: np.ndarray,
         mesh = mesh_codec.make_mesh()
     rows = tuple(int(x) for x in rows)
     x = gf256.frags_to_planes(frags, k)    # (S, k*8, 64)
+
     s = x.shape[0]
     p = mesh.devices.shape[mesh.axis_names.index("frag")]
     dp = mesh.devices.shape[mesh.axis_names.index("dp")]
@@ -136,6 +145,7 @@ def ring_decode(k: int, rows, frags: np.ndarray,
         x = np.concatenate(
             [x, np.zeros((pad, *x.shape[1:]), dtype=np.uint8)], axis=0)
     planes = np.ascontiguousarray(np.transpose(x, (1, 0, 2)))
-    out = _ring_decode_fn(k, rows, mesh)(jnp.asarray(planes))
+    with mesh_codec._BUILD_LOCK:  # jit is lazy: lock spans the call
+        out = _ring_decode_fn(k, rows, mesh)(jnp.asarray(planes))
     out = np.asarray(out)[:s]              # (S, k*8, 64)
     return out.reshape(s * k * gf256.CHUNK_SIZE)

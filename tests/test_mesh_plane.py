@@ -1,0 +1,381 @@
+"""The mesh-sharded codec data plane (ISSUE 8): serving and heal
+traffic over the (dp, frag) device mesh.
+
+test_mesh_codec.py proves the raw sharded kernels; this file proves the
+PLANE — that real traffic reaches them: BatchingCodec routing (mesh
+picked iff multi-device AND the ``cluster.mesh-codec`` key is on, with
+the min-batch fallback intact), byte parity against the NumPy oracle
+across geometries, sharding asserted from the compiled lowering, shd
+heal launches on the heal-origin counter, live ``volume set
+cluster.mesh-codec``, and the registry families.  Everything runs on
+the 8-device virtual CPU mesh the conftest provisions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from glusterfs_tpu.ops import gf256
+from glusterfs_tpu.ops.batch import BatchingCodec
+from glusterfs_tpu.parallel import mesh_codec
+
+
+def _rand(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _probe():
+    # warm the wedge-safe device-count cache so BatchingCodec mesh
+    # warms synchronously-fast in every test
+    assert mesh_codec.device_count() == 8
+    yield
+
+
+def _mesh_batcher(k, r, **kw):
+    kw.setdefault("backend", "ref")
+    kw.setdefault("min_batch", 0)
+    kw.setdefault("window", 0.005)
+    return BatchingCodec(k, r, kw.pop("backend"), mesh=kw.pop("mesh", True),
+                         **kw)
+
+
+# -- parity: the mesh plane vs the oracle, across geometries -----------
+
+
+@pytest.mark.parametrize("k,r", [(4, 2), (8, 3), (16, 4)])
+def test_mesh_plane_parity_vs_oracle(k, r):
+    """Coalesced mesh encode AND decode are byte-exact against the
+    oracle at the 4+2 / 8+3 / 16+4 geometries."""
+    n = k + r
+    stripe = k * gf256.CHUNK_SIZE
+    codec = _mesh_batcher(k, r)
+
+    async def run():
+        assert await codec.ensure_mesh()
+        datas = [_rand(stripe * (i + 1), 31 * k + i) for i in range(5)]
+        outs = await asyncio.gather(
+            *(codec.encode_async(d) for d in datas))
+        for d, o in zip(datas, outs):
+            np.testing.assert_array_equal(o, gf256.ref_encode(d, k, n))
+        # degraded decode: first r fragments lost (worst case)
+        rows = tuple(range(r, n))
+        frs = [gf256.ref_encode(d, k, n) for d in datas]
+        outs = await asyncio.gather(
+            *(codec.decode_async(f[np.asarray(rows)], rows) for f in frs))
+        for d, o in zip(datas, outs):
+            np.testing.assert_array_equal(o, d)
+
+    asyncio.run(run())
+    enc = codec.mesh_launches.get(("encode", "serve"), 0)
+    dec = codec.mesh_launches.get(("decode", "serve"), 0)
+    assert enc >= 1 and dec >= 1, codec.mesh_launches
+    codec.close()
+
+
+def test_mesh_coalesces_concurrent_fops_into_one_launch():
+    codec = _mesh_batcher(4, 2)
+
+    async def run():
+        assert await codec.ensure_mesh()
+        datas = [_rand(4 * 512 * (i + 1), i) for i in range(8)]
+        await asyncio.gather(*(codec.encode_async(d) for d in datas))
+
+    asyncio.run(run())
+    assert codec.mesh_launches[("encode", "serve")] == 1, \
+        "8 concurrent encodes must share ONE mesh launch"
+    assert codec.max_batch == 8
+    codec.close()
+
+
+# -- sharding asserted from the compiled lowering ----------------------
+
+
+def test_frag_axis_partitions_fragment_dim_in_lowering():
+    """The compiled encode really lays fragments over ``frag`` and
+    stripes over ``dp`` — asserted from the lowering's output sharding
+    and the per-device shard shapes, not from wrapper bookkeeping."""
+    k, r = 4, 2
+    n = k + r
+    mesh = mesh_codec.default_mesh()
+    dp, frag = mesh.devices.shape
+    fn = mesh_codec._encode_fn(k, n, mesh)
+    x = jax.ShapeDtypeStruct((dp * 2, k * 8, 64), jnp.uint8)
+    compiled = fn.lower(x).compile()
+    out_sh = compiled.output_shardings
+    assert out_sh.spec == P("frag", "dp", None), out_sh
+    # and at run time each device holds fragment-dim slice n*8/frag
+    batch = _rand(dp * 2 * k * 8 * 64, 5).reshape(dp * 2, k * 8, 64)
+    out = fn(jnp.asarray(batch))
+    shapes = {sh.data.shape for sh in out.addressable_shards}
+    assert shapes == {(n * 8 // frag, dp * 2 // dp, 64)}, shapes
+
+
+# -- routing: mesh iff multi-device AND key on, min-batch fallback -----
+
+
+def test_mesh_not_picked_without_the_key():
+    codec = _mesh_batcher(4, 2, mesh=False)
+    assert codec._mesh_state == "off"
+
+    async def run():
+        out = await codec.encode_async(_rand(4 * 512, 1))
+        np.testing.assert_array_equal(
+            out, gf256.ref_encode(_rand(4 * 512, 1), 4, 6))
+
+    asyncio.run(run())
+    assert not codec.mesh_launches
+    codec.close()
+
+
+def test_mesh_not_picked_on_single_device(monkeypatch):
+    monkeypatch.setattr(mesh_codec, "device_count", lambda *a: 1)
+    codec = _mesh_batcher(4, 2)
+
+    async def run():
+        assert not await codec.ensure_mesh()
+        await codec.encode_async(_rand(4 * 512, 2))
+
+    asyncio.run(run())
+    assert codec._mesh_state == "unavailable"
+    assert not codec.mesh_launches
+    codec.close()
+
+
+def test_min_batch_fallback_keeps_ladder_untouched():
+    """Below stripe-cache-min-batch the flush takes the pre-mesh ladder
+    (here: the CPU oracle) even with the key armed and the mesh ready."""
+    codec = _mesh_batcher(4, 2, min_batch=1 << 20)
+
+    async def run():
+        assert await codec.ensure_mesh()
+        d = _rand(4 * 512 * 4, 3)  # 8 KiB << 1 MiB min-batch
+        out = await codec.encode_async(d)
+        np.testing.assert_array_equal(out, gf256.ref_encode(d, 4, 6))
+        # and a flush AT the floor goes to the mesh
+        big = _rand(1 << 20, 4)
+        out = await codec.encode_async(big)
+        np.testing.assert_array_equal(out, gf256.ref_encode(big, 4, 6))
+
+    asyncio.run(run())
+    assert codec.mesh_launches.get(("encode", "serve")) == 1
+    codec.close()
+
+
+def test_systematic_volume_never_takes_the_mesh():
+    codec = BatchingCodec(4, 2, "ref", mesh=True, min_batch=0,
+                          systematic=True)
+    assert codec._mesh_state == "off"
+    codec.close()
+
+
+def test_ring_codec_is_the_large_decode_alternative(monkeypatch):
+    """parallel.ring_decode is the documented memory-bounded alternative:
+    mesh-tier decodes past MESH_RING_DECODE_BYTES ride the ppermute
+    ring instead of the all-gather plane (the parallel/__init__ role
+    pointer)."""
+    import glusterfs_tpu.parallel as parallel
+    from glusterfs_tpu.ops import codec as codec_mod
+    from glusterfs_tpu.parallel import ring_codec
+
+    assert "ring_decode" in parallel.__all__
+    called = {}
+    orig = ring_codec.ring_decode
+
+    def spy(k, rows, frags, mesh=None):
+        called["ring"] = True
+        return orig(k, rows, frags, mesh)
+
+    monkeypatch.setattr(ring_codec, "ring_decode", spy)
+    monkeypatch.setattr(codec_mod, "MESH_RING_DECODE_BYTES", 16 * 1024)
+    codec = _mesh_batcher(4, 2)
+    d = _rand(4 * 512 * 16, 6)
+    frs = gf256.ref_encode(d, 4, 6)
+    rows = (0, 2, 3, 5)
+
+    async def run():
+        assert await codec.ensure_mesh()
+        return await codec.decode_async(frs[np.asarray(rows)], rows)
+
+    out = asyncio.run(run())
+    np.testing.assert_array_equal(out, d)
+    assert called.get("ring"), "large mesh decode did not take the ring"
+    assert codec.mesh_launches.get(("decode", "serve")) == 1
+    codec.close()
+
+
+# -- observability: families + the per-launch span ---------------------
+
+
+def test_registry_families_and_span():
+    from glusterfs_tpu.core import tracing
+    from glusterfs_tpu.core.metrics import REGISTRY
+
+    codec = _mesh_batcher(4, 2)
+    tid = "feedc0de" * 2
+
+    async def run():
+        assert await codec.ensure_mesh()
+        tracing.arm(tid)  # the flush joins the arming fop's trace
+        await codec.encode_async(_rand(4 * 512 * 2, 7))
+
+    asyncio.run(run())
+    snap = REGISTRY.snapshot()
+    for fam in ("gftpu_mesh_launches_total",
+                "gftpu_mesh_batch_stripes_total", "gftpu_mesh_devices"):
+        assert fam in snap, fam
+    serve = [s for s in snap["gftpu_mesh_launches_total"]["samples"]
+             if s[0].get("op") == "encode"
+             and s[0].get("origin") == "serve"]
+    assert serve and serve[0][1] >= 1, serve
+    assert all("codec" in s[0] for s in serve), \
+        "instance label missing (duplicate series across codecs)"
+    axes = {s[0]["axis"]: s[1]
+            for s in snap["gftpu_mesh_devices"]["samples"]}
+    assert axes["total"] == 8 and axes["dp"] * axes["frag"] == 8, axes
+    spans = [s for s in tracing.spans_for(tid) if s[2] == "mesh-codec"]
+    assert spans and spans[0][3] == "encode", \
+        "mesh dispatch missing from the fop's span tree"
+    codec.close()
+
+
+# -- the served planes: EC serving path and shd heal -------------------
+
+
+def _ec_graph(tmp_path, options=None):
+    from glusterfs_tpu.api.glfs import Client
+    from glusterfs_tpu.core.graph import Graph
+    from glusterfs_tpu.utils.volspec import ec_volfile
+
+    opts = {"cpu-extensions": "ref", "stripe-cache": "on",
+            "stripe-cache-min-batch": 0, "mesh-codec": "on"}
+    opts.update(options or {})
+    g = Graph.construct(ec_volfile(tmp_path, 6, 2, options=opts))
+    return Client(g), g.top
+
+
+def test_serving_path_launches_on_mesh(tmp_path):
+    c, ec = _ec_graph(tmp_path)
+
+    async def run():
+        await c.mount()
+        assert ec.codec.mesh_requested
+        assert await ec.codec.ensure_mesh()
+        payloads = {f"/s{i}": _rand(32768, 40 + i).tobytes()
+                    for i in range(4)}
+        await asyncio.gather(*(c.write_file(p, b)
+                               for p, b in payloads.items()))
+        for p, b in payloads.items():
+            assert await c.read_file(p) == b
+        await c.unmount()
+
+    asyncio.run(run())
+    assert sum(v for (op, o), v in ec.codec.mesh_launches.items()
+               if o == "serve") > 0, ec.codec.mesh_launches
+
+
+def test_shd_heal_launches_on_mesh_counter(tmp_path):
+    """The heal half of the data plane: a degraded write + shd
+    full-crawl re-encode lands on the mesh under origin=heal, and the
+    healed fragments serve a degraded read."""
+    from glusterfs_tpu.mgmt import shd as shd_mod
+
+    c, ec = _ec_graph(tmp_path)
+
+    async def run():
+        await c.mount()
+        assert await ec.codec.ensure_mesh()
+        payloads = {f"/h{i}": _rand(3 * 2048, 50 + i).tobytes()
+                    for i in range(3)}
+        await asyncio.gather(*(c.write_file(p, b)
+                               for p, b in payloads.items()))
+        ec.set_child_up(1, False)
+        await asyncio.gather(*(c.write_file(p, b[::-1])
+                               for p, b in payloads.items()))
+        ec.set_child_up(1, True)
+        report = await shd_mod.full_crawl(c, max_heals=4)
+        assert not report["failed"], report["failed"]
+        heal = sum(v for (op, o), v in ec.codec.mesh_launches.items()
+                   if o == "heal")
+        assert heal > 0, ec.codec.mesh_launches
+        ec.set_child_up(0, False)  # healed brick must carry the read
+        for p, b in payloads.items():
+            assert await c.read_file(p) == b[::-1]
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_live_reconfigure_swaps_codec_mesh(tmp_path):
+    """Toggling mesh-codec live rebuilds the BatchingCodec with the
+    mesh tier armed (and back off), like every other codec key."""
+    c, ec = _ec_graph(tmp_path, {"mesh-codec": "off"})
+    # reconfigure carries the FULL option set (a volgen-regenerated
+    # volfile's semantics): unnamed keys revert to their defaults
+    base = {"cpu-extensions": "ref", "stripe-cache": "on",
+            "stripe-cache-min-batch": 0, "redundancy": 2}
+
+    async def run():
+        await c.mount()
+        assert not ec.codec.mesh_requested
+        ec.reconfigure({**base, "mesh-codec": "on"})
+        assert ec.codec.mesh_requested
+        assert await ec.codec.ensure_mesh()
+        d = _rand(32768, 60).tobytes()
+        await c.write_file("/r", d)
+        assert await c.read_file("/r") == d
+        assert sum(v for (op, o), v in ec.codec.mesh_launches.items()
+                   if o == "serve") > 0
+        ec.reconfigure({**base, "mesh-codec": "off"})
+        assert not ec.codec.mesh_requested
+        await c.write_file("/r2", d)
+        assert await c.read_file("/r2") == d
+        assert not ec.codec.mesh_launches  # fresh codec, ladder only
+        await c.unmount()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_managed_volume_set_mesh_codec(tmp_path):
+    """`volume set cluster.mesh-codec on` through glusterd: op-version
+    10 gating passes, the generated client graph arms the mesh tier."""
+    from glusterfs_tpu.core.layer import walk
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as mc:
+                await mc.call(
+                    "volume-create", name="mv", vtype="disperse",
+                    redundancy=2,
+                    bricks=[{"path": str(tmp_path / f"b{i}")}
+                            for i in range(6)])
+                await mc.call("volume-start", name="mv")
+                await mc.call("volume-set", name="mv",
+                              key="cluster.mesh-codec", value="on")
+                info = await mc.call("volume-info", name="mv")
+                assert info["mv"]["options"]["cluster.mesh-codec"] == "on"
+            cl = await mount_volume(d.host, d.port, "mv")
+            try:
+                ec = next(l for l in walk(cl.graph.top)
+                          if l.type_name == "cluster/disperse")
+                assert ec.opts["mesh-codec"] is True
+                assert ec.codec.mesh_requested
+                await cl.write_file("/x", b"y" * 8192)
+                assert await cl.read_file("/x") == b"y" * 8192
+            finally:
+                await cl.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())

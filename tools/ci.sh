@@ -31,6 +31,13 @@
 #                               present and moving, and the managed
 #                               op-version-9 volume-set path applies
 #                               the key to a live brick (ISSUE 7)
+#   6. mesh smoke               the mesh-codec data plane under 8
+#                               forced host devices: the parity +
+#                               routing tests of test_mesh_plane.py,
+#                               then a batched encode through a
+#                               mesh-armed BatchingCodec asserting the
+#                               gftpu_mesh_launches_total family
+#                               appears with origin=serve (ISSUE 8)
 #
 # Usage:  tools/ci.sh [extra pytest args for the tier-1 runs...]
 # Exit: first failing stage's code; 0 = mergeable.
@@ -442,10 +449,63 @@ if [ $evt_rc -ne 0 ]; then
     exit $evt_rc
 fi
 
+echo "== ci: mesh smoke (parity + routing on 8 forced host devices,"
+echo "       gftpu_mesh_launches_total after a batched encode) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_mesh_plane.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+mesh_rc=$?
+if [ $mesh_rc -ne 0 ]; then
+    echo "ci: mesh parity/routing tests failed — not mergeable"
+    exit $mesh_rc
+fi
+timeout -k 10 180 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'EOF'
+import asyncio, numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from glusterfs_tpu.core.metrics import REGISTRY
+from glusterfs_tpu.ops import gf256
+from glusterfs_tpu.ops.batch import BatchingCodec
+
+async def main():
+    codec = BatchingCodec(4, 2, "ref", mesh=True, min_batch=0,
+                          window=0.005)
+    assert await codec.ensure_mesh(), codec._mesh_state
+    datas = [np.random.default_rng(i).integers(0, 256, 4 * 512 * 4,
+                                               dtype=np.uint8)
+             for i in range(6)]
+    outs = await asyncio.gather(*(codec.encode_async(d) for d in datas))
+    for d, o in zip(datas, outs):
+        assert np.array_equal(o, gf256.ref_encode(d, 4, 6)), "parity"
+    snap = REGISTRY.snapshot()
+    fam = snap.get("gftpu_mesh_launches_total")
+    assert fam, "gftpu_mesh_launches_total family missing"
+    serve = [s for s in fam["samples"]
+             if s[0].get("op") == "encode"
+             and s[0].get("origin") == "serve"]
+    assert serve and serve[0][1] >= 1, fam["samples"]
+    assert codec.max_batch == 6, codec.max_batch
+    devs = {s[0]["axis"]: s[1]
+            for s in snap["gftpu_mesh_devices"]["samples"]}
+    assert devs.get("total") == 8, devs
+    codec.close()
+    print("mesh smoke: 6 concurrent encodes coalesced onto the "
+          "(dp, frag) mesh, launches family present, parity held")
+
+asyncio.run(main())
+EOF
+mesh_rc=$?
+if [ $mesh_rc -ne 0 ]; then
+    echo "ci: mesh smoke failed — not mergeable"
+    exit $mesh_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
 fi
 echo "ci: mergeable (two identical green tier-1 runs + bench contract"
-echo "    + metrics smoke + gateway smoke + concurrency smoke)"
+echo "    + metrics smoke + gateway smoke + concurrency smoke"
+echo "    + mesh smoke)"
 exit 0
