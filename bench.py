@@ -687,6 +687,98 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
     return out
 
 
+def degraded_bench(n_clients: int = 6, file_mib: int = 1) -> dict:
+    """Degraded-serving rows (ISSUE 9): a managed disperse 4+2 volume
+    over six real brick subprocesses, measured through the wire — the
+    healthy write/read pair first, then ONE brick SIGKILLed and the
+    same workload degraded (writes at 5/6 >= quorum, reads decoding
+    around the dead fragment, parity asserted byte-for-byte).  The
+    degraded-vs-healthy pair is the failure-containment plane's
+    serving-cost row; callers record an explicit skipped row when the
+    host can't hold the managed stack."""
+    import asyncio
+    import os
+    import shutil
+    import signal
+    import tempfile
+
+    from glusterfs_tpu.core.layer import walk
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    base = tempfile.mkdtemp(prefix="degraded")
+    payload = np.random.default_rng(9).integers(
+        0, 256, file_mib * MIB, dtype=np.uint8).tobytes()
+    out: dict = {}
+
+    async def run():
+        d = Glusterd(os.path.join(base, "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="dg", vtype="disperse",
+                             bricks=[{"path": os.path.join(base, f"b{i}")}
+                                     for i in range(N)],
+                             redundancy=R)
+                await c.call("volume-start", name="dg")
+            cl = await mount_volume(d.host, d.port, "dg")
+            try:
+                for layer in walk(cl.graph.top):
+                    cal = getattr(getattr(layer, "codec", None),
+                                  "ensure_calibrated", None)
+                    if cal is not None:
+                        await cal()
+                await cl.write_file("/warm", payload)
+                await cl.read_file("/warm")
+                total = n_clients * file_mib
+
+                async def wpass(tag):
+                    t0 = time.perf_counter()
+                    await asyncio.gather(*(
+                        cl.write_file(f"/{tag}{i}", payload)
+                        for i in range(n_clients)))
+                    return total / (time.perf_counter() - t0)
+
+                async def rpass(tag):
+                    t0 = time.perf_counter()
+                    datas = await asyncio.gather(*(
+                        cl.read_file(f"/{tag}{i}")
+                        for i in range(n_clients)))
+                    dt = time.perf_counter() - t0
+                    assert all(bytes(x) == payload for x in datas), \
+                        f"{tag} read parity"
+                    return total / dt
+
+                # two file sets written healthy: "h" is the healthy
+                # read pass, "g" stays UNREAD until the brick is dead —
+                # re-reading "h" degraded would measure the client's
+                # io-cache, not the degraded decode path
+                await wpass("g")
+                out["degraded_healthy_write_MiB_s"] = round(
+                    await wpass("h"), 1)
+                out["degraded_healthy_read_MiB_s"] = round(
+                    await rpass("h"), 1)
+                # SIGKILL one brick: the degraded pair measures the
+                # SAME workload at 5/6 (reads decode around the dead
+                # fragment; parity stays asserted)
+                proc = d.bricks.pop("dg-brick-1")
+                d.ports.pop("dg-brick-1", None)
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.wait()
+                out["degraded_write_MiB_s"] = round(await wpass("d"), 1)
+                out["degraded_read_MiB_s"] = round(await rpass("g"), 1)
+            finally:
+                await cl.unmount()
+        finally:
+            await d.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 #: Geometries on the sweep record (BASELINE.md 8+3 / 8+4 / 16+4 plus the
 #: 4+2 headline config, so decode-vs-encode is comparable per geometry).
 SWEEP_GEOMETRIES = ((4, 2), (8, 3), (8, 4), (16, 4))
@@ -1458,6 +1550,13 @@ def main() -> None:
                 vol.setdefault(f"gateway_{_op}_c{_n}_MiB_s",
                                f"skipped: {str(e)[:150]}")
     try:
+        # degraded-serving pair (ISSUE 9): 4+2 with one brick
+        # SIGKILLed, recorded beside its own healthy pair from the
+        # same managed stack — parity asserted inside the bench
+        vol.update(degraded_bench())
+    except Exception as e:
+        vol["degraded_bench_error"] = str(e)[:200]
+    try:
         # metrics-off wire pass (ISSUE 4): same pipeline config as the
         # primary run but with histograms + trace spans darkened on
         # both ends — the pair proves the accounting overhead is
@@ -1490,6 +1589,9 @@ def main() -> None:
                 "metrics_off_wire_read_MiB_s",
                 "wire_readv_p50_ms", "wire_readv_p99_ms",
                 "wire_writev_p50_ms", "wire_writev_p99_ms",
+                "degraded_read_MiB_s", "degraded_write_MiB_s",
+                "degraded_healthy_read_MiB_s",
+                "degraded_healthy_write_MiB_s",
                 "smallfile_wire_create_compound_per_s",
                 "smallfile_wire_create_singles_per_s",
                 "smallfile_wire_rpc_per_create_compound",
@@ -1505,6 +1607,8 @@ def main() -> None:
                 mode = "compound" if "compound" in row else "singles"
                 reason = vol.get(f"smallfile_wire_{mode}_error") \
                     or vol.get("smallfile_wire_bench_error")
+            elif row.startswith("degraded"):
+                reason = vol.get("degraded_bench_error")
             elif row.startswith("nocompound"):
                 reason = vol.get("nocompound_wire_bench_error")
             elif row.startswith("metrics_off"):

@@ -658,6 +658,15 @@ class DisperseLayer(Layer):
         # the gfid lock we hold; removal is lock-free so they can drain.
         await self._quiesce_writes(st)
         self._eager.pop(gfid, None)
+        # commit gfid-addressed, NOT by the window-open path: a rename
+        # while the post-op was deferred makes that path a lie, and the
+        # per-child ENOENTs would silently strand the size/version
+        # commit (the file then reads as empty forever — the chaos
+        # harness caught exactly this through the gateway's temp+rename
+        # PUT).  The reference never has this problem because its
+        # xattrop addresses the inode; gfid is our inode identity.
+        if gfid:
+            loc = Loc("", gfid=gfid)
         unlocked: set[int] = set()
         try:
             post: dict = {}

@@ -92,11 +92,18 @@ WRITE_FOPS = frozenset({
 
 class FopError(OSError):
     """A fop failure carrying a POSIX errno (the reference's op_errno;
-    unwinding with op_ret=-1 maps to raising this)."""
+    unwinding with op_ret=-1 maps to raising this).
 
-    def __init__(self, err: int, msg: str = ""):
+    ``xdata`` is the error-path reply dict (the reference unwinds
+    op_errno WITH an xdata dict — e.g. the lock-revocation notice of
+    features/locks rides the EAGAIN it causes).  Optional: most errors
+    carry none, and both wire codecs keep the two-field shape for
+    those."""
+
+    def __init__(self, err: int, msg: str = "", xdata: dict | None = None):
         super().__init__(err, msg or _errno.errorcode.get(err, str(err)))
         self.err = err
+        self.xdata = xdata
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"FopError({_errno.errorcode.get(self.err, self.err)})"

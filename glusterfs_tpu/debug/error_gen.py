@@ -20,6 +20,13 @@ class ErrorGenLayer(Layer):
     OPTIONS = (
         Option("failure", "percent", default=0.0, min=0, max=100,
                description="probability (%) of injecting a failure"),
+        Option("failure-count", "int", default=0, min=0,
+               description="DETERMINISTIC mode: fail exactly the first "
+                           "N matching fops, then pass (chaos scenarios "
+                           "assert exact outcomes instead of tuning "
+                           "probability + seed).  Re-arms on "
+                           "reconfigure; overrides `failure` while the "
+                           "budget lasts"),
         Option("error-no", "enum", default="EIO",
                values=tuple(_ERRNO), description="errno to inject"),
         Option("enable", "str", default="",
@@ -30,6 +37,7 @@ class ErrorGenLayer(Layer):
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         self._rng = random.Random(self.opts["seed"] or None)
+        self.injected = 0
         self._install()
 
     def reconfigure(self, options):
@@ -42,11 +50,31 @@ class ErrorGenLayer(Layer):
         self._enabled = enabled or {f.value for f in Fop}
         self._rate = self.opts["failure"] / 100.0
         self._err = _ERRNO[self.opts["error-no"]]
+        # deterministic budget: every (re)configure re-arms it in full
+        self._count_mode = int(self.opts["failure-count"] or 0)
+        self._budget = self._count_mode
 
     def _maybe_fail(self, op: str):
-        if op in self._enabled and self._rate > 0 and \
-                self._rng.random() < self._rate:
+        if op not in self._enabled:
+            return
+        if self._count_mode:
+            # failure-count mode: exactly the first N matching fops
+            # fail, every later one passes — deterministic by design
+            if self._budget > 0:
+                self._budget -= 1
+                self.injected += 1
+                raise FopError(self._err,
+                               f"error-gen injected on {op} "
+                               f"({self._count_mode - self._budget}"
+                               f"/{self._count_mode})")
+            return
+        if self._rate > 0 and self._rng.random() < self._rate:
+            self.injected += 1
             raise FopError(self._err, f"error-gen injected on {op}")
+
+    def dump_private(self) -> dict:
+        return {"injected": self.injected,
+                "count_budget_left": self._budget}
 
 
 def _make_injected(op_name: str):

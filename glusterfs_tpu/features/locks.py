@@ -19,16 +19,37 @@ from __future__ import annotations
 
 import asyncio
 import errno
+import time as _time
 from collections import defaultdict
 
+from ..core.events import gf_event
 from ..core.fops import FopError
 from ..core.layer import FdObj, Layer, Loc, register
 from ..core.options import Option
+from ..core import metrics as _metrics
+
+#: live locks layers, scraped by the unified registry (weak: retired
+#: graphs age out with the GC).  The revocation counter and the wedge
+#: gauges hang off one population.
+_LIVE_LOCKS_LAYERS = _metrics.REGISTRY.register_objects(
+    "gftpu_locks_revoked_total", "counter",
+    "granted locks forcibly revoked, by trigger (age = holder older "
+    "than features.locks-revocation-secs with waiters queued, "
+    "max-blocked = blocked queue over features.locks-revocation-"
+    "max-blocked, clear-locks = operator `volume clear-locks`)",
+    lambda l: [({"layer": l.name, "reason": r}, v)
+               for r, v in l.revoked_counts.items()])
+_metrics.REGISTRY.register_objects(
+    "gftpu_locks_blocked", "gauge",
+    "lock requests currently parked in FIFO waiter queues, per table",
+    lambda l: [({"layer": l.name, "kind": k}, v)
+               for k, v in l._blocked_counts().items()],
+    live=_LIVE_LOCKS_LAYERS)
 
 
 class _Lock:
     __slots__ = ("owner", "ltype", "start", "end", "client",
-                 "last_notify")
+                 "last_notify", "granted_at")
 
     def __init__(self, owner: bytes, ltype: str, start: int, end: int):
         self.owner = owner
@@ -40,6 +61,9 @@ class _Lock:
         # stamped at grant time by LocksLayer
         self.client: bytes | None = None
         self.last_notify = 0.0
+        # monotonic grant stamp: the revocation monitor ages holders
+        # from this (pl_inode_lock granted_time)
+        self.granted_at = 0.0
 
     def overlaps(self, other: "_Lock") -> bool:
         a_end = self.end if self.end >= 0 else float("inf")
@@ -59,18 +83,24 @@ class _Lock:
 
 
 class _LockDomain:
-    """Granted locks + FIFO waiter queue for one (gfid, domain)."""
+    """Granted locks + FIFO waiter queue for one (gfid, domain).
+    Waiter entries are ``(req, fut, since)`` — the monotonic park stamp
+    feeds the wedge view and the revocation monitor."""
 
     def __init__(self):
         self.granted: list[_Lock] = []
-        self.waiters: list[tuple[_Lock, asyncio.Future]] = []
+        self.waiters: list[tuple[_Lock, asyncio.Future, float]] = []
 
     def _grantable(self, req: _Lock) -> bool:
         return not any(g.conflicts(req) for g in self.granted)
 
+    def _grant(self, req: _Lock) -> None:
+        req.granted_at = _time.monotonic()
+        self.granted.append(req)
+
     def try_lock(self, req: _Lock) -> bool:
         if self._grantable(req):
-            self.granted.append(req)
+            self._grant(req)
             return True
         return False
 
@@ -78,7 +108,7 @@ class _LockDomain:
         if self.try_lock(req):
             return
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self.waiters.append((req, fut))
+        self.waiters.append((req, fut, _time.monotonic()))
         await fut
 
     def unlock(self, owner: bytes, start: int, end: int) -> bool:
@@ -96,16 +126,48 @@ class _LockDomain:
             self._wake()
         return n - len(self.granted)
 
+    def release_matching(self, pred) -> int:
+        """Drop granted locks matching ``pred(lock)`` and evict matching
+        waiters (their futures fail ENOTCONN so in-process callers
+        unblock), then grant whoever became compatible."""
+        n = len(self.granted)
+        self.granted = [g for g in self.granted if not pred(g)]
+        gone = n - len(self.granted)
+        still = []
+        for req, fut, since in self.waiters:
+            if pred(req):
+                if not fut.done():
+                    fut.set_exception(FopError(
+                        errno.ENOTCONN, "lock waiter's client went away"))
+            else:
+                still.append((req, fut, since))
+        self.waiters = still
+        if gone:
+            self._wake()
+        return gone
+
     def _wake(self) -> None:
         # grant queued requests in FIFO order while compatible
         still = []
-        for req, fut in self.waiters:
+        for req, fut, since in self.waiters:
             if not fut.cancelled() and self._grantable(req):
-                self.granted.append(req)
+                self._grant(req)
                 fut.set_result(None)
             elif not fut.cancelled():
-                still.append((req, fut))
+                still.append((req, fut, since))
         self.waiters = still
+
+    def oldest_holder_age(self) -> float:
+        if not self.granted:
+            return 0.0
+        now = _time.monotonic()
+        return max(now - g.granted_at for g in self.granted)
+
+    def oldest_waiter_age(self) -> float:
+        if not self.waiters:
+            return 0.0
+        now = _time.monotonic()
+        return max(now - since for _r, _f, since in self.waiters)
 
     def empty(self) -> bool:
         return not self.granted and not self.waiters
@@ -132,6 +194,28 @@ class LocksLayer(Layer):
                description="TEST TOOL (pl monkey-unlocking): ~50% of "
                            "unlocks pretend success and leak the lock, "
                            "exercising stale-lock recovery paths"),
+        Option("revocation-secs", "time", default="0",
+               description="forced revocation of wedged holders "
+                           "(features.locks-revocation-secs, reference "
+                           "entrylk.c:129-173 + the inodelk twin): "
+                           "while requests queue behind a granted lock "
+                           "older than this, the monitor revokes the "
+                           "domain's holders, drains the FIFO waiter "
+                           "queue, and the revoked owner's next lock "
+                           "fop gets EAGAIN with a 'lock-revoked' "
+                           "notice in the error xdata.  0 = never "
+                           "revoke (the reference default)"),
+        Option("revocation-clear-all", "bool", default="off",
+               description="on revocation also CLEAR the blocked queue "
+                           "(features.locks-revocation-clear-all): "
+                           "waiters fail EAGAIN instead of being "
+                           "granted — the domain starts from empty"),
+        Option("revocation-max-blocked", "int", default=0, min=0,
+               description="revoke a domain's holders as soon as its "
+                           "blocked queue exceeds this many waiters, "
+                           "regardless of holder age (features.locks-"
+                           "revocation-max-blocked); 0 = no queue "
+                           "trigger"),
         Option("mandatory-locking", "enum", default="off",
                values=("off", "forced"),
                description="forced: data fops conflicting with another "
@@ -188,6 +272,32 @@ class LocksLayer(Layer):
         self._posixlk: dict[bytes, _LockDomain] = defaultdict(_LockDomain)
         self._sink = None  # BrickServer's event-push callback
         self.contention_sent = 0
+        # revocation plane (features.locks-revocation-*): per-trigger
+        # revoked-lock counts (the gftpu_locks_revoked_total family)
+        # and the pending owner notices — a revoked owner's NEXT lock
+        # fop gets EAGAIN with the notice in the error xdata
+        self.revoked_counts: dict[str, int] = {}
+        self._revocation_notices: dict[bytes, dict] = {}
+        self._monitor_task: asyncio.Task | None = None
+        _LIVE_LOCKS_LAYERS.add(self)
+
+    async def init(self):
+        await super().init()
+        # revocation monitor: age-triggered revocation must fire while
+        # every party is parked (no new request would ever re-check), so
+        # a ticker owns the deadline.  Started unconditionally — the
+        # options are read per-tick so `volume set` arms it live
+        self._monitor_task = asyncio.create_task(self._revocation_loop())
+
+    async def fini(self):
+        if self._monitor_task is not None:
+            self._monitor_task.cancel()
+            try:
+                await self._monitor_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._monitor_task = None
+        await super().fini()
 
     def set_upcall_sink(self, sink) -> None:
         self._sink = sink
@@ -231,6 +341,165 @@ class LocksLayer(Layer):
         self.contention_sent += n
         return n
 
+    # -- forced revocation (features.locks-revocation-*; the reference's
+    # entrylk.c:129-173 revocation machinery + the inodelk twin) ----------
+
+    _TABLE_KINDS = ("inodelk", "entrylk", "posix")
+
+    def _tables(self):
+        return zip(self._TABLE_KINDS,
+                   (self._inodelk, self._entrylk, self._posixlk))
+
+    def _blocked_counts(self) -> dict[str, int]:
+        return {kind: sum(len(d.waiters) for d in table.values())
+                for kind, table in self._tables()}
+
+    @staticmethod
+    def _describe_key(kind: str, key) -> dict:
+        if kind == "inodelk":
+            return {"gfid": key[0].hex(), "domain": key[1]}
+        if kind == "entrylk":
+            return {"gfid": key[0].hex(), "domain": key[1],
+                    "basename": key[2]}
+        return {"gfid": key.hex() if isinstance(key, bytes) else str(key)}
+
+    def _note_revoked(self, kind: str, key, lock: _Lock,
+                      reason: str) -> None:
+        """Remember the revocation for the owner's next lock fop (the
+        EAGAIN + xdata notice).  Bounded FIFO: a dead owner that never
+        returns must not pin entries forever."""
+        note = {"reason": reason, "kind": kind, "ltype": lock.ltype,
+                "start": lock.start, "end": lock.end,
+                "held_secs": round(_time.monotonic() - lock.granted_at, 3),
+                **self._describe_key(kind, key)}
+        self._revocation_notices[lock.owner] = note
+        while len(self._revocation_notices) > 512:
+            self._revocation_notices.pop(
+                next(iter(self._revocation_notices)))
+
+    def _revoke_domain(self, kind: str, key, dom: _LockDomain,
+                       reason: str, what: str = "all") -> int:
+        """Revoke one domain: drop its granted locks (``what`` in
+        granted/all), optionally flush its blocked queue (clear-all or
+        ``what`` in blocked/all for the operator path), then drain the
+        FIFO waiter queue through the usual grant path.  Returns how
+        many locks were cleared (granted + flushed waiters)."""
+        cleared = 0
+        if what in ("granted", "all") and dom.granted:
+            for g in dom.granted:
+                self._note_revoked(kind, key, g, reason)
+            cleared += len(dom.granted)
+            dom.granted.clear()
+        flush_blocked = what in ("blocked", "all") or \
+            (reason != "clear-locks" and self.opts["revocation-clear-all"])
+        if flush_blocked and dom.waiters:
+            for _req, fut, _since in dom.waiters:
+                if not fut.done():
+                    fut.set_exception(FopError(
+                        errno.EAGAIN, "blocked lock cleared by "
+                                      "revocation",
+                        xdata={"lock-revoked": {
+                            "reason": reason, "kind": kind,
+                            **self._describe_key(kind, key)}}))
+            cleared += len(dom.waiters)
+            dom.waiters.clear()
+        # grant whoever is compatible now (the queue DRAIN the
+        # revocation exists for)
+        dom._wake()
+        if cleared:
+            self.revoked_counts[reason] = \
+                self.revoked_counts.get(reason, 0) + cleared
+            gf_event("LOCK_REVOKED", layer=self.name, kind=kind,
+                     reason=reason, cleared=cleared,
+                     waiters=len(dom.waiters),
+                     **self._describe_key(kind, key))
+        return cleared
+
+    def _maybe_revoke(self, kind: str, key, dom: _LockDomain) -> None:
+        """Apply the two automatic triggers to one domain.  Called from
+        the monitor tick and at waiter-park time (the max-blocked
+        trigger must fire on the block that crosses the line, not a
+        second later)."""
+        if not dom.waiters or not dom.granted:
+            return
+        maxb = int(self.opts["revocation-max-blocked"] or 0)
+        if maxb and len(dom.waiters) > maxb:
+            self._revoke_domain(kind, key, dom, "max-blocked", "granted")
+            return
+        secs = float(self.opts["revocation-secs"] or 0)
+        if secs and dom.oldest_holder_age() >= secs:
+            self._revoke_domain(kind, key, dom, "age", "granted")
+
+    async def _revocation_loop(self) -> None:
+        """The revocation monitor: scans domains carrying waiters on a
+        tick scaled to the configured deadline (options re-read per
+        tick, so ``volume set`` arms/disarms live)."""
+        try:
+            while True:
+                secs = float(self.opts["revocation-secs"] or 0)
+                tick = max(0.05, min(1.0, secs / 4)) if secs else 1.0
+                await asyncio.sleep(tick)
+                if not secs and not self.opts["revocation-max-blocked"]:
+                    continue
+                for kind, table in self._tables():
+                    for key, dom in list(table.items()):
+                        self._maybe_revoke(kind, key, dom)
+                        if dom.empty():
+                            table.pop(key, None)
+        except asyncio.CancelledError:
+            pass
+
+    def _ensure_monitor(self) -> None:
+        """(Re)start the monitor on the CURRENT loop: test harnesses
+        activate graphs on one short-lived loop and run fops on another,
+        which strands the init-time task on a dead loop."""
+        t = self._monitor_task
+        try:
+            if t is not None and not t.done() and \
+                    t.get_loop() is asyncio.get_running_loop():
+                return
+        except RuntimeError:
+            return  # no running loop: nothing to park on either
+        self._monitor_task = asyncio.create_task(self._revocation_loop())
+
+    async def clear_locks(self, path: str, kind: str = "all",
+                          xdata: dict | None = None) -> dict:
+        """Operator-forced clearing (`volume clear-locks`, the
+        reference's clear-locks command riding the same machinery):
+        ``kind`` in blocked/granted/all; clears every lock table's
+        domains for the path's gfid and drains the queues."""
+        if kind not in ("blocked", "granted", "all"):
+            raise FopError(errno.EINVAL,
+                           f"clear-locks kind {kind!r} not one of "
+                           "blocked/granted/all")
+        gfid = await self._gfid_for(Loc(path))
+        out = {"path": path, "kind": kind, "cleared": {}, "total": 0}
+        for tkind, table in self._tables():
+            n = 0
+            for key, dom in list(table.items()):
+                kg = key[0] if isinstance(key, tuple) else key
+                if kg != gfid:
+                    continue
+                n += self._revoke_domain(tkind, key, dom,
+                                         "clear-locks", kind)
+                if dom.empty():
+                    table.pop(key, None)
+            if n:
+                out["cleared"][tkind] = n
+                out["total"] += n
+        return out
+
+    def _consume_notice(self, owner: bytes) -> None:
+        """EAGAIN + notice for a revoked owner's next lock fop: the
+        holder learns its lock is gone the moment it comes back for
+        one (pairs with client.strict-locks, which already fails the
+        lock-protected I/O path on handle loss)."""
+        note = self._revocation_notices.pop(owner, None)
+        if note is not None:
+            raise FopError(errno.EAGAIN,
+                           "lock revoked (features.locks-revocation)",
+                           xdata={"lock-revoked": note})
+
     # -- helpers -----------------------------------------------------------
 
     async def _gfid_for(self, loc: Loc) -> bytes:
@@ -261,6 +530,9 @@ class LocksLayer(Layer):
         from ..rpc.wire import CURRENT_CLIENT
 
         req.client = CURRENT_CLIENT.get()
+        kind = next(k for k, t in self._tables() if t is table)
+        # a revoked owner's next lock fop carries the notice (EAGAIN)
+        self._consume_notice(req.owner)
         if cmd == "lock-nb":
             if not dom.try_lock(req):
                 if table is self._inodelk:
@@ -275,10 +547,19 @@ class LocksLayer(Layer):
                 if table is self._inodelk:
                     self._contend(key[0], key[1], dom, req)
                 fut = asyncio.get_running_loop().create_future()
-                dom.waiters.append((req, fut))
+                dom.waiters.append((req, fut, _time.monotonic()))
+                # the park that crosses revocation-max-blocked (or meets
+                # an already-aged holder) fires the revocation NOW; the
+                # monitor covers deadlines that pass while parked
+                self._ensure_monitor()
+                self._maybe_revoke(kind, key, dom)
                 try:
                     await asyncio.wait_for(fut, timeout or None)
                 except asyncio.TimeoutError:
+                    # drop our (cancelled) waiter entry: the wedge
+                    # gauges and max-blocked trigger must not count it
+                    dom.waiters = [w for w in dom.waiters
+                                   if w[1] is not fut]
                     raise FopError(errno.ETIMEDOUT,
                                    "lock wait timed out") from None
             return {}
@@ -402,14 +683,57 @@ class LocksLayer(Layer):
 
     def release_client(self, owner: bytes) -> int:
         """Drop every lock held by a disconnected client (the reference
-        cleans locks on client disconnect via client_t)."""
+        cleans locks on client disconnect via client_t) and drain the
+        freed queues WITHOUT waiting for revocation-secs.
+
+        ``owner`` is either a bare lk-owner (in-process callers) or a
+        connection identity: the wire scopes owners to
+        ``identity + b"/" + lk-owner`` (protocol/server._scope_owner),
+        so match the exact owner, the scoped prefix, AND the grant-time
+        client identity — an identity-only match is what reaps wire
+        clients' locks at all.  The dead client's own parked waiters
+        are evicted too (nobody will ever collect their grant)."""
+        prefix = owner + b"/"
+
+        def dead(lk: _Lock) -> bool:
+            return lk.owner == owner or lk.owner.startswith(prefix) or \
+                lk.client == owner
+
         n = 0
-        for table in (self._inodelk, self._entrylk, self._posixlk):
+        for _kind, table in self._tables():
             for key in list(table):
-                n += table[key].release_owner(owner)
+                n += table[key].release_matching(dead)
                 if table[key].empty():
                     table.pop(key, None)
+        # pending revocation notices die with the client
+        for o in [o for o in self._revocation_notices
+                  if o == owner or o.startswith(prefix)]:
+            self._revocation_notices.pop(o, None)
         return n
+
+    def lock_status(self) -> dict:
+        """The wedge view (`volume status callpool` + dump_private):
+        per-domain blocked-waiter counts and oldest-holder age, so an
+        operator can SEE a wedge before revocation fires."""
+        domains = []
+        for kind, table in self._tables():
+            for key, dom in table.items():
+                if not dom.waiters and not dom.granted:
+                    continue
+                row = {"kind": kind, "granted": len(dom.granted),
+                       "blocked": len(dom.waiters),
+                       "oldest_holder_secs":
+                           round(dom.oldest_holder_age(), 3),
+                       "oldest_waiter_secs":
+                           round(dom.oldest_waiter_age(), 3),
+                       **self._describe_key(kind, key)}
+                domains.append(row)
+        # wedges first: most-blocked, then oldest holder
+        domains.sort(key=lambda r: (-r["blocked"],
+                                    -r["oldest_holder_secs"]))
+        return {"blocked": self._blocked_counts(),
+                "revoked": dict(self.revoked_counts),
+                "domains": domains[:64]}
 
     def dump_private(self) -> dict:
         return {
@@ -418,4 +742,5 @@ class LocksLayer(Layer):
             "posixlk_inodes": len(self._posixlk),
             "granted": sum(len(d.granted) for d in self._inodelk.values()),
             "waiting": sum(len(d.waiters) for d in self._inodelk.values()),
+            **self.lock_status(),
         }

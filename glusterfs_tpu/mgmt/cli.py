@@ -9,6 +9,7 @@ Command surface kept (cli-cmd-volume.c vocabulary):
     gftpu volume status NAME [detail|clients|fds|inodes|callpool|mem]
     gftpu volume set NAME KEY VALUE
     gftpu volume heal NAME [info] [PATH] | statistics heal-count
+    gftpu volume clear-locks NAME PATH kind {blocked|granted|all}
     gftpu volume quota NAME enable|disable|list|limit-usage PATH BYTES|remove PATH
     gftpu volume rebalance NAME
     gftpu volume profile NAME
@@ -345,6 +346,27 @@ async def _run(args) -> Any:
                 return await top.heal_file(path)
             finally:
                 await client.unmount()
+        if sub == "clear-locks":
+            # volume clear-locks NAME PATH kind {blocked|granted|all}
+            # (the literal "kind" keyword mirrors the reference's
+            # syntax; tolerated absent).  Rides the brick-side
+            # revocation machinery; --json prints the per-brick
+            # cleared counts
+            usage = ("usage: volume clear-locks NAME PATH kind "
+                     "{blocked|granted|all}")
+            rest = list(args.args)
+            if not rest:
+                raise SystemExit(usage)
+            path = rest.pop(0)
+            if rest and rest[0] == "kind":
+                rest.pop(0)
+            kind = rest.pop(0) if rest else "all"
+            if kind not in ("blocked", "granted", "all") or rest:
+                raise SystemExit(usage)
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-clear-locks",
+                                    name=args.name, path=path,
+                                    kind=kind)
         if sub == "quota":
             # gftpu volume quota NAME enable|disable|list
             #                        |limit-usage PATH BYTES|remove PATH
@@ -548,7 +570,7 @@ def main(argv=None) -> int:
                                      "rebalance", "profile", "metrics",
                                      "quota", "bitrot", "add-brick",
                                      "remove-brick", "replace-brick",
-                                     "top", "gateway"])
+                                     "top", "gateway", "clear-locks"])
     vol.add_argument("name", nargs="?", default="")
     vol.add_argument("args", nargs="*")
 

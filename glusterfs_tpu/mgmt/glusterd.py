@@ -1222,6 +1222,57 @@ class Glusterd:
                                   "error": repr(e)[:200]}
         return {"bricks": out}
 
+    async def op_volume_clear_locks(self, name: str, path: str,
+                                    kind: str = "all") -> dict:
+        """``gftpu volume clear-locks <v> <path> kind
+        {blocked|granted|all}`` — operator-forced lock clearing riding
+        the revocation machinery (the reference's clear-locks command,
+        glusterd-volume-ops.c GF_CLI_CLEAR_LOCKS): fans out to every
+        brick's features/locks and merges the per-brick cleared
+        counts."""
+        if kind not in ("blocked", "granted", "all"):
+            raise MgmtError(f"clear-locks kind {kind!r} not one of "
+                            "blocked/granted/all")
+        vol = self._vol(name)
+        if vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        bricks, partial = await self._gather_bricks(
+            "volume-clear-locks-local", nodes=self._vol_nodes(vol),
+            name=name, path=path, kind=kind)
+        total = sum(v.get("total", 0) for v in bricks.values()
+                    if isinstance(v, dict))
+        return self._merge_partial(
+            {"volume": name, "path": path, "kind": kind,
+             "bricks": bricks, "total": total}, partial)
+
+    async def op_volume_clear_locks_local(self, name: str, path: str,
+                                          kind: str = "all") -> dict:
+        """One node's share of clear-locks: each local brick's
+        features/locks.clear_locks via the authenticated RPC extra."""
+        vol = self._vol(name)
+        out: dict[str, dict] = {}
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid:
+                continue
+            port = self.ports.get(b["name"])
+            if not port:
+                out[b["name"]] = {"offline": True, "total": 0}
+                continue
+            try:
+                r = await self._brick_call(
+                    vol, port, "clear_locks", [path, kind],
+                    subvol=b["name"] + "-server")
+                out[b["name"]] = r or {"total": 0}
+            except FopError as e:
+                if e.err == 2:  # ENOENT: path not on this brick (dht)
+                    out[b["name"]] = {"total": 0, "absent": True}
+                else:
+                    out[b["name"]] = {"total": 0, "error": str(e)}
+            except Exception as e:
+                out[b["name"]] = {"offline": True, "total": 0,
+                                  "error": repr(e)[:200]}
+        return {"bricks": out}
+
     _TOP_METRICS = ("open", "read", "write", "read-bytes",
                     "write-bytes")
 

@@ -58,6 +58,21 @@ OPTION_MAP = {
                                        "slow-fop-threshold"),
     "diagnostics.span-ring-size": ("debug/io-stats", "span-ring-size"),
     "client.strict-locks": ("protocol/client", "strict-locks"),
+    # failure containment (ISSUE 9): per-brick circuit breaking, the
+    # idempotent-retry knobs, the call-timeout transport bail, and
+    # deadline-budget propagation (client ships, brick arms — the
+    # server half is capability-advertised, not option-gated)
+    "client.circuit-breaker": ("protocol/client", "circuit-breaker"),
+    "client.circuit-failure-threshold": ("protocol/client",
+                                         "circuit-failure-threshold"),
+    "client.circuit-reset-interval": ("protocol/client",
+                                      "circuit-reset-interval"),
+    "client.failfast": ("protocol/client", "failfast"),
+    "client.idempotent-retries": ("protocol/client",
+                                  "idempotent-retries"),
+    "client.retry-backoff-max": ("protocol/client", "retry-backoff-max"),
+    "network.deadline-propagation": ("protocol/client",
+                                     "deadline-propagation"),
     # concurrent event plane (ISSUE 7; the multithreaded-epoll knobs,
     # event-epoll.c): frame-turning worker pools on both transport
     # ends, live-reconfigurable
@@ -194,6 +209,13 @@ OPTION_MAP = {
                                          "notify-contention"),
     "features.locks-notify-contention-delay": ("features/locks",
                                                "notify-contention-delay"),
+    # lock revocation (failure containment, op-version 11)
+    "features.locks-revocation-secs": ("features/locks",
+                                       "revocation-secs"),
+    "features.locks-revocation-clear-all": ("features/locks",
+                                            "revocation-clear-all"),
+    "features.locks-revocation-max-blocked": ("features/locks",
+                                              "revocation-max-blocked"),
     # quota tuning
     "features.default-soft-limit": ("features/quota",
                                     "default-soft-limit"),
@@ -218,6 +240,7 @@ OPTION_MAP = {
     "debug.error-gen": ("debug/error-gen", "__enable__"),
     "debug.error-fops": ("debug/error-gen", "enable"),
     "debug.error-failure": ("debug/error-gen", "failure"),
+    "debug.error-failure-count": ("debug/error-gen", "failure-count"),
     "debug.error-number": ("debug/error-gen", "error-no"),
     "debug.random-failure-seed": ("debug/error-gen", "seed"),
     "debug.delay-gen": ("debug/delay-gen", "__enable__"),
@@ -673,6 +696,26 @@ _V10_KEYS = (
     "cluster.mesh-codec",
 )
 OPTION_MIN_OPVERSION.update({k: 10 for k in _V10_KEYS})
+
+# round-12 additions ship at op-version 11: the failure-containment
+# plane — lock revocation (a v10 brick has no monitor to arm), the
+# client circuit/retry/failfast knobs, deadline propagation (a v10
+# brick would pass the reserved request field into fop signatures),
+# and error-gen's deterministic failure-count chaos mode
+_V11_KEYS = (
+    "features.locks-revocation-secs",
+    "features.locks-revocation-clear-all",
+    "features.locks-revocation-max-blocked",
+    "client.circuit-breaker",
+    "client.circuit-failure-threshold",
+    "client.circuit-reset-interval",
+    "client.failfast",
+    "client.idempotent-retries",
+    "client.retry-backoff-max",
+    "network.deadline-propagation",
+    "debug.error-failure-count",
+)
+OPTION_MIN_OPVERSION.update({k: 11 for k in _V11_KEYS})
 
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key

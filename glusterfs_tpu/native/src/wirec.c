@@ -300,7 +300,10 @@ static int enc(PyObject *v, Out *o, PyObject *blobs)
             return enc_attr_list(v, o, names, 3, T_FD);
         }
         if (PyObject_IsInstance(v, cls_err) == 1) {
-            /* FopError: [err, message] where message = args[1] or "" */
+            /* FopError: [err, message] where message = args[1] or "";
+             * a non-empty .xdata dict (the error-path reply dict, e.g.
+             * the lock-revocation notice) rides as a third element
+             * that two-field decoders simply ignore */
             PyObject *errno_o = PyObject_GetAttrString(v, "err");
             if (!errno_o)
                 return -1;
@@ -313,13 +316,21 @@ static int enc(PyObject *v, Out *o, PyObject *blobs)
                 msg = PyUnicode_FromString("");
             }
             Py_XDECREF(args);
+            PyObject *xd = PyObject_GetAttrString(v, "xdata");
+            if (!xd)
+                PyErr_Clear(); /* pre-xdata FopError: two-field shape */
+            int with_xd = xd && PyDict_CheckExact(xd) &&
+                          PyDict_GET_SIZE(xd) > 0;
             int rc = -1;
             if (msg && out_byte(o, T_ERR) == 0 &&
-                out_byte(o, T_LIST) == 0 && out_uint(o, 2) == 0 &&
-                enc(errno_o, o, NULL) == 0 && enc(msg, o, NULL) == 0)
+                out_byte(o, T_LIST) == 0 &&
+                out_uint(o, with_xd ? 3 : 2) == 0 &&
+                enc(errno_o, o, NULL) == 0 && enc(msg, o, NULL) == 0 &&
+                (!with_xd || enc(xd, o, NULL) == 0))
                 rc = 0;
             Py_DECREF(errno_o);
             Py_XDECREF(msg);
+            Py_XDECREF(xd);
             return rc;
         }
     }

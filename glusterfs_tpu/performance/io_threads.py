@@ -31,9 +31,10 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import errno
 from concurrent.futures import ThreadPoolExecutor
 
-from ..core.fops import Fop
+from ..core.fops import Fop, FopError
 from ..core.layer import Layer, register
 from ..core.options import Option
 from ..core import metrics as _metrics
@@ -53,6 +54,13 @@ _metrics.REGISTRY.register_objects(
     "fops admitted through each priority gate",
     lambda l: [({"layer": l.name, "prio": _PRIO_NAMES[i]}, v)
                for i, v in enumerate(l.executed)],
+    live=_LIVE_IOT_LAYERS)
+_metrics.REGISTRY.register_objects(
+    "gftpu_io_threads_deadline_dropped_total", "counter",
+    "queued fops dropped at gate admission because the client's "
+    "propagated deadline budget had already expired (the client "
+    "abandoned the call — answering would burn a worker for nothing)",
+    lambda l: [({"layer": l.name}, l.deadline_dropped)],
     live=_LIVE_IOT_LAYERS)
 
 # fop -> priority class (io-threads.c:64-89)
@@ -170,6 +178,8 @@ class IoThreadsLayer(Layer):
         # watermark since init
         self.inflight = 0
         self.peak_inflight = 0
+        # abandoned work shed at admission (deadline propagation)
+        self.deadline_dropped = 0
         self._pool: ThreadPoolExecutor | None = None
         self._pool_width = 0
         _LIVE_IOT_LAYERS.add(self)
@@ -225,6 +235,7 @@ class IoThreadsLayer(Layer):
                 "executed": list(self.executed),
                 "inflight": self.inflight,
                 "peak_inflight": self.peak_inflight,
+                "deadline_dropped": self.deadline_dropped,
                 "pool_threads": self._pool_width or
                 self.opts["thread-count"]}
 
@@ -240,6 +251,21 @@ def _gated(fop: Fop):
         self.queued[p] += 1
         try:
             async with self._gates[p]:
+                # abandoned-work shedding (network.deadline-propagation):
+                # if the client's budget expired while this fop queued
+                # behind the gate, drop it NOW — the reply would be
+                # discarded by a caller that already raised ETIMEDOUT,
+                # and the worker slot belongs to a live request
+                from ..rpc import wire as _wire
+
+                dl = _wire.CURRENT_DEADLINE.get()
+                if dl is not None and \
+                        asyncio.get_running_loop().time() > dl:
+                    self.deadline_dropped += 1
+                    raise FopError(
+                        errno.ETIMEDOUT,
+                        f"{name} dropped at io-threads: client "
+                        "deadline budget expired before dispatch")
                 self.executed[p] += 1
                 self.inflight += 1
                 if self.inflight > self.peak_inflight:

@@ -55,6 +55,29 @@ _metrics.REGISTRY.register_objects(
     lambda l: [({"layer": l.name}, l.connects)],
     live=_LIVE_CLIENT_LAYERS)
 
+# failure-containment plane (ISSUE 9): per-brick circuit state, the
+# idempotent-retry volume, and failfast transport bails — the health
+# plane's view of which bricks are shedding load
+_CB_STATES = {"closed": 0, "open": 1, "half-open": 2}
+_metrics.REGISTRY.register_objects(
+    "gftpu_client_circuit_state", "gauge",
+    "per-brick circuit breaker state (0 closed / 1 open / 2 half-open)",
+    lambda l: [({"layer": l.name}, _CB_STATES.get(l._cb_state, 0))],
+    live=_LIVE_CLIENT_LAYERS)
+_metrics.REGISTRY.register_objects(
+    "gftpu_client_retries_total", "counter",
+    "idempotent fops re-dispatched after a transport-class failure "
+    "(capped exponential backoff through the circuit breaker)",
+    lambda l: [({"layer": l.name}, l.retries_total)],
+    live=_LIVE_CLIENT_LAYERS)
+_metrics.REGISTRY.register_objects(
+    "gftpu_client_failfast_total", "counter",
+    "call-timeout transport bails: the connection was dropped so every "
+    "other outstanding frame failed NOW instead of serially waiting "
+    "out its own deadline",
+    lambda l: [({"layer": l.name}, l.failfast_drops)],
+    live=_LIVE_CLIENT_LAYERS)
+
 
 @register("protocol/client")
 class ClientLayer(Layer):
@@ -116,6 +139,55 @@ class ClientLayer(Layer):
                            "engages when the brick advertised trace "
                            "support at SETVOLUME — a live-downgraded "
                            "peer simply never sees the field"),
+        Option("circuit-breaker", "bool", default="on",
+               description="per-brick circuit breaking "
+                           "(client.circuit-breaker): after "
+                           "circuit-failure-threshold consecutive "
+                           "transport-class failures (ENOTCONN / "
+                           "ETIMEDOUT) the circuit OPENS — fops fail "
+                           "immediately instead of feeding a flapping "
+                           "brick a retry storm; after "
+                           "circuit-reset-interval it half-opens and "
+                           "admits ONE probe, whose outcome closes or "
+                           "re-opens it.  A successful SETVOLUME "
+                           "handshake always closes the circuit"),
+        Option("circuit-failure-threshold", "int", default=5, min=1,
+               max=1024,
+               description="consecutive transport failures that open "
+                           "the circuit (client.circuit-failure-"
+                           "threshold)"),
+        Option("circuit-reset-interval", "time", default="2",
+               description="open -> half-open probe delay "
+                           "(client.circuit-reset-interval)"),
+        Option("failfast", "bool", default="on",
+               description="a fop round-trip hitting call-timeout "
+                           "drops the transport (the frame-timeout "
+                           "bail): every other outstanding frame "
+                           "fails with ENOTCONN NOW instead of each "
+                           "serially waiting out its own deadline "
+                           "against a peer that eats requests.  Lock "
+                           "fops are exempt — they park server-side "
+                           "legitimately"),
+        Option("idempotent-retries", "int", default=2, min=0, max=8,
+               description="re-dispatch attempts for idempotent "
+                           "(read-class) fops after a transport-class "
+                           "failure, with capped exponential backoff; "
+                           "retries stop the moment the circuit opens "
+                           "(client.idempotent-retries; the georep "
+                           "repce retry allowlist idea on the data "
+                           "plane).  0 = fail through immediately"),
+        Option("retry-backoff-max", "time", default="1",
+               description="cap on the exponential retry backoff "
+                           "(base 50ms, doubling per attempt)"),
+        Option("deadline-propagation", "bool", default="on",
+               description="ship each fop's remaining deadline budget "
+                           "in the request (network.deadline-"
+                           "propagation): the brick arms it per "
+                           "request so io-threads can DROP work whose "
+                           "client already timed the call out instead "
+                           "of burning a worker on an abandoned "
+                           "answer.  Only engages when the brick "
+                           "advertised the capability at SETVOLUME"),
         Option("strict-locks", "bool", default="off",
                description="fds holding posix locks must not be "
                            "reached through anonymous (gfid-addressed) "
@@ -172,6 +244,16 @@ class ClientLayer(Layer):
         self.bytes_tx = 0
         self.bytes_rx = 0
         self.connects = 0
+        # circuit breaker (client.circuit-breaker): closed -> open on
+        # consecutive transport failures -> half-open probe -> closed
+        self._cb_state = "closed"
+        self._cb_failures = 0
+        self._cb_opened_at = 0.0
+        self._cb_probing = False
+        self.retries_total = 0
+        self.failfast_drops = 0
+        # did the brick advertise deadline-budget arming at SETVOLUME?
+        self._peer_deadline = False
         _LIVE_CLIENT_LAYERS.add(self)
         # reopen bookkeeping (client-handshake.c reopen_fd_count):
         # live fds with server-side handles (value = (fd, reopen fop)),
@@ -282,6 +364,10 @@ class ClientLayer(Layer):
         # volume-set of diagnostics.trace-propagation applies without
         # a reconnect — same pattern as compound-fops
         self._peer_trace = bool(res.get("trace"))
+        # deadline-budget propagation: only to bricks that pop the
+        # reserved request field before dispatch (older bricks would
+        # pass it into the fop signature)
+        self._peer_deadline = bool(res.get("deadline"))
         # re-open tracked fds and re-acquire held locks BEFORE CHILD_UP
         # (client_child_up_reopen_done): parents must never see an "up"
         # child whose fd handles are stale
@@ -293,6 +379,9 @@ class ClientLayer(Layer):
             raise
         self.connected = True
         self.connects += 1
+        # a successful SETVOLUME is transport proof: the circuit closes
+        # (the probe path for reconnect-driven recovery)
+        self._cb_record(True)
         loop = asyncio.get_running_loop()
         self._last_pong = loop.time()
         self._tasks.append(asyncio.create_task(self._ping_loop()))
@@ -443,6 +532,86 @@ class ClientLayer(Layer):
         except asyncio.CancelledError:
             pass
 
+    # -- circuit breaker (client.circuit-breaker) --------------------------
+
+    #: failures that indict the TRANSPORT (not the fop): these trip the
+    #: breaker and are the only errors the idempotent allowlist retries
+    _TRANSPORT_ERRNOS = (errno.ENOTCONN, errno.ETIMEDOUT)
+
+    @classmethod
+    def _is_transport_err(cls, e: FopError) -> bool:
+        """Did this failure indict the transport?  ENOTCONN always
+        does; ETIMEDOUT only when the CLIENT's own deadline expired
+        (``_local_timeout`` stamped in _call) — a server-ANSWERED
+        ETIMEDOUT (a contended lock wait, an io-threads deadline drop)
+        proves the wire as well as OK does, and must not open the
+        circuit for a healthy brick."""
+        if e.err == errno.ENOTCONN:
+            return True
+        return e.err == errno.ETIMEDOUT and \
+            getattr(e, "_local_timeout", False)
+
+    def _cb_admit(self) -> bool:
+        """Gate one fop through the breaker: open fails fast (load
+        shedding — a flapping brick must not absorb a retry storm),
+        open past the reset interval half-opens and admits exactly ONE
+        probe, half-open with a probe in flight fails fast.  Returns
+        True when THIS call is the half-open probe (the caller must
+        clear ``_cb_probing`` if it aborts without an outcome)."""
+        if not self.opts["circuit-breaker"] or self._cb_state == "closed":
+            return False
+        if self._cb_state == "open":
+            now = asyncio.get_running_loop().time()
+            if now - self._cb_opened_at < \
+                    self.opts["circuit-reset-interval"]:
+                raise FopError(errno.ENOTCONN,
+                               f"{self.name}: circuit open")
+            self._cb_state = "half-open"
+            self._cb_probing = False
+        if self._cb_probing:
+            raise FopError(errno.ENOTCONN,
+                           f"{self.name}: circuit half-open "
+                           "(probe in flight)")
+        self._cb_probing = True
+        return True
+
+    def _cb_record(self, transport_ok: bool) -> None:
+        """Account one fop outcome.  ``transport_ok`` means the wire
+        answered (success or an ordinary fop error — ENOENT proves the
+        transport as well as OK does)."""
+        if not self.opts["circuit-breaker"]:
+            return
+        if transport_ok:
+            self._cb_failures = 0
+            self._cb_probing = False
+            if self._cb_state != "closed":
+                self._cb_state = "closed"
+                log.info(6, "%s: circuit closed", self.name)
+                gf_event("CLIENT_CIRCUIT_CLOSE", layer=self.name,
+                         remote=f"{self.opts['remote-host']}:"
+                                f"{self.opts['remote-port']}",
+                         subvol=self.opts["remote-subvolume"])
+            return
+        self._cb_failures += 1
+        self._cb_probing = False
+        threshold = int(self.opts["circuit-failure-threshold"])
+        if self._cb_state == "half-open" or \
+                self._cb_failures >= threshold:
+            try:
+                self._cb_opened_at = asyncio.get_running_loop().time()
+            except RuntimeError:
+                return  # no loop: stay put rather than wedge open
+            if self._cb_state != "open":
+                self._cb_state = "open"
+                log.warning(6, "%s: circuit OPEN after %d consecutive "
+                            "transport failures", self.name,
+                            self._cb_failures)
+                gf_event("CLIENT_CIRCUIT_OPEN", layer=self.name,
+                         failures=self._cb_failures,
+                         remote=f"{self.opts['remote-host']}:"
+                                f"{self.opts['remote-port']}",
+                         subvol=self.opts["remote-subvolume"])
+
     # -- call machinery ----------------------------------------------------
 
     @staticmethod
@@ -467,8 +636,20 @@ class ClientLayer(Layer):
         writer = self._writer
         if writer is None:
             raise FopError(errno.ENOTCONN, f"{self.name}: not connected")
-        if fop == "__compound__" or not fop.startswith("__"):
+        data_fop = fop == "__compound__" or not fop.startswith("__")
+        if data_fop:
             self.rpc_roundtrips += 1
+        timeout = self.opts["call-timeout"]
+        if fop in self._LOCK_FOPS:
+            timeout *= self._load_headroom()
+        elif data_fop and self._peer_deadline and \
+                self.opts["deadline-propagation"]:
+            # ship the remaining budget (relative seconds — clocks
+            # differ across processes) so brick-side io-threads can
+            # drop work this call will have abandoned by the time a
+            # worker frees up (the reserved field is popped by the
+            # brick before dispatch; gated on the SETVOLUME capability)
+            kwargs = {**(kwargs or {}), "__deadline__": round(timeout, 3)}
         xid = next(self._xid)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[xid] = fut
@@ -503,14 +684,30 @@ class ClientLayer(Layer):
             self._pending.pop(xid, None)
             await self._drop_connection()
             raise FopError(errno.ENOTCONN, "send failed") from None
-        timeout = self.opts["call-timeout"]
-        if fop in self._LOCK_FOPS:
-            timeout *= self._load_headroom()
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             self._pending.pop(xid, None)
-            raise FopError(errno.ETIMEDOUT, f"{fop} timed out") from None
+            if data_fop and fop not in self._LOCK_FOPS and \
+                    self.opts["failfast"]:
+                # frame-timeout bail (disconnect failfast): a peer that
+                # ate a whole call deadline is treated as dead — drop
+                # the transport so every OTHER outstanding frame fails
+                # ENOTCONN now instead of serially waiting out its own
+                # deadline.  Lock fops are exempt (they park
+                # server-side legitimately); the reconnect loop takes
+                # over from here.
+                self.failfast_drops += 1
+                log.warning(6, "%s: %s hit call-timeout (%.0fs) — "
+                            "bailing the transport", self.name, fop,
+                            timeout)
+                await self._drop_connection()
+            e = FopError(errno.ETIMEDOUT, f"{fop} timed out")
+            # the CLIENT's deadline expired — the wire never answered.
+            # The breaker distinguishes this from a server-returned
+            # ETIMEDOUT (which proves the transport)
+            e._local_timeout = True
+            raise e from None
 
     # payloads at or above this ride the out-of-band blob lane; below
     # it the tagged codec's inline copy is cheaper than a second iovec
@@ -561,7 +758,48 @@ class ClientLayer(Layer):
 
     _LOCK_FOPS = ("inodelk", "finodelk", "entrylk", "fentrylk", "lk")
 
+    #: fops safe to re-dispatch after a transport-class failure (the
+    #: georep repce allowlist idea on the data plane): read-class only —
+    #: a duplicated read is harmless, a duplicated write is not
+    _IDEMPOTENT_FOPS = frozenset((
+        "lookup", "stat", "fstat", "access", "readlink", "readv",
+        "getxattr", "fgetxattr", "statfs", "readdir", "readdirp",
+        "seek", "rchecksum"))
+
     async def fop_call(self, name: str, *args, **kwargs) -> Any:
+        """One fop through the breaker, with the idempotent-retry loop:
+        read-class fops re-dispatch after transport-class failures with
+        capped exponential backoff (base 50ms, doubling), but never
+        past an OPEN circuit — load shedding beats persistence on a
+        flapping brick."""
+        attempt = 0
+        while True:
+            try:
+                return await self._fop_call_once(name, *args, **kwargs)
+            except FopError as e:
+                if not self._is_transport_err(e) or \
+                        name not in self._IDEMPOTENT_FOPS or \
+                        self._closing or self._cb_state == "open" or \
+                        attempt >= int(self.opts["idempotent-retries"]):
+                    raise
+                attempt += 1
+                self.retries_total += 1
+                delay = min(float(self.opts["retry-backoff-max"]),
+                            0.05 * (1 << (attempt - 1)))
+                log.debug(8, "%s: retrying %s after %r (attempt %d, "
+                          "%.2fs backoff)", self.name, name, e, attempt,
+                          delay)
+                await asyncio.sleep(delay)
+
+    async def _fop_call_once(self, name: str, *args, **kwargs) -> Any:
+        try:
+            probe = self._cb_admit()
+        except FopError:
+            if name in self._LOCK_FOPS:
+                # same contract as the not-connected path: a shed
+                # unlock must still drop its replay entry
+                self._track_lock(name, args, kwargs, failed=True)
+            raise
         if not self.connected:
             if name in self._LOCK_FOPS:
                 # a failed UNLOCK must still drop the replay entry: the
@@ -569,15 +807,32 @@ class ClientLayer(Layer):
                 # caller proceeds as released — replaying it on
                 # reconnect would pin a lock nobody will ever drop
                 self._track_lock(name, args, kwargs, failed=True)
+            self._cb_record(False)
             raise FopError(errno.ENOTCONN, f"{self.name}: child down")
-        if name not in self._LOCK_FOPS:
-            self._strict_lock_check(args)
         try:
+            if name not in self._LOCK_FOPS:
+                self._strict_lock_check(args)
             ret = await self._call(name, self._wire_args(args), kwargs)
-        except FopError:
+        except FopError as e:
+            self._cb_record(not self._is_transport_err(e))
             if name in self._LOCK_FOPS:
                 self._track_lock(name, args, kwargs, failed=True)
+                note = (getattr(e, "xdata", None)
+                        or {}).get("lock-revoked")
+                if note:
+                    # the brick revoked our lock(s): purge the replay
+                    # set for that domain, or reconnect would resurrect
+                    # a lock the containment plane just broke
+                    self._forget_revoked(note)
             raise
+        except BaseException:
+            # an aborted probe (cancellation, encode error) has no
+            # outcome to record — release the half-open slot or the
+            # breaker wedges in "probe in flight" forever
+            if probe:
+                self._cb_probing = False
+            raise
+        self._cb_record(True)
         out = self._absorb(ret, args)
         if name in ("open", "create", "opendir"):
             self._note_fd_result(name, out, args)
@@ -638,17 +893,24 @@ class ClientLayer(Layer):
                        if isinstance(v, cfop.FdRef) else v)
                    for k, v in kwargs.items()}
             wire_links.append([fop, wargs, wkw])
+        probe = self._cb_admit()
         try:
             replies = await self._call(
                 "__compound__", (wire_links,),
                 {"xdata": xdata} if xdata else {})
         except FopError as e:
+            self._cb_record(not self._is_transport_err(e))
             if e.err in (errno.ENOSYS, errno.EOPNOTSUPP):
                 # the brick was downgraded/reconfigured under us:
                 # remember and fall back to singles for this connection
                 self._peer_compound = False
                 return await cfop.decompose(self, links, xdata)
             raise
+        except BaseException:
+            if probe:  # aborted probe: release the half-open slot
+                self._cb_probing = False
+            raise
+        self._cb_record(True)
         out = []
         for entry, (fop, args, _kw) in zip(replies, links):
             st, val = entry[0], entry[1]
@@ -658,6 +920,23 @@ class ClientLayer(Layer):
                     self._note_fd_result(fop, val, args)
             out.append([st, val])
         return out
+
+    def _forget_revoked(self, note: dict) -> None:
+        """A 'lock-revoked' notice arrived on a lock fop's EAGAIN
+        (features.locks-revocation): drop every replay entry in that
+        lock domain — the brick already broke them, and the strict-locks
+        pairing means lock-protected I/O on those fds fails loudly
+        rather than riding a lock that no longer exists.  Dropping only
+        weakens reconnect replay, never correctness."""
+        domain = note.get("domain")
+        kind = note.get("kind")
+        for key in list(self._held_locks):
+            if kind == "posix":
+                if key[0] == "lk":
+                    self._held_locks.pop(key, None)
+            elif domain is not None and len(key) > 2 and \
+                    key[2] == domain:
+                self._held_locks.pop(key, None)
 
     def _track_lock(self, name: str, args: tuple, kwargs: dict,
                     failed: bool = False) -> None:

@@ -219,7 +219,7 @@ _THROTTLE_EXEMPT = {"inodelk", "finodelk", "entrylk", "fentrylk", "lk"}
 _RPC_EXTRAS = {"heal_info", "heal_file", "heal_entry", "rebalance",
                "release", "getactivelk", "quota_usage", "top_stats",
                "metrics_dump", "changelog_history",
-               "contend_held_locks"}
+               "contend_held_locks", "clear_locks"}
 
 #: the deep-status op family (GF_CLI_STATUS_* brick half) — the ONE
 #: definition; glusterd's fan-out and the CLI parser import it
@@ -967,6 +967,7 @@ class BrickServer:
             return {"itables": tables, "identity": identity}
         if kind == "callpool":
             pools = []
+            locks = []
             for layer in walk(top):
                 q = getattr(layer, "queued", None)
                 ex = getattr(layer, "executed", None)
@@ -974,7 +975,14 @@ class BrickServer:
                     pools.append({"layer": layer.name,
                                   "queued": list(q),
                                   "executed": list(ex)})
+                # the lock wedge view (ISSUE 9): per-domain blocked
+                # counts + oldest-holder age, so an operator sees a
+                # wedge before revocation fires
+                ls = getattr(layer, "lock_status", None)
+                if ls is not None:
+                    locks.append({"layer": layer.name, **ls()})
             return {"io_threads": pools,
+                    "locks": locks,
                     "outstanding": [
                         {"client": c.identity.hex(),
                          "inflight": c.inflight,
@@ -1025,6 +1033,18 @@ class BrickServer:
             # from an older client is the bare 3-element triple)
             fop_name, args, kwargs = payload[0], payload[1], payload[2]
             trace_id = payload[3] if len(payload) > 3 else None
+            # deadline budget (network.deadline-propagation): the
+            # client's remaining call budget rides a reserved request
+            # field, popped HERE so fop signatures never see it, and
+            # armed as an absolute local-clock deadline for this
+            # request's context — io-threads drops work the client has
+            # already abandoned
+            budget = None
+            if isinstance(kwargs, dict):
+                budget = kwargs.pop("__deadline__", None)
+            if isinstance(budget, (int, float)) and budget > 0:
+                wire.CURRENT_DEADLINE.set(
+                    asyncio.get_running_loop().time() + float(budget))
             if fop_name == "__handshake__":
                 creds = args[2] if len(args) > 2 else {}
                 want = args[1] if len(args) > 1 else ""
@@ -1083,7 +1103,11 @@ class BrickServer:
                                        "compound":
                                            self._compound_on(top),
                                        "sg": conn.sg,
-                                       "trace": self._trace_on(top)}
+                                       "trace": self._trace_on(top),
+                                       # deadline-budget arming: this
+                                       # build pops the reserved
+                                       # request field before dispatch
+                                       "deadline": True}
             if not conn.authed:
                 # SETVOLUME gates everything — pings included (no
                 # pre-auth liveness probing; server.c refuses requests

@@ -49,6 +49,14 @@ import contextvars as _contextvars  # noqa: E402
 CURRENT_CLIENT: "_contextvars.ContextVar" = _contextvars.ContextVar(
     "gftpu_current_client", default=None)
 
+# The absolute (local event-loop clock) deadline of the request being
+# dispatched, armed per-call by protocol/server from the client's
+# propagated budget (network.deadline-propagation).  Brick-side queue
+# layers (io-threads) read it to DROP work whose client has already
+# timed the call out; None = no budget known.
+CURRENT_DEADLINE: "_contextvars.ContextVar" = _contextvars.ContextVar(
+    "gftpu_current_deadline", default=None)
+
 _HDR = struct.Struct(">IBBxx")
 
 # record flags (byte 5 of the header; 0 in pre-blob frames)
@@ -295,7 +303,11 @@ def encode_value(v: Any, out: bytearray,
         encode_value([v.fdid, v.gfid, v.path], out)
     elif isinstance(v, FopError):
         out.append(_T_ERR)
-        encode_value([v.err, str(v.args[1]) if len(v.args) > 1 else ""], out)
+        msg = str(v.args[1]) if len(v.args) > 1 else ""
+        xd = getattr(v, "xdata", None)
+        # two-field shape unless an error xdata rides along (the
+        # lock-revocation notice): a third element old decoders ignore
+        encode_value([v.err, msg, xd] if xd else [v.err, msg], out)
     else:
         raise WireError(f"unencodable type {type(v).__name__}")
 
@@ -366,7 +378,8 @@ def decode_value(buf: memoryview, pos: int,
         return FdHandle(vals[0], vals[1], vals[2]), pos
     if tag == _T_ERR:
         vals, pos = decode_value(buf, pos)
-        return FopError(vals[0], vals[1]), pos
+        return FopError(vals[0], vals[1],
+                        vals[2] if len(vals) > 2 else None), pos
     raise WireError(f"bad tag {tag}")
 
 
@@ -390,7 +403,7 @@ if not os.environ.get("GFTPU_NO_WIREC"):
                            ctime=v[10], rdev=v[11], blksize=v[12]),
             lambda v: Loc(v[0], gfid=v[1], parent=v[2], name=v[3]),
             lambda v: FdHandle(v[0], v[1], v[2]),
-            lambda v: FopError(v[0], v[1]),
+            lambda v: FopError(v[0], v[1], v[2] if len(v) > 2 else None),
             WireError, blob_stats)
     except Exception:  # no toolchain: pure-Python codec serves
         _wirec = None

@@ -1,0 +1,737 @@
+"""Failure-containment plane (ISSUE 9): lock revocation
+(features.locks-revocation-*), disconnect failfast, per-brick circuit
+breakers, deadline-budget shedding, deterministic error-gen, and the
+clear-locks operator surface."""
+
+import asyncio
+import errno
+import os
+import sys
+import time
+
+import pytest
+
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc, walk
+
+sys.path.insert(0, os.path.dirname(__file__))
+from harness import BrickProc  # noqa: E402
+
+LOCKS_VOL = """
+volume posix
+    type storage/posix
+    option directory {d}
+end-volume
+
+volume locks
+    type features/locks
+{opts}    subvolumes posix
+end-volume
+"""
+
+
+def _locks_graph(tmp_path, **options):
+    opts = "".join(f"    option {k} {v}\n" for k, v in options.items())
+    g = Graph.construct(LOCKS_VOL.format(d=tmp_path / "brick", opts=opts))
+    return g
+
+
+# ---------------------------------------------------------------------------
+# revocation: the scenario pins of the acceptance criteria
+# ---------------------------------------------------------------------------
+
+
+def test_revocation_secs_inodelk_waiters_drain(tmp_path):
+    """A wedged inodelk holder is revoked within revocation-secs and
+    EVERY blocked waiter is granted — the queue drains to empty."""
+    g = _locks_graph(tmp_path, **{"revocation-secs": "0.4"})
+
+    async def run():
+        await g.activate()
+        locks = g.by_name["locks"]
+        loc = Loc("/")
+        await locks.inodelk("dom", loc, "lock", "wr", 0, -1,
+                            {"lk-owner": b"WEDGED"})
+        t0 = asyncio.get_event_loop().time()
+        # several rd waiters park behind the wedged wr holder
+        waiters = [asyncio.create_task(
+            locks.inodelk("dom", loc, "lock", "rd", 0, -1,
+                          {"lk-owner": bytes([65 + i])}))
+            for i in range(3)]
+        await asyncio.wait_for(asyncio.gather(*waiters), 5)
+        dt = asyncio.get_event_loop().time() - t0
+        assert 0.2 < dt < 2.0, dt  # within revocation-secs order
+        st = locks.lock_status()
+        assert st["blocked"]["inodelk"] == 0  # queue drained to empty
+        assert locks.revoked_counts.get("age") == 1
+        # the revoked owner's NEXT lock fop: EAGAIN + notice in xdata
+        with pytest.raises(FopError) as ei:
+            await locks.inodelk("dom", loc, "lock-nb", "wr", 0, -1,
+                                {"lk-owner": b"WEDGED"})
+        assert ei.value.err == errno.EAGAIN
+        note = (ei.value.xdata or {}).get("lock-revoked")
+        assert note and note["reason"] == "age" and \
+            note["domain"] == "dom"
+        # the notice is one-shot: the owner may take fresh locks after
+        await locks.inodelk("dom", loc, "unlock", "rd", 0, -1,
+                            {"lk-owner": b"A"})
+        await g.fini()
+
+    asyncio.run(run())
+
+
+def test_revocation_secs_entrylk(tmp_path):
+    """The entrylk twin of the revocation machinery (reference
+    entrylk.c:129-173)."""
+    g = _locks_graph(tmp_path, **{"revocation-secs": "0.3"})
+
+    async def run():
+        await g.activate()
+        locks = g.by_name["locks"]
+        loc = Loc("/")
+        await locks.entrylk("d", loc, "name", "lock", "wr",
+                            {"lk-owner": b"WEDGED"})
+        await asyncio.wait_for(
+            locks.entrylk("d", loc, "name", "lock", "wr",
+                          {"lk-owner": b"B"}), 5)
+        assert locks.revoked_counts.get("age") == 1
+        assert locks.lock_status()["blocked"]["entrylk"] == 0
+        with pytest.raises(FopError) as ei:
+            await locks.entrylk("d", loc, "name", "lock-nb", "wr",
+                                {"lk-owner": b"WEDGED"})
+        assert ei.value.err == errno.EAGAIN
+        assert ei.value.xdata["lock-revoked"]["kind"] == "entrylk"
+        await g.fini()
+
+    asyncio.run(run())
+
+
+def test_revocation_max_blocked(tmp_path):
+    """The queue-depth trigger: blocked queue over max-blocked revokes
+    immediately, no holder aging needed."""
+    g = _locks_graph(tmp_path, **{"revocation-max-blocked": "1"})
+
+    async def run():
+        await g.activate()
+        locks = g.by_name["locks"]
+        loc = Loc("/")
+        await locks.inodelk("d", loc, "lock", "wr", 0, -1,
+                            {"lk-owner": b"H"})
+        waiters = [asyncio.create_task(
+            locks.inodelk("d", loc, "lock", "rd", 0, -1,
+                          {"lk-owner": bytes([65 + i])}))
+            for i in range(2)]
+        await asyncio.wait_for(asyncio.gather(*waiters), 3)
+        assert locks.revoked_counts.get("max-blocked") == 1
+        await g.fini()
+
+    asyncio.run(run())
+
+
+def test_revocation_clear_all_flushes_waiters(tmp_path):
+    """revocation-clear-all: the blocked queue is CLEARED (EAGAIN with
+    the notice) instead of granted."""
+    g = _locks_graph(tmp_path, **{"revocation-secs": "0.3",
+                                  "revocation-clear-all": "on"})
+
+    async def run():
+        await g.activate()
+        locks = g.by_name["locks"]
+        loc = Loc("/")
+        await locks.inodelk("d", loc, "lock", "wr", 0, -1,
+                            {"lk-owner": b"H"})
+        with pytest.raises(FopError) as ei:
+            await asyncio.wait_for(
+                locks.inodelk("d", loc, "lock", "rd", 0, -1,
+                              {"lk-owner": b"W"}), 5)
+        assert ei.value.err == errno.EAGAIN
+        assert ei.value.xdata["lock-revoked"]["reason"] == "age"
+        assert locks.lock_status()["blocked"]["inodelk"] == 0
+        await g.fini()
+
+    asyncio.run(run())
+
+
+def test_clear_locks_kinds(tmp_path):
+    """Operator clear-locks: blocked / granted / all are distinct
+    sweeps over the path's domains."""
+    g = _locks_graph(tmp_path)
+
+    async def run():
+        await g.activate()
+        locks = g.by_name["locks"]
+        loc = Loc("/")
+        await locks.inodelk("d", loc, "lock", "wr", 0, -1,
+                            {"lk-owner": b"H"})
+        w = asyncio.create_task(
+            locks.inodelk("d", loc, "lock", "wr", 0, -1,
+                          {"lk-owner": b"W"}))
+        await asyncio.sleep(0.05)
+        # blocked only: the waiter fails EAGAIN, the holder survives
+        out = await locks.clear_locks("/", "blocked")
+        assert out["total"] == 1 and out["cleared"]["inodelk"] == 1
+        with pytest.raises(FopError):
+            await asyncio.wait_for(w, 2)
+        assert len(locks._inodelk) == 1  # holder still there
+        # granted: the holder goes, a new non-blocking lock succeeds
+        out = await locks.clear_locks("/", "granted")
+        assert out["total"] == 1
+        await locks.inodelk("d2", loc, "lock-nb", "wr", 0, -1,
+                            {"lk-owner": b"N"})
+        out = await locks.clear_locks("/", "all")
+        assert out["total"] == 1
+        assert locks.dump_private()["granted"] == 0
+        with pytest.raises(FopError):
+            await locks.clear_locks("/", "bogus")
+        await g.fini()
+
+    asyncio.run(run())
+
+
+def test_release_client_reaps_scoped_owners_and_waiters(tmp_path):
+    """The disconnect reap (client_t analog): a dead client's granted
+    locks — wire-scoped as identity + b"/" + lk-owner — are released
+    and its parked waiters evicted, WITHOUT waiting revocation-secs."""
+    g = _locks_graph(tmp_path)
+
+    async def run():
+        await g.activate()
+        locks = g.by_name["locks"]
+        loc = Loc("/")
+        ident = b"CLIENT-A"
+        # wire-shaped scoped owner (protocol/server._scope_owner)
+        await locks.inodelk("d", loc, "lock", "wr", 0, -1,
+                            {"lk-owner": ident + b"/o1"})
+        # dead client's own parked waiter (scoped too)
+        w_dead = asyncio.create_task(
+            locks.inodelk("d", loc, "lock", "wr", 0, -1,
+                          {"lk-owner": ident + b"/o2"}))
+        # an innocent bystander behind the same lock
+        w_live = asyncio.create_task(
+            locks.inodelk("d", loc, "lock", "wr", 0, -1,
+                          {"lk-owner": b"B"}))
+        await asyncio.sleep(0.05)
+        n = locks.release_client(ident)
+        assert n == 1, n
+        # the bystander gets the lock; the dead waiter is evicted
+        await asyncio.wait_for(w_live, 2)
+        with pytest.raises(FopError) as ei:
+            await asyncio.wait_for(w_dead, 2)
+        assert ei.value.err == errno.ENOTCONN
+        await g.fini()
+
+    asyncio.run(run())
+
+
+def test_release_client_over_the_wire(tmp_path):
+    """End to end: client A holds a lock through a real brick and
+    DISCONNECTS; client B's blocked request is granted promptly (the
+    server-side reap, not revocation, frees it)."""
+
+    async def run():
+        from glusterfs_tpu.daemon import serve_brick
+
+        server = await serve_brick(LOCKS_VOL.format(
+            d=tmp_path / "brick", opts=""))
+        CLIENT = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume locks
+end-volume
+"""
+
+        async def connect():
+            g = Graph.construct(CLIENT.format(port=server.port))
+            await g.activate()
+            for _ in range(200):
+                if g.top.connected:
+                    break
+                await asyncio.sleep(0.05)
+            assert g.top.connected
+            return g
+
+        ga = await connect()
+        gb = await connect()
+        loc = Loc("/")
+        await ga.top.inodelk("d", loc, "lock", "wr", 0, -1,
+                             {"lk-owner": b"o"})
+        blocked = asyncio.create_task(
+            gb.top.inodelk("d", loc, "lock", "wr", 0, -1,
+                           {"lk-owner": b"o"}))
+        await asyncio.sleep(0.3)
+        assert not blocked.done()
+        t0 = time.perf_counter()
+        await ga.fini()  # A disconnects: the brick reaps its locks
+        await asyncio.wait_for(blocked, 5)
+        assert time.perf_counter() - t0 < 5
+        await gb.fini()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# failfast + circuit breaker (acceptance pins)
+# ---------------------------------------------------------------------------
+
+DELAY_BRICK = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume delay
+    type debug/delay-gen
+    option delay-duration 8000000
+    option delay-percentage 100
+    option enable readv
+    subvolumes posix
+end-volume
+volume locks
+    type features/locks
+    subvolumes delay
+end-volume
+"""
+
+CLIENT_VOL = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+{opts}    option remote-subvolume locks
+end-volume
+"""
+
+
+async def _wire_client(port, **options):
+    opts = "".join(f"    option {k} {v}\n" for k, v in options.items())
+    g = Graph.construct(CLIENT_VOL.format(port=port, opts=opts))
+    await g.activate()
+    for _ in range(200):
+        if g.top.connected:
+            break
+        await asyncio.sleep(0.05)
+    assert g.top.connected, "client never connected"
+    return g
+
+
+def test_failfast_outstanding_frames_under_1s(tmp_path):
+    """Killing a brick with N outstanding frames fails ALL N in under
+    a second — the saved-frames unwind, not N x call-timeout."""
+    b = BrickProc(str(tmp_path), "b0", DELAY_BRICK)
+    b.start()
+
+    async def run():
+        g = await _wire_client(b.port)
+        cl = g.top
+        fd, _ = await cl.create(Loc("/f"), os.O_CREAT | os.O_RDWR,
+                                0o644)
+        await cl.writev(fd, b"z" * 4096, 0)
+        # 16 readvs parked in the brick's 8s delay-gen
+        futs = [asyncio.ensure_future(cl.readv(fd, 16, 0))
+                for _ in range(16)]
+        await asyncio.sleep(0.5)
+        t0 = time.perf_counter()
+        b.kill()
+        res = await asyncio.gather(*futs, return_exceptions=True)
+        dt = time.perf_counter() - t0
+        assert dt < 1.0, f"outstanding frames took {dt:.2f}s to fail"
+        assert all(isinstance(r, FopError) and r.err == errno.ENOTCONN
+                   for r in res)
+        assert cl.failfast_drops == 0  # unwind, not timeout bail
+        await g.fini()
+
+    asyncio.run(run())
+
+
+def test_circuit_opens_then_half_open_probe_closes(tmp_path):
+    """The breaker lifecycle: consecutive transport failures open the
+    circuit at the threshold (further fops shed immediately), and
+    after the reset interval a half-open probe against the recovered
+    brick closes it."""
+    b = BrickProc(str(tmp_path), "b0")
+    b.start()
+
+    async def run():
+        g = await _wire_client(b.port, **{
+            "circuit-failure-threshold": "3",
+            "circuit-reset-interval": "0.5",
+            "idempotent-retries": "0"})
+        cl = g.top
+        await cl.create(Loc("/f"), os.O_CREAT | os.O_RDWR, 0o644)
+        port = b.port
+        b.kill()
+        # burn the transport failures (reconnect-interval keeps trying
+        # in the background; fop_call fails ENOTCONN immediately)
+        for _ in range(200):
+            if not cl.connected:
+                break
+            await asyncio.sleep(0.05)
+        for _ in range(3):
+            with pytest.raises(FopError):
+                await cl.fop_call("stat", Loc("/f"))
+        assert cl._cb_state == "open", cl._cb_state
+        # open circuit sheds instantly, even the error text says so
+        with pytest.raises(FopError) as ei:
+            await cl.fop_call("stat", Loc("/f"))
+        assert "circuit open" in str(ei.value)
+        # brick returns on the same port; the next fop past the reset
+        # interval is the half-open probe — wait for reconnect first
+        # so the probe has a transport to prove
+        b2 = BrickProc(str(tmp_path), "b0")
+        b2.start(port=port)
+        try:
+            for _ in range(300):
+                if cl.connected:
+                    break
+                await asyncio.sleep(0.05)
+            assert cl.connected
+            # handshake success already closes the circuit (the
+            # reconnect-driven recovery path)
+            assert cl._cb_state == "closed"
+            await cl.fop_call("stat", Loc("/f"))
+            await g.fini()
+        finally:
+            b2.kill()
+
+    asyncio.run(run())
+
+
+def test_circuit_half_open_probe_path(tmp_path):
+    """The probe path proper: with the transport UP but fops failing
+    transport-class (error-gen ENOTCONN), the breaker opens, then a
+    half-open probe against a healed brick closes it without any
+    reconnect."""
+    VOL = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume errs
+    type debug/error-gen
+    option error-no ENOTCONN
+    option failure-count {count}
+    option enable stat
+    subvolumes posix
+end-volume
+volume locks
+    type features/locks
+    subvolumes errs
+end-volume
+"""
+    b = BrickProc(str(tmp_path), "b0", VOL.replace("{count}", "3"))
+    b.start()
+
+    async def run():
+        g = await _wire_client(b.port, **{
+            "circuit-failure-threshold": "3",
+            "circuit-reset-interval": "0.3",
+            "idempotent-retries": "0"})
+        cl = g.top
+        fd, _ = await cl.create(Loc("/f"), os.O_CREAT | os.O_RDWR,
+                                0o644)
+        for _ in range(3):
+            with pytest.raises(FopError):
+                await cl.fop_call("stat", Loc("/f"))
+        assert cl._cb_state == "open"
+        await asyncio.sleep(0.4)  # past the reset interval
+        # error budget exhausted: the half-open probe succeeds
+        await cl.fop_call("stat", Loc("/f"))
+        assert cl._cb_state == "closed"
+        await g.fini()
+
+    asyncio.run(run())
+
+
+def test_idempotent_retry_rides_out_transport_blip(tmp_path):
+    """A read-class fop retries through a transport-class failure
+    (error-gen ENOTCONN burns one attempt, the retry lands);
+    write-class fops never retry."""
+    VOL = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume errs
+    type debug/error-gen
+    option error-no ENOTCONN
+    option failure-count 1
+    option enable stat
+    subvolumes posix
+end-volume
+volume locks
+    type features/locks
+    subvolumes errs
+end-volume
+"""
+    b = BrickProc(str(tmp_path), "b0", VOL)
+    b.start()
+
+    async def run():
+        g = await _wire_client(b.port, **{"idempotent-retries": "2"})
+        cl = g.top
+        await cl.create(Loc("/f"), os.O_CREAT | os.O_RDWR, 0o644)
+        ia = await cl.stat(Loc("/f"))  # blip absorbed by one retry
+        assert ia is not None
+        assert cl.retries_total == 1, cl.retries_total
+        await g.fini()
+
+    asyncio.run(run())
+
+
+def test_call_timeout_failfast_bails_transport(tmp_path):
+    """A data fop hitting call-timeout drops the WHOLE transport: the
+    second outstanding frame fails ENOTCONN immediately instead of
+    waiting out its own deadline (the frame-timeout bail)."""
+    b = BrickProc(str(tmp_path), "b0", DELAY_BRICK)
+    b.start()
+
+    async def run():
+        g = await _wire_client(b.port, **{"call-timeout": "1",
+                                          "idempotent-retries": "0"})
+        cl = g.top
+        fd, _ = await cl.create(Loc("/f"), os.O_CREAT | os.O_RDWR,
+                                0o644)
+        await cl.writev(fd, b"z" * 4096, 0)
+        t0 = time.perf_counter()
+        futs = [asyncio.ensure_future(cl.readv(fd, 16, 0))
+                for _ in range(8)]
+        res = await asyncio.gather(*futs, return_exceptions=True)
+        dt = time.perf_counter() - t0
+        # one frame ate the 1s deadline; the rest failed with it —
+        # NOT 8 x 1s serially
+        assert dt < 3.0, f"{dt:.2f}s: frames waited serially"
+        errs = {r.err for r in res if isinstance(r, FopError)}
+        assert errs <= {errno.ETIMEDOUT, errno.ENOTCONN} and errs
+        assert cl.failfast_drops >= 1
+        await g.fini()
+        b.kill()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation + io-threads shedding
+# ---------------------------------------------------------------------------
+
+
+def test_io_threads_drops_expired_deadline(tmp_path):
+    """io-threads sheds work whose client budget expired before a
+    worker freed up (the abandoned-call drop)."""
+    VOL = """
+volume posix
+    type storage/posix
+    option directory {d}
+end-volume
+volume iot
+    type performance/io-threads
+    subvolumes posix
+end-volume
+"""
+    g = Graph.construct(VOL.format(d=tmp_path / "brick"))
+
+    async def run():
+        await g.activate()
+        iot = g.by_name["iot"]
+        from glusterfs_tpu.rpc import wire
+
+        loop = asyncio.get_running_loop()
+        tok = wire.CURRENT_DEADLINE.set(loop.time() - 0.1)  # expired
+        try:
+            with pytest.raises(FopError) as ei:
+                await iot.stat(Loc("/"))
+            assert ei.value.err == errno.ETIMEDOUT
+            assert iot.deadline_dropped == 1
+        finally:
+            wire.CURRENT_DEADLINE.reset(tok)
+        # no deadline: passes
+        await iot.stat(Loc("/"))
+        assert iot.deadline_dropped == 1
+        await g.fini()
+
+    asyncio.run(run())
+
+
+def test_deadline_budget_rides_the_wire(tmp_path):
+    """The client's remaining budget is popped server-side and armed
+    as CURRENT_DEADLINE for the request's dispatch context."""
+    captured = {}
+
+    async def run():
+        from glusterfs_tpu.daemon import serve_brick
+        from glusterfs_tpu.rpc import wire
+        from glusterfs_tpu.storage.posix import PosixLayer
+
+        server = await serve_brick(LOCKS_VOL.format(
+            d=tmp_path / "brick", opts=""))
+        g = await _wire_client(server.port, **{"call-timeout": "7"})
+        real = PosixLayer.stat
+
+        async def spy(self, loc, xdata=None):
+            captured["deadline"] = wire.CURRENT_DEADLINE.get()
+            captured["now"] = asyncio.get_running_loop().time()
+            return await real(self, loc, xdata)
+
+        PosixLayer.stat = spy
+        try:
+            assert g.top._peer_deadline  # advertised at SETVOLUME
+            await g.top.stat(Loc("/"))
+        finally:
+            PosixLayer.stat = real
+        assert captured.get("deadline") is not None, \
+            "deadline never armed brick-side"
+        remaining = captured["deadline"] - captured["now"]
+        assert 0 < remaining <= 7.5, remaining
+        # lock fops are exempt (they park legitimately)
+        captured.clear()
+        await g.top.inodelk("d", Loc("/"), "lock-nb", "wr", 0, -1,
+                            {"lk-owner": b"o"})
+        await g.fini()
+        await server.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# deterministic error-gen
+# ---------------------------------------------------------------------------
+
+
+def test_error_gen_failure_count_exact(tmp_path):
+    """failure-count fails exactly the first N matching fops, then
+    passes — and reconfigure re-arms the budget."""
+    VOL = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume errs
+    type debug/error-gen
+    option failure-count 3
+    option enable stat
+    option error-no ENOSPC
+    subvolumes posix
+end-volume
+"""
+    g = Graph.construct(VOL.format(dir=tmp_path / "brick"))
+
+    async def run():
+        await g.activate()
+        errs = g.by_name["errs"]
+        loc = Loc("/")
+        for i in range(3):
+            with pytest.raises(FopError) as ei:
+                await errs.stat(loc)
+            assert ei.value.err == errno.ENOSPC
+        for _ in range(5):
+            await errs.stat(loc)  # budget spent: passes forever
+        assert errs.injected == 3
+        # other fops never matched
+        await errs.lookup(loc)
+        # reconfigure re-arms in full
+        errs.reconfigure({"failure-count": "2", "enable": "stat",
+                          "error-no": "ENOSPC"})
+        for _ in range(2):
+            with pytest.raises(FopError):
+                await errs.stat(loc)
+        await errs.stat(loc)
+        assert errs.injected == 5
+        await g.fini()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# wedge view + managed clear-locks surface
+# ---------------------------------------------------------------------------
+
+
+def test_lock_status_wedge_view(tmp_path):
+    """dump_private / lock_status show blocked counts and oldest
+    holder age BEFORE revocation fires — the operator's early
+    warning."""
+    g = _locks_graph(tmp_path)
+
+    async def run():
+        await g.activate()
+        locks = g.by_name["locks"]
+        loc = Loc("/")
+        await locks.inodelk("d", loc, "lock", "wr", 0, -1,
+                            {"lk-owner": b"H"})
+        w = asyncio.create_task(
+            locks.inodelk("d", loc, "lock", "wr", 0, -1,
+                          {"lk-owner": b"W"}))
+        await asyncio.sleep(0.25)
+        st = locks.lock_status()
+        assert st["blocked"]["inodelk"] == 1
+        row = st["domains"][0]
+        assert row["kind"] == "inodelk" and row["blocked"] == 1
+        assert row["oldest_holder_secs"] >= 0.2
+        assert row["oldest_waiter_secs"] >= 0.2
+        dp = locks.dump_private()
+        assert dp["blocked"]["inodelk"] == 1 and dp["domains"]
+        w.cancel()
+        await g.fini()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_clear_locks_managed_cli_op(tmp_path):
+    """`gftpu volume clear-locks VOL path kind all` end to end: the
+    glusterd op fans out to real brick subprocesses and clears a wire
+    client's granted lock; the holder's next lock fop carries the
+    notice."""
+
+    async def run():
+        from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                                 mount_volume)
+
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="clv",
+                             vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "b0")}])
+                await c.call("volume-start", name="clv")
+            m = await mount_volume(d.host, d.port, "clv")
+            try:
+                await m.write_file("/f", b"x" * 1024)
+                top = m.graph.top
+                await top.inodelk("app", Loc("/f"), "lock", "wr", 0, -1,
+                                  {"lk-owner": b"wedged"})
+                # the wedge is visible in callpool before clearing
+                st = await d.op_volume_status_deep("clv", "callpool")
+                lk = st["bricks"]["clv-brick-0"]["locks"]
+                assert any(r["domains"] for r in lk), lk
+                out = await d.op_volume_clear_locks("clv", "/f", "all")
+                assert out["total"] == 1, out
+                with pytest.raises(FopError) as ei:
+                    await top.inodelk("app", Loc("/f"), "lock-nb", "wr",
+                                      0, -1, {"lk-owner": b"wedged"})
+                assert ei.value.err == errno.EAGAIN
+                assert ei.value.xdata["lock-revoked"]["reason"] == \
+                    "clear-locks"
+            finally:
+                await m.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+
+
+def test_circuit_families_and_lock_families_registered():
+    """The containment plane's registry families are present."""
+    from glusterfs_tpu.core.metrics import REGISTRY
+
+    snap = REGISTRY.snapshot()
+    for fam in ("gftpu_client_circuit_state",
+                "gftpu_client_retries_total",
+                "gftpu_client_failfast_total",
+                "gftpu_locks_revoked_total",
+                "gftpu_locks_blocked",
+                "gftpu_io_threads_deadline_dropped_total"):
+        assert fam in snap, f"missing family {fam}"

@@ -1,0 +1,619 @@
+#!/usr/bin/env python3
+"""chaos.py — the failure-containment proof harness (ISSUE 9).
+
+Scenario runner over a MANAGED disperse 4+2 volume (glusterd + six
+real brick subprocesses, I/O through the full wire stack): each
+scenario breaks the cluster a specific way and asserts the degraded
+contract the EC/protocol planes promise:
+
+* ``degraded_read``   — SIGKILL a brick mid-write: every write still
+                        lands (5/6 >= quorum), every read with the
+                        brick down is byte-identical, the restarted
+                        brick heals to convergence (heal-count -> 0),
+                        and a read forced THROUGH the healed brick
+                        (disperse.ec-read-mask) is byte-identical.
+* ``quorum_write``    — SIGKILL R+1 bricks: writes fail CLEANLY
+                        (FopError, bounded time, no hang), and after
+                        restart + heal no torn state is visible — the
+                        pre-kill file is byte-identical and the failed
+                        write's target either errors or reads back
+                        exactly what was attempted.
+* ``blackhole``       — SIGSTOP a brick (transport alive, nothing
+                        answers): reads complete degraded within a
+                        bound (ping-timeout + failfast drop, never a
+                        call-timeout serial crawl), byte-identical.
+* ``error_storm``     — debug.error-gen in deterministic
+                        failure-count mode on a brick's readv: reads
+                        stay byte-identical while the injected
+                        failures burn down, and the budget is exact.
+* ``delay_storm``     — debug.delay-gen on every brick's readv:
+                        reads stay correct and bounded.
+* ``gateway``         — the HTTP front door over the same volume
+                        keeps answering (correct bytes or clean
+                        error, never a hang) while a brick is down.
+* ``fuse``            — (--with-fuse only; kernel-dependent) the
+                        mount stays responsive through a brick kill.
+
+Every scenario is wall-clock bounded (a hang IS a failure), and the
+run reports leaked threads/tasks against a warmed baseline — the
+containment plane must not pay for failure handling with leaks.
+
+Usage:
+    python tools/chaos.py [--scenario NAME ...] [--json] [--with-fuse]
+Exit 0 iff every selected scenario passed and nothing leaked.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import numpy as np  # noqa: E402
+
+from glusterfs_tpu.core.fops import FopError  # noqa: E402
+from glusterfs_tpu.core.layer import walk  # noqa: E402
+from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,  # noqa: E402
+                                         mount_volume)
+
+K, R = 4, 2
+N = K + R
+MIB = 1 << 20
+
+#: per-scenario wall-clock bound (a wedged scenario FAILS, it never
+#: hangs the harness)
+SCENARIO_DEADLINE_S = 300.0
+
+SCENARIOS: dict = {}
+
+
+def scenario(name: str):
+    def deco(fn):
+        SCENARIOS[name] = fn
+        return fn
+    return deco
+
+
+def payload_for(i: int, mib: int = 1) -> bytes:
+    return np.random.default_rng(1000 + i).integers(
+        0, 256, mib * MIB, dtype=np.uint8).tobytes()
+
+
+class Stack:
+    """One managed disperse 4+2 stack: glusterd + 6 brick subprocesses
+    + helpers to break and mend them."""
+
+    def __init__(self, base: str, name: str = "chaos"):
+        self.base = base
+        self.name = name
+        self.d: Glusterd | None = None
+
+    async def __aenter__(self):
+        self.d = Glusterd(os.path.join(self.base, "gd"))
+        await self.d.start()
+        async with MgmtClient(self.d.host, self.d.port) as c:
+            await c.call("volume-create", name=self.name,
+                         vtype="disperse", redundancy=R,
+                         bricks=[{"path": os.path.join(self.base,
+                                                       f"b{i}")}
+                                 for i in range(N)])
+            await c.call("volume-start", name=self.name)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.d.stop()
+
+    async def set(self, key: str, value: str) -> None:
+        async with MgmtClient(self.d.host, self.d.port) as c:
+            await c.call("volume-set", name=self.name, key=key,
+                         value=value)
+
+    async def mount(self):
+        cl = await mount_volume(self.d.host, self.d.port, self.name)
+        # calibrate the codec router off the clock (its first device
+        # probe pays jax imports that would eat a scenario's bound)
+        for layer in walk(cl.graph.top):
+            cal = getattr(getattr(layer, "codec", None),
+                          "ensure_calibrated", None)
+            if cal is not None:
+                await cal()
+        return cl
+
+    def brick_name(self, i: int) -> str:
+        return f"{self.name}-brick-{i}"
+
+    def kill_brick(self, i: int, sig=signal.SIGKILL) -> int:
+        """SIGKILL brick i; returns the port it was serving (for the
+        same-port respawn clients expect)."""
+        bname = self.brick_name(i)
+        proc = self.d.bricks.pop(bname)
+        port = self.d.ports.pop(bname)
+        os.kill(proc.pid, sig)
+        proc.wait()
+        return port
+
+    def pause_brick(self, i: int) -> None:
+        os.kill(self.d.bricks[self.brick_name(i)].pid, signal.SIGSTOP)
+
+    def resume_brick(self, i: int) -> None:
+        os.kill(self.d.bricks[self.brick_name(i)].pid, signal.SIGCONT)
+
+    async def restart_brick(self, i: int, port: int) -> None:
+        vol = self.d._vol(self.name)
+        b = next(x for x in vol["bricks"]
+                 if x["name"] == self.brick_name(i))
+        await self.d._spawn_brick(vol, b, port=port)
+
+    async def heal_until_converged(self, timeout: float = 120.0) -> dict:
+        """heal full, then poll heal-count to 0 (convergence proof)."""
+        res = await self.d.op_volume_heal(self.name, "full")
+        deadline = time.monotonic() + timeout
+        while True:
+            hc = await self.d.op_volume_heal_count(self.name)
+            if hc.get("total", -1) == 0 and "partial" not in hc:
+                return {"healed": res, "heal_count": hc["total"]}
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"heal never converged: {hc}")
+            await asyncio.sleep(1.0)
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+
+@scenario("degraded_read")
+async def degraded_read(base: str, opts) -> dict:
+    """Brick SIGKILL mid-write -> degraded byte-identical reads ->
+    restart -> heal converges -> the healed brick serves reads."""
+    out: dict = {}
+    n_files = 6
+    victim = 2
+    async with Stack(base) as st:
+        cl = await st.mount()
+        try:
+            pay = [payload_for(i) for i in range(n_files)]
+            # writes in flight when the brick dies: the kill lands
+            # mid-stream, not between fops
+            writes = [asyncio.ensure_future(
+                cl.write_file(f"/f{i}", pay[i])) for i in range(n_files)]
+            await asyncio.sleep(0.3)
+            port = st.kill_brick(victim)
+            out["killed_mid_write"] = sum(1 for w in writes
+                                          if not w.done())
+            await asyncio.gather(*writes)
+            # degraded reads: one brick down, byte-identical
+            datas = await asyncio.gather(*(cl.read_file(f"/f{i}")
+                                           for i in range(n_files)))
+            assert all(bytes(d) == p for d, p in zip(datas, pay)), \
+                "degraded read parity broken"
+            out["degraded_reads_ok"] = n_files
+            # restart + heal to convergence
+            await st.restart_brick(victim, port)
+            conv = await st.heal_until_converged()
+            out["heal_count_after"] = conv["heal_count"]
+        finally:
+            await cl.unmount()
+        # the healed brick must actually SERVE: force it into the
+        # read set (ec-read-mask is strict) with exactly K ids
+        mask = ",".join(str(i) for i in
+                        [victim] + [i for i in range(N)
+                                    if i != victim][:K - 1])
+        await st.set("disperse.ec-read-mask", mask)
+        cl2 = await st.mount()
+        try:
+            datas = await asyncio.gather(*(cl2.read_file(f"/f{i}")
+                                           for i in range(n_files)))
+            assert all(bytes(d) == p for d, p in zip(datas, pay)), \
+                "post-heal read through the healed brick broke parity"
+            out["healed_brick_serves"] = True
+        finally:
+            await cl2.unmount()
+    return out
+
+
+@scenario("quorum_write")
+async def quorum_write(base: str, opts) -> dict:
+    """R+1 bricks dead -> writes fail cleanly; after restart + heal
+    nothing torn is visible."""
+    out: dict = {}
+    async with Stack(base) as st:
+        cl = await st.mount()
+        pre = payload_for(100)
+        attempted = payload_for(101)
+        ports = {}
+        try:
+            await cl.write_file("/pre", pre)
+            # make /pre DURABLE before the blast: fsync forces the
+            # eager window's version/size commit onto all six bricks.
+            # Without it the deferred post-op would reach only the
+            # three survivors — a below-K version split that is
+            # legitimately unhealable once the others return (a
+            # non-fsynced write's durability is quorum-best-effort,
+            # here we are testing the durable file's contract)
+            f = await cl.open("/pre", os.O_RDWR)
+            await f.fsync()
+            await f.close()
+            for i in range(R + 1):   # 3 dead of 6: 3 < K=4
+                ports[i] = st.kill_brick(i)
+            t0 = time.monotonic()
+            try:
+                await asyncio.wait_for(cl.write_file("/torn", attempted),
+                                       60)
+                raise AssertionError(
+                    "below-quorum write succeeded (3/6 bricks up)")
+            except FopError as e:
+                out["write_failed_cleanly"] = repr(e)[:120]
+            out["fail_latency_s"] = round(time.monotonic() - t0, 2)
+        finally:
+            await cl.unmount()
+        for i, port in ports.items():
+            await st.restart_brick(i, port)
+        conv = await st.heal_until_converged()
+        out["heal_count_after"] = conv["heal_count"]
+        cl2 = await st.mount()
+        try:
+            got = await cl2.read_file("/pre")
+            assert bytes(got) == pre, "pre-kill file torn after recovery"
+            out["pre_file_intact"] = True
+            # the failed write must not be VISIBLY torn: either a clean
+            # error, or exactly the attempted bytes (had it reached
+            # quorum after all) — never a mangled in-between
+            try:
+                got = await asyncio.wait_for(cl2.read_file("/torn"), 60)
+                assert bytes(got) == attempted, \
+                    "failed write left torn bytes visible"
+                out["failed_write_state"] = "complete"
+            except FopError as e:
+                out["failed_write_state"] = f"clean error {e.err}"
+        finally:
+            await cl2.unmount()
+    return out
+
+
+@scenario("blackhole")
+async def blackhole(base: str, opts) -> dict:
+    """SIGSTOP a brick: the transport stays up but answers nothing —
+    ping-timeout + disconnect failfast turn it into a bounded degrade,
+    not a call-timeout crawl."""
+    out: dict = {}
+    victim = 1
+    async with Stack(base) as st:
+        cl = await st.mount()
+        try:
+            pay = payload_for(200)
+            await cl.write_file("/bh", pay)
+            st.pause_brick(victim)
+            try:
+                t0 = time.monotonic()
+                # several reads: the FIRST eats the ping-timeout
+                # detection window, the rest ride the dropped child
+                for _ in range(3):
+                    got = await asyncio.wait_for(cl.read_file("/bh"), 60)
+                    assert bytes(got) == pay, "blackhole read parity"
+                dt = time.monotonic() - t0
+                out["blackhole_3_reads_s"] = round(dt, 2)
+                assert dt < 45, f"blackhole reads not bounded: {dt:.1f}s"
+                # a write through the same hole also completes (5/6)
+                await asyncio.wait_for(
+                    cl.write_file("/bh2", pay[:256 * 1024]), 60)
+                out["blackhole_write_ok"] = True
+            finally:
+                st.resume_brick(victim)
+        finally:
+            await cl.unmount()
+    return out
+
+
+@scenario("error_storm")
+async def error_storm(base: str, opts) -> dict:
+    """debug.error-gen deterministic failure-count storm: every
+    brick's readv fails exactly N times, then passes.  While the
+    budget burns a read either succeeds byte-identical or fails
+    CLEANLY within its bound (never a hang, never wrong bytes); once
+    it is spent — deterministically, no probability/seed tuning —
+    reads recover and STAY byte-identical."""
+    out: dict = {}
+    async with Stack(base) as st:
+        cl = await st.mount()
+        try:
+            pay = payload_for(300)
+            await cl.write_file("/es", pay)
+        finally:
+            await cl.unmount()
+        # arm the storm: exactly 4 readv failures per brick, then pass
+        await st.set("debug.error-gen", "on")
+        await st.set("debug.error-fops", "readv")
+        await st.set("debug.error-number", "EIO")
+        await st.set("debug.error-failure-count", "4")
+        cl = await st.mount()
+        try:
+            clean_failures = 0
+            recovered_at = None
+            streak = 0
+            for i in range(24):
+                try:
+                    got = await asyncio.wait_for(cl.read_file("/es"), 60)
+                    assert bytes(got) == pay, \
+                        "error-storm served WRONG bytes"
+                    streak += 1
+                    if recovered_at is None:
+                        recovered_at = i
+                    if streak >= 5:
+                        break
+                except FopError:
+                    clean_failures += 1
+                    streak = 0
+                    recovered_at = None
+            assert streak >= 5, \
+                f"reads never recovered after the deterministic " \
+                f"budget ({clean_failures} failures)"
+            out["clean_failures_during_storm"] = clean_failures
+            out["recovered_at_attempt"] = recovered_at
+        finally:
+            await cl.unmount()
+        await st.set("debug.error-gen", "off")
+    return out
+
+
+@scenario("delay_storm")
+async def delay_storm(base: str, opts) -> dict:
+    """debug.delay-gen on every brick's readv: correctness and a
+    bounded completion under injected latency."""
+    out: dict = {}
+    async with Stack(base) as st:
+        cl = await st.mount()
+        try:
+            pay = payload_for(400)
+            await cl.write_file("/ds", pay)
+        finally:
+            await cl.unmount()
+        await st.set("debug.delay-gen", "on")
+        await st.set("debug.delay-fops", "readv")
+        await st.set("debug.delay-duration", "200000")  # 200ms
+        await st.set("debug.delay-percent", "100")
+        cl = await st.mount()
+        try:
+            t0 = time.monotonic()
+            got = await asyncio.wait_for(cl.read_file("/ds"), 90)
+            dt = time.monotonic() - t0
+            assert bytes(got) == pay, "delay-storm read parity"
+            out["delayed_read_s"] = round(dt, 2)
+        finally:
+            await cl.unmount()
+        await st.set("debug.delay-gen", "off")
+    return out
+
+
+@scenario("gateway")
+async def gateway(base: str, opts) -> dict:
+    """The HTTP front door stays responsive while a brick is down:
+    correct bytes or a clean error within a deadline — never a hang."""
+    from glusterfs_tpu.api.glfs import Client, wait_connected
+    from glusterfs_tpu.core.graph import Graph
+    from glusterfs_tpu.gateway import ClientPool, ObjectGateway
+    from glusterfs_tpu.gateway.minihttp import fetch as http
+
+    out: dict = {}
+    async with Stack(base) as st:
+        async with MgmtClient(st.d.host, st.d.port) as c:
+            spec = await c.call("getspec", name=st.name)
+
+        async def factory():
+            g = Graph.construct(spec["volfile"])
+            gcl = Client(g)
+            await gcl.mount()
+            await wait_connected(g)
+            return gcl
+
+        gw = ObjectGateway(ClientPool(factory, 2), volume=st.name)
+        await gw.start()
+        try:
+            body = payload_for(500, 1)[:512 * 1024]
+            s, _, _ = await http(gw.host, gw.port, "PUT", "/b")
+            assert s == 200, s
+            s, _, _ = await http(gw.host, gw.port, "PUT", "/b/obj",
+                                 body=body)
+            assert s == 200, s
+            # let the EC eager window's deferred size commit land
+            # before breaking things: cross-pool-client read-after-PUT
+            # coherence is bounded by the post-op delay (~eager-lock-
+            # timeout), and THIS scenario measures degraded
+            # responsiveness, not that (documented) window
+            deadline = time.monotonic() + 10
+            while True:
+                s, _, data = await http(gw.host, gw.port, "GET",
+                                        "/b/obj")
+                if s == 200 and data == body:
+                    break
+                assert time.monotonic() < deadline, \
+                    f"healthy GET never settled ({s}, {len(data)}B)"
+                await asyncio.sleep(0.3)
+            port = st.kill_brick(3)
+            t0 = time.monotonic()
+            s, _, data = await asyncio.wait_for(
+                http(gw.host, gw.port, "GET", "/b/obj"), 60)
+            assert s == 200 and data == body, \
+                f"degraded gateway GET broke ({s})"
+            out["degraded_get_s"] = round(time.monotonic() - t0, 2)
+            s, _, _ = await asyncio.wait_for(
+                http(gw.host, gw.port, "PUT", "/b/obj2",
+                     body=body[:64 * 1024]), 60)
+            assert s in (200, 503), f"degraded PUT hung or broke ({s})"
+            out["degraded_put_status"] = s
+            await st.restart_brick(3, port)
+        finally:
+            await gw.stop()
+    return out
+
+
+@scenario("fuse")
+async def fuse(base: str, opts) -> dict:
+    """Kernel-mount responsiveness through a brick kill (gated behind
+    --with-fuse: /dev/fuse behavior is kernel-dependent in sandboxes)."""
+    if not opts.with_fuse:
+        return {"skipped": "pass --with-fuse to run (kernel-dependent)"}
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..", "tests"))
+    from harness import spawn_fuse, stop_fuse
+
+    out: dict = {}
+    async with Stack(base) as st:
+        mnt = os.path.join(base, "mnt")
+        os.makedirs(mnt)
+        proc = spawn_fuse(f"127.0.0.1:{st.d.port}", st.name,
+                          os.path.join(base, "ready"), mnt)
+        try:
+            pay = payload_for(600)
+
+            def timed(fn, seconds, label):
+                box: dict = {}
+
+                def work():
+                    try:
+                        box["v"] = fn()
+                    except BaseException as e:  # noqa: BLE001
+                        box["e"] = e
+
+                th = threading.Thread(target=work, daemon=True)
+                th.start()
+                th.join(seconds)
+                if th.is_alive():
+                    raise TimeoutError(f"fuse {label} hung")
+                if "e" in box:
+                    raise box["e"]
+                return box.get("v")
+
+            timed(lambda: open(os.path.join(mnt, "f"), "wb").write(pay),
+                  120, "write")
+            port = st.kill_brick(4)
+            got = timed(lambda: open(os.path.join(mnt, "f"),
+                                     "rb").read(), 120, "degraded read")
+            assert got == pay, "fuse degraded read parity"
+            out["fuse_degraded_read_ok"] = True
+            await st.restart_brick(4, port)
+        finally:
+            stop_fuse(proc, mnt)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+async def warmup(base: str) -> None:
+    """Spin every process-wide lazy pool (client event pool, codec
+    probe, wirec build) BEFORE the leak baseline: those threads are
+    by-design persistent, not leaks."""
+    async with Stack(os.path.join(base, "warm"), name="warm") as st:
+        cl = await st.mount()
+        try:
+            pay = payload_for(0)
+            await cl.write_file("/w", pay)
+            assert bytes(await cl.read_file("/w")) == pay
+        finally:
+            await cl.unmount()
+
+
+def live_threads() -> set:
+    return {t.name for t in threading.enumerate() if t.is_alive()}
+
+
+async def settle_tasks(grace: float = 3.0) -> list:
+    """Let teardown finish, then report still-pending tasks (excluding
+    the runner itself)."""
+    me = asyncio.current_task()
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        rest = [t for t in asyncio.all_tasks() if t is not me]
+        if not rest:
+            return []
+        await asyncio.sleep(0.2)
+    return [repr(t)[:120] for t in asyncio.all_tasks() if t is not me]
+
+
+async def amain(opts) -> dict:
+    names = opts.scenario or [n for n in SCENARIOS if n != "fuse"]
+    if opts.with_fuse and "fuse" not in names:
+        names.append("fuse")
+    for n in names:
+        if n not in SCENARIOS:
+            raise SystemExit(f"unknown scenario {n!r} "
+                             f"(have: {', '.join(SCENARIOS)})")
+    root = tempfile.mkdtemp(prefix="gftpu-chaos")
+    report: dict = {"ok": True, "scenarios": {},
+                    "host_cores": len(os.sched_getaffinity(0))}
+    try:
+        await warmup(root)
+        baseline_threads = live_threads()
+        for name in names:
+            base = os.path.join(root, name)
+            os.makedirs(base, exist_ok=True)
+            t0 = time.monotonic()
+            try:
+                detail = await asyncio.wait_for(
+                    SCENARIOS[name](base, opts), SCENARIO_DEADLINE_S)
+                detail["ok"] = True
+            except BaseException as e:  # noqa: BLE001 - report, don't die
+                detail = {"ok": False, "error": repr(e)[:300]}
+                report["ok"] = False
+            detail["elapsed_s"] = round(time.monotonic() - t0, 1)
+            report["scenarios"][name] = detail
+            print(f"[chaos] {name}: "
+                  f"{'ok' if detail['ok'] else 'FAIL'} "
+                  f"({detail['elapsed_s']}s)", file=sys.stderr)
+        # leak audit: nothing the failure paths spun up may survive
+        leaked_tasks = await settle_tasks()
+        # codec/executor threads shut down asynchronously: poll out
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            leaked = sorted(live_threads() - baseline_threads)
+            if not leaked:
+                break
+            time.sleep(0.3)
+        report["leaked_threads"] = leaked
+        report["leaked_tasks"] = leaked_tasks
+        if leaked or leaked_tasks:
+            report["ok"] = False
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return report
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--scenario", action="append",
+                   help="scenario name (repeatable); default = all "
+                        "except fuse")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--with-fuse", action="store_true",
+                   help="include the kernel-mount scenario")
+    opts = p.parse_args()
+    report = asyncio.run(amain(opts))
+    if opts.json:
+        print(json.dumps(report, indent=1, default=repr))
+    else:
+        for name, d in report["scenarios"].items():
+            print(f"{name}: {'ok' if d.get('ok') else 'FAIL'}  {d}")
+        print(f"leaked_threads={report['leaked_threads']} "
+              f"leaked_tasks={len(report['leaked_tasks'])}")
+        print("chaos:", "GREEN" if report["ok"] else "RED")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

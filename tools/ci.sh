@@ -38,6 +38,13 @@
 #                               mesh-armed BatchingCodec asserting the
 #                               gftpu_mesh_launches_total family
 #                               appears with origin=serve (ISSUE 8)
+#   7. chaos smoke              ONE bounded failure-containment
+#                               scenario (tools/chaos.py
+#                               degraded_read): brick SIGKILL
+#                               mid-write -> degraded reads
+#                               byte-identical -> restart -> heal
+#                               converges -> the healed brick serves,
+#                               with the zero-leak audit (ISSUE 9)
 #
 # Usage:  tools/ci.sh [extra pytest args for the tier-1 runs...]
 # Exit: first failing stage's code; 0 = mergeable.
@@ -501,11 +508,21 @@ if [ $mesh_rc -ne 0 ]; then
     exit $mesh_rc
 fi
 
+echo "== ci: chaos smoke (brick kill -> degraded read parity ->"
+echo "       restart -> heal converges; zero-leak audit) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python tools/chaos.py --scenario degraded_read --json
+chaos_rc=$?
+if [ $chaos_rc -ne 0 ]; then
+    echo "ci: chaos smoke failed — not mergeable"
+    exit $chaos_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
 fi
 echo "ci: mergeable (two identical green tier-1 runs + bench contract"
 echo "    + metrics smoke + gateway smoke + concurrency smoke"
-echo "    + mesh smoke)"
+echo "    + mesh smoke + chaos smoke)"
 exit 0
