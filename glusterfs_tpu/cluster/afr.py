@@ -783,6 +783,14 @@ class ReplicateLayer(Layer):
             loc, fd.gfid, "writev",
             lambda i: ((self._child_fd(fd, i), data, offset), {}))
 
+    async def xorv(self, fd: FdObj, data, offset: int,
+                   xdata: dict | None = None):
+        # the parity-delta apply is disperse-internal (issued by EC to
+        # its own children): the base-class first-child forward would
+        # silently diverge the replicas, so refuse loudly instead
+        raise FopError(errno.EOPNOTSUPP,
+                       f"{self.name}: xorv is disperse-internal")
+
     async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
         ia, _ = await self.lookup(loc)
         return await self._write_txn(loc, ia.gfid, "truncate",

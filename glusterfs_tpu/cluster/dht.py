@@ -730,6 +730,13 @@ class DistributeLayer(Layer):
         i, cfd = await self._fd_target(fd)
         return await self.children[i].writev(cfd, data, offset, xdata)
 
+    async def xorv(self, fd: FdObj, data, offset: int,
+                   xdata: dict | None = None):
+        # routed like writev (fd-addressed data fop): the base-class
+        # first-child default would land the delta on the wrong subvol
+        i, cfd = await self._fd_target(fd)
+        return await self.children[i].xorv(cfd, data, offset, xdata)
+
     async def flush(self, fd: FdObj, xdata: dict | None = None):
         i, cfd = await self._fd_target(fd)
         return await self.children[i].flush(cfd, xdata)

@@ -56,12 +56,46 @@ _metrics.REGISTRY.register_objects(
     lambda l: [({"layer": l.name, "what": m}, v)
                for m, v in l.read_coalesced.items()],
     live=_LIVE_EC_LAYERS)
+# parity-delta write plane (ISSUE 10): which path each unaligned write
+# took, and what the delta path saved over the full read-modify-write
+_metrics.REGISTRY.register_objects(
+    "gftpu_ec_delta_writes_total", "counter",
+    "sub-stripe writes served by the parity-delta path (touched data "
+    "slices + brick-side parity xorv; no k-fragment decode)",
+    lambda l: [({"layer": l.name}, l.write_path["delta"])],
+    live=_LIVE_EC_LAYERS)
+_metrics.REGISTRY.register_objects(
+    "gftpu_ec_rmw_writes_total", "counter",
+    "unaligned writes that paid the full read-modify-write (degraded, "
+    "non-systematic, EOF-crossing, delta-writes off, or a peer "
+    "without xorv)",
+    lambda l: [({"layer": l.name}, l.write_path["rmw"])],
+    live=_LIVE_EC_LAYERS)
+_metrics.REGISTRY.register_objects(
+    "gftpu_ec_delta_bytes_saved_total", "counter",
+    "fragment bytes the delta path did NOT move versus the full RMW "
+    "it replaced (dir=read: decode-source bytes not read; dir=write: "
+    "fragment bytes not rewritten)",
+    lambda l: [({"layer": l.name, "dir": d}, v)
+               for d, v in l.delta_saved.items()],
+    live=_LIVE_EC_LAYERS)
 from ..core.options import Option
 from ..core import gflog
+from ..core import tracing as _tracing
 from ..ops import codec as codec_mod
 from ..rpc import wire
 
+import time as _time
+
 log = gflog.get_logger("ec")
+
+
+class _DeltaFallback(Exception):
+    """Internal: the parity-delta path bailed before committing
+    anything it cannot undo (live-downgraded peer, failed internal
+    read) — the caller redoes the write through the full-RMW path,
+    which rewrites every fragment of the region and converges any
+    partially-applied wave."""
 
 XA_VERSION = "trusted.ec.version"
 XA_SIZE = "trusted.ec.size"
@@ -174,6 +208,23 @@ class DisperseLayer(Layer):
                            "ec_is_range_conflict ec-common.c:185)"),
         Option("quorum-count", "int", default=0, min=0,
                description="extra write quorum (0 = K)"),
+        Option("delta-writes", "bool", default="on",
+               description="parity-delta sub-stripe writes "
+                           "(cluster.delta-writes, op-version 12): on a "
+                           "HEALTHY systematic volume an unaligned "
+                           "write inside the file reads back only the "
+                           "bytes it overwrites from the touched data "
+                           "fragments, forms Δ = old ⊕ new, and "
+                           "dispatches the touched data slices as "
+                           "writev plus parity(Δ) as brick-side xorv — "
+                           "one wave, no k-fragment decode, no "
+                           "n-fragment rewrite (the classic RAID "
+                           "parity-logging result; linearity: "
+                           "frag(old ⊕ Δ) = frag(old) ⊕ frag(Δ)).  "
+                           "Degraded / non-systematic / EOF-crossing "
+                           "writes (and peers without xorv) keep the "
+                           "full read-modify-write path byte-"
+                           "identically"),
         Option("systematic", "bool", default="off",
                description="systematic generator matrix "
                            "(gf256.systematic_matrix): data fragments "
@@ -277,6 +328,14 @@ class DisperseLayer(Layer):
         # links of one compound chain merged into ONE ranged brick
         # read per fan-out
         self.read_coalesced = {"chains": 0, "links": 0}
+        # parity-delta write plane (ISSUE 10): path taken per unaligned
+        # write + fragment bytes the delta path saved over full RMW
+        self.write_path = {"delta": 0, "rmw": 0}
+        self.delta_saved = {"read": 0, "write": 0}
+        # live-downgrade memory: a parity brick answering EOPNOTSUPP to
+        # xorv parks the WHOLE layer on the RMW path (parity rows are
+        # fixed brick indices — one refusing brick breaks every delta)
+        self._xorv_ok = True
         # last announced "≥K children up" state (events.h
         # EVENT_EC_MIN_BRICKS_UP / _NOT_UP fire on the transition)
         self._min_up_ok = True
@@ -313,6 +372,13 @@ class DisperseLayer(Layer):
                 mesh=self.opts["mesh-codec"], name=self.name)
         self._batching = self.opts["stripe-cache"]
         self._read_mask = self._parse_read_mask()
+        if self.opts["delta-writes"]:
+            # re-arm the downgrade memory on ANY reconfigure that
+            # leaves the key on: volume-set is the operator's "bricks
+            # were upgraded, try again" signal, and a still-downgraded
+            # peer re-parks at the client-side capability gate for the
+            # cost of one local EOPNOTSUPP (no round trip)
+            self._xorv_ok = True
 
     def _parse_read_mask(self) -> frozenset[int] | None:
         """ec_assign_read_mask (ec.c:717-775): parse + validate — every
@@ -735,10 +801,20 @@ class DisperseLayer(Layer):
     async def _dispatch(self, idxs: list[int], op: str, argfn):
         """Run fop on children idxs concurrently; returns {idx: result or
         exception}.  argfn(i) -> (args, kwargs) per child."""
+        return await self._dispatch_multi(
+            {i: (op, *argfn(i)) for i in idxs}, order=idxs)
+
+    async def _dispatch_multi(self, wave: dict[int, tuple],
+                              order: list[int] | None = None):
+        """Concurrent dispatch with a (possibly) DIFFERENT fop per
+        child: ``wave[i] = (op, args, kwargs)`` — the delta write
+        path's one mixed wave of data-slice writev + parity xorv, and
+        the engine under :meth:`_dispatch`."""
+        idxs = sorted(wave) if order is None else order
         if self._local_children:
             out = {}
             for i in idxs:
-                args, kwargs = argfn(i)
+                op, args, kwargs = wave[i]
                 try:
                     out[i] = await getattr(self.children[i], op)(*args,
                                                                  **kwargs)
@@ -747,7 +823,7 @@ class DisperseLayer(Layer):
             return out
 
         async def one(i):
-            args, kwargs = argfn(i)
+            op, args, kwargs = wave[i]
             return await getattr(self.children[i], op)(*args, **kwargs)
 
         results = await asyncio.gather(*(one(i) for i in idxs),
@@ -1433,8 +1509,210 @@ class DisperseLayer(Layer):
             st.pre_landed.set()
         return {i: r for i, r in res.items() if i in ok}
 
+    # -- parity-delta sub-stripe writes (ISSUE 10) -------------------------
+
+    def _delta_eligible(self, st: _EagerState, data, offset: int) -> bool:
+        """May this write take the parity-delta path?  Healthy
+        systematic volumes only, write strictly inside the true size,
+        unaligned (an aligned write is a pure encode already), key on,
+        and no brick has refused xorv.  Everything else keeps the
+        full-RMW path byte-identically."""
+        if not (data and self.opts["systematic"]
+                and self.opts["delta-writes"] and self._xorv_ok):
+            return False
+        end = offset + len(data)
+        if offset % self.stripe == 0 and end % self.stripe == 0:
+            return False  # aligned: no RMW to beat
+        if end > st.size:
+            return False  # EOF-crossing/extending (zero tails, size)
+        every = set(range(self.n))
+        # a stale fragment XOR'd with a parity delta diverges from the
+        # codeword forever: every brick must be up, in the window's
+        # good set, and meta-consistent
+        return st.good == every and set(st.candidates) == every \
+            and all(self.up)
+
+    def _delta_plan(self, data_len: int, offset: int):
+        """Map a write [offset, offset+data_len) onto the systematic
+        layout: per touched data fragment j, the list of copy pieces
+        ``(frag_off, ulo, uhi)`` — user bytes [ulo, uhi) live verbatim
+        at fragment byte ``frag_off`` (chunk j of each stripe).  Pieces
+        of one fragment tile a single contiguous fragment range (one
+        contiguous user interval intersects each 512-byte chunk window
+        at most once per stripe, and consecutive stripes are adjacent
+        in fragment space)."""
+        end = offset + data_len
+        a_off = offset // self.stripe * self.stripe
+        a_end = (end + self.stripe - 1) // self.stripe * self.stripe
+        pieces: dict[int, list[tuple[int, int, int]]] = {}
+        for s in range(a_off // self.stripe, a_end // self.stripe):
+            base = s * self.stripe
+            for j in range(self.k):
+                u0 = base + j * CHUNK
+                lo, hi = max(u0, offset), min(u0 + CHUNK, end)
+                if lo < hi:
+                    pieces.setdefault(j, []).append(
+                        (s * CHUNK + (lo - u0), lo, hi))
+        return a_off, a_end, pieces
+
+    async def _writev_delta(self, fd: FdObj, loc: Loc, st: _EagerState,
+                            data: bytes, offset: int):
+        """The parity-delta wave: read back ONLY the overwritten bytes
+        from the touched data fragments, form Δ = old ⊕ new, then ONE
+        wave of touched-data writev + parity xorv(parity(Δ)) — no
+        k-fragment decode, no n-fragment rewrite.  Untouched data
+        bricks see no fop and KEEP their good status (their chunks did
+        not change; the window's post-op version wave still covers
+        them).  Rides the same pre-op/good-set/poison/quorum machinery
+        as every write wave."""
+        end = offset + len(data)
+        a_off, a_end, pieces = self._delta_plan(len(data), offset)
+        a_len = a_end - a_off
+        f_len = a_len // self.k
+        intervals: dict[int, tuple[int, int]] = {}
+        for j, ps in pieces.items():
+            lo = ps[0][0]
+            hi = ps[-1][0] + (ps[-1][2] - ps[-1][1])
+            if hi - lo != sum(uhi - ulo for _f, ulo, uhi in ps):
+                raise _DeltaFallback()  # non-contiguous (cannot happen)
+            intervals[j] = (lo, hi)
+        span = _tracing.enter(self.name, "delta-write") \
+            if _tracing.ENABLED else None
+        t0 = _time.perf_counter()
+        failed = True
+        try:
+            # old bytes: one ranged readv per touched data fragment —
+            # internal write reads, never subject to the read mask
+            res = await self._dispatch(
+                sorted(intervals), "readv",
+                lambda i: ((self._child_fd(fd, i),
+                            intervals[i][1] - intervals[i][0],
+                            intervals[i][0]), {}))
+            if any(isinstance(r, BaseException) for r in res.values()):
+                raise _DeltaFallback()  # read trouble: RMW sorts it out
+            newbuf = np.zeros(a_len, dtype=np.uint8)
+            newbuf[offset - a_off: end - a_off] = np.frombuffer(
+                bytes(data), dtype=np.uint8)
+            delta = newbuf.copy()  # becomes old ⊕ new inside the range
+            read_bytes = 0
+            for j, ps in pieces.items():
+                lo = intervals[j][0]
+                arr = np.frombuffer(wire.as_single_buffer(res[j]),
+                                    dtype=np.uint8)
+                read_bytes += intervals[j][1] - lo
+                for frag_off, ulo, uhi in ps:
+                    piece = arr[frag_off - lo:
+                                frag_off - lo + (uhi - ulo)]
+                    if piece.size:  # a short tail XORs against zeros
+                        delta[ulo - a_off:
+                              ulo - a_off + piece.size] ^= piece
+            pdeltas = await self._codec_delta(delta)
+            f_off = a_off // self.k
+            wave: dict[int, tuple] = {}
+            data_write_bytes = 0
+            for j, ps in pieces.items():
+                lo, hi = intervals[j]
+                wbuf = np.concatenate(
+                    [newbuf[ulo - a_off: uhi - a_off]
+                     for _f, ulo, uhi in ps])
+                data_write_bytes += wbuf.size
+                wave[j] = ("writev", (self._child_fd(fd, j),
+                                      wbuf.tobytes(), lo), {})
+            for p in range(self.k, self.n):
+                wave[p] = ("xorv", (self._child_fd(fd, p),
+                                    pdeltas[p - self.k].tobytes(),
+                                    f_off), {})
+            if not st.pre:
+                # pre-op once per window (the writev piggyback does not
+                # apply: the wave targets a subset of the pre set)
+                pre_targets = sorted(st.good)
+                await self._xattrop(pre_targets, loc,
+                                    {XA_DIRTY: _pack_u64x2(1, 0)})
+                st.pre = set(pre_targets)
+            st.inflight += 1
+            st.idle.clear()
+            ok: set[int] | None = None
+            unsupported: set[int] = set()
+            res = {}
+            try:
+                res = await self._dispatch_multi(wave)
+                unsupported = {i for i, r in res.items()
+                               if isinstance(r, FopError)
+                               and r.err == errno.EOPNOTSUPP
+                               and wave[i][0] == "xorv"}
+                ok = {i for i, r in res.items()
+                      if not isinstance(r, BaseException)}
+            finally:
+                # DELIBERATELY narrower poison than _window_op's
+                # `good &= ok`: this wave targets a SUBSET of good, and
+                # the untargeted data bricks are still current (their
+                # chunks did not change), so only targeted failures
+                # drop.  _window_op's full wave targets good∩up, where
+                # dropping every non-ok brick (down ones included) is
+                # the right call — keep both semantics in view when
+                # editing either site.
+                if ok is None:
+                    st.good -= set(wave)  # torn-off wave: poison all
+                else:
+                    # an EOPNOTSUPP brick applied NOTHING, and the
+                    # immediate full-RMW redo rewrites every fragment
+                    # of this region on all good bricks — keep it good
+                    st.good -= set(wave) - ok - unsupported
+                st.inflight -= 1
+                if st.inflight == 0:
+                    st.idle.set()
+            if unsupported:
+                self._xorv_ok = False
+                log.warning(3, "%s: brick(s) %s have no xorv (live "
+                            "downgrade?) — parity-delta writes off, "
+                            "full RMW from here", self.name,
+                            sorted(unsupported))
+                raise _DeltaFallback()
+            # quorum over SURVIVING good bricks, not wave oks: the
+            # untargeted data bricks count toward the file's
+            # consistent set (under _window_op's full wave the two
+            # formulations coincide — targets ARE good∩up there)
+            if len(st.good & set(self._up_idx())) < self._write_quorum():
+                errs = [r.err for r in res.values()
+                        if isinstance(r, FopError)]
+                err = Counter(errs).most_common(1)[0][0] if errs \
+                    else errno.EIO
+                raise FopError(err, f"delta write quorum lost "
+                                    f"({len(st.good)}/{self.n})")
+            st.delta += 1
+            st.candidates = sorted(st.good)
+            if st.pre:
+                st.pre_landed.set()
+            # what the replaced RMW would have moved: a k-fragment
+            # aligned-region read + an n-fragment rewrite
+            rmw_read = max(
+                0, min(a_end, self._frag_len(st.size) * self.k) - a_off)
+            self.write_path["delta"] += 1
+            self.delta_saved["read"] += max(0, rmw_read - read_bytes)
+            self.delta_saved["write"] += max(
+                0, self.n * f_len
+                - (data_write_bytes + self.r * f_len))
+            ia = next(r for r in res.values()
+                      if not isinstance(r, BaseException))
+            ia = Iatt(**{**ia.__dict__})
+            st.size = max(st.size, end)
+            ia.size = st.size
+            failed = False
+            return ia
+        finally:
+            if span is not None:
+                _tracing.exit_span(span, _time.perf_counter() - t0,
+                                   failed)
+
     async def _writev_in_window(self, fd: FdObj, loc: Loc, st: _EagerState,
-                                data: bytes, offset: int):
+                                data: bytes, offset: int,
+                                allow_delta: bool = True):
+        if allow_delta and self._delta_eligible(st, data, offset):
+            try:
+                return await self._writev_delta(fd, loc, st, data,
+                                                offset)
+            except _DeltaFallback:
+                pass  # downgraded peer / read trouble: full RMW below
         true_size = st.size
         end = offset + len(data)
         a_off = offset // self.stripe * self.stripe
@@ -1446,6 +1724,7 @@ class DisperseLayer(Layer):
                                   offset > true_size):
             have_end = min(a_end, self._frag_len(true_size) * self.k)
             if have_end > a_off:
+                self.write_path["rmw"] += 1
                 old = await self._read_aligned(
                     fd, a_off, have_end - a_off, list(st.candidates))
                 buf[: old.size] = old
@@ -1532,7 +1811,11 @@ class DisperseLayer(Layer):
                      int(self.opts["self-heal-window-size"]))
         while length > 0:
             n = min(window, length)
-            await self._writev_in_window(fd, loc, st, b"\0" * n, offset)
+            # allocation-class edges keep the full-RMW path (ISSUE 10
+            # fallback matrix): zerofill semantics are size-coupled and
+            # the RMW path is their long-proven shape
+            await self._writev_in_window(fd, loc, st, b"\0" * n, offset,
+                                         allow_delta=False)
             offset += n
             length -= n
 
@@ -1874,6 +2157,14 @@ class DisperseLayer(Layer):
             return await self.codec.encode_async(buf, origin=origin)
         return self.codec.encode(buf)
 
+    async def _codec_delta(self, buf, origin: str = "serve"):
+        """Parity-rows-only delta encode through the batching window
+        (coalesced delta flushes ride the same measured ladder)."""
+        if self._batching:
+            return await self.codec.encode_delta_async(buf,
+                                                       origin=origin)
+        return self.codec.encode_delta(buf)
+
     async def _codec_decode(self, frags, rows, origin: str = "serve"):
         if self._batching:
             return await self.codec.decode_async(frags, rows,
@@ -1897,6 +2188,9 @@ class DisperseLayer(Layer):
             "up": self.up, "up_count": sum(self.up),
             "read_fanout": dict(self.read_fanout),
             "read_coalesced": dict(self.read_coalesced),
+            "write_path": dict(self.write_path),
+            "delta_saved": dict(self.delta_saved),
+            "xorv_ok": self._xorv_ok,
             "eager_windows": len(self._eager),
             "stripe_cache": self.codec.dump_stats(),
         }

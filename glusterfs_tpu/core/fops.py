@@ -77,6 +77,12 @@ class Fop(enum.Enum):
     NAMELINK = "namelink"
     COPY_FILE_RANGE = "copy_file_range"
     COMPOUND = "compound"
+    # parity-delta write plane (ISSUE 10): read-xor-write at a fragment
+    # offset, served by storage/posix in one journal-batched pass.  A
+    # write-class fop that must NEVER be blindly retried: XOR is an
+    # involution, so a double-applied delta self-cancels (the client's
+    # idempotent-retry allowlist is read-class only and excludes it).
+    XORV = "xorv"
 
 
 #: Fops that modify data or metadata (drive version/dirty accounting in the
@@ -87,6 +93,7 @@ WRITE_FOPS = frozenset({
     Fop.CREATE, Fop.FTRUNCATE, Fop.XATTROP, Fop.FXATTROP, Fop.FSETXATTR,
     Fop.SETATTR, Fop.FSETATTR, Fop.FREMOVEXATTR, Fop.FALLOCATE, Fop.DISCARD,
     Fop.ZEROFILL, Fop.PUT, Fop.ICREATE, Fop.NAMELINK, Fop.COPY_FILE_RANGE,
+    Fop.XORV,
 })
 
 

@@ -76,6 +76,15 @@ class BitRotStubLayer(Layer):
             raise FopError(errno.EIO, "object quarantined (bit-rot)")
         return await self.children[0].rchecksum(fd, offset, length, xdata)
 
+    async def xorv(self, fd: FdObj, data, offset: int,
+                   xdata: dict | None = None):
+        # parity-delta applies are client data writes (heal rebuilds
+        # full fragments via writev, never xorv): a quarantined object
+        # stays fenced against them like any other mutation
+        if self._deny(fd.gfid):
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        return await self.children[0].xorv(fd, data, offset, xdata)
+
     async def writev(self, fd: FdObj, data: bytes, offset: int,
                      xdata: dict | None = None):
         healing = bool((xdata or {}).get(HEAL_WRITE))

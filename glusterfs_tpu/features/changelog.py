@@ -33,7 +33,13 @@ log = gflog.get_logger("changelog")
 E_FOPS = {Fop.CREATE, Fop.MKNOD, Fop.MKDIR, Fop.UNLINK, Fop.RMDIR,
           Fop.SYMLINK, Fop.RENAME, Fop.LINK, Fop.ICREATE, Fop.PUT}
 D_FOPS = {Fop.WRITEV, Fop.TRUNCATE, Fop.FTRUNCATE, Fop.FALLOCATE,
-          Fop.DISCARD, Fop.ZEROFILL, Fop.COPY_FILE_RANGE, Fop.PUT}
+          Fop.DISCARD, Fop.ZEROFILL, Fop.COPY_FILE_RANGE, Fop.PUT,
+          # a parity-delta apply mutates data: journal it wherever it
+          # lands (volgen additionally disables delta-writes under a
+          # changelog-armed disperse graph — the UNTOUCHED data bricks
+          # of a delta wave see no fop at all, which would starve a
+          # geo-rep Active worker tailing one of them)
+          Fop.XORV}
 M_FOPS = {Fop.SETATTR, Fop.FSETATTR, Fop.SETXATTR, Fop.FSETXATTR,
           Fop.REMOVEXATTR, Fop.FREMOVEXATTR}
 

@@ -263,6 +263,14 @@ class LocksLayer(Layer):
                               offset + len(data), True)
         return await self.children[0].writev(fd, data, offset, xdata)
 
+    async def xorv(self, fd: FdObj, data, offset: int,
+                   xdata: dict | None = None):
+        # the parity-delta apply is a write: mandatory locking must
+        # fence it exactly like writev (same byte range)
+        self._mandatory_check(fd.gfid, xdata, offset,
+                              offset + len(data), True)
+        return await self.children[0].xorv(fd, data, offset, xdata)
+
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         # (gfid, domain) -> _LockDomain for inodelks;

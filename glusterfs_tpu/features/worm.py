@@ -90,6 +90,18 @@ class WormLayer(Layer):
             return
         raise FopError(errno.EROFS, "worm: file retained")
 
+    async def xorv(self, fd: FdObj, data, offset: int,
+                   xdata: dict | None = None):
+        # the parity-delta apply mutates stored bytes exactly like an
+        # overwriting writev (read-xor-write is ALWAYS an overwrite):
+        # the same retention fences must hold, or a delta wave's parity
+        # half would slip past WORM while its data half is denied
+        if self._file_level():
+            await self._deny_file_level(Loc(fd.path, gfid=fd.gfid))
+        elif self._on():
+            raise FopError(errno.EROFS, "worm: overwrite denied")
+        return await self.children[0].xorv(fd, data, offset, xdata)
+
     async def writev(self, fd: FdObj, data, offset: int,
                      xdata: dict | None = None):
         if self._file_level():

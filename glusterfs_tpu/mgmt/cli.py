@@ -252,7 +252,9 @@ async def _run(args) -> Any:
                 vtype = "replicate"
                 group = int(rest[1])
                 rest = rest[2:]
-            arbiter = thin = systematic = 0
+            arbiter = thin = 0
+            systematic = -1  # unset: disperse defaults systematic at
+            # cluster op-version >= 12 (explicit opt-out below)
             if rest and rest[0] == "arbiter":
                 arbiter = int(rest[1])
                 rest = rest[2:]
@@ -263,6 +265,11 @@ async def _run(args) -> Any:
                 # fragment format flag (create-time only; see
                 # cluster/disperse "systematic")
                 systematic = 1
+                rest = rest[1:]
+            elif rest and rest[0] == "non-systematic":
+                # explicit opt-out of the systematic default (the
+                # mesh codec tier has no systematic mode yet)
+                systematic = 0
                 rest = rest[1:]
             bricks = [{"path": b.split(":", 1)[-1],
                        "host": "127.0.0.1"} for b in rest]

@@ -755,10 +755,17 @@ class Glusterd:
                                group_size: int = 0,
                                arbiter: int = 0,
                                thin_arbiter: int = 0,
-                               systematic: int = 0) -> dict:
+                               systematic: int = -1) -> dict:
         """bricks: list of {host, port(optional: mgmt node), path} or
         'host:/path' strings; host must match a node's host:port mgmt id
-        or 'localhost'."""
+        or 'localhost'.
+
+        ``systematic``: -1 (unset) defaults NEW disperse volumes to the
+        systematic code layout once the whole cluster is at op-version
+        12 (ROADMAP item 5's standing note; the parity-delta write
+        plane is the write-side justification, zero-decode healthy
+        reads were the read side).  Explicit 0 opts out (CLI:
+        ``volume create ... non-systematic``)."""
         if name in self.state["volumes"]:
             raise MgmtError(f"volume {name} exists")
         if name.startswith("snap-"):
@@ -793,6 +800,12 @@ class Glusterd:
                 raise MgmtError("thin-arbiter needs replica 2 + one "
                                 "tie-breaker brick (3 bricks)")
             volinfo["thin-arbiter"] = 1
+        if systematic < 0:
+            # default-on for new disperse volumes (explicit opt-out
+            # only), mixed-version guarded: a pre-12 peer's volgen
+            # would hand out non-systematic volfiles for this volume
+            systematic = 1 if vtype == "disperse" and \
+                self.cluster_op_version() >= 12 else 0
         if systematic:
             if vtype != "disperse":
                 raise MgmtError("systematic applies to disperse volumes")
@@ -943,6 +956,15 @@ class Glusterd:
             # see docs/volume_options.md)
             raise MgmtError(f"unsupported transport {value!r} "
                             "(this build speaks tcp)")
+        if key == "cluster.mesh-codec" and volgen._bool(value) and \
+                self._vol(name).get("systematic"):
+            # the mesh tier has no systematic mode (ops/batch only
+            # warms it on non-systematic codecs): storing the key
+            # would silently do nothing — refuse loudly instead
+            raise MgmtError(
+                "cluster.mesh-codec has no systematic mode yet and "
+                f"volume {name!r} uses the systematic layout "
+                "(create with 'non-systematic' to use the mesh tier)")
         results = await self._cluster_txn(
             "volume-set", {"name": name, "key": key, "value": value})
         return {"ok": True,
@@ -2015,7 +2037,12 @@ class Glusterd:
         volinfo = _new_volinfo(self.state, clonename, base["type"],
                                bricks, base.get("redundancy", 0))
         volinfo["options"] = dict(base.get("options", {}))
-        for key in ("group-size", "arbiter", "thin-arbiter"):
+        # systematic rides along: the clone serves the snapped
+        # FRAGMENTS, and the fragment format is a property of those
+        # bytes — a non-systematic volfile over systematic fragments
+        # decodes to garbage (and vice versa)
+        for key in ("group-size", "arbiter", "thin-arbiter",
+                    "systematic"):
             if key in base:
                 volinfo[key] = base[key]
         await self._cluster_txn("snapshot-clone", {

@@ -99,6 +99,9 @@ OPTION_MAP = {
     # mesh-sharded codec data plane (ISSUE 8): coalesced stripe
     # batches ride the (dp, frag) device mesh when >1 device is up
     "cluster.mesh-codec": ("cluster/disperse", "mesh-codec"),
+    # parity-delta sub-stripe writes (ISSUE 10): healthy systematic
+    # volumes update small writes as touched-data writev + parity xorv
+    "cluster.delta-writes": ("cluster/disperse", "delta-writes"),
     "disperse.read-policy": ("cluster/disperse", "read-policy"),
     "disperse.quorum-count": ("cluster/disperse", "quorum-count"),
     "disperse.eager-lock": ("cluster/disperse", "eager-lock"),
@@ -717,6 +720,18 @@ _V11_KEYS = (
 )
 OPTION_MIN_OPVERSION.update({k: 11 for k in _V11_KEYS})
 
+# round-13 addition ships at op-version 12: the parity-delta write
+# plane — the key routes sub-stripe writes through the xorv fop, which
+# a v11 brick does not serve (the client's capability gate would fall
+# back per write, wasting the advertisement round trip), and op-version
+# 12 is also the cluster floor for volgen's systematic-by-default
+# disperse layout (an older peer's volgen would hand out
+# non-systematic volfiles for the same volume)
+_V12_KEYS = (
+    "cluster.delta-writes",
+)
+OPTION_MIN_OPVERSION.update({k: 12 for k in _V12_KEYS})
+
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key
 DEFAULT_PERF_STACK = [
@@ -981,6 +996,16 @@ def build_client_volfile(volinfo: dict,
                 # live — see cluster/disperse "systematic")
                 opts["systematic"] = "on"
             opts.update(layer_options(volinfo, "cluster/disperse"))
+            if _enabled(volinfo, "changelog.changelog", False) and \
+                    "delta-writes" not in opts:
+                # geo-rep tails ONE brick's changelog per disperse
+                # group (gsyncd Active-worker election assumes every
+                # brick journals the same logical ops) — a delta
+                # wave's UNTOUCHED data bricks journal nothing, so the
+                # tailed brick could silently miss writes.  Full RMW
+                # journals on every brick; an explicit
+                # cluster.delta-writes=on from the operator still wins
+                opts["delta-writes"] = "off"
             out.append(_emit(lname, "cluster/disperse", opts, children))
         elif vtype == "replicate":
             lname = f"{vname}-replicate-{idx}"
@@ -1102,6 +1127,13 @@ def build_client_volfile(volinfo: dict,
                 # stripes instead of partial edges
                 lopts["page-size"] = str(
                     max(ec_stripe, (128 << 10) // ec_stripe * ec_stripe))
+            if ec_stripe and ltype == "performance/write-behind" and \
+                    "stripe-size" not in lopts:
+                # the write-side twin (ISSUE 10): pressure drains cut
+                # at stripe boundaries, so streamed (gateway
+                # chunked-PUT) writes hit EC's aligned fast path
+                # instead of paying head/tail RMW every chunk
+                lopts["stripe-size"] = str(ec_stripe)
             out.append(_emit(lname, ltype, lopts, [top]))
             top = lname
     if _enabled(volinfo, "performance.client-io-threads", False) and \

@@ -174,6 +174,11 @@ class BatchingCodec(Codec):
         self._enc_task: asyncio.Task | None = None
         self._dec_q: dict[tuple[int, ...], list[tuple]] = {}
         self._dec_task: asyncio.Task | None = None
+        # parity-delta queue (ISSUE 10): coalesced sub-stripe write
+        # deltas ride the same flush ladder as full encodes — one
+        # parity-rows-only launch per flush
+        self._delta_q: list[tuple] = []
+        self._delta_task: asyncio.Task | None = None
         self._cpu = None  # lazy small-batch codec
         self.launches = 0
         self.cpu_launches = 0
@@ -237,6 +242,11 @@ class BatchingCodec(Codec):
         with self._lock:
             self.launches += 1
         return super().decode(frags, rows)
+
+    def encode_delta(self, delta: np.ndarray) -> np.ndarray:
+        with self._lock:
+            self.launches += 1
+        return super().encode_delta(delta)
 
     def _small(self) -> Codec:
         if self._cpu is None:
@@ -499,6 +509,18 @@ class BatchingCodec(Codec):
         frags = self.encode(data)
         return frags[:, : s * self.fragment_chunk]
 
+    def _delta_bucketed(self, delta: np.ndarray) -> np.ndarray:
+        """Device parity-delta encode with zero-stripe bucket padding
+        (zero stripes have zero parity deltas — sliced back off)."""
+        s = delta.size // self.stripe_size
+        sb = _bucket_stripes(s)
+        if sb != s:
+            delta = np.concatenate(
+                [delta, np.zeros((sb - s) * self.stripe_size,
+                                 dtype=np.uint8)])
+        pds = self.encode_delta(delta)
+        return pds[:, : s * self.fragment_chunk]
+
     def _decode_bucketed(self, frags: np.ndarray, rows) -> np.ndarray:
         w = frags.shape[1]
         s = w // self.fragment_chunk
@@ -610,6 +632,75 @@ class BatchingCodec(Codec):
                 fut.set_exception(err)
             else:
                 fut.set_result(results[i])
+
+    # -- parity-delta encode (ISSUE 10) ------------------------------------
+
+    async def encode_delta_async(self, delta: np.ndarray,
+                                 origin: str = "serve") -> np.ndarray:
+        """Parity deltas for a stripe-aligned XOR delta; coalesced with
+        concurrent calls exactly like ``encode_async`` (fragment-stream
+        concatenation holds for the parity submatrix too — stripes are
+        independent).  Deltas ride the measured flush ladder; the mesh
+        tier never applies (it has no systematic mode, and delta
+        encodes exist only on systematic volumes)."""
+        delta = np.ascontiguousarray(delta, dtype=np.uint8).ravel()
+        if delta.size % self.stripe_size:
+            raise ValueError("delta length not a multiple of the stripe")
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._delta_q.append((delta, fut, origin, _tracing.current_id()))
+        if sum(d.size for d, *_ in self._delta_q) >= self.max_batch_bytes:
+            self._flush_deltas()
+        elif self._delta_task is None:
+            self._delta_task = asyncio.ensure_future(self._delta_timer())
+        return await fut
+
+    async def _delta_timer(self):
+        await asyncio.sleep(self.window)
+        self._flush_deltas()
+
+    def _flush_deltas(self) -> None:
+        if self._delta_task is not None:
+            self._delta_task.cancel()
+            self._delta_task = None
+        batch, self._delta_q = self._delta_q, []
+        if not batch:
+            return
+        self._last_flush = time.monotonic()
+        self.batched_fops += len(batch)
+        self.max_batch = max(self.max_batch, len(batch))
+        total = sum(d.size for d, *_ in batch)
+        codec, kind = self._route(total)
+        if kind == "mesh":
+            kind = "device"  # no mesh systematic mode (defensive)
+        if kind == "cpu" and codec is not self:
+            self.cpu_launches += 1
+        loop = asyncio.get_running_loop()
+        self._submit(self._run_delta, loop, batch, codec, kind, total)
+
+    def _run_delta(self, loop, batch, codec: Codec, kind: str,
+                   total: int) -> None:
+        try:
+            t0 = time.perf_counter()
+            if len(batch) == 1:
+                cat = batch[0][0]
+            else:
+                cat = np.concatenate([d for d, *_ in batch])
+            if kind == "device":
+                pds = self._delta_bucketed(cat)
+            else:
+                pds = codec.encode_delta(cat)
+            # the single-device models track full-generator encodes;
+            # parity-only work would skew them low — don't observe
+            results, off = [], 0
+            for d, *_ in batch:
+                flen = d.size // self.k
+                results.append(pds[:, off:off + flen].copy()
+                               if len(batch) > 1 else pds)
+                off += flen
+            loop.call_soon_threadsafe(self._resolve, batch, results, None)
+        except Exception as e:
+            loop.call_soon_threadsafe(self._resolve, batch, None, e)
 
     # -- decode ------------------------------------------------------------
 

@@ -394,6 +394,45 @@ class Codec:
                                     systematic=True)
         return gf256.ref_encode(data, self.k, self.n, systematic=True)
 
+    def encode_delta(self, delta: np.ndarray) -> np.ndarray:
+        """Parity-fragment deltas ((n-k), len/k) of a stripe-aligned
+        XOR delta — the sub-stripe write primitive (parity-delta /
+        parity-logging): linearity gives ``frag_i(old ⊕ Δ) =
+        frag_i(old) ⊕ frag_i(Δ)``, and on a systematic volume the data
+        rows of Δ are the overwritten bytes themselves, so a small
+        write ships only the touched data slices plus these parity
+        deltas (brick-side ``xorv`` applies them in place).  Only the
+        parity submatrix of the generator is applied — no backend
+        touches the k identity rows."""
+        if not self.systematic:
+            raise ValueError("delta encode needs the systematic layout "
+                             "(non-systematic fragments are all "
+                             "codewords; there is no verbatim data row "
+                             "to delta against)")
+        delta = np.ascontiguousarray(delta, dtype=np.uint8).ravel()
+        if delta.size % self.stripe_size:
+            raise ValueError(
+                f"delta length {delta.size} not a multiple of stripe "
+                f"{self.stripe_size}")
+        b = self.backend
+        if b in ("pallas-xor", "pallas-mxu"):
+            from . import gf256_pallas
+
+            return gf256_pallas.parity(delta, self.k, self.n)
+        if b == "native":
+            from glusterfs_tpu import native
+
+            # gf_encode walks whatever (rows, k*8) bit-matrix it is
+            # handed: the parity submatrix with n-k output fragments
+            return native.encode(delta, self.k, self.n - self.k,
+                                 gf256.parity_bits_cached(self.k, self.n))
+        if b in ("xla", "xla-xor"):
+            from . import gf256_xla
+
+            form = "xor" if b == "xla-xor" else "matmul"
+            return gf256_xla.parity(delta, self.k, self.n, form)
+        return gf256.ref_parity(delta, self.k, self.n)
+
     def reassemble(self, bufs, rows, frag_len: int) -> np.ndarray | None:
         """Healthy systematic fast path straight from fragment BUFFERS
         (the zero-staging lane of the read fan-out, ISSUE 3): when every

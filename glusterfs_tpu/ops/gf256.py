@@ -284,6 +284,32 @@ def ref_encode(data: np.ndarray, k: int, n: int,
     )
 
 
+def ref_parity(data: np.ndarray, k: int, n: int) -> np.ndarray:
+    """Parity rows ONLY of the systematic code: ((n-k), S*512) for
+    stripe-major bytes (length multiple of k*512).
+
+    This is the delta-encode primitive of the parity-delta write plane
+    (the classic RAID parity-logging result): the code is linear, so
+    ``frag_i(old ⊕ Δ) = frag_i(old) ⊕ frag_i(Δ)`` — a sub-stripe write
+    ships the overwritten data bytes verbatim (systematic data rows ARE
+    the stripe chunks) plus ``parity(Δ)`` applied brick-side as an XOR
+    (the ``xorv`` fop), never re-encoding the untouched rows."""
+    data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
+    if data.size % (k * CHUNK_SIZE):
+        raise ValueError("data length must be a multiple of k*512")
+    pbits = parity_bits_cached(k, n)
+    x = _to_planes(data, k)  # (S, k*8, 64)
+    y = _xor_matmul_planes(pbits, x)  # (S, (n-k)*8, 64)
+    m = n - k
+    s = x.shape[0]
+    return (
+        y.reshape(s, m, GF_BITS * WORD_SIZE)
+        .transpose(1, 0, 2)
+        .reshape(m, s * CHUNK_SIZE)
+        .copy()
+    )
+
+
 def frags_to_planes(frags: np.ndarray, k: int) -> np.ndarray:
     """Fragment-major (k, S*512) -> stripe-major plane words (S, k*8, 64)
     (inverse of ref_encode's output transform)."""

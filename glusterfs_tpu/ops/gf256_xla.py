@@ -138,6 +138,45 @@ def _decode_fn(k: int, formulation: str, rows: tuple[int, ...] | None,
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=64)
+def _parity_fn(k: int, n: int, formulation: str):
+    """jitted: stripe-major bytes -> parity fragments ONLY
+    ((n-k), S*512) of the systematic code — the delta-encode of the
+    parity-delta write plane (only the generator's parity submatrix is
+    applied; the data rows of a delta are shipped verbatim)."""
+    if formulation == "xor":
+        prog = gf256.parity_program(k, n)
+        pbits_np = None
+    else:
+        pbits_np = gf256.parity_bits_cached(k, n)
+    m = n - k
+
+    def run(data: jnp.ndarray) -> jnp.ndarray:
+        s = data.shape[0] // (k * gf256.CHUNK_SIZE)
+        x = data.reshape(s, k * 8, gf256.WORD_SIZE)
+        if formulation == "xor":
+            y = _apply_program(prog, x)
+        else:
+            y = _apply_matmul(jnp.asarray(pbits_np), x)
+        return (
+            y.reshape(s, m, gf256.CHUNK_SIZE)
+            .transpose(1, 0, 2)
+            .reshape(m, s * gf256.CHUNK_SIZE)
+        )
+
+    return jax.jit(run)
+
+
+def parity(data: np.ndarray, k: int, n: int,
+           formulation: str = "matmul") -> np.ndarray:
+    """Systematic parity rows ((n-k), S*512) for stripe-major bytes."""
+    data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
+    if data.size % (k * gf256.CHUNK_SIZE):
+        raise ValueError("data length must be a multiple of k*512")
+    out = _parity_fn(k, n, formulation)(jnp.asarray(data))
+    return np.asarray(out)
+
+
 def encode(data: np.ndarray, k: int, n: int, formulation: str = "matmul",
            systematic: bool = False) -> np.ndarray:
     """Encode bytes (len multiple of k*512) -> (n, S*512) fragments."""

@@ -71,6 +71,10 @@ NORMAL = {Fop.READV, Fop.WRITEV, Fop.FLUSH, Fop.FSYNC, Fop.CREATE,
           Fop.SYMLINK, Fop.MKNOD, Fop.TRUNCATE, Fop.FTRUNCATE,
           Fop.SETXATTR, Fop.FSETXATTR, Fop.XATTROP, Fop.FXATTROP,
           Fop.SETATTR, Fop.FSETATTR,
+          # parity-delta applies are data-path write work: the slow
+          # queue would invert them vs the sibling data writevs of
+          # the SAME delta wave
+          Fop.XORV,
           # fused chains are data-path work (create+writev+flush);
           # the slow queue would invert their priority vs their links
           Fop.COMPOUND}

@@ -61,6 +61,21 @@ class WriteBehindLayer(Layer):
                            "drain is one fused writev chain, and flush "
                            "rides the same frame as the final drain "
                            "instead of its own round trip"),
+        Option("stripe-size", "int", default=0, min=0,
+               description="align window flush cut points to this "
+                           "stripe size (volgen sets the EC stripe "
+                           "when the window sits above a disperse "
+                           "graph): PRESSURE drains cut at the last "
+                           "stripe boundary and keep the sub-stripe "
+                           "TAIL absorbed, so a streamed writer (the "
+                           "gateway's chunked PUT) hits the aligned "
+                           "encode path instead of paying a tail "
+                           "read-modify-write per chunk.  A stream "
+                           "that STARTS unaligned still pays its one "
+                           "intrinsic head partial on the first drain "
+                           "(holding the head back could never align "
+                           "it).  flush/fsync/read/release still "
+                           "drain everything; 0 = cut anywhere"),
     )
 
     def __init__(self, *args, **kw):
@@ -104,16 +119,49 @@ class WriteBehindLayer(Layer):
         self.window_bytes += ctx.bytes - before
 
     async def _drain(self, fd: FdObj, ctx: _WbFd,
-                     tail: tuple = ()) -> list | None:
+                     tail: tuple = (), partial: bool = False) -> list | None:
         """Flush the window.  With compound-fops on, a multi-chunk
         window (or any window with a ``tail`` of extra links, e.g. the
         flush that triggered the drain) goes down as ONE fused chain;
         otherwise the historical per-chunk writev loop runs and the
         tail is the caller's business.  Returns the tail's reply
-        entries when a chain carried them, else None."""
+        entries when a chain carried them, else None.
+
+        ``partial`` (pressure drains only) with ``stripe-size`` set:
+        the flush cuts at the last stripe boundary of each chunk and
+        RETAINS the sub-stripe tail in the window — the next absorbed
+        write extends it, so a streamed sequential writer below a
+        disperse graph pays no TAIL partial per chunk (every retained
+        cut is stripe-aligned, so all drains after a stream's first
+        start aligned too; an unaligned stream START keeps its one
+        intrinsic head partial — holding it back could never align
+        it).  Ordering is safe: the retained tail stays newest-data
+        in the window, and every full-drain site (flush/fsync/read/
+        fstat/release/compound) still empties it."""
         async with ctx.lock:
-            self.window_bytes -= ctx.bytes
-            chunks, ctx.chunks, ctx.bytes = ctx.chunks, [], 0
+            chunks = ctx.chunks
+            keep: list[tuple[int, bytearray]] = []
+            s = self.opts["stripe-size"]
+            if partial and s:
+                flushable = []
+                for off, buf in chunks:
+                    cut = (off + len(buf)) // s * s
+                    if cut <= off:
+                        keep.append((off, buf))  # all sub-stripe: hold
+                        continue
+                    flushable.append((off, buf[: cut - off]))
+                    if cut - off < len(buf):
+                        keep.append((cut, buf[cut - off:]))
+                if flushable:
+                    chunks = flushable
+                else:
+                    keep = []  # nothing aligned: flush everything —
+                    # the window must stay bounded even for pathological
+                    # all-sub-stripe patterns
+            ctx.chunks = keep
+            before = ctx.bytes
+            ctx.bytes = sum(len(b) for _, b in keep)
+            self.window_bytes -= before - ctx.bytes
             if self.opts["compound-fops"] and chunks and \
                     (len(chunks) + len(tail)) > 1:
                 links = [("writev", (fd, bytes(buf), off), {})
@@ -182,7 +230,9 @@ class WriteBehindLayer(Layer):
         agg = self.opts["aggregate-size"]
         if ctx.bytes >= self.opts["window-size"] or \
                 (agg and any(len(b) >= agg for _, b in ctx.chunks)):
-            await self._drain(fd, ctx)
+            # pressure drain: stripe-aligned cut points (the sub-stripe
+            # tail stays absorbed for the next write to extend)
+            await self._drain(fd, ctx, partial=True)
             self._raise_deferred(ctx)
         ia = ctx.last_iatt
         if ia is None:

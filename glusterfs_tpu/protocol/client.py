@@ -254,6 +254,8 @@ class ClientLayer(Layer):
         self.failfast_drops = 0
         # did the brick advertise deadline-budget arming at SETVOLUME?
         self._peer_deadline = False
+        # did the brick advertise the xorv fop (parity-delta writes)?
+        self._peer_xorv = False
         _LIVE_CLIENT_LAYERS.add(self)
         # reopen bookkeeping (client-handshake.c reopen_fd_count):
         # live fds with server-side handles (value = (fd, reopen fop)),
@@ -368,6 +370,10 @@ class ClientLayer(Layer):
         # reserved request field before dispatch (older bricks would
         # pass it into the fop signature)
         self._peer_deadline = bool(res.get("deadline"))
+        # parity-delta writes: only bricks that serve xorv (op-version
+        # 12).  A missing key fails the fop EOPNOTSUPP locally — zero
+        # round trips wasted per write against a live-downgraded brick
+        self._peer_xorv = bool(res.get("xorv"))
         # re-open tracked fds and re-acquire held locks BEFORE CHILD_UP
         # (client_child_up_reopen_done): parents must never see an "up"
         # child whose fd handles are stale
@@ -920,6 +926,30 @@ class ClientLayer(Layer):
                     self._note_fd_result(fop, val, args)
             out.append([st, val])
         return out
+
+    async def xorv(self, fd: FdObj, data, offset: int,
+                   xdata: dict | None = None):
+        """Parity-delta apply (ISSUE 10).  Capability-gated: a brick
+        that did not advertise ``xorv`` at SETVOLUME (op-version < 12,
+        or live-downgraded under us) fails EOPNOTSUPP HERE, without a
+        round trip — the EC layer treats that as "peer speaks full RMW
+        only" and falls back.  Write-class: deliberately NOT in the
+        idempotent-retry allowlist (a replayed XOR self-cancels)."""
+        if self.connected and not self._peer_xorv:
+            raise FopError(errno.EOPNOTSUPP,
+                           f"{self.name}: peer has no xorv "
+                           "(pre-op-version-12 brick)")
+        kwargs = {"xdata": xdata} if xdata is not None else {}
+        try:
+            return await self.fop_call("xorv", fd, data, offset,
+                                       **kwargs)
+        except FopError as e:
+            if e.err in (errno.EOPNOTSUPP, errno.ENOSYS):
+                # reconfigured/downgraded brick answered: remember so
+                # later writes skip the wasted round trip
+                self._peer_xorv = False
+                raise FopError(errno.EOPNOTSUPP, str(e)) from None
+            raise
 
     def _forget_revoked(self, note: dict) -> None:
         """A 'lock-revoked' notice arrived on a lock fop's EAGAIN

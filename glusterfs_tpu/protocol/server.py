@@ -1107,7 +1107,13 @@ class BrickServer:
                                        # deadline-budget arming: this
                                        # build pops the reserved
                                        # request field before dispatch
-                                       "deadline": True}
+                                       "deadline": True,
+                                       # parity-delta write plane
+                                       # (op-version 12): this brick
+                                       # serves the xorv fop — a peer
+                                       # that never sees this key
+                                       # keeps the full-RMW path
+                                       "xorv": True}
             if not conn.authed:
                 # SETVOLUME gates everything — pings included (no
                 # pre-auth liveness probing; server.c refuses requests

@@ -1226,6 +1226,54 @@ class PosixLayer(Layer):
             raise _fop_errno(e)
         return self._iatt_gfid(fd.gfid)
 
+    async def xorv(self, fd: FdObj, data: bytes, offset: int,
+                   xdata: dict | None = None):
+        """Read-xor-write at a byte offset (the parity-delta write
+        plane's brick half, ISSUE 10): the stored bytes become
+        ``stored ⊕ data`` in one local pass, so a parity-fragment
+        update costs the client ZERO read round trips.  Bytes past EOF
+        read as zeros (``0 ⊕ d = d``), so a delta landing on a sparse
+        or short region degenerates to a plain write.  The whole op
+        runs under one journal batch (the pre-xattrop marker's sidecar
+        append coalesces with it).  Write-class and NEVER blindly
+        retried: XOR self-cancels on double-apply."""
+        self._check_reserve()
+        with self.journal_batch():
+            pre = (xdata or {}).get("pre-xattrop")
+            if pre:
+                await self.fxattrop(fd, "add64", dict(pre), None)
+            fdno = self._os_fd(fd)
+
+            def work():
+                old = b""
+                pos = offset
+                want = len(data)
+                while len(old) < want:
+                    chunk = os.pread(fdno, want - len(old),
+                                     pos + len(old))
+                    if not chunk:
+                        break  # EOF: the rest XORs against zeros
+                    old += chunk
+                buf = bytearray(data)
+                if old:
+                    x = int.from_bytes(old, "little") ^ \
+                        int.from_bytes(buf[: len(old)], "little")
+                    buf[: len(old)] = x.to_bytes(len(old), "little")
+                view = memoryview(buf)
+                pos = offset
+                while view:
+                    n = os.pwrite(fdno, view, pos)
+                    if n <= 0:
+                        raise FopError(errno.EIO, "short write")
+                    view = view[n:]
+                    pos += n
+
+            try:
+                await self._io(work)
+            except OSError as e:
+                raise _fop_errno(e)
+        return self._iatt_gfid(fd.gfid)
+
     async def truncate(self, loc: Loc, size: int, xdata: dict | None = None):
         path = self._loc_path(loc)
         try:

@@ -358,6 +358,9 @@ def test_managed_volume_set_mesh_codec(tmp_path):
                 await mc.call(
                     "volume-create", name="mv", vtype="disperse",
                     redundancy=2,
+                    # the mesh tier has no systematic mode: opt out of
+                    # the op-version-12 systematic-by-default layout
+                    systematic=0,
                     bricks=[{"path": str(tmp_path / f"b{i}")}
                             for i in range(6)])
                 await mc.call("volume-start", name="mv")

@@ -518,11 +518,75 @@ if [ $chaos_rc -ne 0 ]; then
     exit $chaos_rc
 fi
 
+echo "== ci: delta-write smoke (managed systematic volume, unaligned"
+echo "       write -> gftpu_ec_delta_writes_total monotonicity) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, os, shutil, tempfile
+
+async def main():
+    from glusterfs_tpu.core.layer import walk
+    from glusterfs_tpu.core.metrics import REGISTRY
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    base = tempfile.mkdtemp(prefix="ci-delta")
+    d = Glusterd(os.path.join(base, "gd"))
+    await d.start()
+    try:
+        async with MgmtClient(d.host, d.port) as c:
+            await c.call("volume-create", name="dv", vtype="disperse",
+                         redundancy=2,
+                         bricks=[{"path": os.path.join(base, f"b{i}")}
+                                 for i in range(6)])
+            info = await c.call("volume-info", name="dv")
+            assert info["dv"].get("systematic") == 1, \
+                "disperse create did not default systematic at op12"
+            await c.call("volume-start", name="dv")
+        cl = await mount_volume(d.host, d.port, "dv")
+        try:
+            ec = next(l for l in walk(cl.graph.top)
+                      if l.type_name == "cluster/disperse")
+            data = bytes(range(256)) * 32  # 8 KiB = 4 stripes at 4+2
+            await cl.write_file("/f", data)
+
+            def fam(name):
+                snap = REGISTRY.snapshot()
+                return sum(s[1] for s in snap[name]["samples"]
+                           if s[0].get("layer") == ec.name)
+
+            d0 = fam("gftpu_ec_delta_writes_total")
+            f = await cl.open("/f")
+            await f.write(b"Q" * 700, 1000)  # sub-stripe, inside size
+            await f.close()
+            d1 = fam("gftpu_ec_delta_writes_total")
+            assert d1 == d0 + 1, (d0, d1)
+            saved = fam("gftpu_ec_delta_bytes_saved_total")
+            assert saved > 0, "delta path saved nothing?"
+            exp = bytearray(data); exp[1000:1700] = b"Q" * 700
+            got = await cl.read_file("/f")
+            assert bytes(got) == bytes(exp), "delta smoke parity"
+        finally:
+            await cl.unmount()
+    finally:
+        await d.stop()
+        shutil.rmtree(base, ignore_errors=True)
+    print("delta smoke: managed systematic-by-default volume served an "
+          "unaligned write via the parity-delta path (family +1, "
+          "bytes-saved > 0, bytes exact)")
+
+asyncio.run(main())
+EOF
+delta_rc=$?
+if [ $delta_rc -ne 0 ]; then
+    echo "ci: delta-write smoke failed — not mergeable"
+    exit $delta_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
 fi
 echo "ci: mergeable (two identical green tier-1 runs + bench contract"
 echo "    + metrics smoke + gateway smoke + concurrency smoke"
-echo "    + mesh smoke + chaos smoke)"
+echo "    + mesh smoke + chaos smoke + delta-write smoke)"
 exit 0
