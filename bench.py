@@ -779,6 +779,127 @@ def degraded_bench(n_clients: int = 6, file_mib: int = 1) -> dict:
     return out
 
 
+def rebalance_bench(n_dirs: int = 3, files_per_dir: int = 8,
+                    file_kib: int = 256) -> dict:
+    """Elastic scale-out rows (ISSUE 11): a managed 2-brick distribute
+    volume grown by add-brick while a reader loop serves — the
+    glusterd-spawned rebalance daemon runs fix-layout + migration
+    through the wire, and the record carries the migration rate
+    (``rebalance_MiB_s``, bytes actually moved over the daemon's
+    wall clock) beside the serving read p99 measured WHILE it ran
+    (``serving_p99_during_rebalance_ms``).  Callers record explicit
+    skipped rows on failure; host_cores rides the record (client,
+    bricks and daemon share the cores, so the rate is a floor)."""
+    import asyncio
+    import os
+    import shutil
+    import tempfile
+
+    from glusterfs_tpu.core.fops import FopError
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    base = tempfile.mkdtemp(prefix="rebalbench")
+    payload = np.random.default_rng(11).integers(
+        0, 256, file_kib * 1024, dtype=np.uint8).tobytes()
+    out: dict = {}
+
+    async def run():
+        d = Glusterd(os.path.join(base, "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="rb",
+                             vtype="distribute", redundancy=0,
+                             bricks=[{"path": os.path.join(base, f"b{i}")}
+                                     for i in range(2)])
+                await c.call("volume-start", name="rb")
+            cl = await mount_volume(d.host, d.port, "rb")
+            try:
+                paths = []
+                for dd in range(n_dirs):
+                    await cl.mkdir(f"/d{dd}")
+                    for i in range(files_per_dir):
+                        p = f"/d{dd}/f{i}"
+                        await cl.write_file(p, payload)
+                        paths.append(p)
+                lat: list[float] = []
+                stop = asyncio.Event()
+
+                async def serve():
+                    i = 0
+                    while not stop.is_set():
+                        p = paths[i % len(paths)]
+                        t0 = time.perf_counter()
+                        try:
+                            got = await cl.read_file(p)
+                            assert bytes(got) == payload, p
+                        except FopError:
+                            pass  # graph-swap blip: latency still real
+                        lat.append(time.perf_counter() - t0)
+                        i += 1
+                        await asyncio.sleep(0.02)
+
+                loader = asyncio.ensure_future(serve())
+                t0 = time.perf_counter()
+                try:
+                    async with MgmtClient(d.host, d.port) as c:
+                        await c.call("volume-add-brick", name="rb",
+                                     bricks=[{"path": os.path.join(
+                                         base, "b2")}])
+                        await c.call("volume-rebalance", name="rb",
+                                     action="start")
+                        deadline = time.monotonic() + 240
+                        while True:
+                            st = await c.call("volume-rebalance",
+                                              name="rb",
+                                              action="status")
+                            rb = st["rebalance"]
+                            if rb.get("status") in ("completed",
+                                                    "failed"):
+                                break
+                            if time.monotonic() > deadline:
+                                raise TimeoutError(f"rebalance: {rb}")
+                            await asyncio.sleep(0.2)
+                    elapsed = time.perf_counter() - t0
+                finally:
+                    stop.set()
+                    await loader
+                assert rb["status"] == "completed", rb
+                ctr = rb["counters"]
+                assert ctr["failed"] == 0, ctr
+                # rate over the daemon's ACTIVE migrate-walk seconds
+                # (phase_seconds excludes spawn, fix-layout and the
+                # mandatory LAYOUT_TTL settle sleeps — the wall clock
+                # is dominated by those constants at bench scale and
+                # would swamp the copy throughput it claims to report)
+                migrate_s = (rb.get("phase_seconds") or {}).get(
+                    "migrate", 0.0)
+                out["rebalance_MiB_s"] = round(
+                    ctr["bytes_moved"] / MIB / migrate_s, 2) \
+                    if migrate_s else "skipped: no migrate phase time"
+                out["rebalance_wall_s"] = round(elapsed, 1)
+                out["rebalance_files_moved"] = ctr["moved"]
+                if lat:
+                    p99 = sorted(lat)[int(0.99 * (len(lat) - 1))]
+                    out["serving_p99_during_rebalance_ms"] = round(
+                        p99 * 1e3, 1)
+                # spot parity after convergence
+                got = await cl.read_file(paths[0])
+                assert bytes(got) == payload, "post-rebalance parity"
+            finally:
+                await cl.unmount()
+        finally:
+            await d.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    out["host_cores"] = host_cores()
+    return out
+
+
 #: Parity-delta write ladder geometries (ISSUE 10): the headline config
 #: plus the wide geometry where the wave-size reduction is largest
 #: (16+4: a 4 KiB write touches ~2 of 16 data fragments, so the delta
@@ -1703,6 +1824,13 @@ def main() -> None:
         vol.update(mesh_sweep())
     except Exception as e:
         vol["mesh_sweep_error"] = str(e)[:200]
+    try:
+        # elastic scale-out (ISSUE 11): add-brick + managed rebalance
+        # daemon while a reader loop serves — migration rate beside
+        # the serving p99 measured during the run
+        vol.update(rebalance_bench())
+    except Exception as e:
+        vol["rebalance_bench_error"] = str(e)[:200]
     # a missing wire/fuse/smallfile-wire row is an EXPLICIT
     # "skipped: <reason>" entry, never silence (r5's detail lost all
     # four rows without a trace)
@@ -1721,6 +1849,8 @@ def main() -> None:
                 "smallfile_wire_create_singles_per_s",
                 "smallfile_wire_rpc_per_create_compound",
                 "smallfile_wire_rpc_per_create_singles",
+                "rebalance_MiB_s",
+                "serving_p99_during_rebalance_ms",
                 *(f"mesh_{op}_d{d}_MiB_s" for op in ("enc", "dec")
                   for d in MESH_LADDER)):
         if row not in vol:
@@ -1728,6 +1858,8 @@ def main() -> None:
                 reason = vol.get("fuse_bench_error")
             elif row.startswith("mesh_"):
                 reason = vol.get("mesh_sweep_error")
+            elif row.startswith(("rebalance", "serving_p99")):
+                reason = vol.get("rebalance_bench_error")
             elif row.startswith("smallfile_wire"):
                 mode = "compound" if "compound" in row else "singles"
                 reason = vol.get(f"smallfile_wire_{mode}_error") \

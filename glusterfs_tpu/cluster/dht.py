@@ -135,7 +135,17 @@ class DistributeLayer(Layer):
         Option("rebalance-stats", "bool", default="off",
                description="per-file timing in rebalance status "
                            "(cluster.rebalance-stats)"),
+        Option("rebal-migrate-window", "size", default="4MB",
+               description="copy window for file migration: the "
+                           "migrator streams a file in windows of "
+                           "this size instead of materializing it "
+                           "whole (cluster.rebal-migrate-window)"),
     )
+
+    #: reserved temp suffix for in-flight migration copies (hidden
+    #: from listings like linkto files; the gateway reserves
+    #: .gftpu.upload~ the same way)
+    MIGRATE_SUFFIX = ".rebalance~"
 
     # throttle -> (concurrent migrations, cooperative sleep between
     # files).  The reference scales migrator THREADS (lazy=1,
@@ -272,12 +282,17 @@ class DistributeLayer(Layer):
         loc = Loc(dirpath)
         ranges: list[tuple[int, int, int]] = []
         commits: set[int] = set()
+        holders: list[int] = []
         found = False
         for i in range(self.n):
             try:
                 out = await self.children[i].getxattr(loc, XA_LAYOUT)
-            except FopError:
+            except FopError as e:
+                if e.err not in (errno.ENOENT, errno.ESTALE):
+                    # unreadable is not proof of absence (child down)
+                    holders.append(i)
                 continue
+            holders.append(i)
             try:
                 _v, commit, start, stop = struct.unpack(
                     _LAYOUT_FMT, out[XA_LAYOUT])
@@ -300,6 +315,23 @@ class DistributeLayer(Layer):
                 log.warning(2, "%s: anomalous layout on %s (%d ranges):"
                             " derived fallback", self.name, dirpath,
                             len(ranges))
+        elif holders and len(holders) < self.n:
+            # NO child carries a layout xattr and the directory exists
+            # on a strict subset of children: a just-grown volume (the
+            # pre-add-brick namespace had a single leg and no dht
+            # records) before fix-layout reaches this directory.
+            # Hashing over ALL children here would route new names at
+            # a child with no parent to create them under; the
+            # reference keeps such a directory on its existing subvols
+            # until fix-layout stamps fresh ranges, so derive an even
+            # split over the HOLDERS (never authoritative — a miss at
+            # the derived owner proves nothing).
+            span = (1 << 32) // len(holders)
+            layout = [(j * span,
+                       (1 << 32) - 1 if j == len(holders) - 1
+                       else (j + 1) * span - 1, i)
+                      for j, i in enumerate(holders)]
+            commits.add(-1)
         authoritative = layout is not None and \
             commits == {self._active_commit()}
         self._layouts[dirpath] = (now + LAYOUT_TTL, layout, authoritative)
@@ -354,14 +386,17 @@ class DistributeLayer(Layer):
                         self.hashed_idx(name)
         return self.hashed_idx(name)
 
-    async def fix_layout(self, path: str = "/",
-                         weights: dict[str, float] | None = None) -> dict:
-        """Recompute + persist every directory's ranges over the CURRENT
-        active children (``rebalance fix-layout``): creates missing
-        directory copies (a just-added brick has none), writes the new
-        ranges, and descends.  Data stays put — only NEW names follow
-        the new layout; ``rebalance`` migrates existing files."""
-        fixed = 0
+    async def fix_layout_dir(self, path: str,
+                             weights: dict[str, float] | None = None
+                             ) -> list[str]:
+        """ONE directory's share of ``rebalance fix-layout``: create
+        missing directory copies (a just-added brick has none),
+        pre-place linktos for names the new ranges re-home, persist
+        the new ranges.  No recursion — the rebalance daemon drives
+        this per directory so its walk can CHECKPOINT between
+        directories; returns the subdirectory names for the caller's
+        descent.  Data stays put — only NEW names follow the new
+        layout; the migration phase moves existing files."""
         loc = Loc(path)
         src = None
         for i in range(self.n):
@@ -385,8 +420,6 @@ class DistributeLayer(Layer):
                         {"gfid-req": src[1].gfid})
                 except FopError:
                     pass
-        if weights is None and self.opts["weighted-rebalance"]:
-            weights = await self._capacity_weights()
         ranges = self.compute_ranges(weights, seed=dm_hash(path))
 
         def owner_of(name: str) -> int:
@@ -402,9 +435,14 @@ class DistributeLayer(Layer):
         # never lose a pre-fix file — its new position either holds the
         # file or points at it
         fd = await self.opendir(loc)
-        entries = await self.readdirp(fd)
+        try:
+            entries = await self.readdirp(fd)
+        finally:
+            await self.release(fd)
+        subdirs: list[str] = []
         for name, ia in entries:
             if ia is not None and ia.ia_type is IAType.DIR:
+                subdirs.append(name)
                 continue
             child = path.rstrip("/") + "/" + name
             cloc = Loc(child)
@@ -420,12 +458,22 @@ class DistributeLayer(Layer):
                     gfid = (await self.children[cur].lookup(cloc))[0].gfid
                     await self._make_linkto(new_owner, cloc, cur, gfid)
         await self._write_layout(path, ranges)
-        fixed += 1
-        for name, ia in entries:
-            if ia is not None and ia.ia_type is IAType.DIR:
-                sub = await self.fix_layout(
-                    path.rstrip("/") + "/" + name, weights)
-                fixed += sub["fixed"]
+        return subdirs
+
+    async def fix_layout(self, path: str = "/",
+                         weights: dict[str, float] | None = None) -> dict:
+        """Recompute + persist every directory's ranges over the CURRENT
+        active children (``rebalance fix-layout``), recursively —
+        the one-shot in-process form; the managed rebalance daemon
+        runs the same per-directory step under its checkpointed walk."""
+        if weights is None and self.opts["weighted-rebalance"]:
+            weights = await self._capacity_weights()
+        subdirs = await self.fix_layout_dir(path, weights)
+        fixed = 1
+        for name in subdirs:
+            sub = await self.fix_layout(
+                path.rstrip("/") + "/" + name, weights)
+            fixed += sub["fixed"]
         return {"fixed": fixed, "path": path}
 
     async def _cached_idx(self, loc: Loc) -> int:
@@ -465,6 +513,39 @@ class DistributeLayer(Layer):
                 continue
         raise FopError(errno.ENOENT, loc.path)
 
+    async def _locate_real(self, loc: Loc) -> tuple[int, "object"]:
+        """(child index, iatt) of the REAL copy of ``loc`` — a direct
+        scan of every child that ignores layout pruning and follows no
+        pointers (linkto copies are skipped, not followed).  This is
+        the MIGRATOR's resolution: a file created through a stale
+        parent layout sits misplaced with no linkto, and the normal
+        ``_cached_idx`` path would lookup-optimize it into ENOENT —
+        unfindable is exactly what the rebalance walk exists to fix
+        (dht_lookup_everywhere minus the pruning)."""
+        for i in range(self.n):
+            try:
+                ia, _ = await self.children[i].lookup(loc)
+            except FopError:
+                continue
+            if ia.ia_type is not IAType.DIR:
+                try:
+                    out = await self.children[i].getxattr(loc, XA_LINKTO)
+                    if XA_LINKTO in out:
+                        continue  # pointer, not content
+                except FopError as e:
+                    if e.err in (errno.ENOENT, errno.ESTALE):
+                        continue  # vanished under the probe
+                    if e.err != errno.ENODATA:
+                        # unreadable is NOT proof of absence: calling
+                        # a linkto "real" here would migrate its empty
+                        # body as content, and a later pass would then
+                        # take the committed-copy path and delete the
+                        # actual data.  Propagate; the walk retries
+                        # the file next pass
+                        raise
+            return i, ia
+        raise FopError(errno.ENOENT, loc.path)
+
     async def _linkto(self, idx: int, loc: Loc) -> int | None:
         try:
             out = await self.children[idx].getxattr(loc, XA_LINKTO)
@@ -478,13 +559,31 @@ class DistributeLayer(Layer):
 
     # -- namespace fops ----------------------------------------------------
 
-    async def lookup(self, loc: Loc, xdata: dict | None = None):
+    async def _with_cached(self, loc: Loc, call):
+        """Resolve + run with ONE re-resolution retry: a file being
+        migrated can have its pointer torn down between our resolution
+        and the fop (linkto followed to the source just as the
+        migrator dropped it) — the reference heals this with
+        lookup-everywhere on ESTALE (dht_lookup_everywhere); here the
+        re-resolution finds the committed destination."""
         idx = await self._cached_idx(loc)
-        return await self.children[idx].lookup(loc, xdata)
+        try:
+            return await call(idx)
+        except FopError as e:
+            if e.err not in (errno.ENOENT, errno.ESTALE):
+                raise
+            idx2 = await self._cached_idx(loc)
+            if idx2 == idx:
+                raise
+            return await call(idx2)
+
+    async def lookup(self, loc: Loc, xdata: dict | None = None):
+        return await self._with_cached(
+            loc, lambda i: self.children[i].lookup(loc, xdata))
 
     async def stat(self, loc: Loc, xdata: dict | None = None):
-        idx = await self._cached_idx(loc)
-        return await self.children[idx].stat(loc, xdata)
+        return await self._with_cached(
+            loc, lambda i: self.children[i].stat(loc, xdata))
 
     async def fstat(self, fd: FdObj, xdata: dict | None = None):
         ctx: DhtFdCtx = fd.ctx_get(self)
@@ -494,6 +593,7 @@ class DistributeLayer(Layer):
 
     async def mkdir(self, loc: Loc, mode: int = 0o755,
                     xdata: dict | None = None):
+        self._check_reserved(loc)
         xdata = dict(xdata or {})
         xdata.setdefault("gfid-req", gfid_new())
         results = []
@@ -583,8 +683,23 @@ class DistributeLayer(Layer):
         total = sum(out.values())
         return {k: v / total * len(out) for k, v in out.items()}
 
+    def _check_reserved(self, loc: Loc) -> None:
+        """Refuse user names carrying the reserved migration suffix:
+        such a name would be hidden from every listing (the temp
+        filter) and then unconditionally reclaimed by the rebalance
+        orphan sweep — accepted, it silently hides and later silently
+        DELETES user data.  The migrator itself never enters through
+        this layer (it drives the children directly)."""
+        name = loc.path.rstrip("/").rpartition("/")[2]
+        if name.endswith(self.MIGRATE_SUFFIX):
+            raise FopError(
+                errno.EPERM,
+                f"{loc.path}: the {self.MIGRATE_SUFFIX!r} suffix is "
+                "reserved for migration temps")
+
     async def create(self, loc: Loc, flags: int = 0, mode: int = 0o644,
                      xdata: dict | None = None):
+        self._check_reserved(loc)
         if flags & os.O_EXCL:
             # O_EXCL must see the file ANYWHERE: the scheduler may
             # target a subvol other than the holder (nufa/switch local
@@ -611,14 +726,20 @@ class DistributeLayer(Layer):
         return fd, ia
 
     async def open(self, loc: Loc, flags: int = 0, xdata: dict | None = None):
-        idx = await self._cached_idx(loc)
-        fd_c = await self.children[idx].open(loc, flags, xdata)
+        fds: dict = {}
+
+        async def one(i):
+            fds["idx"] = i
+            return await self.children[i].open(loc, flags, xdata)
+
+        fd_c = await self._with_cached(loc, one)
         fd = FdObj(fd_c.gfid, flags, path=loc.path)
-        fd.ctx_set(self, DhtFdCtx(idx, fd_c))
+        fd.ctx_set(self, DhtFdCtx(fds["idx"], fd_c))
         return fd
 
     async def mknod(self, loc: Loc, mode: int = 0o644, rdev: int = 0,
                     xdata: dict | None = None):
+        self._check_reserved(loc)
         idx = await self._sched(loc)
         ia = await self.children[idx].mknod(loc, mode, rdev, xdata)
         hi = await self._placed(loc)
@@ -627,6 +748,7 @@ class DistributeLayer(Layer):
         return ia
 
     async def symlink(self, target: str, loc: Loc, xdata: dict | None = None):
+        self._check_reserved(loc)
         return await self.children[await self._placed(loc)].symlink(
             target, loc, xdata)
 
@@ -645,11 +767,13 @@ class DistributeLayer(Layer):
         return await self.children[idx].unlink(loc, xdata)
 
     async def link(self, oldloc: Loc, newloc: Loc, xdata: dict | None = None):
+        self._check_reserved(newloc)
         idx = await self._cached_idx(oldloc)
         return await self.children[idx].link(oldloc, newloc, xdata)
 
     async def rename(self, oldloc: Loc, newloc: Loc,
                      xdata: dict | None = None):
+        self._check_reserved(newloc)
         src = await self._cached_idx(oldloc)
         ia, _ = await self.children[src].lookup(oldloc)
         if ia.ia_type is IAType.DIR:  # dirs: rename everywhere
@@ -874,6 +998,11 @@ class DistributeLayer(Layer):
             for name, ia in entries:
                 if name in seen:
                     continue
+                if name.endswith(self.MIGRATE_SUFFIX):
+                    # in-flight (or crash-orphaned) migration copy:
+                    # reserved namespace, never listed — like the
+                    # linkto pointers below
+                    continue
                 if rd_opt and i != first_up and ia is not None and \
                         ia.ia_type is IAType.DIR:
                     # cluster.readdir-optimize: directories exist on
@@ -913,26 +1042,226 @@ class DistributeLayer(Layer):
 
     # -- rebalance (dht-rebalance.c dht_migrate_file) ----------------------
 
+    #: xattr namespaces that are a CHILD's private metadata, never
+    #: copied across subvolumes by migration (EC fragment counters
+    #: describe the source group's fragments; dht layout/linkto
+    #: records are position, not content)
+    _MIGRATE_XATTR_SKIP = ("trusted.ec.", "trusted.glusterfs.",
+                           "trusted.bit-rot", "glusterfs.")
+
     async def _migrate_file(self, cloc: Loc, ia, idx: int,
                             hi: int) -> int:
-        """Move one file idx -> hi: copy data + xattrs, then swap.
-        Returns bytes moved."""
-        src_fd = await self.children[idx].open(cloc, 2)
-        data = await self.children[idx].readv(src_fd, ia.size, 0)
-        xattrs = await self.children[idx].getxattr(cloc)
+        """Move one file idx -> hi (dht_migrate_file analog), torn-read
+        safe: the bytes land in a reserved-suffix temp on the
+        destination child — hidden from listings, never a resolution
+        target, copied as ONE compound chain per window where the
+        graph carries it — get fsynced, and a same-child RENAME
+        commits them over the destination name atomically.  A
+        concurrent reader therefore sees the old full file (via the
+        existing linkto / global lookup to the source) or the new full
+        file, never a partial copy.  The source must be QUIESCENT: its
+        iatt is re-checked against the pre-copy snapshot and a changed
+        source re-copies (bounded — the reference's
+        migration-in-progress phase-2 check).  Cleanup unlinks carry
+        the internal-op xdata flag so features/trash never captures
+        migration garbage (trash.c internal_op).  Returns bytes
+        moved."""
+        from ..features.trash import INTERNAL_OP
+
+        internal = {INTERNAL_OP: True}
+        window = max(64 * 1024, int(self.opts["rebal-migrate-window"]))
+        src, dst = self.children[idx], self.children[hi]
+        dirpath, _, name = cloc.path.rstrip("/").rpartition("/")
+        tmp = Loc(f"{dirpath}/.{name}{self.MIGRATE_SUFFIX}")
+        # a migrator that died between its rename commit and the source
+        # unlink left TWO real copies.  The rename commit is the only
+        # way a pointer-free file lands at the hashed child, so a real
+        # copy standing there IS the committed one — and clients have
+        # been resolving to it ever since (hashed wins _cached_idx),
+        # possibly writing.  Re-copying the stale source over it would
+        # silently revert those writes: finish the dead migrator's
+        # teardown instead.  Only definite absence answers may steer
+        # back to the copy path — a transport error (ENOTCONN under
+        # the failfast plane) proves nothing, and guessing either way
+        # risks deleting the only real copy or clobbering the
+        # committed one; propagate, count failed, retry later.
+        committed = False
         try:
-            await self.children[hi].unlink(cloc)  # stale linkto
+            await dst.lookup(cloc)
+        except FopError as e:
+            if e.err not in (errno.ENOENT, errno.ESTALE):
+                raise
+        else:
+            try:
+                await dst.getxattr(cloc, XA_LINKTO)
+                # marker standing: a pointer, not a committed copy —
+                # clients are still routed to the source; migrate
+            except FopError as e:
+                if e.err not in (errno.ENODATA, errno.ENOENT,
+                                 errno.ESTALE):
+                    raise
+                committed = True
+        if committed:
+            # a failed teardown unlink propagates too: falling through
+            # would re-copy the stale source over the committed copy
+            await src.unlink(cloc, dict(internal))
+            return 0
+        moved = -1
+        try:
+            for _attempt in range(5):
+                # a crash-orphaned temp (or a failed previous attempt)
+                # would EEXIST the O_EXCL create
+                try:
+                    await dst.unlink(tmp, dict(internal))
+                except FopError:
+                    pass
+                moved = await self._migrate_copy(src, dst, cloc, tmp,
+                                                 ia, window, internal)
+                if moved < 0:  # source moved under the copy: go again
+                    ia, _ = await src.lookup(cloc)
+                    continue
+                # final pre-commit re-check: narrows the lost-write
+                # race from the whole copy duration to lookup->rename.
+                # (The residual window is real — the reference closes
+                # it with its locked phase-2 delta sync; documented in
+                # docs/rebalance.md failure semantics.)
+                # a failed re-check ABORTS (cleanup below reclaims
+                # the temp): a gone source means a serving client
+                # unlinked or renamed the file away after our copy —
+                # committing it would RESURRECT deleted data — and an
+                # unreachable source can't prove quiescence either
+                # way; a later pass re-decides against live state
+                ia3, _ = await src.lookup(cloc)
+                if ia3 is not None and \
+                        (ia3.size, ia3.mtime) != (ia.size, ia.mtime):
+                    ia = ia3
+                    moved = -1
+                    continue
+                break
+            if moved < 0:
+                raise FopError(errno.EBUSY,
+                               f"{cloc.path}: source never quiesced")
+            # commit: one atomic same-child swap over the destination
+            # name (and over the stale linkto standing there)
+            await dst.rename(tmp, cloc)
+        except BaseException:
+            # ANY exit before the rename commit reclaims the hidden
+            # temp: the suffix is filtered from every listing, so an
+            # escape here (source unlinked mid-retry, rename failure,
+            # never-quiesced give-up) would leak up to the whole
+            # file's bytes invisibly until a post-crash RESUMED walk
+            # happened to sweep this directory
+            try:
+                await dst.unlink(tmp, dict(internal))
+            except (FopError, asyncio.CancelledError):
+                pass
+            raise
+        # the replaced linkto shared the file's gfid, and brick xattr
+        # stores are gfid-keyed: drop the pointer marker or the
+        # committed file keeps routing readers at the source.  Only a
+        # marker-already-absent answer may pass — any other failure
+        # must abort BEFORE the source unlink below, or readers follow
+        # the surviving marker to a deleted source forever; failing
+        # here leaves the file served from the source and a later
+        # pass retries the whole migration
+        try:
+            await dst.removexattr(cloc, XA_LINKTO)
+        except FopError as e:
+            if e.err not in (errno.ENODATA, errno.ENOENT,
+                             errno.ESTALE):
+                raise
+        # drop the source copy; readers that raced the teardown
+        # re-resolve through _with_cached to the committed destination
+        await src.unlink(cloc, dict(internal))
+        return moved
+
+    async def _migrate_copy(self, src, dst, cloc: Loc, tmp: Loc, ia,
+                            window: int, internal: dict) -> int:
+        """One copy attempt of ``cloc`` into the hidden temp on
+        ``dst``.  Returns bytes copied, or -1 when the source changed
+        under the copy (caller re-snapshots and retries).  Memory is
+        bounded by ``window``: a file at or under it rides ONE
+        compound chain (the smallfile common case — create + writev +
+        setxattr + fsync + release in one frame where the graph
+        carries it); a larger file streams window-at-a-time through a
+        plain fd so a multi-GB migration never materializes the file
+        (the option's contract).  The temp carries the file's OWN
+        gfid (like the seed's direct create): clients cache
+        path->gfid dentries, and a re-minted gfid would ESTALE every
+        cached handle after the commit.  Destination is fsynced
+        BEFORE the swap (the rebalance.ensure-durability contract): a
+        crash right after the rename must not leave the only copy in
+        page cache.  A failed copy unlinks its partial temp."""
+        from ..rpc import compound as cfop
+
+        size = ia.size
+        chunks: list[bytes] = []
+        sfd = await src.open(cloc, os.O_RDONLY)
+        dfd = None
+        try:
+            if size <= window:
+                off = 0
+                while off < size:
+                    data = await src.readv(sfd, size - off, off)
+                    b = bytes(data)
+                    if not b:
+                        break
+                    chunks.append(b)
+                    off += len(b)
+            else:
+                dfd, _ = await dst.create(
+                    tmp, os.O_RDWR | os.O_EXCL, ia.mode & 0o7777,
+                    {"gfid-req": ia.gfid})
+                off = 0
+                while off < size:
+                    data = await src.readv(sfd, min(window, size - off),
+                                           off)
+                    b = bytes(data)
+                    if not b:
+                        break
+                    await dst.writev(dfd, b, off)
+                    off += len(b)
+            xattrs = await src.getxattr(cloc)
+            ia2, _ = await src.lookup(cloc)
+            if (ia2.size, ia2.mtime) != (size, ia.mtime):
+                return -1
+            clean = {k: v for k, v in xattrs.items()
+                     if not k.startswith(self._MIGRATE_XATTR_SKIP)}
+            if dfd is None:
+                links: list = [("create",
+                                (tmp, os.O_RDWR | os.O_EXCL,
+                                 ia.mode & 0o7777,
+                                 {"gfid-req": ia.gfid}),
+                                {})]
+                w = 0
+                for b in chunks:
+                    links.append(("writev", (cfop.FdRef(0), b, w), {}))
+                    w += len(b)
+                if clean:
+                    links.append(("setxattr", (tmp, clean), {}))
+                links.append(("fsync", (cfop.FdRef(0), 0), {}))
+                links.append(("release", (cfop.FdRef(0),), {}))
+                replies = await dst.compound(links)
+                err = cfop.first_error(replies)
+                if err is not None:
+                    raise err
+                return w
+            if clean:
+                await dst.setxattr(tmp, clean)
+            await dst.fsync(dfd, 0)
+            return off
         except FopError:
-            pass
-        dfd, _ = await self.children[hi].create(
-            cloc, 0, ia.mode, {"gfid-req": ia.gfid})
-        if data:
-            await self.children[hi].writev(dfd, data, 0)
-        clean = {k: v for k, v in xattrs.items() if k != XA_LINKTO}
-        if clean:
-            await self.children[hi].setxattr(cloc, clean)
-        await self.children[idx].unlink(cloc)
-        return len(data) if data else 0
+            try:
+                await dst.unlink(tmp, dict(internal))
+            except FopError:
+                pass
+            raise
+        finally:
+            rel = getattr(src, "release", None)
+            if rel:
+                await rel(sfd)
+            if dfd is not None:
+                await dst.release(dfd)
 
     async def rebalance(self, path: str = "/") -> dict:
         """Move every misplaced file to its hashed subvolume.
@@ -953,7 +1282,10 @@ class DistributeLayer(Layer):
 
         async def walk_dir(path: str) -> None:
             fd = await self.opendir(Loc(path))
-            entries = await self.readdir(fd)
+            try:
+                entries = await self.readdir(fd)
+            finally:
+                await self.release(fd)
             pending: list[asyncio.Task] = []
 
             async def migrate(child: str, cloc: Loc, ia, idx: int,

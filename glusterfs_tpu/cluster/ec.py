@@ -309,6 +309,12 @@ class DisperseLayer(Layer):
             systematic=self.opts["systematic"],
             mesh=self.opts["mesh-codec"], name=self.name)
         self._batching = self.opts["stripe-cache"]
+        # default origin label for this layer's codec traffic on the
+        # batch/mesh metrics families: "serve" for a client mount; the
+        # rebalance daemon tags its PRIVATE graph "rebalance" so mesh
+        # launches and counters attribute migration I/O (the shd heal
+        # precedent — explicit origin="heal" call sites still win)
+        self.traffic_origin = "serve"
         self.stripe = self.k * CHUNK
         self.up = [True] * self.n  # xl_up bitmask (ec.c:571 notify)
         self._locks: dict[bytes, asyncio.Lock] = {}
@@ -2152,23 +2158,25 @@ class DisperseLayer(Layer):
             return {"healed": healed, "skipped": False,
                     "size": rep2["size"], "stable": stable}
 
-    async def _codec_encode(self, buf, origin: str = "serve"):
+    async def _codec_encode(self, buf, origin: str | None = None):
         if self._batching:
-            return await self.codec.encode_async(buf, origin=origin)
+            return await self.codec.encode_async(
+                buf, origin=origin or self.traffic_origin)
         return self.codec.encode(buf)
 
-    async def _codec_delta(self, buf, origin: str = "serve"):
+    async def _codec_delta(self, buf, origin: str | None = None):
         """Parity-rows-only delta encode through the batching window
         (coalesced delta flushes ride the same measured ladder)."""
         if self._batching:
-            return await self.codec.encode_delta_async(buf,
-                                                       origin=origin)
+            return await self.codec.encode_delta_async(
+                buf, origin=origin or self.traffic_origin)
         return self.codec.encode_delta(buf)
 
-    async def _codec_decode(self, frags, rows, origin: str = "serve"):
+    async def _codec_decode(self, frags, rows,
+                            origin: str | None = None):
         if self._batching:
-            return await self.codec.decode_async(frags, rows,
-                                                 origin=origin)
+            return await self.codec.decode_async(
+                frags, rows, origin=origin or self.traffic_origin)
         return self.codec.decode(frags, rows)
 
     async def fini(self):

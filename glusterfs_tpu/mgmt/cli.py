@@ -11,7 +11,7 @@ Command surface kept (cli-cmd-volume.c vocabulary):
     gftpu volume heal NAME [info] [PATH] | statistics heal-count
     gftpu volume clear-locks NAME PATH kind {blocked|granted|all}
     gftpu volume quota NAME enable|disable|list|limit-usage PATH BYTES|remove PATH
-    gftpu volume rebalance NAME
+    gftpu volume rebalance NAME start [fix-layout]|status|stop
     gftpu volume profile NAME
     gftpu volume metrics NAME
     gftpu volume gateway NAME start|stop|status
@@ -394,11 +394,12 @@ async def _run(args) -> Any:
                 return await c.call("volume-add-brick", name=args.name,
                                     bricks=bricks)
         if sub == "remove-brick":
-            # volume remove-brick NAME BRICK... start|status|commit|force
-            action = args.args[-1] if args.args and args.args[-1] in (
-                "start", "status", "commit", "force") else "start"
-            named = [a for a in args.args
-                     if a not in ("start", "status", "commit", "force")]
+            # volume remove-brick NAME BRICK...
+            #                     start|status|stop|commit|force
+            actions = ("start", "status", "stop", "commit", "force")
+            action = args.args[-1] if args.args and \
+                args.args[-1] in actions else "start"
+            named = [a for a in args.args if a not in actions]
             async with MgmtClient(host, port) as c:
                 return await c.call("volume-remove-brick",
                                     name=args.name, bricks=named,
@@ -417,10 +418,32 @@ async def _run(args) -> Any:
                 return await c.call("volume-bitrot", name=args.name,
                                     action=action)
         if sub == "rebalance":
-            # volume rebalance NAME [fix-layout] [child=weight ...] —
-            # fix-layout rewrites every directory's persisted hash
-            # ranges over the current brick set (optionally weighted)
-            # without moving data; bare rebalance migrates files
+            # volume rebalance NAME start [fix-layout] | status | stop
+            # — the glusterd-managed per-volume daemon (checkpointed,
+            # throttleable, resumable; op-version 13).  Legacy direct
+            # forms stay: `fix-layout [child=weight ...]` rewrites the
+            # persisted hash ranges in-process; bare `rebalance NAME`
+            # runs the one-shot in-process walk.
+            if args.args and args.args[0] in ("start", "status",
+                                              "stop"):
+                action = args.args[0]
+                flavor = args.args[1] if len(args.args) > 1 else ""
+                async with MgmtClient(host, port) as c:
+                    return await c.call("volume-rebalance",
+                                        name=args.name, action=action,
+                                        flavor=flavor)
+            # the daemon's temp handling assumes it is the volume's
+            # ONLY migrator (both walks target the same deterministic
+            # `.NAME.rebalance~` temps) — refuse the legacy in-process
+            # forms while a managed run is live
+            async with MgmtClient(host, port) as c:
+                info = await c.call("volume-info", name=args.name)
+            if (info.get(args.name, {}).get("rebalance") or {}) \
+                    .get("status") == "started":
+                return {"error": "a managed rebalance is running on "
+                                 f"{args.name}; the in-process walk "
+                                 "would race its migrator (`volume "
+                                 f"rebalance {args.name} stop` first)"}
             client = await mount_volume(host, port, args.name)
             try:
                 from ..cluster.dht import DistributeLayer

@@ -111,6 +111,8 @@ class Glusterd:
         self.bitd: dict[str, subprocess.Popen] = {}  # volname -> bitd
         self.quotad: dict[str, subprocess.Popen] = {}  # volname -> quotad
         self.gateway: dict[str, subprocess.Popen] = {}  # volname -> gateway
+        self.rebalanced: dict[str, subprocess.Popen] = {}  # volname -> rebal
+        self._rb_saved: dict[str, float] = {}  # volname -> last ckpt save
         self._server: asyncio.AbstractServer | None = None
         self._txn_lock = asyncio.Lock()
         self._txn_holder: str | None = None
@@ -182,6 +184,11 @@ class Glusterd:
                     self._spawn_quotad(vol)
                 if vol.get("gateway", {}).get("status") == "started":
                     self._spawn_gateway(vol)
+                if vol.get("rebalance", {}).get("status") == "started" \
+                        and vol["rebalance"].get("node") == self.uuid:
+                    # restart-resume: the daemon picks its checkpoint
+                    # out of the volinfo and CONTINUES the walk
+                    self._spawn_rebalanced(vol)
         # activated snapshots resume serving too
         for s in self.state.get("snaps", {}).values():
             vi = s.get("volinfo")
@@ -214,6 +221,8 @@ class Glusterd:
             self._kill_quotad(name)
         for name in list(self.gateway):
             self._kill_gateway(name)
+        for name in list(self.rebalanced):
+            self._kill_rebalanced(name)
         for name in list(self.shd):
             self._kill_shd(name)
         for name in list(self.bricks):
@@ -441,11 +450,15 @@ class Glusterd:
                 self._spawn_gateway(vol)
             else:
                 self._kill_gateway(name)
+            if vol.get("rebalance", {}).get("status") == "started" and \
+                    vol["rebalance"].get("node") == self.uuid:
+                self._spawn_rebalanced(vol)
         else:
             self._kill_shd(name)
             self._kill_bitd(name)
             self._kill_quotad(name)
             self._kill_gateway(name)
+            self._kill_rebalanced(name)
             if deleted:
                 self._kill_gsync(name)
 
@@ -873,6 +886,9 @@ class Glusterd:
             self._spawn_quotad(vol)
         if vol.get("gateway", {}).get("status") == "started":
             self._spawn_gateway(vol)
+        if vol.get("rebalance", {}).get("status") == "started" and \
+                vol["rebalance"].get("node") == self.uuid:
+            self._spawn_rebalanced(vol)
         gf_event("VOLUME_START", name=name)
         await self._run_hooks("start", "post", name)
         return {"started": name,
@@ -903,6 +919,7 @@ class Glusterd:
         self._kill_bitd(name)
         self._kill_quotad(name)
         self._kill_gateway(name)
+        self._kill_rebalanced(name)
         self._kill_shd(name)
         for b in vol["bricks"]:
             if b["node"] == self.uuid:
@@ -1103,6 +1120,18 @@ class Glusterd:
             for k in ("progress", "moved", "scanned", "error"):
                 if k in rb:
                     row[k] = rb[k]
+            tasks.append(row)
+        reb = vol.get("rebalance")
+        if reb and reb.get("mode") != "drain":
+            # a drain's task row is the remove-brick one above — two
+            # rows for one background walk would double-report it
+            row = {"type": "rebalance",
+                   "status": reb.get("status", "unknown"),
+                   "mode": reb.get("mode", "full"),
+                   "phase": reb.get("phase", "idle")}
+            for k in ("counters", "throttle", "error", "resumed_from"):
+                if k in reb:
+                    row[k] = reb[k]
             tasks.append(row)
         return tasks
 
@@ -1591,6 +1620,13 @@ class Glusterd:
                 raise MgmtError(
                     f"add-brick on a {vol['type']} volume needs a "
                     f"multiple of {group_size} bricks (whole groups)")
+        if (vol.get("rebalance") or {}).get("status") == "started":
+            # a live rebalance walks the CURRENT layout; growing it
+            # mid-run would leave the new brick unstamped by the
+            # already-passed fix-layout directories (the reference
+            # refuses the same way, glusterd-brick-ops.c)
+            raise MgmtError("a rebalance is in progress; stop it "
+                            "before add-brick")
         parsed = self._parse_new_bricks(vol, bricks)
         results = await self._cluster_txn(
             "add-brick", {"name": name, "bricks": parsed,
@@ -1656,6 +1692,26 @@ class Glusterd:
             if rb.get("status") == "started":
                 raise MgmtError("a remove-brick is already in "
                                 "progress; commit or wait first")
+            if (vol.get("rebalance") or {}).get("status") == "started":
+                # the drain rides the SAME daemon slot: starting it
+                # under a live full rebalance would clobber that run's
+                # record while the old daemon keeps walking (and its
+                # next checkpoint push would flip the mode back,
+                # stranding the remove-brick record 'started' forever)
+                raise MgmtError("a rebalance is in progress; stop it "
+                                "before remove-brick start")
+            if self.cluster_op_version() < 13:
+                # the drain rides the rebalance daemon machinery
+                # (rebalance-start txn + rebalance-update pushes): a
+                # v12 peer has neither op, and failing mid-txn-pair
+                # would strand remove-brick 'started' with no daemon
+                # draining it.  Re-handshake before refusing (the
+                # volume-set ladder's pattern).
+                await self._refresh_peers()
+            if self.cluster_op_version() < 13:
+                raise MgmtError(
+                    "remove-brick start needs cluster op-version "
+                    f">= 13 (cluster is at {self.cluster_op_version()})")
             leaving = set(bricks or ())
             have = {b["name"] for b in vol["bricks"]}
             if not leaving or not leaving <= have:
@@ -1675,10 +1731,20 @@ class Glusterd:
                         raise MgmtError("partial group in remove-brick")
             await self._cluster_txn("remove-brick-start", {
                 "name": name, "bricks": sorted(leaving)})
-            # drain asynchronously (the reference's rebalance process);
-            # status flips to completed when the migration finishes
-            self._spawn_task(self._drain_bricks(name))
+            # the drain IS a rebalance: the managed daemon walks the
+            # namespace in drain mode (decommissioned children are
+            # already excluded from placement, dht.py:88-90), so
+            # shrink gets status/stop/checkpoints for free
+            await self._cluster_txn("rebalance-start", {
+                "name": name, "mode": "drain", "node": self.uuid,
+                "ts": time.time()})
             return {"ok": True, "status": "started"}
+        if action == "stop":
+            if rb.get("status") != "started":
+                raise MgmtError("no remove-brick in progress")
+            await self._cluster_txn("remove-brick-stop", {"name": name})
+            gf_event("REBALANCE_STOPPED", name=name, mode="drain")
+            return {"ok": True, "status": "stopped"}
         if action in ("commit", "force"):
             if not rb:
                 raise MgmtError("no remove-brick in progress")
@@ -1700,78 +1766,22 @@ class Glusterd:
             self._notify_subscribers(name)  # layout excludes leavers
         return {"draining": bricks}
 
-    async def _drain_bricks(self, name: str) -> None:
-        """Migrate data off the leaving bricks (decommission walk)."""
+    def commit_remove_brick_stop(self, name: str) -> dict:
+        """Abort a shrink: kill the drain daemon and drop the
+        decommission so the leavers re-join the layout (the
+        reference's remove-brick stop restores the node map)."""
         vol = self._vol(name)
-        rb = vol.get("remove-brick") or {}
-        try:
-            if vol["status"] == "started":
-                from ..cluster.dht import DistributeLayer
-
-                client = await mount_volume(self.host, self.port, name)
-                try:
-                    dht = next(
-                        (l for l in client.graph.by_name.values()
-                         if isinstance(l, DistributeLayer)), None)
-                    if dht is not None:
-                        # publish LIVE defrag progress while the walk
-                        # runs (the reference's rebalance process
-                        # reports through the defrag status op)
-                        task = asyncio.ensure_future(
-                            dht.rebalance("/"))
-                        try:
-                            while not task.done():
-                                rb["progress"] = dict(dht.rebal_status)
-                                await asyncio.sleep(0.2)
-                            out = task.result()
-                        finally:
-                            # a cancelled poll must not orphan the
-                            # walk: its migrations would keep running
-                            # against the client we unmount below
-                            if not task.done():
-                                task.cancel()
-                                try:
-                                    await task
-                                except (asyncio.CancelledError,
-                                        Exception):
-                                    pass
-                        rb["progress"] = dict(dht.rebal_status)
-                    else:
-                        out = {}
-                finally:
-                    await client.unmount()
-                rb["moved"] = len(out.get("moved", ()))
-                rb["scanned"] = out.get("scanned", 0)
-            rb["status"] = "completed"
-        except Exception as e:
-            rb["status"] = "failed"
-            rb["error"] = repr(e)[:300]
-            log.error(21, "remove-brick drain of %s failed: %r", name, e)
+        self._kill_rebalanced(name)
+        vol.pop("remove-brick", None)
+        reb = vol.get("rebalance")
+        if reb is not None and reb.get("mode") == "drain" and \
+                reb.get("status") == "started":
+            reb["status"] = "stopped"
         self._bump(vol)
         self._save()
-        # propagate the terminal drain status cluster-wide so
-        # `remove-brick status`/`commit` addressed to ANY node sees it
-        # (the reference's rebalance process reports back through the
-        # defrag status op to every glusterd); unreachable peers catch
-        # up via peer-hello volinfo reconciliation
-        for node in self._all_nodes():
-            if node["uuid"] == self.uuid:
-                continue
-            try:
-                await asyncio.wait_for(self._node_call(
-                    node, "remove-brick-update", name=name,
-                    rb=dict(rb)), 10)
-            except Exception:
-                pass
-
-    def op_remove_brick_update(self, name: str, rb: dict) -> dict:
-        """Originator pushes terminal drain status to every peer."""
-        vol = self._vol(name)
-        if vol.get("remove-brick") is not None:
-            vol["remove-brick"].update(rb)
-            self._bump(vol)
-            self._save()
-        return {"ok": True}
+        if vol["status"] == "started":
+            self._notify_subscribers(name)  # leavers re-enter layout
+        return {"stopped": name}
 
     async def commit_remove_brick_commit(self, name: str) -> dict:
         vol = self._vol(name)
@@ -1858,6 +1868,285 @@ class Glusterd:
                 await client.unmount()
         except Exception as e:
             log.warning(22, "post-replace heal of %s: %r", name, e)
+
+    # -- rebalance daemon lifecycle (glusterd-rebalance.c analog) ----------
+    # ``volume rebalance NAME start[ fix-layout]|status|stop`` — a
+    # per-volume daemon owned by the starting node, spawned like the
+    # gateway/shd service daemons, reporting resumable checkpoints back
+    # into the volinfo over the rebalance-update RPC: SIGKILL + respawn
+    # CONTINUES the walk from the last completed directory, never
+    # restarts it.
+
+    async def op_volume_rebalance(self, name: str,
+                                  action: str = "status",
+                                  flavor: str = "") -> dict:
+        vol = self._vol(name)
+        if action == "status":
+            return await self._rebalance_status(vol)
+        if action not in ("start", "stop"):
+            raise MgmtError(f"bad rebalance action {action!r} "
+                            "(want start|status|stop)")
+        if self.cluster_op_version() < 13:
+            # stored versions are probe-time snapshots: re-handshake
+            # before refusing (the volume-set ladder's pattern)
+            await self._refresh_peers()
+        if self.cluster_op_version() < 13:
+            raise MgmtError(
+                "volume rebalance needs cluster op-version >= 13 "
+                f"(cluster is at {self.cluster_op_version()})")
+        rb = vol.get("rebalance") or {}
+        if action == "stop":
+            if rb.get("status") != "started":
+                raise MgmtError("no rebalance in progress")
+            if rb.get("mode") == "drain":
+                # stopping the drain daemon without dropping the
+                # decommission would strand remove-brick 'started'
+                # with nothing draining it — the remove-brick stop op
+                # owns that cleanup
+                raise MgmtError("this rebalance is a remove-brick "
+                                "drain; use `volume remove-brick ... "
+                                "stop`")
+            await self._cluster_txn("rebalance-stop", {"name": name})
+            gf_event("REBALANCE_STOPPED", name=name,
+                     mode=rb.get("mode", "full"))
+            return {"ok": True, "status": "stopped",
+                    "checkpoint": (self._vol(name).get("rebalance")
+                                   or {}).get("checkpoint")}
+        if vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        if flavor not in ("", "fix-layout"):
+            raise MgmtError(f"bad rebalance flavor {flavor!r} "
+                            "(only fix-layout)")
+        if (vol.get("remove-brick") or {}).get("status") == "started":
+            raise MgmtError("a remove-brick drain is in progress; its "
+                            "daemon IS a rebalance — wait or stop it")
+        mode = flavor or "full"
+        if rb.get("status") == "started":
+            proc = self.rebalanced.get(name)
+            if proc is not None and proc.poll() is None:
+                raise MgmtError("rebalance already in progress")
+            if rb.get("node") != self.uuid:
+                raise MgmtError(
+                    "rebalance owned by node "
+                    f"{(rb.get('node') or '?')[:8]}; start it there")
+            # dead daemon (SIGKILL, crash): respawn — the checkpoint
+            # in the volinfo makes this a RESUME, never a restart
+            self._spawn_rebalanced(vol)
+            return {"ok": True, "status": "resumed",
+                    "checkpoint": rb.get("checkpoint")}
+        await self._cluster_txn("rebalance-start", {
+            "name": name, "mode": mode, "node": self.uuid,
+            "ts": time.time()})
+        return {"ok": True, "status": "started", "mode": mode}
+
+    @staticmethod
+    def _rebal_topology(vol: dict) -> dict:
+        """What a rebalance checkpoint is valid AGAINST: the brick set
+        and (for drain) which bricks are leaving.  A checkpoint taken
+        under one topology must never steer a run under another —
+        resuming a pre-add-brick checkpoint skips fix-layout for the
+        new leg, and resuming drain-A's checkpoint for drain-B never
+        scans B's files and a later commit drops them undrained."""
+        return {"bricks": sorted(b["name"] for b in vol["bricks"]),
+                "drain": sorted((vol.get("remove-brick") or {})
+                                .get("bricks") or ())}
+
+    def commit_rebalance_start(self, name: str, mode: str, node: str,
+                               ts: float) -> dict:
+        vol = self._vol(name)
+        prev = vol.get("rebalance") or {}
+        rb = {"status": "started", "mode": mode, "node": node,
+              "started": ts, "topology": self._rebal_topology(vol)}
+        if prev.get("status") == "stopped" and \
+                prev.get("mode") == mode and prev.get("checkpoint") \
+                and prev.get("topology") == rb["topology"]:
+            # stop -> start continues from the stop's checkpoint (the
+            # counters ride inside it) — but ONLY under the same
+            # topology it was taken against
+            rb["checkpoint"] = prev["checkpoint"]
+        vol["rebalance"] = rb
+        self._bump(vol)
+        self._save()
+        if node == self.uuid and vol["status"] == "started":
+            self._spawn_rebalanced(vol)
+            gf_event("REBALANCE_START", name=name, mode=mode)
+        return {"rebalance": mode}
+
+    def commit_rebalance_stop(self, name: str) -> dict:
+        vol = self._vol(name)
+        rb = vol.get("rebalance") or {}
+        if rb.get("node") == self.uuid:
+            # SIGTERM: the daemon pushes a final stopped update with
+            # its checkpoint before exiting; the stamp below covers a
+            # daemon that was already dead
+            self._kill_rebalanced(name)
+        if rb.get("status") == "started":
+            rb["status"] = "stopped"
+        self._bump(vol)
+        self._save()
+        return {"stopped": name}
+
+    async def _rebalance_status(self, vol: dict) -> dict:
+        """Per-node daemon state fan-out merged like ``volume status``
+        (the defrag status aggregation of glusterd-rebalance.c), with
+        unreachable nodes NAMED in ``partial``."""
+        name = vol["name"]
+        rb = dict(vol.get("rebalance") or {"status": "not-started"})
+        nodes = {n["uuid"]: n for n in self._vol_nodes(vol)}
+        owner = rb.get("node")
+        if owner and owner not in nodes:
+            for n in self._all_nodes():
+                if n["uuid"] == owner:
+                    nodes[owner] = n
+        per_node, partial = await self._gather_bricks(
+            "volume-rebalance-local", nodes=list(nodes.values()),
+            name=name)
+        for row in per_node.values():
+            if row.get("owner") and row.get("rebalance"):
+                # the owner's row carries the freshest pushed state
+                rb = row["rebalance"]
+        return self._merge_partial(
+            {"volume": name, "rebalance": rb, "nodes": per_node},
+            partial)
+
+    def op_volume_rebalance_local(self, name: str) -> dict:
+        """One node's share of rebalance status: its daemon liveness
+        plus its volinfo view (rides the _gather_bricks merge, keyed
+        by node id)."""
+        vol = self._vol(name)
+        rb = vol.get("rebalance") or {}
+        proc = self.rebalanced.get(name)
+        online = proc is not None and proc.poll() is None
+        row: dict[str, Any] = {
+            "online": online, "pid": proc.pid if online else 0,
+            "owner": bool(rb) and rb.get("node") == self.uuid}
+        if rb:
+            row["rebalance"] = dict(rb)
+        return {"bricks": {self.uuid[:8]: row}}
+
+    async def op_rebalance_update(self, name: str, info: dict) -> dict:
+        """The daemon (or the owner's terminal fan-out) pushes
+        rebalance progress into the volinfo; CHECKPOINTS land here,
+        which is what makes SIGKILL + respawn resume."""
+        vol = self._vol(name)
+        rb = vol.get("rebalance")
+        if rb is None:
+            rb = vol["rebalance"] = {}
+        rb.update(info)
+        terminal = info.get("status") in ("completed", "failed",
+                                          "stopped")
+        if rb.get("mode") == "drain":
+            self._mirror_drain(vol, rb, info)
+        if terminal:
+            self._bump(vol)
+            self._save()
+        else:
+            # checkpoint pushes can arrive many times a second; the
+            # in-memory volinfo is what status ops and a daemon
+            # respawn read, so persist at most once a second (a
+            # glusterd CRASH resumes from a slightly older checkpoint
+            # — the walk is idempotent)
+            now = time.monotonic()
+            if now - self._rb_saved.get(name, 0.0) >= 1.0:
+                self._rb_saved[name] = now
+                self._save()
+        if terminal and rb.get("node") == self.uuid:
+            # propagate terminal state so status/commit addressed to
+            # ANY node sees it; peers that miss the push catch up via
+            # peer-hello volinfo reconciliation (the generation bumped)
+            for node in self._all_nodes():
+                if node["uuid"] == self.uuid:
+                    continue
+                try:
+                    await asyncio.wait_for(self._node_call(
+                        node, "rebalance-update", name=name,
+                        info=dict(rb)), 10)
+                except Exception:
+                    pass
+        return {"ok": True}
+
+    def _mirror_drain(self, vol: dict, rb: dict, info: dict) -> None:
+        """A drain-mode rebalance IS the remove-brick migration: its
+        progress and terminal state land on the remove-brick record
+        that ``remove-brick status``/``commit`` read."""
+        rbk = vol.get("remove-brick")
+        if rbk is None:
+            return
+        ctr = rb.get("counters") or {}
+        rbk["progress"] = {"phase": rb.get("phase", ""), **ctr}
+        status = info.get("status")
+        if status == "completed":
+            rbk["status"] = "completed"
+            rbk["moved"] = ctr.get("moved", 0)
+            rbk["scanned"] = ctr.get("scanned", 0)
+        elif status == "failed":
+            rbk["status"] = "failed"
+            rbk["error"] = rb.get("error", "")
+
+    def _spawn_rebalanced(self, vol: dict) -> None:
+        name = vol["name"]
+        proc = self.rebalanced.get(name)
+        if proc is not None and proc.poll() is None:
+            return
+        rb = vol.get("rebalance") or {}
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        statusfile = os.path.join(self.workdir,
+                                  f"rebalanced-{name}.json")
+        if not rb.get("checkpoint"):
+            # a FRESH run must not inherit a previous run's
+            # statusfile: the daemon only writes it at its first push
+            # (after the mount settles), and a stop before that would
+            # harvest the OLD run's checkpoint into this record —
+            # whose topology stamp is this run's own, so the
+            # fingerprint guard cannot catch the swap
+            try:
+                os.unlink(statusfile)
+            except OSError:
+                pass
+        with open(os.path.join(self.workdir, f"rebalanced-{name}.log"),
+                  "ab") as logf:
+            self.rebalanced[name] = subprocess.Popen(
+                [sys.executable, "-m", "glusterfs_tpu.mgmt.rebalanced",
+                 "--glusterd", f"{self.host}:{self.port}",
+                 "--volname", name,
+                 "--mode", rb.get("mode", "full"),
+                 "--statusfile", statusfile],
+                env=env, stdout=subprocess.DEVNULL, stderr=logf)
+
+    def _kill_rebalanced(self, name: str) -> None:
+        proc = self.rebalanced.pop(name, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            # the daemon's final rebalance-update cannot land while
+            # THIS loop is blocked in wait() (the daemon bounds that
+            # push and exits) — its statusfile carries the same final
+            # checkpoint, so harvest it here to keep the
+            # stop-continues-from-the-stop's-checkpoint contract
+            self._harvest_rebal_statusfile(name)
+
+    def _harvest_rebal_statusfile(self, name: str) -> None:
+        vol = self.state["volumes"].get(name)
+        if vol is None or not (vol.get("rebalance") or {}).get("node"):
+            return
+        rb = vol["rebalance"]
+        if rb.get("node") != self.uuid or \
+                rb.get("status") == "completed":
+            return
+        try:
+            with open(os.path.join(
+                    self.workdir, f"rebalanced-{name}.json")) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            return
+        for k in ("checkpoint", "counters", "phase"):
+            if k in snap:
+                rb[k] = snap[k]
 
     def _snap_volinfo_by_name(self, volname: str) -> dict | None:
         for s in self.state.get("snaps", {}).values():

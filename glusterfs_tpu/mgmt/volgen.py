@@ -122,6 +122,13 @@ OPTION_MAP = {
     "cluster.lookup-unhashed": ("cluster/distribute", "lookup-unhashed"),
     "cluster.min-free-disk": ("cluster/distribute", "min-free-disk"),
     "cluster.rebal-throttle": ("cluster/distribute", "rebal-throttle"),
+    "cluster.rebal-migrate-window": ("cluster/distribute",
+                                     "rebal-migrate-window"),
+    # consumed by the glusterd-spawned rebalance daemon, not a graph
+    # layer (mgmt/rebalanced.py reads it out of the volinfo like the
+    # gateway daemon reads gateway.*)
+    "rebalance.checkpoint-interval": ("mgmt/rebalanced",
+                                      "checkpoint-interval"),
     "network.ping-timeout": ("protocol/client", "ping-timeout"),
     "storage.health-check-interval": ("storage/posix",
                                       "health-check-interval"),
@@ -731,6 +738,17 @@ _V12_KEYS = (
     "cluster.delta-writes",
 )
 OPTION_MIN_OPVERSION.update({k: 12 for k in _V12_KEYS})
+
+# round-14 additions ship at op-version 13: the managed rebalance
+# daemon — a v12 glusterd has no rebalanced spawner, no
+# rebalance-update RPC and no checkpoint slot in its volinfo, so both
+# the daemon knob and the migration window key must not reach it (the
+# `volume rebalance` ops themselves are gated on 13 in glusterd)
+_V13_KEYS = (
+    "rebalance.checkpoint-interval",
+    "cluster.rebal-migrate-window",
+)
+OPTION_MIN_OPVERSION.update({k: 13 for k in _V13_KEYS})
 
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key

@@ -79,6 +79,18 @@ def test_add_and_remove_brick_distribute(tmp_path):
                                  bricks=[], action="commit")
                     info = await c.call("volume-info", name="ev")
                     assert len(info["ev"]["bricks"]) == 2
+
+                    # commit pushes a 2-brick volfile; like the
+                    # add-brick half above, wait for the swapped
+                    # graph's clients to CONNECT before reading (the
+                    # swap window is sub-second but real)
+                    async def settled():
+                        cls = [l for l in m.graph.by_name.values()
+                               if l.type_name == "protocol/client"]
+                        return len(cls) == 2 and \
+                            all(l.connected for l in cls)
+
+                    assert await _wait(settled), "post-commit swap"
                     for n in names:
                         assert await m.read_file(f"/{n}") == n.encode()
                 finally:

@@ -160,7 +160,7 @@ def test_option_map_integrity():
 
     # pseudo-targets consumed by daemons, not graph layers
     pseudo = {"__ssl__", "mgmt/glusterd", "mgmt/shd", "mgmt/gsyncd",
-              "mgmt/bitd", "mgmt/gateway"}
+              "mgmt/bitd", "mgmt/gateway", "mgmt/rebalanced"}
     # both-end transport keys must exist on BOTH protocol layers
     for key, (ltype, opt) in volgen.OPTION_MAP.items():
         if ltype == "__transport__":

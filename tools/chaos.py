@@ -31,6 +31,13 @@ contract the EC/protocol planes promise:
 * ``gateway``         — the HTTP front door over the same volume
                         keeps answering (correct bytes or clean
                         error, never a hang) while a brick is down.
+* ``rebalance_grow``  — grow the loaded 4+2 volume by a second
+                        distribute leg WHILE serving: managed daemon
+                        migration under live reads/writes, SIGKILL +
+                        respawn resumes from its checkpoint, bounded
+                        read latency, every pre-existing and
+                        in-flight object byte-identical after
+                        convergence (ISSUE 11 acceptance).
 * ``fuse``            — (--with-fuse only; kernel-dependent) the
                         mount stays responsive through a brick kill.
 
@@ -55,6 +62,7 @@ import sys
 import tempfile
 import threading
 import time
+import traceback
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
@@ -74,8 +82,9 @@ N = K + R
 MIB = 1 << 20
 
 #: per-scenario wall-clock bound (a wedged scenario FAILS, it never
-#: hangs the harness)
-SCENARIO_DEADLINE_S = 300.0
+#: hangs the harness); sized for rebalance_grow, which spawns six
+#: extra bricks plus two rebalance daemons on a loaded host
+SCENARIO_DEADLINE_S = 420.0
 
 SCENARIOS: dict = {}
 
@@ -460,6 +469,147 @@ async def gateway(base: str, opts) -> dict:
     return out
 
 
+@scenario("rebalance_grow")
+async def rebalance_grow(base: str, opts) -> dict:
+    """ISSUE 11 acceptance: grow a LOADED disperse 4+2 volume by an
+    added distribute leg while it serves — fix-layout + daemon
+    migration under live reads/writes, a SIGKILL + respawn mid-run
+    RESUMES from the checkpoint (never restarts the walk), serving
+    read latency stays bounded throughout, and every pre-existing and
+    in-flight object is byte-identical after convergence."""
+    out: dict = {}
+    async with Stack(base) as st:
+        await st.set("cluster.rebal-throttle", "lazy")
+        await st.set("rebalance.checkpoint-interval", "0.1")
+        cl = await st.mount()
+        try:
+            # pre-existing namespace spread over directories, so the
+            # checkpoint has directory boundaries to land on
+            pre: dict[str, bytes] = {}
+            for dd in range(6):
+                await cl.mkdir(f"/d{dd}")
+                for i in range(6):
+                    p = f"/d{dd}/f{i}"
+                    pre[p] = payload_for(dd * 16 + i)[:256 * 1024]
+                    await cl.write_file(p, pre[p])
+            # serving load: reads with latency recorded (bounded!),
+            # plus in-flight writes landing under the NEW layout
+            lat: list[float] = []
+            inflight: dict[str, bytes] = {}
+            retries = {"n": 0}
+            stop_load = asyncio.Event()
+
+            async def load():
+                i = 0
+                names = list(pre)
+                while not stop_load.is_set():
+                    p = names[i % len(names)]
+                    t0 = time.monotonic()
+                    try:
+                        got = await asyncio.wait_for(cl.read_file(p), 60)
+                    except FopError:
+                        # one bounded retry: the live add-brick graph
+                        # swap can catch a read mid-flight
+                        retries["n"] += 1
+                        got = await asyncio.wait_for(cl.read_file(p), 60)
+                    lat.append(time.monotonic() - t0)
+                    assert bytes(got) == pre[p], \
+                        f"serving read of {p} returned wrong bytes"
+                    if i % 3 == 0:
+                        np_path = f"/d{i % 6}/new{i}"
+                        body = payload_for(7000 + i)[:64 * 1024]
+                        try:
+                            await asyncio.wait_for(
+                                cl.write_file(np_path, body), 60)
+                        except FopError:
+                            # same graph-swap blip as the read above
+                            # (EEXIST from a landed first try falls
+                            # back to open+write inside write_file)
+                            retries["n"] += 1
+                            await asyncio.wait_for(
+                                cl.write_file(np_path, body), 60)
+                        inflight[np_path] = body
+                    i += 1
+                    await asyncio.sleep(0.05)
+
+            loader = asyncio.ensure_future(load())
+            try:
+                async with MgmtClient(st.d.host, st.d.port) as c:
+                    # a second 4+2 leg: the volume becomes 2x(4+2)
+                    await c.call("volume-add-brick", name=st.name,
+                                 bricks=[{"path": os.path.join(
+                                     base, f"nb{i}")} for i in range(N)])
+                    await c.call("volume-rebalance", name=st.name,
+                                 action="start")
+
+                def rb() -> dict:
+                    return st.d._vol(st.name).get("rebalance") or {}
+
+                # wait for a mid-migration checkpoint, then SIGKILL
+                deadline = time.monotonic() + 150
+                while True:
+                    r = rb()
+                    ck = r.get("checkpoint") or {}
+                    if r.get("phase") == "migrate" and \
+                            ck.get("last_dir") and \
+                            (r.get("counters") or {}).get("moved", 0):
+                        break
+                    assert r.get("status") == "started", \
+                        f"rebalance died or finished too fast: {r}"
+                    assert time.monotonic() < deadline, r
+                    await asyncio.sleep(0.05)
+                pre_ctr = dict(rb()["counters"])
+                proc = st.d.rebalanced[st.name]
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.wait()
+                out["killed_at_checkpoint"] = \
+                    rb()["checkpoint"]["last_dir"]
+                async with MgmtClient(st.d.host, st.d.port) as c:
+                    resp = await c.call("volume-rebalance",
+                                        name=st.name, action="start")
+                assert resp["status"] == "resumed", resp
+                deadline = time.monotonic() + 240
+                while rb().get("status") not in ("completed", "failed"):
+                    assert time.monotonic() < deadline, rb()
+                    await asyncio.sleep(0.3)
+                r = rb()
+                assert r["status"] == "completed", r
+                assert r.get("resumed_from", {}).get("last_dir"), \
+                    f"respawn restarted instead of resuming: {r}"
+                fin = r["counters"]
+                assert fin["scanned"] > pre_ctr["scanned"], (pre_ctr, fin)
+                assert fin["dirs_fixed"] == pre_ctr["dirs_fixed"], \
+                    "respawn redid fix-layout"
+                out["resumed_from"] = r["resumed_from"]
+                out["migrated"] = {"moved": fin["moved"],
+                                   "bytes": fin["bytes_moved"],
+                                   "failed": fin["failed"]}
+                assert fin["failed"] == 0, fin
+            finally:
+                stop_load.set()
+                await loader
+            assert lat, "serving load never ran"
+            p99 = sorted(lat)[int(0.99 * (len(lat) - 1))]
+            out["serving_reads"] = len(lat)
+            out["read_retries"] = retries["n"]
+            out["read_p99_s"] = round(p99, 2)
+            assert p99 < 30, \
+                f"serving latency unbounded during rebalance: {p99:.1f}s"
+        finally:
+            await cl.unmount()
+        # fresh mount: every object byte-identical after convergence
+        cl2 = await st.mount()
+        try:
+            for p, body in {**pre, **inflight}.items():
+                got = await asyncio.wait_for(cl2.read_file(p), 60)
+                assert bytes(got) == body, \
+                    f"{p} not byte-identical after growth"
+            out["objects_verified"] = len(pre) + len(inflight)
+        finally:
+            await cl2.unmount()
+    return out
+
+
 @scenario("fuse")
 async def fuse(base: str, opts) -> dict:
     """Kernel-mount responsiveness through a brick kill (gated behind
@@ -569,7 +719,8 @@ async def amain(opts) -> dict:
                     SCENARIOS[name](base, opts), SCENARIO_DEADLINE_S)
                 detail["ok"] = True
             except BaseException as e:  # noqa: BLE001 - report, don't die
-                detail = {"ok": False, "error": repr(e)[:300]}
+                detail = {"ok": False, "error": repr(e)[:300],
+                          "trace": traceback.format_exc()[-1200:]}
                 report["ok"] = False
             detail["elapsed_s"] = round(time.monotonic() - t0, 1)
             report["scenarios"][name] = detail

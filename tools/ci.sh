@@ -582,11 +582,89 @@ if [ $delta_rc -ne 0 ]; then
     exit $delta_rc
 fi
 
+echo "== ci: rebalance smoke (managed volume, add-brick, daemon"
+echo "       start -> status converges, families present) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, json, os, shutil, tempfile, time
+
+async def main():
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    base = tempfile.mkdtemp(prefix="ci-rebal")
+    d = Glusterd(os.path.join(base, "gd"))
+    await d.start()
+    try:
+        async with MgmtClient(d.host, d.port) as c:
+            await c.call("volume-create", name="rv", vtype="distribute",
+                         redundancy=0,
+                         bricks=[{"path": os.path.join(base, f"b{i}")}
+                                 for i in range(2)])
+            await c.call("volume-start", name="rv")
+        cl = await mount_volume(d.host, d.port, "rv")
+        data = {}
+        try:
+            for dd in range(3):
+                await cl.mkdir(f"/d{dd}")
+                for i in range(5):
+                    p = f"/d{dd}/f{i}"
+                    data[p] = f"{p}-payload".encode() * 150
+                    await cl.write_file(p, data[p])
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-add-brick", name="rv",
+                             bricks=[{"path": os.path.join(base, "b2")}])
+                out = await c.call("volume-rebalance", name="rv",
+                                   action="start")
+                assert out["status"] == "started", out
+                deadline = time.monotonic() + 240
+                while True:
+                    st = await c.call("volume-rebalance", name="rv",
+                                      action="status")
+                    rb = st["rebalance"]
+                    if rb.get("status") in ("completed", "failed"):
+                        break
+                    assert time.monotonic() < deadline, rb
+                    await asyncio.sleep(0.3)
+                assert rb["status"] == "completed", rb
+                ctr = rb["counters"]
+                assert ctr["moved"] >= 1 and ctr["failed"] == 0, ctr
+                assert ctr["scanned"] == ctr["moved"] + ctr["skipped"], ctr
+                vs = await c.call("volume-status", name="rv")
+                kinds = [t["type"] for t in vs.get("tasks", [])]
+                assert "rebalance" in kinds, vs.get("tasks")
+            with open(os.path.join(d.workdir,
+                                   "rebalanced-rv.json")) as f:
+                fams = json.load(f)["families"]
+            for fam in ("gftpu_rebalance_files_total",
+                        "gftpu_rebalance_bytes_total",
+                        "gftpu_rebalance_failures_total",
+                        "gftpu_rebalance_phase"):
+                assert fam in fams, fam
+            for p, body in data.items():
+                assert bytes(await cl.read_file(p)) == body, p
+        finally:
+            await cl.unmount()
+    finally:
+        await d.stop()
+        shutil.rmtree(base, ignore_errors=True)
+    print("rebalance smoke: add-brick + managed daemon converged "
+          "(moved>=1, task row rendered, all four gftpu_rebalance_* "
+          "families in the daemon's snapshot, bytes exact)")
+
+asyncio.run(main())
+EOF
+rebal_rc=$?
+if [ $rebal_rc -ne 0 ]; then
+    echo "ci: rebalance smoke failed — not mergeable"
+    exit $rebal_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
 fi
 echo "ci: mergeable (two identical green tier-1 runs + bench contract"
 echo "    + metrics smoke + gateway smoke + concurrency smoke"
-echo "    + mesh smoke + chaos smoke + delta-write smoke)"
+echo "    + mesh smoke + chaos smoke + delta-write smoke"
+echo "    + rebalance smoke)"
 exit 0
