@@ -14,7 +14,11 @@ __version__ = "0.1.0"
 # minimum, gating newer volume-set keys until every member upgrades.
 # Lives here (not in mgmt/glusterd) so protocol/client can advertise it
 # at SETVOLUME without dragging the whole management plane into every
-# client process.  Version history: 13 managed rebalance daemon
+# client process.  Version history: 14 multi-process data plane
+# (gateway.workers shared-nothing worker pool + cluster.mesh-distributed
+# jax.distributed brick mesh, volgen._V14_KEYS; also lifts the
+# mesh-codec-vs-systematic mutual exclusion — the mesh tier gained a
+# parity-rows-only systematic encode); 13 managed rebalance daemon
 # (volume rebalance start/status/stop ops + rebalance-update RPC +
 # rebalance.checkpoint-interval / cluster.rebal-migrate-window,
 # volgen._V13_KEYS); 12 parity-delta write plane (the
@@ -31,4 +35,4 @@ __version__ = "0.1.0"
 # diagnostics, _V7_KEYS); 6 zero-copy reads + strict-locks (_V6_KEYS);
 # 5 compound fops + auth.ssl-allow (_V5_KEYS); 4 round-5 keys
 # (_V4_KEYS); 3 the round-4 option long tail (_V3_KEYS).
-OP_VERSION = 13
+OP_VERSION = 14

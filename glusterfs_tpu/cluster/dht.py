@@ -1280,13 +1280,15 @@ class DistributeLayer(Layer):
         }
         moved: list[tuple] = []
 
+        from ..mgmt.svcutil import ThrottleWave
+
         async def walk_dir(path: str) -> None:
             fd = await self.opendir(Loc(path))
             try:
                 entries = await self.readdir(fd)
             finally:
                 await self.release(fd)
-            pending: list[asyncio.Task] = []
+            wave = ThrottleWave()
 
             async def migrate(child: str, cloc: Loc, ia, idx: int,
                               hi: int) -> None:
@@ -1330,20 +1332,11 @@ class DistributeLayer(Layer):
                 width, pause = self._THROTTLE[
                     self.opts["rebal-throttle"]]
                 st["throttle"] = self.opts["rebal-throttle"]
-                while len(pending) >= width:
-                    done, rest = await asyncio.wait(
-                        pending, return_when=asyncio.FIRST_COMPLETED)
-                    pending = list(rest)
-                pending.append(asyncio.create_task(
-                    migrate(child, cloc, ia, idx, hi)))
+                await wave.admit(migrate(child, cloc, ia, idx, hi),
+                                 width, pause)
                 st["max_inflight"] = max(st["max_inflight"],
-                                         len(pending))
-                if pause:
-                    # lazy: hand the loop back so client fops
-                    # interleave with the crawl
-                    await asyncio.sleep(pause)
-            if pending:
-                await asyncio.wait(pending)
+                                         wave.max_inflight)
+            await wave.drain()
 
         try:
             await walk_dir(path)

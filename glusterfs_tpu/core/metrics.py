@@ -199,16 +199,23 @@ class MetricsRegistry:
 
     def render(self) -> str:
         """Prometheus text exposition format (version 0.0.4)."""
-        lines: list[str] = []
-        for name, fam in self.collect().items():
-            if fam["help"]:
-                lines.append(f"# HELP {name} {fam['help']}")
-            lines.append(f"# TYPE {name} {fam['type']}")
-            for labels, value in fam["samples"]:
-                if isinstance(value, float) and value == int(value):
-                    value = int(value)
-                lines.append(f"{name}{_fmt_labels(labels)} {value}")
-        return "\n".join(lines) + "\n"
+        return render_families(self.collect())
+
+
+def render_families(families: dict) -> str:
+    """Text exposition of a ``collect()``-shaped family dict — shared
+    by the registry's own ``render()`` and aggregators that merge
+    OTHER processes' snapshots (the gateway worker-pool supervisor)."""
+    lines: list[str] = []
+    for name, fam in families.items():
+        if fam["help"]:
+            lines.append(f"# HELP {name} {fam['help']}")
+        lines.append(f"# TYPE {name} {fam['type']}")
+        for labels, value in fam["samples"]:
+            if isinstance(value, float) and value == int(value):
+                value = int(value)
+            lines.append(f"{name}{_fmt_labels(labels)} {value}")
+    return "\n".join(lines) + "\n"
 
 
 #: THE process-global registry (the prometheus default-registry shape);

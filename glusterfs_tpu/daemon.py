@@ -42,14 +42,12 @@ async def serve_brick(volfile_text: str, host: str = "127.0.0.1",
     return server
 
 
-async def serve_metrics(host: str = "127.0.0.1",
-                        port: int = 0) -> asyncio.AbstractServer:
-    """Prometheus-style scrape endpoint (OFF by default — armed by
-    ``--metrics-port``): a minimal HTTP/1.0 responder serving the
-    unified registry's text dump at ``/metrics``.  Read-only and
-    allocation-light; scraping is a cold path by design."""
-    from .core.metrics import REGISTRY
-
+def http_route_handler(routes):
+    """A one-shot HTTP/1.0 responder over ``routes``: path ->
+    ``async () -> (body_bytes, content_type_bytes)``.  ONE copy of the
+    head parse / 404 / Content-Length plumbing, shared by the daemon
+    metrics endpoint and the gateway worker-pool supervisor's
+    aggregated endpoint — an endpoint or header fix lands everywhere."""
     async def handle(reader: asyncio.StreamReader,
                      writer: asyncio.StreamWriter) -> None:
         try:
@@ -61,13 +59,15 @@ async def serve_metrics(host: str = "127.0.0.1",
                 return
             line = head.split(b"\r\n", 1)[0].split()
             path = line[1].decode("latin-1") if len(line) > 1 else "/"
-            if path.split("?", 1)[0] not in ("/metrics", "/"):
+            path = path.split("?", 1)[0]
+            route = routes.get(path)
+            if route is None:
                 writer.write(b"HTTP/1.0 404 Not Found\r\n"
                              b"Content-Length: 0\r\n\r\n")
                 return
-            body = REGISTRY.render().encode()
+            body, ctype = await route()
             writer.write(b"HTTP/1.0 200 OK\r\n"
-                         b"Content-Type: text/plain; version=0.0.4\r\n"
+                         b"Content-Type: " + ctype + b"\r\n"
                          + f"Content-Length: {len(body)}\r\n\r\n".encode()
                          + body)
             await writer.drain()
@@ -79,7 +79,31 @@ async def serve_metrics(host: str = "127.0.0.1",
             except Exception:
                 pass
 
-    srv = await asyncio.start_server(handle, host, port)
+    return handle
+
+
+async def serve_metrics(host: str = "127.0.0.1",
+                        port: int = 0) -> asyncio.AbstractServer:
+    """Prometheus-style scrape endpoint (OFF by default — armed by
+    ``--metrics-port``): a minimal HTTP/1.0 responder serving the
+    unified registry's text dump at ``/metrics`` and the structured
+    snapshot at ``/metrics.json`` (what ``gftpu volume metrics`` and
+    the worker-pool supervisor ingest).  Read-only and
+    allocation-light; scraping is a cold path by design."""
+    import json
+
+    from .core.metrics import REGISTRY
+
+    async def text():
+        return REGISTRY.render().encode(), b"text/plain; version=0.0.4"
+
+    async def structured():
+        return (json.dumps(REGISTRY.snapshot()).encode(),
+                b"application/json")
+
+    srv = await asyncio.start_server(
+        http_route_handler({"/metrics": text, "/": text,
+                            "/metrics.json": structured}), host, port)
     log.info(6, "metrics endpoint on %s:%d", host,
              srv.sockets[0].getsockname()[1])
     return srv
@@ -112,6 +136,15 @@ async def _amain(args) -> None:
         from .core import events
 
         events.configure(args.eventsd)
+    # cluster.mesh-distributed (ISSUE 12): a brick spawned into a
+    # jax.distributed job (glusterd exports GFTPU_MESH_*) joins the
+    # coordinator in the BACKGROUND — glusterd spawns bricks one at a
+    # time awaiting each port, so a rank that blocked startup waiting
+    # for siblings would deadlock the volume start.  Failure degrades
+    # to the single-runtime plane, never wedges serving.
+    from .parallel import meshd
+
+    meshd.maybe_initialize()
     with open(args.volfile) as f:
         text = f.read()
     server = await serve_brick(text, args.host, args.listen,

@@ -12,6 +12,10 @@ kernel bridge.
 See :mod:`glusterfs_tpu.gateway.server` for the dialect and
 docs/object_gateway.md for the API tour, the coherence model against a
 concurrent fuse client, and the GET-path copy census.
+:mod:`glusterfs_tpu.gateway.workers` is the shared-nothing worker pool
+(``gateway.workers``, docs/process_plane.md) that breaks the
+one-interpreter frame-turning floor.
 """
 
 from .server import ClientPool, ObjectGateway  # noqa: F401
+from .workers import GatewaySupervisor  # noqa: F401

@@ -7,6 +7,19 @@ Each pool member is a full managed mount (GETSPEC + volfile watcher),
 so live ``volume set`` changes reconfigure the gateway's graphs the
 same way they reconfigure a fuse mount.  ``--volfile`` serves a raw
 volfile instead (tests / standalone use — no watcher, no glusterd).
+
+Three roles (ISSUE 12):
+
+* ``--workers 0`` (default): the single-process gateway — one event
+  loop serves the port directly (the pre-op-version-14 shape).
+* ``--workers N``: this process becomes the worker-pool SUPERVISOR —
+  it owns the port (SO_REUSEPORT reservation, or accept + SCM_RIGHTS
+  fd passing under ``--fd-pass``/old kernels), spawns N shared-nothing
+  worker processes, respawns crashes, fans SIGTERM out, and serves the
+  AGGREGATED metrics on ``--metrics-port``.
+* ``--worker-fd FD`` (internal): spawned BY a supervisor — runs one
+  worker's gateway with its own event loop, glfs pool, and metrics
+  registry shard, talking to the parent over the control socketpair.
 """
 
 from __future__ import annotations
@@ -24,10 +37,10 @@ from .server import ClientPool, ObjectGateway
 log = gflog.get_logger("gateway.daemon")
 
 
-async def _amain(args) -> None:
-    if args.eventsd:
-        gf_events.configure(args.eventsd)
-
+def _pool_factory(args):
+    """The glfs mount factory shared by every role — each CALL is one
+    private graph, so workers (separate processes) and pool members
+    (same process) alike own their wire connections outright."""
     if args.volfile:
         with open(args.volfile) as f:
             text = f.read()
@@ -49,8 +62,11 @@ async def _amain(args) -> None:
             from ..mgmt.glusterd import mount_volume
 
             return await mount_volume(gd_host, gd_port, args.volume)
+    return factory
 
-    gw = ObjectGateway(ClientPool(factory, args.pool),
+
+async def _amain_single(args) -> None:
+    gw = ObjectGateway(ClientPool(_pool_factory(args), args.pool),
                        host=args.host, port=args.listen,
                        max_clients=args.max_clients,
                        volume=args.volume or args.volfile)
@@ -75,6 +91,54 @@ async def _amain(args) -> None:
     await gw.stop()
 
 
+async def _amain_worker(args) -> None:
+    from .workers import worker_serve
+
+    gw = ObjectGateway(ClientPool(_pool_factory(args), args.pool),
+                       host=args.host, port=args.listen,
+                       max_clients=args.max_clients,
+                       volume=args.volume or args.volfile)
+    await worker_serve(gw, args.worker_fd, args.worker_rank,
+                       args.reuseport, args.host, args.listen)
+
+
+async def _amain_supervisor(args) -> None:
+    from .workers import GatewaySupervisor
+
+    base_argv = [sys.executable, "-m", "glusterfs_tpu.gateway",
+                 "--pool", str(args.pool)]
+    if args.volfile:
+        base_argv += ["--volfile", args.volfile]
+    else:
+        base_argv += ["--glusterd", args.glusterd,
+                      "--volume", args.volume]
+    if args.eventsd:
+        base_argv += ["--eventsd", args.eventsd]
+    sup = GatewaySupervisor(
+        base_argv, host=args.host, port=args.listen,
+        workers=args.workers, max_clients=args.max_clients,
+        metrics_port=args.metrics_port, portfile=args.portfile,
+        statusfile=args.statusfile, force_fd_pass=args.fd_pass)
+    await sup.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await sup.stop()
+
+
+async def _amain(args) -> None:
+    if args.eventsd:
+        gf_events.configure(args.eventsd)
+    if args.worker_fd >= 0:
+        await _amain_worker(args)
+    elif args.workers > 0:
+        await _amain_supervisor(args)
+    else:
+        await _amain_single(args)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="gftpu-gateway")
     p.add_argument("--glusterd", default="127.0.0.1:24007",
@@ -91,16 +155,33 @@ def main(argv=None) -> int:
     p.add_argument("--portfile", default="",
                    help="write the bound port here")
     p.add_argument("--pool", type=int, default=4,
-                   help="glfs client pool size (gateway.pool-size)")
+                   help="glfs client pool size (gateway.pool-size; "
+                        "per worker when --workers is set)")
     p.add_argument("--max-clients", type=int, default=512,
                    help="connection admission limit "
-                        "(gateway.max-clients)")
+                        "(gateway.max-clients; the supervisor divides "
+                        "it across workers at spawn)")
     p.add_argument("--metrics-port", type=int, default=0,
                    help="serve the unified metrics registry on this "
-                        "port (0 = off)")
+                        "port (0 = off; aggregated across workers "
+                        "when --workers is set)")
     p.add_argument("--eventsd", default="",
                    help="host:port of gftpu-eventsd (arms GATEWAY_* "
                         "lifecycle events; GFTPU_EVENTSD also works)")
+    p.add_argument("--workers", type=int, default=0,
+                   help="shared-nothing worker processes "
+                        "(gateway.workers; 0 = single-process)")
+    p.add_argument("--fd-pass", action="store_true",
+                   help="force the parent-accepts + SCM_RIGHTS "
+                        "fd-passing lane instead of SO_REUSEPORT")
+    p.add_argument("--statusfile", default="",
+                   help="supervisor writes worker pids/mode here")
+    p.add_argument("--worker-fd", type=int, default=-1,
+                   help=argparse.SUPPRESS)  # internal: control channel
+    p.add_argument("--worker-rank", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--reuseport", action="store_true",
+                   help=argparse.SUPPRESS)  # internal: bind own socket
     args = p.parse_args(argv)
     if not args.volume and not args.volfile:
         p.error("one of --volume / --volfile is required")

@@ -286,7 +286,12 @@ class ObjectGateway:
         gf_events.gf_event(kind, volume=self.volume, port=self.port,
                            **fields)
 
-    async def start(self) -> None:
+    async def start(self, sock=None, listen: bool = True) -> None:
+        """``sock``: serve an already-bound listening socket (the
+        SO_REUSEPORT worker-pool lane — each worker binds its own).
+        ``listen=False``: no listener at all — connections arrive as
+        passed fds (the SCM_RIGHTS fallback lane) and the owner feeds
+        them to :meth:`_serve_conn` directly."""
         if not self.pool.clients:
             await self.pool.start()
         # pool-aware event plane: pre-size the shared reply-turning
@@ -295,9 +300,14 @@ class ObjectGateway:
         from ..rpc import event_pool as _evt
 
         _evt.client_pool(self.pool.event_threads())
-        self._server = await asyncio.start_server(
-            self._serve_conn, self.host, self.port)
-        self.port = self._server.sockets[0].getsockname()[1]
+        if sock is not None:
+            self._server = await asyncio.start_server(
+                self._serve_conn, sock=sock)
+            self.port = self._server.sockets[0].getsockname()[1]
+        elif listen:
+            self._server = await asyncio.start_server(
+                self._serve_conn, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
         self._event("GATEWAY_START", pool=self.pool.size,
                     max_clients=self.max_clients)
         log.info(2, "object gateway for %s on %s:%d (pool=%d)",

@@ -1,0 +1,644 @@
+"""Shared-nothing gateway worker pool (ISSUE 12).
+
+Every concurrency record so far flatlined at the same wall: ONE CPython
+interpreter turns all gateway frames, so the c1->c512 ladder sits flat
+at the single-core frame-turning floor (docs/event_threads.md GIL
+analysis).  This module breaks that floor the way nginx/envoy do — by
+not sharing the interpreter at all:
+
+* ``gateway.workers = N`` forks N **worker processes**.  Each worker
+  owns its own event loop, its own glfs :class:`ClientPool` (so its own
+  wire connections and upcall sinks), and its own metrics registry
+  shard — shared-nothing; the GIL stops being a cross-request
+  bottleneck because there is no shared interpreter left to contend on.
+
+* **Socket plane**: every worker ``bind()``s the same port with
+  ``SO_REUSEPORT`` and the kernel load-balances accepted connections
+  across them (the reference's many-glusterfsd analog).  On kernels
+  without usable reuseport distribution — or under ``--fd-pass`` — the
+  parent accepts and hands connection fds to workers over a
+  ``socketpair`` with ``SCM_RIGHTS`` (the classic pre-fork fd-passing
+  fallback), round-robin.
+
+* **Supervision**: the parent is a supervisor, not a data path.  A
+  crashed worker is respawned (same rank, fresh channel); SIGTERM fans
+  out; admission control (``gateway.max-clients``) is divided across
+  workers at spawn so the pool as a whole honors the volume key.
+
+* **Metrics**: each worker's registry shard is scraped over its control
+  channel; the parent aggregates per-worker snapshots (counters sum,
+  gauges sum, quantile gauges take the max) and serves the merged
+  families on ``gateway.metrics-port`` (text + ``/metrics.json``) —
+  plus its own ``gftpu_gateway_workers`` / worker-respawn families.
+
+Control channel: one ``AF_UNIX`` ``SOCK_SEQPACKET`` socketpair per
+worker carrying JSON messages (fd in ancillary data for ``conn``):
+
+    parent -> worker   {"op": "conn"} + fd          (fd-pass mode)
+    parent -> worker   {"op": "snapshot", "id": n}
+    worker -> parent   {"op": "ready", "port": p}
+    worker -> parent   {"op": "snapshot", "id": n, "registry": ...,
+                        "gateway": ...}
+
+Channel EOF means the peer died: the worker exits (orphan guard), the
+parent respawns.
+"""
+
+from __future__ import annotations
+
+import array
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from ..core import gflog
+from ..core.metrics import render_families
+
+log = gflog.get_logger("gateway.workers")
+
+#: seqpacket message ceiling ASKED FOR at channel creation — a worker
+#: registry snapshot is a few KiB.  The kernel silently clamps
+#: SO_SNDBUF to net.core.wmem_max, so the EFFECTIVE cap is read back
+#: per socket (recv buffers size to it, and an EMSGSIZE send degrades
+#: to a truncated reply — never a dead worker)
+_BUFSIZE = 4 << 20
+
+_READY_TIMEOUT_S = 120.0  # cold interpreter + jax imports + pool mounts
+_SNAPSHOT_TIMEOUT_S = 5.0
+
+
+def reuseport_ok(host: str) -> bool:
+    """Can two sockets bind the same (host, port) with SO_REUSEPORT on
+    this kernel?  Probed, not assumed — the fallback exists for kernels
+    that lack it (or lack the load-balancing semantics)."""
+    if not hasattr(socket, "SO_REUSEPORT"):
+        return False
+    s1 = s2 = None
+    try:
+        s1 = bind_reuseport(host, 0)
+        s2 = bind_reuseport(host, s1.getsockname()[1])
+        return True
+    except OSError:
+        return False
+    finally:
+        for s in (s1, s2):
+            if s is not None:
+                s.close()
+
+
+def bind_reuseport(host: str, port: int) -> socket.socket:
+    """A bound (not yet listening) SO_REUSEPORT socket."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        s.bind((host, port))
+    except BaseException:
+        s.close()
+        raise
+    return s
+
+
+def make_channel() -> tuple[socket.socket, socket.socket]:
+    """The per-worker control socketpair (seqpacket: message-framed
+    JSON, SCM_RIGHTS rides the ``conn`` messages)."""
+    a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_SEQPACKET)
+    for s in (a, b):
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, _BUFSIZE)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, _BUFSIZE)
+    return a, b
+
+
+async def _wait_io(loop, sock: socket.socket, write: bool) -> None:
+    fut = loop.create_future()
+    fd = sock.fileno()
+    add, remove = ((loop.add_writer, loop.remove_writer) if write
+                   else (loop.add_reader, loop.remove_reader))
+
+    def ready():
+        if not fut.done():
+            fut.set_result(None)
+
+    add(fd, ready)
+    try:
+        await fut
+    finally:
+        remove(fd)
+
+
+async def send_msg(loop, sock: socket.socket, obj: dict,
+                   fds: tuple[int, ...] = ()) -> None:
+    """One JSON message (+ optional fds) as one seqpacket datagram."""
+    payload = json.dumps(obj).encode()
+    anc = [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+            array.array("i", fds))] if fds else []
+    while True:
+        try:
+            sock.sendmsg([payload], anc)
+            return
+        except BlockingIOError:
+            await _wait_io(loop, sock, write=True)
+
+
+async def recv_msg(loop, sock: socket.socket
+                   ) -> tuple[dict | None, list[int]]:
+    """One message; ``(None, [])`` on EOF.  Received fds are returned
+    raw (caller owns closing them).  The receive buffer is sized to
+    the socket's EFFECTIVE buffer (getsockopt), not the 4 MiB ask — a
+    4 MiB bytes alloc per ~20-byte ``conn`` message would churn
+    gigabytes on a busy fd-pass accept path."""
+    bufsize = max(64 << 10,
+                  sock.getsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF))
+    while True:
+        try:
+            data, anc, _flags, _addr = sock.recvmsg(
+                bufsize, socket.CMSG_LEN(4 * 8))
+            break
+        except BlockingIOError:
+            await _wait_io(loop, sock, write=False)
+    fds: list[int] = []
+    for level, ctype, cdata in anc:
+        if level == socket.SOL_SOCKET and ctype == socket.SCM_RIGHTS:
+            a = array.array("i")
+            a.frombytes(cdata[: len(cdata) - len(cdata) % a.itemsize])
+            fds.extend(a)
+    if not data:
+        return None, fds
+    return json.loads(data.decode()), fds
+
+
+def merge_snapshots(snaps: list[dict]) -> dict:
+    """Aggregate per-worker registry snapshots into one family dict
+    (the ``MetricsRegistry.snapshot()`` shape).  Counters and plain
+    gauges SUM across the shards (each worker counts only its own
+    traffic); gauge samples carrying a ``quantile`` label take the MAX
+    (summing percentiles across shards is meaningless — the max is the
+    honest worst-shard view)."""
+    merged: dict[str, dict] = {}
+    for snap in snaps:
+        for name, fam in snap.items():
+            m = merged.setdefault(
+                name, {"type": fam.get("type", "gauge"),
+                       "help": fam.get("help", ""), "samples": {}})
+            for labels, value in fam.get("samples", []):
+                key = tuple(sorted(labels.items()))
+                if "quantile" in labels:
+                    prev = m["samples"].get(key)
+                    m["samples"][key] = value if prev is None \
+                        else max(prev, value)
+                else:
+                    m["samples"][key] = m["samples"].get(key, 0) + value
+    return {name: {"type": fam["type"], "help": fam["help"],
+                   "samples": [[dict(k), v]
+                               for k, v in sorted(fam["samples"].items())]}
+            for name, fam in sorted(merged.items())}
+
+
+class _Worker:
+    """Parent-side handle of one worker process."""
+
+    def __init__(self, rank: int, proc: subprocess.Popen,
+                 chan: socket.socket):
+        self.rank = rank
+        self.proc = proc
+        self.chan = chan
+        self.ready = asyncio.get_running_loop().create_future()
+        self.port = 0
+        self._waiters: dict[int, asyncio.Future] = {}
+        self._reader: asyncio.Task | None = None
+
+    def start_reader(self, loop) -> None:
+        self._reader = loop.create_task(self._read_loop(loop))
+
+    async def _read_loop(self, loop) -> None:
+        try:
+            while True:
+                msg, fds = await recv_msg(loop, self.chan)
+                for fd in fds:  # workers never send fds; be safe
+                    os.close(fd)
+                if msg is None:
+                    break
+                if msg.get("op") == "ready":
+                    self.port = int(msg.get("port", 0))
+                    if not self.ready.done():
+                        self.ready.set_result(True)
+                elif msg.get("op") == "snapshot":
+                    fut = self._waiters.pop(msg.get("id"), None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+        except Exception:  # noqa: BLE001 - channel torn: worker is gone
+            pass
+        finally:
+            if not self.ready.done():
+                self.ready.set_result(False)
+            for fut in self._waiters.values():
+                if not fut.done():
+                    fut.set_result(None)
+            self._waiters.clear()
+
+    async def snapshot(self, loop, req_id: int) -> dict | None:
+        fut = loop.create_future()
+        self._waiters[req_id] = fut
+        try:
+            await send_msg(loop, self.chan,
+                           {"op": "snapshot", "id": req_id})
+            return await asyncio.wait_for(fut, _SNAPSHOT_TIMEOUT_S)
+        except (OSError, asyncio.TimeoutError):
+            self._waiters.pop(req_id, None)
+            return None
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def close(self) -> None:
+        if self._reader is not None:
+            self._reader.cancel()
+        try:
+            self.chan.close()
+        except OSError:
+            pass
+
+
+class GatewaySupervisor:
+    """The parent process of a ``gateway.workers`` pool.
+
+    Owns the port (reserving it or accepting on it), the worker
+    lifecycle (spawn / respawn / SIGTERM fan-out), and the aggregated
+    metrics endpoint.  It serves no HTTP itself — the data plane lives
+    entirely in the workers."""
+
+    def __init__(self, base_argv: list[str], host: str, port: int,
+                 workers: int, max_clients: int,
+                 metrics_port: int = 0, portfile: str = "",
+                 statusfile: str = "", force_fd_pass: bool = False):
+        self.base_argv = list(base_argv)
+        self.host = host
+        self.port = int(port)
+        self.workers = max(1, int(workers))
+        self.max_clients = int(max_clients)
+        self.metrics_port = int(metrics_port)
+        self.portfile = portfile
+        self.statusfile = statusfile
+        self.force_fd_pass = bool(force_fd_pass)
+        self.mode = ""  # "reuseport" | "fd-pass"
+        self.respawns = 0
+        self._workers: dict[int, _Worker] = {}
+        self._reserve: socket.socket | None = None
+        self._lsock: socket.socket | None = None
+        self._tasks: list[asyncio.Task] = []
+        self._metrics_srv: asyncio.AbstractServer | None = None
+        self._stopping = False
+        self._snap_seq = 0
+        self._rr = 0
+        self._last_respawn: dict[int, float] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def per_worker_clients(self) -> int:
+        """The admission split: the volume key bounds the POOL, so each
+        worker enforces its share (never below 1)."""
+        return max(1, self.max_clients // self.workers)
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        if not self.force_fd_pass and reuseport_ok(self.host):
+            self.mode = "reuseport"
+            # reserve the port for the pool's lifetime: bound but NEVER
+            # listening, so the kernel's reuseport distribution only
+            # ever sees the workers' listening sockets
+            self._reserve = bind_reuseport(self.host, self.port)
+            self.port = self._reserve.getsockname()[1]
+        else:
+            self.mode = "fd-pass"
+            self._lsock = socket.socket(socket.AF_INET,
+                                        socket.SOCK_STREAM)
+            self._lsock.setsockopt(socket.SOL_SOCKET,
+                                   socket.SO_REUSEADDR, 1)
+            self._lsock.bind((self.host, self.port))
+            self._lsock.listen(512)
+            self._lsock.setblocking(False)
+            self.port = self._lsock.getsockname()[1]
+        for rank in range(self.workers):
+            self._spawn(rank)
+        ok = await asyncio.gather(
+            *(asyncio.wait_for(w.ready, _READY_TIMEOUT_S)
+              for w in self._workers.values()),
+            return_exceptions=True)
+        if not any(r is True for r in ok):
+            raise RuntimeError(
+                f"no gateway worker came up (of {self.workers})")
+        if self.mode == "fd-pass":
+            self._tasks.append(loop.create_task(self._accept_loop(loop)))
+        self._tasks.append(loop.create_task(self._supervise(loop)))
+        if self.metrics_port:
+            from ..daemon import http_route_handler
+
+            async def text():
+                return (render_families(await self.snapshot()).encode(),
+                        b"text/plain; version=0.0.4")
+
+            async def structured():
+                return (json.dumps(await self.snapshot()).encode(),
+                        b"application/json")
+
+            async def per_worker():
+                return (json.dumps({
+                    "mode": self.mode, "respawns": self.respawns,
+                    "workers": await self.gateway_dumps()}).encode(),
+                    b"application/json")
+
+            self._metrics_srv = await asyncio.start_server(
+                http_route_handler({"/metrics": text, "/": text,
+                                    "/metrics.json": structured,
+                                    "/workers.json": per_worker}),
+                self.host, self.metrics_port)
+        if self.portfile:
+            tmp = self.portfile + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(self.port))
+            os.replace(tmp, self.portfile)
+        self._write_status()
+        log.info(2, "gateway worker pool on %s:%d (%d workers, %s, "
+                 "%d clients/worker)", self.host, self.port,
+                 self.workers, self.mode, self.per_worker_clients())
+
+    def _spawn(self, rank: int) -> None:
+        parent_sock, child_sock = make_channel()
+        parent_sock.setblocking(False)
+        argv = self.base_argv + [
+            "--worker-fd", str(child_sock.fileno()),
+            "--worker-rank", str(rank),
+            "--host", self.host,
+            "--listen", str(self.port),
+            "--max-clients", str(self.per_worker_clients()),
+        ]
+        if self.mode == "reuseport":
+            argv.append("--reuseport")
+        proc = subprocess.Popen(argv, pass_fds=(child_sock.fileno(),),
+                                stdout=subprocess.DEVNULL)
+        child_sock.close()
+        w = _Worker(rank, proc, parent_sock)
+        w.start_reader(asyncio.get_running_loop())
+        self._workers[rank] = w
+
+    async def _supervise(self, loop) -> None:
+        """Respawn crashed workers; a dying worker loses its in-flight
+        connections (its clients reconnect and land on a live sibling)
+        but never the pool."""
+        while not self._stopping:
+            await asyncio.sleep(0.3)
+            for rank, w in list(self._workers.items()):
+                if self._stopping or w.alive():
+                    continue
+                # backoff: a worker dying INSTANTLY (bad config, port
+                # gone) must not crash-loop at poll rate — one respawn
+                # per rank per second bounds the spawn storm while a
+                # healthy-but-crashed worker still returns fast
+                now = time.monotonic()
+                if now - self._last_respawn.get(rank, 0.0) < 1.0:
+                    continue
+                self._last_respawn[rank] = now
+                log.warning(2, "gateway worker %d died (rc=%s); "
+                            "respawning", rank, w.proc.returncode)
+                w.close()
+                self.respawns += 1
+                self._spawn(rank)
+                self._write_status()
+
+    async def _accept_loop(self, loop) -> None:
+        """fd-pass mode: accept here, hand the connection fd to the
+        next live worker over SCM_RIGHTS, close our copy."""
+        while not self._stopping:
+            try:
+                conn, _addr = await loop.sock_accept(self._lsock)
+            except (OSError, asyncio.CancelledError):
+                break
+            sent = False
+            workers = [w for w in self._workers.values() if w.alive()]
+            for i in range(len(workers)):
+                w = workers[(self._rr + i) % len(workers)]
+                try:
+                    await send_msg(loop, w.chan, {"op": "conn"},
+                                   fds=(conn.fileno(),))
+                    self._rr = (self._rr + i + 1) % max(1, len(workers))
+                    sent = True
+                    break
+                except OSError:
+                    continue
+            conn.close()  # worker holds its own duplicate now
+            if not sent:
+                log.warning(3, "no live worker to take a connection")
+
+    # -- aggregated metrics ------------------------------------------------
+
+    async def snapshot(self) -> dict:
+        """Merged per-worker registry snapshots + supervisor families."""
+        loop = asyncio.get_running_loop()
+        reqs = []
+        for w in list(self._workers.values()):
+            if w.alive():
+                self._snap_seq += 1
+                reqs.append(w.snapshot(loop, self._snap_seq))
+        replies = await asyncio.gather(*reqs) if reqs else []
+        shards = [r["registry"] for r in replies
+                  if r and "registry" in r]
+        merged = merge_snapshots(shards)
+        alive = sum(1 for w in self._workers.values() if w.alive())
+        merged["gftpu_gateway_workers"] = {
+            "type": "gauge",
+            "help": "shared-nothing gateway worker processes by state "
+                    "(mode label says reuseport vs fd-pass)",
+            "samples": [[{"state": "alive", "mode": self.mode}, alive],
+                        [{"state": "configured", "mode": self.mode},
+                         self.workers]]}
+        merged["gftpu_gateway_worker_respawns_total"] = {
+            "type": "counter",
+            "help": "gateway workers respawned after a crash",
+            "samples": [[{}, self.respawns]]}
+        return merged
+
+    async def gateway_dumps(self) -> list[dict]:
+        """Per-worker ObjectGateway.dump() list (tests/status)."""
+        loop = asyncio.get_running_loop()
+        out = []
+        for w in list(self._workers.values()):
+            if not w.alive():
+                continue
+            self._snap_seq += 1
+            r = await w.snapshot(loop, self._snap_seq)
+            if r and "gateway" in r:
+                out.append({"rank": w.rank, **r["gateway"]})
+        return out
+
+    def _write_status(self) -> None:
+        if not self.statusfile:
+            return
+        info = {"pid": os.getpid(), "port": self.port,
+                "mode": self.mode, "respawns": self.respawns,
+                "workers": [
+                    {"rank": w.rank, "pid": w.proc.pid,
+                     "alive": w.alive()}
+                    for w in sorted(self._workers.values(),
+                                    key=lambda x: x.rank)]}
+        tmp = self.statusfile + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(info, f)
+            os.replace(tmp, self.statusfile)
+        except OSError:
+            pass
+
+    # -- teardown ----------------------------------------------------------
+
+    async def stop(self) -> None:
+        """SIGTERM fan-out, bounded wait, SIGKILL stragglers."""
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        for w in self._workers.values():
+            if w.alive():
+                try:
+                    w.proc.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 5.0
+        for w in self._workers.values():
+            left = deadline - time.monotonic()
+            try:
+                # off-loop: the supervisor's loop stays live (metrics
+                # scrapes, accept teardown) while workers drain
+                await asyncio.to_thread(w.proc.wait,
+                                        timeout=max(0.1, left))
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+                await asyncio.to_thread(w.proc.wait)
+            w.close()
+        self._workers.clear()
+        if self._metrics_srv is not None:
+            self._metrics_srv.close()
+            self._metrics_srv = None
+        for s in (self._reserve, self._lsock):
+            if s is not None:
+                s.close()
+        self._reserve = self._lsock = None
+        if self.portfile:
+            try:
+                os.unlink(self.portfile)
+            except FileNotFoundError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+
+
+async def worker_serve(gw, ctl_fd: int, rank: int,
+                       reuseport: bool, host: str, port: int) -> None:
+    """One worker's life: start the gateway (own listener under
+    reuseport, none under fd-pass), answer the control channel, exit
+    when the channel closes (parent died) or SIGTERM lands.
+
+    ``gw`` is this worker's own :class:`ObjectGateway` — its pool, its
+    event loop, its registry shard; nothing here is shared with any
+    sibling."""
+    loop = asyncio.get_running_loop()
+    chan = socket.socket(fileno=ctl_fd)
+    chan.setblocking(False)
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+
+    if reuseport:
+        lsock = bind_reuseport(host, port)
+        lsock.listen(128)
+        await gw.start(sock=lsock)
+    else:
+        await gw.start(listen=False)
+        gw.port = port  # the parent's listener; dumps stay truthful
+
+    # strong refs to passed-fd serve tasks: the loop keeps only weak
+    # refs, and a GC'd task resets its client's connection mid-request
+    serving: set[asyncio.Task] = set()
+
+    async def read_ctl():
+        try:
+            await _read_ctl_loop()
+        except Exception:  # noqa: BLE001 - channel torn any other way
+            # ECONNRESET (supervisor SIGKILLed with data in flight) or
+            # a corrupt datagram must ALSO trip the orphan guard — a
+            # dead reader task without stop.set() leaves a zombie
+            # worker sharing the reuseport distribution forever
+            stop.set()
+
+    async def _read_ctl_loop():
+        while True:
+            msg, fds = await recv_msg(loop, chan)
+            if msg is None:
+                for fd in fds:
+                    os.close(fd)
+                stop.set()  # parent gone: orphaned workers must exit
+                return
+            op = msg.get("op")
+            if op == "conn":
+                for fd in fds:
+                    conn = socket.socket(fileno=fd)
+                    try:
+                        r, w = await asyncio.open_connection(sock=conn)
+                    except OSError:
+                        conn.close()
+                        continue
+                    t = loop.create_task(gw._serve_conn(r, w))
+                    serving.add(t)
+                    t.add_done_callback(serving.discard)
+            elif op == "snapshot":
+                import errno as _errno
+
+                from ..core.metrics import REGISTRY
+
+                for fd in fds:
+                    os.close(fd)
+                try:
+                    await send_msg(loop, chan, {
+                        "op": "snapshot", "id": msg.get("id"),
+                        "registry": REGISTRY.snapshot(),
+                        "gateway": gw.dump()})
+                except OSError as e:
+                    if e.errno != _errno.EMSGSIZE:
+                        stop.set()  # channel truly dead
+                        return
+                    # the shard outgrew the channel's effective
+                    # message cap (wmem_max clamp): degrade the REPLY
+                    # — a metrics scrape must never kill a worker
+                    try:
+                        await send_msg(loop, chan, {
+                            "op": "snapshot", "id": msg.get("id"),
+                            "registry": {},
+                            "truncated": "registry snapshot exceeded "
+                                         "the control channel's "
+                                         "message cap",
+                            "gateway": gw.dump()})
+                    except OSError:
+                        stop.set()
+                        return
+            else:
+                for fd in fds:
+                    os.close(fd)
+
+    reader = loop.create_task(read_ctl())
+    try:
+        await send_msg(loop, chan, {"op": "ready", "port": gw.port,
+                                    "rank": rank})
+    except OSError:
+        stop.set()
+    await stop.wait()
+    reader.cancel()
+    await gw.stop()
+    try:
+        chan.close()
+    except OSError:
+        pass

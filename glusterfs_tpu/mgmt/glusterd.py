@@ -974,14 +974,19 @@ class Glusterd:
             raise MgmtError(f"unsupported transport {value!r} "
                             "(this build speaks tcp)")
         if key == "cluster.mesh-codec" and volgen._bool(value) and \
-                self._vol(name).get("systematic"):
-            # the mesh tier has no systematic mode (ops/batch only
-            # warms it on non-systematic codecs): storing the key
-            # would silently do nothing — refuse loudly instead
+                self._vol(name).get("systematic") and \
+                self.cluster_op_version() < 14:
+            # pre-14 members have no systematic mesh tier (ops/batch
+            # only armed it on non-systematic codecs): storing the key
+            # would silently do nothing on them — refuse loudly.  At
+            # cluster op-version >= 14 the mesh tier runs systematic
+            # volumes through the parity-rows-only sharded encode, so
+            # the old mutual exclusion is lifted (ROADMAP item 5).
             raise MgmtError(
-                "cluster.mesh-codec has no systematic mode yet and "
-                f"volume {name!r} uses the systematic layout "
-                "(create with 'non-systematic' to use the mesh tier)")
+                "cluster.mesh-codec on a systematic volume needs "
+                "cluster op-version >= 14 (a member's mesh tier has "
+                f"no systematic mode; cluster is at "
+                f"{self.cluster_op_version()})")
         results = await self._cluster_txn(
             "volume-set", {"name": name, "key": key, "value": value})
         return {"ok": True,
@@ -1423,7 +1428,11 @@ class Glusterd:
             {"volume": name, "bricks": bricks}, partial)
 
     async def op_volume_metrics_local(self, name: str) -> dict:
-        """One node's share of volume-metrics: its local bricks."""
+        """One node's share of volume-metrics: its local bricks, plus
+        this node's gateway daemon's families when it exposes them
+        (``gateway.metrics-port``) — under a worker pool that endpoint
+        is the supervisor's AGGREGATED per-worker merge, so `volume
+        metrics` sees the whole pool as one front door."""
         vol = self._vol(name)
         out: dict[str, dict] = {}
         for b in vol["bricks"]:
@@ -1439,7 +1448,36 @@ class Glusterd:
             except Exception:
                 snap = None  # dead brick: report empty, not an error
             out[b["name"]] = snap or {}
+        gw_snap = await self._gateway_metrics(vol)
+        if gw_snap is not None:
+            out[f"gateway:{self.host}"] = gw_snap
         return {"bricks": out}
+
+    async def _gateway_metrics(self, vol: dict) -> dict | None:
+        """This node's gateway families over its /metrics.json (both
+        the single-process daemon and the worker-pool supervisor serve
+        it); None when no gateway/metrics-port is armed."""
+        name = vol["name"]
+        proc = self.gateway.get(name)
+        mport = int(vol.get("options", {}).get("gateway.metrics-port",
+                                               0) or 0)
+        if proc is None or proc.poll() is not None or not mport:
+            return None
+        host = str(vol.get("options", {}).get("gateway.listen-host",
+                                              "127.0.0.1"))
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, mport), 3)
+            try:
+                writer.write(b"GET /metrics.json HTTP/1.0\r\n\r\n")
+                await writer.drain()
+                raw = await asyncio.wait_for(reader.read(-1), 5)
+            finally:
+                writer.close()
+            body = raw.split(b"\r\n\r\n", 1)[1]
+            return json.loads(body.decode())
+        except Exception:  # noqa: BLE001 - metrics are best-effort
+            return None
 
     async def op_volume_top(self, name: str, metric: str = "open",
                             count: int = 10) -> dict:
@@ -2908,6 +2946,15 @@ class Glusterd:
                 "--max-clients", str(opts.get("gateway.max-clients",
                                               512)),
                 "--portfile", portfile]
+        workers = int(opts.get("gateway.workers", 0) or 0)
+        if workers > 0:
+            # the shared-nothing worker pool (op-version 14): the
+            # spawned process becomes the supervisor; worker pids land
+            # in the statusfile so status/chaos tooling can see them
+            argv += ["--workers", str(workers),
+                     "--statusfile",
+                     os.path.join(self.workdir,
+                                  f"gateway-{name}.workers")]
         if opts.get("gateway.metrics-port"):
             # the daemon's gftpu_gateway_* families are in ITS process:
             # without this the managed front door is metrics-blind
@@ -3172,7 +3219,8 @@ class Glusterd:
     async def _spawn_daemon(self, volfile: str, text: str, portfile: str,
                             logfile: str, top: str,
                             port: int | None = None,
-                            what: str = "brick"
+                            what: str = "brick",
+                            extra_env: dict | None = None
                             ) -> tuple[subprocess.Popen, int]:
         """Shared spawn-and-wait machinery for brick daemons (dedicated
         bricks and the mux anchor use the same path)."""
@@ -3183,6 +3231,8 @@ class Glusterd:
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
+        if extra_env:
+            env.update(extra_env)
         with open(logfile, "ab") as logf:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "glusterfs_tpu.daemon",
@@ -3275,6 +3325,42 @@ class Glusterd:
             return
         self._kill_brick(name)
 
+    def _mesh_env(self, vol: dict, b: dict) -> dict | None:
+        """``cluster.mesh-distributed`` (op-version 14): each brick
+        daemon of the volume is one ``jax.distributed`` process —
+        coordinator on brick 0's node, ``num_processes`` = brick
+        count, ``process_id`` = brick index.  The daemon's meshd glue
+        (parallel/meshd.py) reads these and initializes in the
+        BACKGROUND, so brick startup (and glusterd's one-at-a-time
+        spawn loop) never blocks on ranks that aren't up yet."""
+        opts = vol.get("options", {})
+        if not volgen._bool(opts.get("cluster.mesh-distributed",
+                                     "off")):
+            return None
+        port = vol.get("mesh-coordinator-port")
+        if not port:
+            # DETERMINISTIC from the replicated volume id: every
+            # node's glusterd computes the same coordinator port with
+            # no cross-node coordination.  (A lazily-bound ephemeral
+            # port picked per node diverged across peers — node B's
+            # ranks dialed a port nothing on node A listened on.)
+            import hashlib
+
+            h = int(hashlib.sha1(
+                str(vol.get("id", vol["name"])).encode()).hexdigest(),
+                16)
+            port = 30000 + (h % 20000)
+            vol["mesh-coordinator-port"] = port
+            self._save()
+        bricks = vol["bricks"]
+        hosts = {n["uuid"]: n["host"] for n in self._all_nodes()}
+        coord = hosts.get(bricks[0]["node"], self.host)
+        rank = next((i for i, x in enumerate(bricks)
+                     if x["name"] == b["name"]), 0)
+        return {"GFTPU_MESH_COORDINATOR": f"{coord}:{port}",
+                "GFTPU_MESH_PROCESSES": str(len(bricks)),
+                "GFTPU_MESH_RANK": str(rank)}
+
     async def _spawn_brick(self, vol: dict, b: dict,
                            port: int | None = None) -> None:
         if self._mux_enabled(vol):
@@ -3290,7 +3376,8 @@ class Glusterd:
             # serve the auth-carrying protocol/server top, not the
             # io-stats layer underneath it
             b["name"] + "-server", port=port,
-            what=f"brick {b['name']}")
+            what=f"brick {b['name']}",
+            extra_env=self._mesh_env(vol, b))
         self.bricks[b["name"]] = proc
         self.ports[b["name"]] = bport
         b["port"] = bport

@@ -64,6 +64,7 @@ from ..core.fops import FopError
 from ..core.iatt import IAType
 from ..core.layer import Loc, walk
 from ..core.metrics import REGISTRY
+from .svcutil import ThrottleWave
 
 log = gflog.get_logger("rebalanced")
 
@@ -121,6 +122,75 @@ def tag_rebalance_origin(graph) -> int:
 
 class RebalanceStopped(Exception):
     """Cooperative stop (SIGTERM / ``volume rebalance stop``)."""
+
+
+class MgmtLink:
+    """Persistent mgmt connection with rate-limited reconnect — the
+    PR-11 deferred item: checkpoint pushes must survive a glusterd
+    restart without hammering a dead endpoint.
+
+    One TCP connection is held across pushes (a multi-hour migration
+    making thousands of rate-limited status pushes should not pay a
+    connect per push).  A call failing with a TRANSPORT error (the
+    glusterd behind it restarted) drops the connection, reconnects,
+    and replays that one call — rebalance-update is a state push and
+    the checkpoint never regresses, so replay is idempotent.
+    Reconnect attempts are rate-limited to one per
+    ``rebalance.checkpoint-interval``: while glusterd stays down, at
+    most one dial per checkpoint beat fails fast and the push is
+    dropped (the statusfile still carries the state; the next push
+    retries).  App-level :class:`MgmtError` is NEVER retried — the
+    call reached a live glusterd and was refused."""
+
+    _TRANSPORT_ERRORS = (ConnectionError, OSError, EOFError,
+                         asyncio.IncompleteReadError)
+
+    def __init__(self, host: str, port: int,
+                 min_reconnect_s: float = 1.0):
+        self.host, self.port = host, port
+        self.min_reconnect_s = float(min_reconnect_s)
+        self._client = None
+        self._last_attempt = float("-inf")  # first dial never limited
+
+    async def _drop(self) -> None:
+        if self._client is not None:
+            try:
+                await self._client.__aexit__(None, None, None)
+            except Exception:  # noqa: BLE001 - already torn
+                pass
+            self._client = None
+
+    async def _reconnect(self) -> None:
+        now = time.monotonic()
+        if now - self._last_attempt < self.min_reconnect_s:
+            raise ConnectionError(
+                f"mgmt reconnect rate-limited "
+                f"({self.min_reconnect_s:.1f}s per attempt)")
+        self._last_attempt = now
+        from .glusterd import MgmtClient
+
+        c = MgmtClient(self.host, self.port)
+        await c.__aenter__()
+        self._client = c
+        # only FAILED dials arm the limiter: after a long-lived healthy
+        # connection dies (glusterd restart) the first reconnect must
+        # not be charged for the dial that opened it hours ago
+        self._last_attempt = float("-inf")
+
+    async def call(self, method: str, **kw):
+        if self._client is None:
+            await self._reconnect()
+        try:
+            return await self._client.call(method, **kw)
+        except self._TRANSPORT_ERRORS:
+            # glusterd restarted under the held connection: one
+            # (rate-limited) reconnect, one replay
+            await self._drop()
+            await self._reconnect()
+            return await self._client.call(method, **kw)
+
+    async def close(self) -> None:
+        await self._drop()
 
 
 class Rebalancer:
@@ -405,7 +475,7 @@ class Rebalancer:
         finally:
             await dht.release(fd)
         subdirs: list[str] = []
-        pending: list[asyncio.Task] = []
+        wave = ThrottleWave()
         for name, ia in entries:
             if ia is not None and ia.ia_type is IAType.DIR:
                 subdirs.append(name)
@@ -434,18 +504,12 @@ class Rebalancer:
             throttle = str(dht.opts["rebal-throttle"])
             self.throttle = throttle
             width, pause = dht._THROTTLE[throttle]
-            while len(pending) >= width:
-                done, rest = await asyncio.wait(
-                    pending, return_when=asyncio.FIRST_COMPLETED)
-                pending = list(rest)
-            pending.append(asyncio.ensure_future(
-                self._migrate_one(dht, child, cloc, fia, idx, hi)))
-            self.max_inflight = max(self.max_inflight, len(pending))
-            if pause:
-                # lazy: hand the loop back so serving fops interleave
-                await asyncio.sleep(pause)
-        if pending:
-            await asyncio.wait(pending)
+            await wave.admit(
+                self._migrate_one(dht, child, cloc, fia, idx, hi),
+                width, pause)
+            self.max_inflight = max(self.max_inflight,
+                                    wave.max_inflight)
+        await wave.drain()
         if self._stop:
             raise RebalanceStopped()
         return subdirs
@@ -524,14 +588,12 @@ def _write_statusfile(path: str, info: dict) -> None:
 
 
 async def _amain(args) -> int:
-    from .glusterd import MgmtClient, mount_volume
+    from .glusterd import mount_volume
 
     host, _, port = args.glusterd.rpartition(":")
     host, port = host or "127.0.0.1", int(port)
-
-    async def mgmt_call(method: str, **kw):
-        async with MgmtClient(host, port) as c:
-            return await c.call(method, **kw)
+    link = MgmtLink(host, port)
+    mgmt_call = link.call
 
     # the volinfo carries the resume checkpoint + the daemon's knobs
     info = await mgmt_call("volume-info", name=args.volname)
@@ -548,6 +610,9 @@ async def _amain(args) -> int:
                     opts.get("rebalance.checkpoint-interval"),
                     args.checkpoint_interval)
         interval = args.checkpoint_interval
+    # reconnect attempts ride the same beat as checkpoint pushes: one
+    # dial per interval while glusterd is down
+    link.min_reconnect_s = max(0.02, interval)
     mode = args.mode or rb.get("mode") or "full"
 
     client = None
@@ -605,6 +670,7 @@ async def _amain(args) -> int:
     except Exception as e:
         log.error(1, "final rebalance-update failed: %r", e)
         rc = rc or 1
+    await link.close()
     await client.unmount()
     return rc
 

@@ -1,14 +1,54 @@
 """Shared plumbing for per-volume service daemons (bitd, quotad, …):
 credential/TLS wiring between glusterd's spawner and the daemon's
-brick ClientLayers.  One copy, so an auth change lands everywhere
+brick ClientLayers, and the migration-wave throttle both rebalance
+walks share.  One copy, so an auth change lands everywhere
 (glusterd-svc-mgmt.c is the reference's shared service layer)."""
 
 from __future__ import annotations
 
+import asyncio
 import os
 from typing import Any
 
 from . import volgen
+
+
+class ThrottleWave:
+    """The ``cluster.rebal-throttle`` wave loop (dht-rebalance.c:3269
+    migrator thread scaling) — ONE copy shared by the rebalance
+    daemon's ``_migrate_dir`` and the legacy in-process
+    ``DistributeLayer.rebalance`` walk: admit a migration task when the
+    in-flight set drops below ``width``, track the peak, and (lazy
+    mode) hand the loop back so serving fops interleave with the
+    crawl.  Width/pause are passed PER ADMIT because both callers
+    re-read the throttle option every wave — a live ``volume set``
+    retunes a running migration."""
+
+    def __init__(self) -> None:
+        self.pending: list[asyncio.Task] = []
+        self.max_inflight = 0
+
+    async def admit(self, coro, width: int, pause: float = 0.0) -> None:
+        """Wait for a slot under ``width``, launch ``coro``, then
+        optionally yield (``pause`` — the lazy throttle's cooperative
+        beat)."""
+        while len(self.pending) >= max(1, int(width)):
+            _done, rest = await asyncio.wait(
+                self.pending, return_when=asyncio.FIRST_COMPLETED)
+            self.pending = list(rest)
+        self.pending.append(asyncio.ensure_future(coro))
+        self.max_inflight = max(self.max_inflight, len(self.pending))
+        if pause:
+            await asyncio.sleep(pause)
+
+    async def drain(self) -> None:
+        """Await every in-flight migration (end of a directory wave).
+        Tasks never re-raise here — both callers count failures inside
+        the task body (an uncounted escape would report a clean run
+        with files still misplaced)."""
+        if self.pending:
+            await asyncio.wait(self.pending)
+        self.pending = []
 
 
 def add_ssl_args(parser) -> None:

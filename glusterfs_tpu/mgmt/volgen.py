@@ -129,6 +129,12 @@ OPTION_MAP = {
     # gateway daemon reads gateway.*)
     "rebalance.checkpoint-interval": ("mgmt/rebalanced",
                                       "checkpoint-interval"),
+    # multi-process data plane (ISSUE 12): the gateway worker-pool
+    # width (consumed by glusterd's gateway spawner) and the
+    # jax.distributed brick mesh (consumed by the brick spawner: each
+    # brick daemon joins the coordinator as one mesh process)
+    "gateway.workers": ("mgmt/gateway", "workers"),
+    "cluster.mesh-distributed": ("mgmt/glusterd", "mesh-distributed"),
     "network.ping-timeout": ("protocol/client", "ping-timeout"),
     "storage.health-check-interval": ("storage/posix",
                                       "health-check-interval"),
@@ -749,6 +755,18 @@ _V13_KEYS = (
     "cluster.rebal-migrate-window",
 )
 OPTION_MIN_OPVERSION.update({k: 13 for k in _V13_KEYS})
+
+# round-15 additions ship at op-version 14: the multi-process data
+# plane — a v13 glusterd has no worker-pool spawner arm (the key would
+# store and silently serve single-process) and no mesh-distributed
+# coordinator plumbing in its brick spawner, so neither key may reach
+# one; 14 is also the floor for lifting the mesh-codec-vs-systematic
+# refusal (an older peer's BatchingCodec has no systematic mesh tier)
+_V14_KEYS = (
+    "gateway.workers",
+    "cluster.mesh-distributed",
+)
+OPTION_MIN_OPVERSION.update({k: 14 for k in _V14_KEYS})
 
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key

@@ -39,6 +39,11 @@ coalesced into HBM-resident batches" — is a batching window:
   ``dp``, the fragment dimension over ``frag``, so the encode IS the
   scatter.  Decodes past ``MESH_RING_DECODE_BYTES`` ride the
   ring-pipelined ppermute reduce instead of the all-gather plane.
+  Systematic volumes joined the tier in ISSUE 12: encodes (and
+  parity deltas) take the PARITY-ROWS-ONLY sharded program — the k
+  data fragments are host reshapes, the mesh computes just the r
+  parity rows — while degraded decodes keep the single-device
+  ladder (healthy systematic reads never decode at all).
   Launches are counted per (op, origin) on the
   ``gftpu_mesh_{launches,batch_stripes}_total`` families ("serve" =
   fop traffic, "heal" = shd re-encode) and each opens a ``mesh-codec``
@@ -197,16 +202,17 @@ class BatchingCodec(Codec):
         # in ONE pjit'd NamedSharding(Mesh(dp, frag)) launch.  The
         # device-count probe can block 45 s on a wedged transport, so it
         # warms OFF the event loop; until it answers "ready", flushes
-        # take the existing ladder unchanged.  No mesh systematic mode
-        # (same constraint as ops/codec): systematic volumes stay on
-        # their ladder even with the key on.
+        # take the existing ladder unchanged.  Systematic volumes ride
+        # the tier too (ISSUE 12): encodes take the parity-rows-only
+        # sharded launch; degraded DECODES keep the single-device
+        # ladder (healthy systematic reads never decode at all).
         self.mesh_requested = mesh
         self._mesh = None
         self._mesh_state = "off"  # off -> warming -> ready/unavailable
         self._mesh_stop = False   # close() retires a retrying warm loop
         self.mesh_launches: dict[tuple[str, str], int] = {}
         self.mesh_stripes: dict[tuple[str, str], int] = {}
-        if mesh and not systematic:
+        if mesh:
             self._mesh_state = "warming"
             # a dedicated daemon thread, NOT the flush pool: on a
             # wedged transport the probe join holds its thread for the
@@ -324,15 +330,20 @@ class BatchingCodec(Codec):
         err = False
         sb = 0
         try:
-            if op == "encode":
+            if op in ("encode", "delta"):
                 s = cat.size // self.stripe_size
                 sb = _bucket_stripes(s)
                 if sb != s:
                     cat = np.concatenate(
                         [cat, np.zeros((sb - s) * self.stripe_size,
                                        dtype=np.uint8)])
-                out = mesh_codec.sharded_encode(
-                    self.k, self.r, cat, self._mesh)
+                if op == "delta":
+                    out = mesh_codec.sharded_parity(
+                        self.k, self.r, cat, self._mesh)
+                else:
+                    out = mesh_codec.sharded_encode(
+                        self.k, self.r, cat, self._mesh,
+                        systematic=self.systematic)
                 out = out[:, : s * self.fragment_chunk]
             else:
                 w = cat.shape[1]
@@ -640,9 +651,11 @@ class BatchingCodec(Codec):
         """Parity deltas for a stripe-aligned XOR delta; coalesced with
         concurrent calls exactly like ``encode_async`` (fragment-stream
         concatenation holds for the parity submatrix too — stripes are
-        independent).  Deltas ride the measured flush ladder; the mesh
-        tier never applies (it has no systematic mode, and delta
-        encodes exist only on systematic volumes)."""
+        independent).  Deltas ride the measured flush ladder, and on a
+        mesh-armed codec a routed flush lands on the same
+        parity-rows-only sharded program as the systematic mesh encode
+        (``mesh_codec.sharded_parity``, a ``delta`` launch on the mesh
+        counters)."""
         delta = np.ascontiguousarray(delta, dtype=np.uint8).ravel()
         if delta.size % self.stripe_size:
             raise ValueError("delta length not a multiple of the stripe")
@@ -671,8 +684,6 @@ class BatchingCodec(Codec):
         self.max_batch = max(self.max_batch, len(batch))
         total = sum(d.size for d, *_ in batch)
         codec, kind = self._route(total)
-        if kind == "mesh":
-            kind = "device"  # no mesh systematic mode (defensive)
         if kind == "cpu" and codec is not self:
             self.cpu_launches += 1
         loop = asyncio.get_running_loop()
@@ -686,7 +697,11 @@ class BatchingCodec(Codec):
                 cat = batch[0][0]
             else:
                 cat = np.concatenate([d for d, *_ in batch])
-            if kind == "device":
+            if kind == "mesh":
+                # parity deltas ride the same parity-rows-only sharded
+                # program as the systematic mesh encode (ISSUE 12)
+                pds = self._mesh_launch("delta", cat, None, batch)
+            elif kind == "device":
                 pds = self._delta_bucketed(cat)
             else:
                 pds = codec.encode_delta(cat)
@@ -737,6 +752,11 @@ class BatchingCodec(Codec):
             self.max_batch = max(self.max_batch, len(batch))
             total = sum(f.size for f, *_ in batch)
             codec, kind = self._route(total)
+            if kind == "mesh" and self.systematic:
+                # the systematic mesh tier is encode-only (parity-rows
+                # sharded launch): a degraded decode reconstructs
+                # missing data rows on the single-device ladder
+                codec, kind = self, "device"
             if kind == "cpu" and codec is not self:
                 self.cpu_launches += 1
             self._submit(self._run_decode, loop, rows, batch, codec,

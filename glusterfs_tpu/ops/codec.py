@@ -178,6 +178,12 @@ def _tpu_present() -> bool:
             return present
 
     def probe() -> bool:
+        # a configured-but-unsettled jax.distributed join (parallel/
+        # meshd) must run before the first backend init; this probe
+        # thread is abandonable, so the bounded wait is safe
+        from glusterfs_tpu.parallel import meshd
+
+        meshd.settle_before_backend_init()
         import jax
 
         return any(d.platform in ("tpu", "axon") for d in jax.devices())
@@ -276,12 +282,11 @@ class Codec:
         # only parity off-device, degraded reads reconstruct only the
         # missing rows.  Incompatible fragment format with the default
         # (reference-parity) code: fixed per volume at create.
+        # systematic + mesh composes since ISSUE 12: encodes ride the
+        # parity-rows-only sharded launch (mesh_codec._parity_fn);
+        # degraded decodes take the ref systematic path (healthy reads
+        # are host assembly and never decode at all)
         self.systematic = systematic
-        if systematic and self.backend == "mesh":
-            if backend == "mesh":
-                raise ValueError(
-                    "mesh backend has no systematic mode yet")
-            self.backend = "pallas-xor"  # auto on multi-chip: serve 1-chip
         _LIVE_CODECS.add(self)  # unified-registry scrape target
 
     # -- encode ------------------------------------------------------------
@@ -371,6 +376,13 @@ class Codec:
 
     def _encode_systematic(self, data: np.ndarray) -> np.ndarray:
         b = self.backend
+        if b == "mesh":
+            from glusterfs_tpu.parallel import mesh_codec
+
+            # parity-rows-only sharded encode: the mesh computes just
+            # the r parity fragments, data rows are host reshapes
+            return mesh_codec.sharded_encode(self.k, self.r, data,
+                                             systematic=True)
         if b in ("pallas-xor", "pallas-mxu"):
             # the device computes (and the link carries) ONLY parity
             from . import gf256_pallas
@@ -415,6 +427,10 @@ class Codec:
                 f"delta length {delta.size} not a multiple of stripe "
                 f"{self.stripe_size}")
         b = self.backend
+        if b == "mesh":
+            from glusterfs_tpu.parallel import mesh_codec
+
+            return mesh_codec.sharded_parity(self.k, self.r, delta)
         if b in ("pallas-xor", "pallas-mxu"):
             from . import gf256_pallas
 
@@ -495,7 +511,11 @@ class Codec:
 
             return native.decode_program(
                 frags, k, gf256.decode_program(k, tuple(rows), True))
-        if b in ("xla", "xla-xor"):
+        if b in ("xla", "xla-xor", "mesh"):
+            # mesh systematic is encode-only (parity-rows sharded):
+            # degraded reconstruction rides the single-device xla
+            # kernels — available on every host the mesh resolves on,
+            # and orders of magnitude over the bit-sliced ref oracle
             from . import gf256_xla
 
             form = "xor" if b == "xla-xor" else "matmul"

@@ -7,9 +7,17 @@ analog of the reference's socket fan-out, ec-common.c:816-900):
   into the ``(dp, frag)`` mesh (stripe batches shard over ``dp``, the
   fragment dimension over ``frag``; the encode IS the scatter-to-bricks
   step).
-* :func:`device_count` / :func:`device_count_cached` — wedge-safe
-  device discovery (deadline probe; the cached form never blocks and is
-  what serving-path routing reads).
+* :func:`device_count` / :func:`device_count_cached` /
+  :func:`local_device_count` — wedge-safe device discovery (deadline
+  probe; the cached form never blocks and is what serving-path routing
+  reads).  Under a ``cluster.mesh-distributed`` job (``meshd``) the
+  global count spans every member process; ``local_device_count`` is
+  this process's share.
+* :mod:`glusterfs_tpu.parallel.meshd` — the multi-process
+  ``jax.distributed`` coordinator glue (ISSUE 12): brick daemons join
+  a per-volume distributed job in the background, so the mesh plane
+  binds one PROCESS per device instead of one runtime over all of
+  them.
 * :func:`sharded_encode` / :func:`sharded_decode` — the pjit'd
   NamedSharding entry points the BatchingCodec's mesh backend and the
   ``cpu-extensions=mesh`` Codec backend launch.
@@ -28,6 +36,7 @@ from .mesh_codec import (  # noqa: F401
     default_mesh,
     device_count,
     device_count_cached,
+    local_device_count,
     make_mesh,
     sharded_decode,
     sharded_encode,
@@ -36,5 +45,6 @@ from .ring_codec import ring_decode  # noqa: F401
 
 __all__ = [
     "make_mesh", "default_mesh", "device_count", "device_count_cached",
-    "sharded_encode", "sharded_decode", "ring_decode",
+    "local_device_count", "sharded_encode", "sharded_decode",
+    "ring_decode",
 ]

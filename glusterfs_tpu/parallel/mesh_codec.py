@@ -61,30 +61,66 @@ def make_mesh(devices=None) -> Mesh:
 #   stalling fops behind a 45 s join.
 
 _count_state: list = []  # [(expires_monotonic|None, count)]
+_local_count_state: list = []  # same shape, jax.local_devices()
 _COUNT_RETRY_S = 300.0
 
 
-def device_count(default_timeout_s: float = 45.0) -> int:
-    """Count ALL jax devices behind a deadline probe; cached."""
-    if _count_state:
-        expires, n = _count_state[0]
+def _probed_count(state: list, fn, default_timeout_s: float) -> int:
+    """Shared deadline-probe + cache for a device-count callable."""
+    if state:
+        expires, n = state[0]
         if expires is None or _time.monotonic() < expires:
             return n
     from ..ops.codec import probe_with_deadline
-
-    def count() -> int:
-        return len(jax.devices())
 
     # default -1 separates "fn raised" from a real 0-device answer:
     # both a timeout AND a transient error (plugin registration race at
     # startup) cache 0 only for _COUNT_RETRY_S — a clean answer caches
     # for the process lifetime
-    n, timed_out = probe_with_deadline(count, -1, default_timeout_s)
+    n, timed_out = probe_with_deadline(fn, -1, default_timeout_s)
     if timed_out or n < 0:
-        _count_state[:] = [(_time.monotonic() + _COUNT_RETRY_S, 0)]
+        state[:] = [(_time.monotonic() + _COUNT_RETRY_S, 0)]
         return 0
-    _count_state[:] = [(None, int(n))]
-    return _count_state[0][1]
+    state[:] = [(None, int(n))]
+    return state[0][1]
+
+
+def device_count(default_timeout_s: float = 45.0) -> int:
+    """Count ALL jax devices behind a deadline probe; cached.
+
+    The distributed path (``cluster.mesh-distributed`` /
+    parallel/meshd.py): once this process joined a ``jax.distributed``
+    job, ``jax.devices()`` is the GLOBAL device list across every
+    member process — exactly what the mesh tier must size its (dp,
+    frag) plane over, since the whole point is one mesh spanning
+    interpreters.  :func:`local_device_count` answers the
+    this-process-only question (what the pre-14 single-runtime plane
+    effectively saw)."""
+    def count() -> int:
+        # a configured-but-unsettled jax.distributed join must run
+        # BEFORE the first backend init — this probe thread is
+        # abandonable, so waiting here is safe (meshd no-ops outside
+        # a distributed job)
+        from . import meshd
+
+        meshd.settle_before_backend_init()
+        return len(jax.devices())
+
+    return _probed_count(_count_state, count, default_timeout_s)
+
+
+def local_device_count(default_timeout_s: float = 45.0) -> int:
+    """Devices bound to THIS process (``jax.local_devices()``) — under
+    a distributed mesh, one brick's share of the global plane; equal to
+    :func:`device_count` in a single-process runtime.  Same wedge-safe
+    deadline probing and caching as the global count."""
+    def count() -> int:
+        from . import meshd
+
+        meshd.settle_before_backend_init()
+        return len(jax.local_devices())
+
+    return _probed_count(_local_count_state, count, default_timeout_s)
 
 
 def device_count_cached() -> int:
@@ -226,12 +262,45 @@ def _encode_fn(k: int, n: int, mesh: Mesh):
         in_shardings=in_s, out_shardings=out_s)
 
 
+@functools.lru_cache(maxsize=32)
+def _parity_fn(k: int, n: int, mesh: Mesh):
+    """Jitted PARITY-ROWS-ONLY encode for the systematic layout
+    (ISSUE 12 / ROADMAP item 5): the k data rows of a systematic code
+    are verbatim stripe chunks — a host reshape, no math — so the mesh
+    computes (and the interconnect carries) only the r parity
+    fragments, sharded exactly like the full encode: stripes over
+    ``dp``, the (parity) fragment dimension over ``frag``."""
+    pbits = jnp.asarray(gf256.parity_bits_cached(k, n))
+    in_s = NamedSharding(mesh, P("dp", None, None))
+    out_s = NamedSharding(mesh, P("frag", "dp", None))
+    return jax.jit(
+        lambda x: jnp.transpose(_apply(pbits, x), (1, 0, 2)),
+        in_shardings=in_s, out_shardings=out_s)
+
+
+def _planes_to_wire(y: np.ndarray, rows: int, s: int) -> np.ndarray:
+    """Plane-major (rows*8, S, 64) -> wire fragment-major
+    (rows, S*512): fragment f's chunk for stripe s' interleaves its 8
+    planes (same transform as the single-chip sandwich,
+    gf256_pallas._encode_fn)."""
+    return (y.reshape(rows, 8, s, gf256.WORD_SIZE)
+             .transpose(0, 2, 1, 3)
+             .reshape(rows, s * gf256.CHUNK_SIZE))
+
+
 def sharded_encode(k: int, r: int, data: np.ndarray,
-                   mesh: Mesh | None = None) -> np.ndarray:
+                   mesh: Mesh | None = None,
+                   systematic: bool = False) -> np.ndarray:
     """Encode stripe-aligned bytes into wire-layout fragments
     ``(n, S*512)`` with stripes sharded over the mesh's ``dp`` axis and
     the fragment dimension over ``frag`` (the served-volume entry point
-    the BatchingCodec's ``mesh`` backend feeds)."""
+    the BatchingCodec's ``mesh`` backend feeds).
+
+    ``systematic=True`` is the parity-rows-only lane: the mesh launch
+    computes just the r parity fragments and the k data fragments are
+    assembled host-side as pure reshapes — fragment-identical to the
+    single-device systematic encode (property-pinned in
+    tests/test_process_plane.py)."""
     if mesh is None:
         mesh = make_mesh()
     n = k + r
@@ -243,16 +312,45 @@ def sharded_encode(k: int, r: int, data: np.ndarray,
     if pad:
         x = np.concatenate(
             [x, np.zeros((pad, *x.shape[1:]), dtype=np.uint8)], axis=0)
+    if systematic:
+        with _BUILD_LOCK:
+            y = np.asarray(_parity_fn(k, n, mesh)(jnp.asarray(x)))
+        y = y[:, :s, :]  # (r*8, S, 64) parity planes
+        out = np.empty((n, s * gf256.CHUNK_SIZE), dtype=np.uint8)
+        # data rows: verbatim stripe chunks (ops/codec._data_rows)
+        out[:k] = np.ascontiguousarray(
+            data.reshape(s, k, gf256.CHUNK_SIZE)
+                .transpose(1, 0, 2)).reshape(k, s * gf256.CHUNK_SIZE)
+        out[k:] = _planes_to_wire(y, r, s)
+        return out
     with _BUILD_LOCK:
         y = np.asarray(_encode_fn(k, n, mesh)(jnp.asarray(x)))
     # y: (n*8, S', 64)
     y = y[:, :s, :]
-    # plane-major -> wire fragment-major (n, S*512): fragment f's chunk
-    # for stripe s' interleaves its 8 planes (same transform as the
-    # single-chip sandwich, gf256_pallas._encode_fn)
-    return (y.reshape(n, 8, s, gf256.WORD_SIZE)
-             .transpose(0, 2, 1, 3)
-             .reshape(n, s * gf256.CHUNK_SIZE))
+    return _planes_to_wire(y, n, s)
+
+
+def sharded_parity(k: int, r: int, delta: np.ndarray,
+                   mesh: Mesh | None = None) -> np.ndarray:
+    """Parity-fragment deltas ``(r, S*512)`` of a stripe-aligned XOR
+    delta over the mesh — the sub-stripe-write primitive
+    (ops/codec.Codec.encode_delta) on the (dp, frag) plane.  Same
+    parity-rows-only program as the systematic encode: linearity makes
+    the parity of Δ exactly the parity delta."""
+    if mesh is None:
+        mesh = make_mesh()
+    n = k + r
+    delta = np.ascontiguousarray(delta, dtype=np.uint8).ravel()
+    s = delta.size // (k * gf256.CHUNK_SIZE)
+    x = delta.reshape(s, k * 8, gf256.WORD_SIZE)
+    dp = mesh.devices.shape[0]
+    pad = (-s) % dp
+    if pad:
+        x = np.concatenate(
+            [x, np.zeros((pad, *x.shape[1:]), dtype=np.uint8)], axis=0)
+    with _BUILD_LOCK:
+        y = np.asarray(_parity_fn(k, n, mesh)(jnp.asarray(x)))
+    return _planes_to_wire(y[:, :s, :], r, s)
 
 
 @functools.lru_cache(maxsize=256)

@@ -598,9 +598,12 @@ def test_changelog_graph_disables_delta():
     assert Fop.XORV in D_FOPS
 
 
-def test_mesh_codec_refused_on_systematic_volume(tmp_path):
-    """volume set cluster.mesh-codec on a systematic (now default)
-    volume refuses loudly instead of silently never arming the tier."""
+def test_mesh_codec_on_systematic_volume_gated_by_opversion(tmp_path):
+    """The mesh-codec-vs-systematic exclusion is LIFTED at cluster
+    op-version >= 14 (the mesh tier's parity-rows-only systematic
+    encode, ISSUE 12): volume set accepts the key on a systematic
+    volume now — and still refuses while any member would be pre-14
+    (pinned by forcing the stored op-version down)."""
     from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient
 
     async def run():
@@ -612,9 +615,16 @@ def test_mesh_codec_refused_on_systematic_volume(tmp_path):
                              vtype="disperse", redundancy=2,
                              bricks=[{"path": str(tmp_path / f"b{i}")}
                                      for i in range(6)])
-                # MgmtError rides the wire as FopError(EINVAL)
-                with pytest.raises(OSError,
-                                   match="no systematic mode"):
+                res = await c.call("volume-set", name="sv",
+                                   key="cluster.mesh-codec",
+                                   value="on")
+                assert res["ok"]
+            # a pre-14 member keeps the old refusal (its BatchingCodec
+            # has no systematic mesh tier): MgmtError rides the wire
+            # as FopError(EINVAL)
+            d.op_version = 13
+            async with MgmtClient(d.host, d.port) as c:
+                with pytest.raises(OSError, match="op-version >= 14"):
                     await c.call("volume-set", name="sv",
                                  key="cluster.mesh-codec", value="on")
         finally:

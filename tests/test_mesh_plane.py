@@ -168,11 +168,32 @@ def test_min_batch_fallback_keeps_ladder_untouched():
     codec.close()
 
 
-def test_systematic_volume_never_takes_the_mesh():
+def test_systematic_volume_rides_the_mesh_parity_lane():
+    """ISSUE 12 lifted the mesh-codec-vs-systematic exclusion: a
+    systematic codec ARMS the mesh tier, encodes take the
+    parity-rows-only sharded launch (fragment-identical to the
+    single-device systematic encode), and degraded decodes keep the
+    single-device ladder (the tier is encode-only on systematic)."""
     codec = BatchingCodec(4, 2, "ref", mesh=True, min_batch=0,
                           systematic=True)
-    assert codec._mesh_state == "off"
+    assert codec._mesh_state != "off", "mesh tier did not arm"
+    ref = BatchingCodec(4, 2, "ref", systematic=True)
+    d = _rand(4 * 512 * 32, 11)
+
+    async def run():
+        assert await codec.ensure_mesh()
+        frs = await codec.encode_async(d)
+        np.testing.assert_array_equal(frs, ref.encode(d))
+        assert codec.mesh_launches.get(("encode", "serve")) == 1
+        # degraded decode: single-device ladder, NOT a mesh launch
+        rows = (0, 1, 2, 4)
+        out = await codec.decode_async(frs[np.asarray(rows)], rows)
+        np.testing.assert_array_equal(out, d)
+        assert ("decode", "serve") not in codec.mesh_launches
+
+    asyncio.run(run())
     codec.close()
+    ref.close()
 
 
 def test_ring_codec_is_the_large_decode_alternative(monkeypatch):

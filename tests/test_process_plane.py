@@ -1,0 +1,532 @@
+"""The multi-process data plane (ISSUE 12): shared-nothing gateway
+worker pool + multi-process ``jax.distributed`` mesh.
+
+Worker pool: byte-identical 64-client interleave through a workers=2
+supervisor, the per-worker admission split, worker-crash respawn
+serving the next request, the SCM_RIGHTS fd-passing fallback lane,
+aggregated per-worker metrics families, and the op-version-14 managed
+volume-set pin.  Mesh: the 2-process ``jax.distributed`` coordinator
+handshake + cross-interpreter sharded encode, and the systematic mesh
+tier's parity-rows-only encode property-pinned against the
+single-device path.  Shared helpers: the rebalance throttle wave and
+the rate-limited mgmt reconnect link also live in this PR.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from glusterfs_tpu.gateway.minihttp import fetch as http
+from glusterfs_tpu.gateway.minihttp import request
+
+BRICK = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+"""
+
+CLIENT = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume locks
+end-volume
+"""
+
+
+class _Pool:
+    """One supervisor subprocess over an in-process brick: the managed
+    spawn shape (glusterd runs the same argv) without a glusterd."""
+
+    def __init__(self, tmp_path, workers=2, fd_pass=False,
+                 max_clients=64, metrics=True):
+        self.tmp = str(tmp_path)
+        self.workers = workers
+        self.fd_pass = fd_pass
+        self.max_clients = max_clients
+        self.metrics = metrics
+        self.port = 0
+        self.metrics_port = 0
+        self.proc = None
+        self.server = None
+        self.statusfile = os.path.join(self.tmp, "gw.status")
+
+    async def __aenter__(self):
+        from glusterfs_tpu.daemon import serve_brick
+
+        os.makedirs(os.path.join(self.tmp, "b"), exist_ok=True)
+        self.server = await serve_brick(
+            BRICK.format(dir=os.path.join(self.tmp, "b")))
+        volfile = os.path.join(self.tmp, "client.vol")
+        with open(volfile, "w") as f:
+            f.write(CLIENT.format(port=self.server.port))
+        portfile = os.path.join(self.tmp, "gw.port")
+        if self.metrics:
+            import socket as _s
+
+            probe = _s.socket()
+            probe.bind(("127.0.0.1", 0))
+            self.metrics_port = probe.getsockname()[1]
+            probe.close()
+        argv = [sys.executable, "-m", "glusterfs_tpu.gateway",
+                "--volfile", volfile, "--workers", str(self.workers),
+                "--pool", "1", "--portfile", portfile,
+                "--statusfile", self.statusfile,
+                "--max-clients", str(self.max_clients),
+                "--metrics-port", str(self.metrics_port)]
+        if self.fd_pass:
+            argv.append("--fd-pass")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(argv, env=env,
+                                     stdout=subprocess.DEVNULL,
+                                     stderr=subprocess.PIPE)
+        for _ in range(600):
+            if os.path.exists(portfile):
+                break
+            assert self.proc.poll() is None, \
+                self.proc.stderr.read().decode(errors="replace")[-2000:]
+            await asyncio.sleep(0.1)
+        assert os.path.exists(portfile), "supervisor never wrote port"
+        with open(portfile) as f:
+            self.port = int(f.read())
+        return self
+
+    async def __aexit__(self, *exc):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        if self.server is not None:
+            await self.server.stop()
+        return False
+
+    def status(self) -> dict:
+        with open(self.statusfile) as f:
+            return json.load(f)
+
+    async def metrics_json(self) -> dict:
+        _s, _h, body = await http("127.0.0.1", self.metrics_port,
+                                  "GET", "/metrics.json")
+        return json.loads(body)
+
+    async def workers_json(self) -> dict:
+        _s, _h, body = await http("127.0.0.1", self.metrics_port,
+                                  "GET", "/workers.json")
+        return json.loads(body)
+
+
+async def _interleave(pool: _Pool, n_clients: int, body: bytes) -> None:
+    """n keep-alive connections PUT distinct objects then GET them
+    back byte-identical — across worker processes, one namespace."""
+    s, _, _ = await http("127.0.0.1", pool.port, "PUT", "/b")
+    assert s == 200, s
+    conns = []
+    try:
+        for _ in range(n_clients):
+            conns.append(await asyncio.open_connection(
+                "127.0.0.1", pool.port))
+
+        async def one(i):
+            r, w = conns[i]
+            st, _, _ = await request(r, w, "PUT", f"/b/o{i}",
+                                     body=body + str(i).encode())
+            assert st == 200, (i, st)
+            st, _, data = await request(r, w, "GET", f"/b/o{i}")
+            assert st == 200, (i, st)
+            assert data == body + str(i).encode(), \
+                f"client {i}: bytes diverged across workers"
+
+        await asyncio.gather(*(one(i) for i in range(n_clients)))
+    finally:
+        for _, w in conns:
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+# -- worker pool: reuseport lane ---------------------------------------
+
+
+def test_worker_pool_64_client_interleave_and_metrics(tmp_path):
+    """The acceptance interleave: 64 concurrent HTTP clients against a
+    workers=2 pool, byte-identical; the supervisor's aggregated
+    families show BOTH workers' shards merged (requests sum across
+    shards, gftpu_gateway_workers alive=2), and the admission split
+    divided the connection budget per worker at spawn."""
+    async def run():
+        # budget 256 -> 128 per worker: the kernel's reuseport hash is
+        # not exactly even, so 64 clients need headroom per shard (an
+        # exact 32/32 split would 503 the skewed side — that's the
+        # per-worker admission WORKING, but not what this test pins)
+        async with _Pool(tmp_path, workers=2,
+                         max_clients=256) as pool:
+            st = pool.status()
+            assert len(st["workers"]) == 2
+            await _interleave(pool, 64, b"w" * 2048)
+            fams = await pool.metrics_json()
+            assert "gftpu_gateway_requests_total" in fams
+            total = sum(v for _l, v in
+                        fams["gftpu_gateway_requests_total"]["samples"])
+            assert total >= 129  # bucket PUT + 64 PUTs + 64 GETs
+            workers_fam = {tuple(sorted(lbl.items())): v for lbl, v in
+                           fams["gftpu_gateway_workers"]["samples"]}
+            alive = [v for k, v in workers_fam.items()
+                     if ("state", "alive") in k]
+            assert alive == [2]
+            assert "gftpu_gateway_worker_respawns_total" in fams
+            # admission split: each worker enforces its share
+            wj = await pool.workers_json()
+            per = [w["max_clients"] for w in wj["workers"]]
+            assert per == [128, 128], per
+            # under reuseport both workers should have turned frames;
+            # under the fallback the distribution is parent-round-robin
+            served = [sum(w["requests"].values())
+                      for w in wj["workers"]]
+            assert all(s > 0 for s in served), \
+                f"a worker served nothing: {served}"
+
+    asyncio.run(run())
+
+
+def test_worker_crash_respawn_serves_next_request(tmp_path):
+    """SIGKILL one worker: the supervisor respawns it (respawns
+    counter + fresh pid in the statusfile) and requests keep being
+    served throughout."""
+    async def run():
+        async with _Pool(tmp_path, workers=2, max_clients=32) as pool:
+            await _interleave(pool, 4, b"x" * 512)
+            victim = pool.status()["workers"][0]["pid"]
+            os.kill(victim, signal.SIGKILL)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                st = pool.status()
+                if st["respawns"] >= 1 and \
+                        all(w["alive"] for w in st["workers"]):
+                    break
+                await asyncio.sleep(0.2)
+            st = pool.status()
+            assert st["respawns"] >= 1, st
+            assert victim not in [w["pid"] for w in st["workers"]]
+            # the pool serves across and after the respawn window
+            ok = 0
+            for i in range(8):
+                try:
+                    s, _, data = await http("127.0.0.1", pool.port,
+                                            "GET", "/b/o0")
+                    if s == 200:
+                        ok += 1
+                except (ConnectionError, OSError):
+                    pass  # a connection routed into the dying worker
+                await asyncio.sleep(0.1)
+            assert ok >= 6, f"pool dropped after worker kill ({ok}/8)"
+
+    asyncio.run(run())
+
+
+# -- worker pool: SCM_RIGHTS fd-passing fallback -----------------------
+
+
+def test_fd_pass_fallback_lane(tmp_path):
+    """--fd-pass forces the parent-accepts + SCM_RIGHTS lane (the
+    no-reuseport-kernel fallback): mode recorded, 16-client interleave
+    byte-identical, both workers fed by the round-robin."""
+    async def run():
+        async with _Pool(tmp_path, workers=2, fd_pass=True,
+                         max_clients=32) as pool:
+            assert pool.status()["mode"] == "fd-pass"
+            await _interleave(pool, 16, b"f" * 1024)
+            wj = await pool.workers_json()
+            served = [sum(w["requests"].values())
+                      for w in wj["workers"]]
+            assert all(s > 0 for s in served), \
+                f"round-robin starved a worker: {served}"
+
+    asyncio.run(run())
+
+
+# -- managed volume-set pin --------------------------------------------
+
+
+def test_process_plane_keys_pinned_at_opversion_14(tmp_path):
+    """gateway.workers / cluster.mesh-distributed store at cluster
+    op-version 14 and refuse on a pre-14 cluster (the mixed-version
+    skew guard every _V14 key rides)."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="pv",
+                             vtype="distribute",
+                             bricks=[{"path": str(tmp_path / "b0")}])
+                for key in ("gateway.workers",
+                            "cluster.mesh-distributed"):
+                    res = await c.call("volume-set", name="pv",
+                                       key=key, value="2"
+                                       if key == "gateway.workers"
+                                       else "on")
+                    assert res["ok"], (key, res)
+            d.op_version = 13
+            async with MgmtClient(d.host, d.port) as c:
+                for key in ("gateway.workers",
+                            "cluster.mesh-distributed"):
+                    with pytest.raises(OSError,
+                                       match="op-version 14"):
+                        await c.call("volume-set", name="pv",
+                                     key=key, value="1")
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+
+
+def test_spawn_gateway_threads_workers_flag(tmp_path):
+    """glusterd's gateway spawner passes --workers/--statusfile iff
+    the key is set (argv inspected, no daemon actually spawned)."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd
+
+    d = Glusterd(str(tmp_path / "gd"))
+    captured = {}
+
+    class _FakeProc:
+        pid = 1
+
+        def poll(self):
+            return None
+
+    import subprocess as _sp
+
+    orig = _sp.Popen
+    try:
+        def fake_popen(argv, **kw):
+            captured["argv"] = argv
+            return _FakeProc()
+
+        _sp.Popen = fake_popen
+        vol = {"name": "wv", "type": "distribute", "status": "started",
+               "bricks": [], "options": {"gateway.workers": "3"},
+               "auth": {}}
+        d._spawn_gateway(vol)
+        assert "--workers" in captured["argv"]
+        assert captured["argv"][
+            captured["argv"].index("--workers") + 1] == "3"
+        assert "--statusfile" in captured["argv"]
+        d.gateway.clear()
+        vol["options"] = {}
+        d._spawn_gateway(vol)
+        assert "--workers" not in captured["argv"]
+    finally:
+        _sp.Popen = orig
+
+
+def test_mesh_env_threaded_through_brick_spawn(tmp_path):
+    """cluster.mesh-distributed: _mesh_env hands every brick its rank,
+    the brick count, and ONE stable coordinator endpoint (persisted in
+    the volinfo so respawns redial the same port)."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd
+
+    d = Glusterd(str(tmp_path / "gd"))
+    bricks = [{"name": f"mv-brick-{i}", "node": d.uuid,
+               "path": str(tmp_path / f"b{i}")} for i in range(3)]
+    vol = {"name": "mv", "type": "distribute", "status": "started",
+           "bricks": bricks,
+           "options": {"cluster.mesh-distributed": "on"}}
+    d.state["volumes"]["mv"] = vol
+    envs = [d._mesh_env(vol, b) for b in bricks]
+    assert all(e is not None for e in envs)
+    coords = {e["GFTPU_MESH_COORDINATOR"] for e in envs}
+    assert len(coords) == 1, "ranks must dial one coordinator"
+    assert [e["GFTPU_MESH_RANK"] for e in envs] == ["0", "1", "2"]
+    assert {e["GFTPU_MESH_PROCESSES"] for e in envs} == {"3"}
+    assert vol.get("mesh-coordinator-port"), "port not persisted"
+    # off volumes get no mesh env
+    vol["options"] = {}
+    assert d._mesh_env(vol, bricks[0]) is None
+
+
+# -- multi-process jax.distributed mesh --------------------------------
+
+
+@pytest.mark.slow
+def test_distributed_mesh_2proc_handshake_and_sharded_encode():
+    """The dryrun's 2-process virtual-mesh attempt as a unit: two rank
+    subprocesses join a fresh coordinator (gloo CPU collectives) and
+    push ONE sharded encode through the GLOBAL 2-device mesh, each
+    verifying its addressable shards against the single-process
+    reference — the coordinator handshake + a cross-interpreter
+    sharded launch, deadline-pinned in kill-able subprocesses."""
+    import __graft_entry__ as graft
+
+    rec = graft._dryrun_distributed(150.0)
+    assert rec["ok"], rec
+    assert rec["mode"] == "distributed-2proc-virtual-mesh"
+    assert rec["n_processes"] == 2
+
+
+def test_meshd_env_glue_and_state():
+    """meshd.configured parses the spawner's env; malformed env is
+    ignored (a typo'd option must not crash a brick daemon)."""
+    from glusterfs_tpu.parallel import meshd
+
+    env = {meshd.ENV_COORDINATOR: "127.0.0.1:9999",
+           meshd.ENV_PROCESSES: "4", meshd.ENV_RANK: "2"}
+    assert meshd.configured(env) == {"coordinator": "127.0.0.1:9999",
+                                     "processes": 4, "rank": 2}
+    assert meshd.configured({}) is None
+    bad = dict(env)
+    bad[meshd.ENV_RANK] = "two"
+    assert meshd.configured(bad) is None
+    assert meshd.state()["status"] in ("off", "joining", "ready",
+                                       "failed")
+
+
+def test_local_vs_global_device_count():
+    """The distributed path of device discovery: in this (single-
+    process) runtime the global and local counts agree; both ride the
+    same wedge-safe cache."""
+    from glusterfs_tpu.parallel import mesh_codec
+
+    assert mesh_codec.device_count() == 8
+    assert mesh_codec.local_device_count() == 8
+    assert mesh_codec.device_count_cached() == 8
+
+
+# -- systematic mesh tier: parity property vs the single-device path ---
+
+
+@pytest.mark.parametrize("k,r", [(4, 2), (8, 3)])
+def test_mesh_systematic_encode_parity_property(k, r):
+    """Property pin (ROADMAP item 5 code half): for random stripe
+    batches, the parity-rows-only sharded encode and the sharded
+    parity-delta are FRAGMENT-identical to the single-device
+    systematic path."""
+    from glusterfs_tpu.ops import gf256
+    from glusterfs_tpu.ops.codec import Codec
+    from glusterfs_tpu.parallel import mesh_codec
+
+    ref = Codec(k, r, "ref", systematic=True)
+    rng = np.random.default_rng(k * 100 + r)
+    for _ in range(4):
+        stripes = int(rng.integers(1, 40))
+        data = rng.integers(0, 256, stripes * k * gf256.CHUNK_SIZE,
+                            dtype=np.uint8)
+        np.testing.assert_array_equal(
+            mesh_codec.sharded_encode(k, r, data, systematic=True),
+            ref.encode(data))
+        np.testing.assert_array_equal(
+            mesh_codec.sharded_parity(k, r, data),
+            ref.encode_delta(data))
+
+
+def test_mesh_systematic_delta_flush_rides_parity_lane():
+    """BatchingCodec.encode_delta_async on a mesh-armed systematic
+    codec lands on the mesh parity program (a 'delta' launch on the
+    mesh counters), byte-identical to the single-device delta."""
+    from glusterfs_tpu.ops import gf256
+    from glusterfs_tpu.ops.batch import BatchingCodec
+    from glusterfs_tpu.ops.codec import Codec
+
+    codec = BatchingCodec(4, 2, "ref", mesh=True, min_batch=0,
+                          systematic=True)
+    ref = Codec(4, 2, "ref", systematic=True)
+    d = np.random.default_rng(5).integers(
+        0, 256, 16 * 4 * gf256.CHUNK_SIZE, dtype=np.uint8)
+
+    async def run():
+        assert await codec.ensure_mesh()
+        pds = await codec.encode_delta_async(d)
+        np.testing.assert_array_equal(pds, ref.encode_delta(d))
+        assert codec.mesh_launches.get(("delta", "serve")) == 1
+
+    asyncio.run(run())
+    codec.close()
+
+
+# -- shared helpers landed with this PR --------------------------------
+
+
+def test_throttle_wave_width_and_peak():
+    """svcutil.ThrottleWave: never more than `width` in flight, peak
+    tracked, drain joins everything (the one loop both rebalance walks
+    now share)."""
+    from glusterfs_tpu.mgmt.svcutil import ThrottleWave
+
+    inflight = {"now": 0, "peak": 0}
+
+    async def job():
+        inflight["now"] += 1
+        inflight["peak"] = max(inflight["peak"], inflight["now"])
+        await asyncio.sleep(0.01)
+        inflight["now"] -= 1
+
+    async def run():
+        wave = ThrottleWave()
+        for _ in range(12):
+            await wave.admit(job(), width=3)
+        await wave.drain()
+        assert inflight["now"] == 0
+        assert 1 <= inflight["peak"] <= 3
+        assert wave.max_inflight <= 3
+
+    asyncio.run(run())
+
+
+def test_mgmt_link_reconnect_rate_limited_and_replays(tmp_path):
+    """MgmtLink: survives a glusterd restart by reconnect + one replay
+    of the failed push; while the endpoint stays down, reconnect
+    attempts are rate-limited to one per interval."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd
+    from glusterfs_tpu.mgmt.rebalanced import MgmtLink
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd1"))
+        await d.start()
+        port = d.port
+        link = MgmtLink(d.host, port, min_reconnect_s=5.0)
+        ps = await link.call("peer-status")
+        assert "peers" in ps or ps is not None
+        # restart glusterd on the SAME port under the held connection
+        await d.stop()
+        d2 = Glusterd(str(tmp_path / "gd2"), port=port)
+        await d2.start()
+        try:
+            # the held connection is dead: transport error -> one
+            # reconnect -> replay lands on the restarted glusterd
+            ps2 = await link.call("peer-status")
+            assert ps2 is not None
+        finally:
+            await link.close()
+            await d2.stop()
+        # dead endpoint: first dial fails honestly, the immediate
+        # second attempt is rate-limited (no second dial burned)
+        link2 = MgmtLink("127.0.0.1", port, min_reconnect_s=30.0)
+        with pytest.raises(OSError):
+            await link2.call("peer-status")
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError, match="rate-limited"):
+            await link2.call("peer-status")
+        assert time.monotonic() - t0 < 1.0, "rate limit should be fast"
+        await link2.close()
+
+    asyncio.run(run())

@@ -28,9 +28,12 @@ contract the EC/protocol planes promise:
                         failures burn down, and the budget is exact.
 * ``delay_storm``     — debug.delay-gen on every brick's readv:
                         reads stay correct and bounded.
-* ``gateway``         — the HTTP front door over the same volume
+* ``gateway``         — the HTTP front door over the same volume —
+                        served by a workers=2 shared-nothing pool —
                         keeps answering (correct bytes or clean
-                        error, never a hang) while a brick is down.
+                        error, never a hang) while a brick is down,
+                        and a worker SIGKILL mid-load never drops
+                        the volume (supervisor respawn, ISSUE 12).
 * ``rebalance_grow``  — grow the loaded 4+2 volume by a second
                         distribute leg WHILE serving: managed daemon
                         migration under live reads/writes, SIGKILL +
@@ -409,33 +412,51 @@ async def delay_storm(base: str, opts) -> dict:
 
 @scenario("gateway")
 async def gateway(base: str, opts) -> dict:
-    """The HTTP front door stays responsive while a brick is down:
-    correct bytes or a clean error within a deadline — never a hang."""
-    from glusterfs_tpu.api.glfs import Client, wait_connected
-    from glusterfs_tpu.core.graph import Graph
-    from glusterfs_tpu.gateway import ClientPool, ObjectGateway
+    """The HTTP front door stays responsive while a brick is down —
+    now against a ``workers=2`` shared-nothing pool (ISSUE 12): the
+    supervisor subprocess owns the port, two worker processes serve
+    it, a brick SIGKILL degrades GETs byte-identically, and a WORKER
+    SIGKILL mid-load never drops the volume (the supervisor respawns,
+    the sibling keeps serving)."""
+    import subprocess
+
     from glusterfs_tpu.gateway.minihttp import fetch as http
 
     out: dict = {}
     async with Stack(base) as st:
         async with MgmtClient(st.d.host, st.d.port) as c:
             spec = await c.call("getspec", name=st.name)
-
-        async def factory():
-            g = Graph.construct(spec["volfile"])
-            gcl = Client(g)
-            await gcl.mount()
-            await wait_connected(g)
-            return gcl
-
-        gw = ObjectGateway(ClientPool(factory, 2), volume=st.name)
-        await gw.start()
+        volfile = os.path.join(base, "gw-client.vol")
+        with open(volfile, "w") as f:
+            f.write(spec["volfile"])
+        portfile = os.path.join(base, "gw.port")
+        statusfile = os.path.join(base, "gw.status")
+        env = dict(os.environ)
+        sup = subprocess.Popen(
+            [sys.executable, "-m", "glusterfs_tpu.gateway",
+             "--volfile", volfile, "--workers", "2", "--pool", "2",
+             "--portfile", portfile, "--statusfile", statusfile,
+             "--max-clients", "128"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
         try:
+            deadline = time.monotonic() + 120
+            while not os.path.exists(portfile):
+                assert sup.poll() is None, "gateway supervisor died"
+                assert time.monotonic() < deadline, \
+                    "worker pool never came up"
+                await asyncio.sleep(0.2)
+            with open(portfile) as f:
+                gw_port = int(f.read())
+            with open(statusfile) as f:
+                wst = json.load(f)
+            out["workers_mode"] = wst["mode"]
+            assert len(wst["workers"]) == 2
             body = payload_for(500, 1)[:512 * 1024]
-            s, _, _ = await http(gw.host, gw.port, "PUT", "/b")
+            s, _, _ = await http("127.0.0.1", gw_port, "PUT", "/b")
             assert s == 200, s
-            s, _, _ = await http(gw.host, gw.port, "PUT", "/b/obj",
-                                 body=body)
+            s, _, _ = await http("127.0.0.1", gw_port, "PUT",
+                                 "/b/obj", body=body)
             assert s == 200, s
             # let the EC eager window's deferred size commit land
             # before breaking things: cross-pool-client read-after-PUT
@@ -444,7 +465,7 @@ async def gateway(base: str, opts) -> dict:
             # responsiveness, not that (documented) window
             deadline = time.monotonic() + 10
             while True:
-                s, _, data = await http(gw.host, gw.port, "GET",
+                s, _, data = await http("127.0.0.1", gw_port, "GET",
                                         "/b/obj")
                 if s == 200 and data == body:
                     break
@@ -454,18 +475,68 @@ async def gateway(base: str, opts) -> dict:
             port = st.kill_brick(3)
             t0 = time.monotonic()
             s, _, data = await asyncio.wait_for(
-                http(gw.host, gw.port, "GET", "/b/obj"), 60)
+                http("127.0.0.1", gw_port, "GET", "/b/obj"), 60)
             assert s == 200 and data == body, \
                 f"degraded gateway GET broke ({s})"
             out["degraded_get_s"] = round(time.monotonic() - t0, 2)
             s, _, _ = await asyncio.wait_for(
-                http(gw.host, gw.port, "PUT", "/b/obj2",
+                http("127.0.0.1", gw_port, "PUT", "/b/obj2",
                      body=body[:64 * 1024]), 60)
             assert s in (200, 503), f"degraded PUT hung or broke ({s})"
             out["degraded_put_status"] = s
             await st.restart_brick(3, port)
+
+            # worker kill MID-LOAD: a steady GET stream keeps running
+            # while one worker dies — the volume (and the pool's port)
+            # must keep answering right bytes; the supervisor respawns
+            served = {"ok": 0, "refused": 0}
+            stop_load = asyncio.Event()
+
+            async def load():
+                while not stop_load.is_set():
+                    try:
+                        s, _, d = await asyncio.wait_for(
+                            http("127.0.0.1", gw_port, "GET",
+                                 "/b/obj"), 30)
+                        if s == 200 and d == body:
+                            served["ok"] += 1
+                        else:
+                            served["refused"] += 1
+                    except (ConnectionError, OSError,
+                            asyncio.TimeoutError):
+                        served["refused"] += 1
+                    await asyncio.sleep(0.05)
+
+            loader = asyncio.ensure_future(load())
+            await asyncio.sleep(0.5)
+            victim = wst["workers"][0]["pid"]
+            os.kill(victim, signal.SIGKILL)
+            t0 = time.monotonic()
+            respawned = False
+            while time.monotonic() - t0 < 30:
+                with open(statusfile) as f:
+                    wst2 = json.load(f)
+                if wst2["respawns"] >= 1 and \
+                        all(w["alive"] for w in wst2["workers"]):
+                    respawned = True
+                    break
+                await asyncio.sleep(0.3)
+            await asyncio.sleep(1.0)  # load rides the respawned pool
+            stop_load.set()
+            await loader
+            assert respawned, "killed worker never respawned"
+            assert served["ok"] >= 5, \
+                f"volume dropped under worker kill: {served}"
+            out["worker_kill_respawn_s"] = round(
+                time.monotonic() - t0, 2)
+            out["worker_kill_load"] = dict(served)
         finally:
-            await gw.stop()
+            if sup.poll() is None:
+                sup.terminate()
+                try:
+                    sup.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    sup.kill()
     return out
 
 

@@ -45,6 +45,17 @@
 #                               byte-identical -> restart -> heal
 #                               converges -> the healed brick serves,
 #                               with the zero-leak audit (ISSUE 9)
+#   8. delta-write smoke        managed systematic-by-default volume
+#                               serves an unaligned write via the
+#                               parity-delta path (ISSUE 10)
+#   9. rebalance smoke          add-brick + managed rebalance daemon
+#                               converges, task row + families,
+#                               bytes exact (ISSUE 11)
+#  10. process-plane smoke      workers=2 managed gateway pool:
+#                               byte-exact PUT/GET through the
+#                               shared-nothing workers, worker
+#                               SIGKILL respawns and keeps serving
+#                               (ISSUE 12)
 #
 # Usage:  tools/ci.sh [extra pytest args for the tier-1 runs...]
 # Exit: first failing stage's code; 0 = mergeable.
@@ -659,6 +670,93 @@ if [ $rebal_rc -ne 0 ]; then
     exit $rebal_rc
 fi
 
+echo "== ci: process-plane smoke (workers=2 managed gateway,"
+echo "       byte-exact PUT/GET, worker respawn) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, json, os, shutil, signal, tempfile, time
+
+async def main():
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient
+    from glusterfs_tpu.gateway.minihttp import fetch as http
+
+    base = tempfile.mkdtemp(prefix="ci-procplane")
+    d = Glusterd(os.path.join(base, "gd"))
+    await d.start()
+    try:
+        async with MgmtClient(d.host, d.port) as c:
+            await c.call("volume-create", name="pv", vtype="distribute",
+                         bricks=[{"path": os.path.join(base, "b0")}])
+            await c.call("volume-start", name="pv")
+            await c.call("volume-set", name="pv",
+                         key="gateway.workers", value="2")
+            await c.call("volume-gateway", name="pv", action="start")
+            port = 0
+            for _ in range(600):
+                st = await c.call("volume-gateway", name="pv",
+                                  action="status")
+                if st["gateway"]["online"] and st["gateway"]["port"]:
+                    port = st["gateway"]["port"]
+                    break
+                await asyncio.sleep(0.1)
+            assert port, f"worker-pool gateway never up: {st}"
+            statusfile = os.path.join(d.workdir, "gateway-pv.workers")
+            with open(statusfile) as f:
+                wst = json.load(f)
+            assert len(wst["workers"]) == 2, wst
+            body = b"process-plane" * 300
+            s = 0
+            for _ in range(100):
+                try:
+                    s, _, _ = await http("127.0.0.1", port, "PUT", "/b")
+                    if s == 200:
+                        break
+                except (ConnectionError, OSError):
+                    pass
+                await asyncio.sleep(0.1)
+            assert s == 200, "pool unreachable"
+            s, _, _ = await http("127.0.0.1", port, "PUT", "/b/k",
+                                 body=body)
+            assert s == 200, s
+            s, _, data = await http("127.0.0.1", port, "GET", "/b/k")
+            assert s == 200 and data == body, (s, len(data))
+            # respawn: SIGKILL a worker, the pool recovers and serves
+            os.kill(wst["workers"][0]["pid"], signal.SIGKILL)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                with open(statusfile) as f:
+                    wst2 = json.load(f)
+                if wst2["respawns"] >= 1 and \
+                        all(w["alive"] for w in wst2["workers"]):
+                    break
+                await asyncio.sleep(0.3)
+            assert wst2["respawns"] >= 1, wst2
+            ok = 0
+            for _ in range(8):
+                try:
+                    s, _, data = await http("127.0.0.1", port, "GET",
+                                            "/b/k")
+                    if s == 200 and data == body:
+                        ok += 1
+                except (ConnectionError, OSError):
+                    pass
+                await asyncio.sleep(0.1)
+            assert ok >= 6, f"pool dropped after worker kill ({ok}/8)"
+            await c.call("volume-gateway", name="pv", action="stop")
+    finally:
+        await d.stop()
+        shutil.rmtree(base, ignore_errors=True)
+    print("process-plane smoke: managed workers=2 pool served "
+          "byte-exact PUT/GET (mode=%s), worker SIGKILL respawned "
+          "and kept serving" % wst["mode"])
+
+asyncio.run(main())
+EOF
+procplane_rc=$?
+if [ $procplane_rc -ne 0 ]; then
+    echo "ci: process-plane smoke failed — not mergeable"
+    exit $procplane_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
@@ -666,5 +764,5 @@ fi
 echo "ci: mergeable (two identical green tier-1 runs + bench contract"
 echo "    + metrics smoke + gateway smoke + concurrency smoke"
 echo "    + mesh smoke + chaos smoke + delta-write smoke"
-echo "    + rebalance smoke)"
+echo "    + rebalance smoke + process-plane smoke)"
 exit 0
