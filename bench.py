@@ -593,13 +593,14 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
                 last_rc = proc.poll()
                 if last_rc is None:
                     proc.kill()
-                proc.wait()
+                await asyncio.to_thread(proc.wait)
                 # the dead bridge may have completed mount(2) before
                 # failing (readyfile is written after) — a stale FUSE
                 # mount would make the retry's own mount(2) fail with
                 # ENOTCONN, so clear it before respawning
-                subprocess.run(["umount", "-l", mnt],
-                               capture_output=True, timeout=30)
+                await asyncio.to_thread(
+                    subprocess.run, ["umount", "-l", mnt],
+                    capture_output=True, timeout=30)
             if not mounted:
                 out["fuse_bench_error"] = (
                     f"fuse mount not ready after "
@@ -661,13 +662,15 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
                     out["fuse_bench_error"] = repr(e)[:200]
             finally:
                 try:
-                    subprocess.run(["umount", mnt], capture_output=True,
-                                   timeout=30)
+                    await asyncio.to_thread(
+                        subprocess.run, ["umount", mnt],
+                        capture_output=True, timeout=30)
                 except subprocess.TimeoutExpired:
-                    subprocess.run(["umount", "-l", mnt],
-                                   capture_output=True, timeout=30)
+                    await asyncio.to_thread(
+                        subprocess.run, ["umount", "-l", mnt],
+                        capture_output=True, timeout=30)
                 try:
-                    proc.wait(timeout=10)
+                    await asyncio.to_thread(proc.wait, timeout=10)
                 except subprocess.TimeoutExpired:
                     proc.kill()
         finally:
@@ -764,7 +767,7 @@ def degraded_bench(n_clients: int = 6, file_mib: int = 1) -> dict:
                 proc = d.bricks.pop("dg-brick-1")
                 d.ports.pop("dg-brick-1", None)
                 os.kill(proc.pid, signal.SIGKILL)
-                proc.wait()
+                await asyncio.to_thread(proc.wait)
                 out["degraded_write_MiB_s"] = round(await wpass("d"), 1)
                 out["degraded_read_MiB_s"] = round(await rpass("g"), 1)
             finally:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import errno as _errno
 import time
 from typing import Any, Callable, ClassVar
 
@@ -171,7 +172,8 @@ def _make_default(op_name: str) -> Callable:
 
     async def default(self, *args, **kwargs):
         if not self.children:
-            raise FopError(95, f"{self.name}: no child to wind {op_name}")
+            raise FopError(_errno.EOPNOTSUPP,
+                           f"{self.name}: no child to wind {op_name}")
         return await getattr(self.children[0], op_name)(*args, **kwargs)
 
     default.__name__ = op_name

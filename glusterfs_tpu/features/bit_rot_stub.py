@@ -85,6 +85,60 @@ class BitRotStubLayer(Layer):
             raise FopError(errno.EIO, "object quarantined (bit-rot)")
         return await self.children[0].xorv(fd, data, offset, xdata)
 
+    # -- the rest of the content-mutating vocabulary (graft-lint GL01
+    # fence parity): a quarantined object's bytes are EVIDENCE — they
+    # must stay exactly as the scrubber found them until heal rebuilds
+    # (writev + HEAL_WRITE) or the operator removes the object --------
+
+    async def truncate(self, loc, size: int, xdata: dict | None = None):
+        if self._deny(loc.gfid):
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        return await self.children[0].truncate(loc, size, xdata)
+
+    async def ftruncate(self, fd: FdObj, size: int,
+                        xdata: dict | None = None):
+        if self._deny(fd.gfid):
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        return await self.children[0].ftruncate(fd, size, xdata)
+
+    async def fallocate(self, fd: FdObj, mode: int, offset: int,
+                        length: int, xdata: dict | None = None):
+        if self._deny(fd.gfid):
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        return await self.children[0].fallocate(fd, mode, offset,
+                                                length, xdata)
+
+    async def discard(self, fd: FdObj, offset: int, length: int,
+                      xdata: dict | None = None):
+        if self._deny(fd.gfid):
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        return await self.children[0].discard(fd, offset, length, xdata)
+
+    async def zerofill(self, fd: FdObj, offset: int, length: int,
+                       xdata: dict | None = None):
+        if self._deny(fd.gfid):
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        return await self.children[0].zerofill(fd, offset, length,
+                                               xdata)
+
+    async def put(self, loc, data, *args, **kwargs):
+        # replacing a quarantined object's body via put would destroy
+        # the evidence without a heal (posix serves put as
+        # create+writev BELOW this fence)
+        if self._deny(loc.gfid):
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        return await self.children[0].put(loc, data, *args, **kwargs)
+
+    async def copy_file_range(self, fd_in: FdObj, off_in: int,
+                              fd_out: FdObj, off_out: int, length: int,
+                              xdata: dict | None = None):
+        # source: never serve corrupt bytes; destination: never write
+        # over quarantined content
+        if self._deny(fd_in.gfid) or self._deny(fd_out.gfid):
+            raise FopError(errno.EIO, "object quarantined (bit-rot)")
+        return await self.children[0].copy_file_range(
+            fd_in, off_in, fd_out, off_out, length, xdata)
+
     async def writev(self, fd: FdObj, data: bytes, offset: int,
                      xdata: dict | None = None):
         healing = bool((xdata or {}).get(HEAL_WRITE))

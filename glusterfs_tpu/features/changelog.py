@@ -31,7 +31,12 @@ log = gflog.get_logger("changelog")
 
 # fop -> record class (changelog-misc.h E/D/M split)
 E_FOPS = {Fop.CREATE, Fop.MKNOD, Fop.MKDIR, Fop.UNLINK, Fop.RMDIR,
-          Fop.SYMLINK, Fop.RENAME, Fop.LINK, Fop.ICREATE, Fop.PUT}
+          Fop.SYMLINK, Fop.RENAME, Fop.LINK, Fop.ICREATE, Fop.PUT,
+          # namelink is icreate's other half (gfid-access: link a name
+          # to an existing inode) — an entry mutation like link;
+          # graft-lint GL01 caught it journaling nowhere, which would
+          # hide the new name from geo-rep forever
+          Fop.NAMELINK}
 D_FOPS = {Fop.WRITEV, Fop.TRUNCATE, Fop.FTRUNCATE, Fop.FALLOCATE,
           Fop.DISCARD, Fop.ZEROFILL, Fop.COPY_FILE_RANGE, Fop.PUT,
           # a parity-delta apply mutates data: journal it wherever it

@@ -271,6 +271,60 @@ class LocksLayer(Layer):
                               offset + len(data), True)
         return await self.children[0].xorv(fd, data, offset, xdata)
 
+    # -- the rest of the content-mutating vocabulary (graft-lint GL01
+    # fence parity: xorv above was itself an after-the-fact fence;
+    # these siblings mutate byte ranges the same way) ----------------------
+
+    _EOF = 1 << 62  # "to end of file" range bound (F_WRLCK l_len=0)
+
+    async def truncate(self, loc, size: int, xdata: dict | None = None):
+        # every byte from the new size to EOF changes (both directions)
+        self._mandatory_check(loc.gfid, xdata, size, self._EOF, True)
+        return await self.children[0].truncate(loc, size, xdata)
+
+    async def ftruncate(self, fd: FdObj, size: int,
+                        xdata: dict | None = None):
+        self._mandatory_check(fd.gfid, xdata, size, self._EOF, True)
+        return await self.children[0].ftruncate(fd, size, xdata)
+
+    async def fallocate(self, fd: FdObj, mode: int, offset: int,
+                        length: int, xdata: dict | None = None):
+        self._mandatory_check(fd.gfid, xdata, offset, offset + length,
+                              True)
+        return await self.children[0].fallocate(fd, mode, offset,
+                                                length, xdata)
+
+    async def discard(self, fd: FdObj, offset: int, length: int,
+                      xdata: dict | None = None):
+        self._mandatory_check(fd.gfid, xdata, offset, offset + length,
+                              True)
+        return await self.children[0].discard(fd, offset, length, xdata)
+
+    async def zerofill(self, fd: FdObj, offset: int, length: int,
+                       xdata: dict | None = None):
+        self._mandatory_check(fd.gfid, xdata, offset, offset + length,
+                              True)
+        return await self.children[0].zerofill(fd, offset, length,
+                                               xdata)
+
+    async def put(self, loc, data, *args, **kwargs):
+        # whole-object body write (posix serves it as create+writev
+        # BELOW this layer — the range check must happen here)
+        self._mandatory_check(loc.gfid, kwargs.get("xdata"), 0,
+                              self._EOF, True)
+        return await self.children[0].put(loc, data, *args, **kwargs)
+
+    async def copy_file_range(self, fd_in: FdObj, off_in: int,
+                              fd_out: FdObj, off_out: int, length: int,
+                              xdata: dict | None = None):
+        # source half is a read, destination half a write — both fence
+        self._mandatory_check(fd_in.gfid, xdata, off_in,
+                              off_in + length, False)
+        self._mandatory_check(fd_out.gfid, xdata, off_out,
+                              off_out + length, True)
+        return await self.children[0].copy_file_range(
+            fd_in, off_in, fd_out, off_out, length, xdata)
+
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         # (gfid, domain) -> _LockDomain for inodelks;

@@ -142,6 +142,123 @@ class WormLayer(Layer):
             raise FopError(errno.EROFS, "worm: rename denied")
         return await self.children[0].rename(oldloc, newloc, xdata)
 
+    # -- the write vocabulary's long tail (graft-lint GL01 fence
+    # parity: PR 10 had to fence xorv here after the fact; these
+    # siblings had the same gap) ------------------------------------------
+
+    async def link(self, oldloc: Loc, newloc: Loc,
+                   xdata: dict | None = None):
+        # a new name for a retained inode re-opens it to namespace
+        # mutation (reference worm_link denies)
+        if self._file_level():
+            await self._deny_file_level(oldloc)
+        elif self._on():
+            raise FopError(errno.EROFS, "worm: link denied")
+        return await self.children[0].link(oldloc, newloc, xdata)
+
+    async def setattr(self, loc: Loc, attrs: dict, valid: int = 0,
+                      xdata: dict | None = None):
+        # retention state rides mtime (worm_state_transition keys off
+        # it): a retained file's metadata is frozen; volume-level worm
+        # fences data only, like the reference
+        if self._file_level():
+            await self._deny_file_level(loc)
+        return await self.children[0].setattr(loc, attrs, valid, xdata)
+
+    async def fsetattr(self, fd: FdObj, attrs: dict, valid: int = 0,
+                       xdata: dict | None = None):
+        if self._file_level():
+            await self._deny_file_level(Loc(fd.path, gfid=fd.gfid))
+        return await self.children[0].fsetattr(fd, attrs, valid, xdata)
+
+    async def fallocate(self, fd: FdObj, mode: int, offset: int,
+                        length: int, xdata: dict | None = None):
+        # same rule as writev: pure extension (append analog) passes
+        # volume-level worm, touching committed bytes does not
+        if self._file_level():
+            await self._deny_file_level(Loc(fd.path, gfid=fd.gfid))
+        elif self._on():
+            ia = await self.children[0].fstat(fd)
+            if offset < ia.size:
+                raise FopError(errno.EROFS, "worm: overwrite denied")
+        return await self.children[0].fallocate(fd, mode, offset,
+                                                length, xdata)
+
+    async def discard(self, fd: FdObj, offset: int, length: int,
+                      xdata: dict | None = None):
+        # hole-punching always mutates committed bytes
+        if self._file_level():
+            await self._deny_file_level(Loc(fd.path, gfid=fd.gfid))
+        elif self._on():
+            raise FopError(errno.EROFS, "worm: discard denied")
+        return await self.children[0].discard(fd, offset, length, xdata)
+
+    async def zerofill(self, fd: FdObj, offset: int, length: int,
+                       xdata: dict | None = None):
+        if self._file_level():
+            await self._deny_file_level(Loc(fd.path, gfid=fd.gfid))
+        elif self._on():
+            ia = await self.children[0].fstat(fd)
+            if offset < ia.size:
+                raise FopError(errno.EROFS, "worm: overwrite denied")
+        return await self.children[0].zerofill(fd, offset, length,
+                                               xdata)
+
+    async def put(self, loc: Loc, data, *args, **kwargs):
+        # put of an EXISTING object is a whole-body overwrite (posix
+        # serves it as create+writev below every fence — it must be
+        # caught here); put of a new object is the allowed create half
+        if self._file_level():
+            await self._deny_file_level(loc)
+        elif self._on():
+            try:
+                await self.children[0].lookup(loc)
+            except FopError:
+                pass  # new object: write-once create is allowed
+            else:
+                raise FopError(errno.EROFS, "worm: overwrite denied")
+        return await self.children[0].put(loc, data, *args, **kwargs)
+
+    async def copy_file_range(self, fd_in: FdObj, off_in: int,
+                              fd_out: FdObj, off_out: int, length: int,
+                              xdata: dict | None = None):
+        # the destination half is a writev (posix re-dispatches it
+        # BELOW this fence): apply writev's exact rules to fd_out
+        if self._file_level():
+            await self._deny_file_level(Loc(fd_out.path,
+                                            gfid=fd_out.gfid))
+        elif self._on():
+            ia = await self.children[0].fstat(fd_out)
+            if off_out < ia.size:
+                raise FopError(errno.EROFS, "worm: overwrite denied")
+        return await self.children[0].copy_file_range(
+            fd_in, off_in, fd_out, off_out, length, xdata)
+
+    async def removexattr(self, loc: Loc, name: str,
+                          xdata: dict | None = None):
+        # stripping trusted.worm.state would silently de-WORM a
+        # retained file
+        if self._file_level() and name == XA_STATE:
+            raise FopError(errno.EPERM,
+                           "worm: retention state is not removable")
+        return await self.children[0].removexattr(loc, name, xdata)
+
+    async def fremovexattr(self, fd: FdObj, name: str,
+                           xdata: dict | None = None):
+        if self._file_level() and name == XA_STATE:
+            raise FopError(errno.EPERM,
+                           "worm: retention state is not removable")
+        return await self.children[0].fremovexattr(fd, name, xdata)
+
+    async def fsetxattr(self, fd: FdObj, xattrs: dict, flags: int = 0,
+                        xdata: dict | None = None):
+        # fd twin of setxattr: the same retention-adjust policing
+        if self._file_level() and XA_STATE in xattrs:
+            return await self.setxattr(Loc(fd.path, gfid=fd.gfid),
+                                       xattrs, flags, xdata)
+        return await self.children[0].fsetxattr(fd, xattrs, flags,
+                                                xdata)
+
     async def setxattr(self, loc: Loc, xattrs: dict, flags: int = 0,
                        xdata: dict | None = None):
         if self._file_level() and XA_STATE in xattrs:

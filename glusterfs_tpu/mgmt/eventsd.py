@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import errno
 import json
 import os
 import signal
@@ -146,7 +147,8 @@ class EventsDaemon:
                     ret = self._ctl_op(method, kwargs or {})
                     resp = (wire.MT_REPLY, ret)
                 except Exception as e:
-                    resp = (wire.MT_ERROR, FopError(22, repr(e)))
+                    resp = (wire.MT_ERROR, FopError(errno.EINVAL,
+                                                    repr(e)))
                 writer.write(wire.pack(xid, *resp))
                 await writer.drain()
         finally:

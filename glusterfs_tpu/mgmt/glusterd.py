@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import errno
 import json
 import os
 import signal
@@ -232,7 +233,7 @@ class Glusterd:
             if proc.poll() is None:
                 proc.terminate()
                 try:
-                    proc.wait(timeout=5)
+                    await asyncio.to_thread(proc.wait, timeout=5)
                 except subprocess.TimeoutExpired:
                     proc.kill()
             self._mux = None
@@ -278,10 +279,10 @@ class Glusterd:
                     resp = (wire.MT_REPLY, ret)
                 except (MgmtError, FopError) as e:
                     resp = (wire.MT_ERROR, FopError(
-                        getattr(e, "err", 22), str(e)))
+                        getattr(e, "err", errno.EINVAL), str(e)))
                 except Exception as e:
                     log.error(11, "mgmt op failed: %r", e)
-                    resp = (wire.MT_ERROR, FopError(5, repr(e)))
+                    resp = (wire.MT_ERROR, FopError(errno.EIO, repr(e)))
                 try:
                     writer.write(wire.pack(xid, *resp))
                     await writer.drain()
@@ -1320,7 +1321,7 @@ class Glusterd:
                     subvol=b["name"] + "-server")
                 out[b["name"]] = r or {"total": 0}
             except FopError as e:
-                if e.err == 2:  # ENOENT: path not on this brick (dht)
+                if e.err == errno.ENOENT:  # path not on this brick (dht)
                     out[b["name"]] = {"total": 0, "absent": True}
                 else:
                     out[b["name"]] = {"total": 0, "error": str(e)}
@@ -3257,7 +3258,7 @@ class Glusterd:
         if proc.poll() is None:
             proc.terminate()
             try:
-                proc.wait(timeout=5)
+                await asyncio.to_thread(proc.wait, timeout=5)
             except subprocess.TimeoutExpired:
                 proc.kill()
         raise MgmtError(f"{what} did not start in time")

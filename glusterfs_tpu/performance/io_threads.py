@@ -71,6 +71,15 @@ NORMAL = {Fop.READV, Fop.WRITEV, Fop.FLUSH, Fop.FSYNC, Fop.CREATE,
           Fop.SYMLINK, Fop.MKNOD, Fop.TRUNCATE, Fop.FTRUNCATE,
           Fop.SETXATTR, Fop.FSETXATTR, Fop.XATTROP, Fop.FXATTROP,
           Fop.SETATTR, Fop.FSETATTR,
+          # the write vocabulary's long tail rides the same queue as
+          # its siblings: allocation fops beside truncate, put/
+          # copy_file_range beside writev, removexattr beside
+          # setxattr, icreate/namelink beside mknod — graft-lint GL01
+          # caught all nine silently falling to the slow queue, which
+          # would invert them vs sibling writes of the SAME workload
+          Fop.FALLOCATE, Fop.DISCARD, Fop.ZEROFILL, Fop.PUT,
+          Fop.COPY_FILE_RANGE, Fop.REMOVEXATTR, Fop.FREMOVEXATTR,
+          Fop.ICREATE, Fop.NAMELINK,
           # parity-delta applies are data-path write work: the slow
           # queue would invert them vs the sibling data writevs of
           # the SAME delta wave

@@ -296,7 +296,7 @@ class _ClientConn:
         if isinstance(v, wire.FdHandle):
             fd = self.fds.get(v.fdid)
             if fd is None:
-                raise FopError(77, f"stale fd {v.fdid}")  # EBADFD
+                raise FopError(errno.EBADFD, f"stale fd {v.fdid}")
             return fd
         if isinstance(v, dict):
             if "__anon_fd__" in v:  # anonymous fd addressed by gfid
@@ -549,7 +549,7 @@ class BrickServer:
         graph = Graph.construct(volfile_text, top_name=top_name)
         name = graph.top.name
         if name == self.top.name or name in self.attached:
-            raise FopError(17, f"brick {name!r} already served")  # EEXIST
+            raise FopError(errno.EEXIST, f"brick {name!r} already served")
         try:
             await graph.activate()
         except BaseException:
@@ -784,8 +784,9 @@ class BrickServer:
                     log.error(2, "reply serialization failed: %r", e)
                     try:
                         await send(xid, wire.MT_ERROR,
-                                   FopError(5, f"unserializable reply: "
-                                               f"{e!r}"))
+                                   FopError(errno.EIO,
+                                            f"unserializable reply: "
+                                            f"{e!r}"))
                     except Exception:
                         pass
             finally:
@@ -1118,7 +1119,7 @@ class BrickServer:
                 # SETVOLUME gates everything — pings included (no
                 # pre-auth liveness probing; server.c refuses requests
                 # from unknown clients)
-                raise FopError(13, "handshake required")  # EACCES
+                raise FopError(errno.EACCES, "handshake required")
             top = conn.top if conn.top is not None else self.top
             graph = conn.graph if conn.top is not None else self.graph
             if trace_id and tracing.ENABLED and self._trace_on(top):
@@ -1135,7 +1136,8 @@ class BrickServer:
                 # graph (reconfigure/statedump), never arbitrary-graph
                 # execution or another volume's detach
                 if not (conn.is_mgmt and conn.top is self.top):
-                    raise FopError(13, "attach needs the anchor "
+                    raise FopError(errno.EACCES,
+                                   "attach needs the anchor "
                                    "mgmt credential")
                 name = await self.attach(args[0],
                                          args[1] if len(args) > 1
@@ -1143,7 +1145,8 @@ class BrickServer:
                 return wire.MT_REPLY, {"ok": True, "attached": name}
             if fop_name == "__detach__":
                 if not (conn.is_mgmt and conn.top is self.top):
-                    raise FopError(13, "detach needs the anchor "
+                    raise FopError(errno.EACCES,
+                                   "detach needs the anchor "
                                    "mgmt credential")
                 ok = await self.detach(args[0])
                 return wire.MT_REPLY, {"ok": ok}
@@ -1207,7 +1210,7 @@ class BrickServer:
                     [st, conn.wrap(val)] if st == "ok" else [st, val]
                     for st, val in replies]
             if fop_name not in _FOPS and fop_name not in _RPC_EXTRAS:
-                raise FopError(95, f"unknown fop {fop_name!r}")
+                raise FopError(errno.EOPNOTSUPP, f"unknown fop {fop_name!r}")
             conn.fop_counts[fop_name] = \
                 conn.fop_counts.get(fop_name, 0) + 1
             fn = getattr(top, fop_name, None)
@@ -1223,7 +1226,7 @@ class BrickServer:
                     if fn is not None:
                         break
             if fn is None:
-                raise FopError(95, f"fop {fop_name!r} unsupported")
+                raise FopError(errno.EOPNOTSUPP, f"fop {fop_name!r} unsupported")
             # release retires the fd-table entry too (long-lived
             # connections like bitd's would otherwise grow it unboundedly)
             if fop_name == "release" and args and \
@@ -1247,7 +1250,7 @@ class BrickServer:
             return wire.MT_ERROR, e
         except Exception as e:  # internal error: surface as EIO
             log.error(2, "dispatch error: %r", e)
-            return wire.MT_ERROR, FopError(5, f"internal: {e!r}")
+            return wire.MT_ERROR, FopError(errno.EIO, f"internal: {e!r}")
 
 
 def _scope_owner(args, kwargs, identity: bytes) -> None:

@@ -5,6 +5,7 @@ the tests/bitrot/*.t analog.  Reference: bit-rot-stub.c:29-40,
 bit-rot.c (signer), bit-rot-scrub.c (scrubber)."""
 
 import asyncio
+import errno
 import json
 import os
 
@@ -253,3 +254,37 @@ def test_scrub_token_bucket():
         assert time.monotonic() - t0 < 0.1
 
     asyncio.run(run())
+
+
+def test_quarantine_fences_content_long_tail(vol):
+    """graft-lint GL01 regression: a quarantined object's CONTENT is
+    evidence — truncate/ftruncate/fallocate/discard/zerofill/put and
+    copy_file_range were slipping past the quarantine that already
+    fenced readv/writev/xorv."""
+    c, ec, base = vol
+    data = _rand(2 * STRIPE, seed=7).tobytes()
+    c.write_file("/q", data)
+    bitds = [BrickBitd(ch, quiesce=0) for ch in ec.children]
+    for b in bitds:
+        assert c._run(b.sign_pass()) == 1
+    _corrupt_preserving_mtime(base / "brick0" / "q")
+    assert c._run(bitds[0].scrub_pass()) == ["/q"]
+    gfid = c.stat("/q").gfid
+    brick0 = ec.children[0]
+    bad_fd = FdObj(gfid, path="/q", anonymous=True)
+    bad_loc = Loc("/q", gfid=gfid)
+
+    async def drive():
+        for denied in (brick0.truncate(bad_loc, 4),
+                       brick0.ftruncate(bad_fd, 4),
+                       brick0.fallocate(bad_fd, 0, 0, 4),
+                       brick0.discard(bad_fd, 0, 4),
+                       brick0.zerofill(bad_fd, 0, 4),
+                       brick0.put(bad_loc, b"clobber"),
+                       brick0.copy_file_range(bad_fd, 0, bad_fd, 4, 4)):
+            with pytest.raises(FopError) as ei:
+                await denied
+            assert ei.value.err == errno.EIO
+    c._run(drive())
+    # the volume still serves correct data around the quarantine
+    assert c.read_file("/q") == data

@@ -134,3 +134,35 @@ def test_shard_sparse_and_append(tmp_path):
     out = c.read_file("/sparse")
     assert out[:10000] == b"\0" * 10000 and out[10000:] == b"END"
     c.close()
+
+
+def test_worm_long_tail_fences(tmp_path):
+    """graft-lint GL01 regression: the write vocabulary's long tail is
+    fenced like its siblings (PR 10 had to fence xorv after the fact;
+    link/discard/zerofill/fallocate/put had the same gap)."""
+    c = _client(tmp_path, ("features/worm", {}))
+    top = c.graph.top
+    c.write_file("/f", b"committed")
+
+    async def drive():
+        f = await c._client.open("/f")
+        with pytest.raises(FopError):  # new name for a wormed file
+            await top.link(Loc("/f"), Loc("/f2"))
+        with pytest.raises(FopError):  # hole punch mutates bytes
+            await top.discard(f.fd, 0, 4)
+        with pytest.raises(FopError):  # zeroing committed bytes
+            await top.zerofill(f.fd, 0, 4)
+        with pytest.raises(FopError):  # allocating over committed bytes
+            await top.fallocate(f.fd, 0, 0, 4)
+        # pure extension is the append analog: allowed
+        await top.fallocate(f.fd, 0, 9, 16)
+        with pytest.raises(FopError):  # whole-body replace of existing
+            await top.put(Loc("/f"), b"overwrite")
+        await top.put(Loc("/new"), b"fresh")  # create half allowed
+        with pytest.raises(FopError):  # cfr destination is a write
+            await top.copy_file_range(f.fd, 0, f.fd, 0, 4)
+        await f.close()
+
+    c._run(drive())
+    assert c.read_file("/f")[:9] == b"committed"
+    c.close()

@@ -359,3 +359,15 @@ def test_georep_per_brick_failover(tmp_path):
             await d.stop()
 
     asyncio.run(run())
+
+
+def test_changelog_entry_class_covers_namelink():
+    """graft-lint GL01 regression: namelink (icreate's other half —
+    link a name to an existing inode) journaled NOWHERE, hiding the
+    new name from geo-rep forever.  It is an entry op: E class, with
+    a generated wrapper like its siblings."""
+    from glusterfs_tpu.core.fops import Fop
+    from glusterfs_tpu.features import changelog as cl
+
+    assert Fop.NAMELINK in cl.E_FOPS
+    assert "namelink" in vars(cl.ChangelogLayer)

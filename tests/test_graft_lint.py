@@ -1,0 +1,317 @@
+"""graft-lint (tools/graft_lint): fixture-driven positive/negative
+cases per checker, the whole-tree zero-findings gate, and the
+pragma-plane pins (a reasonless suppression is rejected AND does not
+suppress).
+
+Fixtures build a miniature repo under tmp_path (the engine's CODE_GLOBS
+shape) so each checker sees exactly one synthetic defect beside one
+clean sibling; the whole-tree test then runs the real suite against the
+real tree — tier-1's enforcement of the ci.sh stage-0 contract.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(REPO_ROOT) not in sys.path:  # direct pytest invocation
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.graft_lint import engine, tables  # noqa: E402
+
+
+def _mini_repo(tmp_path: Path, files: dict[str, str]) -> Path:
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return tmp_path
+
+
+def _codes(findings):
+    return sorted({f.code for f in findings})
+
+
+# -- GL01: fop vocabulary ----------------------------------------------
+
+# a miniature but COMPLETE vocabulary: the real read class (so the
+# stale-READ_CLASS-table check stays armed) plus one write fop
+_READ_MEMBERS = "\n    ".join(
+    f'{n.upper()} = "{n}"' for n in sorted(tables.READ_CLASS))
+_MINI_FOPS = f'''
+import enum
+
+class Fop(enum.Enum):
+    {_READ_MEMBERS}
+    WRITEV = "writev"
+    {{extra}}
+
+WRITE_FOPS = frozenset({{{{Fop.WRITEV}}}})
+'''
+
+
+def test_gl01_unclassified_fop_is_a_finding(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/core/fops.py":
+            _MINI_FOPS.format(extra='FROBNICATE = "frobnicate"')})
+    found = engine.run(root)
+    assert any(f.code == "GL01" and "frobnicate" in f.message
+               for f in found), found
+
+
+def test_gl01_classified_vocabulary_is_clean(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/core/fops.py": _MINI_FOPS.format(extra="")})
+    assert engine.run(root) == []
+
+
+def test_gl01_write_fop_in_idempotent_allowlist(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/core/fops.py": _MINI_FOPS.format(extra=""),
+        "glusterfs_tpu/protocol/client.py":
+            'class ClientLayer:\n'
+            '    _IDEMPOTENT_FOPS = frozenset(("readv", "writev"))\n'})
+    found = [f for f in engine.run(root) if f.code == "GL01"]
+    assert any("writev" in f.message and "double-applies" in f.message
+               for f in found), found
+
+
+# -- GL02: option plane ------------------------------------------------
+
+_MINI_VOLGEN = '''
+OPTION_MAP = {
+    "cluster.foo": ("cluster/x", "foo"),
+}
+OPTION_MIN_OPVERSION = {%s}
+'''
+
+
+def test_gl02_unmapped_option_read_is_a_finding(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/mgmt/volgen.py": _MINI_VOLGEN % "",
+        "glusterfs_tpu/mgmt/other.py":
+            'def f(opts):\n'
+            '    return opts.get("cluster.bar", 1)\n'})
+    found = [f for f in engine.run(root) if f.code == "GL02"]
+    assert any("cluster.bar" in f.message for f in found), found
+
+
+def test_gl02_mapped_read_and_opversion_are_clean(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/mgmt/volgen.py":
+            _MINI_VOLGEN % '"cluster.foo": 2',
+        "glusterfs_tpu/mgmt/other.py":
+            'def f(opts):\n'
+            '    return opts.get("cluster.foo", 1)\n'})
+    assert engine.run(root) == []
+
+
+def test_gl02_opversion_for_unmapped_key(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/mgmt/volgen.py":
+            _MINI_VOLGEN % '"cluster.ghost": 9'})
+    found = [f for f in engine.run(root) if f.code == "GL02"]
+    assert any("cluster.ghost" in f.message for f in found), found
+
+
+# -- GL03: async discipline --------------------------------------------
+
+
+def test_gl03_blocking_calls_in_async_def(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py":
+            'import time, subprocess\n'
+            'async def f(proc):\n'
+            '    time.sleep(1)\n'
+            '    subprocess.run(["x"])\n'
+            '    proc.wait(timeout=5)\n'})
+    found = [f for f in engine.run(root) if f.code == "GL03"]
+    assert len(found) == 3, found
+
+
+def test_gl03_async_native_forms_are_clean(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/good.py":
+            'import asyncio, time, os\n'
+            'async def f(proc, ev):\n'
+            '    await asyncio.sleep(1)\n'
+            '    await proc.wait()\n'
+            '    await asyncio.wait_for(ev.wait(), 1.0)\n'
+            '    await asyncio.to_thread(proc.wait, timeout=5)\n'
+            '    asyncio.ensure_future(ev.wait())\n'
+            '    os.path.join("a", "b")\n'
+            '    ",".join(["a"])\n'
+            'def g():\n'
+            '    time.sleep(1)  # sync scope: fine\n'})
+    assert engine.run(root) == []
+
+
+# -- GL04: errno discipline --------------------------------------------
+
+
+def test_gl04_bare_errno_and_wrong_attr(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py":
+            'from .core.fops import FopError\n'
+            'def f():\n'
+            '    try:\n'
+            '        raise FopError(13, "nope")\n'
+            '    except FopError as e:\n'
+            '        if e.errno == 2:\n'
+            '            return e.err == 5\n'})
+    found = [f for f in engine.run(root) if f.code == "GL04"]
+    # bare 13 in the raise, e.errno use, and two bare comparisons
+    assert len(found) == 4, found
+
+
+def test_gl04_errno_names_and_oserror_are_clean(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/good.py":
+            'import errno\n'
+            'from .core.fops import FopError\n'
+            'def f():\n'
+            '    try:\n'
+            '        raise FopError(errno.EACCES, "nope")\n'
+            '    except FopError as e:\n'
+            '        ok = e.err == errno.ENOENT\n'
+            '    except OSError as e:\n'
+            '        ok = e.errno == errno.ENOENT  # real OSError\n'
+            '    return FopError(0)\n'})
+    assert engine.run(root) == []
+
+
+# -- GL05: metrics plane -----------------------------------------------
+
+
+def test_gl05_duplicate_registration_and_ghost_reference(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/a.py":
+            'from .core import metrics as _m\n'
+            '_m.REGISTRY.counter("gftpu_x_total", "help")\n',
+        "glusterfs_tpu/b.py":
+            'from .core import metrics as _m\n'
+            '_m.REGISTRY.counter("gftpu_x_total", "other help")\n'
+            'NAME = "gftpu_ghost_total"\n'})
+    found = [f for f in engine.run(root) if f.code == "GL05"]
+    msgs = [f.message for f in found]
+    assert any("registered 2 times" in m for m in msgs), found
+    assert any("gftpu_ghost_total" in m for m in msgs), found
+
+
+def test_gl05_single_registration_and_references_clean(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/a.py":
+            'from .core import metrics as _m\n'
+            '_m.REGISTRY.register_objects(\n'
+            '    "gftpu_x_total", "counter", "help",\n'
+            '    lambda o: [({"layer": o.name, "kind": "a"}, 1),\n'
+            '               ({"layer": o.name, "kind": "b"}, 2)])\n'
+            'REF = "gftpu_x_total"\n'
+            'import contextvars\n'
+            'CV = contextvars.ContextVar("gftpu_not_a_family")\n'})
+    assert engine.run(root) == []
+
+
+def test_gl05_mixed_label_schema(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/a.py":
+            'from .core import metrics as _m\n'
+            '_m.REGISTRY.register(\n'
+            '    "gftpu_x_total", "counter", "help",\n'
+            '    lambda: [({"layer": "l"}, 1), ({"prio": "fast"}, 2)])\n'})
+    found = [f for f in engine.run(root) if f.code == "GL05"]
+    assert any("mixed label key sets" in f.message for f in found), found
+
+
+# -- GL00: the pragma plane checks itself ------------------------------
+
+
+def test_reasonless_pragma_is_rejected_and_does_not_suppress(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py":
+            'import time\n'
+            'async def f():\n'
+            '    time.sleep(1)  '
+            '# graft-lint: disable=GL03\n'})
+    found = engine.run(root)
+    assert "GL00" in _codes(found), found    # the pragma itself
+    assert "GL03" in _codes(found), found    # ...and it suppressed nothing
+
+
+def test_reasoned_pragma_suppresses(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/ok.py":
+            'import time\n'
+            'async def f():\n'
+            '    time.sleep(1)  '
+            '# graft-lint: disable=GL03 -- fixture: deliberate block\n'})
+    assert engine.run(root) == []
+
+
+def test_own_line_pragma_covers_next_line(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/ok.py":
+            'import time\n'
+            'async def f():\n'
+            '    # graft-lint: disable=GL03 -- fixture: next-line form\n'
+            '    time.sleep(1)\n'})
+    assert engine.run(root) == []
+
+
+def test_pragma_in_string_is_data_not_suppression(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py":
+            'import time\n'
+            'P = "# graft-lint: disable=GL03"\n'
+            'async def f():\n'
+            '    time.sleep(1)\n'})
+    found = engine.run(root)
+    assert "GL03" in _codes(found), found
+    assert "GL00" not in _codes(found), found
+
+
+# -- the whole-tree gate (the tier-1 enforcement of ci.sh stage-0) -----
+
+
+def test_whole_tree_is_clean_and_fast():
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools/graft_lint/run.py"),
+         "--json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
+    payload = json.loads(out.stdout)
+    assert out.returncode == 0, payload["findings"]
+    assert payload["count"] == 0, payload["findings"]
+    assert payload["seconds"] < 30, payload["seconds"]
+
+
+def test_runner_narrowed_paths_and_exit_code(tmp_path):
+    # a narrowed run over one clean file exits 0 without the full tree
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools/graft_lint/run.py"),
+         "glusterfs_tpu/core/fops.py"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_malformed_pragma_code_is_a_finding(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py":
+            'X = 1  # graft-lint: disable=GLXX -- reasoned but bogus\n'})
+    found = engine.run(root)
+    assert any(f.code == "GL00" and "malformed" in f.message
+               for f in found), found
+
+
+def test_typo_path_is_an_error_not_clean():
+    # a narrowed run matching nothing must not read as a clean tree
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools/graft_lint/run.py"),
+         "glusterfs_tpu/no_such_subtree"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "no scanned files match" in out.stderr

@@ -119,3 +119,23 @@ def test_priority_gates_still_account(tmp_path):
         await g.fini()
 
     asyncio.run(run())
+
+
+def test_write_vocabulary_fully_classified():
+    """graft-lint GL01 regression: every write-class fop has an
+    explicit priority class — nine (fallocate/discard/zerofill/put/
+    copy_file_range/removexattr/fremovexattr/icreate/namelink) were
+    silently falling to the slow queue, inverting them against
+    sibling writes of the same workload."""
+    from glusterfs_tpu.core.fops import Fop, WRITE_FOPS
+    from glusterfs_tpu.performance.io_threads import (
+        FAST, LEAST, NORMAL, UNGATED, _prio)
+
+    classed = FAST | NORMAL | LEAST | UNGATED
+    assert WRITE_FOPS <= classed, sorted(
+        f.value for f in WRITE_FOPS - classed)
+    # the long tail rides beside its siblings, not behind them
+    for f in (Fop.FALLOCATE, Fop.DISCARD, Fop.ZEROFILL, Fop.PUT,
+              Fop.COPY_FILE_RANGE, Fop.REMOVEXATTR, Fop.FREMOVEXATTR,
+              Fop.ICREATE, Fop.NAMELINK):
+        assert _prio(f) == _prio(Fop.WRITEV)

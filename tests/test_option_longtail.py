@@ -290,3 +290,84 @@ def test_afr_quorum_type_and_read_pin(tmp_path):
         c.write_file("/solo", b"one child")
     finally:
         c.close()
+
+
+def test_mandatory_locking_fences_content_long_tail(tmp_path):
+    """graft-lint GL01 regression: mandatory byte-range locks fence
+    EVERY content mutator, not just readv/writev/xorv — truncate,
+    discard, fallocate, zerofill and copy_file_range were slipping
+    past another owner's lock."""
+    c = _client(tmp_path, """
+volume locks
+    type features/locks
+    option mandatory-locking forced
+    subvolumes posix
+end-volume
+""")
+    try:
+        top = c.graph.top
+        c.write_file("/f", b"0" * 1024)
+
+        async def drive():
+            f = await c._client.open("/f")
+            await top.lk(f.fd, "setlkw",
+                         {"type": "wr", "start": 0, "len": 512},
+                         xdata={"lk-owner": b"ownerA"})
+            b = {"lk-owner": b"ownerB"}
+            for blocked in (
+                    top.truncate(Loc("/f", gfid=f.fd.gfid), 100,
+                                 xdata=b),
+                    top.ftruncate(f.fd, 100, xdata=b),
+                    top.discard(f.fd, 100, 10, xdata=b),
+                    top.fallocate(f.fd, 0, 100, 10, xdata=b),
+                    top.zerofill(f.fd, 100, 10, xdata=b),
+                    top.copy_file_range(f.fd, 600, f.fd, 100, 10,
+                                        xdata=b)):
+                with pytest.raises(FopError) as ei:
+                    await blocked
+                assert ei.value.err == errno.EAGAIN
+            # outside the locked range: allowed
+            await top.discard(f.fd, 600, 10, xdata=b)
+            # the holder itself passes
+            await top.zerofill(f.fd, 0, 10,
+                               xdata={"lk-owner": b"ownerA"})
+            await top.lk(f.fd, "setlk",
+                         {"type": "unlck", "start": 0, "len": 512},
+                         xdata={"lk-owner": b"ownerA"})
+            await f.close()
+
+        c._run(drive())
+    finally:
+        c.close()
+
+
+def test_worm_file_level_long_tail(tmp_path):
+    """graft-lint GL01 regression: a RETAINED file's metadata and
+    retention state are fenced — setattr is denied and
+    trusted.worm.state cannot be stripped (de-WORMing by removexattr)."""
+    c = _client(tmp_path, """
+volume worm
+    type features/worm
+    option worm off
+    option worm-file-level on
+    option auto-commit-period 0.2
+    option default-retention-period 30
+    subvolumes posix
+end-volume
+""")
+    try:
+        c.write_file("/w", b"immutable")
+        time.sleep(0.3)  # past auto-commit: file turns WORM
+        top = c.graph.top
+
+        async def drive():
+            with pytest.raises(FopError) as ei:
+                await top.setattr(Loc("/w"), {"mode": 0o777})
+            assert ei.value.err == errno.EROFS
+            with pytest.raises(FopError) as ei:
+                await top.removexattr(Loc("/w"), "trusted.worm.state")
+            assert ei.value.err == errno.EPERM
+
+        c._run(drive())
+    finally:
+        c.close()

@@ -534,7 +534,7 @@ async def gateway(base: str, opts) -> dict:
             if sup.poll() is None:
                 sup.terminate()
                 try:
-                    sup.wait(timeout=10)
+                    await asyncio.to_thread(sup.wait, timeout=10)
                 except subprocess.TimeoutExpired:
                     sup.kill()
     return out
@@ -632,7 +632,7 @@ async def rebalance_grow(base: str, opts) -> dict:
                 pre_ctr = dict(rb()["counters"])
                 proc = st.d.rebalanced[st.name]
                 os.kill(proc.pid, signal.SIGKILL)
-                proc.wait()
+                await asyncio.to_thread(proc.wait)
                 out["killed_at_checkpoint"] = \
                     rb()["checkpoint"]["last_dir"]
                 async with MgmtClient(st.d.host, st.d.port) as c:
@@ -806,7 +806,7 @@ async def amain(opts) -> dict:
             leaked = sorted(live_threads() - baseline_threads)
             if not leaked:
                 break
-            time.sleep(0.3)
+            await asyncio.sleep(0.3)
         report["leaked_threads"] = leaked
         report["leaked_tasks"] = leaked_tasks
         if leaked or leaked_tasks:

@@ -2,6 +2,11 @@
 # ci.sh — the one-command pre-merge gate (ISSUE 3 satellite; the
 # regression signal ROADMAP's tier-1 bar depends on):
 #
+#   0. graft-lint               tools/graft_lint cross-file invariant
+#                               suite (fop/option/async/errno/metrics
+#                               planes, ISSUE 13) — runs FIRST because
+#                               it is the cheapest signal (<30s);
+#                               --json archived to /tmp/gftpu-ci
 #   1. tools/flake_gate.sh      tier-1 twice, diffing the failure sets
 #                               (stable failures -> exit 1, flakes -> 2)
 #   2. bench contract test      the driver-facing reporting contract
@@ -62,6 +67,32 @@
 
 set -u
 cd "$(dirname "$0")/.."
+
+echo "== ci: stage 0 — graft-lint (cross-file invariants) =="
+mkdir -p /tmp/gftpu-ci
+timeout -k 5 60 env JAX_PLATFORMS=cpu \
+    python tools/graft_lint/run.py --json \
+    > /tmp/gftpu-ci/graft_lint.json
+lint_rc=$?
+if [ $lint_rc -ne 0 ]; then
+    echo "ci: graft-lint findings (archived at"
+    echo "    /tmp/gftpu-ci/graft_lint.json) — not mergeable"
+    python - <<'PYEOF'
+import json
+try:
+    d = json.load(open("/tmp/gftpu-ci/graft_lint.json"))
+except Exception as e:  # internal error/timeout: archive is not JSON
+    print(f"  (no findings archive — linter internal error or "
+          f"timeout: {e})")
+else:
+    for f in d.get("findings", []):
+        print(f"  {f['path']}:{f['line']}: {f['code']} {f['message']}")
+PYEOF
+    exit $lint_rc
+fi
+echo "ci: lint clean ($(python -c "import json; \
+d=json.load(open('/tmp/gftpu-ci/graft_lint.json')); \
+print(d['seconds'])")s, archived)"
 
 echo "== ci: flake gate (tier-1 x2) =="
 tools/flake_gate.sh "$@"

@@ -1,0 +1,45 @@
+"""graft-lint — cross-file invariant checker for the fop, option,
+async, errno and metrics planes.
+
+Every checker here is grounded in a defect class this repo has already
+paid for in review time (docs/static_analysis.md carries the catalog
+with the historical bug behind each id):
+
+* **GL01** fop-vocabulary completeness: read/write classification,
+  changelog journaling, io-threads priority, brick-side fence parity
+  (worm / bit-rot-stub / locks / read-only / barrier), and the
+  idempotent-retry allowlist staying read-class.
+* **GL02** option-plane consistency: dotted option-key reads vs
+  volgen's OPTION_MAP, OPTION_MIN_OPVERSION ⊆ OPTION_MAP,
+  docs/volume_options.md regenerate-and-diff, SETVOLUME capability
+  advertisement vs client check sites.
+* **GL03** async discipline: blocking calls inside ``async def``.
+* **GL04** errno discipline: ``.errno`` where ``FopError.err`` is the
+  contract, bare integer errno literals.
+* **GL05** metrics-family discipline: every ``gftpu_*`` family
+  registered exactly once, label-key consistency, references in
+  tests/docs resolve to registered families.
+
+Suppression: ``# graft-lint: disable=GLxx -- <reason>`` on the finding
+line (or the full-line comment directly above it).  A suppression
+WITHOUT a reason is itself a finding (GL00) — the pragma plane is
+checked like everything else.  There are no file-level excludes.
+
+Pure stdlib (``ast`` + ``tokenize``); the only import of repo code is
+GL02's regenerate-and-diff of docs/volume_options.md, which calls
+``mgmt.volgen.options_doc()`` because the doc IS that function's
+output.
+"""
+
+from __future__ import annotations
+
+__all__ = ["all_checkers"]
+
+
+def all_checkers():
+    """The checker registry, id-ordered (GL00 runs in the engine)."""
+    from . import gl01_fops, gl02_options, gl03_async, gl04_errno, \
+        gl05_metrics
+
+    return [gl01_fops.check, gl02_options.check, gl03_async.check,
+            gl04_errno.check, gl05_metrics.check]

@@ -1,0 +1,120 @@
+"""Small AST helpers shared by the checkers (stdlib ``ast`` only)."""
+
+from __future__ import annotations
+
+import ast
+
+
+def dotted(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fop_name(node: ast.AST) -> str | None:
+    """``Fop.WRITEV`` -> ``"writev"`` (the enum VALUE convention: every
+    member's value is its lowercased name)."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "Fop":
+        return node.attr.lower()
+    return None
+
+
+class SetEvalError(Exception):
+    pass
+
+
+def eval_fop_set(node: ast.AST, env: dict[str, frozenset]) -> frozenset:
+    """Evaluate a module-level fop-set expression to a frozenset of fop
+    value strings.  Understands set literals of ``Fop.X``, names bound
+    in ``env`` (e.g. WRITE_FOPS), ``frozenset(...)`` / ``set(...)``
+    wrapping, and the ``| - &`` set operators — the shapes the fence
+    and classification tables actually use."""
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        out = set()
+        for e in node.elts:
+            f = fop_name(e)
+            if f is None:
+                s = const_str(e)
+                if s is None:
+                    raise SetEvalError(ast.dump(e))
+                out.add(s)
+            else:
+                out.add(f)
+        return frozenset(out)
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise SetEvalError(f"unknown name {node.id}")
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("frozenset", "set") and \
+            len(node.args) == 1:
+        return eval_fop_set(node.args[0], env)
+    if isinstance(node, ast.BinOp):
+        left = eval_fop_set(node.left, env)
+        right = eval_fop_set(node.right, env)
+        if isinstance(node.op, ast.BitOr):
+            return left | right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        if isinstance(node.op, ast.BitAnd):
+            return left & right
+        raise SetEvalError(f"operator {node.op}")
+    raise SetEvalError(ast.dump(node)[:80])
+
+
+def module_fop_sets(tree: ast.Module,
+                    seed: dict[str, frozenset] | None = None
+                    ) -> dict[str, frozenset]:
+    """Walk module-level assignments in order, evaluating every
+    fop-set-shaped one into an environment (barrier's ``_GATED``
+    builds on io-threads-style prior names)."""
+    env: dict[str, frozenset] = dict(seed or {})
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        tgt = stmt.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        try:
+            env[tgt.id] = eval_fop_set(stmt.value, env)
+        except SetEvalError:
+            continue
+    return env
+
+
+def class_def(tree: ast.Module, name_suffix: str) -> ast.ClassDef | None:
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef) and \
+                stmt.name.endswith(name_suffix):
+            return stmt
+    return None
+
+
+def calls_in(node: ast.AST):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def str_keys(node: ast.Dict) -> list[str] | None:
+    """All-literal-string keys of a dict literal, else None."""
+    out = []
+    for k in node.keys:
+        s = const_str(k) if k is not None else None
+        if s is None:
+            return None
+        out.append(s)
+    return out

@@ -1,0 +1,274 @@
+"""GL01 — fop-vocabulary completeness.
+
+Historical bugs this pins: PR 10's review pass had to fence ``xorv`` in
+worm/bit-rot-stub/locks AFTER the fact (a new write fop slipped past
+three brick-side gates), the xorv double-apply hazard (XOR is an
+involution — blind idempotent retry self-cancels), and io-threads
+classifying xorv NORMAL only because a reviewer noticed the slow queue
+would invert it against its own wave's writevs.
+
+Sub-checks, all driven by tables.py:
+
+1. every ``Fop`` member is classified: WRITE_FOPS (core/fops.py) or
+   tables.READ_CLASS, disjointly;
+2. every write-class fop appears in changelog's E/D/M record classes
+   or tables.CHANGELOG_EXEMPT;
+3. every write-class fop has an explicit io-threads priority class
+   (FAST/NORMAL/LEAST/UNGATED) or tables.IOT_SLOW_EXEMPT;
+4. fence parity: each fence layer's gate set covers WRITE_FOPS up to
+   its exemption table (and exemptions must not be stale);
+5. ``_IDEMPOTENT_FOPS`` ⊆ read-class, and every string in it (and in
+   ``_LOCK_FOPS``) names a real fop.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import tables
+from .astutil import class_def, dotted, eval_fop_set, \
+    module_fop_sets, SetEvalError
+from .engine import Finding, RepoIndex
+
+FOPS_PATH = "glusterfs_tpu/core/fops.py"
+CHANGELOG_PATH = "glusterfs_tpu/features/changelog.py"
+IOT_PATH = "glusterfs_tpu/performance/io_threads.py"
+CLIENT_PATH = "glusterfs_tpu/protocol/client.py"
+
+
+def _vocabulary(tree: ast.Module) -> tuple[frozenset, int]:
+    """(fop values, enum lineno) from the Fop enum class."""
+    cls = class_def(tree, "Fop")
+    vals = set()
+    line = 1
+    if cls is not None:
+        line = cls.lineno
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Constant) and \
+                    isinstance(stmt.value.value, str):
+                vals.add(stmt.value.value)
+    return frozenset(vals), line
+
+
+def _named_set(tree: ast.Module, name: str,
+               env: dict | None = None) -> tuple[frozenset, int] | None:
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id == name:
+            try:
+                return eval_fop_set(stmt.value, env or {}), stmt.lineno
+            except SetEvalError:
+                return None
+    return None
+
+
+def check(idx: RepoIndex) -> list[Finding]:
+    out: list[Finding] = []
+    fops_sf = idx.code.get(FOPS_PATH)
+    if fops_sf is None or fops_sf.tree is None:
+        return out  # partial runs (explicit paths) skip cross-file checks
+    vocab, vocab_line = _vocabulary(fops_sf.tree)
+    got = _named_set(fops_sf.tree, "WRITE_FOPS")
+    if not vocab or got is None:
+        out.append(Finding("GL01", FOPS_PATH, vocab_line,
+                           "could not extract Fop vocabulary or "
+                           "WRITE_FOPS — the classification plane is "
+                           "unchecked"))
+        return out
+    write_fops, wf_line = got
+
+    # 1. read/write partition ---------------------------------------------
+    unknown_write = write_fops - vocab
+    for f in sorted(unknown_write):
+        out.append(Finding("GL01", FOPS_PATH, wf_line,
+                           f"WRITE_FOPS names {f!r} which is not in the "
+                           "Fop vocabulary"))
+    unclassified = vocab - write_fops - tables.READ_CLASS
+    for f in sorted(unclassified):
+        out.append(Finding(
+            "GL01", FOPS_PATH, vocab_line,
+            f"fop {f!r} is neither write-class (WRITE_FOPS) nor "
+            "read-class (tools/graft_lint/tables.py READ_CLASS) — "
+            "classify it explicitly"))
+    for f in sorted(write_fops & tables.READ_CLASS):
+        out.append(Finding(
+            "GL01", FOPS_PATH, wf_line,
+            f"fop {f!r} is BOTH in WRITE_FOPS and tables.READ_CLASS"))
+    for f in sorted(tables.READ_CLASS - vocab):
+        out.append(Finding(
+            "GL01", FOPS_PATH, vocab_line,
+            f"tables.READ_CLASS names {f!r} which is not in the Fop "
+            "vocabulary (stale table)"))
+
+    # 2. changelog E/D/M coverage -----------------------------------------
+    ch = idx.code.get(CHANGELOG_PATH)
+    if ch is not None and ch.tree is not None:
+        sets = {}
+        line = 1
+        for nm in ("E_FOPS", "D_FOPS", "M_FOPS"):
+            got = _named_set(ch.tree, nm)
+            if got is not None:
+                sets[nm], line = got
+        journaled = frozenset().union(*sets.values()) if sets else \
+            frozenset()
+        for f in sorted(write_fops - journaled
+                        - set(tables.CHANGELOG_EXEMPT)):
+            out.append(Finding(
+                "GL01", CHANGELOG_PATH, line,
+                f"write-class fop {f!r} is in no changelog record "
+                "class (E/D/M) — geo-rep would never see its "
+                "mutations; journal it or exempt it in "
+                "tables.CHANGELOG_EXEMPT with a reason"))
+        for f, why in sorted(tables.CHANGELOG_EXEMPT.items()):
+            if f in journaled:
+                out.append(Finding(
+                    "GL01", CHANGELOG_PATH, line,
+                    f"stale exemption: {f!r} is journaled now — drop "
+                    f"it from tables.CHANGELOG_EXEMPT ({why[:40]}...)"))
+
+    # 3. io-threads priority classes --------------------------------------
+    iot = idx.code.get(IOT_PATH)
+    if iot is not None and iot.tree is not None:
+        env = module_fop_sets(iot.tree)
+        classed = frozenset().union(
+            *(env.get(n, frozenset())
+              for n in ("FAST", "NORMAL", "LEAST", "UNGATED")))
+        line = next((s.lineno for s in iot.tree.body
+                     if isinstance(s, ast.Assign)
+                     and isinstance(s.targets[0], ast.Name)
+                     and s.targets[0].id == "NORMAL"), 1)
+        for f in sorted(write_fops - classed
+                        - set(tables.IOT_SLOW_EXEMPT)):
+            out.append(Finding(
+                "GL01", IOT_PATH, line,
+                f"write-class fop {f!r} has no explicit io-threads "
+                "priority class — it falls to the slow queue, "
+                "inverting it against sibling write fops of the same "
+                "workload (the xorv-vs-writev wave hazard); classify "
+                "it or exempt it in tables.IOT_SLOW_EXEMPT"))
+        for f in sorted(set(tables.IOT_SLOW_EXEMPT) & classed):
+            out.append(Finding(
+                "GL01", IOT_PATH, line,
+                f"stale exemption: {f!r} is classified now — drop it "
+                "from tables.IOT_SLOW_EXEMPT"))
+
+    # 4. fence parity ------------------------------------------------------
+    for path, spec in tables.FENCES.items():
+        sf = idx.code.get(path)
+        if sf is None or sf.tree is None:
+            continue  # partial runs skip absent fence layers
+        gated, line = _gated_set(sf.tree, spec,
+                                 {"WRITE_FOPS": write_fops,
+                                  "Fop": vocab})
+        exempt = spec["exempt"]
+        for f in sorted(write_fops - gated - set(exempt)):
+            out.append(Finding(
+                "GL01", path, line,
+                f"fence gap: write-class fop {f!r} is not gated by "
+                f"{spec['layer']} while its siblings are — a new "
+                "write fop must be fenced everywhere or exempted in "
+                "tables.FENCES with a reason (the PR-10 xorv "
+                "after-the-fact fence class)"))
+        for f in sorted(set(exempt) & gated):
+            out.append(Finding(
+                "GL01", path, line,
+                f"stale fence exemption: {spec['layer']} gates {f!r} "
+                "now — drop it from tables.FENCES"))
+        for f in sorted(set(exempt) - write_fops):
+            out.append(Finding(
+                "GL01", path, line,
+                f"fence exemption {f!r} is not a write-class fop "
+                "(stale table)"))
+
+    # 5. idempotent-retry allowlist ---------------------------------------
+    cl = idx.code.get(CLIENT_PATH)
+    if cl is not None and cl.tree is not None:
+        for name, must_be_read in (("_IDEMPOTENT_FOPS", True),
+                                   ("_LOCK_FOPS", False)):
+            found = _class_str_tuple(cl.tree, name)
+            if found is None:
+                continue
+            vals, line = found
+            for v in sorted(set(vals) - vocab):
+                out.append(Finding(
+                    "GL01", CLIENT_PATH, line,
+                    f"{name} names {v!r} which is not a fop value "
+                    "(typo pins nothing)"))
+            if must_be_read:
+                for v in sorted(set(vals) & write_fops):
+                    out.append(Finding(
+                        "GL01", CLIENT_PATH, line,
+                        f"{name} contains write-class fop {v!r} — "
+                        "blind re-dispatch of a write after a "
+                        "transport failure double-applies it (the "
+                        "xorv involution hazard, pinned forever)"))
+    return out
+
+
+def _gated_set(tree: ast.Module, spec: dict,
+               env: dict[str, frozenset]) -> tuple[frozenset, int]:
+    """The write-fop set a fence layer gates, per its declared kind."""
+    if spec["kind"] == "loop":
+        # module-level: for _f in <set-expr>: setattr(Class, _f.value,…)
+        full_env = module_fop_sets(tree, seed=env)
+        for stmt in tree.body:
+            if not isinstance(stmt, ast.For):
+                continue
+            has_setattr = any(
+                isinstance(c.func, ast.Name) and c.func.id == "setattr"
+                for n in ast.walk(stmt)
+                for c in ([n] if isinstance(n, ast.Call) else []))
+            if not has_setattr:
+                continue
+            try:
+                return (eval_fop_set(stmt.iter, full_env) &
+                        env["WRITE_FOPS"], stmt.lineno)
+            except SetEvalError:
+                continue
+        return frozenset(), 1
+    # methods: write-fop-named async defs whose body calls a marker
+    # or raises FopError before winding
+    cls = class_def(tree, spec["layer"])
+    if cls is None:
+        return frozenset(), 1
+    markers = set(spec.get("markers", ()))
+    gated = set()
+    for stmt in cls.body:
+        if not isinstance(stmt, (ast.AsyncFunctionDef, ast.FunctionDef)):
+            continue
+        if stmt.name not in env["WRITE_FOPS"]:
+            continue
+        fences = False
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d.split(".")[-1] in markers:
+                    fences = True
+            if isinstance(n, ast.Raise) and isinstance(n.exc, ast.Call) \
+                    and dotted(n.exc.func).endswith("FopError"):
+                fences = True
+        if fences:
+            gated.add(stmt.name)
+    return frozenset(gated), cls.lineno
+
+
+def _class_str_tuple(tree: ast.Module,
+                     attr: str) -> tuple[list, int] | None:
+    """A class-level (or module-level) tuple/frozenset of string
+    literals named ``attr``."""
+    bodies = [tree.body]
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            bodies.append(stmt.body)
+    for body in bodies:
+        for stmt in body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == attr:
+                vals = [n.value for n in ast.walk(stmt.value)
+                        if isinstance(n, ast.Constant)
+                        and isinstance(n.value, str)]
+                return vals, stmt.lineno
+    return None

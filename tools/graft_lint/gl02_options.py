@@ -1,0 +1,213 @@
+"""GL02 — option-plane consistency.
+
+Historical bugs: volume-option keys drifting between read sites,
+volgen registration and docs/volume_options.md (several review passes
+caught one-end-only keys by hand), and SETVOLUME capability keys whose
+client check site was forgotten (the sg/deadline/xorv family grew one
+advertisement per PR).
+
+Sub-checks:
+
+1. every dotted option-shaped ``.get("x.y")`` read in code resolves to
+   a key volgen registers (OPTION_MAP), or is exempted in
+   tables.OPTION_READ_EXEMPT;
+2. OPTION_MIN_OPVERSION ⊆ OPTION_MAP (an op-version for a key nobody
+   maps gates nothing);
+3. docs/volume_options.md == volgen.options_doc() regenerated
+   (the one sub-check that imports repo code: the doc IS that
+   function's output);
+4. every SETVOLUME reply capability has a client check site
+   (``res.get("<cap>")`` in protocol/client.py) or a tables.CAPABILITIES
+   exemption, and the table itself carries no stale entries.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from . import tables
+from .astutil import const_str, dotted
+from .engine import Finding, RepoIndex
+
+VOLGEN_PATH = "glusterfs_tpu/mgmt/volgen.py"
+SERVER_PATH = "glusterfs_tpu/protocol/server.py"
+CLIENT_PATH = "glusterfs_tpu/protocol/client.py"
+DOC_PATH = "docs/volume_options.md"
+
+_OPTION_KEY_RE = re.compile(
+    r"^(?:%s)\.[a-z][a-z0-9.-]*$" % "|".join(tables.OPTION_KEY_PREFIXES))
+
+
+def _volgen_tables(tree: ast.Module) -> tuple[dict, dict]:
+    """(OPTION_MAP key->lineno, OPTION_MIN_OPVERSION key->lineno),
+    following the literal assignment + ``.update({k: v for k in
+    _Vn_KEYS})`` idiom."""
+    opt_map: dict[str, int] = {}
+    min_ver: dict[str, int] = {}
+    tuples: dict[str, list[tuple[str, int]]] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            if isinstance(stmt.value, ast.Dict):
+                keys = [(const_str(k), k.lineno if k else stmt.lineno)
+                        for k in stmt.value.keys]
+                if name == "OPTION_MAP":
+                    opt_map.update({k: ln for k, ln in keys
+                                    if k is not None})
+                elif name == "OPTION_MIN_OPVERSION":
+                    min_ver.update({k: ln for k, ln in keys
+                                    if k is not None})
+            elif isinstance(stmt.value, (ast.Tuple, ast.List)):
+                tuples[name] = [(e.value, e.lineno)
+                                for e in stmt.value.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)]
+        # OPTION_MIN_OPVERSION.update({k: N for k in _Vn_KEYS})
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Call) and \
+                dotted(stmt.value.func) == "OPTION_MIN_OPVERSION.update":
+            arg = stmt.value.args[0] if stmt.value.args else None
+            if isinstance(arg, ast.DictComp) and \
+                    isinstance(arg.generators[0].iter, ast.Name):
+                src = arg.generators[0].iter.id
+                for k, ln in tuples.get(src, ()):
+                    min_ver[k] = ln
+            elif isinstance(arg, ast.Dict):
+                for k in arg.keys:
+                    s = const_str(k)
+                    if s is not None:
+                        min_ver[s] = k.lineno
+    return opt_map, min_ver
+
+
+def check(idx: RepoIndex) -> list[Finding]:
+    out: list[Finding] = []
+    vg = idx.code.get(VOLGEN_PATH)
+    if vg is None or vg.tree is None:
+        return out  # partial runs skip the cross-file option plane
+    opt_map, min_ver = _volgen_tables(vg.tree)
+    if not opt_map:
+        out.append(Finding("GL02", VOLGEN_PATH, 1,
+                           "could not extract OPTION_MAP — the option "
+                           "plane is unchecked"))
+        return out
+
+    # 2. min-opversion keys must be mapped --------------------------------
+    for k, ln in sorted(min_ver.items()):
+        if k not in opt_map:
+            out.append(Finding(
+                "GL02", VOLGEN_PATH, ln,
+                f"OPTION_MIN_OPVERSION entry {k!r} is not in "
+                "OPTION_MAP — an op-version gate for an unmapped key "
+                "gates nothing"))
+
+    # 1. dotted option reads ----------------------------------------------
+    valid = set(opt_map) | set(tables.OPTION_READ_EXEMPT)
+    used_exempt: set[str] = set()
+    for sf in idx.code.values():
+        if sf.tree is None or sf.path.startswith("tools/graft_lint/"):
+            continue  # the linter's own tables/docstrings name keys
+        for n in ast.walk(sf.tree):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "get" and n.args):
+                continue
+            key = const_str(n.args[0])
+            if key is None or not _OPTION_KEY_RE.match(key):
+                continue
+            if key in tables.OPTION_READ_EXEMPT:
+                used_exempt.add(key)
+                continue
+            if key not in valid:
+                out.append(Finding(
+                    "GL02", sf.path, n.lineno,
+                    f"option key {key!r} is read here but volgen's "
+                    "OPTION_MAP does not register it — `volume set` "
+                    "can never reach this site (key drift); map it or "
+                    "exempt it in tables.OPTION_READ_EXEMPT"))
+    for k in sorted(set(tables.OPTION_READ_EXEMPT) - used_exempt):
+        out.append(Finding(
+            "GL02", VOLGEN_PATH, 1,
+            f"stale tables.OPTION_READ_EXEMPT entry {k!r}: no code "
+            "reads it any more"))
+
+    # 3. docs regenerate-and-diff -----------------------------------------
+    committed = idx.docs.get(DOC_PATH)
+    if committed is not None:
+        try:
+            from glusterfs_tpu.mgmt import volgen as _volgen
+            want = _volgen.options_doc()
+        except Exception as e:  # noqa: BLE001 - import env may lack jax
+            out.append(Finding("GL02", DOC_PATH, 1,
+                               f"could not regenerate options doc: {e!r}"))
+        else:
+            if committed != want:
+                line = _first_diff_line(committed, want)
+                out.append(Finding(
+                    "GL02", DOC_PATH, line,
+                    "docs/volume_options.md drifted from "
+                    "volgen.options_doc() — regenerate: python -c "
+                    "\"from glusterfs_tpu.mgmt.volgen import "
+                    "options_doc; open('docs/volume_options.md','w')"
+                    ".write(options_doc())\""))
+
+    # 4. SETVOLUME capabilities -------------------------------------------
+    out.extend(_check_capabilities(idx))
+    return out
+
+
+def _first_diff_line(a: str, b: str) -> int:
+    for i, (la, lb) in enumerate(zip(a.splitlines(), b.splitlines()),
+                                 start=1):
+        if la != lb:
+            return i
+    return min(len(a.splitlines()), len(b.splitlines())) + 1
+
+
+def _check_capabilities(idx: RepoIndex) -> list[Finding]:
+    out: list[Finding] = []
+    sv = idx.code.get(SERVER_PATH)
+    cl = idx.code.get(CLIENT_PATH)
+    if sv is None or sv.tree is None or cl is None or cl.tree is None:
+        return out
+    advertised: dict[str, int] = {}
+    # the SETVOLUME reply: the dict literal carrying both "volume" and
+    # "ok" keys
+    for n in ast.walk(sv.tree):
+        if isinstance(n, ast.Dict):
+            keys = {const_str(k) for k in n.keys if k is not None}
+            if {"volume", "ok"} <= keys:
+                for k in n.keys:
+                    s = const_str(k)
+                    if s and s not in ("volume", "ok", "error"):
+                        advertised[s] = k.lineno
+    checked: set[str] = set()
+    for n in ast.walk(cl.tree):
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr == "get" and n.args:
+            s = const_str(n.args[0])
+            if s is not None:
+                checked.add(s)
+    for cap, ln in sorted(advertised.items()):
+        spec = tables.CAPABILITIES.get(cap)
+        if spec is None:
+            out.append(Finding(
+                "GL02", SERVER_PATH, ln,
+                f"SETVOLUME advertises capability {cap!r} but "
+                "tables.CAPABILITIES does not declare it — say where "
+                "the client checks it (or why it never must)"))
+        elif spec == "checked" and cap not in checked:
+            out.append(Finding(
+                "GL02", CLIENT_PATH, 1,
+                f"capability {cap!r} is advertised at SETVOLUME but "
+                "protocol/client.py never reads it from the handshake "
+                "reply — the feature it gates can never arm"))
+    for cap in sorted(set(tables.CAPABILITIES) - set(advertised)):
+        out.append(Finding(
+            "GL02", SERVER_PATH, 1,
+            f"stale tables.CAPABILITIES entry {cap!r}: the SETVOLUME "
+            "reply no longer advertises it"))
+    return out

@@ -1,0 +1,125 @@
+"""GL03 — async discipline: blocking calls inside ``async def``.
+
+Historical bug: PR 12's review moved two blocking waits off-loop
+(daemon teardown joins stalling the event loop); the 2-core chaos host
+turns any such stall directly into serving-p99.
+
+Flagged inside ``async def`` bodies (nested sync ``def``/``lambda``
+bodies are their own scope and exempt):
+
+* ``time.sleep(...)``
+* ``subprocess.run / call / check_call / check_output`` (``Popen``
+  construction is spawn-and-return and allowed)
+* non-awaited ``.wait(...)`` / ``.communicate(...)`` — the blocking
+  subprocess shapes; awaited forms (``await proc.wait()``) and calls
+  passed into asyncio wrappers (``wait_for``/``shield``/
+  ``ensure_future``/``create_task``/``gather``/``to_thread``) are the
+  async forms and pass
+* zero-argument ``.join()`` (thread/process join; ``sep.join(it)`` and
+  ``os.path.join(a, b)`` always carry arguments)
+* zero-argument ``.result()`` (a concurrent.futures block; asyncio
+  futures are awaited, not ``.result()``-polled)
+
+The remedy is ``await asyncio.to_thread(...)`` (or the asyncio-native
+primitive); a deliberate block carries a pragma with its reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import dotted
+from .engine import Finding, RepoIndex
+
+_BLOCKING_SUBPROCESS = {"subprocess.run", "subprocess.call",
+                        "subprocess.check_call",
+                        "subprocess.check_output"}
+_ASYNC_WRAPPERS = {"wait_for", "shield", "ensure_future", "create_task",
+                   "gather", "to_thread", "run_coroutine_threadsafe",
+                   "wait", "as_completed", "timeout", "timeout_at"}
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[Finding] = []
+        self._async_depth = 0
+        self._exempt: set[int] = set()  # node ids inside wrappers/awaits
+
+    # -- scope tracking ----------------------------------------------------
+
+    def visit_AsyncFunctionDef(self, node):
+        self._async_depth += 1
+        self.generic_visit(node)
+        self._async_depth -= 1
+
+    def visit_FunctionDef(self, node):
+        saved, self._async_depth = self._async_depth, 0
+        self.generic_visit(node)
+        self._async_depth = saved
+
+    def visit_Lambda(self, node):
+        saved, self._async_depth = self._async_depth, 0
+        self.generic_visit(node)
+        self._async_depth = saved
+
+    # -- exemption marking -------------------------------------------------
+
+    def visit_Await(self, node):
+        if isinstance(node.value, ast.Call):
+            self._exempt.add(id(node.value))
+        self.generic_visit(node)
+
+    def _mark_wrapper_args(self, call: ast.Call) -> None:
+        name = dotted(call.func)
+        if name.split(".")[-1] in _ASYNC_WRAPPERS:
+            for a in list(call.args) + [k.value for k in call.keywords]:
+                for n in ast.walk(a):
+                    if isinstance(n, ast.Call):
+                        self._exempt.add(id(n))
+
+    # -- the check ---------------------------------------------------------
+
+    def visit_Call(self, node):
+        self._mark_wrapper_args(node)
+        if self._async_depth and id(node) not in self._exempt:
+            self._flag(node)
+        self.generic_visit(node)
+
+    def _flag(self, node: ast.Call) -> None:
+        name = dotted(node.func)
+        msg = None
+        if name == "time.sleep":
+            msg = "time.sleep blocks the event loop — use " \
+                  "await asyncio.sleep"
+        elif name in _BLOCKING_SUBPROCESS:
+            msg = f"{name} blocks until the child exits — use " \
+                  "asyncio.create_subprocess_exec or " \
+                  "await asyncio.to_thread(...)"
+        elif isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            nargs = len(node.args) + len(node.keywords)
+            if attr in ("wait", "communicate"):
+                msg = f".{attr}() here is the blocking form — await " \
+                      "it, wrap it in an asyncio primitive, or move " \
+                      "it off-loop with await asyncio.to_thread(...)"
+            elif attr in ("join", "result") and nargs == 0:
+                msg = f".{attr}() with no arguments is a blocking " \
+                      "thread/future primitive — move it off-loop " \
+                      "(await asyncio.to_thread) or await the " \
+                      "asyncio-native form"
+        if msg is not None:
+            self.findings.append(Finding(
+                "GL03", self.path, node.lineno,
+                f"blocking call inside async def: {msg}"))
+
+
+def check(idx: RepoIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in idx.code.values():
+        if sf.tree is None:
+            continue
+        v = _Visitor(sf.path)
+        v.visit(sf.tree)
+        out.extend(v.findings)
+    return out

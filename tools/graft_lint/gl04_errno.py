@@ -1,0 +1,97 @@
+"""GL04 — errno discipline.
+
+Historical bugs: PR 11's linkto-marker gate compared ``e.errno`` on a
+``FopError`` (the OSError alias is the WRONG field contract here —
+``FopError.err`` is the codebase's op_errno), and PR 9 shipped a bare
+``110`` where ``errno.ETIMEDOUT`` was meant.
+
+Flagged:
+
+* ``<var>.errno`` where ``<var>`` is bound by an ``except`` clause that
+  names ``FopError`` (catching plain OSError keeps ``.errno``);
+* ``FopError(<int literal>, ...)`` — raise with ``errno.<NAME>``;
+* comparisons of an ``.err`` / ``.errno`` attribute against a bare
+  integer literal (``e.err == 2`` reads as line noise; ``errno.ENOENT``
+  reads as intent).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import dotted
+from .engine import Finding, RepoIndex
+
+
+def _names_fop_error(type_node: ast.AST | None) -> bool:
+    if type_node is None:
+        return False
+    nodes = type_node.elts if isinstance(type_node, ast.Tuple) \
+        else [type_node]
+    return any(dotted(n).split(".")[-1] == "FopError" for n in nodes)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[Finding] = []
+        self._fop_err_vars: list[str] = []  # stack of handler var names
+
+    def visit_ExceptHandler(self, node):
+        is_fop = _names_fop_error(node.type) and node.name is not None
+        if is_fop:
+            self._fop_err_vars.append(node.name)
+        self.generic_visit(node)
+        if is_fop:
+            self._fop_err_vars.pop()
+
+    def visit_Attribute(self, node):
+        if node.attr == "errno" and isinstance(node.value, ast.Name) \
+                and node.value.id in self._fop_err_vars:
+            self.findings.append(Finding(
+                "GL04", self.path, node.lineno,
+                f"'{node.value.id}.errno' on a FopError — the "
+                "codebase contract is '.err' (op_errno); .errno is "
+                "the OSError alias and reads as the wrong plane"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if dotted(node.func).split(".")[-1] == "FopError" and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, int) \
+                    and not isinstance(a.value, bool) and a.value != 0:
+                self.findings.append(Finding(
+                    "GL04", self.path, node.lineno,
+                    f"bare integer errno {a.value} in FopError(...) — "
+                    "use errno.<NAME> so the intent survives review "
+                    "(the PR-9 bare-110 class)"))
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        sides = [node.left] + list(node.comparators)
+        has_err_attr = any(
+            isinstance(s, ast.Attribute) and s.attr in ("err", "errno")
+            for s in sides)
+        bad_int = next(
+            (s for s in sides
+             if isinstance(s, ast.Constant) and isinstance(s.value, int)
+             and not isinstance(s.value, bool) and s.value > 0), None)
+        if has_err_attr and bad_int is not None and all(
+                isinstance(op, (ast.Eq, ast.NotEq, ast.In, ast.NotIn))
+                for op in node.ops):
+            self.findings.append(Finding(
+                "GL04", self.path, node.lineno,
+                f"errno attribute compared against bare integer "
+                f"{bad_int.value} — use errno.<NAME>"))
+        self.generic_visit(node)
+
+
+def check(idx: RepoIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in idx.code.values():
+        if sf.tree is None:
+            continue
+        v = _Visitor(sf.path)
+        v.visit(sf.tree)
+        out.extend(v.findings)
+    return out

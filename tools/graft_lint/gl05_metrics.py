@@ -1,0 +1,155 @@
+"""GL05 — metrics-family discipline.
+
+Historical bugs: the PR-8 review caught mesh families whose identical
+label sets from two codec instances would collide in the exposition
+(a ``{codec}`` label had to be added), and several PRs hand-verified
+that family names asserted in tests/ci actually exist in the source.
+
+Sub-checks:
+
+1. every ``gftpu_*`` family is REGISTERED exactly once (a second
+   registration call silently replaces the first — last-import-wins);
+   registration is a registry call (``register`` /
+   ``register_objects`` / ``counter`` / ``gauge``) or a synthesized
+   snapshot entry (``merged["gftpu_x"] = {"type": ...}`` — the gateway
+   supervisor's aggregation shape);
+2. label-key consistency: the literal label dicts inside one
+   registration's collector must share one key set (mixed key sets in
+   one family break Prometheus scrapers);
+3. every ``gftpu_*`` reference outside a registration — tests, docs,
+   tools, code — names a registered family or a family-group prefix
+   (``gftpu_rebalance_*``), so an assertion can never pin a family
+   that does not exist.  ``ContextVar("gftpu_...")`` names are not
+   families and are auto-exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from . import tables
+from .astutil import const_str, dotted, str_keys
+from .engine import Finding, RepoIndex
+
+_REG_METHODS = {"register", "register_objects", "counter", "gauge"}
+_FAMILY_RE = re.compile(r"gftpu_[a-z0-9_]*[a-z0-9]")
+
+
+def _registrations(sf) -> list[tuple[str, int, ast.AST]]:
+    """(family, line, node) for registry calls AND synthesized
+    snapshot-dict assignments."""
+    out = []
+    for n in ast.walk(sf.tree):
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr in _REG_METHODS and n.args:
+            name = const_str(n.args[0])
+            if name is not None and name.startswith("gftpu_"):
+                out.append((name, n.lineno, n))
+        elif isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Subscript) and \
+                isinstance(n.value, ast.Dict):
+            key = const_str(n.targets[0].slice)
+            vkeys = str_keys(n.value)
+            if key is not None and key.startswith("gftpu_") and \
+                    vkeys is not None and "type" in vkeys:
+                out.append((key, n.lineno, n))
+    return out
+
+
+def _nonfamily_strings(tree: ast.Module) -> set[int]:
+    """ids of string nodes that name ContextVars, not families."""
+    out = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and \
+                dotted(n.func).split(".")[-1] == "ContextVar" and n.args:
+            out.add(id(n.args[0]))
+    return out
+
+
+def check(idx: RepoIndex) -> list[Finding]:
+    out: list[Finding] = []
+    registered: dict[str, list[tuple[str, int]]] = {}
+    reg_strings: set[int] = set()  # ids of registration name nodes
+
+    # 1. registration census (tests count for resolution, never for
+    # the duplicate check: test-local fixture families may repeat) ----
+    def census(sf, report: bool):
+        for name, line, node in _registrations(sf):
+            if report:
+                registered.setdefault(name, []).append((sf.path, line))
+            else:
+                registered.setdefault(name, [])
+            if isinstance(node, ast.Call):
+                reg_strings.add(id(node.args[0]))
+            # 2. label-key consistency inside this registration
+            key_sets = {}
+            for n in ast.walk(node):
+                if isinstance(n, ast.Dict) and n is not getattr(
+                        node, "value", None):
+                    keys = str_keys(n)
+                    if keys is not None and keys and \
+                            "type" not in keys:
+                        key_sets.setdefault(frozenset(keys), n.lineno)
+            if report and len(key_sets) > 1:
+                shapes = " vs ".join(
+                    "{" + ",".join(sorted(ks)) + "}"
+                    for ks in sorted(key_sets, key=sorted))
+                out.append(Finding(
+                    "GL05", sf.path, line,
+                    f"family {name!r} emits samples with mixed label "
+                    f"key sets ({shapes}) — one family, one label "
+                    "schema (the mesh codec-label collision class)"))
+
+    for sf in idx.code.values():
+        if sf.tree is not None:
+            census(sf, report=True)
+    for sf in idx.tests.values():
+        if sf.tree is not None:
+            census(sf, report=False)
+    for name, sites in sorted(registered.items()):
+        if len(sites) > 1:
+            locs = ", ".join(f"{p}:{ln}" for p, ln in sites[1:])
+            out.append(Finding(
+                "GL05", sites[0][0], sites[0][1],
+                f"family {name!r} is registered {len(sites)} times "
+                f"(also at {locs}) — registration is last-wins, the "
+                "earlier collector silently disappears"))
+
+    fams = set(registered)
+
+    # 3. references resolve ------------------------------------------------
+    def resolve(token: str) -> bool:
+        if token in fams or token in tables.NON_FAMILY_LITERALS:
+            return True
+        # family-group prefix at an underscore boundary
+        # (docstrings say "the gftpu_rebalance_* families")
+        return any(f.startswith(token + "_") for f in fams)
+
+    for sf in idx.all_py().values():
+        if sf.tree is None or sf.path.startswith("tools/graft_lint/") \
+                or sf.path == "tests/test_graft_lint.py":
+            continue  # the linter and its fixture corpus name fake
+            # families on purpose
+        ctxvars = _nonfamily_strings(sf.tree)
+        for n in ast.walk(sf.tree):
+            s = const_str(n) if isinstance(n, ast.Constant) else None
+            if s is None or id(n) in reg_strings or id(n) in ctxvars:
+                continue
+            for token in _FAMILY_RE.findall(s):
+                if not resolve(token):
+                    out.append(Finding(
+                        "GL05", sf.path, n.lineno,
+                        f"reference to unregistered metrics family "
+                        f"{token!r} — the assertion (or exposition "
+                        "read) can never match a live registry"))
+    for path, text in idx.docs.items():
+        for i, line in enumerate(text.splitlines(), start=1):
+            for token in _FAMILY_RE.findall(line):
+                if not resolve(token):
+                    out.append(Finding(
+                        "GL05", path, i,
+                        f"doc references unregistered metrics family "
+                        f"{token!r}"))
+    return out

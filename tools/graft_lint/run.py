@@ -1,0 +1,61 @@
+"""graft-lint runner.
+
+Usage::
+
+    python tools/graft_lint/run.py [--json] [paths...]
+
+Exit codes: 0 clean, 1 findings, 2 internal error.  ``paths`` narrows
+the scan to the given repo-relative files/directories (cross-file
+checks that need files outside the narrowed set skip themselves);
+default is the whole tree.  ``--json`` prints a machine-readable
+finding list (the ci.sh stage-0 archive format).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="graft-lint")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative files/dirs to narrow the scan")
+    args = ap.parse_args(argv)
+
+    if str(REPO_ROOT) not in sys.path:
+        sys.path.insert(0, str(REPO_ROOT))
+    from tools.graft_lint import engine
+
+    t0 = time.monotonic()
+    try:
+        findings = engine.run(REPO_ROOT, args.paths or None)
+    except engine.NoFilesMatched as e:
+        print(f"graft-lint: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:  # noqa: BLE001 - runner must not masquerade
+        print(f"graft-lint: internal error: {e!r}", file=sys.stderr)
+        return 2
+    dt = time.monotonic() - t0
+    if args.json:
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "count": len(findings),
+            "seconds": round(dt, 2),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"graft-lint: {len(findings)} finding(s) in {dt:.1f}s")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# lint.sh — the local one-liner for the graft-lint suite (ci.sh runs
+# the same thing as stage 0).  Usage: tools/lint.sh [--json] [paths...]
+set -u
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python tools/graft_lint/run.py "$@"
