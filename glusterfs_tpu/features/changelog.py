@@ -131,6 +131,9 @@ class ChangelogLayer(Layer):
         fully covered by changelogs (changelog_history() in the
         reference returns ENOENT for such windows)."""
 
+        # runs on a to_thread worker; self._dir is safe to read there
+        # because it is immutable after init() — a declared graft-race
+        # ownership row (tables.OWNERSHIP["...ChangelogLayer._dir"])
         def scan():
             recs: list[dict] = []
             truncated = False

@@ -199,6 +199,11 @@ class FuseBridge:
         log.info(2, "unmounted %s", self.mountpoint)
 
     def _teardown(self) -> None:
+        # dev_fd is the split plane's cross-context sentinel: loop-side
+        # teardown writes -1, the reader/writer threads poll it to
+        # stand down (each thread owns and closes its ACTUAL fd).  The
+        # contract is a declared graft-race ownership row
+        # (tables.OWNERSHIP["...FuseBridge.dev_fd"], GL09).
         if self.dev_fd < 0:
             return
         if self.reader_split:

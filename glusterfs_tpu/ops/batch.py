@@ -184,7 +184,11 @@ class BatchingCodec(Codec):
         # parity-rows-only launch per flush
         self._delta_q: list[tuple] = []
         self._delta_task: asyncio.Task | None = None
-        self._cpu = None  # lazy small-batch codec
+        # lazy small-batch codec; CPU-ladder backends alias self HERE
+        # (pre-publication, against self.backend as RESOLVED by the
+        # base init) so _small()'s lazy build is the only
+        # cross-context write left — and that one is lock-serialized
+        self._cpu = None if self.backend in _DEVICE_BACKENDS else self
         self.launches = 0
         self.cpu_launches = 0
         self.batched_fops = 0
@@ -255,16 +259,19 @@ class BatchingCodec(Codec):
         return super().encode_delta(delta)
 
     def _small(self) -> Codec:
+        # double-checked under the codec lock: _route (loop) and
+        # _calibrate (flush-pool thread) race the first call, and an
+        # unserialized lazy build constructs the native codec twice —
+        # graft-race GL09 caught the unlocked cross-context write
         if self._cpu is None:
-            if self.backend in _DEVICE_BACKENDS:
-                try:
-                    self._cpu = Codec(self.k, self.r, "native",
-                                      systematic=self.systematic)
-                except RuntimeError:
-                    self._cpu = Codec(self.k, self.r, "ref",
-                                      systematic=self.systematic)
-            else:
-                self._cpu = self  # already a CPU ladder backend
+            with self._lock:
+                if self._cpu is None:
+                    try:
+                        self._cpu = Codec(self.k, self.r, "native",
+                                          systematic=self.systematic)
+                    except RuntimeError:
+                        self._cpu = Codec(self.k, self.r, "ref",
+                                          systematic=self.systematic)
         return self._cpu
 
     # -- mesh data plane ---------------------------------------------------
@@ -425,8 +432,13 @@ class BatchingCodec(Codec):
 
     def _maybe_schedule_calibration(self) -> None:
         """Debounced: start calibration after an idle gap, not under load."""
-        if self._cal_state != "idle" or self._cal_timer is not None:
-            return
+        # _cal_state is written by the pool thread (_calibrate) under
+        # the lock; this loop-side read takes it too (graft-race GL09:
+        # an unlocked read beside a cross-context writer) — one
+        # uncontended acquire on a path that already locks in _route
+        with self._lock:
+            if self._cal_state != "idle" or self._cal_timer is not None:
+                return
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:

@@ -179,6 +179,11 @@ _metrics.REGISTRY.register(
 # lock across the call costs little: the backend serializes on-device
 # execution anyway, and shape bucketing (ops/batch) bounds how often a
 # call is a compile at all.
+# graft-race GL07 machine-checks this extent now: the jit factories
+# are tables.KNOWN_LAZY rows and every lock-spans-the-call site below
+# is a declared tables.LAZY_UNDER_LOCK_OK row — shrinking the lock
+# back off the call fails lint instead of reintroducing the empty
+# critical region.
 _BUILD_LOCK = _threading.Lock()
 
 

@@ -145,7 +145,9 @@ def ring_decode(k: int, rows, frags: np.ndarray,
         x = np.concatenate(
             [x, np.zeros((pad, *x.shape[1:]), dtype=np.uint8)], axis=0)
     planes = np.ascontiguousarray(np.transpose(x, (1, 0, 2)))
-    with mesh_codec._BUILD_LOCK:  # jit is lazy: lock spans the call
+    # jit is lazy: the lock SPANS the call (a declared graft-race
+    # tables.LAZY_UNDER_LOCK_OK site — GL07 verifies the extent)
+    with mesh_codec._BUILD_LOCK:
         out = _ring_decode_fn(k, rows, mesh)(jnp.asarray(planes))
     out = np.asarray(out)[:s]              # (S, k*8, 64)
     return out.reshape(s * k * gf256.CHUNK_SIZE)

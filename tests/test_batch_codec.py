@@ -211,3 +211,61 @@ def test_ec_volume_concurrent_writes_coalesce(tmp_path):
     assert wl < 12, f"12 concurrent writes took {wl} launches (no coalescing)"
     for (p, d), got in zip(datas.items(), reads):
         assert got == d, p
+
+
+def test_small_codec_lazy_build_is_race_free():
+    """graft-race GL09 regression (ISSUE 14): _small()'s lazy native
+    codec used to be built with an UNLOCKED check-then-assign, and the
+    routing path (event loop) races the calibration path (flush-pool
+    thread) into it — two racers must converge on ONE codec instance,
+    built under the codec lock."""
+    import threading
+
+    codec = BatchingCodec(K, R, "xla", min_batch=1 << 20)
+    assert codec._cpu is None  # device backend: still lazy
+
+    built = []
+    start = threading.Barrier(8)
+
+    def race():
+        start.wait()
+        built.append(codec._small())
+
+    threads = [threading.Thread(target=race) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert len(built) == 8
+    assert all(b is built[0] for b in built), \
+        "racing _small() calls built more than one small codec"
+    assert built[0] is not codec  # device backend got a CPU sibling
+    # CPU-ladder backends alias self at construction (pre-publication):
+    # no lazy cross-context write exists at all
+    cpu = BatchingCodec(K, R, "native", min_batch=1 << 20)
+    assert cpu._cpu is cpu
+
+
+def test_calibration_schedule_check_is_locked():
+    """graft-race GL09 regression (ISSUE 14): the debounce check read
+    _cal_state WITHOUT the lock while _calibrate (pool thread) writes
+    it under the lock; the locked read must still debounce — exactly
+    one timer per idle gap, and a non-idle state schedules nothing."""
+    codec = BatchingCodec(K, R, "xla", min_batch=1 << 20)
+
+    async def run():
+        codec._maybe_schedule_calibration()
+        t1 = codec._cal_timer
+        codec._maybe_schedule_calibration()  # debounced: same timer
+        t2 = codec._cal_timer
+        with codec._lock:
+            codec._cal_state = "done"
+        t1.cancel()
+        codec._cal_timer = None
+        codec._maybe_schedule_calibration()  # non-idle: no new timer
+        t3 = codec._cal_timer
+        return t1, t2, t3
+
+    t1, t2, t3 = asyncio.run(run())
+    assert t1 is t2 and t1 is not None
+    assert t3 is None

@@ -12,6 +12,7 @@ real tree — tier-1's enforcement of the ci.sh stage-0 contract.
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -143,7 +144,8 @@ def test_gl03_async_native_forms_are_clean(tmp_path):
             '    await proc.wait()\n'
             '    await asyncio.wait_for(ev.wait(), 1.0)\n'
             '    await asyncio.to_thread(proc.wait, timeout=5)\n'
-            '    asyncio.ensure_future(ev.wait())\n'
+            '    bg = asyncio.ensure_future(ev.wait())\n'
+            '    await bg\n'  # retained: GL08 must stay quiet too
             '    os.path.join("a", "b")\n'
             '    ",".join(["a"])\n'
             'def g():\n'
@@ -228,6 +230,527 @@ def test_gl05_mixed_label_schema(tmp_path):
     assert any("mixed label key sets" in f.message for f in found), found
 
 
+# -- GL06: loop/thread boundary discipline (graft-race) ----------------
+
+# a miniature hybrid runtime: one thread entry, one loop entry, shared
+# helpers — the ctxgraph reachability shapes the real planes use
+_HYBRID = '''
+import asyncio
+import threading
+
+
+class Plane:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def spawn(self):
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    async def serve(self):
+        pass
+
+{body}
+'''
+
+
+def test_gl06_thread_touching_loop_apis(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py": _HYBRID.format(body='''
+    def _worker(self):
+        loop = asyncio.get_event_loop()
+        t = loop.create_task(self.serve())
+        t.add_done_callback(print)
+''')})
+    found = [f for f in engine.run(root) if f.code == "GL06"]
+    assert any("create_task" in f.message and
+               "thread-reachable" in f.message for f in found), found
+
+
+def test_gl06_threadsafe_reentry_is_clean(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/good.py": _HYBRID.format(body='''
+    def _worker(self):
+        loop = asyncio.get_event_loop()
+        loop.call_soon_threadsafe(self._on_loop)
+
+    def _on_loop(self):
+        t = asyncio.get_event_loop().create_task(self.serve())
+        self._bg = t
+''')})
+    assert engine.run(root) == []
+
+
+def test_gl06_future_resolve_from_thread(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py": _HYBRID.format(body='''
+    def _worker(self):
+        self.fut.set_result(1)
+''')})
+    found = [f for f in engine.run(root) if f.code == "GL06"]
+    assert any("set_result" in f.message for f in found), found
+
+
+def test_gl06_loop_reachable_sync_block(tmp_path):
+    # the reachability gap GL03 cannot see: the block lives in a SYNC
+    # helper, only reachable from async code
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py": _HYBRID.format(body='''
+    def _helper(self, fut):
+        return fut.result()
+
+    async def caller(self):
+        return self._helper(None)
+''')})
+    found = [f for f in engine.run(root) if f.code == "GL06"]
+    assert any(".result() blocks" in f.message and
+               "loop-reachable via" in f.message for f in found), found
+
+
+def test_gl06_forwarded_submit_payload_gets_thread_ctx(tmp_path):
+    # one-hop higher-order handoff: _submit(fn) -> pool.submit(fn) —
+    # the forwarder fixpoint must classify the payload as thread code
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py": _HYBRID.format(body='''
+    def _submit(self, fn):
+        self._pool.submit(fn)
+
+    def _payload(self):
+        asyncio.get_event_loop().create_task(self.serve())
+
+    async def flush(self):
+        self._submit(self._payload)
+''')})
+    found = [f for f in engine.run(root) if f.code == "GL06"]
+    assert any("create_task" in f.message for f in found), found
+
+
+def test_gl06_cf_future_done_callback_is_not_loop_context(tmp_path):
+    # concurrent.futures runs done-callbacks in the COMPLETING worker
+    # thread; only provably-asyncio receivers seed loop context — a
+    # blocking call in a pool-future callback must NOT read as
+    # blocking the loop (review catch)
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/good.py": _HYBRID.format(body='''
+    async def kick(self):
+        pf = self._pool.submit(self._work)
+        pf.add_done_callback(self._after)
+        t = asyncio.create_task(self.serve())
+        t.add_done_callback(self._on_loop_done)
+        self._bg = t
+
+    def _work(self):
+        pass
+
+    def _after(self, pf):
+        pf.result()  # completing-thread callback: blocking is fine
+
+    def _on_loop_done(self, t):
+        self.done = True
+''')})
+    found = engine.run(root)
+    assert not any(".result() blocks" in f.message
+                   for f in found), found
+
+
+def test_gl06_task_done_callback_gets_loop_context(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py": _HYBRID.format(body='''
+    async def kick(self, fut):
+        t = asyncio.create_task(self.serve())
+        t.add_done_callback(self._on_loop_done)
+        self._bg = t
+
+    def _on_loop_done(self, t):
+        import time
+        time.sleep(1)  # runs ON the loop: a real stall
+''')})
+    found = [f for f in engine.run(root) if f.code == "GL06"]
+    assert any("time.sleep" in f.message and
+               "loop-reachable" in f.message for f in found), found
+
+
+def test_gl06_stale_ctx_table_entry(tmp_path, monkeypatch):
+    from tools.graft_lint import tables
+    monkeypatch.setattr(tables, "CTX_THREAD_ENTRY", {
+        "glusterfs_tpu/x.py::gone": "was a dynamic dispatch target"})
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/x.py": "def still_here():\n    pass\n"})
+    found = [f for f in engine.run(root) if f.code == "GL06"]
+    assert any("stale tables.CTX_THREAD_ENTRY" in f.message
+               for f in found), found
+
+
+def test_gl06_declared_thread_entry_arms_the_checker(tmp_path,
+                                                     monkeypatch):
+    from tools.graft_lint import tables
+    monkeypatch.setattr(tables, "CTX_THREAD_ENTRY", {
+        "glusterfs_tpu/x.py::dispatched":
+            "registered into a dispatch dict, spawned elsewhere"})
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/x.py":
+            'import asyncio\n'
+            'def dispatched():\n'
+            '    asyncio.get_event_loop().create_task(_noop())\n'
+            'async def _noop():\n'
+            '    pass\n'})
+    found = [f for f in engine.run(root) if f.code == "GL06"]
+    assert any("create_task" in f.message for f in found), found
+
+
+# -- GL07: lock discipline ---------------------------------------------
+
+
+def test_gl07_await_under_threading_lock(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py": _HYBRID.format(body='''
+    async def flush(self):
+        with self._lock:
+            await asyncio.sleep(0.1)
+''')})
+    found = [f for f in engine.run(root) if f.code == "GL07"]
+    assert any("await while holding threading lock" in f.message
+               for f in found), found
+
+
+def test_gl07_release_before_await_is_clean(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/good.py": _HYBRID.format(body='''
+    async def flush(self):
+        with self._lock:
+            batch = [1]
+        await asyncio.sleep(0.1)
+        return batch
+
+    def _worker(self):
+        with self._lock:
+            pass
+''')})
+    assert engine.run(root) == []
+
+
+def test_gl07_known_lazy_under_lock_and_declared_site(tmp_path,
+                                                      monkeypatch):
+    from tools.graft_lint import tables
+    src = _HYBRID.format(body='''
+    def _worker(self):
+        with self._lock:
+            jitted_fn(1, 2)
+
+def jitted_fn(a, b):
+    return a + b
+''')
+    monkeypatch.setattr(tables, "KNOWN_LAZY",
+                        {"jitted_fn": "fixture: compiles on call"})
+    monkeypatch.setattr(tables, "LAZY_UNDER_LOCK_OK", {})
+    root = _mini_repo(tmp_path, {"glusterfs_tpu/bad.py": src})
+    found = [f for f in engine.run(root) if f.code == "GL07"]
+    assert any("known-lazy callable 'jitted_fn'" in f.message
+               for f in found), found
+    # the declared-deliberate site suppresses exactly that finding
+    monkeypatch.setattr(tables, "LAZY_UNDER_LOCK_OK", {
+        "glusterfs_tpu/bad.py::Plane._worker::jitted_fn":
+            "fixture: serializing the compile is the design"})
+    assert [f for f in engine.run(root) if f.code == "GL07"] == []
+    # ...and the declaration VERIFIES the lock extent: remove the
+    # lock from the site and the entry goes stale (the PR-8
+    # empty-critical-region regression, machine-checked)
+    root2 = _mini_repo(tmp_path / "unlocked", {
+        "glusterfs_tpu/bad.py": _HYBRID.format(body='''
+    def _worker(self):
+        jitted_fn(1, 2)
+
+def jitted_fn(a, b):
+    return a + b
+''')})
+    found = [f for f in engine.run(root2) if f.code == "GL07"]
+    assert any("stale tables.LAZY_UNDER_LOCK_OK" in f.message and
+               "no longer holds a lock" in f.message
+               for f in found), found
+
+
+def test_gl07_lock_order_cycle(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/order.py":
+            'import threading\n'
+            'A = threading.Lock()\n'
+            'B = threading.Lock()\n'
+            'def one():\n'
+            '    with A:\n'
+            '        with B:\n'
+            '            pass\n'
+            'def two():\n'
+            '    with B:\n'
+            '        with A:\n'
+            '            pass\n'})
+    found = [f for f in engine.run(root) if f.code == "GL07"]
+    assert any("lock-order cycle" in f.message for f in found), found
+
+
+def test_gl07_consistent_lock_order_is_clean(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/order.py":
+            'import threading\n'
+            'A = threading.Lock()\n'
+            'B = threading.Lock()\n'
+            'def one():\n'
+            '    with A:\n'
+            '        with B:\n'
+            '            pass\n'
+            'def two():\n'
+            '    with A:\n'
+            '        with B:\n'
+            '            pass\n'})
+    assert engine.run(root) == []
+
+
+def test_gl07_cycle_through_same_file_call(tmp_path):
+    # A held while calling a function that takes B, and vice versa —
+    # the acquisition edge flows through the call graph
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/order.py":
+            'import threading\n'
+            'A = threading.Lock()\n'
+            'B = threading.Lock()\n'
+            'def take_b():\n'
+            '    with B:\n'
+            '        pass\n'
+            'def take_a():\n'
+            '    with A:\n'
+            '        pass\n'
+            'def one():\n'
+            '    with A:\n'
+            '        take_b()\n'
+            'def two():\n'
+            '    with B:\n'
+            '        take_a()\n'})
+    found = [f for f in engine.run(root) if f.code == "GL07"]
+    assert any("lock-order cycle" in f.message for f in found), found
+
+
+# -- GL08: task/future lifecycle ---------------------------------------
+
+
+def test_gl08_discarded_and_unused_task(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py":
+            'import asyncio\n'
+            'async def a(coro):\n'
+            '    asyncio.get_event_loop().create_task(coro)\n'
+            'async def b(coro):\n'
+            '    t = asyncio.create_task(coro)\n'
+            '    return None\n'})
+    found = [f for f in engine.run(root) if f.code == "GL08"]
+    assert any("result discarded" in f.message for f in found), found
+    assert any("never used" in f.message for f in found), found
+
+
+def test_gl08_retained_tasks_are_clean(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/good.py":
+            'import asyncio\n'
+            'class S:\n'
+            '    async def a(self, coro):\n'
+            '        t = asyncio.create_task(coro)\n'
+            '        self._bg.add(t)\n'
+            '        t.add_done_callback(self._bg.discard)\n'
+            '    async def b(self, coro):\n'
+            '        await asyncio.create_task(coro)\n'
+            '    async def c(self, coro):\n'
+            '        self._t = asyncio.create_task(coro)\n'
+            '    async def d(self, coro):\n'
+            '        return asyncio.create_task(coro)\n'})
+    assert engine.run(root) == []
+
+
+def test_gl08_future_unresolved_on_exception_edge(tmp_path):
+    # the PR-7 shape: set_result in a try, handler swallows without
+    # resolving — the awaiting side wedges forever
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py":
+            'import asyncio\n'
+            'async def f(fn):\n'
+            '    fut = asyncio.get_event_loop().create_future()\n'
+            '    try:\n'
+            '        fut.set_result(fn())\n'
+            '    except Exception:\n'
+            '        pass\n'
+            '    return 1\n'})
+    found = [f for f in engine.run(root) if f.code == "GL08"]
+    assert any("unresolved" in f.message for f in found), found
+
+
+def test_gl08_future_resolved_both_edges_is_clean(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/good.py":
+            'import asyncio\n'
+            'async def f(fn):\n'
+            '    fut = asyncio.get_event_loop().create_future()\n'
+            '    try:\n'
+            '        fut.set_result(fn())\n'
+            '    except BaseException as e:\n'
+            '        fut.set_exception(e)\n'
+            '    return 1\n'})
+    assert engine.run(root) == []
+
+
+def test_gl08_escaped_future_is_owners_problem(tmp_path):
+    # handing the future off (stored/passed/returned) transfers
+    # ownership — no finding even though this function never resolves
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/good.py":
+            'import asyncio\n'
+            'async def f(q):\n'
+            '    fut = asyncio.get_event_loop().create_future()\n'
+            '    q.append(fut)\n'
+            '    await fut\n'
+            'async def g():\n'
+            '    fut = asyncio.get_event_loop().create_future()\n'
+            '    return fut\n'})
+    assert engine.run(root) == []
+
+
+def test_gl08_creation_nested_in_compound_statements(tmp_path):
+    # the creation itself sits INSIDE a try / an if body — the flow
+    # walk must still track it (review catch: the old walk only saw
+    # top-level creations)
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py":
+            'import asyncio\n'
+            'async def f(fn, loop):\n'
+            '    try:\n'
+            '        fut = loop.create_future()\n'
+            '        fut.set_result(fn())\n'
+            '    except Exception:\n'
+            '        pass\n'
+            '    return 1\n'
+            'async def g(ok, loop):\n'
+            '    if ok:\n'
+            '        fut = loop.create_future()\n'
+            '    return 2\n'})
+    found = [f for f in engine.run(root) if f.code == "GL08"]
+    assert sum("unresolved" in f.message for f in found) == 2, found
+
+
+def test_gl08_creation_nested_and_resolved_is_clean(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/good.py":
+            'import asyncio\n'
+            'async def f(fn, loop):\n'
+            '    try:\n'
+            '        fut = loop.create_future()\n'
+            '        fut.set_result(fn())\n'
+            '    except Exception as e:\n'
+            '        fut.set_exception(e)\n'
+            '    return 1\n'
+            'async def g(ok, loop):\n'
+            '    if ok:\n'
+            '        fut = loop.create_future()\n'
+            '        fut.cancel()\n'
+            '    return 2\n'})
+    assert engine.run(root) == []
+
+
+def test_gl08_branch_missing_resolve(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py":
+            'import asyncio\n'
+            'async def f(ok):\n'
+            '    fut = asyncio.get_event_loop().create_future()\n'
+            '    if ok:\n'
+            '        fut.set_result(1)\n'
+            '    return 2\n'})
+    found = [f for f in engine.run(root) if f.code == "GL08"]
+    assert any("unresolved" in f.message for f in found), found
+
+
+# -- GL09: shared-state ownership --------------------------------------
+
+
+def test_gl09_undeclared_cross_context_attr(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py": _HYBRID.format(body='''
+    def _worker(self):
+        self.state = "ready"
+
+    async def poll(self):
+        return self.state
+''')})
+    found = [f for f in engine.run(root) if f.code == "GL09"]
+    assert any("Plane.state" in f.message and
+               "tables.OWNERSHIP" in f.message for f in found), found
+
+
+def test_gl09_lock_protected_is_machine_verified(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/good.py": _HYBRID.format(body='''
+    def _worker(self):
+        with self._lock:
+            self.state = "ready"
+
+    async def poll(self):
+        with self._lock:
+            return self.state
+''')})
+    assert engine.run(root) == []
+
+
+def test_gl09_constructor_writes_are_pre_publication(tmp_path):
+    # __init__ writes + cross-context reads = immutable-after-start,
+    # auto-passed without a declaration
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/good.py": _HYBRID.format(body='''
+    def _worker(self):
+        return self._lock
+
+    async def poll(self):
+        return self._lock
+''')})
+    assert engine.run(root) == []
+
+
+def test_gl09_declared_ownership_passes_and_stale_entry_fails(
+        tmp_path, monkeypatch):
+    from tools.graft_lint import tables
+    src = _HYBRID.format(body='''
+    def _worker(self):
+        self.state = "ready"
+
+    async def poll(self):
+        return self.state
+''')
+    monkeypatch.setattr(tables, "OWNERSHIP", {
+        "glusterfs_tpu/bad.py::Plane.state": (
+            "threadsafe-handoff", "fixture: GIL-atomic str")})
+    root = _mini_repo(tmp_path, {"glusterfs_tpu/bad.py": src})
+    assert engine.run(root) == []
+    # the attribute disappears -> the entry is stale -> finding
+    root2 = _mini_repo(tmp_path / "second", {
+        "glusterfs_tpu/bad.py": _HYBRID.format(body='''
+    def _worker(self):
+        pass
+''')})
+    found = [f for f in engine.run(root2) if f.code == "GL09"]
+    assert any("stale tables.OWNERSHIP" in f.message
+               for f in found), found
+
+
+def test_gl09_bogus_classification_is_a_finding(tmp_path, monkeypatch):
+    from tools.graft_lint import tables
+    monkeypatch.setattr(tables, "OWNERSHIP", {
+        "glusterfs_tpu/bad.py::Plane.state": (
+            "hope", "fixture: not a real classification")})
+    root = _mini_repo(tmp_path, {
+        "glusterfs_tpu/bad.py": _HYBRID.format(body='''
+    def _worker(self):
+        self.state = "ready"
+
+    async def poll(self):
+        return self.state
+''')})
+    found = [f for f in engine.run(root) if f.code == "GL09"]
+    assert any("not one of" in f.message for f in found), found
+
+
 # -- GL00: the pragma plane checks itself ------------------------------
 
 
@@ -287,6 +810,113 @@ def test_whole_tree_is_clean_and_fast():
     assert out.returncode == 0, payload["findings"]
     assert payload["count"] == 0, payload["findings"]
     assert payload["seconds"] < 30, payload["seconds"]
+    # per-checker timing rides the archived json (ci.sh stage 0): a
+    # slow checker must be visible before it eats the 30s budget
+    per = payload["checker_seconds"]
+    for code in ("GL01", "GL02", "GL03", "GL04", "GL05",
+                 "GL06", "GL07", "GL08", "GL09", "parse"):
+        assert code in per, per
+    assert all(isinstance(v, float) for v in per.values()), per
+
+
+def test_declared_table_paths_exist():
+    # a table row whose declared FILE was deleted or renamed would
+    # silently survive the in-checker stale detection (the checker
+    # cannot tell a missing file from a narrowed fixture scan), so the
+    # real tree pins it here: every path-keyed declaration must point
+    # at a live file
+    keyed = []
+    for table in (tables.CTX_THREAD_ENTRY, tables.CTX_LOOP_ENTRY,
+                  tables.THREADSAFE_FUTURE_RESOLVE,
+                  tables.LAZY_UNDER_LOCK_OK, tables.OWNERSHIP):
+        keyed.extend(table.keys())
+    keyed.extend(tables.FENCES.keys())
+    missing = [k for k in keyed
+               if not (REPO_ROOT / k.split("::")[0]).is_file()]
+    assert missing == [], missing
+
+
+def test_module_entry_point():
+    # python -m tools.graft_lint — no path games
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graft_lint",
+         "glusterfs_tpu/core/fops.py"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_changed_mode_clean_worktree_and_synthetic_change(tmp_path):
+    # on a clean worktree --changed scans nothing and exits 0...
+    probe = subprocess.run(
+        ["git", "status", "--porcelain"], capture_output=True,
+        text=True, cwd=REPO_ROOT, timeout=30)
+    if probe.returncode != 0:
+        pytest.skip("not a git worktree")
+    if any(ln and not ln.startswith("??") for ln in
+           probe.stdout.splitlines()):
+        pytest.skip("dirty worktree: --changed output is not stable")
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graft_lint", "--json",
+         "--changed"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["count"] == 0
+
+
+def test_changed_mode_narrows_to_the_modified_file(tmp_path):
+    # the synthetic-change half: a throwaway git repo with one clean
+    # commit, then a GL03 defect lands in a file — --changed must scan
+    # exactly that file (plus the table anchors) and report it
+    def git(*args):
+        r = subprocess.run(["git", *args], cwd=tmp_path,
+                           capture_output=True, text=True, timeout=30)
+        assert r.returncode == 0, r.stderr
+        return r.stdout
+
+    _mini_repo(tmp_path, {
+        "glusterfs_tpu/mod.py": "def f():\n    pass\n",
+        "glusterfs_tpu/other.py": "def g():\n    pass\n"})
+    git("init", "-q")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "add", "-A")
+    git("-c", "user.email=t@t", "-c", "user.name=t",
+        "commit", "-qm", "clean")
+    (tmp_path / "glusterfs_tpu/mod.py").write_text(
+        "import time\nasync def f():\n    time.sleep(1)\n")
+    env = dict(os.environ, GRAFT_LINT_ROOT=str(tmp_path))
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graft_lint", "--json",
+         "--changed"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT,
+        env=env)
+    payload = json.loads(out.stdout)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert any(f["code"] == "GL03" and f["path"] ==
+               "glusterfs_tpu/mod.py" for f in payload["findings"]), \
+        payload
+    assert "glusterfs_tpu/mod.py" in payload["changed"]
+    assert "glusterfs_tpu/other.py" not in payload["changed"]
+
+
+def test_narrowed_run_with_cross_file_lock_has_no_stale_noise():
+    # regression: ring_codec acquires mesh_codec._BUILD_LOCK across
+    # files; a narrowed scan that cannot SEE mesh_codec must not read
+    # the declared LAZY_UNDER_LOCK_OK row as stale (stale-entry checks
+    # are full-tree only)
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graft_lint",
+         "glusterfs_tpu/parallel/ring_codec.py"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_changed_mode_rejects_explicit_paths():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graft_lint", "--changed",
+         "glusterfs_tpu"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
+    assert out.returncode == 2
+    assert "mutually exclusive" in out.stderr
 
 
 def test_runner_narrowed_paths_and_exit_code(tmp_path):

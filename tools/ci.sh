@@ -90,9 +90,15 @@ else:
 PYEOF
     exit $lint_rc
 fi
-echo "ci: lint clean ($(python -c "import json; \
-d=json.load(open('/tmp/gftpu-ci/graft_lint.json')); \
-print(d['seconds'])")s, archived)"
+python - <<'PYEOF'
+import json
+d = json.load(open("/tmp/gftpu-ci/graft_lint.json"))
+per = d.get("checker_seconds", {})
+slow = sorted(per.items(), key=lambda kv: -kv[1])[:3]
+pretty = ", ".join(f"{k} {v:.1f}s" for k, v in slow)
+print(f"ci: lint clean ({d['seconds']}s of a 30s budget; "
+      f"slowest: {pretty}; archived with per-checker timings)")
+PYEOF
 
 echo "== ci: flake gate (tier-1 x2) =="
 tools/flake_gate.sh "$@"

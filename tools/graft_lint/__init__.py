@@ -20,6 +20,27 @@ with the historical bug behind each id):
   registered exactly once, label-key consistency, references in
   tests/docs resolve to registered families.
 
+The graft-race suite (GL06-GL09) adds flow- and context-sensitive
+concurrency checks over a shared execution-context reachability
+analysis (:mod:`ctxgraph` — thread entries from Thread targets /
+executor submits / the declarative tables, loop entries from ``async
+def`` and loop-callback registration, propagated through the call
+graph):
+
+* **GL06** loop/thread boundary discipline: thread-context code must
+  reach the loop only through ``call_soon_threadsafe`` /
+  ``run_coroutine_threadsafe``; loop-reachable sync code must not
+  block on concurrent futures / sleeps / child processes.
+* **GL07** lock discipline: no ``await`` (or known-lazy first-call
+  compile) while holding a ``threading.Lock``; the per-class lock
+  acquisition graph stays acyclic.
+* **GL08** task/future lifecycle: every ``create_task`` result
+  retained (weak-ref GC hazard), every created future resolved on all
+  paths including exception edges.
+* **GL09** shared-state ownership: attributes crossing the
+  thread/loop boundary are lock-protected (machine-verified),
+  immutable-after-start, or declared in ``tables.OWNERSHIP``.
+
 Suppression: ``# graft-lint: disable=GLxx -- <reason>`` on the finding
 line (or the full-line comment directly above it).  A suppression
 WITHOUT a reason is itself a finding (GL00) — the pragma plane is
@@ -37,9 +58,16 @@ __all__ = ["all_checkers"]
 
 
 def all_checkers():
-    """The checker registry, id-ordered (GL00 runs in the engine)."""
+    """The checker registry, id-ordered (GL00 runs in the engine):
+    ``(checker id, callable)`` pairs so the runner can time each one
+    (ci.sh archives per-checker seconds — a slow checker must be
+    visible before it eats the 30s stage-0 budget)."""
     from . import gl01_fops, gl02_options, gl03_async, gl04_errno, \
-        gl05_metrics
+        gl05_metrics, gl06_context, gl07_locks, gl08_lifecycle, \
+        gl09_ownership
 
-    return [gl01_fops.check, gl02_options.check, gl03_async.check,
-            gl04_errno.check, gl05_metrics.check]
+    return [("GL01", gl01_fops.check), ("GL02", gl02_options.check),
+            ("GL03", gl03_async.check), ("GL04", gl04_errno.check),
+            ("GL05", gl05_metrics.check), ("GL06", gl06_context.check),
+            ("GL07", gl07_locks.check), ("GL08", gl08_lifecycle.check),
+            ("GL09", gl09_ownership.check)]

@@ -1,0 +1,10 @@
+"""``python -m tools.graft_lint [--json] [--changed] [paths]`` — the
+no-path-games entry point (run.py stays the script-path form ci.sh and
+lint.sh call; both share main())."""
+
+import sys
+
+from .run import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,16 @@ def dotted(node: ast.AST) -> str:
     return ""
 
 
+def call_name(func: ast.AST) -> str:
+    """Last component of a call target: ``a.b.c`` -> ``c``, and — where
+    :func:`dotted` gives up — the attribute name of chains rooted in a
+    call (``get_event_loop().create_future`` -> ``create_future``)."""
+    d = dotted(func)
+    if d:
+        return d.split(".")[-1]
+    return func.attr if isinstance(func, ast.Attribute) else ""
+
+
 def const_str(node: ast.AST) -> str | None:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return node.value

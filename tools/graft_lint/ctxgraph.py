@@ -1,0 +1,560 @@
+"""Execution-context reachability: which code runs on an event loop,
+which on a daemon thread (graft-race's shared analysis, GL06-GL09).
+
+The repo is a hybrid runtime — asyncio loops per brick/gateway/daemon
+interwoven with daemon threads (event-pool workers, codec flush pools,
+mesh warm/probe threads, the fuse reader/writer split, worker-pool
+supervisors).  The reference keeps the analogous planes apart by
+contract (gf-event threads vs syncop continuation context); here the
+contract is machine-checked, which needs to know, per function, the
+execution context(s) it can run under.
+
+Seeding:
+
+* **loop** — every ``async def`` body (coroutines only ever run on a
+  loop), plus sync callables handed to the loop by name:
+  ``call_soon_threadsafe`` / ``call_soon`` / ``call_later`` /
+  ``call_at`` / ``add_reader`` / ``add_writer`` / ``add_done_callback``
+  / ``add_signal_handler`` arguments.
+* **thread** — ``threading.Thread(target=...)`` targets, every
+  function-valued argument of a ``.submit(...)`` (executor pools and
+  the event pool's keyed submit), ``asyncio.to_thread(fn, ...)`` and
+  ``loop.run_in_executor(pool, fn, ...)`` payloads, and the
+  declarative entries in :data:`tables.CTX_THREAD_ENTRY` (dynamic
+  dispatch the syntax cannot see).
+
+Contexts then propagate through the *direct* call graph: a sync
+function called from loop-context code is loop-reachable, one called
+from a thread entry is thread-reachable, and a function can be both.
+Crucially, handing a callable ACROSS the boundary is not a call edge —
+``loop.call_soon_threadsafe(done)`` from a worker thread seeds ``done``
+as loop context, exactly the re-entry the runtime performs.
+
+Resolution is deliberately shallow but honest: ``self.method`` within
+a class, module-level names within a file, ``from ..x import y`` /
+``import a.b as c`` across files.  Unresolvable dynamic dispatch means
+a function stays context-UNKNOWN and the checkers skip it — the
+declarative entry tables exist to close exactly those gaps, as data.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from .astutil import call_name, dotted
+from .engine import RepoIndex
+
+LOOP = "loop"
+THREAD = "thread"
+
+#: last-component call names whose function-ref arguments run on a
+#: thread (position: which args to consider; None = all)
+_THREAD_HANDOFF = {"submit": None, "to_thread": (0,),
+                   "run_in_executor": (1,)}
+#: last-component call names whose function-ref arguments run on the
+#: loop (the thread->loop re-entry points).  ``add_done_callback`` is
+#: handled separately: asyncio tasks/futures run callbacks on their
+#: loop, but concurrent.futures runs them in the COMPLETING THREAD —
+#: it only seeds loop when the receiver provably came from
+#: create_task/ensure_future/create_future in the same function.
+_LOOP_HANDOFF = {"call_soon_threadsafe": None, "call_soon": None,
+                 "call_later": None, "call_at": None, "add_reader": None,
+                 "add_writer": None, "add_signal_handler": None}
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qual: str                 # "<relpath>::<Scope.dotted.name>"
+    path: str
+    scope: str                # dotted name within the file
+    node: ast.AST             # FunctionDef / AsyncFunctionDef / Lambda
+    cls: str | None           # innermost enclosing class, if any
+    is_async: bool
+    calls: list[str] = dataclasses.field(default_factory=list)
+    #: own parameter names (for forwarder detection)
+    params: list[str] = dataclasses.field(default_factory=list)
+    #: (call node, resolved target qual) pairs, for the forwarder
+    #: fixpoint
+    callsites: list = dataclasses.field(default_factory=list)
+    #: (owner_qual, param) for own-or-ancestor params this function
+    #: CALLS directly (makes the owner a context forwarder once this
+    #: function has a context)
+    param_calls: list = dataclasses.field(default_factory=list)
+    #: (owner_qual, param, side) for params handed straight to a
+    #: thread/loop handoff (unconditional forwarders)
+    param_handoffs: list = dataclasses.field(default_factory=list)
+    #: resolver closure bound to this function's scope (set in pass 2)
+    resolver: object = None
+
+    @property
+    def lineno(self) -> int:
+        return self.node.lineno
+
+    def body_walk(self):
+        """Walk this function's own body, NOT descending into nested
+        function/lambda bodies (they are their own FuncInfos) but
+        including comprehension bodies (those execute inline)."""
+        stack = list(ast.iter_child_nodes(self.node))
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+
+class _FileScope:
+    """Per-file name environment for shallow resolution."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.module_funcs: dict[str, str] = {}    # name -> qual
+        self.classes: dict[str, dict[str, str]] = {}  # cls -> meth -> qual
+        self.mod_alias: dict[str, str] = {}       # alias -> module dotted
+        self.from_imports: dict[str, tuple[str, str]] = {}  # name ->
+        #                                           (module dotted, name)
+
+
+class ContextGraph:
+    def __init__(self) -> None:
+        self.funcs: dict[str, FuncInfo] = {}
+        self.loop: set[str] = set()
+        self.thread: set[str] = set()
+        #: qual -> (caller qual or seed description) for rendering the
+        #: reachability chain in findings
+        self.why_loop: dict[str, str] = {}
+        self.why_thread: dict[str, str] = {}
+        self._mod_to_path: dict[str, str] = {}
+        self._children: dict[tuple[str, str], dict[str, str]] = {}
+        self._by_path: dict[str, list["FuncInfo"]] = {}
+
+    # -- queries -----------------------------------------------------------
+
+    def ctx(self, qual: str) -> frozenset:
+        out = set()
+        if qual in self.loop:
+            out.add(LOOP)
+        if qual in self.thread:
+            out.add(THREAD)
+        return frozenset(out)
+
+    def chain(self, qual: str, ctx: str, limit: int = 4) -> str:
+        """Render how ``qual`` got its context, for finding messages."""
+        why = self.why_thread if ctx == THREAD else self.why_loop
+        hops, cur, seen = [], qual, set()
+        while cur in why and cur not in seen and len(hops) < limit:
+            seen.add(cur)
+            cur = why[cur]
+            hops.append(cur.split("::")[-1] if "::" in cur else cur)
+        return " <- ".join(hops)
+
+    def methods_of(self, path: str, cls: str) -> list[FuncInfo]:
+        return [fi for fi in self._by_path.get(path, ())
+                if fi.cls == cls]
+
+
+def _module_of(path: str) -> str:
+    mod = path[:-3] if path.endswith(".py") else path
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+def build(idx: RepoIndex) -> ContextGraph:
+    """Build (and memoize on the index) the context graph for the
+    scanned code files."""
+    cached = getattr(idx, "_ctxgraph", None)
+    if cached is not None:
+        return cached
+    g = ContextGraph()
+    mod_to_path = {}
+    for path in idx.code:
+        mod_to_path[_module_of(path)] = path
+    g._mod_to_path = mod_to_path
+
+    scopes: dict[str, _FileScope] = {}
+    seeds_thread: list[tuple[str, str]] = []   # (qual, why)
+    seeds_loop: list[tuple[str, str]] = []
+
+    # pass 1: index every function and the per-file name environment
+    for path, sf in idx.code.items():
+        if sf.tree is None:
+            continue
+        fs = _FileScope(path)
+        scopes[path] = fs
+        _index_file(g, fs, sf.tree, mod_to_path)
+
+    # index nested defs by (path, parent scope) and functions by path
+    # once — pass 2 runs per call site and must not rescan the graph
+    g._children = {}
+    by_path: dict[str, list[FuncInfo]] = {}
+    for qual, fi2 in g.funcs.items():
+        parent = fi2.scope.rsplit(".", 1)[0] \
+            if "." in fi2.scope else ""
+        g._children.setdefault((fi2.path, parent), {})[
+            fi2.scope.split(".")[-1]] = qual
+        by_path.setdefault(fi2.path, []).append(fi2)
+    g._by_path = by_path
+
+    # pass 2: call edges + handoff seeds
+    for path, sf in idx.code.items():
+        if sf.tree is None:
+            continue
+        fs = scopes[path]
+        for fi in by_path.get(path, ()):
+            _extract_calls(g, fs, fi, seeds_thread, seeds_loop)
+        # module-level statements spawn threads too (rare but legal)
+        mod_fi = FuncInfo(qual=f"{path}::<module>", path=path,
+                          scope="<module>", node=sf.tree, cls=None,
+                          is_async=False)
+        _extract_calls(g, fs, mod_fi, seeds_thread, seeds_loop)
+
+    # pass 3: declarative entries (tables.py — dynamic dispatch the
+    # syntax cannot see) with stale-entry detection left to GL06
+    from . import tables
+    for qual, reason in tables.CTX_THREAD_ENTRY.items():
+        if qual in g.funcs:
+            seeds_thread.append((qual, f"tables.CTX_THREAD_ENTRY "
+                                       f"({reason})"))
+    for qual, reason in tables.CTX_LOOP_ENTRY.items():
+        if qual in g.funcs:
+            seeds_loop.append((qual, f"tables.CTX_LOOP_ENTRY "
+                                     f"({reason})"))
+
+    # pass 4: propagate to a fixpoint with forwarder discovery.  async
+    # bodies are loop seeds by construction; contexts flow only into
+    # SYNC callees (an async callee's body is already loop, and a
+    # thread cannot run a coroutine body by calling the function — it
+    # only gets a coroutine object).  Forwarders close the one-hop
+    # higher-order gap: a function handing its own parameter to
+    # ``.submit``/``to_thread``/``run_in_executor`` (or calling it
+    # while itself context-classified) turns its call sites' function
+    # arguments into seeds of that context.
+    for qual, fi in g.funcs.items():
+        if fi.is_async:
+            seeds_loop.append((qual, "async def (coroutines only ever "
+                                     "run on a loop)"))
+    forwarders: dict[str, set[tuple[str, str]]] = {
+        THREAD: set(), LOOP: set()}
+    for fi in g.funcs.values():
+        for owner, param, side in fi.param_handoffs:
+            forwarders[side].add((owner, param))
+    for _ in range(12):  # bounded fixpoint (depth of forward chains)
+        g.loop, g.thread = set(), set()
+        g.why_loop, g.why_thread = {}, {}
+        _propagate(g, seeds_loop, g.loop, g.why_loop)
+        _propagate(g, seeds_thread, g.thread, g.why_thread,
+                   sync_only_seeds=True)
+        grew = False
+        # a context-classified function that calls its (or a lexical
+        # ancestor's) parameter executes the callable in that context
+        for qual, fi in g.funcs.items():
+            for side, members in ((THREAD, g.thread), (LOOP, g.loop)):
+                if qual not in members:
+                    continue
+                for owner, param in fi.param_calls:
+                    if (owner, param) not in forwarders[side]:
+                        forwarders[side].add((owner, param))
+                        grew = True
+        # resolve call-site arguments feeding forwarder params
+        before = (len(seeds_thread), len(seeds_loop))
+        by_target: dict[str, dict[str, list[str]]] = {}
+        for side in (THREAD, LOOP):
+            for owner, param in forwarders[side]:
+                by_target.setdefault(owner, {}).setdefault(
+                    side, []).append(param)
+        for fi in g.funcs.values():
+            for call, target in fi.callsites:
+                if target is None or target not in by_target:
+                    continue
+                tfi = g.funcs.get(target)
+                if tfi is None:
+                    continue
+                for side, seeds in ((THREAD, seeds_thread),
+                                    (LOOP, seeds_loop)):
+                    for param in by_target[target].get(side, ()):
+                        owner = target
+                        expr = _arg_for(call, tfi, param)
+                        if expr is None:
+                            continue
+                        t = fi.resolver(expr) if fi.resolver else None
+                        if t is not None:
+                            entry = (t, f"forwarded into {side} "
+                                        f"context by {target} at "
+                                        f"{fi.path}:{call.lineno}")
+                            if entry not in seeds:
+                                seeds.append(entry)
+                                grew = True
+                        elif isinstance(expr, ast.Name):
+                            o2 = _param_owner(g, fi, expr.id)
+                            if o2 is not None and \
+                                    (o2, expr.id) not in \
+                                    forwarders[side]:
+                                forwarders[side].add((o2, expr.id))
+                                grew = True
+        if not grew and (len(seeds_thread),
+                         len(seeds_loop)) == before:
+            break
+    idx._ctxgraph = g
+    return g
+
+
+def _param_owner(g: ContextGraph, fi: FuncInfo,
+                 name: str) -> str | None:
+    """qual of the function (fi or a lexical ancestor) owning param
+    ``name``."""
+    scope = fi.scope
+    while True:
+        qual = f"{fi.path}::{scope}"
+        owner = g.funcs.get(qual)
+        if owner is not None and name in owner.params:
+            return qual
+        if "." not in scope:
+            return None
+        scope = scope.rsplit(".", 1)[0]
+
+
+def _arg_for(call: ast.Call, target: FuncInfo,
+             param: str) -> ast.AST | None:
+    """The call-site expression feeding ``param`` of ``target``."""
+    for kw in call.keywords:
+        if kw.arg == param:
+            return kw.value
+    if param not in target.params:
+        return None
+    idx = target.params.index(param)
+    # a method called as self.m(...) / obj.m(...) binds params[0]
+    # implicitly
+    if target.cls is not None and target.params and \
+            target.params[0] in ("self", "cls") and \
+            isinstance(call.func, ast.Attribute):
+        idx -= 1
+    if 0 <= idx < len(call.args):
+        a = call.args[idx]
+        if isinstance(a, ast.Starred):
+            return None
+        return a
+    return None
+
+
+def _propagate(g: ContextGraph, seeds, out: set, why: dict,
+               sync_only_seeds: bool = False) -> None:
+    work = []
+    for qual, reason in seeds:
+        fi = g.funcs.get(qual)
+        if fi is None:
+            continue
+        if sync_only_seeds and fi.is_async:
+            continue  # a thread "running" a coroutine fn is just a bug
+        if qual not in out:
+            out.add(qual)
+            why[qual] = reason
+            work.append(qual)
+    while work:
+        cur = work.pop()
+        for callee in g.funcs[cur].calls:
+            fi = g.funcs.get(callee)
+            if fi is None or fi.is_async or callee in out:
+                continue
+            out.add(callee)
+            why[callee] = cur
+            work.append(callee)
+
+
+# -- pass 1: indexing ------------------------------------------------------
+
+
+def _index_file(g: ContextGraph, fs: _FileScope, tree: ast.Module,
+                mod_to_path: dict[str, str]) -> None:
+    pkg_parts = fs.path.split("/")[:-1]
+
+    def resolve_module(level: int, module: str | None) -> str | None:
+        if level == 0:
+            return module
+        base = pkg_parts[: len(pkg_parts) - (level - 1)]
+        mod = ".".join(base + ([module] if module else []))
+        return mod or None
+
+    for stmt in ast.walk(tree):
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                fs.mod_alias[alias.asname or alias.name.split(".")[0]] \
+                    = alias.name
+        elif isinstance(stmt, ast.ImportFrom):
+            mod = resolve_module(stmt.level, stmt.module)
+            if mod is None:
+                continue
+            for alias in stmt.names:
+                name = alias.asname or alias.name
+                if f"{mod}.{alias.name}" in mod_to_path:
+                    # ``from ..core import metrics`` — a module import
+                    fs.mod_alias[name] = f"{mod}.{alias.name}"
+                else:
+                    fs.from_imports[name] = (mod, alias.name)
+
+    def visit(node: ast.AST, scope: list[str], cls: str | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                fs.classes.setdefault(child.name, {})
+                visit(child, scope + [child.name], child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                dotted_scope = ".".join(scope + [child.name])
+                qual = f"{fs.path}::{dotted_scope}"
+                fi = FuncInfo(
+                    qual=qual, path=fs.path, scope=dotted_scope,
+                    node=child, cls=cls,
+                    is_async=isinstance(child, ast.AsyncFunctionDef))
+                g.funcs[qual] = fi
+                if not scope:
+                    fs.module_funcs[child.name] = qual
+                elif cls is not None and scope[-1] == cls:
+                    fs.classes[cls][child.name] = qual
+                visit(child, scope + [child.name], cls)
+            elif isinstance(child, ast.Lambda):
+                dotted_scope = ".".join(
+                    scope + [f"<lambda@{child.lineno}>"])
+                qual = f"{fs.path}::{dotted_scope}"
+                g.funcs[qual] = FuncInfo(
+                    qual=qual, path=fs.path, scope=dotted_scope,
+                    node=child, cls=cls, is_async=False)
+                visit(child, scope + [f"<lambda@{child.lineno}>"], cls)
+            else:
+                visit(child, scope, cls)
+
+    visit(tree, [], None)
+
+
+# -- pass 2: call edges + handoff seeds ------------------------------------
+
+
+def _extract_calls(g: ContextGraph, fs: _FileScope, fi: FuncInfo,
+                   seeds_thread: list, seeds_loop: list) -> None:
+    # nested defs visible by name from this function's body
+    prefix = "" if fi.scope == "<module>" else fi.scope + "."
+    local_defs = g._children.get(
+        (fi.path, "" if fi.scope == "<module>" else fi.scope), {})
+
+    def resolve(expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Lambda):
+            return f"{fs.path}::{prefix}<lambda@{expr.lineno}>" \
+                if f"{fs.path}::{prefix}<lambda@{expr.lineno}>" \
+                in g.funcs else None
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            if n in local_defs:
+                return local_defs[n]
+            if n in fs.module_funcs:
+                return fs.module_funcs[n]
+            if n in fs.classes:  # constructing a class calls __init__
+                return fs.classes[n].get("__init__")
+            if n in fs.from_imports:
+                mod, orig = fs.from_imports[n]
+                from_path = _mod_path(mod)
+                if from_path is not None:
+                    q = f"{from_path}::{orig}"
+                    return q if q in g.funcs else None
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id in ("self", "cls") and fi.cls is not None:
+                    return fs.classes.get(fi.cls, {}).get(expr.attr)
+                alias = fs.mod_alias.get(base.id)
+                if alias is not None:
+                    from_path = _mod_path(alias)
+                    if from_path is not None:
+                        q = f"{from_path}::{expr.attr}"
+                        return q if q in g.funcs else None
+        return None
+
+    def _mod_path(mod: str) -> str | None:
+        return g._mod_to_path.get(mod)
+
+    def unwrap(expr: ast.AST) -> ast.AST:
+        """functools.partial(fn, ...) hands off fn."""
+        if isinstance(expr, ast.Call) and \
+                dotted(expr.func).split(".")[-1] == "partial" and \
+                expr.args:
+            return expr.args[0]
+        return expr
+
+    args_node = getattr(fi.node, "args", None)
+    if args_node is not None:
+        fi.params = [a.arg for a in
+                     args_node.posonlyargs + args_node.args +
+                     args_node.kwonlyargs]
+    fi.resolver = resolve
+
+    # names provably bound to asyncio tasks/futures in this function —
+    # their add_done_callback callbacks run on the loop (a cf.Future's
+    # run in the completing worker thread, so anything else stays
+    # context-UNKNOWN)
+    asyncio_names: set[str] = set()
+    for n in fi.body_walk():
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Name) and \
+                isinstance(n.value, ast.Call) and \
+                call_name(n.value.func) in ("create_task",
+                                            "ensure_future",
+                                            "create_future"):
+            asyncio_names.add(n.targets[0].id)
+
+    def handoff(expr: ast.AST, side: str, why: str) -> None:
+        expr = unwrap(expr)
+        t = resolve(expr)
+        if t is not None:
+            (seeds_thread if side == "thread"
+             else seeds_loop).append((t, why))
+        elif isinstance(expr, ast.Name):
+            owner = _param_owner(g, fi, expr.id)
+            if owner is not None:
+                fi.param_handoffs.append((owner, expr.id, side))
+
+    for n in fi.body_walk():
+        if not isinstance(n, ast.Call):
+            continue
+        name = dotted(n.func)
+        last = name.split(".")[-1] if name else \
+            (n.func.attr if isinstance(n.func, ast.Attribute) else "")
+        # direct call edge
+        target = resolve(n.func)
+        if target is not None:
+            fi.calls.append(target)
+        fi.callsites.append((n, target))
+        # calling a bare name that is a parameter (own or lexical
+        # ancestor's): the owner is a context forwarder once this
+        # function is classified
+        if target is None and isinstance(n.func, ast.Name):
+            owner = _param_owner(g, fi, n.func.id)
+            if owner is not None:
+                fi.param_calls.append((owner, n.func.id))
+        # thread spawn: threading.Thread(target=...)
+        if last == "Thread":
+            for kw in n.keywords:
+                if kw.arg == "target":
+                    handoff(kw.value, "thread",
+                            f"threading.Thread target at "
+                            f"{fi.path}:{n.lineno}")
+        elif last in _THREAD_HANDOFF:
+            pos = _THREAD_HANDOFF[last]
+            for i, a in enumerate(n.args):
+                if pos is not None and i not in pos:
+                    continue
+                handoff(a, "thread",
+                        f".{last}() handoff at {fi.path}:{n.lineno}")
+        elif last in _LOOP_HANDOFF:
+            for a in list(n.args) + [k.value for k in n.keywords]:
+                handoff(a, "loop",
+                        f".{last}() loop re-entry at "
+                        f"{fi.path}:{n.lineno}")
+        elif last == "add_done_callback" and \
+                isinstance(n.func, ast.Attribute) and \
+                isinstance(n.func.value, ast.Name) and \
+                n.func.value.id in asyncio_names:
+            for a in n.args:
+                handoff(a, "loop",
+                        f"asyncio done-callback at "
+                        f"{fi.path}:{n.lineno}")

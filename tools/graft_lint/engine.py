@@ -117,6 +117,10 @@ class RepoIndex:
     @classmethod
     def load(cls, root: Path, only: list[str] | None = None) -> "RepoIndex":
         idx = cls(root)
+        #: narrowed runs skip cross-file STALE-entry checks: deciding
+        #: that a table row is dead needs the whole tree in view (a
+        #: lock defined in an unscanned file must not read as gone)
+        idx.full_tree = only is None
 
         def want(rel: str) -> bool:
             if "__pycache__" in rel:
@@ -182,23 +186,34 @@ class NoFilesMatched(Exception):
     """A narrowed run whose paths select nothing must not report clean."""
 
 
-def run(root: Path, only: list[str] | None = None) -> list[Finding]:
-    """Parse the tree, run every checker, apply suppressions."""
+def run(root: Path, only: list[str] | None = None,
+        timings: dict | None = None) -> list[Finding]:
+    """Parse the tree, run every checker, apply suppressions.  Pass a
+    dict as ``timings`` to receive per-checker wall seconds (the ci.sh
+    archived-json surface that makes a slow checker visible before it
+    eats the 30s stage-0 budget)."""
+    import time
     from . import all_checkers
 
+    t0 = time.perf_counter()
     idx = RepoIndex.load(root, only)
     if only is not None and not idx.code and not idx.tests:
         raise NoFilesMatched(
             f"no scanned files match {only!r} — a typo'd path must not "
             "read as a clean tree")
+    if timings is not None:
+        timings["parse"] = round(time.perf_counter() - t0, 3)
     findings: list[Finding] = []
     for sf in idx.all_py().values():
         if sf.parse_error is not None:
             findings.append(Finding("GL00", sf.path, 1,
                                     f"does not parse: {sf.parse_error}"))
     findings.extend(pragma_findings(idx))
-    for check in all_checkers():
+    for name, check in all_checkers():
+        t0 = time.perf_counter()
         findings.extend(check(idx))
+        if timings is not None:
+            timings[name] = round(time.perf_counter() - t0, 3)
     kept = [f for f in findings
             if f.code == "GL00"
             or not _is_suppressed(idx, f)]

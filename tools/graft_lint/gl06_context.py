@@ -1,0 +1,171 @@
+"""GL06 — loop/thread boundary discipline (graft-race).
+
+Historical bugs this encodes:
+
+* PR 12: a GC'd passed-fd serve task reset a live connection — the
+  thread/loop handoff around task creation is exactly where lifetime
+  and affinity mistakes land.
+* PR 7: an orphaned event-pool future wedged its connection — resolved
+  from a worker thread without ``call_soon_threadsafe`` it would have
+  raced the loop instead.
+
+Two directions, both over :mod:`ctxgraph`'s reachability (the gap
+GL03's purely syntactic in-``async def`` check cannot see):
+
+* **thread-context** code must not touch loop-affine APIs —
+  ``create_task`` / ``ensure_future``, ``Future.set_result`` /
+  ``set_exception``, or ``<task>.cancel()`` — except through the
+  ``call_soon_threadsafe`` / ``run_coroutine_threadsafe`` re-entry
+  points (their callables are seeded as LOOP context by ctxgraph, so
+  code inside them is exempt by construction).  asyncio's loop and its
+  futures are not thread-safe; the runtime only promises these two
+  doors.
+* **loop-context** sync code must not block: ``.result()`` on a
+  concurrent future, ``time.sleep``, the blocking ``subprocess``
+  family, zero-argument ``.join()``, ``.wait(...)`` /
+  ``.communicate(...)`` on subprocess/event objects.  (Inside ``async
+  def`` GL03 already flags these; GL06 extends the same discipline to
+  sync functions *reachable from* loop context.)
+
+Stale declarative entries (:data:`tables.CTX_THREAD_ENTRY` /
+``CTX_LOOP_ENTRY`` naming functions that no longer exist) are findings
+too — the tables must not rot.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import ctxgraph, tables
+from .astutil import dotted
+from .engine import Finding, RepoIndex
+
+#: loop-affine call names (last component) illegal from thread context
+_LOOP_AFFINE = {"create_task", "ensure_future"}
+#: future-resolution calls illegal from thread context on an asyncio
+#: future (concurrent.futures handoffs are declared in tables)
+_FUTURE_RESOLVE = {"set_result", "set_exception"}
+
+_BLOCKING_SUBPROCESS = {"subprocess.run", "subprocess.call",
+                        "subprocess.check_call",
+                        "subprocess.check_output"}
+#: asyncio wrappers whose call arguments are not themselves executed
+#: on the spot (mirrors GL03's exemption)
+_ASYNC_WRAPPERS = {"wait_for", "shield", "ensure_future", "create_task",
+                   "gather", "to_thread", "run_coroutine_threadsafe",
+                   "wait", "as_completed", "timeout", "timeout_at"}
+
+
+def _wrapper_exempt_ids(fi: ctxgraph.FuncInfo) -> set[int]:
+    out: set[int] = set()
+    for n in fi.body_walk():
+        if isinstance(n, ast.Call):
+            name = dotted(n.func)
+            if name.split(".")[-1] in _ASYNC_WRAPPERS:
+                for a in list(n.args) + [k.value for k in n.keywords]:
+                    for sub in ast.walk(a):
+                        if isinstance(sub, ast.Call):
+                            out.add(id(sub))
+    return out
+
+
+def _thread_findings(g: ctxgraph.ContextGraph,
+                     fi: ctxgraph.FuncInfo) -> list[Finding]:
+    out = []
+    chain = g.chain(fi.qual, ctxgraph.THREAD)
+    via = f" (thread-reachable via {chain})" if chain else ""
+    for n in fi.body_walk():
+        if not isinstance(n, ast.Call) or \
+                not isinstance(n.func, ast.Attribute):
+            continue
+        attr = n.func.attr
+        recv = dotted(n.func.value)
+        if attr in _LOOP_AFFINE:
+            out.append(Finding(
+                "GL06", fi.path, n.lineno,
+                f"thread-context code calls .{attr}() — the loop is "
+                f"not thread-safe; hand the callable over with "
+                f"loop.call_soon_threadsafe or use "
+                f"asyncio.run_coroutine_threadsafe{via}"))
+        elif attr in _FUTURE_RESOLVE:
+            key = f"{fi.path}::{fi.scope}"
+            if key in tables.THREADSAFE_FUTURE_RESOLVE:
+                continue
+            out.append(Finding(
+                "GL06", fi.path, n.lineno,
+                f"thread-context code resolves a future via "
+                f".{attr}() — an asyncio future must be resolved on "
+                f"its loop (call_soon_threadsafe); if "
+                f"{recv or 'this'!s} is a concurrent.futures.Future "
+                f"handoff, declare it in "
+                f"tables.THREADSAFE_FUTURE_RESOLVE{via}"))
+        elif attr == "cancel" and ("task" in (recv or "").lower()):
+            out.append(Finding(
+                "GL06", fi.path, n.lineno,
+                f"thread-context code cancels {recv} — task.cancel() "
+                f"is loop-affine; route it through "
+                f"loop.call_soon_threadsafe{via}"))
+    return out
+
+
+def _loop_findings(g: ctxgraph.ContextGraph,
+                   fi: ctxgraph.FuncInfo) -> list[Finding]:
+    out = []
+    chain = g.chain(fi.qual, ctxgraph.LOOP)
+    via = f" (loop-reachable via {chain})" if chain else ""
+    exempt = _wrapper_exempt_ids(fi)
+    for n in fi.body_walk():
+        if not isinstance(n, ast.Call) or id(n) in exempt:
+            continue
+        name = dotted(n.func)
+        msg = None
+        if name == "time.sleep":
+            msg = "time.sleep blocks the event loop"
+        elif name in _BLOCKING_SUBPROCESS:
+            msg = f"{name} blocks until the child exits"
+        elif isinstance(n.func, ast.Attribute):
+            attr = n.func.attr
+            nargs = len(n.args) + len(n.keywords)
+            if attr == "result":
+                msg = ".result() blocks the loop on a concurrent " \
+                      "future"
+            elif attr in ("join", "communicate") and nargs == 0:
+                msg = f".{attr}() with no arguments is a blocking " \
+                      "thread/process primitive"
+        if msg is not None:
+            out.append(Finding(
+                "GL06", fi.path, n.lineno,
+                f"sync function reachable from loop context blocks: "
+                f"{msg} — move it off-loop (asyncio.to_thread) or "
+                f"split the thread/loop paths{via}"))
+    return out
+
+
+def check(idx: RepoIndex) -> list[Finding]:
+    g = ctxgraph.build(idx)
+    out: list[Finding] = []
+    # stale declarative entries explain themselves (full-tree runs
+    # only — a narrowed scan sees too little to call a row dead)
+    for table_name in (("CTX_THREAD_ENTRY", "CTX_LOOP_ENTRY",
+                        "THREADSAFE_FUTURE_RESOLVE")
+                       if getattr(idx, "full_tree", True) else ()):
+        table = getattr(tables, table_name)
+        for qual, reason in table.items():
+            path = qual.split("::")[0]
+            if path in idx.code and qual not in g.funcs:
+                out.append(Finding(
+                    "GL06", path, 1,
+                    f"stale tables.{table_name} entry {qual!r} "
+                    f"(reason was: {reason}) — the function no longer "
+                    f"exists; delete the entry"))
+    for qual, fi in g.funcs.items():
+        if fi.path not in idx.code:
+            continue
+        if qual in g.thread and not fi.is_async:
+            out.extend(_thread_findings(g, fi))
+        if qual in g.loop and not fi.is_async and qual not in g.thread:
+            # both-context helpers are GL09's shared-state territory;
+            # flagging their blocking calls as loop bugs would indict
+            # the thread half too (declared, not inferred)
+            out.extend(_loop_findings(g, fi))
+    return out

@@ -1,0 +1,316 @@
+"""GL07 — threading-lock discipline (graft-race).
+
+Historical bug: PR 8's ``_BUILD_LOCK`` was released *before* the lazy
+jit call it was supposed to serialize — jax.jit traces/compiles at the
+first CALL, so the criticial region was empty; the review pass had to
+re-derive lock extent by hand.  The inverse hazard is as real: an
+``await`` (or a multi-second lazy first-compile) while HOLDING a
+``threading.Lock`` parks every other thread — and on the hybrid plane
+one of those threads may be running the event loop's only executor.
+
+Three checks, over locks discovered structurally (``self.x =
+threading.Lock()`` / module-level ``X = threading.Lock()``, RLock and
+Condition included, any import alias):
+
+* **await-under-lock** — an ``await`` lexically inside a ``with
+  <threading lock>:`` body.  A threading lock held across a suspension
+  point outlives its task's scheduling slice: every OTHER thread
+  touching the lock blocks for as long as the loop takes to resume the
+  coroutine, and a second task acquiring the same lock on the SAME
+  loop deadlocks it outright.  (``asyncio.Lock`` is the loop-side
+  primitive.)
+* **known-lazy-under-lock** — a call to a :data:`tables.KNOWN_LAZY`
+  callable inside a lock body: these compile/trace on first call
+  (seconds of GIL-holding work), which turns the lock into a
+  process-wide stall.  Sites that *deliberately* serialize the compile
+  (the PR-8 fix holds _BUILD_LOCK across the jitted call on purpose)
+  declare themselves in :data:`tables.LAZY_UNDER_LOCK_OK` with the
+  reason.
+* **lock-order cycles** — the per-class/per-module acquisition graph
+  (lock A held while B is acquired, through same-file direct calls)
+  must stay acyclic; an A->B / B->A pair is a deadlock waiting for two
+  threads to interleave.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import ctxgraph, tables
+from .astutil import call_name, dotted
+from .engine import Finding, RepoIndex
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _lock_defs(idx: RepoIndex) -> dict[str, set[str]]:
+    """path -> lock names DEFINED there ('self._lock' attrs and bare
+    module-level names assigned a threading Lock/RLock/Condition)."""
+    out: dict[str, set[str]] = {}
+    for path, sf in idx.code.items():
+        if sf.tree is None:
+            continue
+        names: set[str] = set()
+        for n in ast.walk(sf.tree):
+            if not isinstance(n, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = n.value
+            if not (isinstance(value, ast.Call)
+                    and isinstance(value.func, (ast.Attribute, ast.Name))
+                    and dotted(value.func).split(".")[-1] in _LOCK_CTORS
+                    and dotted(value.func) != "asyncio.Lock"):
+                continue
+            targets = n.targets if isinstance(n, ast.Assign) \
+                else [n.target]
+            for t in targets:
+                d = dotted(t)
+                if d:
+                    names.add(d)  # "self._lock" or "_BUILD_LOCK"
+        if names:
+            out[path] = names
+    return out
+
+
+def _lock_env(idx: RepoIndex) -> dict[str, dict[str, tuple[str, str]]]:
+    """path -> {name-as-written-at-a-with-site: (defining path, lock
+    name)}.  Local definitions plus IMPORTED module-level locks — the
+    ring_codec plane acquires mesh_codec._BUILD_LOCK across files, and
+    a file-local view would neither see that acquisition nor order it
+    against the owner's."""
+    from . import ctxgraph as _cg
+
+    defs = _lock_defs(idx)
+    mod_to_path = {_cg._module_of(p): p for p in idx.code}
+    env: dict[str, dict[str, tuple[str, str]]] = {}
+    for path, sf in idx.code.items():
+        if sf.tree is None:
+            continue
+        m: dict[str, tuple[str, str]] = {}
+        for name in defs.get(path, ()):
+            m[name] = (path, name)
+        pkg_parts = path.split("/")[:-1]
+        for stmt in ast.walk(sf.tree):
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    tgt = mod_to_path.get(alias.name)
+                    if tgt is None:
+                        continue
+                    asname = alias.asname or alias.name.split(".")[0]
+                    if alias.asname is None and "." in alias.name:
+                        continue  # a.b.c without asname: written fully
+                    for lk in defs.get(tgt, ()):
+                        if "." not in lk:
+                            m[f"{asname}.{lk}"] = (tgt, lk)
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.level == 0:
+                    mod = stmt.module
+                else:
+                    base = pkg_parts[: len(pkg_parts)
+                                     - (stmt.level - 1)]
+                    mod = ".".join(
+                        base + ([stmt.module] if stmt.module else []))
+                if not mod:
+                    continue
+                for alias in stmt.names:
+                    nm = alias.asname or alias.name
+                    sub = mod_to_path.get(f"{mod}.{alias.name}")
+                    if sub is not None:  # imported a MODULE
+                        for lk in defs.get(sub, ()):
+                            if "." not in lk:
+                                m[f"{nm}.{lk}"] = (sub, lk)
+                    else:  # maybe imported the lock object itself
+                        tgt = mod_to_path.get(mod)
+                        if tgt is not None and \
+                                alias.name in defs.get(tgt, ()):
+                            m[nm] = (tgt, alias.name)
+        if m:
+            env[path] = m
+    return env
+
+
+def _shallow_walk(body: list[ast.AST]):
+    """Walk statements without descending into nested function/lambda
+    bodies — code merely DEFINED under a lock does not run under it."""
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _with_lock_items(fn_node: ast.AST, locks: set[str]):
+    """(with_node, lock_name, body) for lock acquisitions in this
+    function's own body (nested defs are their own FuncInfos)."""
+    body = getattr(fn_node, "body", [])
+    if not isinstance(body, list):  # lambda
+        return
+    for n in _shallow_walk(body):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                d = dotted(item.context_expr)
+                if d in locks:
+                    yield n, d, n.body
+
+
+def check(idx: RepoIndex) -> list[Finding]:
+    g = ctxgraph.build(idx)
+    lock_env = _lock_env(idx)
+    out: list[Finding] = []
+
+    #: declared lazy-under-lock sites actually observed in this run
+    #: (path::scope::lazy) — a declaration whose site no longer holds
+    #: the lock across the lazy call is stale, so the table verifies
+    #: the PR-8 lock-extent contract instead of merely excusing it
+    seen_declared: set[str] = set()
+
+    # per-function direct-acquire sets + call edges for the
+    # acquisition graph (lock ids are canonical (defining-path, name)
+    # pairs, so a cross-file acquisition orders against the owner's)
+    acquires: dict[str, set[tuple[str, str]]] = {}
+    for qual, fi in g.funcs.items():
+        locks = lock_env.get(fi.path, {})
+        if not locks:
+            continue
+        mine = set()
+        for _, lock, _ in _with_lock_items(fi.node, locks):
+            mine.add(locks[lock])
+        if mine:
+            acquires[qual] = mine
+
+    # transitive acquire sets through resolved direct calls (bounded
+    # fixpoint — the graph is tiny)
+    trans: dict[str, set[tuple[str, str]]] = {
+        q: set(s) for q, s in acquires.items()}
+    for q in g.funcs:
+        trans.setdefault(q, set())
+    changed = True
+    iters = 0
+    while changed and iters < 20:
+        changed = False
+        iters += 1
+        for qual, fi in g.funcs.items():
+            cur = trans[qual]
+            for callee in fi.calls:
+                extra = trans.get(callee)
+                if extra and not extra <= cur:
+                    cur |= extra
+                    changed = True
+
+    edges: dict[tuple[str, str], set[tuple[str, str]]] = {}
+    edge_sites: dict[tuple, tuple[str, int]] = {}
+
+    for path, sf in idx.code.items():
+        if sf.tree is None:
+            continue
+        locks = lock_env.get(path, {})
+        if not locks:
+            continue
+        for fi in g._by_path.get(path, ()):
+            for wnode, lock, body in _with_lock_items(fi.node, locks):
+                held = locks[lock]
+                for n in _shallow_walk(body):
+                    # a) await under a threading lock
+                    if isinstance(n, ast.Await):
+                        out.append(Finding(
+                            "GL07", path, n.lineno,
+                            f"await while holding threading lock "
+                            f"{lock!r} — the lock outlives the "
+                            f"scheduling slice and can deadlock the "
+                            f"loop against its own second acquirer; "
+                            f"use asyncio.Lock or release before "
+                            f"suspending"))
+                    # b) known-lazy call under a lock
+                    if isinstance(n, ast.Call):
+                        name = dotted(n.func)
+                        for lazy, why in tables.KNOWN_LAZY.items():
+                            if name == lazy or \
+                                    name.endswith("." + lazy):
+                                site = f"{path}::{fi.scope}::{lazy}"
+                                if site in tables.LAZY_UNDER_LOCK_OK:
+                                    seen_declared.add(site)
+                                    continue
+                                out.append(Finding(
+                                    "GL07", path, n.lineno,
+                                    f"known-lazy callable {lazy!r} "
+                                    f"({why}) called while holding "
+                                    f"{lock!r} — first call "
+                                    f"traces/compiles for seconds "
+                                    f"under the lock; declare the "
+                                    f"site in tables."
+                                    f"LAZY_UNDER_LOCK_OK if the "
+                                    f"serialization is deliberate"))
+                    # c) acquisition edges: nested withs + same-file
+                    # calls that acquire
+                    if isinstance(n, (ast.With, ast.AsyncWith)):
+                        for item in n.items:
+                            d = dotted(item.context_expr)
+                            if d in locks and locks[d] != held:
+                                edges.setdefault(held, set()).add(
+                                    locks[d])
+                                edge_sites[(held, locks[d])] = \
+                                    (path, n.lineno)
+                    if isinstance(n, ast.Call):
+                        t = None
+                        # resolve the call through the context graph
+                        # (match on the callee's SCOPE tail)
+                        want = call_name(n.func)
+                        for callee in fi.calls:
+                            cfi = g.funcs.get(callee)
+                            if cfi is not None and want and \
+                                    cfi.scope.split(".")[-1] == want:
+                                t = callee
+                                break
+                        if t is not None:
+                            for other in trans.get(t, ()):
+                                if other != held:
+                                    edges.setdefault(
+                                        held, set()).add(other)
+                                    edge_sites[(held, other)] = \
+                                        (path, n.lineno)
+
+    # stale LAZY_UNDER_LOCK_OK entries: the declared site must still
+    # exist AND still hold the lock across the lazy call — the
+    # declaration IS the lock-extent contract (PR 8), not an excuse.
+    # Full-tree runs only: on a narrowed scan the lock's DEFINING file
+    # (mesh_codec for ring_codec's cross-file acquisition) may be
+    # outside the scanned set, and an unresolvable lock must not read
+    # as a dropped one.
+    for site, reason in (tables.LAZY_UNDER_LOCK_OK.items()
+                         if getattr(idx, "full_tree", True) else ()):
+        path = site.split("::")[0]
+        if path in idx.code and site not in seen_declared:
+            out.append(Finding(
+                "GL07", path, 1,
+                f"stale tables.LAZY_UNDER_LOCK_OK entry {site!r} — "
+                f"the site no longer holds a lock across that lazy "
+                f"call (or is gone); delete the entry, or restore "
+                f"the deliberate serialization it declared "
+                f"(reason was: {reason})"))
+
+    # cycle detection over the acquisition graph
+    seen_cycles = set()
+    for start in edges:
+        stack = [(start, [start])]
+        while stack:
+            node, trail = stack.pop()
+            for nxt in edges.get(node, ()):
+                if nxt == start and len(trail) > 1:
+                    cyc = frozenset(trail)
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    path, line = edge_sites.get(
+                        (node, nxt), (start[0], 1))
+                    pretty = " -> ".join(
+                        lk for _, lk in trail + [start])
+                    out.append(Finding(
+                        "GL07", path, line,
+                        f"lock-order cycle {pretty} — two threads "
+                        f"interleaving these acquisitions deadlock; "
+                        f"impose a single acquisition order"))
+                elif nxt not in trail:
+                    stack.append((nxt, trail + [nxt]))
+    return out

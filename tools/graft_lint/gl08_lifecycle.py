@@ -1,0 +1,287 @@
+"""GL08 — task/future lifecycle (graft-race).
+
+The two exact shapes behind real bugs on this tree:
+
+* **PR 12**: ``loop.create_task(gw._serve_conn(...))`` whose result was
+  dropped — the event loop holds only a WEAK reference to tasks, so
+  the GC collected a live passed-fd serve task mid-connection and its
+  ``__del__`` reset the socket.  Every ``create_task`` /
+  ``ensure_future`` result must be RETAINED: assigned and then used
+  (stored, awaited, callback-registered), passed along, returned, or
+  awaited in place.
+* **PR 7**: an event-pool job's future was orphaned on shutdown — a
+  created future that is not resolved on EVERY path (exception edges
+  included) wedges whoever awaits it.  For futures born via
+  ``create_future()`` and never handed off, each path to function exit
+  must ``set_result`` / ``set_exception`` / ``cancel``; a
+  ``set_result`` inside a ``try`` whose handler neither resolves nor
+  re-raises is the canonical miss.
+
+Both checks are flow-sensitive within one function and deliberately
+stop at escape: a future/task stored into a container or attribute,
+passed to a call, or returned has transferred ownership — lifecycle
+then belongs to the holder (and to GL09's ownership table if the
+holder is cross-context shared state).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import ctxgraph
+from .astutil import call_name
+from .engine import Finding, RepoIndex
+
+_SPAWN = {"create_task", "ensure_future"}
+_RESOLVE = {"set_result", "set_exception", "cancel"}
+#: neutral observers: using the future this way neither resolves nor
+#: hands it off
+_OBSERVE = {"done", "cancelled", "result", "exception"}
+
+
+def _parents(fn_node: ast.AST) -> dict[int, ast.AST]:
+    out: dict[int, ast.AST] = {}
+    stack = [fn_node]
+    while stack:
+        n = stack.pop()
+        for c in ast.iter_child_nodes(n):
+            out[id(c)] = n
+            if not isinstance(c, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                stack.append(c)
+    return out
+
+
+# -- (a) task retention ----------------------------------------------------
+
+
+def _task_findings(fi: ctxgraph.FuncInfo) -> list[Finding]:
+    out = []
+    spawn_calls = [n for n in fi.body_walk()
+                   if isinstance(n, ast.Call)
+                   and call_name(n.func) in _SPAWN]
+    if not spawn_calls:
+        return out
+    parents = _parents(fi.node)
+    for call in spawn_calls:
+        p = parents.get(id(call))
+        if isinstance(p, ast.Expr):
+            out.append(Finding(
+                "GL08", fi.path, call.lineno,
+                "create_task/ensure_future result discarded — the "
+                "loop holds only a weak reference; an un-retained "
+                "task can be GC'd mid-flight (the PR-12 passed-fd "
+                "serve-task bug).  Keep it: add to a set with an "
+                "add_done_callback(discard), assign it, or await it"))
+            continue
+        if isinstance(p, (ast.Assign, ast.AnnAssign)) or \
+                isinstance(p, ast.NamedExpr):
+            targets = p.targets if isinstance(p, ast.Assign) \
+                else [p.target]
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if not names:
+                continue  # stored to attribute/subscript: retained
+            used = False
+            for n in fi.body_walk():
+                if isinstance(n, ast.Name) and n.id in names and \
+                        isinstance(n.ctx, ast.Load):
+                    used = True
+                    break
+            if not used:
+                out.append(Finding(
+                    "GL08", fi.path, call.lineno,
+                    f"task assigned to {names[0]!r} but never used — "
+                    f"a local that dies at function exit does not "
+                    f"retain the task (weak-ref GC hazard); store "
+                    f"it, await it, or register a done callback"))
+    return out
+
+
+# -- (b) future resolution on all paths ------------------------------------
+
+
+def _future_names(fi: ctxgraph.FuncInfo) -> list[tuple[str, ast.AST]]:
+    out = []
+    for n in fi.body_walk():
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Name) and \
+                isinstance(n.value, ast.Call) and \
+                call_name(n.value.func) == "create_future":
+            out.append((n.targets[0].id, n))
+    return out
+
+
+def _is_resolve(node: ast.AST, name: str) -> bool:
+    """Does this subtree resolve ``name`` (set_result/exception/cancel
+    directly on it)?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr in _RESOLVE and \
+                isinstance(n.func.value, ast.Name) and \
+                n.func.value.id == name:
+            return True
+    return False
+
+
+def _escapes(fi: ctxgraph.FuncInfo, name: str,
+             parents: dict[int, ast.AST]) -> bool:
+    """Any use of ``name`` that hands the future to someone else: call
+    argument, return, yield, stored into an attribute/subscript/
+    container, aliased, awaited after storing...  Conservative: any
+    Load that is not a direct .set_*/.cancel/observer attribute access
+    counts as an escape."""
+    for n in fi.body_walk():
+        if not (isinstance(n, ast.Name) and n.id == name
+                and isinstance(n.ctx, ast.Load)):
+            continue
+        # direct attribute access on the name?
+        parent = parents.get(id(n))
+        if isinstance(parent, ast.Attribute) and \
+                parent.attr in (_RESOLVE | _OBSERVE |
+                                {"add_done_callback"}):
+            continue
+        if isinstance(parent, ast.Await):
+            continue  # awaiting does not transfer ownership
+        return True
+    return False
+
+
+class _Flow:
+    """Tiny path-sensitive walk over the statement tree.  State per
+    path is ``(ok, created)`` where ``ok`` means "no outstanding
+    unresolved future on this path" (vacuously true before creation);
+    creation flips ok False, a resolve flips it True.  Creation is
+    detected uniformly during recursion, so a ``create_future()``
+    nested in an if/try/with body is analyzed like a top-level one.
+    ``raise`` ends a path harmlessly (an escaping exception means no
+    caller ever saw the future); loops are approximated as
+    zero-or-once for leak detection."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.leak: int | None = None
+
+    def block(self, stmts: list[ast.AST], ok: bool,
+              created: bool) -> tuple[bool, bool, bool]:
+        """Returns (ok_at_fallthrough, created_at_fallthrough,
+        falls_through)."""
+        for stmt in stmts:
+            if self._creates(stmt):
+                created, ok = True, False
+                continue
+            if isinstance(stmt, ast.Return):
+                if not ok:
+                    self.leak = self.leak or stmt.lineno
+                return ok, created, False
+            if isinstance(stmt, ast.Raise):
+                return ok, created, False
+            if isinstance(stmt, ast.If):
+                o1, c1, f1 = self.block(stmt.body, ok, created)
+                o2, c2, f2 = self.block(stmt.orelse, ok, created)
+                if not f1 and not f2:
+                    return ok, created or c1 or c2, False
+                falls = ([(o1, c1)] if f1 else []) + \
+                        ([(o2, c2)] if f2 else [])
+                ok = all(o for o, _ in falls)
+                created = any(c for _, c in falls)
+                continue
+            if isinstance(stmt, ast.Try):
+                ob, cb, fb = self.block(stmt.body + stmt.orelse,
+                                        ok, created)
+                # exception edge: the raise may land between a
+                # creation in the body and its resolve, so a handler
+                # entered after an in-body creation starts not-ok
+                body_creates = cb and not created
+                ok_h = ok and not body_creates
+                falls: list[tuple[bool, bool]] = []
+                created_any = cb
+                if fb:
+                    falls.append((ob, cb))
+                for h in stmt.handlers:
+                    oh, ch, fh = self.block(h.body, ok_h,
+                                            created or cb)
+                    created_any = created_any or ch
+                    if fh:
+                        falls.append((oh, ch))
+                if stmt.finalbody:
+                    if self._resolves_list(stmt.finalbody):
+                        falls = [(True, c) for _, c in falls] or \
+                            [(True, created_any)]
+                    _, _, ff = self.block(
+                        stmt.finalbody,
+                        bool(falls) and all(o for o, _ in falls),
+                        created_any)
+                    if not ff:
+                        return (bool(falls) and
+                                all(o for o, _ in falls),
+                                created_any, False)
+                if not falls:
+                    return ok, created_any, False
+                ok = all(o for o, _ in falls)
+                created = created_any
+                continue
+            if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                self.block(stmt.body, ok, created)
+                self.block(stmt.orelse, ok, created)
+                continue  # may run zero times: state unchanged
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                ok, created, ft = self.block(stmt.body, ok, created)
+                if not ft:
+                    return ok, created, False
+                continue
+            if self._resolves(stmt):
+                ok = True
+        return ok, created, True
+
+    def _creates(self, stmt: ast.AST) -> bool:
+        return isinstance(stmt, ast.Assign) and \
+            len(stmt.targets) == 1 and \
+            isinstance(stmt.targets[0], ast.Name) and \
+            stmt.targets[0].id == self.name and \
+            isinstance(stmt.value, ast.Call) and \
+            call_name(stmt.value.func) == "create_future"
+
+    def _resolves(self, stmt: ast.AST) -> bool:
+        return _is_resolve(stmt, self.name)
+
+    def _resolves_list(self, stmts: list[ast.AST]) -> bool:
+        return any(_is_resolve(s, self.name) for s in stmts)
+
+
+def _future_findings(fi: ctxgraph.FuncInfo) -> list[Finding]:
+    out = []
+    names = _future_names(fi)
+    if not names:
+        return out
+    parents = _parents(fi.node)
+    for name, creation in names:
+        if _escapes(fi, name, parents):
+            continue  # ownership transferred; holder's problem
+        flow = _Flow(name)
+        ok, created, falls = flow.block(
+            list(getattr(fi.node, "body", [])), True, False)
+        if falls and created and not ok:
+            flow.leak = flow.leak or creation.lineno
+        if flow.leak:
+            out.append(Finding(
+                "GL08", fi.path, flow.leak,
+                f"future {name!r} can reach function exit unresolved "
+                f"— whoever awaits it wedges forever (the PR-7 "
+                f"orphaned event-pool future); resolve it on every "
+                f"path, exception edges included (set_exception in "
+                f"the handler or cancel in a finally)"))
+    return out
+
+
+def check(idx: RepoIndex) -> list[Finding]:
+    g = ctxgraph.build(idx)
+    out: list[Finding] = []
+    for qual, fi in g.funcs.items():
+        if fi.path not in idx.code or fi.scope == "<module>":
+            continue
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        out.extend(_task_findings(fi))
+        out.extend(_future_findings(fi))
+    return out

@@ -1,0 +1,176 @@
+"""GL09 — cross-context shared-state ownership (graft-race).
+
+Historical bug: PR 12's ``maybe_initialize`` probe raced the
+join-thread spawn window — a state attribute written by loop code and
+read by a probe thread, with the transition invisible to review
+because nothing DECLARED the attribute as cross-context.
+
+The contract: an instance attribute written in one execution context
+and touched in the other (per :mod:`ctxgraph`) is cross-context shared
+state and must be accounted for, in order of preference:
+
+1. **machine-verified lock-protected** — every cross-context access
+   sits lexically inside a ``with <threading lock>:`` of the same
+   class/module; nothing to declare, the code proves itself;
+2. **immutable-after-start** — written only by context-UNKNOWN code
+   (``__init__`` and other pre-concurrency setup); reads from either
+   context are then safe by construction, nothing to declare;
+3. **declared** — an entry in :data:`tables.OWNERSHIP` keyed
+   ``path::Class.attr`` with a classification (``lock-protected`` for
+   designs the lexical check cannot see, ``immutable-after-start``
+   for hand-off-once fields, ``threadsafe-handoff`` for queues/
+   events/GIL-atomic flags) and the reason.  New cross-context state
+   is thereby a reviewed DATA edit, the graft-lint precedent.
+
+Stale OWNERSHIP entries (attr no longer cross-context, or gone) are
+findings too.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import ctxgraph, tables
+from .astutil import dotted
+from .engine import Finding, RepoIndex
+
+_CLASSIFICATIONS = ("lock-protected", "immutable-after-start",
+                    "threadsafe-handoff")
+
+
+def _lock_spans(fi: ctxgraph.FuncInfo, locks) -> list[tuple]:
+    from .gl07_locks import _with_lock_items
+    spans = []
+    for wnode, _lock, _body in _with_lock_items(fi.node, locks):
+        spans.append((wnode.lineno,
+                      getattr(wnode, "end_lineno", wnode.lineno)))
+    return spans
+
+
+def _self_accesses(fi: ctxgraph.FuncInfo):
+    """(attr, is_write, lineno) for every ``self.X`` touch in this
+    function's own body."""
+    for n in fi.body_walk():
+        if isinstance(n, ast.Attribute) and \
+                isinstance(n.value, ast.Name) and n.value.id == "self":
+            yield (n.attr, isinstance(n.ctx, (ast.Store, ast.Del)),
+                   n.lineno)
+        elif isinstance(n, ast.AugAssign) and \
+                isinstance(n.target, ast.Attribute) and \
+                isinstance(n.target.value, ast.Name) and \
+                n.target.value.id == "self":
+            # AugAssign target is Store; the read side is implicit
+            yield (n.target.attr, True, n.lineno)
+
+
+def check(idx: RepoIndex) -> list[Finding]:
+    from .gl07_locks import _lock_env
+    g = ctxgraph.build(idx)
+    lock_env = _lock_env(idx)
+    out: list[Finding] = []
+
+    # group methods (nested closures included — they carry the
+    # enclosing class) by (path, class)
+    by_class: dict[tuple[str, str], list[ctxgraph.FuncInfo]] = {}
+    for fi in g.funcs.values():
+        if fi.cls is not None and fi.path in idx.code:
+            by_class.setdefault((fi.path, fi.cls), []).append(fi)
+
+    live_keys: set[str] = set()
+    for (path, cls), methods in sorted(by_class.items()):
+        locks = lock_env.get(path, {})
+        # attr -> per-context access records
+        acc: dict[str, dict] = {}
+        ctxs_present = set()
+        for fi in methods:
+            ctx = g.ctx(fi.qual)
+            if not ctx:
+                # context-unknown code (constructors, CLI paths):
+                # writes here are "before concurrency" — the
+                # immutable-after-start auto-pass falls out of simply
+                # not counting them
+                continue
+            if fi.scope.split(".")[-1] in ("__init__", "__new__"):
+                # constructor writes happen before the object is
+                # published to any other context (even when the
+                # constructor itself runs under a classified context)
+                continue
+            ctxs_present |= ctx
+            spans = _lock_spans(fi, locks)
+            for attr, is_write, line in _self_accesses(fi):
+                a = acc.setdefault(attr, _blank())
+                locked = any(lo <= line <= hi for lo, hi in spans)
+                for c in ctx:
+                    key = ("write" if is_write else "read", c)
+                    a["sites"].setdefault(key, []).append(
+                        (line, locked))
+
+        if not ({"loop", "thread"} <= ctxs_present):
+            continue  # not a hybrid class
+
+        for attr, a in sorted(acc.items()):
+            sites = a["sites"]
+            loop_w = sites.get(("write", "loop"), [])
+            thr_w = sites.get(("write", "thread"), [])
+            loop_r = sites.get(("read", "loop"), [])
+            thr_r = sites.get(("read", "thread"), [])
+            cross = (loop_w and (thr_r or thr_w)) or \
+                    (thr_w and (loop_r or loop_w))
+            key = f"{path}::{cls}.{attr}"
+            if not cross:
+                continue
+            live_keys.add(key)
+            declared = tables.OWNERSHIP.get(key)
+            if declared is not None:
+                cl = declared[0] if isinstance(declared, tuple) \
+                    else None
+                if cl not in _CLASSIFICATIONS:
+                    first = (loop_w + thr_w + loop_r + thr_r)[0][0]
+                    out.append(Finding(
+                        "GL09", path, first,
+                        f"tables.OWNERSHIP[{key!r}] classification "
+                        f"{cl!r} is not one of {_CLASSIFICATIONS}"))
+                continue
+            # machine-verified lock-protected?  Writes must be locked,
+            # and so must reads in a context some OTHER context writes
+            # from; a read beside its own context's writes needs no
+            # lock against itself.
+            relevant = list(loop_w) + list(thr_w)
+            if thr_w:
+                relevant += loop_r
+            if loop_w:
+                relevant += thr_r
+            if relevant and all(locked for _, locked in relevant):
+                continue
+            all_sites = loop_w + thr_w + loop_r + thr_r
+            first = min(ln for ln, _ in all_sites)
+            wctx = "loop" if loop_w else "thread"
+            octx = "thread" if wctx == "loop" else "loop"
+            out.append(Finding(
+                "GL09", path, first,
+                f"{cls}.{attr} is written in {wctx} context and "
+                f"touched from {octx} context without a lock the "
+                f"checker can see — cross-context state must be "
+                f"lock-protected (with the class lock at every "
+                f"site), immutable-after-start, or declared in "
+                f"tables.OWNERSHIP[{key!r}] with its classification "
+                f"and reason"))
+
+    # stale declarations (full-tree runs only: cross-context liveness
+    # depends on callers/seeds that may sit outside a narrowed scan)
+    for key, entry in (tables.OWNERSHIP.items()
+                       if getattr(idx, "full_tree", True) else ()):
+        path = key.split("::")[0]
+        if path in idx.code and key not in live_keys:
+            reason = entry[1] if isinstance(entry, tuple) and \
+                len(entry) > 1 else ""
+            out.append(Finding(
+                "GL09", path, 1,
+                f"stale tables.OWNERSHIP entry {key!r} — the "
+                f"attribute is no longer cross-context (or the class "
+                f"is gone); delete the entry (reason was: {reason})"))
+    return out
+
+
+def _blank() -> dict:
+    return {"sites": {}}

@@ -197,6 +197,109 @@ CAPABILITIES = {
 }
 
 # --------------------------------------------------------------------------
+# GL06-GL09 — graft-race concurrency plane (ctxgraph)
+# --------------------------------------------------------------------------
+
+#: Extra thread-context entry points the syntax cannot see (dynamic
+#: dispatch, callables stored then spawned elsewhere).  Key:
+#: ``path::Scope.func``; value: why this runs on a thread.
+CTX_THREAD_ENTRY: dict[str, str] = {}
+
+#: Extra loop-context entry points (callables registered with a loop
+#: through an indirection ctxgraph cannot follow).
+CTX_LOOP_ENTRY: dict[str, str] = {}
+
+#: Functions whose ``set_result``/``set_exception`` from thread
+#: context resolve a **concurrent.futures.Future** (thread-safe by
+#: contract) rather than an asyncio future.  Key: ``path::Scope.func``.
+THREADSAFE_FUTURE_RESOLVE: dict[str, str] = {}
+
+#: Callables that trace/compile on FIRST call (jax.jit laziness):
+#: calling one inside a ``with <threading.Lock>`` body turns the lock
+#: into a seconds-long process-wide stall (GL07).  Key: dotted-name
+#: suffix as written at call sites; value: what makes it lazy.
+_MESH_JIT = "lru-cached jax.jit factory — the returned callable " \
+    "traces + compiles the whole mesh program at first call per shape"
+KNOWN_LAZY: dict[str, str] = {
+    "sharded_step_fn": _MESH_JIT + " (parallel/mesh_codec.py)",
+    "_encode_fn": _MESH_JIT,
+    "_parity_fn": _MESH_JIT,
+    "_decode_fn": _MESH_JIT + " (one program per surviving mask)",
+    "_ring_decode_fn": _MESH_JIT + " (parallel/ring_codec.py)",
+    "jax.jit":
+        "jit construction is cheap but the returned callable compiles "
+        "at first call; building it under a lock invites calling it "
+        "there too",
+}
+
+#: Sites that hold a lock across a known-lazy call ON PURPOSE
+#: (serializing the first compile IS the design, the PR-8 second-pass
+#: fix).  Key: ``path::Scope.func::lazy-name``; value: reason.
+_BUILD_LOCK_WHY = "deliberate (PR 8, second review pass): jax.jit is " \
+    "LAZY, so the serialization _BUILD_LOCK exists for — two flush " \
+    "workers racing an encode/decode first trace+compile (observed " \
+    "once as a pybind11 instance-allocation failure under e2e load) " \
+    "— only happens when the lock SPANS the jitted call; holding it " \
+    "costs little because the backend serializes on-device execution " \
+    "anyway and shape bucketing bounds how often a call compiles"
+LAZY_UNDER_LOCK_OK: dict[str, str] = {
+    "glusterfs_tpu/parallel/mesh_codec.py::run_step::sharded_step_fn":
+        _BUILD_LOCK_WHY,
+    "glusterfs_tpu/parallel/mesh_codec.py::sharded_encode::_encode_fn":
+        _BUILD_LOCK_WHY,
+    "glusterfs_tpu/parallel/mesh_codec.py::sharded_encode::_parity_fn":
+        _BUILD_LOCK_WHY + " (systematic branch)",
+    "glusterfs_tpu/parallel/mesh_codec.py::sharded_parity::_parity_fn":
+        _BUILD_LOCK_WHY,
+    "glusterfs_tpu/parallel/mesh_codec.py::sharded_decode::_decode_fn":
+        _BUILD_LOCK_WHY,
+    "glusterfs_tpu/parallel/ring_codec.py::ring_decode::_ring_decode_fn":
+        _BUILD_LOCK_WHY,
+}
+
+#: Cross-context instance attributes (written in one of loop/thread
+#: context, touched in the other) that are neither machine-verifiably
+#: lock-protected nor immutable-after-start.  Key:
+#: ``path::Class.attr``; value: (classification, reason) with
+#: classification one of "lock-protected" (a design the lexical check
+#: cannot see), "immutable-after-start", "threadsafe-handoff"
+#: (queue/event/GIL-atomic flag).  New cross-context state is a
+#: reviewed DATA edit here — the graft-lint precedent (GL09).
+OWNERSHIP: dict[str, tuple[str, str]] = {
+    "glusterfs_tpu/features/changelog.py::ChangelogLayer._dir": (
+        "immutable-after-start",
+        "set once in async init() before the brick serves a single "
+        "fop; the history-scan closure (asyncio.to_thread) and the "
+        "journal writers only ever read it"),
+    "glusterfs_tpu/mount/fuse_bridge.py::FuseBridge.dev_fd": (
+        "threadsafe-handoff",
+        "GIL-atomic int sentinel: mount() publishes the fd BEFORE "
+        "spawning the reader/writer split threads, and the only "
+        "cross-context write afterwards is _teardown's -1, which the "
+        "threads poll to stand down (each thread OWNS its actual fd: "
+        "_rfd/_wfd, closed by the owner) — the documented split-plane "
+        "teardown contract (docs/event_threads.md)"),
+    "glusterfs_tpu/ops/batch.py::BatchingCodec._cpu": (
+        "lock-protected",
+        "double-checked lazy build under self._lock (the graft-race "
+        "fix): the unlocked fast-path read can see a stale None and "
+        "then serializes on the lock; it can never see a partially "
+        "built codec because the GIL publishes the assignment whole"),
+    "glusterfs_tpu/ops/batch.py::BatchingCodec._mesh": (
+        "threadsafe-handoff",
+        "written exactly once by the warm thread BEFORE _mesh_state "
+        "flips to 'ready' (program-order publication the GIL makes "
+        "visible); loop readers gate every access on _mesh_state"),
+    "glusterfs_tpu/ops/batch.py::BatchingCodec._mesh_state": (
+        "threadsafe-handoff",
+        "single-writer state machine (off -> warming -> ready/"
+        "unavailable) advanced only by the warm thread via GIL-atomic "
+        "str assignment; loop reads tolerate staleness BY DESIGN — "
+        "'warming' routes flushes to the measured ladder fallback, "
+        "which is the codec's whole wedge-safety story"),
+}
+
+# --------------------------------------------------------------------------
 # GL05 — metrics plane
 # --------------------------------------------------------------------------
 

@@ -1,6 +1,9 @@
 #!/usr/bin/env bash
 # lint.sh — the local one-liner for the graft-lint suite (ci.sh runs
-# the same thing as stage 0).  Usage: tools/lint.sh [--json] [paths...]
+# the same thing as stage 0).
+# Usage: tools/lint.sh [--json] [--changed] [paths...]
+#   --changed : lint only git-modified files + their table anchors —
+#               the fast pre-commit path (full tree stays the ci gate)
 set -u
 cd "$(dirname "$0")/.."
 exec env JAX_PLATFORMS=cpu python tools/graft_lint/run.py "$@"
