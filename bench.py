@@ -1319,6 +1319,184 @@ def event_threads_sweep() -> dict:
     return out
 
 
+def lease_sweep(obj_kib: int = 64, ladder=(64, 512),
+                budget_s: float = 150.0) -> dict:
+    """The lease-held hot-object pair (ISSUE 16): the SAME gateway
+    stack — brick posix/locks/leases/upcall, 4-client glfs pool —
+    serving ONE hot ``obj_kib``-KiB object to N keep-alive HTTP
+    clients, with the gateway object cache off (``unleased_``, every
+    GET walks the wire) vs on (``leased_``, the gateway holds a read
+    lease and serves from memory).  One variable flips.
+
+    Bench honesty on a shared 2-core host: the MiB/s pair swings with
+    scheduling (driver, brick, and gateway contend for the same
+    cores), so each rung also records ``wire_fops_per_get`` — the
+    scheduling-independent fact.  Leased must sit at 0.0 after the
+    fill; unleased pays the full lookup/open/read chain per GET.  The
+    leased mode's cache-hit ratio goes on the record, and every
+    unmeasured rung is an explicit ``skipped:`` row."""
+    import asyncio
+    import tempfile
+
+    out: dict = {"lease_sweep_host_cores": host_cores()}
+    rows = [f"{m}gateway_get_c{n}_{suf}"
+            for m in ("unleased_", "leased_") for n in ladder
+            for suf in ("MiB_s", "wire_fops_per_get")]
+    rows.append("leased_gateway_cache_hit_ratio")
+    t_start = time.perf_counter()
+
+    async def run():
+        from glusterfs_tpu.api.glfs import Client, wait_connected
+        from glusterfs_tpu.core.graph import Graph
+        from glusterfs_tpu.core.layer import walk
+        from glusterfs_tpu.daemon import serve_brick
+        from glusterfs_tpu.gateway import ClientPool, ObjectGateway
+        from glusterfs_tpu.gateway.minihttp import request
+        from glusterfs_tpu.protocol.client import ClientLayer
+
+        payload = np.random.default_rng(16).integers(
+            0, 256, obj_kib << 10, dtype=np.uint8).tobytes()
+
+        def pool_wire(gw):
+            return sum(l.rpc_roundtrips
+                       for c in gw.pool.clients
+                       for l in walk(c.graph.top)
+                       if isinstance(l, ClientLayer))
+
+        for mode, csize in (("unleased_", 0), ("leased_", 64 << 20)):
+            # fresh stack per mode: no leases or cached state may
+            # leak from one arm of the pair into the other
+            base = tempfile.mkdtemp(prefix=f"leasebench_{mode}")
+            server = await serve_brick(f"""
+volume posix
+    type storage/posix
+    option directory {os.path.join(base, 'b')}
+end-volume
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+volume leases
+    type features/leases
+    subvolumes locks
+end-volume
+volume upcall
+    type features/upcall
+    subvolumes leases
+end-volume
+""")
+            text = f"""
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {server.port}
+    option remote-subvolume upcall
+    option compound-fops on
+    option ping-timeout 60
+end-volume
+volume wb
+    type performance/write-behind
+    option compound-fops on
+    subvolumes c0
+end-volume
+"""
+
+            async def factory():
+                g = Graph.construct(text)
+                c = Client(g)
+                await c.mount()
+                await wait_connected(g)
+                return c
+
+            gw = ObjectGateway(ClientPool(factory, 4),
+                               max_clients=2 * max(ladder),
+                               volume="bench",
+                               object_cache_size=csize)
+            await gw.start()
+            try:
+                r0, w0 = await asyncio.open_connection(gw.host, gw.port)
+                assert (await request(r0, w0, "PUT", "/b"))[0] == 200
+                assert (await request(r0, w0, "PUT", "/b/hot",
+                                      body=payload))[0] == 200
+                # warm GET: jit/fd/pool paths off the clock, and in
+                # leased mode the fill — the lease + cache entry land
+                # here so the measured rungs see steady state
+                assert (await request(r0, w0, "GET", "/b/hot"))[0] == 200
+                w0.close()
+
+                for n in ladder:
+                    if time.perf_counter() - t_start > budget_s:
+                        for suf in ("MiB_s", "wire_fops_per_get"):
+                            out[f"{mode}gateway_get_c{n}_{suf}"] = \
+                                "skipped: lease sweep time budget " \
+                                "exhausted"
+                        continue
+                    reqs = max(1, 1024 // n)  # ~1024 GETs per rung
+                    conns = []
+                    try:
+                        for _ in range(n):
+                            conns.append(await asyncio.open_connection(
+                                gw.host, gw.port))
+
+                        async def client(i):
+                            cr, cw = conns[i]
+                            for _ in range(reqs):
+                                st, _, body = await request(
+                                    cr, cw, "GET", "/b/hot")
+                                assert st == 200 and \
+                                    len(body) == len(payload), (st, n)
+
+                        wire0 = pool_wire(gw)
+                        total_mib = n * reqs * len(payload) / MIB
+                        t0 = time.perf_counter()
+                        await asyncio.gather(*(client(i)
+                                               for i in range(n)))
+                        dt = time.perf_counter() - t0
+                        out[f"{mode}gateway_get_c{n}_MiB_s"] = round(
+                            total_mib / dt, 1)
+                        out[f"{mode}gateway_get_c{n}"
+                            f"_wire_fops_per_get"] = round(
+                            (pool_wire(gw) - wire0) / (n * reqs), 3)
+                        out[f"{mode}gateway_obj_KiB"] = obj_kib
+                    except Exception as e:  # rung fails, pair continues
+                        for suf in ("MiB_s", "wire_fops_per_get"):
+                            out.setdefault(
+                                f"{mode}gateway_get_c{n}_{suf}",
+                                f"skipped: {e!r}"[:200])
+                    finally:
+                        for _, cw in conns:
+                            try:
+                                cw.close()
+                            except Exception:
+                                pass
+                if csize:
+                    d = gw._ocache.dump()
+                    seen = d["hits"] + d["misses"]
+                    out["leased_gateway_cache_hit_ratio"] = round(
+                        d["hits"] / seen, 4) if seen else \
+                        "skipped: no cache traffic"
+            finally:
+                await gw.stop()
+                await server.stop()
+
+    try:
+        asyncio.run(run())
+    except Exception as e:  # whole-bench failure: every row says why
+        reason = f"skipped: {e!r}"[:200]
+        for row in rows:
+            out.setdefault(row, reason)
+    for row in rows:
+        out.setdefault(row, "skipped: not measured")
+    out["lease_sweep_analysis"] = (
+        f"{out['lease_sweep_host_cores']} schedulable cores shared by "
+        f"brick, gateway, and the bench driver, so the MiB/s pair swings "
+        f"with scheduling; wire_fops_per_get is the "
+        f"scheduling-independent column — leased serves the hot "
+        f"object from the lease-held cache at 0 wire fops per GET "
+        f"after the fill, unleased pays the full per-GET fop chain")
+    return out
+
+
 def process_plane_sweep(obj_kib: int = 64) -> dict:
     """The worker-pool on/off pair (ISSUE 12): the gateway ladder's
     c64/c512 rungs through the SAME stack with ``workers=0`` (one
@@ -1967,6 +2145,15 @@ def main() -> None:
         vol.update(process_plane_sweep())
     except Exception as e:
         vol["process_plane_sweep_error"] = str(e)[:200]
+        vol.setdefault("host_cores", host_cores())
+    try:
+        # lease-held hot-object pair (ISSUE 16): ONE hot object at
+        # c64/c512 through the same stack, gateway object cache off
+        # vs on — wire_fops_per_get is the scheduling-independent
+        # column on this shared host (0 after the leased fill)
+        vol.update(lease_sweep())
+    except Exception as e:
+        vol["lease_sweep_error"] = str(e)[:200]
         vol.setdefault("host_cores", host_cores())
     # a missing wire/fuse/smallfile-wire row is an EXPLICIT
     # "skipped: <reason>" entry, never silence (r5's detail lost all

@@ -14,7 +14,11 @@ __version__ = "0.1.0"
 # minimum, gating newer volume-set keys until every member upgrades.
 # Lives here (not in mgmt/glusterd) so protocol/client can advertise it
 # at SETVOLUME without dragging the whole management plane into every
-# client process.  Version history: 14 multi-process data plane
+# client process.  Version history: 15 lease plane (brick-side lease
+# grants/recalls advertised as the "leases" SETVOLUME capability,
+# features.lease-timeout idle expiry + the gateway's lease-held object
+# cache gateway.object-cache-size, volgen._V15_KEYS); 14 multi-process
+# data plane
 # (gateway.workers shared-nothing worker pool + cluster.mesh-distributed
 # jax.distributed brick mesh, volgen._V14_KEYS; also lifts the
 # mesh-codec-vs-systematic mutual exclusion — the mesh tier gained a
@@ -35,4 +39,4 @@ __version__ = "0.1.0"
 # diagnostics, _V7_KEYS); 6 zero-copy reads + strict-locks (_V6_KEYS);
 # 5 compound fops + auth.ssl-allow (_V5_KEYS); 4 round-5 keys
 # (_V4_KEYS); 3 the round-4 option long tail (_V3_KEYS).
-OP_VERSION = 14
+OP_VERSION = 15

@@ -30,7 +30,7 @@ from ..core.fops import FopError
 from ..core.graph import Graph
 from ..core.iatt import Iatt, ROOT_GFID
 from ..core.inode import InodeTable
-from ..core.layer import Event, FdObj, Loc
+from ..core.layer import Event, FdObj, Loc, walk
 
 # one-shot whole-file read window (readv truncates at EOF); files larger
 # than this continue in a loop.  Kept moderate: page-granular perf
@@ -188,6 +188,47 @@ class File:
                 await release(self.fd)
 
 
+class HeldLeases:
+    """Client-held lease registry (the glfs_lease state, reference
+    api/src/glfs-handleops.c glfs_h_lease + leases client tables).
+
+    The perf caches (md-cache/quick-read/io-cache) and the gateway
+    object cache key zero-round-trip mode off :meth:`held`: while a
+    gfid is here, cached state is served with NO wire revalidation —
+    the brick's recall contract is the coherence story.  ``drop`` fires
+    its ``on_drop`` callbacks *synchronously*, so everything keyed on
+    the lease is gone before the recall is acked back to the brick."""
+
+    __slots__ = ("_m", "on_drop")
+
+    def __init__(self):
+        self._m: dict[bytes, tuple[str, str]] = {}  # gfid -> (id, type)
+        self.on_drop: list = []  # callbacks fired as (gfid) on drop
+
+    def grant(self, gfid: bytes, lease_id: str, ltype: str) -> None:
+        self._m[bytes(gfid)] = (lease_id, ltype)
+
+    def held(self, gfid) -> bool:
+        return gfid is not None and bytes(gfid) in self._m
+
+    def get(self, gfid) -> tuple[str, str] | None:
+        return self._m.get(bytes(gfid))
+
+    def drop(self, gfid) -> tuple[str, str] | None:
+        out = self._m.pop(bytes(gfid), None)
+        if out is not None:
+            for cb in self.on_drop:
+                cb(bytes(gfid))
+        return out
+
+    def clear(self) -> None:
+        for gfid in list(self._m):
+            self.drop(gfid)
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+
 class _UpcallSink:
     """Top-of-graph event tap — the glfs upcall consumer (reference
     api/src/glfs-handleops.c glfs_h_poll_upcall / the mount's
@@ -197,19 +238,43 @@ class _UpcallSink:
     (the object gateway) deleting and recreating a path leaves this
     client resolving the dead gfid out of its itable forever — the
     layer caches (md-cache/io-cache) revalidate on upcall, but the
-    api-level dentry cache must too."""
+    api-level dentry cache must too.
 
-    __slots__ = ("itable", "invalidations")
+    Lease recalls land here LAST: ``notify`` propagates bottom-up, so
+    by the time the recall reaches this top-of-graph tap every layer
+    cache below has already dropped the gfid's state.  Dropping the
+    held-lease entry here and only then scheduling the release ack is
+    what makes "drop cached state synchronously before the ack" true
+    by construction, not by convention."""
 
-    def __init__(self, itable: InodeTable):
+    __slots__ = ("itable", "invalidations", "client")
+
+    def __init__(self, itable: InodeTable, client=None):
+        import weakref
+
         self.itable = itable
         self.invalidations = 0
+        self.client = weakref.ref(client) if client is not None else None
 
     def notify(self, event, source=None, data=None) -> None:
         if event is Event.UPCALL and isinstance(data, dict) and \
                 data.get("gfid"):
             self.invalidations += 1
             self.itable.invalidate(data["gfid"])
+            if data.get("event") == "lease-recall":
+                client = self.client() if self.client is not None \
+                    else None
+                if client is not None:
+                    client._lease_recalled(data["gfid"],
+                                           data.get("lease-id", ""),
+                                           data.get("reason", ""))
+        elif event is Event.CHILD_DOWN and self.client is not None:
+            # a dropped connection means the brick is reaping our
+            # grants (release_client) and any recall it pushed was
+            # lost with the socket — zero-RT mode MUST end locally too
+            client = self.client()
+            if client is not None and len(client.leases):
+                client.leases.clear()
 
 
 class Client:
@@ -220,13 +285,33 @@ class Client:
         self.itable = InodeTable()
         self.mounted = False
         self.watchers: list = []  # background tasks (volfile watcher)
-        self.upcall_sink = _UpcallSink(self.itable)
+        self.upcall_sink = _UpcallSink(self.itable, client=self)
+        # held-lease registry + this client's lease identity (one id
+        # per glfs_t, minted once — the brick keys revocation poisoning
+        # on (client, lease-id), so reusing the id across files is
+        # fine and across a revoke is caught)
+        self.leases = HeldLeases()
+        self.lease_id = os.urandom(16).hex()
+        # tri-state capability memo: None = unprobed, False = the stack
+        # answered ENOTSUP (leases off / old brick) — stop asking
+        self._lease_ok: bool | None = None
+        self.lease_recalls = 0
+        self._lease_tasks: set = set()  # in-flight release acks
+
+    def _wire_lease_registry(self, top) -> None:
+        """Hand every lease-aware cache layer the held-lease registry
+        (zero-RT freshness checks consult it)."""
+        for layer in walk(top):
+            hook = getattr(layer, "set_lease_registry", None)
+            if hook is not None:
+                hook(self.leases)
 
     async def mount(self) -> None:
         if not self.graph.active:
             await self.graph.activate()
         if self.upcall_sink not in self.graph.top.parents:
             self.graph.top.parents.append(self.upcall_sink)
+        self._wire_lease_registry(self.graph.top)
         self.mounted = True
 
     async def unmount(self) -> None:
@@ -240,6 +325,11 @@ class Client:
             except (asyncio.CancelledError, Exception):
                 pass
         self.watchers.clear()
+        # local lease state dies with the mount — the bricks reap our
+        # grants through release_client when the connections drop
+        self.leases.clear()
+        for t in list(self._lease_tasks):
+            t.cancel()
         if self.upcall_sink in self.graph.top.parents:
             self.graph.top.parents.remove(self.upcall_sink)
         if self.graph.active:
@@ -267,6 +357,12 @@ class Client:
             if self.upcall_sink in old.top.parents:
                 old.top.parents.remove(self.upcall_sink)
             new.top.parents.append(self.upcall_sink)
+            # leases were granted through the OLD graph's connections —
+            # its bricks reap them at disconnect; the new stack starts
+            # unleased and re-probes capability
+            self.leases.clear()
+            self._lease_ok = None
+            self._wire_lease_registry(new.top)
         except BaseException:
             # cancelled/failed mid-swap: don't leak the half-built graph
             # (shielded — the fini must run even though we were cancelled)
@@ -312,6 +408,82 @@ class Client:
             raise FopError(errno.EINVAL, "cannot operate on /")
         ploc = await self.resolve(parent)
         return Loc(_norm(path), parent=ploc.gfid, name=name)
+
+    # -- leases (glfs_lease analog) ----------------------------------------
+
+    def _peers_lease_capable(self) -> bool:
+        """Every protocol client in the stack advertised lease support
+        at SETVOLUME (vacuously true for a wire-free local stack)."""
+        from ..protocol.client import ClientLayer
+
+        return all(l._peer_leases for l in walk(self.graph.top)
+                   if isinstance(l, ClientLayer))
+
+    async def lease_acquire(self, path: str, ltype: str = "rd") -> bool:
+        """Take (or keep) a lease on *path*.  True means the caches may
+        serve this gfid with zero wire fops until a recall drops it;
+        False means the stack can't or won't grant (old brick, leases
+        off, conflicting holder) and TTL revalidation stays the story.
+        Never raises for "no lease" outcomes — callers treat the lease
+        as a performance contract, not a lock."""
+        loc = await self.resolve(path)
+        gfid = bytes(loc.gfid)
+        held = self.leases.get(gfid)
+        if held is not None and (held[1] == ltype or held[1] == "rw"):
+            return True
+        if self._lease_ok is False:
+            return False
+        if not self._peers_lease_capable():
+            self._lease_ok = False
+            return False
+        try:
+            await self.graph.top.lease(loc, "grant", ltype,
+                                       self.lease_id)
+        except FopError as e:
+            if e.err in (errno.ENOTSUP, errno.EOPNOTSUPP):
+                self._lease_ok = False  # sticky: stop probing
+            return False
+        self._lease_ok = True
+        self.leases.grant(gfid, self.lease_id, ltype)
+        return True
+
+    async def lease_release(self, path: str) -> None:
+        """Voluntarily return the lease (and drop everything riding
+        on it) — glfs_lease(UNLK)."""
+        loc = await self.resolve(path)
+        gfid = bytes(loc.gfid)
+        held = self.leases.drop(gfid)
+        if held is not None:
+            await self.graph.top.lease(Loc(path, gfid=loc.gfid),
+                                       "release", held[1], held[0])
+
+    def _lease_recalled(self, gfid, lease_id: str,
+                        reason: str = "") -> None:
+        """Upcall-sink hook: the brick recalled (or expired) our
+        lease.  The layer caches already dropped the gfid's state
+        during the notify's bottom-up walk; drop the registry entry
+        (ending zero-RT mode) and THEN ack by releasing — the brick's
+        conflict gate unblocks only after nothing stale can be
+        served."""
+        gfid = bytes(gfid)
+        held = self.leases.drop(gfid)
+        if held is None:
+            return
+        self.lease_recalls += 1
+        if reason == "expired":
+            return  # the brick already dropped it; nothing to ack
+        t = asyncio.ensure_future(
+            self._lease_release_ack(gfid, held[1], held[0]))
+        self._lease_tasks.add(t)
+        t.add_done_callback(self._lease_tasks.discard)
+
+    async def _lease_release_ack(self, gfid: bytes, ltype: str,
+                                 lease_id: str) -> None:
+        try:
+            await self.graph.top.lease(Loc("", gfid=gfid), "release",
+                                       ltype, lease_id)
+        except Exception:
+            pass  # the brick revokes on timeout; our state is gone
 
     # -- namespace ops -----------------------------------------------------
 

@@ -585,6 +585,16 @@ class DistributeLayer(Layer):
         return await self._with_cached(
             loc, lambda i: self.children[i].stat(loc, xdata))
 
+    async def lease(self, loc: Loc, cmd: str, ltype: str = "rd",
+                    lease_id: str = "", xdata: dict | None = None):
+        # leases must live where the writes land: route to the cached
+        # subvol (the default first-child wind would park the lease on
+        # a brick the hashed writer never touches, so conflicting
+        # writes would never recall it)
+        return await self._with_cached(
+            loc, lambda i: self.children[i].lease(loc, cmd, ltype,
+                                                  lease_id, xdata))
+
     async def fstat(self, fd: FdObj, xdata: dict | None = None):
         ctx: DhtFdCtx = fd.ctx_get(self)
         if ctx is None:

@@ -1,11 +1,37 @@
-"""features/leases — NFS-style lease grants and recalls.
+"""features/leases — NFS-style lease grants, recalls, and the
+reader-interest commit push.
 
 Reference: xlators/features/leases (leases.c): a client may take a
 RD/RW lease on an inode; a conflicting fop from ANOTHER client recalls
 the lease (upcall to the holder) and blocks for the recall timeout; an
 unreturned lease is revoked.  Brick-side layer: leases are keyed by
-gfid and lease-id, conflict checks gate the write path, recalls ride
-the same event-push channel the upcall layer uses.
+gfid and (client, lease-id), conflict checks gate the write path,
+recalls ride the same event-push channel the upcall layer uses.
+
+The lease contract here (ISSUE 16) is what lets the client-side caches
+(md-cache/quick-read/io-cache, the gateway object cache) serve hits
+with ZERO wire fops: while a lease is held, no TTL revalidation runs —
+coherence is recall-exact, not timeout-approximate.  Three obligations
+make that sound:
+
+* **Recall before conflict.**  Any conflicting write-class fop recalls
+  holders through the upcall sink and WAITS (bounded by
+  ``recall-timeout``) before proceeding; an unreturned lease is
+  revoked and its (client, lease-id) poisoned, so a holder that went
+  quiet can never ride a stale grant back in.
+* **Grant waits out open write windows.**  A read-lease grant is the
+  reader's registered interest: if another client holds an inodelk on
+  the gfid (an EC/AFR eager window with a pending delayed post-op),
+  the grant pushes ``inodelk-contention`` at the holders via the
+  sibling locks layer and waits for the locks to clear — the pending
+  eager post-op COMMITS before the grant returns, closing the
+  cross-door read-after-PUT window PR 6 documented.
+* **Reap on disconnect.**  ``release_client`` (the client_t reap path)
+  drops a dead holder's leases, so a crashed client stalls writers for
+  at most one recall-timeout, never forever.
+
+Leases idle past ``lease-timeout`` expire (amortized sweep); the
+holder is told via the same ``lease-recall`` event so its caches drop.
 """
 
 from __future__ import annotations
@@ -16,23 +42,30 @@ import time
 from typing import Callable
 
 from ..core.fops import FopError, WRITE_FOPS
-from ..core.layer import FdObj, Layer, Loc, register
+from ..core.layer import FdObj, Layer, Loc, register, walk
 from ..core.options import Option
 from ..core import gflog
+from ..core.events import gf_event
+from ..core.metrics import REGISTRY
 from ..rpc import wire
 
 log = gflog.get_logger("leases")
 
 RD_LEASE, RW_LEASE = "rd", "rw"
 
+#: recall poll period while waiting out a recall / an open write window
+_POLL = 0.02
+
 
 class _Lease:
-    __slots__ = ("lease_id", "ltype", "client", "recalled_at")
+    __slots__ = ("lease_id", "ltype", "client", "granted_at",
+                 "recalled_at")
 
     def __init__(self, lease_id: str, ltype: str, client: bytes):
         self.lease_id = lease_id
         self.ltype = ltype
         self.client = client
+        self.granted_at = time.monotonic()
         self.recalled_at = 0.0
 
 
@@ -43,6 +76,11 @@ class LeasesLayer(Layer):
         Option("recall-timeout", "time", default="2",
                description="grace before an unreturned lease is "
                            "revoked (lease-lock-recall-timeout)"),
+        Option("lease-timeout", "time", default="600", min=0,
+               description="idle expiry: a lease not renewed (by the "
+                           "holder's reads or a repeat grant) for this "
+                           "long is dropped and the holder told "
+                           "(features.lease-timeout); 0 = never"),
     )
 
     def __init__(self, *args, **kw):
@@ -52,20 +90,110 @@ class LeasesLayer(Layer):
         # revocations are per (client, lease-id) — one client's
         # revoked id must not poison everyone else's
         self._revoked: set[tuple[bytes, str]] = set()
+        # recall/drop accounting by reason (the
+        # gftpu_lease_recalls_total family): conflict = a conflicting
+        # fop recalled holders; revoked = the recall grace expired;
+        # expired = idle past lease-timeout; disconnect = client_t reap
+        self.recalls: dict[str, int] = {"conflict": 0, "revoked": 0,
+                                        "expired": 0, "disconnect": 0}
+        self._ops = 0  # amortized-sweep counter
+        self._locks = None  # sibling locks layer (resolved lazily)
+        _LIVE_LEASES.add(self)
 
     def set_upcall_sink(self, sink) -> None:
         self._sink = sink
 
     def release_client(self, identity: bytes) -> None:
+        """Disconnect reap (client_t cleanup, PR 9's release_client
+        walk): a dead holder's leases must not stall writers for more
+        than the one recall-timeout already in flight."""
         self._revoked = {(c, i) for c, i in self._revoked
                          if c != identity}
         for gfid in list(self._leases):
             kept = [l for l in self._leases[gfid]
                     if l.client != identity]
+            dropped = len(self._leases[gfid]) - len(kept)
+            if dropped:
+                self.recalls["disconnect"] += dropped
             if kept:
                 self._leases[gfid] = kept
             else:
                 del self._leases[gfid]
+
+    # -- expiry sweep (amortized like upcall's registry sweep) -------------
+
+    def _expire(self) -> None:
+        timeout = self.opts["lease-timeout"]
+        if not timeout:
+            return
+        horizon = time.monotonic() - timeout
+        for gfid in list(self._leases):
+            held = self._leases[gfid]
+            dead = [l for l in held if l.granted_at < horizon]
+            if not dead:
+                continue
+            kept = [l for l in held if l not in dead]
+            if kept:
+                self._leases[gfid] = kept
+            else:
+                del self._leases[gfid]
+            self.recalls["expired"] += len(dead)
+            for l in dead:
+                # tell the holder: its zero-RT cache mode must end (the
+                # recall event doubles as the expiry notice — the
+                # client drops cached state exactly as on a recall)
+                if self._sink is not None:
+                    self._sink([l.client],
+                               {"event": "lease-recall", "gfid": gfid,
+                                "lease-id": l.lease_id,
+                                "reason": "expired"})
+                gf_event("LEASE_EXPIRED", gfid=gfid.hex(),
+                         lease_id=l.lease_id, ltype=l.ltype,
+                         brick=self.name)
+
+    def _tick(self) -> None:
+        self._ops += 1
+        if self._ops % 1024 == 0:
+            self._expire()
+
+    # -- the sibling locks layer (reader-interest commit push) -------------
+
+    def _locks_layer(self):
+        """The locks layer below us, if any — the grant path asks it
+        which OTHER clients hold inodelks on the gfid (an open eager
+        window) and nudges them to commit."""
+        if self._locks is None:
+            self._locks = next(
+                (l for l in walk(self) if l is not self
+                 and hasattr(l, "contend_gfid")), False)
+        return self._locks or None
+
+    async def _settle_windows(self, gfid: bytes, client: bytes) -> None:
+        """The reader's registered interest PUSHES any pending eager
+        post-op: fire inodelk-contention at every other client holding
+        an inodelk on this gfid (their EC/AFR drains the window and
+        commits the delayed post-op NOW), then wait — bounded by
+        recall-timeout — for the locks to clear.  After this returns
+        quiet, a lookup votes the committed size: the cross-door
+        read-after-PUT window is closed, not documented."""
+        locks = self._locks_layer()
+        if locks is None:
+            return
+        holders = locks.inodelk_holders(gfid, but_not=client)
+        if not holders:
+            return
+        locks.contend_gfid(gfid, but_not=client)
+        deadline = time.monotonic() + self.opts["recall-timeout"]
+        while time.monotonic() < deadline:
+            await asyncio.sleep(_POLL)
+            if not locks.inodelk_holders(gfid, but_not=client):
+                return
+        # an unresponsive writer must not wedge reads forever: grant
+        # anyway after the grace (the same stance the revocation plane
+        # takes on wedged locks) — the window commits on its own timer
+        log.warning(3, "%s: eager-window holders on %s ignored the "
+                    "grant nudge for %.1fs", self.name, gfid.hex(),
+                    self.opts["recall-timeout"])
 
     # -- the lease fop (GF_FOP_LEASE) --------------------------------------
 
@@ -75,8 +203,12 @@ class LeasesLayer(Layer):
         if not self.opts["leases"]:
             raise FopError(errno.ENOTSUP, "leases disabled")
         client = wire.CURRENT_CLIENT.get()
-        ia, _ = await self.children[0].lookup(loc)
-        gfid = bytes(ia.gfid)
+        if loc.gfid:
+            gfid = bytes(loc.gfid)
+        else:
+            ia, _ = await self.children[0].lookup(loc)
+            gfid = bytes(ia.gfid)
+        self._tick()
         held = self._leases.get(gfid, [])
         if cmd == "grant":
             if not lease_id:
@@ -92,8 +224,24 @@ class LeasesLayer(Layer):
                                            l.ltype == RW_LEASE):
                     raise FopError(errno.EAGAIN,
                                    "conflicting lease held")
-            self._leases.setdefault(gfid, []).append(
-                _Lease(lease_id, ltype, client))
+            prior = next((l for l in held if l.client == client
+                          and l.lease_id == lease_id), None)
+            if prior is not None:
+                # repeat grant = renewal: refresh the expiry stamp and
+                # upgrade rd -> rw in place
+                prior.granted_at = time.monotonic()
+                if ltype == RW_LEASE:
+                    prior.ltype = RW_LEASE
+            else:
+                self._leases.setdefault(gfid, []).append(
+                    _Lease(lease_id, ltype, client))
+                gf_event("LEASE_GRANTED", gfid=gfid.hex(),
+                         lease_id=lease_id, ltype=ltype,
+                         brick=self.name)
+            # the grant IS the reader's registered interest: settle any
+            # open write window before the caller starts trusting its
+            # cache (see _settle_windows)
+            await self._settle_windows(gfid, client)
             return {"granted": ltype, "lease-id": lease_id}
         if cmd == "release":
             before = len(held)
@@ -107,10 +255,17 @@ class LeasesLayer(Layer):
             return {"released": "all"}
         raise FopError(errno.EINVAL, f"lease cmd {cmd!r}")
 
+    # -- the conflict gate --------------------------------------------------
+
     async def _check(self, gfid: bytes, is_write: bool) -> None:
         """Conflict gate: recall other clients' conflicting leases and
-        wait out the grace, then revoke (lease_recall + timeout)."""
+        wait out the grace, then revoke (lease_recall + timeout).  A
+        voluntarily returned lease (the holder's release ack arrives
+        AFTER it dropped its cached state) ends the wait early — the
+        conflicting fop proceeds only once no holder can serve a stale
+        hit."""
         client = wire.CURRENT_CLIENT.get()
+        self._tick()
         held = self._leases.get(gfid, [])
         conflicting = [l for l in held if l.client != client and
                        (is_write or l.ltype == RW_LEASE)]
@@ -120,24 +275,63 @@ class LeasesLayer(Layer):
         for l in conflicting:
             if not l.recalled_at:
                 l.recalled_at = now
+                self.recalls["conflict"] += 1
+                gf_event("LEASE_RECALLED", gfid=gfid.hex(),
+                         lease_id=l.lease_id, ltype=l.ltype,
+                         brick=self.name)
                 if self._sink is not None:
+                    # raw-bytes gfid: the holder's md-cache/quick-read/
+                    # io-cache invalidate on the same payload shape the
+                    # upcall layer's cache-invalidation events carry
                     self._sink([l.client], {
                         "event": "lease-recall",
-                        "gfid": gfid.hex(), "lease-id": l.lease_id})
+                        "gfid": gfid, "lease-id": l.lease_id,
+                        "reason": "conflict"})
         deadline = max(l.recalled_at for l in conflicting) + \
             self.opts["recall-timeout"]
         while time.monotonic() < deadline:
             held = self._leases.get(gfid, [])
             if not any(l in held for l in conflicting):
                 return  # returned voluntarily
-            await asyncio.sleep(0.05)
+            await asyncio.sleep(_POLL)
         # grace expired: revoke
-        for l in conflicting:
+        survivors = [l for l in conflicting
+                     if l in self._leases.get(gfid, [])]
+        for l in survivors:
             self._revoked.add((l.client, l.lease_id))
+            self.recalls["revoked"] += 1
+            gf_event("LEASE_REVOKED", gfid=gfid.hex(),
+                     lease_id=l.lease_id, ltype=l.ltype,
+                     brick=self.name)
         self._leases[gfid] = [l for l in self._leases.get(gfid, [])
                               if l not in conflicting]
+        if not self._leases[gfid]:
+            del self._leases[gfid]
         log.warning(1, "revoked %d unreturned lease(s) on %s",
-                    len(conflicting), gfid.hex())
+                    len(survivors), gfid.hex())
+
+    async def rename(self, oldloc: Loc, newloc: Loc,
+                     xdata: dict | None = None):
+        """Rename recalls BOTH ends: the source holder loses its name,
+        and — the one the generic gate would miss — the DESTINATION
+        holder is about to have its object replaced out from under it
+        (the gateway's PUT commit is exactly this temp+rename shape).
+        The destination loc usually arrives without a gfid (it names
+        where the file WILL be), so the existing occupant is looked up
+        brick-locally."""
+        if self.opts["leases"]:
+            if oldloc.gfid:
+                await self._check(bytes(oldloc.gfid), True)
+            dst = bytes(newloc.gfid) if newloc.gfid else None
+            if dst is None:
+                try:
+                    ia, _ = await self.children[0].lookup(newloc)
+                    dst = bytes(ia.gfid)
+                except FopError:
+                    dst = None  # fresh destination: nobody to recall
+            if dst is not None:
+                await self._check(dst, True)
+        return await self.children[0].rename(oldloc, newloc, xdata)
 
     async def open(self, loc: Loc, flags: int = 0,
                    xdata: dict | None = None):
@@ -154,14 +348,58 @@ class LeasesLayer(Layer):
     async def readv(self, fd, size: int, offset: int,
                     xdata: dict | None = None):
         if self.opts["leases"] and fd.gfid:
+            gfid = bytes(fd.gfid)
             # a reader must recall another client's RW lease first
             # (its holder may be caching unwritten data)
-            await self._check(bytes(fd.gfid), False)
+            await self._check(gfid, False)
+            # the holder's own reads renew its lease (expiry is IDLE
+            # expiry, not a hard deadline on an active holder)
+            client = wire.CURRENT_CLIENT.get(None)
+            if client is not None:
+                now = time.monotonic()
+                for l in self._leases.get(gfid, []):
+                    if l.client == client:
+                        l.granted_at = now
         return await self.children[0].readv(fd, size, offset, xdata)
 
+    # -- introspection (the lease wedge view, beside PR 9's locks) ---------
+
+    def lease_status(self) -> dict:
+        """``volume status ... callpool`` share: held/recalling counts
+        and the oldest holder's age, so a stuck recall is visible, not
+        a mystery hang."""
+        now = time.monotonic()
+        held = recalling = 0
+        oldest = 0.0
+        by_type = {"rd": 0, "rw": 0}
+        for leases in self._leases.values():
+            for l in leases:
+                if l.recalled_at:
+                    recalling += 1
+                else:
+                    held += 1
+                by_type[l.ltype] = by_type.get(l.ltype, 0) + 1
+                oldest = max(oldest, now - l.granted_at)
+        return {"held": held, "recalling": recalling,
+                "by_type": by_type, "inodes": len(self._leases),
+                "oldest_holder_age": round(oldest, 3),
+                "recalls": dict(self.recalls)}
+
     def dump_private(self) -> dict:
-        return {"inodes": len(self._leases),
-                "leases": sum(len(v) for v in self._leases.values())}
+        now = time.monotonic()
+        table = []
+        for gfid, leases in self._leases.items():
+            for l in leases:
+                table.append({
+                    "gfid": gfid.hex(), "lease_id": l.lease_id[:16],
+                    "client": l.client.hex() if l.client else "",
+                    "type": l.ltype,
+                    "age": round(now - l.granted_at, 3),
+                    "recalling": bool(l.recalled_at),
+                    "recall_age": round(now - l.recalled_at, 3)
+                    if l.recalled_at else 0.0})
+        return {"inodes": len(self._leases), "leases": len(table),
+                "table": table, **self.lease_status()}
 
 
 def _gated(op_name: str):
@@ -180,5 +418,28 @@ def _gated(op_name: str):
 
 
 for _f in WRITE_FOPS:
-    if _f.value not in ("lease",):
+    # lease is the plane's own fop; rename has a two-sided check above
+    # that the single-gfid gate would clobber; xattrop/fxattrop are
+    # internal transaction fops (EC/AFR pre/post-op version commits,
+    # never issued by applications) — the reference's is_internal_fop
+    # exemption, without which a read-lease grant would deadlock
+    # against the very eager-window commit it pushes
+    if _f.value not in ("lease", "rename", "xattrop", "fxattrop"):
         setattr(LeasesLayer, _f.value, _gated(_f.value))
+
+
+# one family set scraped over every live leases layer (the
+# register_objects weak-population pattern core/metrics documents)
+_LIVE_LEASES = REGISTRY.register_objects(
+    "gftpu_leases", "gauge",
+    "brick lease tables by state (held = granted and quiet; "
+    "recalling = a recall upcall is outstanding)",
+    lambda l: [({"state": "held"}, l.lease_status()["held"]),
+               ({"state": "recalling"}, l.lease_status()["recalling"])])
+REGISTRY.register_objects(
+    "gftpu_lease_recalls_total", "counter",
+    "lease recalls/drops by reason (conflict = recall issued for a "
+    "conflicting fop; revoked = recall grace expired; expired = idle "
+    "past lease-timeout; disconnect = holder's client_t reaped)",
+    lambda l: [({"reason": k}, v) for k, v in sorted(l.recalls.items())],
+    live=_LIVE_LEASES)

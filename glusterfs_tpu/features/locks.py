@@ -403,6 +403,49 @@ class LocksLayer(Layer):
         self.contention_sent += n
         return n
 
+    def inodelk_holders(self, gfid: bytes,
+                        but_not: bytes | None = None) -> set[bytes]:
+        """Clients (other than ``but_not``) holding an inodelk on the
+        gfid in ANY domain — the leases layer's grant path asks this to
+        find open eager windows worth settling."""
+        holders: set[bytes] = set()
+        for (g, _domain), dom in self._inodelk.items():
+            if g != gfid:
+                continue
+            holders.update(x.client for x in dom.granted
+                           if x.client is not None
+                           and x.client != but_not)
+        return holders
+
+    def contend_gfid(self, gfid: bytes,
+                     but_not: bytes | None = None) -> int:
+        """Fire a contention upcall at every inodelk holder on one gfid
+        (a lease grant is a reader's registered interest: the holder's
+        eager window should commit its delayed post-op NOW).  Same
+        rate limit as _contend so a grant storm cannot flood a holder."""
+        if self._sink is None:
+            return 0
+        import time as _time
+
+        now = _time.monotonic()
+        delay = self.opts["notify-contention-delay"]
+        n = 0
+        for (g, domain), dom in list(self._inodelk.items()):
+            if g != gfid:
+                continue
+            targets = set()
+            for x in dom.granted:
+                if x.client is not None and x.client != but_not and \
+                        now - x.last_notify >= delay:
+                    x.last_notify = now
+                    targets.add(x.client)
+            for t in sorted(targets):
+                self._sink([t], {"event": "inodelk-contention",
+                                 "gfid": gfid, "domain": domain})
+                n += 1
+        self.contention_sent += n
+        return n
+
     # -- forced revocation (features.locks-revocation-*; the reference's
     # entrylk.c:129-173 revocation machinery + the inodelk twin) ----------
 

@@ -65,11 +65,18 @@ def _pool_factory(args):
     return factory
 
 
+def _object_cache_bytes(args) -> int:
+    from ..core.options import parse_size
+
+    return parse_size(args.object_cache)
+
+
 async def _amain_single(args) -> None:
     gw = ObjectGateway(ClientPool(_pool_factory(args), args.pool),
                        host=args.host, port=args.listen,
                        max_clients=args.max_clients,
-                       volume=args.volume or args.volfile)
+                       volume=args.volume or args.volfile,
+                       object_cache_size=_object_cache_bytes(args))
     await gw.start()
     if args.portfile:
         tmp = args.portfile + ".tmp"
@@ -97,7 +104,8 @@ async def _amain_worker(args) -> None:
     gw = ObjectGateway(ClientPool(_pool_factory(args), args.pool),
                        host=args.host, port=args.listen,
                        max_clients=args.max_clients,
-                       volume=args.volume or args.volfile)
+                       volume=args.volume or args.volfile,
+                       object_cache_size=_object_cache_bytes(args))
     await worker_serve(gw, args.worker_fd, args.worker_rank,
                        args.reuseport, args.host, args.listen)
 
@@ -106,7 +114,11 @@ async def _amain_supervisor(args) -> None:
     from .workers import GatewaySupervisor
 
     base_argv = [sys.executable, "-m", "glusterfs_tpu.gateway",
-                 "--pool", str(args.pool)]
+                 "--pool", str(args.pool),
+                 # per-worker budget: shared-nothing workers each own a
+                 # full cache (their own pool clients hold the leases
+                 # that keep it coherent)
+                 "--object-cache", str(_object_cache_bytes(args))]
     if args.volfile:
         base_argv += ["--volfile", args.volfile]
     else:
@@ -161,6 +173,11 @@ def main(argv=None) -> int:
                    help="connection admission limit "
                         "(gateway.max-clients; the supervisor divides "
                         "it across workers at spawn)")
+    p.add_argument("--object-cache", default="0",
+                   help="lease-held object cache budget in bytes, "
+                        "size suffixes accepted "
+                        "(gateway.object-cache-size; 0 = off; per "
+                        "worker when --workers is set)")
     p.add_argument("--metrics-port", type=int, default=0,
                    help="serve the unified metrics registry on this "
                         "port (0 = off; aggregated across workers "

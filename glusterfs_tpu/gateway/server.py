@@ -53,7 +53,8 @@ from ..core import events as gf_events
 from ..core import gflog
 from ..core.fops import FopError
 from ..core.metrics import REGISTRY, LogHistogram, labeled
-from ..rpc.wire import SGBuf
+from ..performance import cache_metrics
+from ..rpc.wire import SGBuf, as_single_buffer
 
 log = gflog.get_logger("gateway")
 
@@ -253,12 +254,98 @@ class ClientPool:
         self.clients.clear()
 
 
+class _CacheEntry:
+    __slots__ = ("gfid", "etag", "size", "mtime", "content")
+
+    def __init__(self, gfid: bytes, etag: str, size: int, mtime,
+                 content: bytes):
+        self.gfid = gfid
+        self.etag = etag
+        self.size = size
+        self.mtime = mtime
+        self.content = content
+
+
+class _ObjectCache:
+    """LRU lease-held object cache (``gateway.object-cache-size``).
+
+    Whole hot objects live here as owned bytes and are served — body,
+    ETag, 304s, HEADs, ranges — with ZERO wire fops.  Coherence is the
+    lease contract, not a TTL: an entry is only filled after
+    ``lease_acquire`` succeeds on the filling pool client, and that
+    client's held-lease registry gets :meth:`drop_gfid` as an
+    ``on_drop`` callback — a recall (any conflicting writer, through
+    any door) drops the entry *synchronously before the recall is
+    acked*, so presence implies validity.  Local same-client writes
+    never trigger a recall, so the gateway's own PUT/DELETE paths call
+    :meth:`drop_path` directly."""
+
+    CACHE_KIND = "gateway"  # the gftpu_cache_* {cache=...} label
+
+    def __init__(self, limit: int):
+        import collections
+
+        self.limit = int(limit)
+        self._m: "collections.OrderedDict[str, _CacheEntry]" = \
+            collections.OrderedDict()
+        self._by_gfid: dict[bytes, set[str]] = {}
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.hit_bytes = 0
+        self.recall_drops = 0
+        cache_metrics.track(self)
+
+    def get(self, path: str) -> _CacheEntry | None:
+        ent = self._m.get(path)
+        if ent is not None:
+            self._m.move_to_end(path)
+        return ent
+
+    def put(self, path: str, ent: _CacheEntry) -> None:
+        if ent.size > self.limit:
+            return
+        self.drop_path(path)
+        self._m[path] = ent
+        self._by_gfid.setdefault(ent.gfid, set()).add(path)
+        self.bytes += ent.size
+        while self.bytes > self.limit and self._m:
+            old_path, old = self._m.popitem(last=False)
+            self._unindex(old_path, old)
+
+    def _unindex(self, path: str, ent: _CacheEntry) -> None:
+        self.bytes -= ent.size
+        paths = self._by_gfid.get(ent.gfid)
+        if paths is not None:
+            paths.discard(path)
+            if not paths:
+                del self._by_gfid[ent.gfid]
+
+    def drop_path(self, path: str) -> None:
+        ent = self._m.pop(path, None)
+        if ent is not None:
+            self._unindex(path, ent)
+
+    def drop_gfid(self, gfid: bytes) -> None:
+        """HeldLeases.on_drop hook — runs synchronously inside the
+        recall's notify, before the release ack goes back."""
+        for path in list(self._by_gfid.get(bytes(gfid), ())):
+            self.recall_drops += 1
+            self.drop_path(path)
+
+    def dump(self) -> dict:
+        return {"objects": len(self._m), "bytes": self.bytes,
+                "limit": self.limit, "hits": self.hits,
+                "misses": self.misses,
+                "recall_drops": self.recall_drops}
+
+
 class ObjectGateway:
     """The HTTP front door (one instance per served volume)."""
 
     def __init__(self, pool: ClientPool, host: str = "127.0.0.1",
                  port: int = 0, max_clients: int = 512,
-                 volume: str = ""):
+                 volume: str = "", object_cache_size: int = 0):
         self.pool = pool
         self.host = host
         self.port = port
@@ -277,6 +364,20 @@ class ObjectGateway:
         self.events = {"GATEWAY_START": 0, "GATEWAY_STOP": 0,
                        "GATEWAY_CLIENT_THROTTLED": 0}
         self._tmp_seq = itertools.count()
+        # lease-held whole-object cache (0 = off); with workers=N each
+        # worker process builds its own, kept coherent by its own pool
+        # clients' upcall sinks
+        self._ocache = _ObjectCache(object_cache_size) \
+            if int(object_cache_size) > 0 else None
+        # gfid-keyed ETag memo validated by (mtime, size) — conditional
+        # GETs/HEADs skip the per-request wire getxattr.  Every gateway
+        # PUT commits to a FRESH gfid (O_EXCL create or temp+rename),
+        # so a stale memo entry can never match the new object's stat
+        import collections
+
+        self._etags: "collections.OrderedDict[bytes, tuple]" = \
+            collections.OrderedDict()
+        self.etag_fast_hits = 0
         _GATEWAYS.add(self)
 
     # -- lifecycle ---------------------------------------------------------
@@ -294,6 +395,13 @@ class ObjectGateway:
         them to :meth:`_serve_conn` directly."""
         if not self.pool.clients:
             await self.pool.start()
+        if self._ocache is not None:
+            # recall-exact coherence: any pool client losing a lease
+            # (recall, expiry, disconnect) drops the object's cache
+            # entries synchronously, before the recall is acked
+            for c in self.pool.clients:
+                if self._ocache.drop_gfid not in c.leases.on_drop:
+                    c.leases.on_drop.append(self._ocache.drop_gfid)
         # pool-aware event plane: pre-size the shared reply-turning
         # workers to the pooled graphs' client.event-threads so the
         # first heavy GET doesn't pay the pool spin-up
@@ -492,6 +600,10 @@ class ObjectGateway:
                     head=method == "HEAD")
             if method == "DELETE":
                 await c.unlink(f"/{bucket}/{key}")
+                if self._ocache is not None:
+                    # same-client deletes don't recall our own lease —
+                    # drop the entry ourselves, synchronously
+                    self._ocache.drop_path(f"/{bucket}/{key}")
                 return await self._respond(writer, 204)
             raise _HttpError(405)
         except _HttpError as e:
@@ -708,6 +820,11 @@ class ObjectGateway:
             etag = await self._write_small(c, bucket, key, bytes(buf))
         else:
             etag = await self._write_stream(c, bucket, key, chunks)
+        if self._ocache is not None:
+            # a PUT through our own pool client doesn't recall our own
+            # lease (same client identity) — drop synchronously so the
+            # next GET refills from the new object
+            self._ocache.drop_path(f"/{bucket}/{key}")
         return await self._respond(writer, 200,
                                    {"etag": f'"{etag}"'}, b"")
 
@@ -853,12 +970,31 @@ class ObjectGateway:
         end = min(end, size - 1)
         return start, end - start + 1
 
-    async def _etag_of(self, c: Client, path: str) -> str:
+    _ETAG_MEMO_MAX = 4096
+
+    async def _etag_of(self, c: Client, path: str, ia=None) -> str:
+        # the conditional-GET fast path: a memo entry whose (mtime,
+        # size) still matches the stat we already paid skips the wire
+        # getxattr every 304/HEAD used to cost
+        gfid = bytes(ia.gfid) if ia is not None and \
+            getattr(ia, "gfid", None) else None
+        if gfid is not None:
+            memo = self._etags.get(gfid)
+            if memo is not None and memo[0] == ia.mtime and \
+                    memo[1] == ia.size:
+                self._etags.move_to_end(gfid)
+                self.etag_fast_hits += 1
+                return memo[2]
         try:
             out = await c.getxattr(path, ETAG_XATTR)
             val = out.get(ETAG_XATTR) if isinstance(out, dict) else out
             if val:
-                return bytes(val).decode("latin-1")
+                etag = bytes(val).decode("latin-1")
+                if gfid is not None:
+                    self._etags[gfid] = (ia.mtime, ia.size, etag)
+                    while len(self._etags) > self._ETAG_MEMO_MAX:
+                        self._etags.popitem(last=False)
+                return etag
         except FopError:
             pass  # written outside the gateway: no stored hash
         return ""
@@ -920,13 +1056,68 @@ class ObjectGateway:
                 pass
         return status
 
+    async def _serve_cached(self, ent: _CacheEntry, headers, writer,
+                            head: bool) -> int:
+        """Serve a GET/HEAD/304/range entirely from a lease-held cache
+        entry — ZERO wire fops.  Presence implies validity: a recall
+        drops the entry synchronously before it is acked, so nothing
+        stale can be sitting here."""
+        self._ocache.hits += 1
+        inm = headers.get("if-none-match", "").strip('"')
+        if ent.etag and inm and inm == ent.etag:
+            raise _HttpError(304, headers={"etag": f'"{ent.etag}"'})
+        base_headers: dict[str, Any] = {
+            "content-type": "application/octet-stream",
+            "accept-ranges": "bytes",
+            "last-modified": str(ent.mtime),
+            "etag": f'"{ent.etag}"'}
+        if head:
+            base_headers["content-length"] = ent.size
+            return await self._respond(writer, 200, base_headers,
+                                       head=True)
+        rng = self._parse_range(headers.get("range", ""), ent.size)
+        if rng is not None:
+            offset, want = rng
+            base_headers["content-range"] = \
+                f"bytes {offset}-{offset + want - 1}/{ent.size}"
+            self._ocache.hit_bytes += want
+            return await self._respond(
+                writer, 206, base_headers,
+                SGBuf([memoryview(ent.content)[offset:offset + want]]))
+        self._ocache.hit_bytes += ent.size
+        return await self._respond(
+            writer, 200, base_headers,
+            SGBuf([ent.content]) if ent.size else b"")
+
+    async def _fill_cache(self, c: Client, path: str, ia, etag: str,
+                          data) -> None:
+        """Admit a just-served whole object — but only under a lease
+        (no lease, no zero-RT contract, no entry).  The one join this
+        pays is the price of owning the bytes past the request."""
+        if not getattr(ia, "gfid", None):
+            return
+        if not await c.lease_acquire(path):
+            return
+        if c.leases.get(bytes(ia.gfid)) is None:
+            return  # the path re-resolved to a different gfid
+        content = bytes(as_single_buffer(data))
+        self._ocache.put(path, _CacheEntry(
+            bytes(ia.gfid), etag, len(content),
+            getattr(ia, "mtime", 0), content))
+
     async def _get_object(self, c: Client, bucket: str, key: str,
                           headers, writer, head: bool = False) -> int:
         path = f"/{bucket}/{key}"
+        if self._ocache is not None:
+            ent = self._ocache.get(path)
+            if ent is not None:
+                return await self._serve_cached(ent, headers, writer,
+                                                head)
+            self._ocache.misses += 1
         ia = await c.stat(path)
         if ia.is_dir():
             raise _HttpError(404, "key is a directory")
-        etag = await self._etag_of(c, path)
+        etag = await self._etag_of(c, path, ia)
         inm = headers.get("if-none-match", "").strip('"')
         if etag and inm and inm == etag:
             raise _HttpError(304, headers={"etag": f'"{etag}"'})
@@ -968,6 +1159,8 @@ class ObjectGateway:
                 data if isinstance(data, (bytes, bytearray))
                 else bytes(data)).hexdigest()
             base_headers["etag"] = f'"{etag}"'
+        if self._ocache is not None:
+            await self._fill_cache(c, path, ia, etag, data)
         return await self._respond(writer, 200, base_headers, data)
 
     # -- introspection -----------------------------------------------------
@@ -984,4 +1177,7 @@ class ObjectGateway:
                 "throttled": self.throttled,
                 "body_writes": dict(self.body_writes),
                 "sg_segments": self.sg_segments,
+                "etag_fast_hits": self.etag_fast_hits,
+                "object_cache": self._ocache.dump()
+                if self._ocache is not None else None,
                 "events": dict(self.events)}

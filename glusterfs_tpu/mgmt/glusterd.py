@@ -2946,6 +2946,8 @@ class Glusterd:
                 "--pool", str(opts.get("gateway.pool-size", 4)),
                 "--max-clients", str(opts.get("gateway.max-clients",
                                               512)),
+                "--object-cache",
+                str(opts.get("gateway.object-cache-size", 0)),
                 "--portfile", portfile]
         workers = int(opts.get("gateway.workers", 0) or 0)
         if workers > 0:

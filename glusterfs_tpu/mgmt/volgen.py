@@ -533,6 +533,11 @@ OPTION_MAP = {
     "gateway.pool-size": ("mgmt/gateway", "pool-size"),
     "gateway.max-clients": ("mgmt/gateway", "max-clients"),
     "gateway.metrics-port": ("mgmt/gateway", "metrics-port"),
+    # lease-driven hot-object serving (ISSUE 16): the gateway's
+    # lease-held object cache budget (spawner arg, per worker) and the
+    # brick-side idle-lease expiry
+    "gateway.object-cache-size": ("mgmt/gateway", "object-cache-size"),
+    "features.lease-timeout": ("features/leases", "lease-timeout"),
 }
 
 # the option long tail above shipped at op-version 3: an older member
@@ -767,6 +772,17 @@ _V14_KEYS = (
     "cluster.mesh-distributed",
 )
 OPTION_MIN_OPVERSION.update({k: 14 for k in _V14_KEYS})
+
+# round-16 additions ship at op-version 15: the lease plane — a v14
+# brick neither advertises "leases" at SETVOLUME nor serves idle
+# expiry, and a v14 glusterd's gateway spawner has no --object-cache
+# arm (the key would store and silently serve uncached), so neither
+# key may reach an older peer
+_V15_KEYS = (
+    "features.lease-timeout",
+    "gateway.object-cache-size",
+)
+OPTION_MIN_OPVERSION.update({k: 15 for k in _V15_KEYS})
 
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key

@@ -1,0 +1,44 @@
+"""performance/cache_metrics — the one shared cache-counter family set.
+
+``MetricsRegistry.register`` is last-registration-wins by name, so the
+``gftpu_cache_*`` families MUST be registered exactly once, from one
+module, over one live population — a per-cache-module registration
+would silently clobber every sibling's samples.  Every cache that wants
+scraping (md-cache, quick-read, io-cache, the gateway object cache)
+calls :func:`track` and exposes::
+
+    CACHE_KIND  : str   — the {cache=...} label value
+    hits        : int
+    misses      : int
+    hit_bytes   : int   — payload bytes served from cache
+"""
+
+from __future__ import annotations
+
+from ..core.metrics import REGISTRY
+
+
+def _samples(attr: str):
+    def of(c) -> list:
+        return [({"cache": c.CACHE_KIND}, getattr(c, attr, 0))]
+    return of
+
+
+_LIVE_CACHES = REGISTRY.register_objects(
+    "gftpu_cache_hits_total", "counter",
+    "cache hits by cache plane (md = attr/xattr, quick-read = whole "
+    "small files, io-cache = read pages, gateway = whole objects)",
+    _samples("hits"))
+REGISTRY.register_objects(
+    "gftpu_cache_misses_total", "counter",
+    "cache misses by cache plane", _samples("misses"),
+    live=_LIVE_CACHES)
+REGISTRY.register_objects(
+    "gftpu_cache_bytes_total", "counter",
+    "payload bytes served from cache by cache plane",
+    _samples("hit_bytes"), live=_LIVE_CACHES)
+
+
+def track(cache) -> None:
+    """Join the scrape population (weak — a dead cache drops out)."""
+    _LIVE_CACHES.add(cache)

@@ -12,6 +12,7 @@ import collections
 from ..core.layer import FdObj, Layer, Loc, register
 from ..core.options import Option
 from ..rpc.wire import as_single_buffer, serve_pages
+from . import cache_metrics
 
 
 @register("performance/io-cache")
@@ -37,6 +38,8 @@ class IoCacheLayer(Layer):
                            "'*.db:3,*.tmp:0'"),
     )
 
+    CACHE_KIND = "io-cache"  # the gftpu_cache_* {cache=...} label
+
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         # (gfid, page_index) -> bytes; OrderedDict as LRU
@@ -52,7 +55,16 @@ class IoCacheLayer(Layer):
         self._prio: dict[bytes, int] = {}  # gfid -> cache-priority level
         self.hits = 0
         self.misses = 0
+        self.hit_bytes = 0
         self.validations = 0
+        # held-lease registry (api/glfs HeldLeases): a leased gfid's
+        # pages skip the fstat revalidation entirely — the brick's
+        # recall contract replaces the mtime probe
+        self._lease_reg = None
+        cache_metrics.track(self)
+
+    def set_lease_registry(self, reg) -> None:
+        self._lease_reg = reg
 
     def _priority_of(self, path: str) -> int:
         """performance.cache-priority (ioc_get_priority): first
@@ -113,6 +125,11 @@ class IoCacheLayer(Layer):
         clients)."""
         import time
 
+        if self._lease_reg is not None and self._lease_reg.held(fd.gfid):
+            # zero-RT mode: cached pages can't be stale while the lease
+            # holds — a conflicting writer is recalled (→ upcall →
+            # _invalidate) before its write proceeds
+            return
         ent = self._seen.get(fd.gfid)
         now = time.monotonic()
         if ent is not None and now - ent[1] < self.opts["cache-timeout"]:
@@ -156,6 +173,7 @@ class IoCacheLayer(Layer):
                 missing.append(i)
             else:
                 self.hits += 1
+                self.hit_bytes += len(page)
                 self._pages.move_to_end((fd.gfid, i))
                 pages[i] = page
                 if len(page) < psz:

@@ -12,6 +12,7 @@ import time
 from ..core.fops import WRITE_FOPS, Fop
 from ..core.layer import Event, FdObj, Layer, Loc, register
 from ..core.options import Option
+from . import cache_metrics
 
 
 @register("performance/md-cache")
@@ -53,6 +54,8 @@ class MdCacheLayer(Layer):
         ("cache-ima-xattrs", ("security.ima",)),
     )
 
+    CACHE_KIND = "md"  # the gftpu_cache_* {cache=...} label
+
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         self._iatt: dict[bytes, tuple[float, object]] = {}
@@ -60,7 +63,17 @@ class MdCacheLayer(Layer):
         self._statfs: tuple[float, object] | None = None
         self.hits = 0
         self.misses = 0
+        self.hit_bytes = 0  # xattr payload served from cache
         self.invalidations = 0  # upcall-driven (not TTL, not local fop)
+        # held-lease registry (api/glfs HeldLeases): while the mount
+        # holds a lease on a gfid its entries never TTL out — recall
+        # (which drops both the lease and, via upcall, the entry) is
+        # the only invalidator.  None = unleased stack, pure TTL.
+        self._lease_reg = None
+        cache_metrics.track(self)
+
+    def set_lease_registry(self, reg) -> None:
+        self._lease_reg = reg
 
     def _xattr_cacheable(self, name: str) -> bool:
         """Internal (trusted.*/glusterfs.*) names always cache; user/
@@ -90,14 +103,21 @@ class MdCacheLayer(Layer):
             self.invalidate(data["gfid"])
         super().notify(event, source, data)
 
-    def _fresh(self, entry) -> bool:
-        return entry is not None and \
-            time.monotonic() - entry[0] < self.opts["timeout"]
+    def _fresh(self, entry, gfid=None) -> bool:
+        if entry is None:
+            return False
+        # lease-held gfids never go stale by clock: the brick MUST
+        # recall (→ upcall invalidation) before any conflicting write
+        # proceeds, so presence implies validity — zero-RT mode
+        if gfid is not None and self._lease_reg is not None and \
+                self._lease_reg.held(gfid):
+            return True
+        return time.monotonic() - entry[0] < self.opts["timeout"]
 
     async def lookup(self, loc: Loc, xdata: dict | None = None):
         if loc.gfid:
             entry = self._iatt.get(loc.gfid)
-            if self._fresh(entry):
+            if self._fresh(entry, loc.gfid):
                 self.hits += 1
                 return entry[1], {}
         self.misses += 1
@@ -108,7 +128,7 @@ class MdCacheLayer(Layer):
     async def stat(self, loc: Loc, xdata: dict | None = None):
         if loc.gfid:
             entry = self._iatt.get(loc.gfid)
-            if self._fresh(entry):
+            if self._fresh(entry, loc.gfid):
                 self.hits += 1
                 return entry[1]
         self.misses += 1
@@ -118,7 +138,7 @@ class MdCacheLayer(Layer):
 
     async def fstat(self, fd: FdObj, xdata: dict | None = None):
         entry = self._iatt.get(fd.gfid)
-        if self._fresh(entry):
+        if self._fresh(entry, fd.gfid):
             self.hits += 1
             return entry[1]
         self.misses += 1
@@ -131,8 +151,11 @@ class MdCacheLayer(Layer):
         if self.opts["cache-xattrs"] and loc.gfid and name is not None \
                 and self._xattr_cacheable(name):
             entry = self._xattr.get(loc.gfid)
-            if self._fresh(entry) and name in entry[1]:
+            if self._fresh(entry, loc.gfid) and name in entry[1]:
                 self.hits += 1
+                val = entry[1][name]
+                self.hit_bytes += len(val) if \
+                    isinstance(val, (bytes, str)) else 0
                 return {name: entry[1][name]}
         out = await self.children[0].getxattr(loc, name, xdata)
         if self.opts["cache-xattrs"] and loc.gfid:
@@ -156,6 +179,8 @@ class MdCacheLayer(Layer):
     def dump_private(self) -> dict:
         return {"iatts": len(self._iatt), "hits": self.hits,
                 "misses": self.misses,
+                "leased": 0 if self._lease_reg is None
+                else len(self._lease_reg),
                 "upcall_invalidations": self.invalidations}
 
 

@@ -12,6 +12,7 @@ import time
 
 from ..core.layer import FdObj, Layer, Loc, register
 from ..core.options import Option
+from . import cache_metrics
 
 
 @register("performance/quick-read")
@@ -34,17 +35,31 @@ class QuickReadLayer(Layer):
             self._invalidate(data["gfid"])
         super().notify(event, source, data)
 
+    CACHE_KIND = "quick-read"  # the gftpu_cache_* {cache=...} label
+
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         self._files: collections.OrderedDict[bytes, tuple[float, bytes]] = \
             collections.OrderedDict()
         self._bytes = 0
         self.hits = 0
+        self.misses = 0
+        self.hit_bytes = 0
+        # held-lease registry (api/glfs HeldLeases): leased content
+        # never times out — a recall drops it via the upcall path
+        self._lease_reg = None
         # gfids known to exceed max-file-size (TTL'd): a large file
         # must not pay a size probe on EVERY read just to learn, again,
         # that it doesn't qualify (the reference learns size from the
         # lookup it piggybacks content on)
         self._too_big: dict[bytes, float] = {}
+        cache_metrics.track(self)
+
+    def set_lease_registry(self, reg) -> None:
+        self._lease_reg = reg
+
+    def _leased(self, gfid) -> bool:
+        return self._lease_reg is not None and self._lease_reg.held(gfid)
 
     def _invalidate(self, gfid: bytes) -> None:
         ent = self._files.pop(gfid, None)
@@ -57,10 +72,14 @@ class QuickReadLayer(Layer):
         maxsz = self.opts["max-file-size"]
         ent = self._files.get(fd.gfid)
         if ent is not None and \
-                time.monotonic() - ent[0] < self.opts["cache-timeout"]:
+                (self._leased(fd.gfid) or
+                 time.monotonic() - ent[0] < self.opts["cache-timeout"]):
             self.hits += 1
             self._files.move_to_end(fd.gfid)
-            return ent[1][offset: offset + size]
+            out = ent[1][offset: offset + size]
+            self.hit_bytes += len(out)
+            return out
+        self.misses += 1
         big = self._too_big.get(fd.gfid)
         if big is not None and \
                 time.monotonic() - big < self.opts["cache-timeout"]:
@@ -125,4 +144,4 @@ class QuickReadLayer(Layer):
 
     def dump_private(self) -> dict:
         return {"files": len(self._files), "bytes": self._bytes,
-                "hits": self.hits}
+                "hits": self.hits, "misses": self.misses}

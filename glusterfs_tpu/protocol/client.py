@@ -256,6 +256,9 @@ class ClientLayer(Layer):
         self._peer_deadline = False
         # did the brick advertise the xorv fop (parity-delta writes)?
         self._peer_xorv = False
+        # did the brick advertise lease grants (op-version 15)?  The
+        # api layer checks this before letting caches go zero-RT
+        self._peer_leases = False
         _LIVE_CLIENT_LAYERS.add(self)
         # reopen bookkeeping (client-handshake.c reopen_fd_count):
         # live fds with server-side handles (value = (fd, reopen fop)),
@@ -374,6 +377,11 @@ class ClientLayer(Layer):
         # 12).  A missing key fails the fop EOPNOTSUPP locally — zero
         # round trips wasted per write against a live-downgraded brick
         self._peer_xorv = bool(res.get("xorv"))
+        # lease plane: only bricks that grant + recall leases (op-
+        # version 15).  A client stack over an older brick never enters
+        # zero-RT cache mode — TTL revalidation stays the coherence
+        # story there
+        self._peer_leases = bool(res.get("leases"))
         # re-open tracked fds and re-acquire held locks BEFORE CHILD_UP
         # (client_child_up_reopen_done): parents must never see an "up"
         # child whose fd handles are stale

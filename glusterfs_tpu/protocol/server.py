@@ -969,6 +969,7 @@ class BrickServer:
         if kind == "callpool":
             pools = []
             locks = []
+            leases = []
             for layer in walk(top):
                 q = getattr(layer, "queued", None)
                 ex = getattr(layer, "executed", None)
@@ -982,8 +983,14 @@ class BrickServer:
                 ls = getattr(layer, "lock_status", None)
                 if ls is not None:
                     locks.append({"layer": layer.name, **ls()})
+                # the lease wedge view (ISSUE 16): held/recalling
+                # counts + oldest-holder age beside the locks table
+                les = getattr(layer, "lease_status", None)
+                if les is not None:
+                    leases.append({"layer": layer.name, **les()})
             return {"io_threads": pools,
                     "locks": locks,
+                    "leases": leases,
                     "outstanding": [
                         {"client": c.identity.hex(),
                          "inflight": c.inflight,
@@ -1114,7 +1121,13 @@ class BrickServer:
                                        # serves the xorv fop — a peer
                                        # that never sees this key
                                        # keeps the full-RMW path
-                                       "xorv": True}
+                                       "xorv": True,
+                                       # lease plane (op-version 15):
+                                       # this brick grants and recalls
+                                       # leases — a client that never
+                                       # sees this key must not enter
+                                       # zero-RT cache mode
+                                       "leases": True}
             if not conn.authed:
                 # SETVOLUME gates everything — pings included (no
                 # pre-auth liveness probing; server.c refuses requests

@@ -1,0 +1,525 @@
+"""Lease-driven hot-object serving (ISSUE 16): brick-side grants,
+recall-before-conflict, revocation poisoning, idle expiry, disconnect
+reap; the client's zero-round-trip cache mode PINNED at 0 wire fops;
+the recall storm; the gateway's lease-held object cache; and the
+read-lease grant that settles an open eager write window (the PR-6
+cross-door read-after-PUT window, now closed, not documented)."""
+
+import asyncio
+import errno
+import time
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client, wait_connected
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc, walk
+from glusterfs_tpu.daemon import serve_brick
+from glusterfs_tpu.gateway import ClientPool, ObjectGateway
+from glusterfs_tpu.gateway.minihttp import fetch as http
+from glusterfs_tpu.protocol.client import ClientLayer
+from glusterfs_tpu.rpc.wire import CURRENT_CLIENT
+
+# the volgen brick order: leases sits ABOVE locks (its grant path asks
+# the sibling locks layer about open windows) and BELOW upcall
+LEASE_BRICK = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+volume leases
+    type features/leases
+    option recall-timeout {recall}
+    subvolumes locks
+end-volume
+volume upcall
+    type features/upcall
+    subvolumes leases
+end-volume
+"""
+
+PLAIN_CLIENT = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume upcall
+end-volume
+"""
+
+# the full zero-RT read stack: quick-read (content) under open-behind
+# (wire-free opens) under md-cache (stat/xattr); every TTL is ZERO so
+# only the lease can make a hit legal
+PERF_CLIENT = PLAIN_CLIENT + """
+volume qr
+    type performance/quick-read
+    option cache-timeout 0
+    subvolumes c0
+end-volume
+volume ob
+    type performance/open-behind
+    subvolumes qr
+end-volume
+volume mdc
+    type performance/md-cache
+    option timeout 0
+    subvolumes ob
+end-volume
+"""
+
+
+def _wire(graph: Graph) -> int:
+    """Wire round trips so far, summed over every protocol client in
+    the graph (pings excluded by the counter itself)."""
+    return sum(l.rpc_roundtrips for l in walk(graph.top)
+               if isinstance(l, ClientLayer))
+
+
+async def _mounted(volfile: str) -> Client:
+    c = Client(Graph.construct(volfile))
+    await c.mount()
+    assert await wait_connected(c.graph)
+    return c
+
+
+# -- brick-side unit tests (in-process graph, no wire) -----------------
+
+
+def test_grant_conflict_recall_revoke(tmp_path):
+    """The state machine: RD leases share, RW conflicts EAGAIN, a
+    conflicting write recalls holders, an unreturned lease is revoked
+    after the grace and its (client, lease-id) poisoned ESTALE, while a
+    voluntary return ends the writer's wait early."""
+    g = Graph.construct(LEASE_BRICK.format(dir=tmp_path / "b",
+                                           recall="0.3")
+                        .replace("""volume upcall
+    type features/upcall
+    subvolumes leases
+end-volume
+""", ""), top_name="leases")
+    lls = g.by_name["leases"]
+    recalls = []
+    lls.set_upcall_sink(lambda t, p: recalls.append((list(t), p)))
+
+    async def run():
+        await g.activate()
+        A, B, W = b"cli-A", b"cli-B", b"cli-W"
+        CURRENT_CLIENT.set(W)
+        fd, ia = await g.top.create(Loc("/f"), 0, 0o644)
+        await g.top.writev(fd, b"v1", 0)
+        gfid = bytes(ia.gfid)
+        loc = Loc("/f", gfid=gfid)
+
+        CURRENT_CLIENT.set(A)
+        assert (await g.top.lease(loc, "grant", "rd", "idA")
+                )["granted"] == "rd"
+        CURRENT_CLIENT.set(B)
+        # RD shares with RD; RW conflicts with A's RD
+        await g.top.lease(loc, "grant", "rd", "idB")
+        with pytest.raises(FopError) as e:
+            await g.top.lease(loc, "grant", "rw", "idB")
+        assert e.value.err == errno.EAGAIN
+        assert lls.lease_status()["held"] == 2
+
+        # W writes: both holders recalled; nobody returns -> revoked
+        # after the 0.3s grace, and the write then proceeds
+        CURRENT_CLIENT.set(W)
+        t0 = time.monotonic()
+        await g.top.writev(fd, b"v2", 0)
+        assert time.monotonic() - t0 >= 0.3
+        assert sorted(t for ts, _ in recalls for t in ts) == [A, B]
+        assert all(p["event"] == "lease-recall" and p["gfid"] == gfid
+                   and p["reason"] == "conflict" for _, p in recalls)
+        assert lls.recalls["conflict"] == 2
+        assert lls.recalls["revoked"] == 2
+        assert lls.lease_status()["held"] == 0
+
+        # the poisoned id can never ride back in; a fresh id can
+        CURRENT_CLIENT.set(A)
+        with pytest.raises(FopError) as e:
+            await g.top.lease(loc, "grant", "rd", "idA")
+        assert e.value.err == errno.ESTALE
+        await g.top.lease(loc, "grant", "rd", "idA2")
+
+        # a holder that DOES return ends the writer's wait early
+        n0 = len(recalls)
+
+        async def return_on_recall():
+            while len(recalls) == n0:
+                await asyncio.sleep(0.01)
+            CURRENT_CLIENT.set(A)
+            await g.top.lease(loc, "release", "rd", "idA2")
+        ack = asyncio.ensure_future(return_on_recall())
+        CURRENT_CLIENT.set(W)
+        t0 = time.monotonic()
+        await g.top.truncate(loc, 0)
+        assert time.monotonic() - t0 < 0.25  # not the full grace
+        await ack
+        assert lls.recalls["revoked"] == 2  # no new revocation
+        # wedge view shape (the callpool share)
+        st = lls.lease_status()
+        assert set(st) >= {"held", "recalling", "by_type", "inodes",
+                           "oldest_holder_age", "recalls"}
+        assert lls.dump_private()["table"] == []
+        CURRENT_CLIENT.set(None)
+        await g.fini()
+
+    asyncio.run(run())
+
+
+def test_idle_expiry_and_read_renewal(tmp_path):
+    """A lease idle past lease-timeout expires (holder told, reason
+    "expired"); the holder's own reads renew it."""
+    g = Graph.construct(LEASE_BRICK.format(dir=tmp_path / "b",
+                                           recall="0.2")
+                        .replace("    option recall-timeout 0.2\n",
+                                 "    option recall-timeout 0.2\n"
+                                 "    option lease-timeout 0.4\n"),
+                        top_name="upcall")
+    lls = g.by_name["leases"]
+    pushed = []
+    for layer in g.by_name.values():
+        if hasattr(layer, "set_upcall_sink"):
+            layer.set_upcall_sink(lambda t, p: pushed.append(p))
+
+    async def run():
+        await g.activate()
+        A = b"cli-A"
+        CURRENT_CLIENT.set(A)
+        fd, ia = await g.top.create(Loc("/f"), 0, 0o644)
+        await g.top.writev(fd, b"data", 0)
+        loc = Loc("/f", gfid=bytes(ia.gfid))
+        await g.top.lease(loc, "grant", "rd", "idA")
+
+        # active holder: reads renew granted_at, the sweep keeps it
+        for _ in range(3):
+            await asyncio.sleep(0.2)
+            await g.top.readv(fd, 4, 0)
+            lls._expire()  # the amortized sweep, invoked directly
+        assert lls.lease_status()["held"] == 1
+
+        # idle holder: expires, and the holder is told
+        await asyncio.sleep(0.5)
+        lls._expire()
+        assert lls.lease_status()["held"] == 0
+        assert lls.recalls["expired"] == 1
+        exp = [p for p in pushed if p.get("reason") == "expired"]
+        assert exp and exp[0]["lease-id"] == "idA"
+        # expiry does not poison: a repeat grant succeeds
+        await g.top.lease(loc, "grant", "rd", "idA")
+        CURRENT_CLIENT.set(None)
+        await g.fini()
+
+    asyncio.run(run())
+
+
+# -- the zero-round-trip pin (over the wire) ---------------------------
+
+
+def test_leased_reads_are_zero_wire(tmp_path):
+    """THE acceptance pin: with every cache TTL at zero, a leased
+    client serves repeated read_file + stat with EXACTLY ZERO wire
+    fops; releasing the lease puts revalidation back on the wire."""
+    async def run():
+        server = await serve_brick(
+            LEASE_BRICK.format(dir=tmp_path / "b", recall="2"))
+        c = await _mounted(PERF_CLIENT.format(port=server.port))
+        payload = bytes(range(256)) * 16  # 4 KiB, quick-read sized
+        try:
+            await c.write_file("/hot", payload)
+            assert await c.lease_acquire("/hot") is True
+            # prime every cache once (these may hit the wire)
+            assert await c.read_file("/hot") == payload
+            assert (await c.stat("/hot")).size == len(payload)
+
+            n0 = _wire(c.graph)
+            for _ in range(5):
+                assert await c.read_file("/hot") == payload
+                assert (await c.stat("/hot")).size == len(payload)
+            assert _wire(c.graph) - n0 == 0, \
+                "leased hot reads must be zero wire fops"
+            # the brick agrees someone is leased (the wedge view)
+            st = await c.graph.by_name["c0"]._call(
+                "__status__", ("callpool",), {})
+            assert any(l["held"] >= 1 for l in st["leases"])
+
+            # lease returned -> zero-TTL caches revalidate on the wire
+            await c.lease_release("/hot")
+            n1 = _wire(c.graph)
+            assert await c.read_file("/hot") == payload
+            assert _wire(c.graph) - n1 > 0, \
+                "unleased zero-TTL reads must revalidate"
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_recall_storm(tmp_path):
+    """12 leased readers vs one writer: every holder is recalled, every
+    holder returns voluntarily (drop-before-ack), the write completes
+    well inside the grace, nothing is revoked, and post-recall reads
+    are byte-exact."""
+    N = 12
+
+    async def run():
+        server = await serve_brick(
+            LEASE_BRICK.format(dir=tmp_path / "b", recall="10"))
+        lls = server.graph.by_name["leases"]
+        w = await _mounted(PLAIN_CLIENT.format(port=server.port))
+        readers = []
+        try:
+            await w.write_file("/obj", b"v1" * 512)
+            readers = [await _mounted(
+                PERF_CLIENT.format(port=server.port)) for _ in range(N)]
+            for r in readers:
+                assert await r.lease_acquire("/obj") is True
+                assert await r.read_file("/obj") == b"v1" * 512
+            assert lls.lease_status()["held"] == N
+
+            v2 = b"longer-after-the-storm" * 64
+            t0 = time.monotonic()
+            await w.write_file("/obj", v2)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 8, f"recall fan-in took {elapsed:.1f}s"
+            assert lls.recalls["conflict"] == N
+            assert lls.recalls["revoked"] == 0, \
+                "holders must return voluntarily, not be revoked"
+            for r in readers:
+                assert r.lease_recalls == 1
+                assert len(r.leases) == 0
+                assert await r.read_file("/obj") == v2
+            # zero-RT mode re-arms after a recall: a fresh grant works
+            assert await readers[0].lease_acquire("/obj") is True
+        finally:
+            for r in readers:
+                await r.unmount()
+            await w.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_disconnect_reaps_leases(tmp_path):
+    """A holder that vanishes (unmount = socket gone) is reaped through
+    release_client: the brick table empties without any recall grace,
+    and the drop is accounted as reason=disconnect."""
+    async def run():
+        server = await serve_brick(
+            LEASE_BRICK.format(dir=tmp_path / "b", recall="10"))
+        lls = server.graph.by_name["leases"]
+        c = await _mounted(PLAIN_CLIENT.format(port=server.port))
+        await c.write_file("/f", b"x")
+        assert await c.lease_acquire("/f") is True
+        assert lls.lease_status()["held"] == 1
+        await c.unmount()
+        for _ in range(100):
+            if lls.lease_status()["held"] == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert lls.lease_status()["held"] == 0
+        assert lls.recalls["disconnect"] == 1
+        await server.stop()
+
+    asyncio.run(run())
+
+
+# -- the gateway object cache ------------------------------------------
+
+
+def test_gateway_object_cache_zero_wire(tmp_path):
+    """Hot GETs, conditional GETs and HEADs served from the gateway's
+    lease-held object cache with EXACTLY ZERO wire fops; a cross-client
+    overwrite recalls the lease and the entry is gone before the next
+    GET, which serves the new bytes."""
+    async def run():
+        server = await serve_brick(
+            LEASE_BRICK.format(dir=tmp_path / "b", recall="5"))
+        vf = PLAIN_CLIENT.format(port=server.port)
+
+        async def factory():
+            return await _mounted(vf)
+
+        gw = ObjectGateway(ClientPool(factory, 2), max_clients=64,
+                           volume="gwlease",
+                           object_cache_size=4 << 20)
+        await gw.start()
+        H, P = gw.host, gw.port
+        fuse = await _mounted(vf)
+        payload = bytes(range(256)) * 64  # 16 KiB
+        try:
+            assert (await http(H, P, "PUT", "/bkt"))[0] == 200
+            st, hd, _ = await http(H, P, "PUT", "/bkt/hot", body=payload)
+            assert st == 200
+            etag = hd["etag"]
+            # first GET fills the cache (lease taken en route)
+            st, _, data = await http(H, P, "GET", "/bkt/hot")
+            assert st == 200 and data == payload
+            assert gw._ocache.dump()["objects"] == 1
+
+            n0 = sum(_wire(c.graph) for c in gw.pool.clients)
+            for _ in range(3):
+                st, hd, data = await http(H, P, "GET", "/bkt/hot")
+                assert st == 200 and data == payload
+                assert hd["etag"] == etag
+            st, _, data = await http(H, P, "GET", "/bkt/hot",
+                                     headers={"if-none-match": etag})
+            assert st == 304 and data == b""
+            st, hd, data = await http(H, P, "HEAD", "/bkt/hot")
+            assert st == 200 and data == b""
+            assert int(hd["content-length"]) == len(payload)
+            # ranged GET out of the cached entry, segments unjoined
+            st, _, data = await http(H, P, "GET", "/bkt/hot",
+                                     headers={"range": "bytes=100-199"})
+            assert st == 206 and data == payload[100:200]
+            assert sum(_wire(c.graph) for c in gw.pool.clients) == n0, \
+                "hot object traffic must be zero wire fops"
+            assert gw._ocache.hits >= 6
+
+            # cross-door overwrite: the fuse-side write recalls the
+            # pool client's lease; the entry drops BEFORE the ack, so
+            # the very next GET refetches — recall-exact, no TTL
+            v2 = b"rewritten-through-the-other-door" * 512
+            await fuse.write_file("/bkt/hot", v2)
+            for _ in range(100):
+                if gw._ocache.dump()["objects"] == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert gw._ocache.dump()["objects"] == 0
+            assert gw._ocache.recall_drops >= 1
+            st, hd, data = await http(H, P, "GET", "/bkt/hot")
+            assert st == 200 and data == v2
+
+            # same-door overwrite invalidates too (no self-recall, the
+            # PUT path drops its own entry)
+            st, _, _ = await http(H, P, "PUT", "/bkt/hot", body=b"v3")
+            assert st == 200
+            st, _, data = await http(H, P, "GET", "/bkt/hot")
+            assert st == 200 and data == b"v3"
+        finally:
+            await fuse.unmount()
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_gateway_etag_fast_path(tmp_path):
+    """Conditional GET / HEAD revalidation without the per-request wire
+    getxattr: the (mtime, size)-validated ETag memo answers, and a PUT
+    (fresh gfid) can never match a stale memo entry."""
+    async def run():
+        server = await serve_brick(
+            LEASE_BRICK.format(dir=tmp_path / "b", recall="5"))
+        vf = PLAIN_CLIENT.format(port=server.port)
+
+        async def factory():
+            return await _mounted(vf)
+
+        # object cache OFF: the memo must stand on its own
+        gw = ObjectGateway(ClientPool(factory, 1), max_clients=64,
+                           volume="gwetag")
+        await gw.start()
+        H, P = gw.host, gw.port
+        try:
+            await http(H, P, "PUT", "/b")
+            st, hd, _ = await http(H, P, "PUT", "/b/o", body=b"one")
+            etag = hd["etag"]
+            # prime the memo (first revalidation may getxattr)
+            st, _, _ = await http(H, P, "GET", "/b/o",
+                                  headers={"if-none-match": etag})
+            assert st == 304
+            f0 = gw.etag_fast_hits
+            st, _, _ = await http(H, P, "GET", "/b/o",
+                                  headers={"if-none-match": etag})
+            assert st == 304
+            st, hd, _ = await http(H, P, "HEAD", "/b/o")
+            assert st == 200 and hd["etag"] == etag
+            assert gw.etag_fast_hits >= f0 + 2
+
+            # overwrite: new gfid, new stat identity — the stale memo
+            # entry cannot answer; the conditional GET sees the change
+            st, hd2, _ = await http(H, P, "PUT", "/b/o", body=b"two!")
+            assert st == 200 and hd2["etag"] != etag
+            st, _, data = await http(H, P, "GET", "/b/o",
+                                     headers={"if-none-match": etag})
+            assert st == 200 and data == b"two!"
+        finally:
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- the grant settles an open eager window (PR-6 window CLOSED) -------
+
+
+@pytest.mark.slow
+def test_read_lease_grant_settles_eager_window(tmp_path):
+    """Cross-door read-after-PUT: a writer's EC eager window (timeout
+    30s — a racing timer cannot be the explanation) holds the size
+    commit back; another client graph's READ-LEASE GRANT pushes
+    inodelk-contention at the writer, the window drains its delayed
+    post-op NOW, and the reader's very next read is byte-exact."""
+    K, R = 2, 1
+    data = bytes(range(256)) * 8  # 2 KiB = 2 stripes at K=2
+
+    def ec_client(ports, eager):
+        chunks = []
+        for i, p in enumerate(ports):
+            chunks.append(PLAIN_CLIENT.format(port=p)
+                          .replace("volume c0", f"volume c{i}")
+                          .rstrip("\n"))
+        subs = " ".join(f"c{i}" for i in range(len(ports)))
+        chunks.append(f"""
+volume disp
+    type cluster/disperse
+    option redundancy {R}
+    option eager-lock-timeout {eager}
+    subvolumes {subs}
+end-volume
+""")
+        return "\n".join(chunks)
+
+    async def run():
+        servers = [await serve_brick(LEASE_BRICK.format(
+            dir=tmp_path / f"b{i}", recall="10")) for i in range(K + R)]
+        ports = [s.port for s in servers]
+        wc = await _mounted(ec_client(ports, 30))
+        rc = await _mounted(ec_client(ports, 0.2))
+        try:
+            f = await wc.create("/win")
+            await f.write(data, 0)
+            ec = wc.graph.by_name["disp"]
+            gfid = bytes(f.fd.gfid)
+            assert gfid in ec._eager, "writer window should be open"
+
+            t0 = time.monotonic()
+            assert await rc.lease_acquire("/win") is True
+            elapsed = time.monotonic() - t0
+            # the grant returned because the PUSH drained the window —
+            # not the 30s window timer, not the 10s recall grace
+            assert elapsed < 5, f"grant stalled {elapsed:.1f}s"
+            for _ in range(100):
+                if gfid not in ec._eager:
+                    break
+                await asyncio.sleep(0.05)
+            assert gfid not in ec._eager, \
+                "grant nudge never drained the writer's window"
+            assert await rc.read_file("/win") == data
+            assert (await rc.stat("/win")).size == len(data)
+            await f.close()
+        finally:
+            await wc.unmount()
+            await rc.unmount()
+            for s in servers:
+                await s.stop()
+
+    asyncio.run(run())

@@ -34,6 +34,14 @@ contract the EC/protocol planes promise:
                         error, never a hang) while a brick is down,
                         and a worker SIGKILL mid-load never drops
                         the volume (supervisor respawn, ISSUE 12).
+* ``lease_storm``     — leased readers vs a hot writer (ISSUE 16):
+                        every overwrite recalls every holder within a
+                        bound, every holder returns voluntarily (a
+                        revocation would poison the next grant), every
+                        post-recall read is byte-exact, and a holder
+                        that dies WITHOUT releasing is reaped at
+                        disconnect instead of stalling the writer for
+                        the recall grace.
 * ``rebalance_grow``  — grow the loaded 4+2 volume by a second
                         distribute leg WHILE serving: managed daemon
                         migration under live reads/writes, SIGKILL +
@@ -459,10 +467,11 @@ async def gateway(base: str, opts) -> dict:
                                  "/b/obj", body=body)
             assert s == 200, s
             # let the EC eager window's deferred size commit land
-            # before breaking things: cross-pool-client read-after-PUT
-            # coherence is bounded by the post-op delay (~eager-lock-
-            # timeout), and THIS scenario measures degraded
-            # responsiveness, not that (documented) window
+            # before breaking things: without a read lease settling it
+            # (features/leases, lease_storm below), cross-pool-client
+            # read-after-PUT coherence is bounded by the post-op delay
+            # (~eager-lock-timeout), and THIS scenario measures
+            # degraded responsiveness, not that window
             deadline = time.monotonic() + 10
             while True:
                 s, _, data = await http("127.0.0.1", gw_port, "GET",
@@ -537,6 +546,76 @@ async def gateway(base: str, opts) -> dict:
                     await asyncio.to_thread(sup.wait, timeout=10)
                 except subprocess.TimeoutExpired:
                     sup.kill()
+    return out
+
+
+@scenario("lease_storm")
+async def lease_storm(base: str, opts) -> dict:
+    """Leased readers vs a hot writer over the managed volume (ISSUE
+    16): recalls fan in bounded and voluntary, post-recall reads are
+    byte-exact, re-grants keep working round after round (revocation
+    would poison them), and a holder that dies without releasing is
+    reaped at disconnect instead of stalling the writer."""
+    out: dict = {}
+    n_readers, rounds = 6, 3
+    hot = 48 * 1024
+    async with Stack(base) as st:
+        # leases are volgen-gated off by default; flipping them on is a
+        # graph-shape change -> bricks respawn with the layer.  The
+        # long recall grace makes the reap assertion sharp: a holder
+        # that is NOT returned/reaped costs 10s, visibly over bound.
+        await st.set("features.leases", "on")
+        await st.set("features.lease-recall-timeout", "10")
+        await st.set("features.lease-timeout", "600")   # v15 key
+        w = await st.mount()
+        readers = [await st.mount() for _ in range(n_readers)]
+        victim = None
+        try:
+            body = payload_for(7)[:hot]
+            await w.write_file("/hot", body)
+            write_s = []
+            for rnd in range(rounds):
+                for r in readers:
+                    assert await r.lease_acquire("/hot"), \
+                        "re-grant refused: a voluntary return poisoned"
+                    assert bytes(await r.read_file("/hot")) == body
+                body = payload_for(100 + rnd)[:hot]
+                t0 = time.monotonic()
+                await w.write_file("/hot", body)
+                write_s.append(round(time.monotonic() - t0, 2))
+                assert write_s[-1] < 8, \
+                    f"recall fan-in stalled: {write_s}"
+                for r in readers:
+                    assert bytes(await r.read_file("/hot")) == body
+            assert all(r.lease_recalls >= rounds for r in readers), \
+                [r.lease_recalls for r in readers]
+            out["write_recall_s"] = write_s
+            out["recalls_per_reader"] = rounds
+
+            # a holder that never releases: unmount drops the sockets
+            # with the lease still granted; the brick's disconnect reap
+            # (release_client) must clear it — the next write completes
+            # inside the bound instead of burning the 10s grace
+            victim = readers.pop()
+            assert await victim.lease_acquire("/hot")
+            await victim.unmount()
+            victim = None
+            await asyncio.sleep(1.0)  # let the reap land
+            body = payload_for(999)[:hot]
+            t0 = time.monotonic()
+            await w.write_file("/hot", body)
+            reap_s = time.monotonic() - t0
+            assert reap_s < 8, \
+                f"dead holder stalled the writer {reap_s:.1f}s"
+            out["dead_holder_write_s"] = round(reap_s, 2)
+            for r in readers:
+                assert bytes(await r.read_file("/hot")) == body
+        finally:
+            if victim is not None:
+                await victim.unmount()
+            for r in readers:
+                await r.unmount()
+            await w.unmount()
     return out
 
 

@@ -794,6 +794,159 @@ if [ $procplane_rc -ne 0 ]; then
     exit $procplane_rc
 fi
 
+echo "== ci: lease smoke (hot GETs off the lease-held object cache at"
+echo "       zero wire fops, recall coherence, gftpu_cache_*/gftpu_leases"
+echo "       families, v15 volume-set keys) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, os, shutil, tempfile
+
+from glusterfs_tpu.api.glfs import Client, wait_connected
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import walk
+from glusterfs_tpu.core.metrics import REGISTRY
+from glusterfs_tpu.daemon import serve_brick
+from glusterfs_tpu.gateway import ClientPool, ObjectGateway
+from glusterfs_tpu.gateway.minihttp import fetch as http
+from glusterfs_tpu.protocol.client import ClientLayer
+
+BRICK = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+volume leases
+    type features/leases
+    subvolumes locks
+end-volume
+volume upcall
+    type features/upcall
+    subvolumes leases
+end-volume
+"""
+CLIENT = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume upcall
+end-volume
+"""
+
+def sample(snap, fam, **labels):
+    return sum(v for l, v in snap.get(fam, {}).get("samples", [])
+               if all(l.get(k) == lv for k, lv in labels.items()))
+
+def wire(graphs):
+    return sum(l.rpc_roundtrips for g in graphs for l in walk(g.top)
+               if isinstance(l, ClientLayer))
+
+async def main():
+    base = tempfile.mkdtemp(prefix="lease-smoke")
+    server = await serve_brick(BRICK.format(dir=os.path.join(base, "b")))
+    vf = CLIENT.format(port=server.port)
+
+    async def factory():
+        c = Client(Graph.construct(vf))
+        await c.mount()
+        await wait_connected(c.graph)
+        return c
+
+    gw = ObjectGateway(ClientPool(factory, 2),
+                       volume="leasev", object_cache_size=4 << 20)
+    await gw.start()
+    H, P = gw.host, gw.port
+    fuse = await factory()
+    payload = bytes(range(256)) * 128  # 32 KiB
+    try:
+        assert (await http(H, P, "PUT", "/b"))[0] == 200
+        st, hd, _ = await http(H, P, "PUT", "/b/hot", body=payload)
+        assert st == 200, st
+        etag = hd["etag"]
+        st, _, data = await http(H, P, "GET", "/b/hot")  # fills cache
+        assert st == 200 and data == payload
+        snap0 = REGISTRY.snapshot()
+        n0 = wire(c.graph for c in gw.pool.clients)
+        for _ in range(20):
+            st, _, data = await http(H, P, "GET", "/b/hot")
+            assert st == 200 and data == payload
+        for _ in range(5):
+            st, _, _ = await http(H, P, "GET", "/b/hot",
+                                  headers={"if-none-match": etag})
+            assert st == 304, st
+        assert wire(c.graph for c in gw.pool.clients) == n0, \
+            "hot-GET loop touched the wire"
+        snap1 = REGISTRY.snapshot()
+        h0 = sample(snap0, "gftpu_cache_hits_total", cache="gateway")
+        h1 = sample(snap1, "gftpu_cache_hits_total", cache="gateway")
+        assert h1 >= h0 + 25, f"gateway cache hits not monotonic " \
+            f"({h0} -> {h1})"
+        assert sample(snap1, "gftpu_cache_bytes_total",
+                      cache="gateway") > 0
+        assert sample(snap1, "gftpu_leases", state="held") >= 1, \
+            "brick lease gauge empty while the cache serves"
+        # recall coherence: an out-of-band overwrite drops the entry
+        # before the ack; the next GET serves the new bytes
+        v2 = b"recalled" * 4096
+        await fuse.write_file("/b/hot", v2)
+        for _ in range(100):
+            if gw._ocache.dump()["objects"] == 0:
+                break
+            await asyncio.sleep(0.05)
+        st, _, data = await http(H, P, "GET", "/b/hot")
+        assert st == 200 and data == v2, "stale bytes after recall"
+        snap2 = REGISTRY.snapshot()
+        assert sample(snap2, "gftpu_lease_recalls_total",
+                      reason="conflict") >= 1
+    finally:
+        await fuse.unmount()
+        await gw.stop()
+        await server.stop()
+
+    # -- managed path: the op-version 15 volume-set keys ----------------
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+    d = Glusterd(os.path.join(base, "gd"))
+    await d.start()
+    try:
+        async with MgmtClient(d.host, d.port) as mc:
+            await mc.call("volume-create", name="lv",
+                          vtype="distribute",
+                          bricks=[{"path": os.path.join(base, "vb0")}])
+            await mc.call("volume-start", name="lv")
+            await mc.call("volume-set", name="lv",
+                          key="features.leases", value="on")
+            for key, val in (("features.lease-timeout", "600"),
+                             ("gateway.object-cache-size", "4MB")):
+                r = await mc.call("volume-set", name="lv",
+                                  key=key, value=val)
+                assert r.get("ok", True), (key, r)
+        m = await mount_volume(d.host, d.port, "lv")
+        try:
+            await m.write_file("/leased", b"managed" * 1024)
+            assert await m.lease_acquire("/leased") is True, \
+                "managed brick refused a lease grant"
+            assert bytes(await m.read_file("/leased")) == \
+                b"managed" * 1024
+        finally:
+            await m.unmount()
+    finally:
+        await d.stop()
+        shutil.rmtree(base, ignore_errors=True)
+    print("lease smoke: 25 hot GETs at zero wire fops, recall-exact "
+          "coherence, cache/lease families monotonic, v15 keys accepted")
+
+asyncio.run(main())
+EOF
+lease_rc=$?
+if [ $lease_rc -ne 0 ]; then
+    echo "ci: lease smoke failed — not mergeable"
+    exit $lease_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
@@ -801,5 +954,5 @@ fi
 echo "ci: mergeable (two identical green tier-1 runs + bench contract"
 echo "    + metrics smoke + gateway smoke + concurrency smoke"
 echo "    + mesh smoke + chaos smoke + delta-write smoke"
-echo "    + rebalance smoke + process-plane smoke)"
+echo "    + rebalance smoke + process-plane smoke + lease smoke)"
 exit 0

@@ -188,6 +188,7 @@ CAPABILITIES = {
     "trace": "checked",
     "deadline": "checked",
     "xorv": "checked",
+    "leases": "checked",
     "sg": ("exempt",
            "requester-driven: the client ASKS via the sg-replies cred "
            "and must decode sg frames iff it asked; the reply key is "
