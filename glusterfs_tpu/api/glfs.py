@@ -261,6 +261,17 @@ class _UpcallSink:
                 data.get("gfid"):
             self.invalidations += 1
             self.itable.invalidate(data["gfid"])
+            client = self.client() if self.client is not None else None
+            if client is not None:
+                # api-consumer invalidation hooks (the glfs upcall
+                # callback surface): the gateway's ETag memo rides
+                # here — any out-of-band change to a gfid must dirty
+                # derived validators, not just the data caches
+                for cb in client.on_invalidate:
+                    try:
+                        cb(bytes(data["gfid"]))
+                    except Exception:  # noqa: BLE001 - tap isolation
+                        pass
             if data.get("event") == "lease-recall":
                 client = self.client() if self.client is not None \
                     else None
@@ -297,6 +308,23 @@ class Client:
         self._lease_ok: bool | None = None
         self.lease_recalls = 0
         self._lease_tasks: set = set()  # in-flight release acks
+        # api-consumer upcall hooks: callbacks fired as (gfid) on every
+        # server-pushed invalidation (gateway ETag memo, embedders)
+        self.on_invalidate: list = []
+        # QoS traffic attribution (features/qos): set BEFORE mount()
+        # so the first handshake already carries it; "" = ordinary
+        # client, "rebalance" rides the brick's paced lane
+        self.traffic_origin = ""
+
+    def _apply_origin(self, top) -> None:
+        """Stamp the origin onto every wire layer of a graph (applied
+        at mount and re-applied after a reload swap — reconnects then
+        re-send it in each fresh handshake's creds)."""
+        if not self.traffic_origin:
+            return
+        for layer in walk(top):
+            if hasattr(layer, "traffic_origin"):
+                layer.traffic_origin = self.traffic_origin
 
     def _wire_lease_registry(self, top) -> None:
         """Hand every lease-aware cache layer the held-lease registry
@@ -307,6 +335,10 @@ class Client:
                 hook(self.leases)
 
     async def mount(self) -> None:
+        # origin stamping precedes activation: the FIRST handshake of
+        # every wire layer must already carry the attribution (tagging
+        # after connect would leave a race window of unattributed fops)
+        self._apply_origin(self.graph.top)
         if not self.graph.active:
             await self.graph.activate()
         if self.upcall_sink not in self.graph.top.parents:
@@ -348,6 +380,7 @@ class Client:
         if self.graph.apply_volfile(volfile_text):
             return "reconfigured"
         new = Graph.construct(volfile_text)
+        self._apply_origin(new.top)
         await new.activate()
         try:
             await wait_connected(new)

@@ -1,0 +1,343 @@
+"""Multi-tenant QoS plane — per-client token buckets, priority lanes,
+and quota-driven backpressure.
+
+The reference names the shape without ever assembling it: io-threads'
+least-priority class and throttling knobs (io-threads.c), the tbf token
+bucket (libglusterfs/src/throttle-tbf.c, used only by bitrot), and
+``server.outstanding-rpc-limit``'s per-client admission gate
+(rpcsvc.c:211-250).  This module is the assembly: one
+:class:`QosEngine` per served brick top (and one per gateway process)
+holds a pair of token buckets per client identity — fops/s and bytes/s
+— consulted by ``protocol/server`` at FRAME ADMISSION, before the fop
+ever enters the brick graph.
+
+Verdicts, and why there are two throttle modes:
+
+* **shed** — a rate-bucket overdraft refuses the frame with a
+  retryable EAGAIN carrying ``xdata["qos-throttle"] = {retry-after,
+  reason}``.  The refusal is ANSWERED over a healthy transport, so the
+  client's PR-9 circuit breaker (which only counts transport failures)
+  structurally cannot trip on shaping — shed-by-identity happens
+  before the breaker ever sees trouble.  And because a shed frame was
+  never dispatched, the client may safely retry ANY fop, not just
+  idempotent ones.
+* **shape** — the connection's read loop sleeps instead of erroring:
+  soft-quota pressure (features/quota's over-soft-limit window) and
+  the rebalance lane both want the traffic to COMPLETE, just slower.
+  TCP flow control then shapes the sender.  Clients over soft quota
+  get shaped, not errored; rebalance daemons (``origin="rebalance"``
+  in the handshake creds) ride a shared paced lane sized by
+  ``qos-rebalance-throttle`` (the lazy/normal/aggressive table) —
+  shedding a migration daemon's non-idempotent fops would break the
+  move, so that lane never sheds.
+
+What is exempt, and why (``EXEMPT_FOPS``): lock-class fops (the same
+deadlock rule as outstanding-rpc-limit — a shed unlock can never free
+the blocked locks that filled the budget), and lease/fd teardown
+(``lease``/``release``/``releasedir``): a recall's ack must never be
+shed, so QoS never holds cache coherence hostage — and in particular
+never recalls (or stalls the return of) a lease just to shape a
+client.  Leased zero-wire readers never reach admission at all: their
+reads are served from client-side caches at zero round trips, which is
+the cheapest possible citizen.
+
+Observability: THROTTLE_{START,STOP} lifecycle events fire on the
+TRANSITION edge only (one START when a client first gets shaped, one
+STOP after a full quiet window — the quorum-event discipline, not one
+event per shed frame), the ``gftpu_qos_*`` families below, and a
+per-client ``qos`` block in ``volume status clients``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable
+
+from ..core.events import gf_event
+from ..core import gflog
+from ..core import metrics as _metrics
+from ..core.options import parse_bool
+from ..mgmt.svcutil import TokenBucket
+
+log = gflog.get_logger("features.qos")
+
+#: fops never charged to a client's buckets (see module docstring)
+EXEMPT_FOPS = {"inodelk", "finodelk", "entrylk", "fentrylk", "lk",
+               "lease", "release", "releasedir"}
+
+#: write-path fops shaped under soft-quota pressure — features/quota's
+#: enforced set plus the namespace creators that grow usage; delaying
+#: reads buys the quota nothing
+SOFT_SHAPED_FOPS = {"writev", "truncate", "ftruncate", "fallocate",
+                    "create", "mknod", "mkdir"}
+
+#: the rebalance lane's fops/s pacing per ``qos-rebalance-throttle``
+#: mode — the lazy/normal/aggressive table the daemon's client-side
+#: ThrottleWave expresses in migration width, re-expressed here as a
+#: brick-side admission rate (aggressive = unpaced, 0 disables)
+REBAL_LANE_FOPS = {"lazy": 64.0, "normal": 512.0, "aggressive": 0.0}
+
+
+def _b(v: Any) -> bool:
+    try:
+        return parse_bool(v)
+    except Exception:  # noqa: BLE001 - malformed option disables
+        return False
+
+
+def _f(v: Any, default: float = 0.0) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def _ident_hex(identity: Any) -> str:
+    """Event/status identity: full hex for bytes (the client-uid shape
+    the rest of the status plane uses), str for gateway peer IPs."""
+    if isinstance(identity, (bytes, bytearray)):
+        return bytes(identity).hex()
+    return str(identity)
+
+
+class _ClientState:
+    """Per-identity shaping state: the bucket pair + the throttle edge
+    tracker behind THROTTLE_{START,STOP}."""
+
+    __slots__ = ("fops", "bytes", "throttled", "reason", "since",
+                 "last_hit", "shed_fops", "shed_bytes", "shaped_fops")
+
+    def __init__(self) -> None:
+        self.fops = TokenBucket(0.0)
+        self.bytes = TokenBucket(0.0)
+        self.throttled = False
+        self.reason = ""
+        self.since = 0.0
+        self.last_hit = 0.0
+        self.shed_fops = 0
+        self.shed_bytes = 0
+        self.shaped_fops = 0
+
+
+class QosEngine:
+    """Admission-control engine for one served top (or one gateway).
+
+    ``opts_fn`` is read PER VERDICT (the ``outstanding-rpc-limit``
+    live-reconfigure pattern): a ``volume set server.qos-*`` retunes
+    running buckets on the next frame, no restart.  ``soft_fn`` yields
+    the identities currently over their quota soft limit (wired to
+    ``features/quota.qos_soft_clients`` by the server)."""
+
+    def __init__(self, name: str, opts_fn: Callable[[], dict],
+                 door: str = "brick",
+                 soft_fn: Callable[[], Iterable] | None = None):
+        self.name = name
+        self.opts_fn = opts_fn
+        self.door = door
+        self.soft_fn = soft_fn
+        self.clients: dict[Any, _ClientState] = {}
+        self._rebal = TokenBucket(0.0)
+        # family counters, labeled by throttle mode
+        self.stats = {"shed": 0, "shaped": 0}
+        self.stats_bytes = {"shed": 0, "shaped": 0}
+        _ENGINES.add(self)
+
+    # -- option reads (live) ----------------------------------------------
+
+    def _opts(self) -> dict:
+        try:
+            return self.opts_fn() or {}
+        except Exception:  # noqa: BLE001 - a dying graph must not shed
+            return {}
+
+    def enabled(self, opts: dict | None = None) -> bool:
+        return _b((opts if opts is not None
+                   else self._opts()).get("qos", False))
+
+    def _window(self, opts: dict) -> float:
+        return max(_f(opts.get("qos-shaped-window", 2.0), 2.0), 0.1)
+
+    # -- the verdict -------------------------------------------------------
+
+    def admit(self, identity: Any, fop: str = "", nbytes: int = 0,
+              origin: str = "") -> tuple[str, float, str]:
+        """One frame's verdict: ``("ok", 0, "")``, ``("shed",
+        retry_after, reason)`` or ``("shape", delay, reason)``.
+
+        ``nbytes`` is the wire frame size in hand (rx); reply bytes are
+        charged after the fact via :meth:`charge` — the bucket borrows
+        (goes negative) so a big readv's reply delays the NEXT
+        admission instead of blocking this send."""
+        opts = self._opts()
+        if not self.enabled(opts):
+            return ("ok", 0.0, "")
+        now = time.monotonic()
+        if origin == "rebalance":
+            # the paced lane: migration fops complete, just slower —
+            # shedding the daemon's non-idempotent moves would break
+            # the migration.  One SHARED bucket: the lane budget is
+            # per brick, not per daemon connection.
+            rate = REBAL_LANE_FOPS.get(
+                str(opts.get("qos-rebalance-throttle",
+                             "normal") or "normal"), 512.0)
+            if rate <= 0:
+                return ("ok", 0.0, "")
+            self._rebal.set_rate(rate)
+            wait = self._rebal.try_take(1.0)
+            if wait > 0:
+                self.stats["shaped"] += 1
+                self.stats_bytes["shaped"] += int(nbytes)
+                return ("shape", min(wait, 1.0), "rebalance")
+            return ("ok", 0.0, "")
+        if fop in EXEMPT_FOPS:
+            return ("ok", 0.0, "")
+        st = self.clients.get(identity)
+        if st is None:
+            st = self.clients[identity] = _ClientState()
+        burst_s = max(_f(opts.get("qos-burst", 1.0), 1.0), 0.001)
+        frate = _f(opts.get("qos-fops-per-sec", 0))
+        brate = _f(opts.get("qos-bytes-per-sec", 0))
+        st.fops.set_rate(frate, frate * burst_s or None)
+        st.bytes.set_rate(brate, brate * burst_s or None)
+        wait = st.fops.try_take(1.0)
+        if nbytes:
+            wait = max(wait, st.bytes.try_take(float(nbytes)))
+        if wait > 0:
+            st.shed_fops += 1
+            st.shed_bytes += int(nbytes)
+            self.stats["shed"] += 1
+            self.stats_bytes["shed"] += int(nbytes)
+            self._hit(identity, st, "rate", now)
+            return ("shed", wait, "rate")
+        if self.soft_fn is not None and fop in SOFT_SHAPED_FOPS:
+            try:
+                soft = self.soft_fn()
+            except Exception:  # noqa: BLE001 - quota probe must not shed
+                soft = ()
+            if identity in soft:
+                delay = _f(opts.get("qos-soft-quota-delay", 0.05), 0.05)
+                if delay > 0:
+                    st.shaped_fops += 1
+                    self.stats["shaped"] += 1
+                    self.stats_bytes["shaped"] += int(nbytes)
+                    self._hit(identity, st, "soft-quota", now)
+                    return ("shape", delay, "soft-quota")
+        self._maybe_stop(identity, st, self._window(opts), now)
+        return ("ok", 0.0, "")
+
+    def charge(self, identity: Any, nbytes: int) -> None:
+        """Debit reply bytes against an EXISTING client's bytes bucket
+        (borrowing — see :meth:`admit`).  Unknown identities (mgmt
+        conns, pre-admission probes) are never charged."""
+        st = self.clients.get(identity)
+        if st is not None and nbytes:
+            st.bytes.debit(float(nbytes))
+
+    def lane(self, identity: Any, origin: str = "") -> str:
+        """io-threads priority lane for this request: rebalance traffic
+        and currently-shaped clients ride the least-priority class
+        (io-threads' enable-least-priority model), everyone else keeps
+        the per-fop priority table."""
+        if not self.enabled():
+            return ""
+        if origin == "rebalance":
+            return "least"
+        st = self.clients.get(identity)
+        return "least" if st is not None and st.throttled else ""
+
+    # -- throttle lifecycle edges -----------------------------------------
+
+    def _hit(self, identity: Any, st: _ClientState, reason: str,
+             now: float) -> None:
+        st.last_hit = now
+        if not st.throttled:
+            st.throttled = True
+            st.reason = reason
+            st.since = now
+            gf_event("THROTTLE_START", volume=self.name, door=self.door,
+                     client=_ident_hex(identity), reason=reason)
+
+    def _maybe_stop(self, identity: Any, st: _ClientState,
+                    window: float, now: float) -> None:
+        if st.throttled and now - st.last_hit >= window:
+            st.throttled = False
+            gf_event("THROTTLE_STOP", volume=self.name, door=self.door,
+                     client=_ident_hex(identity), reason=st.reason,
+                     duration=round(now - st.since, 3))
+            st.reason = ""
+
+    def poll(self) -> None:
+        """Sweep STOP edges for clients that went quiet without sending
+        another frame (the admission path only sees active clients)."""
+        window = self._window(self._opts())
+        now = time.monotonic()
+        for identity, st in list(self.clients.items()):
+            self._maybe_stop(identity, st, window, now)
+
+    def release_client(self, identity: Any) -> None:
+        """Disconnect reap: a START without a matching STOP would read
+        as still-throttled in the event history."""
+        st = self.clients.pop(identity, None)
+        if st is not None and st.throttled:
+            gf_event("THROTTLE_STOP", volume=self.name, door=self.door,
+                     client=_ident_hex(identity), reason=st.reason,
+                     duration=round(time.monotonic() - st.since, 3))
+
+    # -- views (status + metrics) -----------------------------------------
+
+    def shaped_count(self) -> int:
+        self.poll()
+        return sum(1 for st in self.clients.values() if st.throttled)
+
+    def client_view(self, identity: Any) -> dict:
+        """The ``qos`` block of one ``volume status clients`` row."""
+        opts = self._opts()
+        st = self.clients.get(identity)
+        if st is not None:
+            self._maybe_stop(identity, st, self._window(opts),
+                             time.monotonic())
+        row = {"enabled": self.enabled(opts),
+               "shaped": bool(st is not None and st.throttled),
+               "reason": st.reason if st is not None else "",
+               "shed_fops": st.shed_fops if st is not None else 0,
+               "shed_bytes": st.shed_bytes if st is not None else 0,
+               "shaped_fops": st.shaped_fops if st is not None else 0}
+        if st is not None and row["enabled"]:
+            row["tokens"] = {"fops": round(st.fops.level(), 1),
+                             "bytes": round(st.bytes.level(), 1)}
+        return row
+
+    def _token_samples(self):
+        for identity, st in self.clients.items():
+            labels = {"server": self.name, "door": self.door,
+                      "client": _ident_hex(identity)[:8]}
+            yield {**labels, "bucket": "fops"}, st.fops.level()
+            yield {**labels, "bucket": "bytes"}, st.bytes.level()
+
+
+# live engines, scraped by the unified registry (weakref: a stopped
+# server's engine ages out with the GC)
+_ENGINES = _metrics.REGISTRY.register_objects(
+    "gftpu_qos_throttled_fops_total", "counter",
+    "frames refused (mode=shed: EAGAIN + retry-after notice) or "
+    "delayed (mode=shaped: soft-quota / rebalance-lane pacing) by the "
+    "QoS admission plane",
+    lambda e: [({"server": e.name, "door": e.door, "mode": m}, v)
+               for m, v in e.stats.items()])
+_metrics.REGISTRY.register_objects(
+    "gftpu_qos_throttled_bytes_total", "counter",
+    "wire bytes of frames shed or shaped by the QoS admission plane",
+    lambda e: [({"server": e.name, "door": e.door, "mode": m}, v)
+               for m, v in e.stats_bytes.items()],
+    live=_ENGINES)
+_metrics.REGISTRY.register_objects(
+    "gftpu_qos_shaped_clients", "gauge",
+    "client identities currently inside a throttle window "
+    "(THROTTLE_START fired, no STOP yet)",
+    lambda e: [({"server": e.name, "door": e.door}, e.shaped_count())],
+    live=_ENGINES)
+_metrics.REGISTRY.register_objects(
+    "gftpu_qos_tokens", "gauge",
+    "current token balance per client bucket (negative = borrowed "
+    "against reply bytes already sent)",
+    lambda e: e._token_samples(), live=_ENGINES)

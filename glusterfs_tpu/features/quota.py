@@ -70,6 +70,12 @@ class QuotaLayer(Layer):
         self._soft_warned: set[str] = set()
         self._dirty: set[str] = set()  # dirs with unpersisted deltas
         self._persisted_at: dict[str, float] = {}
+        # identities recently seen writing into an over-soft-limit
+        # directory (identity -> last-seen monotonic) — the QoS plane's
+        # backpressure feed (protocol/server polls qos_soft_clients and
+        # SHAPES these writers instead of erroring them; the hard limit
+        # still EDQUOTs in _check)
+        self._soft_clients: dict = {}
         self._parse_limits(self.opts["limits"])
 
     def _parse_limits(self, text: str) -> None:
@@ -209,6 +215,13 @@ class QuotaLayer(Layer):
                 import time as _time
 
                 now = _time.monotonic()
+                # QoS backpressure feed: remember WHO is pushing this
+                # directory over its soft limit (frame->root->client)
+                from ..rpc import wire as _wire
+
+                ident = _wire.CURRENT_CLIENT.get()
+                if ident is not None:
+                    self._soft_clients[ident] = now
                 warned = getattr(self, "_soft_warned_at", None)
                 if warned is None:
                     warned = self._soft_warned_at = {}
@@ -231,6 +244,23 @@ class QuotaLayer(Layer):
 
                     gf_event("QUOTA_SOFT_LIMIT", path=d,
                              used=int(used), limit=int(lim))
+
+    # soft-pressure attribution expires after this quiet interval: a
+    # writer that backed off (or whose directory was cleaned up) stops
+    # being shaped without any explicit reset
+    _SOFT_TTL = 3.0
+
+    def qos_soft_clients(self):
+        """Identities currently driving some directory over its soft
+        limit — polled by protocol/server's QoS engine (features/qos),
+        which shapes their writes via admission delay instead of
+        erroring them."""
+        import time as _time
+
+        now = _time.monotonic()
+        self._soft_clients = {i: t for i, t in self._soft_clients.items()
+                              if now - t < self._SOFT_TTL}
+        return set(self._soft_clients)
 
     async def _account(self, path: str, delta: int) -> None:
         for d in self._covering(path):

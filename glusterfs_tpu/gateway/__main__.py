@@ -71,12 +71,21 @@ def _object_cache_bytes(args) -> int:
     return parse_size(args.object_cache)
 
 
+def _qos_kw(args) -> dict:
+    from ..core.options import parse_size, parse_time
+
+    return {"qos_fops": float(args.qos_fops),
+            "qos_bytes": float(parse_size(args.qos_bytes)),
+            "qos_burst": float(parse_time(args.qos_burst))}
+
+
 async def _amain_single(args) -> None:
     gw = ObjectGateway(ClientPool(_pool_factory(args), args.pool),
                        host=args.host, port=args.listen,
                        max_clients=args.max_clients,
                        volume=args.volume or args.volfile,
-                       object_cache_size=_object_cache_bytes(args))
+                       object_cache_size=_object_cache_bytes(args),
+                       **_qos_kw(args))
     await gw.start()
     if args.portfile:
         tmp = args.portfile + ".tmp"
@@ -105,7 +114,8 @@ async def _amain_worker(args) -> None:
                        host=args.host, port=args.listen,
                        max_clients=args.max_clients,
                        volume=args.volume or args.volfile,
-                       object_cache_size=_object_cache_bytes(args))
+                       object_cache_size=_object_cache_bytes(args),
+                       **_qos_kw(args))
     await worker_serve(gw, args.worker_fd, args.worker_rank,
                        args.reuseport, args.host, args.listen)
 
@@ -118,7 +128,16 @@ async def _amain_supervisor(args) -> None:
                  # per-worker budget: shared-nothing workers each own a
                  # full cache (their own pool clients hold the leases
                  # that keep it coherent)
-                 "--object-cache", str(_object_cache_bytes(args))]
+                 "--object-cache", str(_object_cache_bytes(args)),
+                 # per-worker buckets too: a peer's rate is enforced by
+                 # whichever worker its connections land on, so with a
+                 # multi-connection peer striped across N workers the
+                 # pool-wide ceiling is up to N x the configured rate
+                 # (documented in docs/qos.md; same shared-nothing
+                 # trade the cache makes)
+                 "--qos-fops", str(args.qos_fops),
+                 "--qos-bytes", str(args.qos_bytes),
+                 "--qos-burst", str(args.qos_burst)]
     if args.volfile:
         base_argv += ["--volfile", args.volfile]
     else:
@@ -178,6 +197,18 @@ def main(argv=None) -> int:
                         "size suffixes accepted "
                         "(gateway.object-cache-size; 0 = off; per "
                         "worker when --workers is set)")
+    p.add_argument("--qos-fops", type=float, default=0.0,
+                   help="per-peer-IP request rate limit, fops/s "
+                        "(server.qos-fops-per-sec; 0 = off; per "
+                        "worker when --workers is set)")
+    p.add_argument("--qos-bytes", default="0",
+                   help="per-peer-IP payload rate limit, bytes/s, "
+                        "size suffixes accepted "
+                        "(server.qos-bytes-per-sec; 0 = off)")
+    p.add_argument("--qos-burst", default="1",
+                   help="bucket depth in seconds of the configured "
+                        "rate, time suffixes accepted "
+                        "(server.qos-burst)")
     p.add_argument("--metrics-port", type=int, default=0,
                    help="serve the unified metrics registry on this "
                         "port (0 = off; aggregated across workers "

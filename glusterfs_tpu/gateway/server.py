@@ -26,7 +26,13 @@ multiplex onto a small :class:`ClientPool` of mounted
 :class:`api.glfs.Client` graphs (the pooled-glfs-handle analog of how
 NFS-Ganesha shares a few glfs_t among many NFS clients).  Admission
 control is connection-granular: past ``max_clients`` live connections
-the gateway answers 503 and emits ``GATEWAY_CLIENT_THROTTLED``.
+the gateway answers 503 and emits ``GATEWAY_CLIENT_THROTTLED``.  When
+glusterd's spawner passes the volume's ``server.qos-*`` rates it is
+ALSO request-granular: per-peer-IP token buckets (features/qos,
+``door="gateway"``) answer 429 + ``Retry-After`` on overdraft — HTTP
+clients inherit the same per-identity shaping the brick applies on the
+wire, and a lease-held object-cache hit is exempt from the fops bucket
+(zero wire fops; QoS never recalls a lease just to shape).
 
 Zero-copy GET path: ranged reads ride
 :meth:`api.glfs.Client.read_file`'s raw window — wire blob views /
@@ -86,8 +92,9 @@ _REASONS = {200: "OK", 204: "No Content", 206: "Partial Content",
             304: "Not Modified", 400: "Bad Request", 403: "Forbidden",
             404: "Not Found", 405: "Method Not Allowed",
             409: "Conflict", 411: "Length Required",
-            416: "Range Not Satisfiable", 500: "Internal Server Error",
-            503: "Service Unavailable", 507: "Insufficient Storage"}
+            416: "Range Not Satisfiable", 429: "Too Many Requests",
+            500: "Internal Server Error", 503: "Service Unavailable",
+            507: "Insufficient Storage"}
 
 # one family set scraped over every live gateway instance (the
 # register_objects weak-population pattern core/metrics documents)
@@ -345,7 +352,9 @@ class ObjectGateway:
 
     def __init__(self, pool: ClientPool, host: str = "127.0.0.1",
                  port: int = 0, max_clients: int = 512,
-                 volume: str = "", object_cache_size: int = 0):
+                 volume: str = "", object_cache_size: int = 0,
+                 qos_fops: float = 0.0, qos_bytes: float = 0.0,
+                 qos_burst: float = 1.0):
         self.pool = pool
         self.host = host
         self.port = port
@@ -378,6 +387,32 @@ class ObjectGateway:
         self._etags: "collections.OrderedDict[bytes, tuple]" = \
             collections.OrderedDict()
         self.etag_fast_hits = 0
+        # gfids whose STORED etag xattr can no longer be trusted: an
+        # out-of-band writer (fuse/glfs, another door) modified the
+        # object in place, which invalidates both the memo AND the
+        # persisted hash.  Fed by the pool clients' upcall
+        # invalidations (Client.on_invalidate); the value is a
+        # generation counter so every overwrite changes the weak
+        # validator _etag_of synthesizes for a dirty gfid
+        self._etag_dirty: dict[bytes, int] = {}
+        self.etag_invalidations = 0
+        # per-HTTP-peer QoS buckets (features/qos, door="gateway"):
+        # HTTP clients inherit the same admission model the brick
+        # applies per connection identity, keyed by peer IP so a
+        # greedy curl loop is shaped no matter how many connections
+        # it opens.  Sheds answer 429 + Retry-After — the HTTP
+        # spelling of the brick's EAGAIN + qos-throttle notice.
+        self._qos = None
+        if float(qos_fops) > 0 or float(qos_bytes) > 0:
+            from ..features.qos import QosEngine
+
+            self._qos_opts = {"qos": "on",
+                              "qos-fops-per-sec": float(qos_fops),
+                              "qos-bytes-per-sec": float(qos_bytes),
+                              "qos-burst": float(qos_burst)}
+            self._qos = QosEngine(volume or "gateway",
+                                  lambda: self._qos_opts,
+                                  door="gateway")
         _GATEWAYS.add(self)
 
     # -- lifecycle ---------------------------------------------------------
@@ -402,6 +437,14 @@ class ObjectGateway:
             for c in self.pool.clients:
                 if self._ocache.drop_gfid not in c.leases.on_drop:
                     c.leases.on_drop.append(self._ocache.drop_gfid)
+        # ETag-memo coherence for OUT-OF-BAND writers: an upcall
+        # invalidation against any pool client marks the gfid dirty,
+        # so a fuse-side in-place overwrite can't keep serving the
+        # pre-overwrite hash to conditional GETs (the stored xattr is
+        # stale too — _etag_of switches to a weak validator)
+        for c in self.pool.clients:
+            if self._etag_invalidate not in c.on_invalidate:
+                c.on_invalidate.append(self._etag_invalidate)
         # pool-aware event plane: pre-size the shared reply-turning
         # workers to the pooled graphs' client.event-threads so the
         # first heavy GET doesn't pay the pool spin-up
@@ -481,6 +524,7 @@ class ObjectGateway:
                     "OPTIONS") else "OTHER"
                 self.inflight += 1
                 t0 = time.perf_counter()
+                tx0 = self.bytes_tx
                 status = 500
                 try:
                     status = await self._dispatch(
@@ -489,6 +533,12 @@ class ObjectGateway:
                     break
                 finally:
                     self.inflight -= 1
+                    if self._qos is not None:
+                        # reply bytes borrow against the peer's bytes
+                        # bucket (the brick's post-send charge): a big
+                        # GET delays the NEXT admission, never its own
+                        self._qos.charge(self._qos_ident(writer),
+                                         self.bytes_tx - tx0)
                     self.requests[(mkey, status)] = \
                         self.requests.get((mkey, status), 0) + 1
                     self.latency.setdefault(
@@ -574,10 +624,47 @@ class ObjectGateway:
         q = urllib.parse.parse_qs(query, keep_blank_values=True)
         return comps, {k: v[-1] for k, v in q.items()}
 
+    @staticmethod
+    def _qos_ident(writer) -> str:
+        """QoS identity of an HTTP request: the peer IP — buckets span
+        connections, so a greedy client can't dodge shaping by opening
+        more sockets (fd-passed / unix peers pool under 'local')."""
+        peer = writer.get_extra_info("peername")
+        if isinstance(peer, (tuple, list)) and peer:
+            return str(peer[0])
+        return "local"
+
+    async def _qos_gate(self, method: str, comps: list, headers,
+                        writer) -> None:
+        """Per-request admission against the peer's bucket pair.
+        A lease-held object-cache hit skips the fops bucket entirely:
+        it is served at ZERO wire fops, the cheapest possible citizen,
+        and shaping it could pressure the lease plane (QoS never
+        recalls a lease just to shape).  Reply bytes are still charged
+        after the response via the tx delta in _serve_conn."""
+        if method in ("GET", "HEAD") and len(comps) >= 2 and \
+                self._ocache is not None and self._ocache.get(
+                    f"/{comps[0]}/{'/'.join(comps[1:])}") is not None:
+            return
+        verdict, wait_s, why = self._qos.admit(
+            self._qos_ident(writer), fop=method.lower(),
+            nbytes=int(headers.get("content-length") or 0))
+        if verdict == "shed":
+            # the HTTP spelling of the brick's EAGAIN + notice; the
+            # request body is left unread, so the serve loop drops the
+            # connection after the response (correct: reading a shed
+            # PUT's body would do the work QoS just refused)
+            raise _HttpError(429, f"qos throttled ({why})",
+                             {"retry-after": max(1, int(wait_s + 1))})
+        if verdict == "shape":
+            await asyncio.sleep(wait_s)
+
     async def _dispatch(self, method, target, headers, body,
                         writer) -> int:
         try:
             comps, query = self._split_target(target)
+            if self._qos is not None:
+                await self._qos_gate(method, comps, headers, writer)
             c = self.pool.acquire()
             if not comps:
                 if method in ("GET", "HEAD"):
@@ -972,6 +1059,20 @@ class ObjectGateway:
 
     _ETAG_MEMO_MAX = 4096
 
+    def _etag_invalidate(self, gfid: bytes) -> None:
+        """Client.on_invalidate tap (upcall plane): another client
+        wrote this gfid through another door.  Drop the memo entry AND
+        remember the gfid as dirty — unlike a gateway PUT (which
+        always commits to a fresh gfid), an in-place overwrite leaves
+        the persisted ETag xattr describing the OLD bytes, so re-read
+        validation isn't enough; _etag_of must stop trusting it."""
+        gfid = bytes(gfid)
+        self.etag_invalidations += 1
+        self._etags.pop(gfid, None)
+        self._etag_dirty[gfid] = self._etag_dirty.get(gfid, 0) + 1
+        while len(self._etag_dirty) > self._ETAG_MEMO_MAX:
+            self._etag_dirty.pop(next(iter(self._etag_dirty)))
+
     async def _etag_of(self, c: Client, path: str, ia=None) -> str:
         # the conditional-GET fast path: a memo entry whose (mtime,
         # size) still matches the stat we already paid skips the wire
@@ -979,6 +1080,16 @@ class ObjectGateway:
         gfid = bytes(ia.gfid) if ia is not None and \
             getattr(ia, "gfid", None) else None
         if gfid is not None:
+            gen = self._etag_dirty.get(gfid)
+            if gen is not None:
+                # out-of-band overwrite: both the memo and the stored
+                # xattr hash may describe the pre-overwrite bytes.
+                # Serve a weak validator derived from what the stat in
+                # hand proves about the CURRENT bytes (+ the upcall
+                # generation, so even a same-second same-size
+                # overwrite changes the tag)
+                return (f"W-{int(ia.mtime * 1e9):x}"
+                        f"-{ia.size:x}-{gen:x}")
             memo = self._etags.get(gfid)
             if memo is not None and memo[0] == ia.mtime and \
                     memo[1] == ia.size:
@@ -1178,6 +1289,11 @@ class ObjectGateway:
                 "body_writes": dict(self.body_writes),
                 "sg_segments": self.sg_segments,
                 "etag_fast_hits": self.etag_fast_hits,
+                "etag_invalidations": self.etag_invalidations,
                 "object_cache": self._ocache.dump()
                 if self._ocache is not None else None,
+                "qos": {"enabled": True, **self._qos_opts,
+                        "shed": self._qos.stats["shed"],
+                        "shaped_clients": self._qos.shaped_count()}
+                if self._qos is not None else None,
                 "events": dict(self.events)}

@@ -97,32 +97,10 @@ async def content_hash(layer: Layer, path: str, gfid: bytes,
     return h.hexdigest()
 
 
-class TokenBucket:
-    """Scrub bandwidth cap — the libglusterfs throttle-tbf.c analog:
-    the scrubber refills ``rate`` byte-tokens per second and sleeps
-    when a read would overdraw, so background verification never
-    starves live I/O.  rate <= 0 disables."""
-
-    def __init__(self, rate: float):
-        self.rate = float(rate)
-        self.tokens = self.rate
-        self._t = time.monotonic()
-
-    async def take(self, n: int) -> None:
-        if self.rate <= 0:
-            return
-        while True:
-            now = time.monotonic()
-            self.tokens = min(self.rate,
-                              self.tokens + (now - self._t) * self.rate)
-            self._t = now
-            # an object bigger than one second's budget proceeds when
-            # the bucket is full (tbf_mod semantics: never starve)
-            if self.tokens >= n or self.tokens >= self.rate:
-                self.tokens -= n
-                return
-            await asyncio.sleep(
-                min(1.0, (min(n, self.rate) - self.tokens) / self.rate))
+# Scrub bandwidth cap: the shared throttle-tbf analog now lives in
+# svcutil (the QoS plane uses the same bucket); re-exported here so
+# `bitd.TokenBucket` keeps resolving for existing callers.
+from .svcutil import TokenBucket  # noqa: E402
 
 
 class BrickBitd:

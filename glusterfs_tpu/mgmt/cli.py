@@ -77,18 +77,29 @@ def _status_human(what: str, out: dict) -> str:
         for bname in sorted(bricks):
             payload = bricks[bname] or {}
             for c in payload.get("clients", ()):
+                qos = c.get("qos") or {}
+                if not qos.get("enabled"):
+                    shaped = "-"
+                elif qos.get("shaped"):
+                    # inside a throttle window right now (reason =
+                    # rate / soft-quota), with the lifetime shed count
+                    shaped = (f"{qos.get('reason', '')}"
+                              f"({qos.get('shed_fops', 0)})")
+                else:
+                    shaped = "no"
                 rows.append([bname, c["client"][:16], c["addr"],
                              f"{c['uptime']:.0f}s",
                              _human_bytes(c["bytes_rx"]),
                              _human_bytes(c["bytes_tx"]),
-                             c["fops"], c["opened_fds"],
+                             c["fops"], c["opened_fds"], shaped,
                              "mgmt" if c.get("mgmt") else
                              f"op-{c.get('op_version', 0)}"])
             if payload.get("offline"):
                 rows.append([bname, "-", "-", "-", "-", "-", "-", "-",
-                             "OFFLINE"])
+                             "-", "OFFLINE"])
         parts.append(_table(["BRICK", "CLIENT", "ADDR", "UPTIME", "RX",
-                             "TX", "FOPS", "FDS", "KIND"], rows))
+                             "TX", "FOPS", "FDS", "SHAPED", "KIND"],
+                            rows))
         return "\n".join(parts)
     if what == "fds":
         rows = []

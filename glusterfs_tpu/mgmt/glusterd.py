@@ -2949,6 +2949,19 @@ class Glusterd:
                 "--object-cache",
                 str(opts.get("gateway.object-cache-size", 0)),
                 "--portfile", portfile]
+        if volgen._bool(opts.get("server.qos", "off")):
+            # HTTP clients inherit the volume's QoS plane: the same
+            # server.qos-* rates the bricks enforce per wire identity,
+            # applied per peer IP at the gateway door (429 +
+            # Retry-After instead of EAGAIN + notice).  Spawn-time
+            # plumbing: retuning these keys live re-spawns via gateway
+            # stop/start (documented in docs/qos.md)
+            argv += ["--qos-fops",
+                     str(opts.get("server.qos-fops-per-sec", 0)),
+                     "--qos-bytes",
+                     str(opts.get("server.qos-bytes-per-sec", 0)),
+                     "--qos-burst",
+                     str(opts.get("server.qos-burst", 1))]
         workers = int(opts.get("gateway.workers", 0) or 0)
         if workers > 0:
             # the shared-nothing worker pool (op-version 14): the
@@ -3521,11 +3534,15 @@ async def _watch_volfile(client, host: str, port: int,
             await asyncio.sleep(1.0)
 
 
-async def mount_volume(host: str, port: int, volname: str):
+async def mount_volume(host: str, port: int, volname: str,
+                       origin: str = ""):
     """Fetch the client volfile from glusterd and build a mounted client
     (the glfs_set_volfile_server + GETSPEC path, api/src/glfs-mgmt.c).
     The mount stays subscribed to volfile changes and applies them live
-    (reconfigure or graph swap)."""
+    (reconfigure or graph swap).  ``origin`` attributes the mount's
+    traffic to the bricks' QoS plane ("rebalance" rides the paced
+    lane) — set here, BEFORE mount, so the very first handshake
+    carries it and every reconnect/graph-swap re-carries it."""
     from ..api.glfs import Client, wait_connected
     from ..core.graph import Graph
 
@@ -3533,6 +3550,8 @@ async def mount_volume(host: str, port: int, volname: str):
         spec = await c.call("getspec", name=volname)
     graph = Graph.construct(spec["volfile"])
     client = Client(graph)
+    if origin:
+        client.traffic_origin = origin
     await client.mount()
     await wait_connected(graph)
     client.watchers.append(

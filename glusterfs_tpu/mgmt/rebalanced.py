@@ -618,7 +618,11 @@ async def _amain(args) -> int:
     client = None
     while client is None:
         try:
-            client = await mount_volume(host, port, args.volname)
+            # origin rides the handshake creds (QoS plane): the brick
+            # routes this daemon's fops into the paced rebalance lane
+            # from the FIRST post-handshake frame
+            client = await mount_volume(host, port, args.volname,
+                                        origin="rebalance")
         except Exception as e:
             log.warning(2, "rebalanced mount %s failed (%r), retrying",
                         args.volname, e)

@@ -1,16 +1,104 @@
 """Shared plumbing for per-volume service daemons (bitd, quotad, …):
 credential/TLS wiring between glusterd's spawner and the daemon's
-brick ClientLayers, and the migration-wave throttle both rebalance
-walks share.  One copy, so an auth change lands everywhere
+brick ClientLayers, the migration-wave throttle both rebalance walks
+share, and the token-bucket rate limiter the scrubber and the QoS
+plane share.  One copy, so an auth change lands everywhere
 (glusterd-svc-mgmt.c is the reference's shared service layer)."""
 
 from __future__ import annotations
 
 import asyncio
 import os
+import time
 from typing import Any
 
 from . import volgen
+
+
+class TokenBucket:
+    """The libglusterfs throttle-tbf.c analog, generalized from the
+    bitrot scrubber's bandwidth cap (mgmt/bitd.py) for the QoS plane
+    (features/qos.py): ``rate`` tokens refill per second up to a
+    ``burst`` ceiling.  ``take`` sleeps until the debit fits (shaping —
+    the scrubber / rebalance-lane semantic); ``try_take`` never sleeps
+    and instead reports how long the caller would have to wait (the
+    admission-shed semantic: the brick answers a retryable errno
+    carrying that wait instead of parking the connection).
+
+    rate <= 0 disables — every take is free, every try_take admits.
+    ``set_rate`` retunes a LIVE bucket (volume set): accumulated
+    tokens are clamped to the new burst so a rate cut takes effect
+    within one refill window instead of after the old burst drains."""
+
+    def __init__(self, rate: float, burst: float | None = None):
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None else self.rate
+        self.tokens = self.burst
+        self._t = time.monotonic()
+
+    def set_rate(self, rate: float, burst: float | None = None) -> None:
+        rate = float(rate)
+        if rate != self.rate or (burst is not None
+                                 and float(burst) != self.burst):
+            was_off = self.rate <= 0
+            self._refill()
+            self.rate = rate
+            self.burst = float(burst) if burst is not None else rate
+            # a bucket switching on starts FULL (a disabled bucket
+            # accrued nothing — without this a client's first frame
+            # after enable would shed); a live retune keeps the
+            # accrued balance, clamped to the new burst
+            self.tokens = self.burst if was_off \
+                else min(self.tokens, self.burst)
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self._t) * self.rate)
+        self._t = now
+
+    def level(self) -> float:
+        """Current token balance (refilled to now) — the gauge probe."""
+        if self.rate <= 0:
+            return 0.0
+        self._refill()
+        return self.tokens
+
+    def debit(self, n: float) -> None:
+        """Unconditional debit — the balance may go NEGATIVE
+        (borrowing): reply bytes are charged after the send, and the
+        debt delays the next admission instead of blocking this one."""
+        if self.rate <= 0:
+            return
+        self._refill()
+        self.tokens -= n
+
+    def try_take(self, n: float) -> float:
+        """Debit ``n`` tokens without ever sleeping.  Returns 0.0 on
+        success; otherwise the seconds until ``n`` (clamped to one
+        burst — a debit bigger than the bucket proceeds when it is
+        full, the tbf never-starve rule) would be available."""
+        if self.rate <= 0:
+            return 0.0
+        self._refill()
+        need = min(n, self.burst)
+        if self.tokens >= need:
+            self.tokens -= n  # may go negative: oversized debits owe
+            return 0.0
+        return (need - self.tokens) / self.rate
+
+    async def take(self, n: float) -> None:
+        if self.rate <= 0:
+            return
+        while True:
+            self._refill()
+            # an object bigger than one burst's budget proceeds when
+            # the bucket is full (tbf_mod semantics: never starve)
+            if self.tokens >= n or self.tokens >= self.burst:
+                self.tokens -= n
+                return
+            await asyncio.sleep(
+                min(1.0, (min(n, self.burst) - self.tokens) / self.rate))
 
 
 class ThrottleWave:

@@ -82,6 +82,21 @@ OPTION_MAP = {
                                         "adaptive-window"),
     "server.outstanding-rpc-limit": ("protocol/server",
                                      "outstanding-rpc-limit"),
+    # multi-tenant QoS plane (features/qos, op-version 16): per-client
+    # token buckets + priority lanes enforced at the brick's frame
+    # admission; the same rates reach the gateway door via glusterd's
+    # spawner.  client.qos-backoff is the mount-side half: re-send a
+    # shed frame after the advertised retry-after
+    "server.qos": ("protocol/server", "qos"),
+    "server.qos-fops-per-sec": ("protocol/server", "qos-fops-per-sec"),
+    "server.qos-bytes-per-sec": ("protocol/server",
+                                 "qos-bytes-per-sec"),
+    "server.qos-burst": ("protocol/server", "qos-burst"),
+    "server.qos-shaped-window": ("protocol/server",
+                                 "qos-shaped-window"),
+    "server.qos-soft-quota-delay": ("protocol/server",
+                                    "qos-soft-quota-delay"),
+    "client.qos-backoff": ("protocol/client", "qos-backoff"),
     "auth.reject": ("protocol/server", "auth-reject"),
     "server.ssl": ("protocol/server", "ssl"),
     "client.ssl": ("protocol/client", "ssl"),
@@ -784,6 +799,23 @@ _V15_KEYS = (
 )
 OPTION_MIN_OPVERSION.update({k: 15 for k in _V15_KEYS})
 
+# round-17 additions ship at op-version 16: the multi-tenant QoS plane
+# — a v15 brick's admission path has no QosEngine (the keys would
+# store and silently not shed/shape), a v15 client doesn't understand
+# the EAGAIN + qos-throttle notice as a backoff signal (it would
+# surface spurious EAGAINs to callers instead of re-sending), and a
+# v15 glusterd's gateway spawner has no --qos-* arm
+_V16_KEYS = (
+    "server.qos",
+    "server.qos-fops-per-sec",
+    "server.qos-bytes-per-sec",
+    "server.qos-burst",
+    "server.qos-shaped-window",
+    "server.qos-soft-quota-delay",
+    "client.qos-backoff",
+)
+OPTION_MIN_OPVERSION.update({k: 16 for k in _V16_KEYS})
+
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key
 DEFAULT_PERF_STACK = [
@@ -962,6 +994,14 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
     sopts.update(_compound_options(volinfo))
     sopts.update(_sg_options(volinfo))
     sopts.update(_trace_options(volinfo))
+    # the QoS rebalance lane inherits the operator's ONE throttle word:
+    # cluster.rebal-throttle already sizes the daemon's client-side
+    # migration wave, and the same lazy/normal/aggressive mode sizes
+    # the brick-side paced lane for origin="rebalance" traffic — two
+    # expressions of one knob, never two knobs
+    rebal = volinfo.get("options", {}).get("cluster.rebal-throttle")
+    if rebal is not None:
+        sopts["qos-rebalance-throttle"] = rebal
     auth = volinfo.get("auth") or {}
     if auth:
         sopts["auth-user"] = auth["username"]

@@ -258,7 +258,16 @@ def _gated(fop: Fop):
     name = fop.value
 
     async def fop_impl(self, *args, **kwargs):
+        from ..rpc import wire as _wire
+
         p = pri
+        if p != 3 and _wire.CURRENT_LANE.get() == "least":
+            # per-client priority lane (features/qos): the server
+            # demoted this request — a currently-shaped client or
+            # rebalance-origin traffic rides the least-priority class
+            # (io-threads' enable-least-priority model, applied per
+            # REQUEST instead of per fop type)
+            p = 3
         if p == 3 and not self.opts["enable-least-priority"]:
             p = 1  # least-priority disabled: ride the normal queue
         self.queued[p] += 1
@@ -269,8 +278,6 @@ def _gated(fop: Fop):
                 # behind the gate, drop it NOW — the reply would be
                 # discarded by a caller that already raised ETIMEDOUT,
                 # and the worker slot belongs to a live request
-                from ..rpc import wire as _wire
-
                 dl = _wire.CURRENT_DEADLINE.get()
                 if dl is not None and \
                         asyncio.get_running_loop().time() > dl:

@@ -77,6 +77,12 @@ _metrics.REGISTRY.register_objects(
     "out its own deadline",
     lambda l: [({"layer": l.name}, l.failfast_drops)],
     live=_LIVE_CLIENT_LAYERS)
+_metrics.REGISTRY.register_objects(
+    "gftpu_qos_client_backoff_total", "counter",
+    "fops re-sent after a brick qos-throttle shed (the client half of "
+    "the QoS plane: the caller sees a slower fop, never the EAGAIN)",
+    lambda l: [({"layer": l.name}, l.qos_backoff_total)],
+    live=_LIVE_CLIENT_LAYERS)
 
 
 @register("protocol/client")
@@ -179,6 +185,16 @@ class ClientLayer(Layer):
         Option("retry-backoff-max", "time", default="1",
                description="cap on the exponential retry backoff "
                            "(base 50ms, doubling per attempt)"),
+        Option("qos-backoff", "bool", default="on",
+               description="honor brick qos-throttle notices "
+                           "(client.qos-backoff): a frame shed by the "
+                           "brick's QoS admission (EAGAIN + retry-after "
+                           "in the error xdata) is re-sent after the "
+                           "advertised wait instead of surfacing the "
+                           "errno — safe for ANY fop, idempotent or "
+                           "not, because a shed frame was refused at "
+                           "admission and never dispatched.  Off = the "
+                           "raw EAGAIN (+ notice) reaches the caller"),
         Option("deadline-propagation", "bool", default="on",
                description="ship each fop's remaining deadline budget "
                            "in the request (network.deadline-"
@@ -252,6 +268,12 @@ class ClientLayer(Layer):
         self._cb_probing = False
         self.retries_total = 0
         self.failfast_drops = 0
+        # QoS plane (features/qos): traffic attribution carried in the
+        # handshake creds ("rebalance" rides the brick's paced lane;
+        # set by api.Client/mount_volume BEFORE connect so the first
+        # handshake already carries it), and the shed-retry count
+        self.traffic_origin = ""
+        self.qos_backoff_total = 0
         # did the brick advertise deadline-budget arming at SETVOLUME?
         self._peer_deadline = False
         # did the brick advertise the xorv fop (parity-delta writes)?
@@ -339,6 +361,10 @@ class ClientLayer(Layer):
         from .. import OP_VERSION
 
         creds["op-version"] = OP_VERSION
+        if self.traffic_origin:
+            # QoS traffic attribution (features/qos): re-sent on every
+            # reconnect handshake, so attribution survives a bounce
+            creds["origin"] = self.traffic_origin
         if self.opts["trace-fops"]:
             creds["trace-fops"] = True
         if self.opts["compression"]:
@@ -780,6 +806,11 @@ class ClientLayer(Layer):
         "getxattr", "fgetxattr", "statfs", "readdir", "readdirp",
         "seek", "rchecksum"))
 
+    # qos-backoff retry ceiling: with a sane brick config the advertised
+    # retry-after drains the bucket debt in a few rounds; the cap only
+    # guards against a pathological advert spinning the loop forever
+    _QOS_RETRY_CAP = 64
+
     async def fop_call(self, name: str, *args, **kwargs) -> Any:
         """One fop through the breaker, with the idempotent-retry loop:
         read-class fops re-dispatch after transport-class failures with
@@ -787,10 +818,30 @@ class ClientLayer(Layer):
         past an OPEN circuit — load shedding beats persistence on a
         flapping brick."""
         attempt = 0
+        shaped = 0
         while True:
             try:
                 return await self._fop_call_once(name, *args, **kwargs)
             except FopError as e:
+                note = (getattr(e, "xdata", None) or {}).get(
+                    "qos-throttle")
+                if note is not None and e.err == errno.EAGAIN and \
+                        self.opts["qos-backoff"] and not self._closing \
+                        and shaped < self._QOS_RETRY_CAP:
+                    # brick QoS shed (features/qos): refused at
+                    # admission, never dispatched — so retrying is safe
+                    # for ANY fop, not just idempotent ones.  The wait
+                    # comes from the brick's own bucket math; the
+                    # backoff cap bounds a misconfigured advert.  This
+                    # loop IS the client-side shaping: the caller just
+                    # sees a slower fop, never the errno.
+                    shaped += 1
+                    self.qos_backoff_total += 1
+                    delay = min(float(self.opts["retry-backoff-max"]),
+                                max(float(note.get("retry-after") or 0),
+                                    0.005))
+                    await asyncio.sleep(delay)
+                    continue
                 if not self._is_transport_err(e) or \
                         name not in self._IDEMPOTENT_FOPS or \
                         self._closing or self._cb_state == "open" or \

@@ -169,6 +169,50 @@ class ServerLayer(Layer):
                            "fops are exempt from the count — a limit full "
                            "of blocked locks would otherwise never admit "
                            "the unlock that frees them (rpcsvc.c:183-208)"),
+        Option("qos", "bool", default="off",
+               description="per-client QoS admission control "
+                           "(features/qos): token-bucket rate limits "
+                           "by client identity, enforced at frame "
+                           "admission — overdrafts are refused with a "
+                           "retryable EAGAIN carrying a qos-throttle "
+                           "notice (retry-after) in the error xdata, "
+                           "answered over the healthy transport so the "
+                           "client circuit breaker never counts them"),
+        Option("qos-fops-per-sec", "int", default=0, min=0,
+               description="per-client fop admission rate; 0 = "
+                           "unlimited.  Lock-class and lease/release "
+                           "fops are exempt (shedding an unlock or a "
+                           "recall ack would deadlock the very client "
+                           "being shaped)"),
+        Option("qos-bytes-per-sec", "size", default="0",
+               description="per-client wire-byte rate (request frames "
+                           "charged at admission, reply frames debited "
+                           "after send — a greedy reader borrows "
+                           "against its bucket and the debt delays its "
+                           "next admission); 0 = unlimited"),
+        Option("qos-burst", "time", default="1",
+               description="bucket depth in seconds of the configured "
+                           "rate: how much a quiet client may burst "
+                           "before shaping starts"),
+        Option("qos-shaped-window", "time", default="2",
+               description="quiet time after the last shed/shape "
+                           "before a client's THROTTLE_STOP fires "
+                           "(lifecycle events are transition-edge "
+                           "only)"),
+        Option("qos-soft-quota-delay", "time", default="0.05",
+               description="per-write-fop admission delay for clients "
+                           "over their quota SOFT limit "
+                           "(features/quota): shaped via TCP "
+                           "backpressure, not errored — the hard "
+                           "limit still returns EDQUOT"),
+        Option("qos-rebalance-throttle", "enum", default="normal",
+               values=("lazy", "normal", "aggressive"),
+               description="fops/s pacing of the rebalance-origin "
+                           "admission lane (lazy=64, normal=512, "
+                           "aggressive=unpaced) — the cluster.rebal-"
+                           "throttle table re-expressed as a QoS lane; "
+                           "the lane paces (sleeps), never sheds: "
+                           "migration fops are not idempotent"),
     )
 
     _TRANSPORT_OPTS = ("ssl", "ssl-cert", "ssl-key", "ssl-ca")
@@ -263,6 +307,9 @@ class _ClientConn:
         self.fop_counts: dict[str, int] = {}
         self.caps: dict = {}  # capabilities advertised at SETVOLUME
         self.opversion = 0    # peer build's op-version (0 = pre-8 peer)
+        # traffic origin from the handshake creds ("rebalance" rides
+        # the paced QoS lane; "" / "client" is ordinary traffic)
+        self.origin = ""
         # outstanding-rpc occupancy (status callpool reads these; they
         # replace the old _serve-closure locals)
         self.inflight = 0
@@ -284,6 +331,7 @@ class _ClientConn:
                 "fop_counts": dict(self.fop_counts),
                 "opened_fds": len(self.fds),
                 "inflight": self.inflight + self.exempt_inflight,
+                "origin": self.origin,
                 "mgmt": self.is_mgmt}
 
     def register_fd(self, fd: FdObj) -> wire.FdHandle:
@@ -375,7 +423,57 @@ class BrickServer:
         # frame-turning workers shared by every connection (and every
         # multiplexed brick) on this transport
         self._pool: EventPool | None = None
+        # QoS admission engines (features/qos), one per served top —
+        # created lazily on the first option-carrying connection so
+        # bare-Layer test servers never pay for the plane
+        self._qos: dict[str, Any] = {}
         _LIVE_SERVERS.add(self)
+
+    # -- QoS admission (features/qos; server.qos-* options) ----------------
+
+    def _qos_of(self, top: Layer):
+        """The admission engine for a served top; None when the top
+        carries no options (bare-Layer test servers).  Option values
+        are read per-verdict inside the engine, so ``volume set``
+        retunes live buckets."""
+        opts = self._opts_of(top)
+        if not opts or "qos" not in opts:
+            return None
+        eng = self._qos.get(top.name)
+        if eng is None:
+            from ..features.qos import QosEngine
+
+            eng = self._qos[top.name] = QosEngine(
+                top.name, lambda: self._opts_of(top),
+                soft_fn=lambda: self._soft_quota_clients(top))
+        return eng
+
+    @staticmethod
+    def _soft_quota_clients(top: Layer):
+        """Identities currently over a quota SOFT limit, pulled from
+        any quota layers in the served graph (features/quota exposes
+        qos_soft_clients) — the backpressure half of the QoS plane."""
+        from ..core.layer import walk
+
+        out: set = set()
+        for layer in walk(top):
+            fn = getattr(layer, "qos_soft_clients", None)
+            if fn is not None:
+                try:
+                    out |= set(fn())
+                except Exception:  # noqa: BLE001 - probe must not shed
+                    pass
+        return out
+
+    def _lane_of(self, conn: _ClientConn) -> str:
+        """io-threads lane of the request being dispatched (rides
+        wire.CURRENT_LANE): least-priority for rebalance-origin and
+        currently-shaped clients when QoS is on."""
+        top = conn.top if conn.top is not None else self.top
+        eng = self._qos.get(top.name)
+        if eng is None or conn.is_mgmt:
+            return ""
+        return eng.lane(conn.identity, conn.origin)
 
     # -- per-client metrics families (scraped by core/metrics.REGISTRY) ----
 
@@ -762,8 +860,17 @@ class BrickServer:
                                              xid, resp_type, resp)
                 else:
                     frames = wire.pack_frames(xid, resp_type, resp)
+            nbytes = sum(len(f) for f in frames)
+            if conn.authed and not conn.is_mgmt and self._qos:
+                # reply-byte debit (features/qos): a greedy reader's
+                # big readv replies borrow against its bytes bucket —
+                # the debt delays its NEXT admission
+                eng = self._qos.get((conn.top if conn.top is not None
+                                     else self.top).name)
+                if eng is not None:
+                    eng.charge(conn.identity, nbytes)
             async with wlock:
-                conn.bytes_tx += sum(len(f) for f in frames)
+                conn.bytes_tx += nbytes
                 writer.writelines(frames)
                 await writer.drain()
 
@@ -858,6 +965,35 @@ class BrickServer:
                     continue
                 fop = payload[0] if isinstance(payload, list) and payload \
                     else None
+                # QoS admission (features/qos, server.qos-*): the
+                # verdict lands BEFORE the outstanding-rpc gate — a
+                # shed frame must not occupy an admission slot.  Sheds
+                # are ANSWERED (EAGAIN + retry-after in the error
+                # xdata) over the healthy transport, so the client's
+                # circuit breaker structurally cannot count them; and
+                # the frame was never dispatched, so the client may
+                # retry ANY fop.  Shapes (soft-quota pressure, the
+                # rebalance lane) sleep the read loop instead — TCP
+                # flow control slows the sender, nothing errors.
+                if not conn.is_mgmt:
+                    eng = self._qos_of(conn.top if conn.top is not None
+                                       else self.top)
+                    if eng is not None:
+                        verdict, wait_s, why = eng.admit(
+                            conn.identity, fop=str(fop or ""),
+                            nbytes=len(rec) + 4, origin=conn.origin)
+                        if verdict == "shed":
+                            try:
+                                await send(xid, wire.MT_ERROR, FopError(
+                                    errno.EAGAIN, "qos throttled",
+                                    {"qos-throttle": {
+                                        "retry-after": round(wait_s, 4),
+                                        "reason": why}}))
+                            except ConnectionError:
+                                break
+                            continue
+                        if verdict == "shape":
+                            await asyncio.sleep(wait_s)
                 limit = _limit()
                 if limit <= 0:
                     kind = "free"  # operator chose unlimited
@@ -924,6 +1060,9 @@ class BrickServer:
                         rc(conn.identity)
                     except Exception:
                         pass
+            eng = self._qos.get(top.name)
+            if eng is not None and not conn.is_mgmt:
+                eng.release_client(conn.identity)
 
     # -- deep volume status (GF_CLI_STATUS_{DETAIL,CLIENTS,INODE,FD,
     # CALLPOOL,MEM} brick half, glusterd-op-sm.c op family) ---------------
@@ -937,8 +1076,17 @@ class BrickServer:
         from ..core.layer import walk
 
         if kind == "clients":
-            return {"clients": [c.info()
-                                for c in self._authed_conns(top)]}
+            eng = self._qos.get(top.name)
+            rows = []
+            for c in self._authed_conns(top):
+                row = c.info()
+                if eng is not None and not c.is_mgmt:
+                    # per-client shaping view (features/qos): whether
+                    # this identity is inside a throttle window, its
+                    # shed/shape counts, and the live bucket balances
+                    row["qos"] = eng.client_view(c.identity)
+                rows.append(row)
+            return {"clients": rows}
         if kind == "fds":
             out = []
             for c in self._authed_conns(top):
@@ -1084,6 +1232,11 @@ class BrickServer:
                 conn.is_mgmt = is_mgmt
                 conn.top, conn.graph = top, graph
                 conn.compress = bool((creds or {}).get("compress"))
+                # traffic origin (rebalance daemons ride the paced QoS
+                # lane; carried in creds so the FIRST post-handshake
+                # frame is already attributed — and a reconnect's fresh
+                # handshake re-carries it)
+                conn.origin = str((creds or {}).get("origin") or "")
                 # sg replies only flow to peers that asked for them
                 # (mixed-version: an old client never sees an sg dict)
                 conn.sg = bool((creds or {}).get("sg-replies")) and \
@@ -1204,6 +1357,7 @@ class BrickServer:
                     cnt[_lf] = cnt.get(_lf, 0) + 1
                     _scope_owner(largs, lkw, conn.identity)
                 wire.CURRENT_CLIENT.set(conn.identity)
+                wire.CURRENT_LANE.set(self._lane_of(conn))
                 # one handle-farm transaction per chain: batch the
                 # posix sidecar journal around the WHOLE dispatch, so
                 # the syscall coalescing holds even when a mid-graph
@@ -1255,6 +1409,7 @@ class BrickServer:
             _scope_owner(args, kwargs, conn.identity)
             # expose the peer identity to brick layers (frame->root->client)
             wire.CURRENT_CLIENT.set(conn.identity)
+            wire.CURRENT_LANE.set(self._lane_of(conn))
             ret = fn(*args, **kwargs)
             if asyncio.iscoroutine(ret):
                 ret = await ret

@@ -57,6 +57,14 @@ CURRENT_CLIENT: "_contextvars.ContextVar" = _contextvars.ContextVar(
 CURRENT_DEADLINE: "_contextvars.ContextVar" = _contextvars.ContextVar(
     "gftpu_current_deadline", default=None)
 
+# The io-threads priority lane of the request being dispatched, set
+# per-call by protocol/server from the QoS engine's verdict
+# (features/qos): "least" demotes the request to the least-priority
+# class (rebalance-origin traffic, currently-shaped clients); "" keeps
+# the per-fop priority table.
+CURRENT_LANE: "_contextvars.ContextVar" = _contextvars.ContextVar(
+    "gftpu_current_lane", default="")
+
 _HDR = struct.Struct(">IBBxx")
 
 # record flags (byte 5 of the header; 0 in pre-blob frames)

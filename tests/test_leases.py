@@ -458,6 +458,77 @@ def test_gateway_etag_fast_path(tmp_path):
     asyncio.run(run())
 
 
+def test_etag_memo_cross_door_overwrite(tmp_path):
+    """PR-16 gap, now closed: an OUT-OF-BAND in-place overwrite (same
+    gfid, other door) used to leave the gateway's ETag memo stale — a
+    conditional GET with the old ETag could answer 304 for bytes that
+    no longer exist.  The upcall invalidation now marks the gfid dirty:
+    the memo (and the stale content-hash xattr) are skipped and a weak
+    validator derived from the live stat answers instead."""
+    async def run():
+        server = await serve_brick(
+            LEASE_BRICK.format(dir=tmp_path / "b", recall="5"))
+        vf = PLAIN_CLIENT.format(port=server.port)
+
+        async def factory():
+            return await _mounted(vf)
+
+        # object cache OFF: the recall path can't save us — the memo
+        # correctness must come from the invalidation hook alone
+        gw = ObjectGateway(ClientPool(factory, 1), max_clients=64,
+                           volume="gwdirty")
+        await gw.start()
+        H, P = gw.host, gw.port
+        fuse = await _mounted(vf)
+        try:
+            await http(H, P, "PUT", "/b")
+            st, hd, _ = await http(H, P, "PUT", "/b/o", body=b"one")
+            etag = hd["etag"]
+            # prime the memo: revalidation answers without wire fops
+            st, _, _ = await http(H, P, "GET", "/b/o",
+                                  headers={"if-none-match": etag})
+            assert st == 304
+
+            # the other door rewrites the SAME file in place (same
+            # gfid — the case a gateway PUT, committing to a fresh
+            # gfid, can never produce)
+            await fuse.write_file("/b/o", b"two")
+            for _ in range(100):
+                if gw.etag_invalidations:
+                    break
+                await asyncio.sleep(0.05)
+            assert gw.etag_invalidations > 0
+
+            # the old ETag must NOT revalidate: full body, new bytes,
+            # and a weak validator (the gfid is dirty forever — its
+            # content hash can no longer be trusted without a read)
+            st, hd, data = await http(H, P, "GET", "/b/o",
+                                      headers={"if-none-match": etag})
+            assert st == 200 and data == b"two"
+            weak = hd["etag"]
+            assert weak.strip('"').startswith("W-")
+
+            # the weak validator itself still revalidates while the
+            # file stays put — conditional GETs keep working
+            st, _, data = await http(H, P, "GET", "/b/o",
+                                     headers={"if-none-match": weak})
+            assert st == 304 and data == b""
+
+            # and a further out-of-band change moves the validator
+            await fuse.write_file("/b/o", b"three!!")
+            await asyncio.sleep(0.1)
+            st, hd, data = await http(H, P, "GET", "/b/o",
+                                      headers={"if-none-match": weak})
+            assert st == 200 and data == b"three!!"
+            assert hd["etag"] != weak
+        finally:
+            await fuse.unmount()
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
 # -- the grant settles an open eager window (PR-6 window CLOSED) -------
 
 

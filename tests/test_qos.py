@@ -1,0 +1,584 @@
+"""Multi-tenant QoS plane (features/qos + server.qos-*): per-client
+token buckets enforced at frame admission, priority lanes, soft-quota
+backpressure, and the THROTTLE_{START,STOP} event edges.
+
+The enforced-limit pins live on BOTH wire ends: the raw-frame client
+sees the retryable EAGAIN + qos-throttle notice the brick answers, and
+the brick's own engine counters account the same sheds.  A real
+protocol/client with qos-backoff on absorbs the sheds invisibly."""
+
+import asyncio
+import errno
+import json
+import time
+
+import pytest
+
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.layer import Loc, walk
+from glusterfs_tpu.daemon import serve_brick
+from glusterfs_tpu.features import qos as qosmod
+from glusterfs_tpu.features.qos import QosEngine
+from glusterfs_tpu.mgmt.svcutil import TokenBucket
+from glusterfs_tpu.rpc import wire
+
+VOLFILE = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+
+volume srv
+    type protocol/server
+    option qos {qos}
+{extra}    subvolumes locks
+end-volume
+"""
+
+
+def _volfile(tmp_path, qos="on", **options):
+    extra = "".join(f"    option {k} {v}\n" for k, v in options.items())
+    return VOLFILE.format(dir=tmp_path / "b", qos=qos, extra=extra)
+
+
+class RawClient:
+    """Frame-level client (the test_rpc_backpressure idiom): sees the
+    wire exactly — a shed arrives as an MT_ERROR FopError payload."""
+
+    def __init__(self, identity=b"rawclient", creds=None):
+        self.identity = identity
+        self.creds = creds or {}
+        self.xid = 0
+        self.reader = None
+        self.writer = None
+
+    async def connect(self, port):
+        self.reader, self.writer = await asyncio.open_connection(
+            "127.0.0.1", port)
+        await self.call("__handshake__",
+                        (self.identity, "", self.creds), {})
+
+    async def call(self, fop, args, kwargs):
+        self.xid += 1
+        self.writer.write(wire.pack(self.xid, wire.MT_CALL,
+                                    [fop, args, kwargs]))
+        await self.writer.drain()
+        rec = await wire.read_frame(self.reader)
+        xid, _mtype, payload = wire.unpack(rec)
+        assert xid == self.xid
+        return payload
+
+    def close(self):
+        self.writer.close()
+
+
+# -- the svcutil token bucket (generalized throttle-tbf.c) -----------------
+
+
+def test_token_bucket_refill_math():
+    """rate tokens/s up to burst; a fresh bucket starts full; the wait
+    a try_take reports is exactly the refill time of the deficit."""
+    b = TokenBucket(10.0, 5.0)
+    for _ in range(5):
+        assert b.try_take(1.0) == 0.0  # burst drains free
+    wait = b.try_take(1.0)
+    assert 0.05 < wait <= 0.11  # ~1 token at 10/s
+    # deterministic refill: rewind the clock instead of sleeping
+    b._t -= 0.3
+    assert 2.5 < b.level() < 3.5  # 0.3s * 10/s accrued
+    assert b.try_take(1.0) == 0.0
+
+
+def test_token_bucket_disable_borrow_and_never_starve():
+    b = TokenBucket(0.0)
+    assert b.try_take(10_000.0) == 0.0  # rate<=0 = plane off
+    assert b.level() == 0.0
+    b = TokenBucket(10.0, 5.0)
+    # never-starve (tbf_mod): a debit bigger than one burst proceeds
+    # when the bucket is full, and the overdraft is owed
+    assert b.try_take(50.0) == 0.0
+    assert b.level() < -40.0
+    wait = b.try_take(1.0)
+    assert wait > 4.0  # the debt delays the next admission
+    # debit is unconditional (reply-byte charging)
+    b2 = TokenBucket(100.0, 100.0)
+    b2.debit(250.0)
+    assert b2.level() < -140.0
+
+
+def test_token_bucket_set_rate_live():
+    b = TokenBucket(0.0)
+    b.set_rate(100.0, 100.0)
+    # a bucket switching ON starts full — the first frame after a
+    # volume-set enable must not shed
+    assert 99.0 < b.level() <= 100.0
+    for _ in range(60):
+        b.try_take(1.0)
+    # a live retune clamps the accrued balance to the new burst
+    b.set_rate(10.0, 5.0)
+    assert b.level() <= 5.0
+    # retune to a bigger burst keeps (not refills) the balance
+    lvl = b.level()
+    b.set_rate(10.0, 50.0)
+    assert b.level() < lvl + 1.0
+
+
+# -- both wire ends: shed is answered, counted, and exempt-safe ------------
+
+
+def test_shed_on_both_wire_ends(tmp_path):
+    """Flooding past qos-fops-per-sec sheds with EAGAIN + a
+    qos-throttle notice (retry-after, reason) in the error xdata —
+    and the brick's engine counts the same sheds; lock fops still
+    flow with the bucket empty (the deadlock exemption)."""
+
+    async def run():
+        server = await serve_brick(_volfile(
+            tmp_path, **{"qos-fops-per-sec": 5, "qos-burst": 1}))
+        try:
+            a = RawClient()
+            await a.connect(server.port)
+            ok = sheds = 0
+            notice = None
+            for _ in range(30):
+                p = await a.call("lookup", (Loc("/"),), {})
+                if isinstance(p, FopError):
+                    assert p.err == errno.EAGAIN
+                    notice = (p.xdata or {}).get("qos-throttle")
+                    sheds += 1
+                else:
+                    ok += 1
+            assert ok >= 5 and sheds >= 1  # burst admitted, flood shed
+            assert notice is not None
+            assert notice["retry-after"] > 0
+            assert notice["reason"] == "rate"
+            eng = server._qos["srv"]
+            assert eng.stats["shed"] == sheds
+            assert eng.stats_bytes["shed"] > 0
+            # lock-class fops are exempt even with the bucket drained
+            got = await a.call("inodelk",
+                               ("dom", Loc("/"), "lock", "wr"), {})
+            assert not isinstance(got, FopError)
+            await a.call("inodelk", ("dom", Loc("/"), "unlock", "wr"),
+                         {})
+            # per-client status view reflects the shaping
+            view = eng.client_view(b"rawclient")
+            assert view["enabled"] and view["shed_fops"] == sheds
+            assert view["reason"] == "rate"
+            rows = server._status_of(server.top, "clients")["clients"]
+            mine = next(r for r in rows
+                        if r["client"] == b"rawclient".hex())
+            assert mine["qos"]["shed_fops"] == sheds
+            a.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+CLIENT_VOL = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+{opts}    option remote-subvolume srv
+end-volume
+"""
+
+
+async def _wire_client(port, **options):
+    opts = "".join(f"    option {k} {v}\n" for k, v in options.items())
+    g = Graph.construct(CLIENT_VOL.format(port=port, opts=opts))
+    await g.activate()
+    for _ in range(200):
+        if g.top.connected:
+            break
+        await asyncio.sleep(0.05)
+    assert g.top.connected, "client never connected"
+    return g
+
+
+def test_client_backoff_absorbs_sheds(tmp_path):
+    """qos-backoff on (default): the flood completes — every shed is
+    re-sent after the advertised retry-after, the caller never sees
+    the EAGAIN; off: the raw errno + notice surface."""
+
+    async def run():
+        server = await serve_brick(_volfile(
+            tmp_path, **{"qos-fops-per-sec": 100, "qos-burst": 1}))
+        try:
+            g = await _wire_client(server.port)
+            for _ in range(140):  # ~40 past the burst
+                await g.top.lookup(Loc("/"))
+            assert g.top.qos_backoff_total > 0
+            assert server._qos["srv"].stats["shed"] > 0
+            await g.fini()
+
+            g2 = await _wire_client(server.port, **{"qos-backoff":
+                                                    "off"})
+            seen = None
+            for _ in range(200):
+                try:
+                    await g2.top.lookup(Loc("/"))
+                except FopError as e:
+                    seen = e
+                    break
+            assert seen is not None and seen.err == errno.EAGAIN
+            assert seen.xdata["qos-throttle"]["retry-after"] > 0
+            await g2.fini()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_rebalance_origin_paced_never_shed(tmp_path):
+    """origin="rebalance" in the handshake creds rides the shared
+    paced lane: even with a 1 fop/s client limit the migration fops
+    all COMPLETE (shaped, never shed — they are not idempotent)."""
+
+    async def run():
+        server = await serve_brick(_volfile(
+            tmp_path, **{"qos-fops-per-sec": 1,
+                         "qos-rebalance-throttle": "lazy"}))
+        try:
+            r = RawClient(b"rebal", creds={"origin": "rebalance"})
+            await r.connect(server.port)
+            for _ in range(80):  # past the lazy lane's 64-token burst
+                p = await r.call("lookup", (Loc("/"),), {})
+                assert not isinstance(p, FopError)
+            eng = server._qos["srv"]
+            assert eng.stats["shed"] == 0
+            assert eng.stats["shaped"] > 0  # the lane paced the tail
+            assert eng.lane(b"rebal", "rebalance") == "least"
+            r.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- engine verdicts (unit) ------------------------------------------------
+
+
+def _engine(opts, soft_fn=None):
+    return QosEngine("t0", lambda: opts, soft_fn=soft_fn)
+
+
+def test_exempt_fops_admit_with_empty_bucket():
+    opts = {"qos": "on", "qos-fops-per-sec": 1, "qos-burst": 1}
+    eng = _engine(opts)
+    assert eng.admit(b"c", fop="lookup")[0] == "ok"  # burst
+    assert eng.admit(b"c", fop="lookup")[0] == "shed"
+    for fop in sorted(qosmod.EXEMPT_FOPS):
+        assert eng.admit(b"c", fop=fop)[0] == "ok", fop
+
+
+def test_bytes_bucket_and_reply_borrowing():
+    opts = {"qos": "on", "qos-bytes-per-sec": 1000, "qos-burst": 1}
+    eng = _engine(opts)
+    assert eng.admit(b"c", fop="readv", nbytes=600)[0] == "ok"
+    verdict, wait, why = eng.admit(b"c", fop="readv", nbytes=600)
+    assert (verdict, why) == ("shed", "rate") and wait > 0
+    # reply bytes borrow: the debt sheds the NEXT admission
+    eng2 = _engine(dict(opts))
+    assert eng2.admit(b"c", fop="readv", nbytes=10)[0] == "ok"
+    eng2.charge(b"c", 5000)
+    assert eng2.admit(b"c", fop="readv", nbytes=10)[0] == "shed"
+    # unknown identities (mgmt conns, cache-only gateway peers) are
+    # never charged — no state materializes
+    eng2.charge(b"ghost", 5000)
+    assert b"ghost" not in eng2.clients
+
+
+def test_soft_quota_shapes_writes_not_reads():
+    soft = set()
+    opts = {"qos": "on", "qos-soft-quota-delay": 0.02}
+    eng = _engine(opts, soft_fn=lambda: soft)
+    assert eng.admit(b"c", fop="writev", nbytes=10)[0] == "ok"
+    soft.add(b"c")
+    verdict, wait, why = eng.admit(b"c", fop="writev", nbytes=10)
+    assert (verdict, why) == ("shape", "soft-quota")
+    assert wait == pytest.approx(0.02)
+    # reads buy the quota nothing — never shaped
+    assert eng.admit(b"c", fop="readv", nbytes=10)[0] == "ok"
+    # other clients untouched
+    assert eng.admit(b"d", fop="writev", nbytes=10)[0] == "ok"
+    assert eng.stats["shaped"] == 1
+    # a shaped (not shed) client still rides the least lane
+    assert eng.lane(b"c") == "least"
+
+
+def test_live_reconfigure_every_qos_key():
+    """opts_fn is read PER VERDICT: every server.qos-* key takes
+    effect on the next admit, no restart (the outstanding-rpc-limit
+    live-reconfigure pattern)."""
+    soft = set()
+    opts = {"qos": "off", "qos-fops-per-sec": 1, "qos-burst": 1}
+    eng = _engine(opts, soft_fn=lambda: soft)
+    for _ in range(5):
+        assert eng.admit(b"c", fop="lookup")[0] == "ok"  # plane off
+    opts["qos"] = "on"                       # server.qos
+    assert eng.admit(b"c", fop="lookup")[0] == "ok"  # enable = full
+    assert eng.admit(b"c", fop="lookup")[0] == "shed"
+    opts["qos-fops-per-sec"] = 100_000       # server.qos-fops-per-sec
+    # the transition frame re-seeds the bucket clock (accrual up to
+    # the retune ran at the OLD rate), so relief starts one refill
+    # tick later — the client's backoff absorbs that single shed
+    eng.admit(b"c", fop="lookup")
+    time.sleep(0.001)
+    assert eng.admit(b"c", fop="lookup")[0] == "ok"
+    opts["qos-bytes-per-sec"] = 100          # server.qos-bytes-per-sec
+    assert eng.admit(b"e1", fop="readv", nbytes=60)[0] == "ok"
+    assert eng.admit(b"e1", fop="readv", nbytes=60)[0] == "shed"
+    opts["qos-burst"] = 600                  # server.qos-burst
+    assert all(eng.admit(b"e2", fop="readv", nbytes=60)[0] == "ok"
+               for _ in range(20))  # 600s of depth absorbs the same run
+    soft.add(b"c")
+    opts["qos-soft-quota-delay"] = 0.0       # server.qos-soft-quota-delay
+    assert eng.admit(b"c", fop="writev")[0] == "ok"  # 0 = no shaping
+    opts["qos-soft-quota-delay"] = 0.01
+    assert eng.admit(b"c", fop="writev")[0] == "shape"
+    # server.qos-rebalance-throttle: lazy paces after 64, aggressive
+    # unpaces entirely
+    opts["qos-rebalance-throttle"] = "lazy"
+    verdicts = {eng.admit(b"r", fop="lookup", origin="rebalance")[0]
+                for _ in range(80)}
+    assert verdicts == {"ok", "shape"}
+    opts["qos-rebalance-throttle"] = "aggressive"
+    assert all(eng.admit(b"r", fop="lookup",
+                         origin="rebalance")[0] == "ok"
+               for _ in range(80))
+    # server.qos-shaped-window: a short window lets the throttle edge
+    # clear without new traffic (exercised in the event test below);
+    # the engine floors it at 0.1s
+    opts["qos-shaped-window"] = 0.12
+    assert eng._window(opts) == pytest.approx(0.12)
+    opts["qos-shaped-window"] = 0.01
+    assert eng._window(opts) == pytest.approx(0.1)
+
+
+def test_throttle_event_transition_edges():
+    """One THROTTLE_START per shaping episode (not per shed frame);
+    STOP fires after a quiet window — or at disconnect reap."""
+    events = []
+    orig = qosmod.gf_event
+    qosmod.gf_event = lambda ev, **kw: events.append((ev, kw))
+    try:
+        opts = {"qos": "on", "qos-fops-per-sec": 1, "qos-burst": 1,
+                "qos-shaped-window": 0.12}
+        eng = _engine(opts)
+        eng.admit(b"c", fop="lookup")
+        for _ in range(4):
+            eng.admit(b"c", fop="lookup")  # repeated sheds, one edge
+        starts = [kw for ev, kw in events if ev == "THROTTLE_START"]
+        assert len(starts) == 1
+        assert starts[0]["client"] == b"c".hex()
+        assert starts[0]["reason"] == "rate"
+        assert starts[0]["door"] == "brick"
+        assert eng.shaped_count() == 1
+        time.sleep(0.15)
+        eng.poll()  # quiet past the window: the sweep fires STOP
+        stops = [kw for ev, kw in events if ev == "THROTTLE_STOP"]
+        assert len(stops) == 1 and stops[0]["duration"] >= 0
+        assert eng.shaped_count() == 0
+        # disconnect reap: a throttled client's STOP must not be lost
+        opts["qos-shaped-window"] = 60
+        for _ in range(3):
+            eng.admit(b"d", fop="lookup")
+        eng.release_client(b"d")
+        stops = [kw for ev, kw in events if ev == "THROTTLE_STOP"]
+        assert len(stops) == 2 and stops[1]["client"] == b"d".hex()
+        assert b"d" not in eng.clients
+    finally:
+        qosmod.gf_event = orig
+
+
+def test_registry_families():
+    from glusterfs_tpu.core.metrics import REGISTRY
+
+    opts = {"qos": "on", "qos-fops-per-sec": 1, "qos-burst": 1,
+            "qos-shaped-window": 60}
+    eng = QosEngine("metrics-brick", lambda: opts)
+    eng.admit(b"\xab\xcd", fop="lookup", nbytes=100)
+    eng.admit(b"\xab\xcd", fop="lookup", nbytes=100)  # shed
+    out = REGISTRY.collect()
+    for fam in ("gftpu_qos_throttled_fops_total",
+                "gftpu_qos_throttled_bytes_total",
+                "gftpu_qos_shaped_clients", "gftpu_qos_tokens"):
+        assert fam in out, fam
+
+    def sample(fam, **match):
+        return [v for labels, v in out[fam]["samples"]
+                if all(labels.get(k) == w for k, w in match.items())]
+
+    assert sample("gftpu_qos_throttled_fops_total",
+                  server="metrics-brick", mode="shed") == [1]
+    assert sample("gftpu_qos_throttled_bytes_total",
+                  server="metrics-brick", mode="shed") == [100]
+    assert sample("gftpu_qos_shaped_clients",
+                  server="metrics-brick") == [1]
+    toks = sample("gftpu_qos_tokens", server="metrics-brick",
+                  client=b"\xab\xcd".hex()[:8])
+    assert len(toks) == 2  # one per bucket
+    # counters are monotonic across more activity
+    eng.admit(b"\xab\xcd", fop="lookup", nbytes=100)
+    out2 = REGISTRY.collect()
+    assert [v for labels, v in
+            out2["gftpu_qos_throttled_fops_total"]["samples"]
+            if labels.get("server") == "metrics-brick"
+            and labels.get("mode") == "shed"] == [2]
+
+
+# -- priority lanes through io-threads -------------------------------------
+
+IOT_VOLFILE = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+
+volume iot
+    type performance/io-threads
+    subvolumes posix
+end-volume
+"""
+
+
+def test_priority_lane_demotes_to_least(tmp_path):
+    """wire.CURRENT_LANE == "least" (set per dispatch by the server
+    from the engine's verdict) demotes ANY fop to io-threads'
+    least-priority class — and enable-least-priority off falls back
+    to the normal queue, same as for the per-fop least set."""
+
+    async def run():
+        g = Graph.construct(IOT_VOLFILE.format(dir=tmp_path / "b"))
+        await g.activate()
+        iot = next(l for l in walk(g.top)
+                   if l.type_name == "performance/io-threads")
+        await g.top.lookup(Loc("/"))
+        assert iot.executed[3] == 0  # lookup rides its own class
+        tok = wire.CURRENT_LANE.set("least")
+        try:
+            await g.top.lookup(Loc("/"))
+            assert iot.executed[3] == 1  # demoted per REQUEST
+            iot.reconfigure({"enable-least-priority": "off"})
+            before = iot.executed[1]
+            await g.top.lookup(Loc("/"))
+            assert iot.executed[3] == 1  # least disabled: normal queue
+            assert iot.executed[1] == before + 1
+        finally:
+            wire.CURRENT_LANE.reset(tok)
+        await g.fini()
+
+    asyncio.run(run())
+
+
+# -- quota soft-limit backpressure, end to end ------------------------------
+
+QUOTA_VOLFILE = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+
+volume quota
+    type features/quota
+    option limits {limits}
+    option default-soft-limit 50
+    subvolumes posix
+end-volume
+
+volume srv
+    type protocol/server
+    option qos on
+    option qos-soft-quota-delay 0.01
+    subvolumes quota
+end-volume
+"""
+
+
+def test_soft_quota_backpressure_over_the_wire(tmp_path):
+    """A writer over its directory's SOFT limit gets shaped (admission
+    delay, fop still succeeds); the HARD limit still EDQUOTs — shaping
+    never replaces enforcement."""
+
+    async def run():
+        server = await serve_brick(QUOTA_VOLFILE.format(
+            dir=tmp_path / "b",
+            limits=json.dumps({"/d": 8192}, separators=(",", ":"))))
+        try:
+            a = RawClient(b"writer")
+            await a.connect(server.port)
+            await a.call("mkdir", (Loc("/d"), 0o755), {})
+            fd, _ = await a.call("create", (Loc("/d/f"), 66, 0o644), {})
+            # past the 50% soft limit (4096), under the hard limit —
+            # the quota layer records WHO is pushing
+            p = await a.call("writev", (fd, b"x" * 5000, 0), {})
+            assert not isinstance(p, FopError)
+            ql = next(l for l in walk(server.top)
+                      if l.type_name == "features/quota")
+            assert b"writer" in ql.qos_soft_clients()
+            # the next write is SHAPED (delayed, not errored)
+            eng = server._qos["srv"]
+            shaped0 = eng.stats["shaped"]
+            p = await a.call("writev", (fd, b"y" * 100, 5000), {})
+            assert not isinstance(p, FopError)
+            assert eng.stats["shaped"] > shaped0
+            assert eng.stats["shed"] == 0
+            # the hard limit still refuses outright
+            p = await a.call("writev", (fd, b"z" * 8192, 5100), {})
+            assert isinstance(p, FopError) and p.err == errno.EDQUOT
+            a.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_wire_reconfigure_flips_plane_live(tmp_path):
+    """volume set on a LIVE brick: qos off->on starts shedding, a rate
+    raise stops — no reconnect, no restart (opts are read per
+    verdict)."""
+
+    async def run():
+        server = await serve_brick(_volfile(
+            tmp_path, qos="off", **{"qos-fops-per-sec": 3,
+                                    "qos-burst": 1}))
+        try:
+            a = RawClient()
+            await a.connect(server.port)
+            for _ in range(20):  # plane off: nothing sheds
+                p = await a.call("lookup", (Loc("/"),), {})
+                assert not isinstance(p, FopError)
+            # glusterd's reconfigure path always ships the FULL merged
+            # option set (volgen regenerates complete volfiles), so
+            # the test does too
+            server.top.reconfigure({"qos": "on", "qos-fops-per-sec": 3,
+                                    "qos-burst": 1})
+            sheds = 0
+            for _ in range(20):
+                p = await a.call("lookup", (Loc("/"),), {})
+                sheds += isinstance(p, FopError)
+            assert sheds > 0
+            server.top.reconfigure({"qos": "on",
+                                    "qos-fops-per-sec": "100000",
+                                    "qos-burst": 1})
+            # the transition frame may shed once (the bucket clock
+            # re-seeds at the retune); after that the raise holds
+            sheds = 0
+            for _ in range(20):
+                p = await a.call("lookup", (Loc("/"),), {})
+                sheds += isinstance(p, FopError)
+            assert sheds <= 1
+            a.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())

@@ -42,6 +42,15 @@ contract the EC/protocol planes promise:
                         that dies WITHOUT releasing is reaped at
                         disconnect instead of stalling the writer for
                         the recall grace.
+* ``qos_storm``       — a greedy flooder vs a polite reader on the
+                        same volume (ISSUE 17): with server.qos off
+                        the flood runs unshaped (baseline); a LIVE
+                        volume-set flip arms per-client token buckets
+                        and the greedy client's throughput drops
+                        measurably while the polite client's p99 stays
+                        bounded and error-free, THROTTLE_START lands
+                        in eventsd history, and the shaping shows in
+                        volume status clients.
 * ``rebalance_grow``  — grow the loaded 4+2 volume by a second
                         distribute leg WHILE serving: managed daemon
                         migration under live reads/writes, SIGKILL +
@@ -616,6 +625,127 @@ async def lease_storm(base: str, opts) -> dict:
             for r in readers:
                 await r.unmount()
             await w.unmount()
+    return out
+
+
+@scenario("qos_storm")
+async def qos_storm(base: str, opts) -> dict:
+    """Greedy flooder vs polite reader (ISSUE 17): the QoS plane,
+    armed by a LIVE volume-set, caps the greedy client per identity —
+    its throughput drops vs the unshaped baseline, the polite client
+    never errors and its p99 stays bounded, THROTTLE_START reaches
+    eventsd, and volume status clients shows the shaping."""
+    from glusterfs_tpu.core import events as gf_events
+    from glusterfs_tpu.mgmt.eventsd import EventsDaemon
+
+    out: dict = {}
+    ev = EventsDaemon()
+    udp, _ctl = await ev.start()
+    # BEFORE Stack: brick subprocesses inherit the env at spawn
+    os.environ["GFTPU_EVENTSD"] = f"127.0.0.1:{udp}"
+    gf_events.configure(f"127.0.0.1:{udp}")
+    try:
+        async with Stack(base) as st:
+            greedy = await st.mount()
+            polite = await st.mount()
+            try:
+                # WRITE load: client caches would serve a read flood
+                # at zero wire fops (the leased-reader exemption by
+                # construction) — writes always meet the admission gate
+                body = payload_for(17)[:4096]
+                retries = {"greedy": 0, "polite": 0}
+
+                async def phase(seconds: float) -> tuple[float, float]:
+                    """(greedy write_file/s, polite p99 seconds) under
+                    a sequential greedy flood + a paced polite writer.
+                    One bounded retry absorbs the live graph-reload
+                    window (the rebalance_grow discipline) — QoS sheds
+                    themselves are invisible here, client backoff
+                    re-sends them."""
+                    stop = asyncio.Event()
+                    done = {"n": 0}
+
+                    async def put(cl, path, who) -> None:
+                        try:
+                            await cl.write_file(path, body)
+                        except FopError:
+                            retries[who] += 1
+                            await cl.write_file(path, body)
+
+                    async def flood(i: int):
+                        # 4-way concurrency on distinct paths: greedy
+                        # means MORE OUTSTANDING WORK, not merely a
+                        # tighter loop — and no lock contention noise
+                        while not stop.is_set():
+                            await put(greedy, f"/g{i}", "greedy")
+                            done["n"] += 1
+
+                    ft = [asyncio.create_task(flood(i))
+                          for i in range(4)]
+                    lat: list[float] = []
+                    t0 = time.monotonic()
+                    while time.monotonic() - t0 < seconds:
+                        s = time.monotonic()
+                        await put(polite, "/p", "polite")
+                        lat.append(time.monotonic() - s)
+                        await asyncio.sleep(0.15)  # ~5/s: in budget
+                    stop.set()
+                    await asyncio.gather(*ft)
+                    lat.sort()
+                    return (done["n"] / seconds,
+                            lat[int(0.99 * (len(lat) - 1))])
+
+                g_off, p99_off = await phase(4.0)
+
+                # LIVE flip — no remount, no brick respawn: the watcher
+                # reconfigures the running server tops and the very
+                # next frames meet the buckets
+                await st.set("server.qos-fops-per-sec", "60")
+                await st.set("server.qos-burst", "1")
+                await st.set("server.qos", "on")
+                await asyncio.sleep(1.5)  # volfile watcher propagation
+
+                g_on, p99_on = await phase(6.0)
+                out["greedy_rps"] = {"off": round(g_off, 1),
+                                     "on": round(g_on, 1)}
+                out["polite_p99_s"] = {"off": round(p99_off, 3),
+                                       "on": round(p99_on, 3)}
+                assert g_on < g_off * 0.7, \
+                    f"flood not shaped: {g_off:.0f} -> {g_on:.0f}/s"
+                assert p99_on < 2.0, \
+                    f"polite p99 unbounded under flood: {p99_on:.2f}s"
+                assert retries["polite"] <= 2, \
+                    f"polite writer kept erroring: {retries}"
+                out["reload_retries"] = dict(retries)
+                assert greedy.graph and any(
+                    l.qos_backoff_total > 0 for l in walk(greedy.graph.top)
+                    if hasattr(l, "qos_backoff_total")), \
+                    "greedy client never paid a backoff"
+
+                # the shaping is visible in volume status clients
+                async with MgmtClient(st.d.host, st.d.port) as c:
+                    deep = await c.call("volume-status-deep",
+                                        name=st.name, what="clients")
+                rows = [r for b in deep["bricks"].values()
+                        for r in b.get("clients", [])]
+                shed = sum(r.get("qos", {}).get("shed_fops", 0)
+                           for r in rows)
+                assert shed > 0, "no brick reported qos sheds"
+                out["status_shed_fops"] = shed
+
+                # ...and in the event plane: transition-edge THROTTLE
+                starts = [e for e in ev.recent
+                          if e.get("event") == "THROTTLE_START"]
+                assert starts, "no THROTTLE_START reached eventsd"
+                assert all(e.get("reason") == "rate" for e in starts)
+                out["throttle_starts"] = len(starts)
+            finally:
+                await greedy.unmount()
+                await polite.unmount()
+    finally:
+        os.environ.pop("GFTPU_EVENTSD", None)
+        gf_events.configure(None)
+        await ev.stop()
     return out
 
 

@@ -61,6 +61,15 @@
 #                               shared-nothing workers, worker
 #                               SIGKILL respawns and keeps serving
 #                               (ISSUE 12)
+#  11. lease smoke              hot GETs off the lease-held gateway
+#                               object cache at zero wire fops,
+#                               recall-exact coherence, cache/lease
+#                               families, v15 keys (ISSUE 16)
+#  12. qos smoke                per-client admission shed at a tight
+#                               fops cap on both wire paths,
+#                               gftpu_qos_* family monotonicity, live
+#                               v16 volume-set flip, shaping column in
+#                               volume-status-deep (ISSUE 17)
 #
 # Usage:  tools/ci.sh [extra pytest args for the tier-1 runs...]
 # Exit: first failing stage's code; 0 = mergeable.
@@ -947,6 +956,125 @@ if [ $lease_rc -ne 0 ]; then
     exit $lease_rc
 fi
 
+echo "== ci: qos smoke (per-client admission shed at a tight fops cap,"
+echo "       gftpu_qos_* family monotonicity, live v16 volume-set flip,"
+echo "       shaping column in volume-status-deep) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, os, shutil, tempfile
+
+from glusterfs_tpu.core.fops import FopError
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.metrics import REGISTRY
+from glusterfs_tpu.daemon import serve_brick
+
+BRICK = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume srv
+    type protocol/server
+    option qos on
+    option qos-fops-per-sec 30
+    option qos-burst 1
+    subvolumes posix
+end-volume
+"""
+CLIENT = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume srv
+end-volume
+"""
+
+def sample(snap, fam, **labels):
+    return sum(v for l, v in snap.get(fam, {}).get("samples", [])
+               if all(l.get(k) == lv for k, lv in labels.items()))
+
+async def main():
+    from glusterfs_tpu.core.layer import Loc
+    base = tempfile.mkdtemp(prefix="qos-smoke")
+    # -- in-process brick: the registry families are reachable --------
+    server = await serve_brick(BRICK.format(dir=os.path.join(base, "b")))
+    try:
+        g = Graph.construct(CLIENT.format(port=server.port))
+        await g.activate()
+        for _ in range(200):
+            if g.top.connected:
+                break
+            await asyncio.sleep(0.01)
+        snap0 = REGISTRY.snapshot()
+        for _ in range(60):  # ~30 past the burst at 30 fops/s
+            await g.top.lookup(Loc("/"))
+        assert g.top.qos_backoff_total > 0, \
+            "client absorbed no sheds at a 30 fops/s cap"
+        eng = server._qos["srv"]
+        assert eng.stats["shed"] > 0, "brick engine counted no sheds"
+        snap1 = REGISTRY.snapshot()
+        t0 = sample(snap0, "gftpu_qos_throttled_fops_total")
+        t1 = sample(snap1, "gftpu_qos_throttled_fops_total")
+        assert t1 > t0, f"qos throttle family not monotonic ({t0}->{t1})"
+        assert "gftpu_qos_tokens" in snap1, "token gauge family missing"
+        rows = server._status_of(server.top, "clients")["clients"]
+        assert any(r.get("qos", {}).get("shed_fops", 0) > 0
+                   for r in rows), "no shaping column in client status"
+        await g.fini()
+    finally:
+        await server.stop()
+
+    # -- managed path: v16 volume-set keys + a LIVE flip ---------------
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+    d = Glusterd(os.path.join(base, "gd"))
+    await d.start()
+    try:
+        async with MgmtClient(d.host, d.port) as mc:
+            await mc.call("volume-create", name="qv",
+                          vtype="distribute",
+                          bricks=[{"path": os.path.join(base, "vb0")}])
+            await mc.call("volume-start", name="qv")
+        m = await mount_volume(d.host, d.port, "qv")
+        try:
+            await m.write_file("/warm", b"q" * 4096)  # pre-flip baseline
+            async with MgmtClient(d.host, d.port) as mc:
+                for key, val in (("server.qos-fops-per-sec", "20"),
+                                 ("server.qos-burst", "1"),
+                                 ("server.qos", "on")):
+                    r = await mc.call("volume-set", name="qv",
+                                      key=key, value=val)
+                    assert r.get("ok", True), (key, r)
+            await asyncio.sleep(1.5)  # volfile watcher propagation
+            for i in range(40):  # writes: reads are cache-served
+                try:
+                    await m.write_file(f"/f{i}", b"q" * 512)
+                except FopError:  # graph-reload blip, one retry
+                    await m.write_file(f"/f{i}", b"q" * 512)
+            async with MgmtClient(d.host, d.port) as mc:
+                deep = await mc.call("volume-status-deep", name="qv",
+                                     what="clients")
+            shed = sum(r.get("qos", {}).get("shed_fops", 0)
+                       for b in deep["bricks"].values()
+                       for r in b.get("clients", []))
+            assert shed > 0, "live flip shed nothing at 20 fops/s"
+        finally:
+            await m.unmount()
+    finally:
+        await d.stop()
+        shutil.rmtree(base, ignore_errors=True)
+    print("qos smoke: admission sheds on both paths, qos families "
+          "monotonic, v16 keys flip the plane live, shaping column "
+          "populated")
+
+asyncio.run(main())
+EOF
+qos_rc=$?
+if [ $qos_rc -ne 0 ]; then
+    echo "ci: qos smoke failed — not mergeable"
+    exit $qos_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
@@ -954,5 +1082,6 @@ fi
 echo "ci: mergeable (two identical green tier-1 runs + bench contract"
 echo "    + metrics smoke + gateway smoke + concurrency smoke"
 echo "    + mesh smoke + chaos smoke + delta-write smoke"
-echo "    + rebalance smoke + process-plane smoke + lease smoke)"
+echo "    + rebalance smoke + process-plane smoke + lease smoke"
+echo "    + qos smoke)"
 exit 0

@@ -1,4 +1,4 @@
-"""graft-lint declarative tables — the editing surface for op-version 15.
+"""graft-lint declarative tables — the editing surface for op-version 16.
 
 Adding a fop, option key, or capability should mean editing DATA here
 (plus the real code site), never checker logic.  Every exemption is a
