@@ -14,7 +14,10 @@ __version__ = "0.1.0"
 # minimum, gating newer volume-set keys until every member upgrades.
 # Lives here (not in mgmt/glusterd) so protocol/client can advertise it
 # at SETVOLUME without dragging the whole management plane into every
-# client process.  Version history: 16 multi-tenant QoS plane
+# client process.  Version history: 17 same-host shared-memory bulk
+# lane (memfd arena transport rpc/shm, the "shm" SETVOLUME capability,
+# network.shm-transport + network.shm-arena-size, volgen._V17_KEYS);
+# 16 multi-tenant QoS plane
 # (per-client token buckets + priority lanes at the brick's frame
 # admission, server.qos-* + client.qos-backoff, the gateway's --qos-*
 # spawner arm, volgen._V16_KEYS); 15 lease plane (brick-side lease
@@ -42,4 +45,4 @@ __version__ = "0.1.0"
 # diagnostics, _V7_KEYS); 6 zero-copy reads + strict-locks (_V6_KEYS);
 # 5 compound fops + auth.ssl-allow (_V5_KEYS); 4 round-5 keys
 # (_V4_KEYS); 3 the round-4 option long tail (_V3_KEYS).
-OP_VERSION = 16
+OP_VERSION = 17

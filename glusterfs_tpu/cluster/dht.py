@@ -1185,6 +1185,26 @@ class DistributeLayer(Layer):
         await src.unlink(cloc, dict(internal))
         return moved
 
+    @staticmethod
+    def _delta_stripe(dst) -> int:
+        """Stripe width of ``dst`` when a streamed migration copy can
+        ride its parity-delta write plane, else 0.  Mirrors the gates
+        of ec._delta_eligible that are knowable up front: a healthy
+        systematic disperse group with delta-writes on and no brick
+        having refused xorv.  Anything else (protocol/client, afr, a
+        degraded group) keeps today's byte-identical streaming."""
+        opts = getattr(dst, "opts", None)
+        if (getattr(dst, "type_name", "") != "cluster/disperse"
+                or not opts
+                or not opts.get("systematic")
+                or not opts.get("delta-writes")
+                or not getattr(dst, "_xorv_ok", False)):
+            return 0
+        up = getattr(dst, "up", None)
+        if not up or not all(up):
+            return 0
+        return int(getattr(dst, "stripe", 0))
+
     async def _migrate_copy(self, src, dst, cloc: Loc, tmp: Loc, ia,
                             window: int, internal: dict) -> int:
         """One copy attempt of ``cloc`` into the hidden temp on
@@ -1201,7 +1221,16 @@ class DistributeLayer(Layer):
         cached handle after the commit.  Destination is fsynced
         BEFORE the swap (the rebalance.ensure-durability contract): a
         crash right after the rename must not leave the only copy in
-        page cache.  A failed copy unlinks its partial temp."""
+        page cache.  A failed copy unlinks its partial temp.
+
+        On a delta-ready systematic disperse destination the streaming
+        path is stripe-aware (ROADMAP item 3, narrow form): the window
+        is rounded down to a stripe multiple so every full window is a
+        pure encode (no RMW read at all), and the temp is pre-sized
+        with ftruncate so the unaligned tail write lands strictly
+        inside the true size — exactly the shape `_delta_eligible`
+        routes onto the PR-10 parity-delta path instead of a full
+        read-modify-write of the final stripe."""
         from ..rpc import compound as cfop
 
         size = ia.size
@@ -1219,9 +1248,14 @@ class DistributeLayer(Layer):
                     chunks.append(b)
                     off += len(b)
             else:
+                stripe = self._delta_stripe(dst)
+                if stripe and window >= stripe:
+                    window = window // stripe * stripe
                 dfd, _ = await dst.create(
                     tmp, os.O_RDWR | os.O_EXCL, ia.mode & 0o7777,
                     {"gfid-req": ia.gfid})
+                if stripe:
+                    await dst.ftruncate(dfd, size)
                 off = 0
                 while off < size:
                     data = await src.readv(sfd, min(window, size - off),

@@ -62,7 +62,8 @@ _metrics.REGISTRY.register_objects(
     "gftpu_ec_delta_writes_total", "counter",
     "sub-stripe writes served by the parity-delta path (touched data "
     "slices + brick-side parity xorv; no k-fragment decode)",
-    lambda l: [({"layer": l.name}, l.write_path["delta"])],
+    lambda l: [({"layer": l.name, "origin": o}, v)
+               for o, v in l.delta_origin.items()],
     live=_LIVE_EC_LAYERS)
 _metrics.REGISTRY.register_objects(
     "gftpu_ec_rmw_writes_total", "counter",
@@ -337,6 +338,11 @@ class DisperseLayer(Layer):
         # parity-delta write plane (ISSUE 10): path taken per unaligned
         # write + fragment bytes the delta path saved over full RMW
         self.write_path = {"delta": 0, "rmw": 0}
+        # delta writes split by traffic_origin ("serve" vs "rebalance"
+        # vs "heal"): write_path["delta"] stays the total; this dict
+        # feeds the per-origin samples on the registry family so an
+        # operator can see migration I/O riding the delta plane
+        self.delta_origin = {"serve": 0}
         self.delta_saved = {"read": 0, "write": 0}
         # live-downgrade memory: a parity brick answering EOPNOTSUPP to
         # xorv parks the WHOLE layer on the RMW path (parity rows are
@@ -1694,6 +1700,8 @@ class DisperseLayer(Layer):
             rmw_read = max(
                 0, min(a_end, self._frag_len(st.size) * self.k) - a_off)
             self.write_path["delta"] += 1
+            o = self.traffic_origin
+            self.delta_origin[o] = self.delta_origin.get(o, 0) + 1
             self.delta_saved["read"] += max(0, rmw_read - read_bytes)
             self.delta_saved["write"] += max(
                 0, self.n * f_len

@@ -2949,20 +2949,37 @@ class Glusterd:
                 "--object-cache",
                 str(opts.get("gateway.object-cache-size", 0)),
                 "--portfile", portfile]
+        workers = int(opts.get("gateway.workers", 0) or 0)
         if volgen._bool(opts.get("server.qos", "off")):
             # HTTP clients inherit the volume's QoS plane: the same
             # server.qos-* rates the bricks enforce per wire identity,
             # applied per peer IP at the gateway door (429 +
             # Retry-After instead of EAGAIN + notice).  Spawn-time
             # plumbing: retuning these keys live re-spawns via gateway
-            # stop/start (documented in docs/qos.md)
+            # stop/start (documented in docs/qos.md).  The per-worker
+            # buckets are shared-nothing, so the spawn-time rates are
+            # DIVIDED across the pool — N workers must enforce the
+            # operator's ONE budget, not N of them (the PR-17 ceiling)
+            share = max(1, workers)
+
+            def _rate(key):
+                # 0 = unlimited stays unlimited at any pool width;
+                # bytes-per-sec is a size option ("1MB"), so parse it
+                # the way the gateway would before dividing
+                from ..core.options import parse_size
+                try:
+                    v = float(parse_size(opts.get(key, 0) or 0))
+                except Exception:
+                    v = 0.0
+                return v / share if v > 0 else 0
+
             argv += ["--qos-fops",
-                     str(opts.get("server.qos-fops-per-sec", 0)),
+                     str(_rate("server.qos-fops-per-sec")),
                      "--qos-bytes",
-                     str(opts.get("server.qos-bytes-per-sec", 0)),
+                     str(_rate("server.qos-bytes-per-sec")),
                      "--qos-burst",
-                     str(opts.get("server.qos-burst", 1))]
-        workers = int(opts.get("gateway.workers", 0) or 0)
+                     str(max(1, int(float(opts.get("server.qos-burst", 1)
+                                          or 1) // share)))]
         if workers > 0:
             # the shared-nothing worker pool (op-version 14): the
             # spawned process becomes the supervisor; worker pids land

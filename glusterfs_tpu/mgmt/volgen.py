@@ -50,6 +50,11 @@ OPTION_MAP = {
     # both transport ends — client requests at SETVOLUME, server
     # honors per-connection
     "network.zero-copy-reads": ("__sg__", "sg-replies"),
+    # same-host shared-memory bulk lane (rpc/shm, ISSUE 18): one key
+    # arms both transport ends — the client asks at SETVOLUME and the
+    # brick advertises + serves the memfd arena exchange
+    "network.shm-transport": ("__shm__", "shm-transport"),
+    "network.shm-arena-size": ("protocol/server", "shm-arena-size"),
     # end-to-end trace propagation (core/tracing.py): one key arms both
     # transport ends — the client ships the trailing trace-id frame
     # field, the server advertises + re-arms it for the brick graph
@@ -816,6 +821,17 @@ _V16_KEYS = (
 )
 OPTION_MIN_OPVERSION.update({k: 16 for k in _V16_KEYS})
 
+# round-18 additions ship at op-version 17: the same-host shared-memory
+# bulk lane — a v16 brick has no fd side-channel (the client key would
+# store and never arm), a v16 client can't decode FL_SHM records (the
+# brick must not advertise to it), and a v16 glusterd doesn't emit
+# the keys to either transport end
+_V17_KEYS = (
+    "network.shm-transport",
+    "network.shm-arena-size",
+)
+OPTION_MIN_OPVERSION.update({k: 17 for k in _V17_KEYS})
+
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key
 DEFAULT_PERF_STACK = [
@@ -994,6 +1010,7 @@ def build_brick_volfile(volinfo: dict, brick: dict) -> str:
     sopts.update(_compound_options(volinfo))
     sopts.update(_sg_options(volinfo))
     sopts.update(_trace_options(volinfo))
+    sopts.update(_shm_options(volinfo))
     # the QoS rebalance lane inherits the operator's ONE throttle word:
     # cluster.rebal-throttle already sizes the daemon's client-side
     # migration wave, and the same lazy/normal/aggressive mode sizes
@@ -1046,6 +1063,14 @@ def _trace_options(volinfo: dict) -> dict[str, Any]:
     return {} if val is None else {"trace-fops": val}
 
 
+def _shm_options(volinfo: dict) -> dict[str, Any]:
+    """network.shm-transport lands on both transport ends (the client
+    asks for the bulk lane at SETVOLUME, the brick advertises + hands
+    out arena fds)."""
+    val = volinfo.get("options", {}).get("network.shm-transport")
+    return {} if val is None else {"shm-transport": val}
+
+
 def build_client_volfile(volinfo: dict,
                          ports: dict[str, int] | None = None,
                          mgmt: str | None = None) -> str:
@@ -1072,6 +1097,7 @@ def build_client_volfile(volinfo: dict,
         opts.update(_compound_options(volinfo))
         opts.update(_sg_options(volinfo))
         opts.update(_trace_options(volinfo))
+        opts.update(_shm_options(volinfo))
         # a TLS brick implies TLS clients (admins set server.ssl once)
         if _enabled(volinfo, "server.ssl", False):
             opts["ssl"] = "on"

@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import errno
 import itertools
+import os
 from typing import Any
 
 from ..core.events import gf_event
@@ -35,6 +36,7 @@ from ..core.layer import Event, FdObj, Layer, Loc, register
 from ..core.options import Option
 from ..core import gflog, tracing
 from ..core import metrics as _metrics
+from ..rpc import shm as _shm
 from ..rpc import wire
 from ..rpc import event_pool as _evt
 
@@ -137,6 +139,17 @@ class ClientLayer(Layer):
                            "segment views — no join copy on either "
                            "end.  Off = the brick joins before "
                            "framing (pre-sg wire behavior)"),
+        Option("shm-transport", "bool", default="on",
+               description="arm the same-host shared-memory bulk lane "
+                           "at SETVOLUME when the brick advertises it "
+                           "(network.shm-transport client half, "
+                           "rpc/shm): request payloads (writev/xorv/"
+                           "compound blobs) are written once into a "
+                           "memfd arena shared with the brick and only "
+                           "descriptors ride the socket; reply blobs "
+                           "arrive as views into the peer's arena.  "
+                           "Read per-call: off live-downgrades to "
+                           "inline frames without a reconnect"),
         Option("trace-fops", "bool", default="on",
                description="ship the current trace id as a trailing "
                            "wire-frame field so brick-side spans join "
@@ -281,6 +294,15 @@ class ClientLayer(Layer):
         # did the brick advertise lease grants (op-version 15)?  The
         # api layer checks this before letting caches go zero-RT
         self._peer_leases = False
+        # same-host shared-memory bulk lane (rpc/shm, op-version 17):
+        # armed at SETVOLUME via the brick's fd side-channel.  _peer_shm
+        # flips only after BOTH arenas mapped and the brick confirmed
+        # (__shm_ok__); _shm_refused remembers a brick-side EOPNOTSUPP
+        # downgrade (like the xorv memory — zero wasted frames after)
+        self._peer_shm = False
+        self._shm_tx = None
+        self._shm_rx = None
+        self._shm_refused = False
         _LIVE_CLIENT_LAYERS.add(self)
         # reopen bookkeeping (client-handshake.c reopen_fd_count):
         # live fds with server-side handles (value = (fd, reopen fop)),
@@ -373,6 +395,12 @@ class ClientLayer(Layer):
             # sg only pays off on the blob lane; compressed frames
             # inline everything anyway
             creds["sg-replies"] = True
+        if self.opts["shm-transport"] and not self.opts["compression"] \
+                and not self._shm_refused and _shm.supported():
+            # ask for the shared-memory bulk lane (same
+            # compression carve-out as sg: inlined frames carry no
+            # blobs for the arena to hold)
+            creds["shm-transport"] = True
         try:
             res = await self._call("__handshake__",
                                    (self.identity,
@@ -408,6 +436,18 @@ class ClientLayer(Layer):
         # zero-RT cache mode — TTL revalidation stays the coherence
         # story there
         self._peer_leases = bool(res.get("leases"))
+        # shm bulk lane: the advert carries boot-id + side-channel
+        # address + one-shot token.  Arming failure of ANY kind is the
+        # boring fallback — this connection simply stays inline
+        ad = res.get("shm")
+        if ad and creds.get("shm-transport"):
+            try:
+                await self._shm_arm(ad)
+            except Exception as e:  # noqa: BLE001 - fallback is total
+                log.warning(8, "%s: shm lane arming failed: %r",
+                            self.name, e)
+                _shm.count_fallback("sidechannel")
+                self._shm_teardown()
         # re-open tracked fds and re-acquire held locks BEFORE CHILD_UP
         # (client_child_up_reopen_done): parents must never see an "up"
         # child whose fd handles are stale
@@ -478,9 +518,67 @@ class ClientLayer(Layer):
                             self.name, fop, e)
                 self._held_locks.pop(key, None)
 
+    async def _shm_arm(self, ad: dict) -> None:
+        """Arm the shared-memory bulk lane from a SETVOLUME advert:
+        boot-id screen, side-channel fd exchange (the real same-host
+        proof — the fds either map or they don't), then __shm_ok__ so
+        the brick knows replies may ride its s2c arena.  The rx arena
+        is armed BEFORE __shm_ok__ goes out: no FL_SHM reply can beat
+        our ability to resolve it."""
+        if str(ad.get("boot-id", "")) != _shm.boot_id():
+            # different machine: the side-channel cannot exist here —
+            # don't even dial (cheap screen; lane never arms)
+            _shm.count_fallback("cross-host")
+            return
+        addr = str(ad.get("addr") or "")
+        token = str(ad.get("token") or "")
+        if not addr or not token:
+            _shm.count_fallback("sidechannel")
+            return
+        # blocking AF_UNIX dial + SCM_RIGHTS receive, off the loop
+        fds = await asyncio.to_thread(_shm.fetch_fds, addr, token)
+        try:
+            self._shm_tx = _shm.ShmTx.attach(fds[0])   # c2s: we write
+            self._shm_rx = _shm.ShmRx.attach(fds[1])   # s2c: we read
+        finally:
+            for fd in fds:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        res = await self._call("__shm_ok__", (), {})
+        if not (isinstance(res, dict) and res.get("ok")):
+            raise FopError(errno.EPROTO, "shm confirm refused")
+        self._peer_shm = True
+        log.info(8, "%s: shm bulk lane armed", self.name)
+
+    def _shm_teardown(self) -> None:
+        """Drop both arenas (close defers under live consumer views);
+        the lane re-arms on the next successful handshake unless
+        refused."""
+        self._peer_shm = False
+        for arena in (self._shm_tx, self._shm_rx):
+            if arena is not None:
+                try:
+                    arena.close()
+                except Exception:
+                    pass
+        self._shm_tx = None
+        self._shm_rx = None
+
+    def _shm_disarm(self, reason: str) -> None:
+        """Peer-driven downgrade (EOPNOTSUPP + shm-unsupported xdata):
+        remembered like the xorv capability — this layer never offers
+        shm again, so zero further frames are wasted on it."""
+        self._shm_refused = True
+        _shm.count_fallback(reason)
+        self._shm_teardown()
+        log.warning(8, "%s: shm lane disarmed (%s)", self.name, reason)
+
     async def _drop_connection(self, notify: bool = True) -> None:
         was = self.connected
         self.connected = False
+        self._shm_teardown()
         if self._writer is not None:
             try:
                 self._writer.close()
@@ -518,9 +616,9 @@ class ClientLayer(Layer):
                     if n > 0 and len(rec) >= _evt.TURN_MIN else None
                 if pool is not None and pool.size > 0:
                     xid, mtype, payload = await pool.turn(
-                        self, wire.unpack, rec)
+                        self, wire.unpack, rec, self._shm_rx)
                 else:
-                    xid, mtype, payload = wire.unpack(rec)
+                    xid, mtype, payload = wire.unpack(rec, self._shm_rx)
                 if mtype == wire.MT_EVENT:
                     # server-pushed upcall (cache invalidation etc.):
                     # surface as a graph notification for md-cache & co
@@ -693,6 +791,7 @@ class ClientLayer(Layer):
         xid = next(self._xid)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[xid] = fut
+        lane = None
         try:
             body = [fop, list(args), kwargs or {}]
             if self._peer_trace and tracing.ENABLED and \
@@ -715,8 +814,16 @@ class ClientLayer(Layer):
             else:
                 # payload blobs ride out-of-band and writelines hands
                 # the ORIGINAL buffers to the transport — a writev
-                # payload is never copied on this side (iobref submit)
-                frames = wire.pack_frames(xid, wire.MT_CALL, body)
+                # payload is never copied on this side (iobref submit).
+                # With the shm lane armed (and the option still on —
+                # read per-call, so a live volume-set downgrades
+                # instantly), blobs land in the shared arena and only
+                # descriptors cross the socket
+                if self._peer_shm and self._shm_tx is not None \
+                        and not self._shm_tx.dead \
+                        and self.opts["shm-transport"]:
+                    lane = self._shm_tx
+                frames = wire.pack_frames(xid, wire.MT_CALL, body, lane)
                 self.bytes_tx += sum(len(f) for f in frames)
                 writer.writelines(frames)
             await writer.drain()
@@ -726,6 +833,17 @@ class ClientLayer(Layer):
             raise FopError(errno.ENOTCONN, "send failed") from None
         try:
             return await asyncio.wait_for(fut, timeout)
+        except FopError as e:
+            if lane is not None \
+                    and isinstance(getattr(e, "xdata", None), dict) \
+                    and e.xdata.get("shm-unsupported"):
+                # the brick can't serve our shm frames (live downgrade,
+                # restarted peer, lost mapping): remember the refusal
+                # like the xorv capability and resend THIS call inline
+                # — the caller never sees the downgrade
+                self._shm_disarm("downgrade")
+                return await self._call(fop, args, kwargs)
+            raise
         except asyncio.TimeoutError:
             self._pending.pop(xid, None)
             if data_fop and fop not in self._LOCK_FOPS and \
@@ -1129,7 +1247,13 @@ class ClientLayer(Layer):
                 "bytes_tx": self.bytes_tx,
                 "bytes_rx": self.bytes_rx,
                 "connects": self.connects,
-                "rpc_roundtrips": self.rpc_roundtrips}
+                "rpc_roundtrips": self.rpc_roundtrips,
+                "shm": {"armed": self._peer_shm,
+                        "refused": self._shm_refused,
+                        "tx_used": (self._shm_tx.used()
+                                    if self._shm_tx is not None else 0),
+                        "rx_held": (self._shm_rx.used()
+                                    if self._shm_rx is not None else 0)}}
 
 
 def _make_wire_fop(op_name: str):

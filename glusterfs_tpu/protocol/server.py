@@ -34,6 +34,7 @@ import asyncio
 import errno
 import fnmatch
 import hmac
+import os
 import socket
 import ssl as ssl_mod
 import time
@@ -45,6 +46,7 @@ from ..core.layer import FdObj, Layer, register
 from ..core.options import Option
 from ..core import gflog, tracing
 from ..core import metrics as _metrics
+from ..rpc import shm as _shm
 from ..rpc import wire
 from ..rpc.event_pool import TURN_MIN, EventPool
 
@@ -131,6 +133,22 @@ class ServerLayer(Layer):
                            "clients that advertised sg at SETVOLUME "
                            "(network.zero-copy-reads server half); "
                            "off = replies are joined to single blobs"),
+        Option("shm-transport", "bool", default="on",
+               description="advertise the same-host shared-memory bulk "
+                           "lane at SETVOLUME (network.shm-transport "
+                           "server half; the RDMA-transport analog, "
+                           "rpc/shm): blob payloads to/from colocated "
+                           "clients ride memfd arenas exchanged over "
+                           "an AF_UNIX side-channel, descriptors ride "
+                           "the socket.  Read per-frame: off "
+                           "live-downgrades every reply to inline "
+                           "blobs without a reconnect"),
+        Option("shm-arena-size", "size", default="16MB", min=65536,
+               description="per-direction shared-memory arena size for "
+                           "the shm bulk lane (network.shm-arena-size). "
+                           "A frame whose blobs don't fit the free ring "
+                           "ships inline — sizing is throughput tuning, "
+                           "never correctness"),
         Option("listen-backlog", "int", default=1024, min=0,
                description="accept-queue depth for the brick listener "
                            "(transport.listen-backlog; socket.c default "
@@ -314,6 +332,14 @@ class _ClientConn:
         # replace the old _serve-closure locals)
         self.inflight = 0
         self.exempt_inflight = 0
+        # same-host shared-memory bulk lane (rpc/shm): armed per
+        # direction by the SETVOLUME side-channel.  shm_tx stays None
+        # until the client confirms its rx mapping (__shm_ok__) — an
+        # FL_SHM reply must never race the peer's arming
+        self.shm_rx = None
+        self.shm_tx = None
+        self.shm_tx_armed = False
+        self.shm_token = ""
 
     def info(self) -> dict:
         """One ``volume status clients`` row (client_t dump shape)."""
@@ -332,6 +358,8 @@ class _ClientConn:
                 "opened_fds": len(self.fds),
                 "inflight": self.inflight + self.exempt_inflight,
                 "origin": self.origin,
+                "shm": ("armed" if self.shm_tx_armed
+                        else "rx" if self.shm_rx is not None else "off"),
                 "mgmt": self.is_mgmt}
 
     def register_fd(self, fd: FdObj) -> wire.FdHandle:
@@ -427,6 +455,13 @@ class BrickServer:
         # created lazily on the first option-carrying connection so
         # bare-Layer test servers never pay for the plane
         self._qos: dict[str, Any] = {}
+        # shm side-channel (rpc/shm): abstract AF_UNIX listener that
+        # hands arena memfds to token-bearing clients via SCM_RIGHTS;
+        # tokens are one-shot and bind the dial to a SETVOLUME'd
+        # transport
+        self._shm_srv: asyncio.AbstractServer | None = None
+        self._shm_addr = ""
+        self._shm_tokens: dict[str, _ClientConn] = {}
         _LIVE_SERVERS.add(self)
 
     # -- QoS admission (features/qos; server.qos-* options) ----------------
@@ -512,6 +547,25 @@ class BrickServer:
                   "client": c.identity.hex()[:8]},
                  sum(c.fop_counts.values()))
                 for c in self._metric_conns()]
+
+    def _shm_advert(self, conn: _ClientConn, creds: dict,
+                    top: Layer):
+        """SETVOLUME shm advert (rpc/shm): only to peers that asked,
+        never under frame compression (inlined frames carry no blobs),
+        and only when the lane can actually arm here — option on,
+        side-channel listening, platform support.  The returned token
+        is one-shot and pairs the side-channel dial with THIS
+        transport."""
+        if not creds.get("shm-transport") or creds.get("compress"):
+            return None
+        if not self._shm_on(top) or self._shm_srv is None \
+                or not _shm.supported():
+            return None
+        token = os.urandom(16).hex()
+        conn.shm_token = token
+        self._shm_tokens[token] = conn
+        return {"boot-id": _shm.boot_id(), "addr": self._shm_addr,
+                "token": token}
 
     def _select_top(self, name: str) -> tuple[Layer, Any]:
         """SETVOLUME routing: the requested remote-subvolume picks the
@@ -612,6 +666,22 @@ class BrickServer:
             return True  # bare graphs (tests): capability always on
         return bool(opts.get("sg-replies", True))
 
+    def _shm_on(self, top: Layer | None = None) -> bool:
+        """Serve the shared-memory bulk lane?  Read per-frame so a
+        live volume-set of network.shm-transport downgrades every
+        reply to inline blobs immediately, no reconnect."""
+        opts = self._opts_of(top if top is not None else self.top)
+        if not opts:
+            return True  # bare graphs (tests): capability always on
+        return bool(opts.get("shm-transport", True))
+
+    def _shm_arena_size(self, top: Layer | None = None) -> int:
+        opts = self._opts_of(top if top is not None else self.top)
+        try:
+            return int(opts.get("shm-arena-size", _shm.DEFAULT_ARENA))
+        except (TypeError, ValueError):
+            return _shm.DEFAULT_ARENA
+
     def _trace_on(self, top: Layer | None = None) -> bool:
         """Re-arm client trace ids?  Read per-use so a live volume-set
         of diagnostics.trace-propagation applies immediately."""
@@ -697,6 +767,21 @@ class BrickServer:
             self._serve, self.host, self.port, ssl=self._ssl_context(),
             backlog=backlog, family=family)
         self.port = self._server.sockets[0].getsockname()[1]
+        # shm bulk-lane side-channel (rpc/shm): an abstract-namespace
+        # AF_UNIX listener (no filesystem residue, dies with the
+        # process) where same-host clients trade their SETVOLUME token
+        # for the two arena memfds.  Failure to bind is not an error —
+        # the lane simply never advertises and every peer stays inline
+        if _shm.supported():
+            try:
+                name = f"\0gftpu-shm-{os.getpid()}-{id(self):x}"
+                self._shm_srv = await asyncio.start_unix_server(
+                    self._shm_serve, path=name)
+                self._shm_addr = "@" + name[1:]
+            except Exception as e:  # noqa: BLE001 - lane is optional
+                log.warning(9, "shm side-channel unavailable: %r", e)
+                self._shm_srv = None
+                self._shm_addr = ""
         # hand the event-push callback to any upcall layer in the graph
         # (the reference's upcall xlator calls back through rpcsvc the
         # same way)
@@ -707,6 +792,52 @@ class BrickServer:
         log.info(1, "brick %s serving on %s:%d", self.top.name, self.host,
                  self.port)
         return self.port
+
+    async def _shm_serve(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        """Side-channel dial: one-shot token -> two arena memfds via
+        SCM_RIGHTS ([c2s, s2c]; the same-host proof is that the fds
+        map at all).  The c2s rx arena is armed BEFORE the fds leave
+        this process, so the client's first FL_SHM call frame always
+        finds a reader; the s2c tx arena stays payload-disarmed until
+        the client confirms its own mapping (__shm_ok__)."""
+        fd_c2s = fd_s2c = -1
+        try:
+            line = await asyncio.wait_for(reader.readline(), 5.0)
+            token = line.decode(errors="replace").strip()
+            conn = self._shm_tokens.pop(token, None) if token else None
+            if conn is None or conn.shm_rx is not None:
+                return
+            top = conn.top if conn.top is not None else self.top
+            size = max(_shm.HDR_SIZE + 4096, self._shm_arena_size(top))
+            rx, fd_c2s = _shm.ShmRx.create(size)
+            conn.shm_rx = rx
+            tx, fd_s2c = _shm.ShmTx.create(size)
+            conn.shm_tx = tx
+            # sendmsg on a dup'd raw socket: the asyncio TransportSocket
+            # wrapper deprecates direct sendmsg, and the transport must
+            # keep owning its fd
+            sock = writer.get_extra_info("socket")
+            raw = socket.socket(fileno=os.dup(sock.fileno()))
+            try:
+                socket.send_fds(raw, [b"ok"], [fd_c2s, fd_s2c])
+            finally:
+                raw.close()
+            log.info(8, "shm lane mapped for client %s (%d bytes/dir)",
+                     conn.identity.hex()[:8], size)
+        except Exception as e:  # noqa: BLE001 - peer falls back inline
+            log.warning(9, "shm fd exchange failed: %r", e)
+        finally:
+            for fd in (fd_c2s, fd_s2c):
+                if fd >= 0:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+            try:
+                writer.close()
+            except Exception:
+                pass
 
     def push_event(self, targets: list[bytes], payload: dict) -> None:
         """Send an MT_EVENT frame to each connected client in targets
@@ -751,6 +882,11 @@ class BrickServer:
             # reaching send() after stop() must not construct a fresh
             # pool (leaked threads); turn() on a closed pool is inline
             self._pool.shutdown()
+        if self._shm_srv is not None:
+            self._shm_srv.close()
+            self._shm_srv = None
+            self._shm_addr = ""
+        self._shm_tokens.clear()
         if self._server is not None:
             self._server.close()
             # close live connections too: since py3.12 wait_closed() also
@@ -854,12 +990,19 @@ class BrickServer:
             else:
                 # blob replies (readv data) go out as raw trailing
                 # buffers — no payload copy between the fop return
-                # and the socket
+                # and the socket.  With the shm lane armed (and the
+                # option still on — read per-frame so a live
+                # volume-set downgrades instantly), blob bytes ride
+                # the shared arena and only descriptors hit the wire
+                lane = conn.shm_tx \
+                    if (conn.shm_tx_armed and not conn.shm_tx.dead
+                        and self._shm_on(conn.top if conn.top is not None
+                                         else self.top)) else None
                 if turn:
                     frames = await pool.turn(conn, wire.pack_frames,
-                                             xid, resp_type, resp)
+                                             xid, resp_type, resp, lane)
                 else:
-                    frames = wire.pack_frames(xid, resp_type, resp)
+                    frames = wire.pack_frames(xid, resp_type, resp, lane)
             nbytes = sum(len(f) for f in frames)
             if conn.authed and not conn.is_mgmt and self._qos:
                 # reply-byte debit (features/qos): a greedy reader's
@@ -926,16 +1069,38 @@ class BrickServer:
                 # where several of this connection's replies can be
                 # in flight at once.
                 pool = self.event_pool()
-                if len(rec) >= TURN_MIN and pool.size > 0:
+                try:
+                    if len(rec) >= TURN_MIN and pool.size > 0:
+                        try:
+                            xid, mtype, payload = await pool.turn(
+                                conn, wire.unpack, rec, conn.shm_rx)
+                        except (asyncio.CancelledError,
+                                wire.ShmDecodeError):
+                            raise
+                        except Exception:
+                            # undecodable frame: drop the transport
+                            break
+                    else:
+                        xid, mtype, payload = wire.unpack(rec,
+                                                          conn.shm_rx)
+                except wire.ShmDecodeError as e:
+                    # an FL_SHM frame this end can't serve (lane not
+                    # armed / arena gone / malformed table): ANSWER it
+                    # — EOPNOTSUPP + the shm-unsupported notice makes
+                    # the peer disarm and resend inline, instead of
+                    # its call hanging out the deadline.  Disarm OUR
+                    # half too: the peer tears its arenas down on the
+                    # notice, so any further FL_SHM reply from here
+                    # would be undecodable over there
+                    log.warning(9, "shm frame refused: %s", e)
+                    conn.shm_tx_armed = False
                     try:
-                        xid, mtype, payload = await pool.turn(
-                            conn, wire.unpack, rec)
-                    except asyncio.CancelledError:
-                        raise
-                    except Exception:
-                        break  # undecodable frame: drop the transport
-                else:
-                    xid, mtype, payload = wire.unpack(rec)
+                        await send(wire.peek_xid(rec), wire.MT_ERROR,
+                                   FopError(errno.EOPNOTSUPP, str(e),
+                                            {"shm-unsupported": True}))
+                    except ConnectionError:
+                        break
+                    continue
                 if mtype != wire.MT_CALL:
                     continue
                 if conn.authed and isinstance(payload, list) and payload \
@@ -1042,6 +1207,21 @@ class BrickServer:
                      brick=top.name, server=self.top.name,
                      bytes_rx=conn.bytes_rx, bytes_tx=conn.bytes_tx,
                      fops=sum(conn.fop_counts.values()))
+        # shm lane teardown: drop both arenas (rx close defers while
+        # consumer views are alive — the last GC'd view completes it;
+        # a dead CLIENT's mappings die with its process, so nothing
+        # here can leak across a peer SIGKILL either way)
+        if conn.shm_token:
+            self._shm_tokens.pop(conn.shm_token, None)
+            conn.shm_token = ""
+        conn.shm_tx_armed = False
+        for arena in (conn.shm_tx, conn.shm_rx):
+            if arena is not None:
+                try:
+                    arena.close()
+                except Exception:
+                    pass
+        conn.shm_tx = conn.shm_rx = None
         for fd in conn.fds.values():
             rel = getattr(top, "release", None)
             if rel is not None:
@@ -1247,7 +1427,8 @@ class BrickServer:
                 # is not client lifetime
                 conn.connected_at = time.time()
                 conn.caps = {k: True for k in
-                             ("compress", "sg-replies", "trace-fops")
+                             ("compress", "sg-replies", "trace-fops",
+                              "shm-transport")
                              if (creds or {}).get(k)}
                 try:
                     conn.opversion = int((creds or {}).get(
@@ -1260,27 +1441,30 @@ class BrickServer:
                              brick=top.name, server=self.top.name,
                              addr=conn.peer_addr, subvol=want,
                              op_version=conn.opversion)
-                return wire.MT_REPLY, {"volume": top.name, "ok": True,
-                                       "compound":
-                                           self._compound_on(top),
-                                       "sg": conn.sg,
-                                       "trace": self._trace_on(top),
-                                       # deadline-budget arming: this
-                                       # build pops the reserved
-                                       # request field before dispatch
-                                       "deadline": True,
-                                       # parity-delta write plane
-                                       # (op-version 12): this brick
-                                       # serves the xorv fop — a peer
-                                       # that never sees this key
-                                       # keeps the full-RMW path
-                                       "xorv": True,
-                                       # lease plane (op-version 15):
-                                       # this brick grants and recalls
-                                       # leases — a client that never
-                                       # sees this key must not enter
-                                       # zero-RT cache mode
-                                       "leases": True}
+                return wire.MT_REPLY, {
+                    "volume": top.name, "ok": True,
+                    "compound": self._compound_on(top),
+                    "sg": conn.sg,
+                    "trace": self._trace_on(top),
+                    # deadline-budget arming: this build pops the
+                    # reserved request field before dispatch
+                    "deadline": True,
+                    # parity-delta write plane (op-version 12):
+                    # this brick serves the xorv fop — a peer
+                    # that never sees this key keeps the
+                    # full-RMW path
+                    "xorv": True,
+                    # lease plane (op-version 15): this brick
+                    # grants and recalls leases — a client that
+                    # never sees this key must not enter zero-RT
+                    # cache mode
+                    "leases": True,
+                    # same-host shared-memory bulk lane (op-version
+                    # 17): a dict advert (boot-id + side-channel addr
+                    # + one-shot token) for peers that asked, when the
+                    # side-channel can hand out arena fds here — None
+                    # otherwise (falsy = no lane, old clients ignore)
+                    "shm": self._shm_advert(conn, creds or {}, top)}
             if not conn.authed:
                 # SETVOLUME gates everything — pings included (no
                 # pre-auth liveness probing; server.c refuses requests
@@ -1295,6 +1479,15 @@ class BrickServer:
                 tracing.arm(str(trace_id))
             if fop_name == "__ping__":
                 return wire.MT_REPLY, "pong"
+            if fop_name == "__shm_ok__":
+                # the client mapped both arenas and armed its rx side:
+                # replies may now ride the s2c arena.  Arming strictly
+                # follows the peer's readiness — no FL_SHM frame is
+                # ever sent to an end that can't resolve it
+                if conn.shm_tx is not None:
+                    conn.shm_tx_armed = True
+                conn.caps["shm"] = True
+                return wire.MT_REPLY, {"ok": conn.shm_tx is not None}
             if fop_name == "__attach__":
                 # brick-mux ATTACH (glusterfsd-mgmt.c:913): only the
                 # ANCHOR graph's mgmt pair authorizes it — a volume's

@@ -69,6 +69,9 @@ _HDR = struct.Struct(">IBBxx")
 
 # record flags (byte 5 of the header; 0 in pre-blob frames)
 FL_BLOBS = 1
+# blob payloads ride a shared-memory arena (rpc/shm): the record
+# carries a (seq, offset, length) descriptor table instead of bytes
+FL_SHM = 2
 
 # value tags
 _T_NONE, _T_TRUE, _T_FALSE = 0, 1, 2
@@ -116,6 +119,14 @@ class Blob:
 
 class WireError(Exception):
     pass
+
+
+class ShmDecodeError(WireError):
+    """An FL_SHM record that cannot be served from a local arena (lane
+    not armed, malformed descriptor, mapping gone).  Transports answer
+    this with EOPNOTSUPP + an ``shm-unsupported`` xdata notice so the
+    peer downgrades to inline frames, instead of dropping the
+    connection over a recoverable capability mismatch."""
 
 
 #: wire spelling of a scatter-gather payload: a one-key dict whose value
@@ -345,6 +356,15 @@ def decode_value(buf: memoryview, pos: int,
         if blobs is None:
             raise WireError("blob reference outside a FL_BLOBS record")
         region, off = blobs
+        if isinstance(region, list):
+            # FL_SHM record: refs resolve by INDEX into the arena views
+            # the descriptor table named (``off`` counts refs here).
+            # Lengths must agree — a mismatch means the table and the
+            # body disagree about the frame's shape
+            if off >= len(region) or len(region[off]) != n:
+                raise ShmDecodeError("shm descriptor/blobref mismatch")
+            blobs[1] = off + 1
+            return region[off], pos
         if off + n > len(region):
             raise WireError("blob reference beyond record")
         blobs[1] = off + n
@@ -438,18 +458,33 @@ def pack(xid: int, mtype: int, payload: Any) -> bytes:
     return struct.pack(">I", len(rec)) + rec
 
 
-def pack_frames(xid: int, mtype: int, payload: Any) -> list:
+def pack_frames(xid: int, mtype: int, payload: Any,
+                shm_tx=None) -> list:
     """Frame a record with payload blobs out-of-band.
 
     Returns a list of buffers for ``StreamWriter.writelines``: one
     prefix (length, header, body-length, body) followed by the blob
     buffers THEMSELVES — file data crosses into the transport without
-    ever being copied into the frame."""
+    ever being copied into the frame.
+
+    With an armed ``shm_tx`` arena (rpc/shm), the blobs are written
+    once into shared memory instead and the record carries only their
+    descriptor table (FL_SHM) — zero payload bytes on the socket.  An
+    arena that can't hold this frame right now returns the frame to
+    the FL_BLOBS path: fallback is per-frame, never a mode switch."""
     blobs: list = []
     body = _encode_body(payload, blobs)
     if not blobs:
         rec = _HDR.pack(xid, mtype, 0) + body
         return [struct.pack(">I", len(rec)) + rec]
+    if shm_tx is not None:
+        descs = shm_tx.put_blobs(blobs)
+        if descs is not None:
+            table = b"".join(descs)
+            rec_len = _HDR.size + 4 + len(body) + len(table)
+            return [struct.pack(">I", rec_len)
+                    + _HDR.pack(xid, mtype, FL_SHM)
+                    + struct.pack(">I", len(body)) + body + table]
     blob_len = sum(len(b) for b in blobs)
     rec_len = _HDR.size + 4 + len(body) + blob_len
     prefix = (struct.pack(">I", rec_len)
@@ -465,7 +500,15 @@ def pack_frames(xid: int, mtype: int, payload: Any) -> list:
 _MAX_INFLATED = 256 << 20
 
 
-def unpack(rec: bytes) -> tuple[int, int, Any]:
+def peek_xid(rec: bytes) -> int:
+    """The xid of a framed record, without decoding it — how a
+    transport answers a frame whose BODY failed to decode (an FL_SHM
+    record on an unarmed lane must still be ANSWERED, or the peer's
+    call hangs out its whole deadline)."""
+    return _HDR.unpack_from(rec, 0)[0]
+
+
+def unpack(rec: bytes, shm_rx=None) -> tuple[int, int, Any]:
     xid, mtype, flags = _HDR.unpack_from(rec, 0)
     if mtype == MT_ZLIB:
         import zlib
@@ -479,6 +522,22 @@ def unpack(rec: bytes) -> tuple[int, int, Any]:
             raise WireError("nested compression refused")
         return unpack(inner[4:])  # strip the inner length prefix
     mv = memoryview(rec)
+    if flags & FL_SHM:
+        # shared-memory record: the frame carries body + descriptor
+        # table only; payload bytes live in the peer-shared arena
+        if shm_rx is None:
+            raise ShmDecodeError("shm record without an armed lane")
+        (body_len,) = struct.unpack_from(">I", rec, _HDR.size)
+        start = _HDR.size + 4
+        if start + body_len > len(rec):
+            raise WireError("shm record body overruns frame")
+        views = shm_rx.views_for(mv[start + body_len:])
+        # a list region routes _T_BLOBREF decoding by index — and
+        # keeps the decode on the pure-Python codec (the C codec only
+        # understands contiguous FL_BLOBS regions)
+        payload, _ = decode_value(mv[:start + body_len], start,
+                                  [views, 0])
+        return xid, mtype, payload
     if flags & FL_BLOBS:
         (body_len,) = struct.unpack_from(">I", rec, _HDR.size)
         start = _HDR.size + 4

@@ -51,14 +51,15 @@ volume c0
     option remote-subvolume srv
     option event-threads {cevt}
     option compound-fops on
-end-volume
+{extra}end-volume
 """
 
 
-async def _connected(tmp_path, evt_threads=4, cevt=2):
+async def _connected(tmp_path, evt_threads=4, cevt=2, extra=""):
     server = await serve_brick(
         BRICK.format(dir=tmp_path / "b", evt=evt_threads))
-    g = Graph.construct(CLIENT.format(port=server.port, cevt=cevt))
+    g = Graph.construct(CLIENT.format(port=server.port, cevt=cevt,
+                                      extra=extra))
     c = Client(g)
     await c.mount()
     for _ in range(200):
@@ -220,7 +221,8 @@ def test_64_interleaved_clients_byte_identical(tmp_path):
             BRICK.format(dir=tmp_path / "b", evt=4))
         clients = []
         for i in range(64):
-            g = Graph.construct(CLIENT.format(port=server.port, cevt=2))
+            g = Graph.construct(CLIENT.format(port=server.port, cevt=2,
+                                              extra=""))
             c = Client(g)
             await c.mount()
             clients.append((c, g))
@@ -309,7 +311,8 @@ def test_compound_one_outstanding_slot_under_concurrency(tmp_path):
 
     async def run():
         server, c1, cl1 = await _connected(tmp_path, evt_threads=4)
-        g2 = Graph.construct(CLIENT.format(port=server.port, cevt=2))
+        g2 = Graph.construct(CLIENT.format(port=server.port, cevt=2,
+                                          extra=""))
         c2 = Client(g2)
         await c2.mount()
         for _ in range(200):
@@ -410,8 +413,14 @@ def test_client_event_threads_reconfigure_resizes_shared_pool(tmp_path):
     through it stay byte-identical."""
 
     async def run():
-        server, c, cl = await _connected(tmp_path, evt_threads=2,
-                                         cevt=2)
+        # inline wire on purpose: the reply pool turns BIG INLINE
+        # frames, and with the same-host shm lane armed (default on)
+        # a 256 KiB reply is a 20-byte descriptor frame that never
+        # needs the pool — the lane's path is pinned in
+        # test_shm_transport.py
+        server, c, cl = await _connected(
+            tmp_path, evt_threads=2, cevt=2,
+            extra="    option shm-transport off\n")
         payload = os.urandom(256 << 10)
         await c.write_file("/big", payload)
         assert await c.read_file("/big") == payload  # pooled decode

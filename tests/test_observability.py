@@ -51,6 +51,13 @@ volume srv
 end-volume
 """
 
+# the blob-lane monotonicity test speaks the inline wire on purpose:
+# with the same-host shm lane armed (default on, op-ver 17) payload
+# blobs ride the arenas and gftpu_wire_blob_stats legitimately stays
+# flat — the lane's own counters are pinned in test_shm_transport.py
+INLINE_CLIENT_VOLFILE = CLIENT_VOLFILE.replace(
+    "end-volume", "    option shm-transport off\nend-volume")
+
 SRV_CLIENT_VOLFILE = """
 volume c0
     type protocol/client
@@ -440,7 +447,7 @@ def test_registry_families_present_and_monotonic(tmp_path):
     async def run():
         server = await serve_brick(
             BRICK_VOLFILE.format(dir=tmp_path / "b"))
-        c, _g = await _connect(server.port)
+        c, _g = await _connect(server.port, INLINE_CLIENT_VOLFILE)
         try:
             snap0 = REGISTRY.snapshot()
             assert "gftpu_wire_blob_stats" in snap0

@@ -340,6 +340,71 @@ def test_spawn_gateway_threads_workers_flag(tmp_path):
         _sp.Popen = orig
 
 
+def test_spawn_gateway_divides_qos_rates_across_workers(tmp_path):
+    """The PR-17 ceiling fix: N shared-nothing workers each get 1/N of
+    the spawn-time --qos-* budget, so the AGGREGATE shed rate a client
+    IP sees equals the workers=1 deployment (N workers must enforce
+    the operator's ONE budget, not N of them)."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd
+
+    d = Glusterd(str(tmp_path / "gd"))
+    captured = {}
+
+    class _FakeProc:
+        pid = 1
+
+        def poll(self):
+            return None
+
+    import subprocess as _sp
+
+    orig = _sp.Popen
+    try:
+        def fake_popen(argv, **kw):
+            captured["argv"] = argv
+            return _FakeProc()
+
+        _sp.Popen = fake_popen
+
+        def spawn(workers):
+            d.gateway.clear()
+            opts = {"server.qos": "on",
+                    "server.qos-fops-per-sec": "100",
+                    "server.qos-bytes-per-sec": "1MB",
+                    "server.qos-burst": "4"}
+            if workers:
+                opts["gateway.workers"] = str(workers)
+            d._spawn_gateway({"name": "qv", "type": "distribute",
+                              "status": "started", "bricks": [],
+                              "options": opts, "auth": {}})
+            argv = captured["argv"]
+
+            def arg(flag):
+                return float(argv[argv.index(flag) + 1])
+
+            return (arg("--qos-fops"), arg("--qos-bytes"),
+                    arg("--qos-burst"))
+
+        one = spawn(0)          # no pool: full budget in one process
+        two = spawn(2)          # pool of 2: half each
+        assert one == (100.0, 1024.0 * 1024, 4.0), one
+        assert two[0] * 2 == one[0], (one, two)
+        assert two[1] * 2 == one[1], (one, two)
+        assert two[2] * 2 == one[2], (one, two)
+        # 0 = unlimited survives any pool width (never divided to
+        # "almost off")
+        d.gateway.clear()
+        d._spawn_gateway({"name": "qv", "type": "distribute",
+                          "status": "started", "bricks": [],
+                          "options": {"server.qos": "on",
+                                      "gateway.workers": "4"},
+                          "auth": {}})
+        argv = captured["argv"]
+        assert float(argv[argv.index("--qos-fops") + 1]) == 0
+    finally:
+        _sp.Popen = orig
+
+
 def test_mesh_env_threaded_through_brick_spawn(tmp_path):
     """cluster.mesh-distributed: _mesh_env hands every brick its rank,
     the brick count, and ONE stable coordinator endpoint (persisted in

@@ -519,6 +519,73 @@ def test_ec_traffic_origin_default_and_rebalance_tag(tmp_path):
     asyncio.run(run())
 
 
+def test_migrate_streaming_rides_delta_path_with_rebalance_origin(
+        tmp_path):
+    """A streamed migration copy onto a healthy systematic disperse
+    destination pre-sizes the temp and stripe-aligns its windows, so
+    the unaligned tail rides the PR-10 parity-delta path (no full
+    RMW), and the gftpu_ec_delta_writes_total family attributes it to
+    origin="rebalance" (ROADMAP item 3, narrow form)."""
+    from glusterfs_tpu.api.glfs import Client
+    from glusterfs_tpu.core.metrics import REGISTRY
+
+    vf = []
+    for g in range(2):
+        for i in range(3):
+            vf.append(f"volume e{g}{i}\n    type storage/posix\n"
+                      f"    option directory {tmp_path}/b{g}{i}\n"
+                      "end-volume\n")
+        subs = " ".join(f"e{g}{i}" for i in range(3))
+        vf.append(f"volume ec{g}\n    type cluster/disperse\n"
+                  "    option redundancy 1\n"
+                  "    option systematic on\n"
+                  f"    subvolumes {subs}\nend-volume\n")
+    vf.append("volume dist\n    type cluster/distribute\n"
+              "    option rebal-migrate-window 64KB\n"
+              "    subvolumes ec0 ec1\nend-volume\n")
+
+    async def run():
+        c = Client(Graph.construct("\n".join(vf)))
+        await c.mount()
+        try:
+            dht = c.graph.top
+            src, dst = _misplace(c, dht)
+            stripe = dht.children[0].stripe
+            # two full 64 KiB windows + an unaligned 700-byte tail:
+            # the streaming path (size > window), tail not a stripe
+            # multiple
+            size = 2 * 64 * 1024 + 700
+            assert size % stripe, "tail must be unaligned"
+            body = bytes(range(256)) * (size // 256) + b"T" * (size % 256)
+            await c.write_file(f"/{src}", body)
+            await c.rename(f"/{src}", f"/{dst}")
+            tag_rebalance_origin(c.graph)
+            dec = dht.children[dht.hashed_idx(dst)]
+            assert dht._delta_stripe(dec) == stripe
+            rmw0 = dec.write_path["rmw"]
+            delta0 = dec.delta_origin.get("rebalance", 0)
+            res = await dht.rebalance("/")
+            assert len(res["moved"]) == 1, res
+            assert res["status"]["failed"] == 0
+            # the tail took the delta plane, attributed to rebalance
+            assert dec.delta_origin.get("rebalance", 0) == delta0 + 1, \
+                dec.delta_origin
+            # ...and NOTHING on the destination paid a full RMW: the
+            # aligned windows are pure encodes over the pre-sized temp
+            assert dec.write_path["rmw"] == rmw0, dec.write_path
+            snap = REGISTRY.snapshot()
+            by_origin = {
+                s[0].get("origin"): s[1]
+                for s in snap["gftpu_ec_delta_writes_total"]["samples"]
+                if s[0]["layer"] == dec.name}
+            assert by_origin.get("rebalance", 0) >= 1, by_origin
+            assert bytes(await c.read_file(f"/{dst}")) == body
+        finally:
+            await c.unmount()
+
+    asyncio.run(run())
+
+
 # -- glusterd surfaces -------------------------------------------------------
 
 

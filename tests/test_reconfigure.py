@@ -197,6 +197,14 @@ def test_option_map_integrity():
                 assert any(o.name == opt for o in cls.OPTIONS), \
                     f"{key}: {t} lacks option {opt!r}"
     pseudo.add("__trace__")
+    # the shm bulk-lane key must exist on both transport ends
+    for key, (ltype, opt) in volgen.OPTION_MAP.items():
+        if ltype == "__shm__":
+            for t in ("protocol/client", "protocol/server"):
+                cls = _REGISTRY[t]
+                assert any(o.name == opt for o in cls.OPTIONS), \
+                    f"{key}: {t} lacks option {opt!r}"
+    pseudo.add("__shm__")
     missing = []
     for key, (ltype, opt) in volgen.OPTION_MAP.items():
         if ltype in pseudo:

@@ -1,0 +1,369 @@
+"""Same-host shared-memory bulk lane (rpc/shm, op-version 17).
+
+The pinned no-copy proof (a readv reply through the armed lane reaches
+the client as memoryviews INTO the shared mapping while the socket
+moves header-only bytes) plus the full fallback matrix the issue
+demands: non-advertising peer, live downgrade mid-connection
+(EOPNOTSUPP remembered like compound/xorv), arena exhaustion under a
+concurrent burst (inline fallback, byte-identical), peer SIGKILL with
+descriptors in flight (no leaked mappings), and cross-host simulation
+(boot-id mismatch: the lane never arms).
+"""
+
+import asyncio
+import gc
+import os
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.daemon import serve_brick
+from glusterfs_tpu.rpc import shm, wire
+
+from .harness import BRICK_VOLFILE, BrickProc
+
+pytestmark = pytest.mark.skipif(
+    not shm.supported(), reason="no memfd/SCM_RIGHTS on this platform")
+
+BRICK = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+
+volume srv
+    type protocol/server
+{opts}    subvolumes locks
+end-volume
+"""
+
+CLIENT = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume srv
+end-volume
+"""
+
+
+async def _up(tmp_path, srv_opts="", timeout=200):
+    server = await serve_brick(
+        BRICK.format(dir=tmp_path / "b", opts=srv_opts))
+    g = Graph.construct(CLIENT.format(port=server.port))
+    c = Client(g)
+    await c.mount()
+    for _ in range(timeout):
+        if g.top.connected:
+            break
+        await asyncio.sleep(0.05)
+    assert g.top.connected
+    return server, c, g.top
+
+
+async def _settle(check, rounds=40):
+    """GC-driven release: poll with collect until ``check`` holds."""
+    for _ in range(rounds):
+        gc.collect()
+        if check():
+            return True
+        await asyncio.sleep(0.05)
+    return check()
+
+
+# -- the lane itself: arming + the pinned no-copy proof ---------------------
+
+def test_lane_arms_and_readv_is_zero_copy(tmp_path):
+    async def run():
+        server, c, top = await _up(tmp_path)
+        try:
+            assert top._peer_shm and top._shm_rx is not None
+            conn = next(iter(server.connections))
+            assert conn.info()["shm"] == "armed"
+
+            body = bytes(os.urandom(100_000))
+            await c.write_file("/f", body)
+            btx0, brx0 = top.bytes_tx, top.bytes_rx
+            rx0 = shm.shm_stats["rx_bytes"]
+            f = await c.open("/f", os.O_RDONLY)
+            data = await top.readv(f.fd, len(body), 0)
+            # the reply blob is a VIEW, not bytes — and it resolves
+            # through the arena counters
+            assert isinstance(data, memoryview), type(data)
+            assert bytes(data) == body
+            assert shm.shm_stats["rx_bytes"] - rx0 >= len(body)
+            # header-only socket traffic: the 100 KB payload moved
+            # through the mapping, the socket carried the frame header
+            # + a 20-byte descriptor, both directions
+            assert top.bytes_tx - btx0 < 600, top.bytes_tx - btx0
+            assert top.bytes_rx - brx0 < 600, top.bytes_rx - brx0
+            # shared-mapping proof: flip a byte through the SERVER's
+            # mapping and watch it change under the client's view
+            idx = bytes(conn.shm_tx.mm).find(body[:64])
+            assert idx >= shm.HDR_SIZE
+            conn.shm_tx.mm[idx] = data[0] ^ 0xFF
+            assert data[0] == body[0] ^ 0xFF
+            # release rides GC: dropping the view frees the descriptor
+            # and the ack watermark hands the slot back to the producer
+            del data
+            assert await _settle(lambda: top._shm_rx.used() == 0)
+            await f.close()
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- fallback matrix --------------------------------------------------------
+
+def test_non_advertising_peer_stays_inline(tmp_path):
+    """network.shm-transport off on the brick: no advert, lane never
+    arms, traffic is byte-identical inline — and nothing is counted as
+    a fallback (declining is not failing)."""
+    async def run():
+        before = dict(shm.fallback_stats)
+        server, c, top = await _up(
+            tmp_path, srv_opts="    option shm-transport off\n")
+        try:
+            assert not top._peer_shm and top._shm_tx is None
+            conn = next(iter(server.connections))
+            assert conn.info()["shm"] == "off"
+            body = b"inline only" * 999
+            await c.write_file("/f", body)
+            assert bytes(await c.read_file("/f")) == body
+            assert shm.fallback_stats == before
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_cross_host_boot_id_mismatch_never_arms(tmp_path):
+    """The cheap cross-host screen: a foreign boot-id means the
+    side-channel cannot exist here — the client never dials and the
+    lane never arms (fallback reason recorded)."""
+    async def run():
+        server = await serve_brick(
+            BRICK.format(dir=tmp_path / "b", opts=""))
+        g = Graph.construct(CLIENT.format(port=server.port))
+        top = g.top
+        orig = top._shm_arm
+
+        async def foreign(ad):
+            await orig({**ad, "boot-id": "another-machine-entirely"})
+
+        top._shm_arm = foreign
+        c = Client(g)
+        miss0 = shm.fallback_stats.get("cross-host", 0)
+        await c.mount()
+        try:
+            for _ in range(200):
+                if top.connected:
+                    break
+                await asyncio.sleep(0.05)
+            assert top.connected
+            assert not top._peer_shm and top._shm_tx is None
+            assert shm.fallback_stats.get("cross-host", 0) == miss0 + 1
+            body = b"x" * 30_000
+            await c.write_file("/f", body)
+            assert bytes(await c.read_file("/f")) == body
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_live_downgrade_is_remembered_and_call_retried(tmp_path):
+    """Mid-connection downgrade: the brick loses its rx arena, answers
+    the next FL_SHM frame EOPNOTSUPP + shm-unsupported, and the client
+    disarms, REMEMBERS the refusal (like compound/xorv) and resends
+    that call inline — the caller never sees it."""
+    async def run():
+        server, c, top = await _up(tmp_path)
+        try:
+            assert top._peer_shm
+            conn = next(iter(server.connections))
+            conn.shm_rx.close()  # the brick's c2s mapping dies
+            down0 = shm.fallback_stats.get("downgrade", 0)
+            body = bytes(os.urandom(8192))
+            await c.write_file("/f", body)  # blob -> FL_SHM -> refused
+            assert bytes(await c.read_file("/f")) == body
+            assert top._shm_refused and not top._peer_shm
+            assert top._shm_tx is None and top._shm_rx is None
+            assert shm.fallback_stats.get("downgrade", 0) == down0 + 1
+            # the brick disarmed its half too: no FL_SHM reply can
+            # chase the torn-down client mapping
+            assert not conn.shm_tx_armed
+            # ...and the refusal sticks across a reconnect
+            await top._drop_connection()
+            for _ in range(200):
+                if top.connected:
+                    break
+                await asyncio.sleep(0.05)
+            assert top.connected and not top._peer_shm
+            assert bytes(await c.read_file("/f")) == body
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_arena_exhaustion_burst_falls_back_per_frame(tmp_path):
+    """64 concurrent writers against a minimum-size (64 KiB) arena:
+    frames that fit ride the lane, frames that don't ship inline
+    (reason arena-full), and every byte lands intact — the per-frame
+    fallback contract."""
+    async def run():
+        server, c, top = await _up(
+            tmp_path, srv_opts="    option shm-arena-size 64KB\n")
+        try:
+            assert top._peer_shm
+            assert top._shm_tx.cap == 64 * 1024 - shm.HDR_SIZE
+            full0 = shm.fallback_stats.get("arena-full", 0)
+            bodies = {i: bytes([i]) * (48 * 1024) for i in range(64)}
+
+            async def one(i):
+                await c.write_file(f"/f{i}", bodies[i])
+
+            await asyncio.gather(*(one(i) for i in range(64)))
+            # two 48 KiB frames can never share the ring: the burst
+            # must have forced inline fallbacks
+            assert shm.fallback_stats.get("arena-full", 0) > full0
+            for i in range(64):
+                assert bytes(await c.read_file(f"/f{i}")) == bodies[i], i
+            # the lane survived the burst armed
+            assert top._peer_shm and not top._shm_tx.dead
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_live_volume_set_off_is_per_frame(tmp_path):
+    """Flipping shm-transport off while the lane is armed downgrades
+    per frame, both directions, no reconnect — and flipping it back
+    resumes the lane on the same connection."""
+    async def run():
+        server, c, top = await _up(tmp_path)
+        try:
+            assert top._peer_shm
+            body = bytes(os.urandom(20_000))
+            await c.write_file("/f", body)
+            f = await c.open("/f", os.O_RDONLY)
+
+            async def read_once():
+                data = await top.readv(f.fd, len(body), 0)
+                out = bytes(data)
+                del data
+                return out
+
+            server.top.opts["shm-transport"] = False
+            top.opts["shm-transport"] = False
+            tx0 = shm.shm_stats["tx_frames"]
+            assert await read_once() == body  # reply shipped inline
+            await c.write_file("/g", body)    # request shipped inline
+            assert shm.shm_stats["tx_frames"] == tx0
+            server.top.opts["shm-transport"] = True
+            top.opts["shm-transport"] = True
+            assert await read_once() == body
+            assert shm.shm_stats["tx_frames"] > tx0  # lane resumed
+            await f.close()
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_peer_sigkill_reclaims_all_mappings(tmp_path):
+    """SIGKILL the brick subprocess with a descriptor still held by a
+    consumer view: the client's teardown defers the rx close under the
+    live view (still readable — the memfd outlives its creator), and
+    GC of the view drives live mappings back to baseline.  The leak
+    audit."""
+    brick = BrickProc(str(tmp_path), "b0")
+
+    async def run():
+        brick.start()
+        g = Graph.construct(
+            CLIENT.replace("option remote-subvolume srv",
+                           "option remote-subvolume locks")
+            .format(port=brick.port))
+        top = g.top
+        base = shm.live_mappings()
+        c = Client(g)
+        await c.mount()
+        try:
+            for _ in range(200):
+                if top.connected:
+                    break
+                await asyncio.sleep(0.05)
+            assert top.connected and top._peer_shm
+            assert shm.live_mappings() == base + 2  # our tx + rx
+            body = bytes(os.urandom(64 * 1024))
+            await c.write_file("/f", body)
+            f = await c.open("/f", os.O_RDONLY)
+            data = await top.readv(f.fd, len(body), 0)
+            assert isinstance(data, memoryview)
+
+            brick.kill()  # descriptors in flight
+            for _ in range(200):
+                if not top.connected:
+                    break
+                await asyncio.sleep(0.05)
+            assert not top.connected
+            # fd-close semantics: the mapping (and our view) survive
+            # the producer's death until WE let go
+            assert bytes(data) == body
+            del data
+            assert await _settle(lambda: shm.live_mappings() == base), \
+                shm.live_mappings()
+        finally:
+            await c.unmount()
+            brick.kill()
+
+    asyncio.run(run())
+
+
+# -- codec-level sanity (no transport) --------------------------------------
+
+def test_fl_shm_pack_unpack_roundtrip_and_watermark():
+    """One frame through a tx/rx arena pair over the same buffer:
+    descriptors resolve to views with the payload bytes, GC of the
+    views advances the shared watermark, and the producer reclaims."""
+    tx, fd = shm.ShmTx.create(256 * 1024)
+    rx = shm.ShmRx.attach(fd)
+    os.close(fd)
+    try:
+        payload = {"blob": wire.Blob(b"B" * 5000), "n": 7}
+        frames = wire.pack_frames(3, wire.MT_REPLY, payload, tx)
+        assert len(frames) == 1
+        rec = bytes(frames[0])[4:]
+        assert rec[5] == wire.FL_SHM
+        assert tx.used() == 5000
+        xid, mtype, out = wire.unpack(rec, rx)
+        assert (xid, mtype) == (3, wire.MT_REPLY)
+        assert bytes(out["blob"]) == b"B" * 5000 and out["n"] == 7
+        del out
+        gc.collect()
+        assert rx.used() == 0
+        # the reclaim is lazy: the next allocation reads the watermark
+        assert tx.put_blobs([memoryview(b"z")]) is not None
+        assert tx.used() == 1
+        # an unarmed receiver must refuse the record, not misread it
+        with pytest.raises(wire.ShmDecodeError):
+            wire.unpack(rec, None)
+    finally:
+        tx.close()
+        rx.close()

@@ -40,8 +40,15 @@ volume c0
     option remote-host 127.0.0.1
     option remote-port {port}
     option remote-subvolume stats
+    option shm-transport off
 end-volume
 """
+# shm-transport off: this plane pins SOCKET bytes against known
+# transfer sizes, and the same-host shm lane (default on, op-ver 17)
+# deliberately moves payloads off the socket — per-connection
+# bytes_rx/tx stay transport-level.  The armed lane's own accounting
+# (header-only socket deltas, arena byte counters) is pinned in
+# tests/test_shm_transport.py.
 
 
 async def _connect(port):

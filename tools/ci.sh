@@ -1075,6 +1075,95 @@ if [ $qos_rc -ne 0 ]; then
     exit $qos_rc
 fi
 
+echo "== ci: shm smoke (managed volume, bulk lane armed, families"
+echo "       monotonic, live volume-set off downgrades inline) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, os, shutil, tempfile
+
+async def main():
+    from glusterfs_tpu.core.layer import walk
+    from glusterfs_tpu.core.metrics import REGISTRY
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+    from glusterfs_tpu.rpc import shm
+
+    if not shm.supported():
+        print("shm smoke: platform has no memfd/SCM_RIGHTS — skipped")
+        return
+
+    def fam(name):
+        return sum(s[1] for s in REGISTRY.snapshot()[name]["samples"])
+
+    base = tempfile.mkdtemp(prefix="ci-shm")
+    d = Glusterd(os.path.join(base, "gd"))
+    await d.start()
+    try:
+        async with MgmtClient(d.host, d.port) as c:
+            await c.call("volume-create", name="sv", vtype="distribute",
+                         bricks=[{"path": os.path.join(base, "b0")}])
+            await c.call("volume-start", name="sv")
+        cl = await mount_volume(d.host, d.port, "sv")
+        try:
+            def lanes():
+                return [l for l in walk(cl.graph.top)
+                        if l.type_name == "protocol/client"]
+
+            for _ in range(200):  # subprocess brick: give arming time
+                if lanes() and all(l._peer_shm for l in lanes()):
+                    break
+                await asyncio.sleep(0.05)
+            assert lanes() and all(l._peer_shm for l in lanes()), \
+                "bulk lane never armed against the managed brick"
+            data = os.urandom(1 << 20)
+            tx0, rx0 = fam("gftpu_shm_tx_bytes_total"), \
+                fam("gftpu_shm_rx_bytes_total")
+            await cl.write_file("/f", data)  # dd stand-in: 1 MiB
+            got = bytes(await cl.read_file("/f"))
+            assert got == data, "armed-lane bytes diverged"
+            tx1, rx1 = fam("gftpu_shm_tx_bytes_total"), \
+                fam("gftpu_shm_rx_bytes_total")
+            assert tx1 - tx0 >= len(data), (tx0, tx1)
+            assert rx1 - rx0 >= len(data), (rx0, rx1)
+            # the per-connection state is on the status surface
+            assert any(l.dump_private()["shm"]["armed"]
+                       for l in lanes())
+
+            # live downgrade: volume set off must drop BOTH directions
+            # to inline with no reconnect and no byte damage
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-set", name="sv",
+                             key="network.shm-transport", value="off")
+            for _ in range(200):
+                ls = lanes()
+                if ls and all(not l.opts["shm-transport"] for l in ls):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(not l.opts["shm-transport"] for l in lanes()), \
+                "volume-set never reached the mounted client"
+            tx2 = fam("gftpu_shm_tx_bytes_total")
+            data2 = os.urandom(1 << 20)
+            await cl.write_file("/g", data2)
+            assert bytes(await cl.read_file("/g")) == data2, \
+                "inline downgrade bytes diverged"
+            assert fam("gftpu_shm_tx_bytes_total") == tx2, \
+                "a frame rode the lane after volume-set off"
+        finally:
+            await cl.unmount()
+    finally:
+        await d.stop()
+        shutil.rmtree(base, ignore_errors=True)
+    print("shm smoke: managed volume armed the bulk lane (families "
+          "+1 MiB both directions), live volume-set off downgraded "
+          "to inline, bytes exact throughout")
+
+asyncio.run(main())
+EOF
+shm_rc=$?
+if [ $shm_rc -ne 0 ]; then
+    echo "ci: shm smoke failed — not mergeable"
+    exit $shm_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
@@ -1083,5 +1172,5 @@ echo "ci: mergeable (two identical green tier-1 runs + bench contract"
 echo "    + metrics smoke + gateway smoke + concurrency smoke"
 echo "    + mesh smoke + chaos smoke + delta-write smoke"
 echo "    + rebalance smoke + process-plane smoke + lease smoke"
-echo "    + qos smoke)"
+echo "    + qos smoke + shm smoke)"
 exit 0

@@ -195,6 +195,12 @@ CAPABILITIES = {
            "the server's per-connection grant, consumed by the "
            "server's own encoder (conn.sg) — there is no client-side "
            "branch to take on it"),
+    "shm": ("exempt",
+            "advert, not a flag: the reply value is a dict (boot-id + "
+            "side-channel addr + one-shot token) consumed by "
+            "client._shm_arm via res.get('shm'); the armed state "
+            "lives in _peer_shm after the fd exchange + __shm_ok__ "
+            "confirm, not in a res.get branch"),
 }
 
 # --------------------------------------------------------------------------
