@@ -62,13 +62,24 @@ def _resolve() -> tuple[str, int] | None:
 
 
 def gf_event(event: str, **fields) -> bool:
-    """Emit one event; returns whether a datagram was sent."""
+    """Emit one event; returns whether a datagram was sent.
+
+    Every emission also lands in the process's flight-recorder ring,
+    and a failure-class event (flight.FAILURE_EVENTS) auto-captures a
+    local incident bundle — the black box records even when no eventsd
+    is listening."""
+    payload = {"event": event, "ts": time.time(), "pid": os.getpid()}
+    payload.update(fields)
+    try:
+        from . import flight
+
+        flight.note_event(event, payload)
+    except Exception:  # noqa: BLE001 - the tap must not fail emission
+        pass
     target = _resolve()
     if target is None:
         emit_stats["unconfigured"] += 1
         return False
-    payload = {"event": event, "ts": time.time(), "pid": os.getpid()}
-    payload.update(fields)
     try:
         _sock.sendto(json.dumps(payload).encode(), target)
         emit_stats["sent"] += 1

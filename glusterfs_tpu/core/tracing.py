@@ -63,9 +63,16 @@ SPANS: collections.deque = collections.deque(maxlen=_RING_DEFAULT)
 CURRENT: contextvars.ContextVar = contextvars.ContextVar(
     "gftpu_trace", default=None)
 
-SLOW_FOPS = REGISTRY.counter(
-    "gftpu_slow_fops_total",
-    "root fops that exceeded diagnostics.slow-fop-threshold")
+#: per-(layer, op) slow-fop counts — the {layer,op} labels say WHICH
+#: door and verb keeps blowing the threshold, not just that one did
+SLOW_FOP_COUNTS: dict[tuple[str, str], int] = {}
+
+REGISTRY.register(
+    "gftpu_slow_fops_total", "counter",
+    "root fops that exceeded diagnostics.slow-fop-threshold, "
+    "by layer and op",
+    lambda: [({"layer": l, "op": o}, v)
+             for (l, o), v in sorted(SLOW_FOP_COUNTS.items())])
 
 
 def set_ring_size(n: int) -> None:
@@ -112,11 +119,30 @@ def exit_span(span, duration: float, err: bool) -> None:
     except ValueError:
         pass  # context migrated (sync facade thread hop): root-only
     SPANS.append((tid, depth, layer_name, op, start, duration, err))
-    if root and SLOW_FOP_THRESHOLD and duration >= SLOW_FOP_THRESHOLD:
-        SLOW_FOPS.inc()
+    if not root:
+        return
+    if SLOW_FOP_THRESHOLD and duration >= SLOW_FOP_THRESHOLD:
+        key = (layer_name, op)
+        SLOW_FOP_COUNTS[key] = SLOW_FOP_COUNTS.get(key, 0) + 1
+        tree = render_tree(tid)
         log.warning(7, "slow fop: %s.%s took %.1fms (threshold %.1fms) "
                     "trace %s\n%s", layer_name, op, duration * 1e3,
-                    SLOW_FOP_THRESHOLD * 1e3, tid, render_tree(tid))
+                    SLOW_FOP_THRESHOLD * 1e3, tid, tree)
+        _flight().record("slow_fop", trace=tid, layer=layer_name, op=op,
+                         ms=round(duration * 1e3, 3), tree=tree)
+    elif err:
+        # an error ROOT fop is flight-notable even when fast: its span
+        # tree names which layer failed (the bundle's "what broke")
+        _flight().record("error_fop", trace=tid, layer=layer_name,
+                         op=op, ms=round(duration * 1e3, 3),
+                         tree=render_tree(tid))
+
+
+def _flight():
+    """Late import: flight imports tracing at module top (for the span
+    ring in its snapshot) — this side of the cycle resolves lazily."""
+    from . import flight
+    return flight
 
 
 def spans_for(trace_id: str) -> list[tuple]:
@@ -146,6 +172,7 @@ def render_tree(trace_id: str) -> str:
     return "\n".join(lines)
 
 
-__all__ = ["ENABLED", "SLOW_FOP_THRESHOLD", "SPANS", "CURRENT", "arm",
+__all__ = ["ENABLED", "SLOW_FOP_THRESHOLD", "SLOW_FOP_COUNTS", "SPANS",
+           "CURRENT", "arm",
            "enter", "exit_span", "current_id", "new_trace_id",
            "recent_spans", "render_tree", "set_ring_size", "spans_for"]

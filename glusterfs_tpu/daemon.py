@@ -92,6 +92,7 @@ async def serve_metrics(host: str = "127.0.0.1",
     allocation-light; scraping is a cold path by design."""
     import json
 
+    from .core import flight
     from .core.metrics import REGISTRY
 
     async def text():
@@ -101,9 +102,18 @@ async def serve_metrics(host: str = "127.0.0.1",
         return (json.dumps(REGISTRY.snapshot()).encode(),
                 b"application/json")
 
+    async def incident_json():
+        # the per-process incident door (single-process gateway / any
+        # daemon with a metrics port): glusterd's incident fan-out
+        # GETs this when no worker-pool supervisor is in front
+        return (json.dumps(flight.snapshot(), default=repr).encode(),
+                b"application/json")
+
     srv = await asyncio.start_server(
         http_route_handler({"/metrics": text, "/": text,
-                            "/metrics.json": structured}), host, port)
+                            "/metrics.json": structured,
+                            "/incident.json": incident_json}),
+        host, port)
     log.info(6, "metrics endpoint on %s:%d", host,
              srv.sockets[0].getsockname()[1])
     return srv
@@ -145,6 +155,9 @@ async def _amain(args) -> None:
     from .parallel import meshd
 
     meshd.maybe_initialize()
+    from .core import flight
+
+    flight.set_role("brick")
     with open(args.volfile) as f:
         text = f.read()
     server = await serve_brick(text, args.host, args.listen,

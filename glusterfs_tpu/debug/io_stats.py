@@ -56,6 +56,29 @@ class IoStatsLayer(Layer):
                max=1 << 20,
                description="bound on the per-process trace-span ring "
                            "(diagnostics.span-ring-size)"),
+        Option("incident-dir", "str", default="",
+               description="directory for auto-captured incident "
+                           "bundles (diagnostics.incident-dir; empty "
+                           "disables capture — the flight-recorder "
+                           "ring itself is always on, core/flight.py)"),
+        Option("incident-max-bytes", "size", default="64MB",
+               description="total size bound on the incident dir; "
+                           "oldest bundles pruned first "
+                           "(diagnostics.incident-max-bytes)"),
+        Option("incident-min-interval", "time", default="60",
+               description="min seconds between auto-captured bundles "
+                           "— one outage, one bundle, not one per "
+                           "breaker flap "
+                           "(diagnostics.incident-min-interval)"),
+        Option("flight-ring-size", "int", default=512, min=16,
+               max=1 << 16,
+               description="bound on the flight-recorder record ring "
+                           "(diagnostics.flight-ring-size)"),
+        Option("access-log", "bool", default="off",
+               description="gateway structured access-log lines "
+                           "(method, path, status, bytes, ms, trace) "
+                           "per HTTP request "
+                           "(diagnostics.access-log)"),
     )
 
     _LOG_LEVELS = {"TRACE": 5, "DEBUG": 10, "INFO": 20, "WARNING": 30,
@@ -76,6 +99,7 @@ class IoStatsLayer(Layer):
         A darkened process (GFTPU_NO_OBSERVABILITY / bench metrics-off)
         wins over the option defaults: latency-measurement's default
         'on' must not re-arm histograms at mount time."""
+        from ..core import flight
         from ..core import layer as layer_mod
         from ..core import tracing
 
@@ -84,6 +108,12 @@ class IoStatsLayer(Layer):
         tracing.SLOW_FOP_THRESHOLD = float(
             self.opts["slow-fop-threshold"])
         tracing.set_ring_size(int(self.opts["span-ring-size"]))
+        flight.set_ring_size(int(self.opts["flight-ring-size"]))
+        flight.configure_capture(
+            incident_dir=str(self.opts["incident-dir"]),
+            max_bytes=int(self.opts["incident-max-bytes"]),
+            min_interval=float(self.opts["incident-min-interval"]))
+        flight.set_access_log(bool(self.opts["access-log"]))
 
     def _restart_dump_task(self) -> None:
         """Cancel + respawn the periodic profile dump so a live

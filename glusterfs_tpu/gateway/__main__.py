@@ -31,7 +31,7 @@ import signal
 import sys
 
 from ..core import events as gf_events
-from ..core import gflog
+from ..core import flight, gflog
 from .server import ClientPool, ObjectGateway
 
 log = gflog.get_logger("gateway.daemon")
@@ -163,10 +163,18 @@ async def _amain(args) -> None:
     if args.eventsd:
         gf_events.configure(args.eventsd)
     if args.worker_fd >= 0:
+        flight.set_role("gateway-worker")
         await _amain_worker(args)
     elif args.workers > 0:
+        # the supervisor mounts no volfile, so the diagnostics.* keys
+        # never reach it through io-stats — its capture arm is argv
+        # (worker-respawn auto-capture writes the pool's bundle here)
+        flight.set_role("gateway-supervisor")
+        if args.incident_dir:
+            flight.configure_capture(incident_dir=args.incident_dir)
         await _amain_supervisor(args)
     else:
+        flight.set_role("gateway")
         await _amain_single(args)
 
 
@@ -224,6 +232,10 @@ def main(argv=None) -> int:
                         "fd-passing lane instead of SO_REUSEPORT")
     p.add_argument("--statusfile", default="",
                    help="supervisor writes worker pids/mode here")
+    p.add_argument("--incident-dir", default="",
+                   help="supervisor auto-capture directory for "
+                        "incident bundles (diagnostics.incident-dir "
+                        "for the role that mounts no volfile)")
     p.add_argument("--worker-fd", type=int, default=-1,
                    help=argparse.SUPPRESS)  # internal: control channel
     p.add_argument("--worker-rank", type=int, default=0,

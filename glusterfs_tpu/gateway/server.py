@@ -56,13 +56,17 @@ from typing import Any, AsyncIterator, Callable
 
 from ..api.glfs import Client
 from ..core import events as gf_events
-from ..core import gflog
+from ..core import flight, gflog, tracing
 from ..core.fops import FopError
 from ..core.metrics import REGISTRY, LogHistogram, labeled
 from ..performance import cache_metrics
 from ..rpc.wire import SGBuf, as_single_buffer
 
 log = gflog.get_logger("gateway")
+
+#: structured per-request access lines (diagnostics.access-log) go to
+#: their own logger so operators can route/ship them separately
+access_log = gflog.get_logger("gateway.access")
 
 #: where the PUT-time content hash lives on the object (the reference
 #: stores bit-rot signatures the same way: a trusted xattr beside the
@@ -459,6 +463,9 @@ class ObjectGateway:
             self._server = await asyncio.start_server(
                 self._serve_conn, self.host, self.port)
             self.port = self._server.sockets[0].getsockname()[1]
+        # the incident bundle's gateway section: this door's request /
+        # cache / pool accounting rides every snapshot
+        flight.add_section("gateway", self.dump)
         self._event("GATEWAY_START", pool=self.pool.size,
                     max_clients=self.max_clients)
         log.info(2, "object gateway for %s on %s:%d (pool=%d)",
@@ -484,10 +491,21 @@ class ObjectGateway:
             self._event("GATEWAY_CLIENT_THROTTLED",
                         conns=self.conns, limit=self.max_clients)
             try:
+                # even a shed connection gets a trace id: the 503 body
+                # names it so a client report can be joined to this
+                # process's flight ring
+                tid = tracing.new_trace_id() if tracing.ENABLED else ""
+                body = json.dumps({"error": "gateway saturated",
+                                   "trace": tid}).encode()
                 writer.write(b"HTTP/1.1 503 Service Unavailable\r\n"
                              b"Connection: close\r\n"
                              b"Retry-After: 1\r\n"
-                             b"Content-Length: 0\r\n\r\n")
+                             b"Content-Type: application/json\r\n" +
+                             (f"X-Gftpu-Trace: {tid}\r\n".encode()
+                              if tid else b"") +
+                             b"Content-Length: " +
+                             str(len(body)).encode() + b"\r\n\r\n" +
+                             body)
                 await writer.drain()
             except ConnectionError:
                 pass
@@ -523,6 +541,17 @@ class ObjectGateway:
                     "GET", "PUT", "HEAD", "DELETE", "POST",
                     "OPTIONS") else "OTHER"
                 self.inflight += 1
+                # ONE trace id per HTTP request, minted HERE and armed
+                # on this task's context: every pooled-glfs fop below
+                # inherits it, protocol/client ships it on the wire,
+                # and the brick re-arms it — a merged incident bundle
+                # shows this GET's waterfall across gateway worker →
+                # client graph → N brick daemons.  _respond reads it
+                # back as the X-Gftpu-Trace response header.
+                tid = ""
+                if tracing.ENABLED:
+                    tid = tracing.new_trace_id()
+                    tracing.arm(tid)
                 t0 = time.perf_counter()
                 tx0 = self.bytes_tx
                 status = 500
@@ -541,9 +570,24 @@ class ObjectGateway:
                                          self.bytes_tx - tx0)
                     self.requests[(mkey, status)] = \
                         self.requests.get((mkey, status), 0) + 1
+                    ms = (time.perf_counter() - t0) * 1e3
                     self.latency.setdefault(
-                        mkey, LogHistogram()).record(
-                            time.perf_counter() - t0)
+                        mkey, LogHistogram()).record(ms / 1e3)
+                    if flight.ACCESS_LOG:
+                        # diagnostics.access-log: one structured line
+                        # per request — grep-able AND json-parseable
+                        access_log.info(
+                            9, "%s", json.dumps(
+                                {"method": method, "path": target,
+                                 "status": status,
+                                 "bytes": self.bytes_tx - tx0,
+                                 "ms": round(ms, 3), "trace": tid},
+                                sort_keys=True))
+                    if status >= 500:
+                        flight.record(
+                            "gateway_5xx", method=method, path=target,
+                            status=status, trace=tid,
+                            ms=round(ms, 3))
                 if not body.consumed:
                     # a response went out before the request body was
                     # fully read (error mid-PUT): the leftover body
@@ -580,6 +624,12 @@ class ObjectGateway:
                        headers: dict[str, Any] | None = None,
                        body=None, head: bool = False) -> int:
         hdrs = dict(headers or {})
+        tid = tracing.current_id() if tracing.ENABLED else None
+        if tid:
+            # the request's trace id goes back to the caller: quote it
+            # in a support report and `volume incident show` finds the
+            # exact cross-process waterfall
+            hdrs.setdefault("X-Gftpu-Trace", tid)
         if body is None:
             length = int(hdrs.pop("content-length", 0))
         else:
@@ -694,8 +744,13 @@ class ObjectGateway:
                 return await self._respond(writer, 204)
             raise _HttpError(405)
         except _HttpError as e:
-            body = json.dumps({"error": str(e) or
-                               _REASONS.get(e.status, "")}).encode()
+            # 5xx and the admission-throttle 429 carry the trace id in
+            # the body too: a client that logs only bodies still gets
+            # the handle into the flight ring
+            err = {"error": str(e) or _REASONS.get(e.status, "")}
+            if e.status in (429, 503) or e.status >= 500:
+                err["trace"] = tracing.current_id() or ""
+            body = json.dumps(err).encode()
             return await self._respond(
                 writer, e.status,
                 {"content-type": "application/json", **e.headers},
@@ -703,8 +758,10 @@ class ObjectGateway:
                 head=method == "HEAD")
         except FopError as e:
             status = _status_of(e)
-            body = json.dumps({"error": str(e),
-                               "errno": e.err}).encode()
+            err = {"error": str(e), "errno": e.err}
+            if status >= 500:
+                err["trace"] = tracing.current_id() or ""
+            body = json.dumps(err).encode()
             return await self._respond(
                 writer, status, {"content-type": "application/json"},
                 body, head=method == "HEAD")
@@ -714,7 +771,9 @@ class ObjectGateway:
             log.error(3, "gateway request failed: %r", e)
             return await self._respond(
                 writer, 500, {"content-type": "application/json"},
-                json.dumps({"error": repr(e)}).encode(),
+                json.dumps({"error": repr(e),
+                            "trace": tracing.current_id() or ""}
+                           ).encode(),
                 head=method == "HEAD")
 
     # -- buckets -----------------------------------------------------------

@@ -56,7 +56,8 @@ import subprocess
 import sys
 import time
 
-from ..core import gflog
+from ..core import flight, gflog
+from ..core.events import gf_event
 from ..core.metrics import render_families
 
 log = gflog.get_logger("gateway.workers")
@@ -351,10 +352,16 @@ class GatewaySupervisor:
                     "workers": await self.gateway_dumps()}).encode(),
                     b"application/json")
 
+            async def incident_json():
+                return (json.dumps(await self.incident(),
+                                   default=repr).encode(),
+                        b"application/json")
+
             self._metrics_srv = await asyncio.start_server(
                 http_route_handler({"/metrics": text, "/": text,
                                     "/metrics.json": structured,
-                                    "/workers.json": per_worker}),
+                                    "/workers.json": per_worker,
+                                    "/incident.json": incident_json}),
                 self.host, self.metrics_port)
         if self.portfile:
             tmp = self.portfile + ".tmp"
@@ -406,6 +413,11 @@ class GatewaySupervisor:
                             "respawning", rank, w.proc.returncode)
                 w.close()
                 self.respawns += 1
+                # failure-class event: the gf_event tap lands it in the
+                # flight ring AND auto-captures an incident bundle when
+                # --incident-dir armed capture (core/flight.py)
+                gf_event("GATEWAY_WORKER_RESPAWN", rank=rank,
+                         rc=w.proc.returncode, respawns=self.respawns)
                 self._spawn(rank)
                 self._write_status()
 
@@ -460,6 +472,35 @@ class GatewaySupervisor:
             "help": "gateway workers respawned after a crash",
             "samples": [[{}, self.respawns]]}
         return merged
+
+    async def incident(self) -> dict:
+        """The pool's incident bundle: the supervisor's own flight
+        snapshot plus every live worker's flight bundle + registry
+        shard over the control channel; a dead worker is NAMED offline,
+        never silently dropped (the volume-status partial contract)."""
+        loop = asyncio.get_running_loop()
+        out: dict = {"role": "gateway-supervisor",
+                     "mode": self.mode, "respawns": self.respawns,
+                     "supervisor": flight.snapshot(),
+                     "workers": []}
+        for w in sorted(self._workers.values(), key=lambda x: x.rank):
+            if not w.alive():
+                out["workers"].append({"rank": w.rank,
+                                       "offline": True})
+                continue
+            self._snap_seq += 1
+            r = await w.snapshot(loop, self._snap_seq)
+            if r is None:
+                out["workers"].append({"rank": w.rank,
+                                       "offline": True})
+                continue
+            row = {"rank": w.rank, "pid": w.proc.pid,
+                   "flight": r.get("flight") or {},
+                   "registry": r.get("registry") or {}}
+            if r.get("truncated"):
+                row["truncated"] = r["truncated"]
+            out["workers"].append(row)
+        return out
 
     async def gateway_dumps(self) -> list[dict]:
         """Per-worker ObjectGateway.dump() list (tests/status)."""
@@ -606,6 +647,11 @@ async def worker_serve(gw, ctl_fd: int, rank: int,
                     await send_msg(loop, chan, {
                         "op": "snapshot", "id": msg.get("id"),
                         "registry": REGISTRY.snapshot(),
+                        # this worker's flight bundle rides the same
+                        # reply (metrics=False: "registry" above is
+                        # already the scrape) so the supervisor's
+                        # incident merge sees every shard's ring
+                        "flight": flight.snapshot(metrics=False),
                         "gateway": gw.dump()})
                 except OSError as e:
                     if e.errno != _errno.EMSGSIZE:
@@ -621,6 +667,8 @@ async def worker_serve(gw, ctl_fd: int, rank: int,
                             "truncated": "registry snapshot exceeded "
                                          "the control channel's "
                                          "message cap",
+                            "flight": flight.snapshot(
+                                spans=50, records=50, metrics=False),
                             "gateway": gw.dump()})
                     except OSError:
                         stop.set()

@@ -15,6 +15,7 @@ Command surface kept (cli-cmd-volume.c vocabulary):
     gftpu volume profile NAME
     gftpu volume metrics NAME
     gftpu volume gateway NAME start|stop|status
+    gftpu volume incident NAME capture|list|show [BUNDLE]
     gftpu peer probe HOST:PORT | peer status
 
 Talks to glusterd over the mgmt wire RPC (--server host:port, default
@@ -504,6 +505,22 @@ async def _run(args) -> Any:
             async with MgmtClient(host, port) as c:
                 return await c.call("volume-top", name=args.name,
                                     metric=metric, count=cnt)
+        if sub == "incident":
+            # volume incident NAME capture|list|show [BUNDLE] — the
+            # flight-recorder plane: capture fans a snapshot across
+            # bricks + gateway + service daemons into one cluster
+            # bundle; list/show read the incident dir
+            action = args.args[0] if args.args else "list"
+            if action not in ("capture", "list", "show"):
+                raise SystemExit("usage: volume incident NAME "
+                                 "capture|list|show [BUNDLE]")
+            async with MgmtClient(host, port) as c:
+                if action == "show":
+                    bundle = args.args[1] if len(args.args) > 1 else ""
+                    return await c.call("volume-incident-show",
+                                        name=args.name, bundle=bundle)
+                return await c.call(f"volume-incident-{action}",
+                                    name=args.name)
     raise SystemExit(f"unknown command {args.cmd} {args.sub}")
 
 
@@ -611,7 +628,8 @@ def main(argv=None) -> int:
                                      "rebalance", "profile", "metrics",
                                      "quota", "bitrot", "add-brick",
                                      "remove-brick", "replace-brick",
-                                     "top", "gateway", "clear-locks"])
+                                     "top", "gateway", "clear-locks",
+                                     "incident"])
     vol.add_argument("name", nargs="?", default="")
     vol.add_argument("args", nargs="*")
 

@@ -1480,6 +1480,207 @@ class Glusterd:
         except Exception:  # noqa: BLE001 - metrics are best-effort
             return None
 
+    # -- incident plane (flight-recorder capture fan-out) ------------------
+
+    def _incident_dir(self, vol: dict) -> str:
+        """Effective incident directory for this volume's cluster
+        bundles: ``diagnostics.incident-dir`` when set (the same dir
+        every process auto-captures into, so ``incident list`` shows
+        both kinds side by side), else a workdir fallback so the
+        operator command works on an unconfigured volume."""
+        d = str(vol.get("options", {}).get("diagnostics.incident-dir",
+                                           "") or "")
+        return d or os.path.join(self.workdir, "incidents", vol["name"])
+
+    def _incident_max_bytes(self, vol: dict) -> int:
+        from ..core.options import parse_size
+
+        try:
+            return parse_size(vol.get("options", {}).get(
+                "diagnostics.incident-max-bytes", "64MB"))
+        except Exception:
+            return 64 * 1024 * 1024
+
+    async def op_volume_incident_capture(self, name: str) -> dict:
+        """``gftpu volume incident capture <v>`` — fan a flight-recorder
+        snapshot request across every node's bricks, gateway and
+        service daemons, and merge the answers into ONE timestamped
+        cluster bundle in the effective incident dir.  A dead peer is
+        NAMED in ``partial`` (the volume-status contract), never
+        silently missing from the merge."""
+        vol = self._vol(name)
+        if vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        procs, partial = await self._gather_bricks(
+            "volume-incident-local", nodes=self._vol_nodes(vol),
+            name=name)
+        bundle = self._merge_partial(
+            {"volume": name, "ts": round(time.time(), 6),
+             "reason": "capture", "origin": self.uuid,
+             "processes": procs}, partial)
+        idir = self._incident_dir(vol)
+        os.makedirs(idir, exist_ok=True)
+        path = os.path.join(
+            idir, f"incident-{time.time_ns()}-cluster-{name}.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, default=repr, separators=(",", ":"),
+                      sort_keys=True)
+        os.replace(tmp, path)
+        from ..core import flight
+
+        flight.prune_dir(idir, self._incident_max_bytes(vol))
+        return self._merge_partial(
+            {"volume": name, "bundle": path,
+             "processes": sorted(procs)}, partial)
+
+    async def op_volume_incident_local(self, name: str) -> dict:
+        """One node's share of incident capture: each local brick's
+        ``__incident__`` RPC, the gateway's ``/incident.json`` (the
+        supervisor aggregates its workers there), and the SIGUSR2
+        capture door of shd / rebalanced.  Non-brick processes ride the
+        shared 'bricks' merge under reserved ``role:host`` keys, the
+        volume-metrics idiom."""
+        vol = self._vol(name)
+        out: dict[str, Any] = {}
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid:
+                continue
+            port = self.ports.get(b["name"])
+            proc = self.bricks.get(b["name"])
+            if not port or proc is None or proc.poll() is not None:
+                out[b["name"]] = {"offline": True}
+                continue
+            try:
+                payload = await self._brick_call(
+                    vol, port, "__incident__", [],
+                    subvol=b["name"] + "-server")
+            except Exception as e:
+                out[b["name"]] = {"offline": True,
+                                  "error": repr(e)[:200]}
+                continue
+            out[b["name"]] = payload if payload is not None \
+                else {"error": "__incident__ refused "
+                               "(older brick build?)"}
+        gw = await self._gateway_incident(vol)
+        if gw is not None:
+            out[f"gateway:{self.host}"] = gw
+        name_ = vol["name"]
+        shd_snap = await self._signal_incident(
+            self.shd.get(name_),
+            os.path.join(self.workdir, f"shd-{name_}.json.incident"))
+        if shd_snap is not None:
+            out[f"shd:{self.host}"] = shd_snap
+        reb_snap = await self._signal_incident(
+            self.rebalanced.get(name_),
+            os.path.join(self.workdir,
+                         f"rebalanced-{name_}.json.incident"))
+        if reb_snap is not None:
+            out[f"rebalance:{self.host}"] = reb_snap
+        return {"bricks": out}
+
+    async def _gateway_incident(self, vol: dict) -> dict | None:
+        """This node's gateway flight bundle over /incident.json (the
+        worker-pool supervisor answers with supervisor + per-worker
+        snapshots merged); None when no gateway runs here."""
+        name = vol["name"]
+        proc = self.gateway.get(name)
+        if proc is None or proc.poll() is not None:
+            return {"offline": True} if proc is not None else None
+        mport = int(vol.get("options", {}).get("gateway.metrics-port",
+                                               0) or 0)
+        if not mport:
+            return {"error": "gateway.metrics-port not set "
+                             "(no incident door)"}
+        host = str(vol.get("options", {}).get("gateway.listen-host",
+                                              "127.0.0.1"))
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, mport), 3)
+            try:
+                writer.write(b"GET /incident.json HTTP/1.0\r\n\r\n")
+                await writer.drain()
+                raw = await asyncio.wait_for(reader.read(-1), 5)
+            finally:
+                writer.close()
+            body = raw.split(b"\r\n\r\n", 1)[1]
+            return json.loads(body.decode())
+        except Exception as e:  # noqa: BLE001 - one process of many
+            return {"offline": True, "error": repr(e)[:200]}
+
+    @staticmethod
+    async def _signal_incident(proc, path: str) -> dict | None:
+        """SIGUSR2 capture door for service daemons with no inbound
+        RPC surface (shd, rebalanced): signal, poll for the bundle
+        file, parse it.  None = no such daemon on this node."""
+        if proc is None:
+            return None
+        if proc.poll() is not None:
+            return {"offline": True}
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        try:
+            proc.send_signal(signal.SIGUSR2)
+        except OSError as e:
+            return {"offline": True, "error": repr(e)[:200]}
+        for _ in range(40):
+            await asyncio.sleep(0.05)
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                continue  # not written yet / mid-rename
+        return {"error": "signal capture timed out"}
+
+    def op_volume_incident_list(self, name: str) -> dict:
+        """``gftpu volume incident list <v>`` — the bundles (auto-
+        captured AND operator-captured) in the effective incident
+        dir."""
+        vol = self._vol(name)
+        idir = self._incident_dir(vol)
+        bundles = []
+        try:
+            names = os.listdir(idir)
+        except OSError:
+            names = []
+        for fn in sorted(names):
+            if not (fn.startswith("incident-")
+                    and fn.endswith(".json")):
+                continue
+            try:
+                st = os.stat(os.path.join(idir, fn))
+            except OSError:
+                continue
+            bundles.append({"name": fn, "bytes": st.st_size,
+                            "mtime": round(st.st_mtime, 3)})
+        return {"volume": name, "dir": idir, "bundles": bundles}
+
+    def op_volume_incident_show(self, name: str,
+                                bundle: str = "") -> dict:
+        """``gftpu volume incident show <v> [bundle]`` — round-trip one
+        bundle's JSON (default: the newest)."""
+        vol = self._vol(name)
+        idir = self._incident_dir(vol)
+        if not bundle:
+            rows = self.op_volume_incident_list(name)["bundles"]
+            if not rows:
+                raise MgmtError(
+                    f"no incident bundles for {name} in {idir}")
+            bundle = max(rows, key=lambda r: r["mtime"])["name"]
+        base = os.path.basename(bundle)  # stay inside the incident dir
+        path = os.path.join(idir, base)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except OSError as e:
+            raise MgmtError(f"cannot read bundle {base}: "
+                            f"{e}") from e
+        except ValueError as e:
+            raise MgmtError(f"bundle {base} is not valid JSON: "
+                            f"{e}") from e
+
     async def op_volume_top(self, name: str, metric: str = "open",
                             count: int = 10) -> dict:
         """``gluster volume top <v> open|read|write|read-bytes|
@@ -2993,6 +3194,12 @@ class Glusterd:
             # without this the managed front door is metrics-blind
             argv += ["--metrics-port",
                      str(opts["gateway.metrics-port"])]
+        if opts.get("diagnostics.incident-dir"):
+            # the supervisor mounts no volfile, so the diagnostics.*
+            # keys never reach it through io-stats — arm its
+            # auto-capture (worker-respawn bundles) via argv
+            argv += ["--incident-dir",
+                     str(opts["diagnostics.incident-dir"])]
         ev = os.environ.get("GFTPU_EVENTSD")
         if ev:
             argv += ["--eventsd", ev]

@@ -588,8 +588,15 @@ def _write_statusfile(path: str, info: dict) -> None:
 
 
 async def _amain(args) -> int:
+    from ..core import flight
     from .glusterd import mount_volume
 
+    flight.set_role("rebalance")
+    if args.statusfile:
+        # incident capture door (no inbound RPC surface): SIGUSR2
+        # writes the flight bundle beside the statusfile, where the
+        # incident fan-out polls for it
+        flight.arm_signal_capture(args.statusfile + ".incident")
     host, _, port = args.glusterd.rpartition(":")
     host, port = host or "127.0.0.1", int(port)
     link = MgmtLink(host, port)

@@ -307,8 +307,10 @@ class SelfHealDaemon:
 
 
 async def _amain(args) -> None:
+    from ..core import flight
     from .glusterd import mount_volume
 
+    flight.set_role("shd")
     host, _, port = args.glusterd.rpartition(":")
     client = None
     while client is None:
@@ -321,6 +323,10 @@ async def _amain(args) -> None:
         with open(args.statefile + ".tmp", "w") as f:
             json.dump({"pid": os.getpid(), "volume": args.volname}, f)
         os.replace(args.statefile + ".tmp", args.statefile)
+        # incident capture door for a daemon with no inbound RPC:
+        # SIGUSR2 writes the flight bundle beside the statefile, where
+        # glusterd's incident fan-out polls for it
+        flight.arm_signal_capture(args.statefile + ".incident")
     shd = SelfHealDaemon(client, args.interval,
                          args.max_heals, args.wait_qlength)
     stop = asyncio.Event()

@@ -62,6 +62,17 @@ OPTION_MAP = {
     "diagnostics.slow-fop-threshold": ("debug/io-stats",
                                        "slow-fop-threshold"),
     "diagnostics.span-ring-size": ("debug/io-stats", "span-ring-size"),
+    # incident plane (op-version 18): io-stats pushes the keys
+    # process-wide (core/flight.py) on both graph ends, so bricks AND
+    # clients/gateway-workers auto-capture into the same directory
+    "diagnostics.incident-dir": ("debug/io-stats", "incident-dir"),
+    "diagnostics.incident-max-bytes": ("debug/io-stats",
+                                       "incident-max-bytes"),
+    "diagnostics.incident-min-interval": ("debug/io-stats",
+                                          "incident-min-interval"),
+    "diagnostics.flight-ring-size": ("debug/io-stats",
+                                     "flight-ring-size"),
+    "diagnostics.access-log": ("debug/io-stats", "access-log"),
     "client.strict-locks": ("protocol/client", "strict-locks"),
     # failure containment (ISSUE 9): per-brick circuit breaking, the
     # idempotent-retry knobs, the call-timeout transport bail, and
@@ -831,6 +842,19 @@ _V17_KEYS = (
     "network.shm-arena-size",
 )
 OPTION_MIN_OPVERSION.update({k: 17 for k in _V17_KEYS})
+
+# round-19 additions ship at op-version 18: the incident plane — a v17
+# io-stats has no flight-recorder push for these keys (they would
+# store and silently never capture), and a v17 glusterd has neither
+# the __incident__ fan-out nor the gateway --incident-dir spawner arm
+_V18_KEYS = (
+    "diagnostics.incident-dir",
+    "diagnostics.incident-max-bytes",
+    "diagnostics.incident-min-interval",
+    "diagnostics.flight-ring-size",
+    "diagnostics.access-log",
+)
+OPTION_MIN_OPVERSION.update({k: 18 for k in _V18_KEYS})
 
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key

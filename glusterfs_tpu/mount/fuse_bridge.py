@@ -34,7 +34,7 @@ import threading
 import time
 
 from ..api.glfs import Client
-from ..core import gflog
+from ..core import gflog, tracing
 from ..core.fops import FopError
 from ..core.iatt import IAType, Iatt
 from ..core.layer import FdObj, Loc
@@ -386,6 +386,12 @@ class FuseBridge:
         if opcode == fp.INTERRUPT:
             return  # best-effort: fops run to completion
         handler = self._HANDLERS.get(opcode)
+        if tracing.ENABLED:
+            # one trace id per KERNEL request (the fuse analog of the
+            # gateway's per-HTTP-request mint): every fop this request
+            # winds through the graph — and every brick span re-armed
+            # from the wire trace element — joins the same waterfall
+            tracing.arm(tracing.new_trace_id())
         # a request that never gets a reply wedges its caller in an
         # unkillable D-state: whatever goes wrong, ALWAYS answer
         data, error = b"", 0
@@ -889,8 +895,10 @@ class FuseBridge:
 
 
 async def _amain(args) -> int:
+    from ..core import flight
     from ..mgmt.glusterd import mount_volume
 
+    flight.set_role("fuse")
     host, _, port = args.server.rpartition(":")
     client = await mount_volume(host or "127.0.0.1", int(port),
                                 args.volume)

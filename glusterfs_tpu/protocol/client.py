@@ -34,7 +34,7 @@ from ..core.fops import Fop, FopError
 from ..core.iatt import gfid_new
 from ..core.layer import Event, FdObj, Layer, Loc, register
 from ..core.options import Option
-from ..core import gflog, tracing
+from ..core import flight, gflog, tracing
 from ..core import metrics as _metrics
 from ..rpc import shm as _shm
 from ..rpc import wire
@@ -574,6 +574,7 @@ class ClientLayer(Layer):
         _shm.count_fallback(reason)
         self._shm_teardown()
         log.warning(8, "%s: shm lane disarmed (%s)", self.name, reason)
+        flight.record("shm_disarm", layer=self.name, reason=reason)
 
     async def _drop_connection(self, notify: bool = True) -> None:
         was = self.connected
@@ -859,6 +860,8 @@ class ClientLayer(Layer):
                 log.warning(6, "%s: %s hit call-timeout (%.0fs) — "
                             "bailing the transport", self.name, fop,
                             timeout)
+                flight.record("failfast_drop", layer=self.name, fop=fop,
+                              timeout_s=round(float(timeout), 3))
                 await self._drop_connection()
             e = FopError(errno.ETIMEDOUT, f"{fop} timed out")
             # the CLIENT's deadline expired — the wire never answered.

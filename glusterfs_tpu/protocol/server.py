@@ -1515,6 +1515,19 @@ class BrickServer:
                 kind = args[0] if args else "clients"
                 return wire.MT_REPLY, _jsonable(
                     self._status_of(top, str(kind)))
+            if fop_name == "__incident__":
+                # incident fan-out brick half (glusterd
+                # op_volume_incident_local): this process's flight
+                # bundle — record ring + span ring + metrics — plus the
+                # per-client accounting the bundle contract promises
+                from ..core import flight
+
+                bundle = flight.snapshot()
+                try:
+                    bundle["clients"] = self._status_of(top, "clients")
+                except Exception as e:  # noqa: BLE001 - best-effort extra
+                    bundle["clients"] = {"error": repr(e)[:200]}
+                return wire.MT_REPLY, _jsonable(bundle)
             if fop_name == "__statedump__":
                 # full-graph dump (has "layers") when the daemon handed
                 # us the graph; bare top-layer dump otherwise

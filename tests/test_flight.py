@@ -1,0 +1,522 @@
+"""Incident flight recorder + cluster-wide trace capture (core/flight,
+the __incident__ RPC, glusterd's incident fan-out): the bounded record
+ring and its registry families, snapshot section isolation, auto-
+capture rate-limit/size-bound/pruning, failure-event triggers, the
+satellite pin that the wire trace id survives the FL_SHM bulk lane and
+the compound envelope (brick spans join the client trace on both
+transports), gateway X-Gftpu-Trace + error-body trace + access-log
+lines, and the managed cluster bundle merge with partial naming."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from glusterfs_tpu.api.glfs import Client
+from glusterfs_tpu.core import flight, gflog, tracing
+from glusterfs_tpu.core.graph import Graph
+from glusterfs_tpu.core.metrics import REGISTRY
+from glusterfs_tpu.daemon import serve_brick
+
+from .harness import BRICK_VOLFILE
+
+CLIENT_VOLFILE = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume locks
+end-volume
+"""
+
+
+@pytest.fixture(autouse=True)
+def _flight_reset():
+    """Flight state is process-global (the point of the module); tests
+    must not leak capture arming or ring contents into each other."""
+    saved = (flight.INCIDENT_DIR, flight.INCIDENT_MAX_BYTES,
+             flight.INCIDENT_MIN_INTERVAL, flight.ROLE,
+             flight.ACCESS_LOG)
+    flight.RING.clear()
+    flight._last_capture = 0.0
+    yield
+    (flight.INCIDENT_DIR, flight.INCIDENT_MAX_BYTES,
+     flight.INCIDENT_MIN_INTERVAL, flight.ROLE,
+     flight.ACCESS_LOG) = saved
+    flight.RING.clear()
+    flight._last_capture = 0.0
+    flight._sections.pop("t", None)
+    flight._sections.pop("boom", None)
+
+
+async def _connect(port, volfile=CLIENT_VOLFILE):
+    g = Graph.construct(volfile.format(port=port))
+    c = Client(g)
+    await c.mount()
+    for _ in range(200):
+        if g.top.connected:
+            break
+        await asyncio.sleep(0.05)
+    assert g.top.connected
+    return c, g
+
+
+def _bundles(d):
+    return sorted(f for f in os.listdir(d)
+                  if f.startswith("incident-") and f.endswith(".json"))
+
+
+# -- the recorder ----------------------------------------------------------
+
+def test_ring_bounded_and_counted():
+    """record() is bounded by the ring size and counted per kind in
+    gftpu_flight_records_total."""
+    flight.set_ring_size(32)
+    try:
+        before = dict(flight._record_counts)
+        for i in range(100):
+            flight.record("t_kind", i=i)
+        assert len(flight.RING) == 32
+        assert flight.RING[-1]["i"] == 99  # newest kept
+        snap = REGISTRY.snapshot()
+        counts = {l["kind"]: v for l, v in
+                  snap["gftpu_flight_records_total"]["samples"]}
+        assert counts["t_kind"] - before.get("t_kind", 0) == 100
+    finally:
+        flight.set_ring_size(512)
+
+
+def test_snapshot_sections_isolated():
+    """A registered section lands in the bundle; a raising section
+    degrades to an error stub without poisoning the snapshot."""
+    flight.add_section("t", lambda: {"x": 1})
+    flight.add_section("boom", lambda: 1 / 0)
+    flight.record("marker", tag="here")
+    snap = flight.snapshot(spans=10)
+    assert snap["t"] == {"x": 1}
+    assert "ZeroDivisionError" in snap["boom"]["error"]
+    assert any(r["kind"] == "marker" for r in snap["records"])
+    assert {"ts", "pid", "role", "spans", "metrics"} <= set(snap)
+    # the whole bundle is JSON-able with the capture encoder
+    json.loads(flight._jsonable_dumps(snap))
+
+
+def test_capture_rate_limit_force_and_prune(tmp_path):
+    """One bundle per min-interval (the breaker-flap guard), force
+    skips the limit but never the size bound, and the pruner deletes
+    oldest-first until the dir fits."""
+    d = str(tmp_path / "inc")
+    flight.configure_capture(incident_dir=d, max_bytes=1 << 30,
+                             min_interval=3600.0)
+    p1 = flight.maybe_capture("BRICK_DISCONNECTED")
+    assert p1 and os.path.exists(p1)
+    assert flight.maybe_capture("BRICK_DISCONNECTED") is None  # limited
+    p2 = flight.maybe_capture("manual", force=True)
+    assert p2 and p2 != p1
+    body = json.load(open(p2))
+    assert body["reason"] == "manual" and body["pid"] == os.getpid()
+    snap = REGISTRY.snapshot()
+    outcomes = {l["outcome"]: v for l, v in
+                snap["gftpu_incident_captures_total"]["samples"]}
+    assert outcomes["written"] >= 2 and outcomes["rate_limited"] >= 1
+    # size bound: a tiny budget keeps only the newest bundle(s)
+    sizes = {f: os.path.getsize(os.path.join(d, f))
+             for f in _bundles(d)}
+    flight.prune_dir(d, max(sizes.values()))
+    left = _bundles(d)
+    assert len(left) < len(sizes)
+    assert os.path.basename(p2) in left  # newest survived
+    flight.prune_dir(d, 0)
+    assert _bundles(d) == []
+
+
+def test_failure_event_auto_capture(tmp_path):
+    """A failure-class gf_event auto-captures a local bundle; routine
+    lifecycle events only land in the ring."""
+    d = str(tmp_path / "inc")
+    flight.configure_capture(incident_dir=d, max_bytes=1 << 30,
+                             min_interval=0.0)
+    from glusterfs_tpu.core.events import gf_event
+
+    gf_event("VOLUME_START", volume="v0")  # routine: ring only
+    assert _bundles(d) == [] if os.path.isdir(d) else True
+    gf_event("BRICK_DISCONNECTED", brick="b0", volume="v0")
+    names = _bundles(d)
+    assert len(names) == 1 and "BRICK_DISCONNECTED" in names[0]
+    bundle = json.load(open(os.path.join(d, names[0])))
+    assert bundle["reason"] == "BRICK_DISCONNECTED"
+    kinds = [r["kind"] for r in bundle["records"]]
+    assert "event" in kinds
+    evs = [r for r in bundle["records"] if r["kind"] == "event"]
+    assert any(e["event"] == "VOLUME_START" for e in evs)
+
+
+def test_error_fop_lands_span_tree_in_ring(tmp_path):
+    """A failed root fop records an error_fop entry carrying its span
+    tree — the flight ring keeps the evidence the log line drops."""
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+"""
+
+    async def run():
+        g = Graph.construct(vf)
+        c = Client(g)
+        await c.mount()
+        try:
+            flight.RING.clear()
+            with pytest.raises(Exception):
+                await c.read_file("/definitely-not-there")
+            errs = [r for r in flight.RING
+                    if r["kind"] == "error_fop"]
+            assert errs, list(flight.RING)
+            assert errs[0]["trace"] and "posix" in errs[0]["tree"]
+        finally:
+            await c.unmount()
+
+    asyncio.run(run())
+
+
+def test_slow_fop_record_carries_tree(tmp_path):
+    """Slow-fop span trees land in the flight ring (not just the log),
+    with the {layer,op} identity the labeled counter uses."""
+    vf = f"""
+volume posix
+    type storage/posix
+    option directory {tmp_path}/b
+end-volume
+volume slow
+    type debug/delay-gen
+    option delay-duration 20000
+    option delay-percentage 100
+    option enable writev
+    subvolumes posix
+end-volume
+volume stats
+    type debug/io-stats
+    option slow-fop-threshold 0.005
+    subvolumes slow
+end-volume
+"""
+
+    async def run():
+        g = Graph.construct(vf)
+        c = Client(g)
+        await c.mount()
+        try:
+            flight.RING.clear()
+            await c.write_file("/f", b"x")
+            slow = [r for r in flight.RING if r["kind"] == "slow_fop"]
+            assert slow, list(flight.RING)
+            rec = slow[0]
+            assert rec["op"] == "writev" and rec["ms"] >= 5
+            assert "writev" in rec["tree"] and rec["trace"]
+        finally:
+            tracing.SLOW_FOP_THRESHOLD = 0.0
+            await c.unmount()
+
+    asyncio.run(run())
+
+
+# -- the brick's __incident__ RPC ------------------------------------------
+
+def test_incident_rpc_returns_bundle(tmp_path):
+    """__incident__ answers the process flight bundle over the
+    authenticated wire, including the per-client accounting section."""
+
+    async def run():
+        server = await serve_brick(
+            BRICK_VOLFILE.format(dir=tmp_path / "b"))
+        c, g = await _connect(server.port)
+        try:
+            await c.write_file("/x", b"data" * 512)
+            bundle = await g.top._call("__incident__", (), {})
+            assert bundle["pid"] == os.getpid()  # in-process brick
+            assert any(s["op"] == "writev" for s in bundle["spans"])
+            assert "metrics" in bundle
+            rows = [r for r in bundle["clients"]["clients"]
+                    if not r["mgmt"]]
+            assert rows and rows[0]["bytes_rx"] >= 2048
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- satellite pin: trace id survives FL_SHM and compound ------------------
+
+def test_trace_survives_shm_bulk_lane(tmp_path):
+    """The trailing wire trace element rides the control frame, so a
+    payload moved through the PR-18 FL_SHM arena still joins the brick
+    spans to the client's trace — pinned against the armed lane."""
+    from glusterfs_tpu.rpc import shm
+
+    if not shm.supported():
+        pytest.skip("no memfd/SCM_RIGHTS on this platform")
+
+    async def run():
+        server = await serve_brick(
+            BRICK_VOLFILE.format(dir=tmp_path / "b"))
+        c, g = await _connect(server.port)
+        try:
+            assert g.top._peer_shm  # the bulk lane IS armed
+            tx0 = shm.shm_stats["tx_bytes"]
+            tid = tracing.new_trace_id()
+            tracing.arm(tid)
+            tracing.SPANS.clear()
+            await c.write_file("/big", b"z" * 100_000)
+            # the payload rode the arena, not the socket
+            assert shm.shm_stats["tx_bytes"] - tx0 >= 100_000
+            spans = [s for s in tracing.SPANS if s[3] == "writev"]
+            by_layer = {s[2]: s[0] for s in spans}
+            # client graph AND brick graph spans carry the armed id:
+            # the codec kept the trace element beside the blob lanes
+            assert by_layer.get("c0") == tid, spans
+            assert by_layer.get("posix") == tid, spans
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_trace_survives_compound_envelope(tmp_path):
+    """A compound chain over the wire keeps ONE trace id: the envelope
+    carries the trailing trace element and every brick-side link span
+    joins the client's trace."""
+
+    async def run():
+        from glusterfs_tpu.core.layer import Loc
+        from glusterfs_tpu.rpc import compound as cfop
+
+        server = await serve_brick(
+            BRICK_VOLFILE.format(dir=tmp_path / "b"))
+        c, g = await _connect(server.port)
+        try:
+            tid = tracing.new_trace_id()
+            tracing.arm(tid)
+            tracing.SPANS.clear()
+            replies = await g.top.compound([
+                ("create", (Loc("/cf"), os.O_RDWR, 0o644), {}),
+                ("writev", (cfop.FdRef(0), b"abc" * 200, 0), {}),
+                ("flush", (cfop.FdRef(0),), {}),
+                ("release", (cfop.FdRef(0),), {})])
+            assert cfop.first_error(replies) is None
+            spans = list(tracing.SPANS)
+            assert spans and all(s[0] == tid for s in spans), spans
+            # brick-side link spans (the posix layer lives across the
+            # wire) joined the same trace
+            posix_ops = {s[3] for s in spans if s[2] == "posix"}
+            assert {"create", "writev"} <= posix_ops, spans
+        finally:
+            await c.unmount()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- gateway: trace header, error bodies, access log -----------------------
+
+GW_BRICK = """
+volume posix
+    type storage/posix
+    option directory {dir}
+end-volume
+volume locks
+    type features/locks
+    subvolumes posix
+end-volume
+volume upcall
+    type features/upcall
+    subvolumes locks
+end-volume
+"""
+
+GW_CLIENT = """
+volume c0
+    type protocol/client
+    option remote-host 127.0.0.1
+    option remote-port {port}
+    option remote-subvolume upcall
+end-volume
+"""
+
+
+async def _start_gateway(volfile_text, **kw):
+    from glusterfs_tpu.gateway import ClientPool, ObjectGateway
+    from glusterfs_tpu.api.glfs import wait_connected
+
+    async def factory():
+        g = Graph.construct(volfile_text)
+        c = Client(g)
+        await c.mount()
+        await wait_connected(g)
+        return c
+
+    gw = ObjectGateway(ClientPool(factory, 2), volume="fltest", **kw)
+    await gw.start()
+    return gw
+
+
+def test_gateway_trace_header_and_access_log(tmp_path):
+    """Every gateway response names its request trace in X-Gftpu-Trace,
+    and diagnostics.access-log emits one structured line per request
+    (method, path, status, bytes, ms, trace)."""
+    from glusterfs_tpu.gateway.minihttp import fetch as http
+
+    async def run():
+        server = await serve_brick(GW_BRICK.format(dir=tmp_path / "b"))
+        gw = await _start_gateway(GW_CLIENT.format(port=server.port))
+        flight.set_access_log(True)
+        try:
+            st, hd, _ = await http(gw.host, gw.port, "PUT", "/bkt")
+            assert st == 200 and hd.get("x-gftpu-trace")
+            st, hd, _ = await http(gw.host, gw.port, "GET", "/bkt/no")
+            assert st == 404 and hd.get("x-gftpu-trace")
+            lines = [m for m in gflog.recent_messages(80)
+                     if '"method"' in m]
+            assert len(lines) >= 2, gflog.recent_messages(20)
+            row = json.loads(lines[-1][lines[-1].index("{"):])
+            assert row["method"] == "GET" and row["status"] == 404
+            assert row["path"] == "/bkt/no" and row["trace"]
+            assert "ms" in row and "bytes" in row
+            # the header and the log line name the SAME trace
+            assert row["trace"] == hd["x-gftpu-trace"]
+        finally:
+            flight.set_access_log(False)
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_gateway_shed_503_names_trace(tmp_path):
+    """The admission-shed 503 carries the trace id in its JSON body
+    (and the header), so a client-side report joins the flight ring."""
+    from glusterfs_tpu.gateway.minihttp import fetch as http
+
+    async def run():
+        server = await serve_brick(GW_BRICK.format(dir=tmp_path / "b"))
+        gw = await _start_gateway(GW_CLIENT.format(port=server.port),
+                                  max_clients=0)
+        try:
+            st, hd, body = await http(gw.host, gw.port, "GET", "/")
+            assert st == 503
+            err = json.loads(body)
+            assert err["error"] == "gateway saturated"
+            assert err["trace"] and err["trace"] == \
+                hd.get("x-gftpu-trace")
+        finally:
+            await gw.stop()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- managed cluster: capture fan-out, list/show, partial ------------------
+
+@pytest.mark.slow
+def test_cluster_incident_capture_merge_and_show(tmp_path):
+    """`volume incident capture` merges brick __incident__ answers,
+    with at least one trace id whose spans come from TWO distinct
+    brick processes (one replicated write = one client trace touching
+    both bricks); list/show round-trip the bundle; a second capture
+    after killing a brick names it offline."""
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    async def run():
+        d = Glusterd(str(tmp_path / "gd"))
+        await d.start()
+        try:
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-create", name="iv",
+                             vtype="replicate",
+                             bricks=[{"path": str(tmp_path / "b0")},
+                                     {"path": str(tmp_path / "b1")}])
+                await c.call("volume-start", name="iv")
+            m = await mount_volume(d.host, d.port, "iv")
+            try:
+                await m.write_file("/traced", b"t" * 4096)
+                assert await m.read_file("/traced") == b"t" * 4096
+                out = await d.op_volume_incident_capture("iv")
+                assert "partial" not in out
+                assert {"iv-brick-0", "iv-brick-1"} <= \
+                    set(out["processes"])
+                bundle = json.load(open(out["bundle"]))
+                procs = bundle["processes"]
+                # pid-distinct processes (real brick subprocesses)
+                pids = {procs[b]["pid"] for b in
+                        ("iv-brick-0", "iv-brick-1")}
+                assert len(pids) == 2
+                # ≥1 trace id spanning BOTH brick processes: the
+                # replicated write fanned one client trace out
+                per_brick = [
+                    {s["trace"] for s in procs[b]["spans"]}
+                    for b in ("iv-brick-0", "iv-brick-1")]
+                shared = per_brick[0] & per_brick[1]
+                assert shared, per_brick
+                # list/show round-trip
+                rows = d.op_volume_incident_list("iv")["bundles"]
+                assert [r["name"] for r in rows] == \
+                    [os.path.basename(out["bundle"])]
+                shown = d.op_volume_incident_show("iv")
+                assert shown["volume"] == "iv"
+                assert shown["processes"].keys() == procs.keys()
+                shown2 = d.op_volume_incident_show(
+                    "iv", bundle=rows[0]["name"])
+                assert shown2 == shown
+                # kill one brick: the next capture reports it offline
+                # instead of silently shrinking the merge
+                d.bricks["iv-brick-0"].kill()
+                d.bricks["iv-brick-0"].wait(timeout=5)
+                out2 = await d.op_volume_incident_capture("iv")
+                b2 = json.load(open(out2["bundle"]))
+                assert b2["processes"]["iv-brick-0"].get("offline"), b2
+                assert "spans" in b2["processes"]["iv-brick-1"]
+            finally:
+                await m.unmount()
+        finally:
+            await d.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_incident_capture_partial_names_dead_peer(tmp_path):
+    """A downed NODE degrades the capture to a NAMED partial — the
+    volume-status contract, not a fake-complete cluster bundle."""
+    from glusterfs_tpu.mgmt.glusterd import Glusterd, MgmtClient
+
+    async def run():
+        d1 = Glusterd(str(tmp_path / "gd1"))
+        await d1.start()
+        d2 = Glusterd(str(tmp_path / "gd2"))
+        await d2.start()
+        try:
+            async with MgmtClient(d1.host, d1.port) as c:
+                await c.call("peer-probe", host=d2.host, port=d2.port)
+                await c.call("volume-create", name="pv",
+                             vtype="replicate",
+                             bricks=[{"node": d1.uuid,
+                                      "path": str(tmp_path / "n1b")},
+                                     {"node": d2.uuid,
+                                      "path": str(tmp_path / "n2b")}])
+                await c.call("volume-start", name="pv")
+            await d2.stop()
+            out = await d1.op_volume_incident_capture("pv")
+            assert out["partial"] and \
+                out["partial"][0].startswith(d2.uuid[:8])
+            bundle = json.load(open(out["bundle"]))
+            assert bundle["partial"] == out["partial"]
+            assert "pv-brick-0" in bundle["processes"]
+            assert "pv-brick-1" not in bundle["processes"]
+        finally:
+            await d2.stop()
+            await d1.stop()
+
+    asyncio.run(run())

@@ -321,9 +321,13 @@ end-volume
         c = Client(g)
         await c.mount()
         try:
-            before = tracing.SLOW_FOPS.value
+            before = sum(tracing.SLOW_FOP_COUNTS.values())
             await c.write_file("/f", b"x")
-            assert tracing.SLOW_FOPS.value > before
+            assert sum(tracing.SLOW_FOP_COUNTS.values()) > before
+            # the counter is labeled {layer,op}: the slow write must
+            # be attributed to a concrete layer+op pair
+            assert any(op == "writev"
+                       for (_, op) in tracing.SLOW_FOP_COUNTS)
             logs = "\n".join(gflog.recent_messages(50))
             assert "slow fop" in logs
             # the logged tree names the layer below (where time went)

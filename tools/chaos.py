@@ -207,11 +207,19 @@ class Stack:
 @scenario("degraded_read")
 async def degraded_read(base: str, opts) -> dict:
     """Brick SIGKILL mid-write -> degraded byte-identical reads ->
-    restart -> heal converges -> the healed brick serves reads."""
+    restart -> heal converges -> the healed brick serves reads.
+    The incident plane rides along: the kill must auto-capture a
+    bundle (BRICK_DISCONNECTED is failure-class), the cluster capture
+    must show one trace id spanning >=2 distinct processes, and the
+    incident dir must respect its size bound."""
     out: dict = {}
     n_files = 6
     victim = 2
     async with Stack(base) as st:
+        inc_dir = os.path.join(base, "incidents")
+        await st.set("diagnostics.incident-dir", inc_dir)
+        await st.set("diagnostics.incident-max-bytes", "8MB")
+        await st.set("diagnostics.incident-min-interval", "0")
         cl = await st.mount()
         try:
             pay = [payload_for(i) for i in range(n_files)]
@@ -230,6 +238,45 @@ async def degraded_read(base: str, opts) -> dict:
             assert all(bytes(d) == p for d, p in zip(datas, pay)), \
                 "degraded read parity broken"
             out["degraded_reads_ok"] = n_files
+            # the kill auto-captured a local bundle: the mounted
+            # client saw BRICK_DISCONNECTED (failure-class) and wrote
+            # its flight ring into the incident dir
+            caps: list = []
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                caps = [f for f in (os.listdir(inc_dir)
+                                    if os.path.isdir(inc_dir) else [])
+                        if "BRICK_DISCONNECTED" in f]
+                if caps:
+                    break
+                await asyncio.sleep(0.2)
+            assert caps, "brick SIGKILL never auto-captured a bundle"
+            out["auto_captured"] = len(caps)
+            # cluster capture: the merged bundle holds >=1 trace id
+            # whose spans come from >=2 distinct PROCESSES (the
+            # degraded reads fanned one client trace across bricks)
+            cluster = await st.d.op_volume_incident_capture(st.name)
+            with open(cluster["bundle"]) as f:
+                merged = json.load(f)
+            pids_by_tid: dict = {}
+            for proc_bundle in merged["processes"].values():
+                if not isinstance(proc_bundle, dict) \
+                        or "spans" not in proc_bundle:
+                    continue
+                for sp in proc_bundle["spans"]:
+                    pids_by_tid.setdefault(sp["trace"], set()).add(
+                        proc_bundle["pid"])
+            shared = [t for t, pids in pids_by_tid.items()
+                      if len(pids) >= 2]
+            assert shared, "no trace id spans two processes"
+            out["cross_process_traces"] = len(shared)
+            # leak audit, incident-dir edition: captures + the
+            # cluster bundle stay inside the configured size bound
+            total = sum(os.path.getsize(os.path.join(inc_dir, f))
+                        for f in os.listdir(inc_dir))
+            assert total <= 8 * MIB, \
+                f"incident dir exceeded its size bound ({total}B)"
+            out["incident_dir_bytes"] = total
             # restart + heal to convergence
             await st.restart_brick(victim, port)
             conv = await st.heal_until_converged()
@@ -448,12 +495,19 @@ async def gateway(base: str, opts) -> dict:
             f.write(spec["volfile"])
         portfile = os.path.join(base, "gw.port")
         statusfile = os.path.join(base, "gw.status")
+        inc_dir = os.path.join(base, "incidents")
+        import socket
+
+        with socket.socket() as _s:  # ephemeral metrics port
+            _s.bind(("127.0.0.1", 0))
+            mport = _s.getsockname()[1]
         env = dict(os.environ)
         sup = subprocess.Popen(
             [sys.executable, "-m", "glusterfs_tpu.gateway",
              "--volfile", volfile, "--workers", "2", "--pool", "2",
              "--portfile", portfile, "--statusfile", statusfile,
-             "--max-clients", "128"],
+             "--max-clients", "128", "--metrics-port", str(mport),
+             "--incident-dir", inc_dir],
             env=env, stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL)
         try:
@@ -548,6 +602,45 @@ async def gateway(base: str, opts) -> dict:
             out["worker_kill_respawn_s"] = round(
                 time.monotonic() - t0, 2)
             out["worker_kill_load"] = dict(served)
+
+            # the respawn is a failure-class event: the supervisor must
+            # have auto-captured an incident bundle into --incident-dir
+            bundle = None
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 15:
+                hits = [f for f in sorted(os.listdir(inc_dir))
+                        if "GATEWAY_WORKER_RESPAWN" in f] \
+                    if os.path.isdir(inc_dir) else []
+                if hits:
+                    bundle = hits[-1]
+                    break
+                await asyncio.sleep(0.3)
+            assert bundle, "worker respawn did not auto-capture an " \
+                "incident bundle"
+            out["auto_captured"] = bundle
+
+            # cross-process trace stitch: a GET's trace id minted in a
+            # gateway WORKER must also appear in a BRICK daemon's span
+            # ring (the wire trace element crossed the client graph)
+            s, _, d = await asyncio.wait_for(
+                http("127.0.0.1", mport, "GET", "/incident.json"), 15)
+            assert s == 200, f"/incident.json -> {s}"
+            sup_bundle = json.loads(d)
+            worker_tids = {sp.get("trace")
+                           for w_ in sup_bundle["workers"]
+                           for sp in w_.get("flight", {}).get(
+                               "spans", [])} - {None}
+            local = await st.d.op_volume_incident_local(st.name)
+            brick_tids = set()
+            for proc in local["bricks"].values():
+                if isinstance(proc, dict):
+                    for sp in proc.get("spans") or []:
+                        if sp.get("trace"):
+                            brick_tids.add(sp["trace"])
+            shared = worker_tids & brick_tids
+            assert shared, "no trace id spans both a gateway worker " \
+                "and a brick process"
+            out["cross_process_traces"] = len(shared)
         finally:
             if sup.poll() is None:
                 sup.terminate()

@@ -70,6 +70,15 @@
 #                               gftpu_qos_* family monotonicity, live
 #                               v16 volume-set flip, shaping column in
 #                               volume-status-deep (ISSUE 17)
+#  13. shm smoke                same-host bulk lane arms against a
+#                               managed brick, shm families move both
+#                               directions, live volume-set off
+#                               downgrades inline (ISSUE 18)
+#  14. incident smoke           managed volume with
+#                               diagnostics.incident-dir armed: brick
+#                               SIGKILL auto-captures a local bundle,
+#                               `volume incident list` shows it,
+#                               `show` round-trips the JSON (ISSUE 19)
 #
 # Usage:  tools/ci.sh [extra pytest args for the tier-1 runs...]
 # Exit: first failing stage's code; 0 = mergeable.
@@ -1164,6 +1173,79 @@ if [ $shm_rc -ne 0 ]; then
     exit $shm_rc
 fi
 
+echo "== ci: incident smoke (managed volume, brick SIGKILL"
+echo "       auto-captures, list shows it, show round-trips) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, os, shutil, tempfile
+
+async def main():
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    base = tempfile.mkdtemp(prefix="ci-inc")
+    inc = os.path.join(base, "incidents")
+    d = Glusterd(os.path.join(base, "gd"))
+    await d.start()
+    try:
+        async with MgmtClient(d.host, d.port) as c:
+            await c.call("volume-create", name="iv",
+                         vtype="distribute",
+                         bricks=[{"path": os.path.join(base, "b0")}])
+            await c.call("volume-set", name="iv",
+                         key="diagnostics.incident-dir", value=inc)
+            await c.call("volume-set", name="iv",
+                         key="diagnostics.incident-min-interval",
+                         value="0")
+            await c.call("volume-start", name="iv")
+        cl = await mount_volume(d.host, d.port, "iv")
+        try:
+            await cl.write_file("/f", b"i" * 65536)
+            assert bytes(await cl.read_file("/f")) == b"i" * 65536
+
+            # brick SIGKILL is a failure-class event: the client's
+            # BRICK_DISCONNECTED must auto-capture a local bundle into
+            # the armed dir with no operator in the loop
+            d.bricks["iv-brick-0"].kill()
+            rows = []
+            for _ in range(200):
+                async with MgmtClient(d.host, d.port) as c:
+                    rows = (await c.call("volume-incident-list",
+                                         name="iv"))["bundles"]
+                if rows:
+                    break
+                await asyncio.sleep(0.1)
+            assert rows, "brick SIGKILL auto-captured no bundle"
+            assert any("BRICK_DISCONNECTED" in r["name"]
+                       for r in rows), rows
+
+            # show must round-trip the bundle JSON (newest by default
+            # AND by explicit name)
+            async with MgmtClient(d.host, d.port) as c:
+                shown = await c.call("volume-incident-show",
+                                     name="iv")
+                named = await c.call("volume-incident-show",
+                                     name="iv",
+                                     bundle=rows[-1]["name"])
+            for b in (shown, named):
+                assert b.get("reason"), b.keys()
+                assert "spans" in b and "metrics" in b, b.keys()
+        finally:
+            await cl.unmount()
+    finally:
+        await d.stop()
+        shutil.rmtree(base, ignore_errors=True)
+    print("incident smoke: brick kill auto-captured a "
+          "BRICK_DISCONNECTED bundle, list surfaced it, show "
+          "round-tripped the JSON")
+
+asyncio.run(main())
+EOF
+inc_rc=$?
+if [ $inc_rc -ne 0 ]; then
+    echo "ci: incident smoke failed — not mergeable"
+    exit $inc_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
@@ -1172,5 +1254,5 @@ echo "ci: mergeable (two identical green tier-1 runs + bench contract"
 echo "    + metrics smoke + gateway smoke + concurrency smoke"
 echo "    + mesh smoke + chaos smoke + delta-write smoke"
 echo "    + rebalance smoke + process-plane smoke + lease smoke"
-echo "    + qos smoke + shm smoke)"
+echo "    + qos smoke + shm smoke + incident smoke)"
 exit 0
