@@ -397,7 +397,8 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
                     compound: str = "on", fuse: bool = True,
                     prefix: str = "", zero_copy: str = "on",
                     metrics: str = "on",
-                    event_threads: str | None = None) -> dict:
+                    event_threads: str | None = None,
+                    history_interval: str | None = None) -> dict:
     """Through-the-wire AND through-the-mount numbers (the reference's
     baseline workloads — dd/iozone/glfs-bm, extras/benchmarking/README —
     all run through the full stack, never in-process):
@@ -419,6 +420,11 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
     the ``GFTPU_NO_OBSERVABILITY`` env the brick subprocesses inherit —
     the bricks' too.  The on/off wire pair is the accounting-overhead
     proof row.
+
+    ``history_interval`` sets diagnostics.history-interval on the served
+    volume (ISSUE 20): the bricks' delta-snapshot samplers retune to the
+    given cadence through io-stats.  An aggressive value ("0.25") vs a
+    parked one ("3600") is the history-sampler on/off overhead pair.
     """
     import asyncio
     import os
@@ -477,6 +483,12 @@ def fullstack_bench(n_clients: int = 8, file_mib: int = 1,
                     await c.call("volume-set", name="bw",
                                  key="client.event-threads",
                                  value=event_threads)
+                if history_interval is not None:
+                    # the v19 history cadence rides the volfile: every
+                    # brick's io-stats retunes its sampler on reload
+                    await c.call("volume-set", name="bw",
+                                 key="diagnostics.history-interval",
+                                 value=history_interval)
             cl = await mount_volume(d.host, d.port, "bw")
             try:
                 # calibrate the stripe-cache router OFF the clock: its
@@ -2442,6 +2454,29 @@ def main() -> None:
     except Exception as e:
         vol["metrics_off_wire_bench_error"] = str(e)[:200]
     try:
+        # history-sampler on/off pair (ISSUE 20): identical wire config,
+        # the delta-snapshot sampler at an aggressive 0.25s cadence vs
+        # parked at an hour (one sample per pass, cadence-wise off) —
+        # the pair records the sampler's marginal cost, judged against
+        # the documented wire swing band like every full-stack row
+        vol.update(fullstack_bench(fuse=False, prefix="hist_on_",
+                                   history_interval="0.25"))
+        vol.update(fullstack_bench(fuse=False, prefix="hist_off_",
+                                   history_interval="3600"))
+        _h_on = vol.get("hist_on_wire_write_MiB_s")
+        _h_off = vol.get("hist_off_wire_write_MiB_s")
+        if isinstance(_h_on, (int, float)) and \
+                isinstance(_h_off, (int, float)) and _h_on > 0:
+            vol["history_sampler_write_ratio"] = round(_h_off / _h_on, 2)
+    except Exception as e:
+        vol["history_sweep_error"] = str(e)[:200]
+    for _m in ("on", "off"):
+        for _op in ("write", "read"):
+            vol.setdefault(
+                f"hist_{_m}_wire_{_op}_MiB_s",
+                "skipped: "
+                + (vol.get("history_sweep_error") or "not measured"))
+    try:
         # event-threads on/off sweep (ISSUE 7): the concurrent event
         # plane pair, or the explicit single-core analysis row
         vol.update(event_threads_sweep())
@@ -2639,12 +2674,31 @@ def _prev_bench() -> dict | None:
     return None
 
 
-def _regression_gate(result: dict) -> list[dict]:
-    """Flag headline/sweep rows that dropped >10% vs the previous
-    round's recording (VERDICT r3 #1: silent round-over-round kernel
-    regressions).  Informational — the flags land in the recorded JSON
-    where the next round's first look sees them."""
-    prev = _prev_bench()
+#: Swing bands for the baseline-compare gate (ISSUE 20), machine-
+#: readable in every flagged row as "band" (the allowed old/new ratio):
+#:
+#: * SWING_BAND_COMPUTE — the headline encode/decode kernels and the
+#:   geometry sweep.  Device-side batch kernels are scheduling-stable at
+#:   these sizes; a 10% drop is a real kernel regression (VERDICT r3 #1).
+#: * SWING_BAND_WIRE — every full-stack row (wire/fuse/gateway/shm/
+#:   smallfile/degraded/...).  The 2-core CI host timeshares glusterd,
+#:   six brick subprocesses and the clients, so IDENTICAL code swings
+#:   wildly between runs: the recorded identical-config wire rows span
+#:   9.7–45.1 MiB/s (docs/observability.md), a 4.65x ratio.  Inside
+#:   that band a drop is scheduling noise, not a regression.
+SWING_BAND_COMPUTE = 1.0 / 0.9
+SWING_BAND_WIRE = 45.1 / 9.7
+
+
+def _regression_gate(result: dict, prev: dict | None = None) -> list[dict]:
+    """Baseline-compare: judge this recording against the committed
+    BENCH_DETAIL.json, flagging rows that dropped beyond their class
+    swing band.  Informational — the machine-readable flags
+    ({"row", "prev", "now", "drop_pct", "band"}) land in the recorded
+    JSON where the next round's first look (and ``--compare``) sees
+    them."""
+    if prev is None:
+        prev = _prev_bench()
     if not prev:
         return []
     if prev.get("backend") != result.get("backend"):
@@ -2656,24 +2710,67 @@ def _regression_gate(result: dict) -> list[dict]:
                  "now": result.get("backend")}]
     flags: list[dict] = []
 
-    def check(name: str, new, old) -> None:
+    def check(name: str, new, old, band: float) -> None:
         if isinstance(new, (int, float)) and isinstance(old, (int, float)) \
-                and old > 0 and new < 0.9 * old:
+                and old > 0 and new * band < old:
             flags.append({"row": name, "prev": old, "now": new,
-                          "drop_pct": round(100 * (1 - new / old), 1)})
+                          "drop_pct": round(100 * (1 - new / old), 1),
+                          "band": round(band, 2)})
 
-    check("encode", result.get("value"), prev.get("value"))
-    check("decode", result.get("decode_MiB_s"), prev.get("decode_MiB_s"))
+    check("encode", result.get("value"), prev.get("value"),
+          SWING_BAND_COMPUTE)
+    check("decode", result.get("decode_MiB_s"), prev.get("decode_MiB_s"),
+          SWING_BAND_COMPUTE)
     psweep = prev.get("sweep") or {}
     for key, row in (result.get("sweep") or {}).items():
         prow = psweep.get(key)
         if isinstance(row, dict) and isinstance(prow, dict):
             for sub in ("encode_MiB_s", "decode_MiB_s"):
-                check(f"sweep.{key}.{sub}", row.get(sub), prow.get(sub))
+                check(f"sweep.{key}.{sub}", row.get(sub), prow.get(sub),
+                      SWING_BAND_COMPUTE)
         elif isinstance(row, (int, float)):
-            check(f"sweep.{key}", row, prow)
+            check(f"sweep.{key}", row, prow, SWING_BAND_COMPUTE)
+    # every other throughput row rides the timeshared host: judge the
+    # full-stack rows at the documented wire band (latency rows, _ms,
+    # are direction-inverted and stay out of this drop gate)
+    for key, new in result.items():
+        if key in ("value", "decode_MiB_s") or \
+                not key.endswith(("_MiB_s", "_per_s")) or \
+                key.startswith(("baseline_", "avx_model_")):
+            continue
+        check(key, new, prev.get(key), SWING_BAND_WIRE)
     return flags
 
 
+def compare_main(detail_path: str | None = None) -> dict:
+    """Standalone baseline-compare mode (``python bench.py --compare``):
+    judge an EXISTING working-tree BENCH_DETAIL.json against the
+    committed recording without re-running any bench — the regression
+    watchdog as a seconds-fast check."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = detail_path or os.path.join(here, "BENCH_DETAIL.json")
+    with open(path) as f:
+        now = json.load(f)
+    prev = _prev_bench()
+    report = {
+        "mode": "compare",
+        "detail_file": os.path.basename(path),
+        "prev_backend": (prev or {}).get("backend"),
+        "now_backend": now.get("backend"),
+        "bands": {"compute": round(SWING_BAND_COMPUTE, 3),
+                  "wire": round(SWING_BAND_WIRE, 2)},
+        "regressions": _regression_gate(now, prev),
+    }
+    report["ok"] = not report["regressions"]
+    return report
+
+
 if __name__ == "__main__":
+    import sys as _sys
+
+    if "--compare" in _sys.argv[1:]:
+        _args = [a for a in _sys.argv[1:] if a != "--compare"]
+        _rep = compare_main(_args[0] if _args else None)
+        print(json.dumps(_rep, indent=1))
+        _sys.exit(0 if _rep["ok"] else 1)
     main()

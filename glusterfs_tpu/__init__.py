@@ -14,7 +14,12 @@ __version__ = "0.1.0"
 # minimum, gating newer volume-set keys until every member upgrades.
 # Lives here (not in mgmt/glusterd) so protocol/client can advertise it
 # at SETVOLUME without dragging the whole management plane into every
-# client process.  Version history: 18 incident plane (per-process
+# client process.  Version history: 19 history + SLO alerting plane
+# (per-process metrics history ring core/history.py + the declarative
+# SLO engine core/slo.py, diagnostics.history-* / diagnostics.slo-rules
+# keys, the __history__/__alerts__ brick doors and glusterd's
+# volume-alerts fan-out, volgen._V19_KEYS);
+# 18 incident plane (per-process
 # flight recorder core/flight.py + auto-capture diagnostics.incident-*
 # keys, the __incident__ brick RPC and glusterd's cluster capture
 # fan-out, the gateway's --incident-dir spawner arm, volgen._V18_KEYS);
@@ -49,4 +54,4 @@ __version__ = "0.1.0"
 # diagnostics, _V7_KEYS); 6 zero-copy reads + strict-locks (_V6_KEYS);
 # 5 compound fops + auth.ssl-allow (_V5_KEYS); 4 round-5 keys
 # (_V4_KEYS); 3 the round-4 option long tail (_V3_KEYS).
-OP_VERSION = 18
+OP_VERSION = 19

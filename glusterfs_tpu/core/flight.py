@@ -57,7 +57,7 @@ RING: collections.deque = collections.deque(maxlen=_RING_DEFAULT)
 FAILURE_EVENTS = frozenset((
     "BRICK_DISCONNECTED", "CLIENT_CIRCUIT_OPEN", "EC_MIN_BRICKS_NOT_UP",
     "AFR_QUORUM_FAIL", "POSIX_HEALTH_CHECK_FAILED", "SERVER_QUORUM_LOST",
-    "GATEWAY_WORKER_RESPAWN",
+    "GATEWAY_WORKER_RESPAWN", "ALERT_RAISED",
 ))
 
 # -- capture configuration (diagnostics.* v18 keys / --incident-dir) ------
@@ -134,13 +134,17 @@ def configure_capture(incident_dir: str | None = None,
         INCIDENT_MIN_INTERVAL = max(0.0, float(min_interval))
 
 
-def record(kind: str, **fields) -> None:
-    """Append one notable record to the ring (cheap, never raises)."""
+def record(kind: str, /, **fields) -> None:
+    """Append one notable record to the ring (cheap, never raises).
+    ``kind`` is positional-only so a field literally named "kind"
+    (e.g. an alert's rule kind) cannot raise a TypeError; the ring's
+    taxonomy key always wins the collision."""
     if not ENABLED:
         return
     try:
-        rec = {"ts": round(time.time(), 6), "kind": str(kind)}
+        rec = {"ts": round(time.time(), 6)}
         rec.update(fields)
+        rec["kind"] = str(kind)
         RING.append(rec)
         _record_counts[kind] = _record_counts.get(kind, 0) + 1
     except Exception:  # noqa: BLE001 - the recorder must never hurt a fop
@@ -153,7 +157,8 @@ def note_event(event: str, payload: dict) -> None:
     if not ENABLED:
         return
     record("event", event=event,
-           **{k: v for k, v in payload.items()
+           **{("event_kind" if k == "kind" else k): v
+              for k, v in payload.items()
               if k not in ("event", "ts", "pid")})
     if event in FAILURE_EVENTS:
         maybe_capture(event)

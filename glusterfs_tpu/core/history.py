@@ -1,0 +1,406 @@
+"""Per-process metrics HISTORY — the time dimension of the registry.
+
+PRs 4/5/19 made every subsystem's state *scrapeable*; this module makes
+it *replayable*: a background sampler captures the unified registry at
+a fixed cadence into a bounded ring of **delta-compressed** samples
+(only the keys whose value changed since the previous tick are stored,
+with a full baseline at ring start), so "what did p99 / the error rate
+look like over the last ten minutes?" is answerable from any daemon —
+no Prometheus server required.  The reference's closest analog is
+``volume profile`` interval mode, which keeps exactly ONE interval of
+state and loses it on read.
+
+Three consumers:
+
+* ``/metrics/history.json`` on every daemon metrics endpoint (and the
+  gateway supervisor, which merges per-worker rings via
+  :func:`merge_series` the same way it merges snapshots);
+* the SLO engine (:mod:`core.slo`), whose rules evaluate windowed
+  rates/ratios against the local ring on every sampler tick;
+* incident bundles — :func:`arm` registers the ring's tail as a flight
+  section, so a captured bundle shows the minutes *before* the
+  failure, not just the instant of it.
+
+Armed like the flight recorder: :func:`arm` at daemon startup, the
+``GFTPU_NO_OBSERVABILITY`` master gate darkens it entirely, and the
+``diagnostics.history-{interval,retention}`` keys (op-version 19,
+pushed process-wide by debug/io-stats) tune it live.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import threading
+import time
+from typing import Callable, Iterable
+
+from . import gflog, tracing
+from .metrics import REGISTRY, LogHistogram, _fmt_labels
+
+log = gflog.get_logger("core.history")
+
+#: rides the tracing master gate: a darkened process (bench
+#: metrics-off) must not pay a sampler thread either
+ENABLED = tracing.ENABLED
+
+DEFAULT_INTERVAL = 10.0
+DEFAULT_RETENTION = 600.0
+#: hard sample-count bound regardless of retention/interval (a
+#: misconfigured 0.1s interval with a day of retention must cost a
+#: bounded ring, not the heap)
+MAX_SAMPLES = 4096
+
+_sample_counts = {"sampled": 0, "error": 0}
+
+REGISTRY.register(
+    "gftpu_history_samples_total", "counter",
+    "history-ring sampler ticks by outcome",
+    lambda: [({"outcome": k}, v) for k, v in sorted(_sample_counts.items())])
+
+
+def flatten(snapshot: dict) -> tuple[dict[str, float], dict[str, str]]:
+    """A ``REGISTRY.snapshot()`` -> (``key -> value``, ``key -> type``)
+    with prometheus-shaped keys (``family{a="b"}``) — the ring's
+    storage unit.  Non-numeric samples (repr'd state strings) are
+    dropped: history is for values that can ramp."""
+    flat: dict[str, float] = {}
+    types: dict[str, str] = {}
+    for name, fam in snapshot.items():
+        mtype = fam.get("type", "gauge")
+        for labels, value in fam.get("samples", ()):
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            key = name + _fmt_labels(labels)
+            flat[key] = value
+            types[key] = mtype
+    return flat, types
+
+
+_KEY_RE = re.compile(r'^(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)'
+                     r'(?:\{(?P<labels>.*)\})?$')
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def key_family(key: str) -> str:
+    m = _KEY_RE.match(key)
+    return m.group("name") if m else key
+
+
+def key_labels(key: str) -> dict[str, str]:
+    m = _KEY_RE.match(key)
+    if not m or not m.group("labels"):
+        return {}
+    return dict(_LABEL_RE.findall(m.group("labels")))
+
+
+class HistoryRing:
+    """Bounded ring of delta-compressed registry samples.
+
+    Each entry is ``(ts, {key: value})`` holding only the keys that
+    changed since the previous entry (the first entry after a reset is
+    a full baseline); reconstruction walks forward carrying values.
+    Thread-safe: the sampler thread appends while scrape/SLO paths
+    read."""
+
+    def __init__(self, interval: float = DEFAULT_INTERVAL,
+                 retention: float = DEFAULT_RETENTION):
+        self.interval = float(interval)
+        self.retention = float(retention)
+        self._lock = threading.Lock()
+        self._samples: collections.deque = collections.deque()
+        self._last: dict[str, float] = {}
+        self._types: dict[str, str] = {}
+
+    def configure(self, interval: float | None = None,
+                  retention: float | None = None) -> None:
+        with self._lock:
+            if interval is not None:
+                self.interval = max(0.05, float(interval))
+            if retention is not None:
+                self.retention = max(1.0, float(retention))
+            self._trim_locked(time.time())
+
+    def _trim_locked(self, now: float) -> None:
+        # retention + hard count bound; trimming the baseline away is
+        # fine — the next-oldest delta simply becomes authoritative
+        # only for the keys it carries, and reconstruction tolerates
+        # keys appearing mid-ring (a late-registered family does the
+        # same thing)
+        while self._samples and (
+                now - self._samples[0][0] > self.retention
+                or len(self._samples) > MAX_SAMPLES):
+            self._samples.popleft()
+
+    def sample(self, snapshot: dict | None = None,
+               now: float | None = None) -> None:
+        """Capture one delta sample (the sampler tick; tests feed
+        synthetic snapshots directly)."""
+        try:
+            if snapshot is None:
+                snapshot = REGISTRY.snapshot()
+            flat, types = flatten(snapshot)
+        except Exception:  # noqa: BLE001 - a scrape must not kill the thread
+            _sample_counts["error"] += 1
+            return
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            delta = {k: v for k, v in flat.items()
+                     if self._last.get(k) != v}
+            self._samples.append((now, delta))
+            self._last = flat
+            self._types.update(types)
+            self._trim_locked(now)
+        _sample_counts["sampled"] += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples.clear()
+            self._last = {}
+            self._types = {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    # -- reconstruction ----------------------------------------------------
+
+    def series(self, prefix: str = "", window: float | None = None,
+               now: float | None = None) -> dict[str, list]:
+        """``key -> [[ts, value], ...]`` reconstructed with carry-
+        forward (an unchanged value still gets a point per tick — the
+        consumer sees a dense series, the ring stores one delta)."""
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            samples = list(self._samples)
+        out: dict[str, list] = {}
+        current: dict[str, float] = {}
+        for ts, delta in samples:
+            current.update(delta)
+            if window is not None and now - ts > window:
+                continue
+            for k, v in current.items():
+                if prefix and not k.startswith(prefix):
+                    continue
+                out.setdefault(k, []).append([ts, v])
+        return out
+
+    def dump(self, window: float | None = None, prefix: str = "",
+             max_samples: int | None = None) -> dict:
+        """The JSON-able ring view ``/metrics/history.json`` serves and
+        incident bundles embed (``max_samples`` bounds the tail for
+        bundle embedding)."""
+        with self._lock:
+            n = len(self._samples)
+            first = self._samples[0][0] if n else 0.0
+            last = self._samples[-1][0] if n else 0.0
+            types = dict(self._types)
+        if max_samples is not None and n:
+            window = min(window if window is not None else float("inf"),
+                         time.time() - last
+                         + self.interval * max_samples)
+        series = self.series(prefix=prefix, window=window)
+        rates = {}
+        for k, pts in series.items():
+            if types.get(k) == "counter" and len(pts) >= 2:
+                rates[k] = round(rate(pts), 6)
+        return {"interval": self.interval, "retention": self.retention,
+                "samples": n, "first_ts": first, "last_ts": last,
+                "series": series, "rates": rates}
+
+
+# -- series math (shared by the SLO engine and the trajectory surface) ----
+
+def increase(points: Iterable, t0: float | None = None,
+             t1: float | None = None) -> float:
+    """Counter increase over ``[t0, t1]``: the sum of positive deltas
+    between consecutive points — a value DROP is a counter reset
+    (daemon respawn), after which the post-reset absolute value counts
+    as new increase (the prometheus ``increase()`` contract)."""
+    total = 0.0
+    prev = None
+    for ts, v in points:
+        if t0 is not None and ts < t0:
+            prev = v
+            continue
+        if t1 is not None and ts > t1:
+            break
+        if prev is None:
+            prev = v
+            continue
+        total += (v - prev) if v >= prev else v
+        prev = v
+    return total
+
+
+def rate(points: list, window: float | None = None) -> float:
+    """Per-second increase over the last ``window`` seconds (or the
+    whole series); 0.0 when fewer than two points span the window."""
+    if not points:
+        return 0.0
+    t1 = points[-1][0]
+    t0 = t1 - window if window is not None else points[0][0]
+    span = [p for p in points if p[0] >= t0]
+    if len(span) < 2:
+        return 0.0
+    dt = span[-1][0] - span[0][0]
+    if dt <= 0:
+        return 0.0
+    return increase(span) / dt
+
+
+def percentile_trajectory(bucket_series: dict[int, list], q: float,
+                          window: float) -> list:
+    """``[[ts, seconds], ...]`` — the q-th percentile derived per tick
+    from windowed increments of log-histogram *bucket counters*
+    (bucket index -> cumulative-count series, the
+    :class:`core.metrics.LogHistogram` bucket convention).  Points with
+    an empty window (sampler gap, no traffic) report 0.0 — a gap is
+    visible as a flat zero, never interpolated away."""
+    grid = sorted({ts for pts in bucket_series.values()
+                   for ts, _ in pts})
+    out = []
+    for ts in grid:
+        counts: list[tuple[int, float]] = []
+        for idx, pts in sorted(bucket_series.items()):
+            inc = increase(pts, ts - window, ts)
+            if inc > 0:
+                counts.append((idx, inc))
+        total = sum(c for _, c in counts)
+        if not total:
+            out.append([ts, 0.0])
+            continue
+        need = q / 100.0 * total
+        seen = 0.0
+        val = LogHistogram.bound(counts[-1][0])
+        for idx, c in counts:
+            seen += c
+            if seen >= need:
+                val = LogHistogram.bound(idx)
+                break
+        out.append([ts, val])
+    return out
+
+
+def merge_series(dumps: list[dict]) -> dict:
+    """Merge several per-process ring dumps (the gateway supervisor's
+    per-worker aggregation, same semantics as its snapshot merge):
+    counters and plain gauges SUM across workers, quantile-labeled
+    gauges take the MAX (summing a p99 is meaningless).  The merged
+    grid is the union of every worker's tick timestamps; a worker
+    contributes its carried-forward value once it has one."""
+    grid = sorted({ts for d in dumps
+                   for pts in d.get("series", {}).values()
+                   for ts, _ in pts})[-MAX_SAMPLES:]
+    keys = sorted({k for d in dumps for k in d.get("series", {})})
+    merged: dict[str, list] = {}
+    for k in keys:
+        use_max = 'quantile="' in k
+        per_worker = [d.get("series", {}).get(k, []) for d in dumps]
+        pts_out = []
+        cursors = [0] * len(per_worker)
+        carried: list[float | None] = [None] * len(per_worker)
+        for ts in grid:
+            for i, pts in enumerate(per_worker):
+                while cursors[i] < len(pts) and pts[cursors[i]][0] <= ts:
+                    carried[i] = pts[cursors[i]][1]
+                    cursors[i] += 1
+            vals = [c for c in carried if c is not None]
+            if not vals:
+                continue
+            pts_out.append([ts, max(vals) if use_max else sum(vals)])
+        if pts_out:
+            merged[k] = pts_out
+    return {"series": merged, "samples": len(grid),
+            "first_ts": grid[0] if grid else 0.0,
+            "last_ts": grid[-1] if grid else 0.0,
+            "workers": len(dumps)}
+
+
+# -- the background sampler (one thread per process, armed at startup) ----
+
+#: THE process ring — every consumer (endpoint, SLO engine, incident
+#: section) reads this one object
+HISTORY = HistoryRing()
+
+_tick_hooks: list[Callable[[], None]] = []
+_thread: threading.Thread | None = None
+_wake = threading.Event()
+_stop = False
+_lock = threading.Lock()
+
+
+def add_tick_hook(fn: Callable[[], None]) -> None:
+    """Run ``fn`` after every sampler tick (the SLO engine's eval
+    cadence — one scrape feeds both the ring and the rules)."""
+    if fn not in _tick_hooks:
+        _tick_hooks.append(fn)
+
+
+def _sampler_loop() -> None:
+    while True:
+        _wake.wait(HISTORY.interval)
+        _wake.clear()
+        if _stop:
+            return
+        HISTORY.sample()
+        for fn in list(_tick_hooks):
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - hook isolation
+                log.warning(1, "history tick hook failed: %r", e)
+
+
+def arm() -> bool:
+    """Start the background sampler (idempotent; no-op when darkened).
+    Also registers the ring tail as an incident-bundle section — a
+    captured bundle carries the minutes before the failure."""
+    global _thread, _stop
+    if not ENABLED:
+        return False
+    with _lock:
+        if _thread is not None and _thread.is_alive():
+            return True
+        from . import flight
+
+        flight.add_section(
+            "history",
+            lambda: HISTORY.dump(max_samples=60))
+        _stop = False
+        _wake.clear()
+        _thread = threading.Thread(target=_sampler_loop,
+                                   name="gftpu-history-sampler",
+                                   daemon=True)
+        _thread.start()
+    return True
+
+
+def disarm() -> None:
+    """Stop the sampler (tests; daemons just exit — the thread is a
+    daemon thread)."""
+    global _thread, _stop
+    with _lock:
+        if _thread is None:
+            return
+        _stop = True
+        _wake.set()
+        t = _thread
+        _thread = None
+    t.join(timeout=2.0)
+
+
+def configure(interval: float | None = None,
+              retention: float | None = None) -> None:
+    """The diagnostics.history-* option push (io-stats, both graph
+    ends) and the gateway's argv arm: retune the ring live and kick
+    the sampler so a shorter interval takes effect now, not after the
+    old sleep."""
+    HISTORY.configure(interval=interval, retention=retention)
+    _wake.set()
+
+
+__all__ = ["ENABLED", "HISTORY", "HistoryRing", "flatten",
+           "key_family", "key_labels", "increase", "rate",
+           "percentile_trajectory", "merge_series",
+           "arm", "disarm", "configure", "add_tick_hook",
+           "DEFAULT_INTERVAL", "DEFAULT_RETENTION", "MAX_SAMPLES"]

@@ -27,7 +27,7 @@ from typing import Any, Callable, ClassVar
 
 from .fops import Fop, FopError
 from .iatt import Iatt
-from .metrics import LogHistogram
+from .metrics import REGISTRY, LogHistogram
 from .options import Option, validate_options
 from . import gflog, tracing
 
@@ -198,6 +198,40 @@ def walk(root: "Layer"):
         stack.extend(layer.children)
 
 
+# Live-layer fop accounting families (ISSUE 20): the per-layer
+# count/error counters _timed already maintains, aggregated by
+# (layer-name, op) across live instances — the SLO engine's error-ratio
+# source (errors/total over a history window).  Aggregation collapses
+# same-named layers from sibling graphs in one process (a test mounting
+# three "c0" clients) into one monotonic series instead of three
+# colliding label sets.
+import weakref as _weakref  # noqa: E402 - after the class machinery above
+
+_LIVE_LAYERS: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def _fop_samples(attr: str) -> list:
+    agg: dict[tuple[str, str], int] = {}
+    for layer in list(_LIVE_LAYERS):
+        for op, st in list(layer.stats.items()):
+            v = getattr(st, attr)
+            if v:
+                key = (layer.name, op)
+                agg[key] = agg.get(key, 0) + v
+    return [({"layer": ln, "op": op}, v)
+            for (ln, op), v in sorted(agg.items())]
+
+
+REGISTRY.register(
+    "gftpu_fops_total", "counter",
+    "fop dispatches per live layer instance (aggregated by name)",
+    lambda: _fop_samples("count"))
+REGISTRY.register(
+    "gftpu_fop_errors_total", "counter",
+    "fop failures (FopError) per live layer instance",
+    lambda: _fop_samples("errors"))
+
+
 # Registry of layer types: "cluster/disperse" -> class (the dlopen analog,
 # reference xlator_dynload xlator.c:369).
 _REGISTRY: dict[str, type["Layer"]] = {}
@@ -273,6 +307,7 @@ class Layer:
         self.opts = validate_options(self.OPTIONS, options or {})
         self.stats: dict[str, _FopStats] = {}
         self.initialized = False
+        _LIVE_LAYERS.add(self)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -256,5 +256,32 @@ def labeled(samples: dict, **fixed) -> list:
     return [({**fixed, "counter": k}, v) for k, v in samples.items()]
 
 
+def register_build_info(role: str) -> None:
+    """Register the standard ``gftpu_build_info{version,op_version,
+    role}`` info-gauge (value always 1, the prometheus build-info
+    idiom): every daemon role calls this at startup so merged bundles
+    and history rings are attributable to the code + op-version that
+    produced them.  Idempotent by registry contract (last registration
+    wins — one role per process)."""
+    from .. import OP_VERSION, __version__
+
+    REGISTRY.register(
+        "gftpu_build_info", "gauge",
+        "build/version identity of this process (value is always 1)",
+        lambda: [({"version": __version__,
+                   "op_version": str(OP_VERSION),
+                   "role": str(role)}, 1)])
+
+
+def history_ring():
+    """The per-process :class:`core.history.HistoryRing` (lazy import:
+    metrics is imported by everything; history pulls in tracing/flight
+    and must not become a base-layer import cost)."""
+    from . import history
+
+    return history.HISTORY
+
+
 __all__ = ["REGISTRY", "MetricsRegistry", "Counter", "Gauge",
-           "LogHistogram", "HIST_BUCKETS", "labeled"]
+           "LogHistogram", "HIST_BUCKETS", "labeled",
+           "register_build_info", "history_ring"]

@@ -1,0 +1,345 @@
+"""Declarative SLO engine — burn-rate alerting over the history ring.
+
+The history ring (:mod:`core.history`) gives every daemon a local time
+series; this module evaluates **rules** against it on every sampler
+tick and turns sustained badness into *transition-edge* alerts:
+``ALERT_RAISED`` fires once when a rule starts breaching and
+``ALERT_CLEARED`` once when it stops — never per evaluation (the
+THROTTLE_START/STOP convention).  A RAISED edge is failure-class: it
+rides :func:`core.events.gf_event` into eventsd/webhooks AND
+auto-captures an incident bundle through the PR-19 door
+(``flight.FAILURE_EVENTS``), so the bundle's embedded history section
+shows the ramp that tripped the rule.
+
+Rule grammar (``diagnostics.slo-rules``, op-version 19): a JSON array
+of rule objects — shipped EMPTY by default; alerting is strictly
+opt-in.  Common fields: ``name`` (unique), ``kind``, optional
+``labels`` (label-subset filter on metric keys).  Kinds:
+
+* ``latency-threshold`` — ``{"metric", "target"(s), "window"(s)}``:
+  breaches while the newest value of any matching series inside the
+  window exceeds ``target`` (point quantile gauges like
+  ``gftpu_gateway_request_seconds{quantile="p99"}``).
+* ``error-ratio`` — ``{"errors", "total", "target"(ratio),
+  "window"}``: windowed ``increase(errors)/increase(total)`` above
+  ``target`` breaches; zero traffic never breaches.
+* ``burn-rate`` — ``{"errors", "total", "slo"(e.g. 0.999), "fast"(s),
+  "slow"(s), "factor"}``: the multiwindow burn-rate alert — breaches
+  only while BOTH windows burn error budget (``ratio/(1-slo)``)
+  faster than ``factor``; the fast window bounds detection time, the
+  slow window vetoes blips.
+* ``absence`` — ``{"metric", "window"}``: breaches when no matching
+  sample landed within ``window`` — covers both a vanished series and
+  a stalled sampler (staleness), because a dead sampler stops
+  producing points for *every* key.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import time
+from typing import Any
+
+from . import gflog, history
+from .metrics import REGISTRY
+
+log = gflog.get_logger("core.slo")
+
+_KINDS = ("latency-threshold", "error-ratio", "burn-rate", "absence")
+#: evaluation needs this much series beyond the longest rule window so
+#: the window's left edge has a baseline point for increase()
+_WINDOW_SLACK = 2.0
+
+_transition_counts = {"raised": 0, "cleared": 0}
+
+
+def _required(kind: str) -> tuple[str, ...]:
+    return {"latency-threshold": ("metric", "target"),
+            "error-ratio": ("errors", "total", "target"),
+            "burn-rate": ("errors", "total", "slo"),
+            "absence": ("metric",)}[kind]
+
+
+def validate_rule(rule: Any) -> str | None:
+    """One rule's validation error, or None.  Kept standalone so
+    glusterd can reject a bad ``volume set`` value up front instead of
+    letting every daemon log it."""
+    if not isinstance(rule, dict):
+        return f"rule is not an object: {rule!r}"
+    name = rule.get("name")
+    if not name or not isinstance(name, str):
+        return f"rule missing a name: {rule!r}"
+    kind = rule.get("kind")
+    if kind not in _KINDS:
+        return f"{name}: unknown kind {kind!r} (one of {_KINDS})"
+    for field in _required(kind):
+        if field not in rule:
+            return f"{name}: {kind} rule missing {field!r}"
+    for field in ("target", "window", "slo", "fast", "slow", "factor"):
+        if field in rule:
+            try:
+                float(rule[field])
+            except (TypeError, ValueError):
+                return f"{name}: {field} is not a number: {rule[field]!r}"
+    if kind == "burn-rate" and not 0.0 < float(rule["slo"]) < 1.0:
+        return f"{name}: slo must be in (0, 1), got {rule['slo']}"
+    if "labels" in rule and not isinstance(rule["labels"], dict):
+        return f"{name}: labels must be an object"
+    return None
+
+
+def parse_rules(text: str) -> tuple[list[dict], list[str]]:
+    """``diagnostics.slo-rules`` value -> (valid rules, errors).
+    Empty/blank means no rules (the shipped default)."""
+    text = (text or "").strip()
+    if not text:
+        return [], []
+    try:
+        raw = json.loads(text)
+    except ValueError as e:
+        return [], [f"slo-rules is not valid JSON: {e}"]
+    if not isinstance(raw, list):
+        return [], ["slo-rules must be a JSON array of rule objects"]
+    rules, errors, seen = [], [], set()
+    for r in raw:
+        err = validate_rule(r)
+        if err is None and r["name"] in seen:
+            err = f"duplicate rule name {r['name']!r}"
+        if err is not None:
+            errors.append(err)
+            continue
+        seen.add(r["name"])
+        rules.append(r)
+    return rules, errors
+
+
+class SloEngine:
+    """Evaluates a rule set against one history ring; tracks breach
+    state per rule and fires transition-edge events."""
+
+    def __init__(self, ring: history.HistoryRing | None = None):
+        self.ring = ring if ring is not None else history.HISTORY
+        self.rules: list[dict] = []
+        self.rule_errors: list[str] = []
+        self.active: dict[str, dict] = {}
+        self.transitions: collections.deque = collections.deque(maxlen=256)
+
+    def set_rules(self, rules: list[dict],
+                  errors: list[str] | None = None) -> None:
+        self.rules = list(rules)
+        self.rule_errors = list(errors or [])
+        # a removed rule must not stay RAISED forever
+        for name in [n for n in self.active
+                     if n not in {r["name"] for r in self.rules}]:
+            self._clear(name, time.time(), reason="rule-removed")
+
+    # -- rule evaluation ---------------------------------------------------
+
+    def _select(self, series: dict, family: str,
+                labels: dict | None) -> dict[str, list]:
+        out = {}
+        for key, pts in series.items():
+            if history.key_family(key) != family:
+                continue
+            if labels:
+                kl = history.key_labels(key)
+                if any(kl.get(lk) != str(lv)
+                       for lk, lv in labels.items()):
+                    continue
+            out[key] = pts
+        return out
+
+    def _increase(self, series: dict, family: str, labels: dict | None,
+                  t0: float, t1: float) -> float:
+        return sum(history.increase(pts, t0, t1) for pts in
+                   self._select(series, family, labels).values())
+
+    def _ratio(self, series: dict, rule: dict, window: float,
+               now: float) -> float | None:
+        labels = rule.get("labels")
+        total = self._increase(series, rule["total"], labels,
+                               now - window, now)
+        if total <= 0:
+            return None  # zero traffic burns no budget
+        errs = self._increase(series, rule["errors"], labels,
+                              now - window, now)
+        return errs / total
+
+    def _observe(self, rule: dict, series: dict,
+                 now: float) -> tuple[bool, float | None, float]:
+        """-> (breaching, observed, target) for one rule."""
+        kind = rule["kind"]
+        if kind == "latency-threshold":
+            window = float(rule.get("window", 60.0))
+            target = float(rule["target"])
+            newest = [pts[-1][1] for pts in
+                      self._select(series, rule["metric"],
+                                   rule.get("labels")).values()
+                      if pts and now - pts[-1][0] <= window]
+            observed = max(newest) if newest else None
+            return (observed is not None and observed > target,
+                    observed, target)
+        if kind == "error-ratio":
+            window = float(rule.get("window", 60.0))
+            target = float(rule["target"])
+            observed = self._ratio(series, rule, window, now)
+            return (observed is not None and observed > target,
+                    observed, target)
+        if kind == "burn-rate":
+            fast = float(rule.get("fast", 300.0))
+            slow = float(rule.get("slow", 3600.0))
+            factor = float(rule.get("factor", 14.4))
+            budget = 1.0 - float(rule["slo"])
+            rf = self._ratio(series, rule, fast, now)
+            rs = self._ratio(series, rule, slow, now)
+            burn_f = (rf / budget) if rf is not None else None
+            burn_s = (rs / budget) if rs is not None else None
+            breach = (burn_f is not None and burn_s is not None
+                      and burn_f >= factor and burn_s >= factor)
+            return breach, burn_f, factor
+        # absence: no matching point within the window = breach (a
+        # stalled sampler stops producing points for every key, so
+        # staleness trips this too)
+        window = float(rule.get("window", 120.0))
+        pts = self._select(series, rule["metric"], rule.get("labels"))
+        newest = max((p[-1][0] for p in pts.values() if p),
+                     default=0.0)
+        return now - newest > window, now - newest, window
+
+    def evaluate(self, now: float | None = None) -> dict[str, dict]:
+        """One pass over every rule (the sampler tick hook); returns
+        the active-alert map after transitions fire."""
+        if not self.rules:
+            return self.active
+        now = time.time() if now is None else float(now)
+        longest = max((max(float(r.get("window", 60.0)),
+                           float(r.get("slow", 3600.0))
+                           if r["kind"] == "burn-rate" else 0.0)
+                       for r in self.rules), default=60.0)
+        series = self.ring.series(
+            window=longest + _WINDOW_SLACK * max(1.0, self.ring.interval),
+            now=now)
+        for rule in self.rules:
+            try:
+                breach, observed, target = self._observe(rule, series, now)
+            except Exception as e:  # noqa: BLE001 - one bad rule only
+                log.warning(1, "slo rule %s evaluation failed: %r",
+                            rule.get("name"), e)
+                continue
+            name = rule["name"]
+            if breach and name not in self.active:
+                self._raise(rule, now, observed, target)
+            elif not breach and name in self.active:
+                self._clear(name, now, observed=observed)
+            elif name in self.active:
+                self.active[name]["observed"] = observed
+                self.active[name]["last_eval"] = now
+        return self.active
+
+    # -- transition edges --------------------------------------------------
+
+    def _window_of(self, rule: dict) -> float:
+        if rule["kind"] == "burn-rate":
+            return float(rule.get("fast", 300.0))
+        return float(rule.get("window",
+                              120.0 if rule["kind"] == "absence"
+                              else 60.0))
+
+    def _raise(self, rule: dict, now: float, observed, target) -> None:
+        from . import events
+
+        name = rule["name"]
+        alert = {"rule": name, "kind": rule["kind"], "since": now,
+                 "observed": observed, "target": target,
+                 "window": self._window_of(rule), "last_eval": now}
+        self.active[name] = alert
+        self.transitions.append({"ts": now, "edge": "RAISED", **{
+            k: alert[k] for k in ("rule", "kind", "observed",
+                                  "target", "window")}})
+        _transition_counts["raised"] += 1
+        log.warning(2, "ALERT RAISED: %s (%s) observed=%r target=%r",
+                    name, rule["kind"], observed, target)
+        # failure-class: the gf_event tap auto-captures an incident
+        # bundle whose history section shows the ramp (flight.py)
+        events.gf_event("ALERT_RAISED", rule=name, kind=rule["kind"],
+                        window=alert["window"], observed=observed,
+                        target=target)
+
+    def _clear(self, name: str, now: float, observed=None,
+               reason: str = "") -> None:
+        from . import events
+
+        alert = self.active.pop(name, None)
+        if alert is None:
+            return
+        duration = round(now - alert["since"], 3)
+        rec = {"ts": now, "edge": "CLEARED", "rule": name,
+               "kind": alert["kind"], "observed": observed,
+               "target": alert["target"], "duration": duration}
+        if reason:
+            rec["reason"] = reason
+        self.transitions.append(rec)
+        _transition_counts["cleared"] += 1
+        log.info(3, "ALERT CLEARED: %s after %.1fs", name, duration)
+        events.gf_event("ALERT_CLEARED", rule=name, kind=alert["kind"],
+                        duration=duration, observed=observed,
+                        target=alert["target"])
+
+    # -- surfaces ----------------------------------------------------------
+
+    def status(self) -> dict:
+        """The ``__alerts__`` door / ``/alerts.json`` shape: rules as
+        configured (+ validation errors), the active set, recent
+        transition history."""
+        return {"rules": list(self.rules),
+                "rule_errors": list(self.rule_errors),
+                "active": sorted(self.active.values(),
+                                 key=lambda a: a["since"]),
+                "history": list(self.transitions)}
+
+
+#: THE process engine, bound to the process history ring; daemons feed
+#: it through configure() and the sampler tick hook
+ENGINE = SloEngine()
+
+REGISTRY.register(
+    "gftpu_slo_alerts_active", "gauge",
+    "currently-raised SLO alerts (one sample per breaching rule)",
+    lambda: [({"rule": n, "kind": a["kind"]}, 1)
+             for n, a in sorted(ENGINE.active.items())])
+REGISTRY.register(
+    "gftpu_slo_transitions_total", "counter",
+    "SLO alert transition edges by direction",
+    lambda: [({"edge": k}, v)
+             for k, v in sorted(_transition_counts.items())])
+
+
+def configure(rules_text: str) -> list[str]:
+    """The diagnostics.slo-rules option push (io-stats, both graph
+    ends) / daemon argv arm: install the rule set on the process
+    engine, hook evaluation onto the sampler tick, and register the
+    active-alert set as an incident-bundle section.  Returns
+    validation errors (also logged — a bad rule loses itself, never
+    the set)."""
+    rules, errors = parse_rules(rules_text)
+    for err in errors:
+        log.warning(4, "slo-rules: %s", err)
+    ENGINE.set_rules(rules, errors)
+    if rules:
+        from . import flight
+
+        history.add_tick_hook(_tick)
+        flight.add_section("alerts", lambda: {
+            "active": sorted(ENGINE.active.values(),
+                             key=lambda a: a["since"]),
+            "transitions": list(ENGINE.transitions)[-32:]})
+    return errors
+
+
+def _tick() -> None:
+    ENGINE.evaluate()
+
+
+__all__ = ["SloEngine", "ENGINE", "parse_rules", "validate_rule",
+           "configure"]

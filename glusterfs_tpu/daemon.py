@@ -92,7 +92,7 @@ async def serve_metrics(host: str = "127.0.0.1",
     allocation-light; scraping is a cold path by design."""
     import json
 
-    from .core import flight
+    from .core import flight, history, slo
     from .core.metrics import REGISTRY
 
     async def text():
@@ -109,10 +109,23 @@ async def serve_metrics(host: str = "127.0.0.1",
         return (json.dumps(flight.snapshot(), default=repr).encode(),
                 b"application/json")
 
+    async def history_json():
+        # the time dimension (ISSUE 20): windowed series reconstructed
+        # from the delta-compressed sampler ring, with derived
+        # per-counter rates (core/history.py)
+        return (json.dumps(history.HISTORY.dump(), default=repr).encode(),
+                b"application/json")
+
+    async def alerts_json():
+        return (json.dumps(slo.ENGINE.status(), default=repr).encode(),
+                b"application/json")
+
     srv = await asyncio.start_server(
         http_route_handler({"/metrics": text, "/": text,
                             "/metrics.json": structured,
-                            "/incident.json": incident_json}),
+                            "/incident.json": incident_json,
+                            "/metrics/history.json": history_json,
+                            "/alerts.json": alerts_json}),
         host, port)
     log.info(6, "metrics endpoint on %s:%d", host,
              srv.sockets[0].getsockname()[1])
@@ -155,9 +168,12 @@ async def _amain(args) -> None:
     from .parallel import meshd
 
     meshd.maybe_initialize()
-    from .core import flight
+    from .core import flight, history
+    from .core.metrics import register_build_info
 
     flight.set_role("brick")
+    register_build_info("brick")
+    history.arm()
     with open(args.volfile) as f:
         text = f.read()
     server = await serve_brick(text, args.host, args.listen,

@@ -79,6 +79,23 @@ class IoStatsLayer(Layer):
                            "(method, path, status, bytes, ms, trace) "
                            "per HTTP request "
                            "(diagnostics.access-log)"),
+        Option("history-interval", "time", default="10",
+               description="metrics history sampler cadence, seconds "
+                           "(diagnostics.history-interval; the ring "
+                           "clamps to a 0.05s floor) — each tick "
+                           "captures one delta-compressed registry "
+                           "snapshot (core/history.py)"),
+        Option("history-retention", "time", default="600",
+               description="how far back the metrics history ring "
+                           "reaches, seconds "
+                           "(diagnostics.history-retention; sample "
+                           "count additionally hard-bounded)"),
+        Option("slo-rules", "str", default="",
+               description="JSON array of SLO alert rules evaluated "
+                           "against the local history ring every "
+                           "sampler tick (diagnostics.slo-rules; "
+                           "empty = no alerting, the default — "
+                           "core/slo.py documents the rule grammar)"),
     )
 
     _LOG_LEVELS = {"TRACE": 5, "DEBUG": 10, "INFO": 20, "WARNING": 30,
@@ -99,7 +116,7 @@ class IoStatsLayer(Layer):
         A darkened process (GFTPU_NO_OBSERVABILITY / bench metrics-off)
         wins over the option defaults: latency-measurement's default
         'on' must not re-arm histograms at mount time."""
-        from ..core import flight
+        from ..core import flight, history, slo
         from ..core import layer as layer_mod
         from ..core import tracing
 
@@ -114,6 +131,15 @@ class IoStatsLayer(Layer):
             max_bytes=int(self.opts["incident-max-bytes"]),
             min_interval=float(self.opts["incident-min-interval"]))
         flight.set_access_log(bool(self.opts["access-log"]))
+        # history + SLO plane (v19): retune the ring live, install the
+        # rule set, and make sure the sampler runs — any process with
+        # an io-stats layer (brick, mounted client, gateway worker)
+        # keeps history; arm() is idempotent and honours the dark gate
+        history.configure(
+            interval=float(self.opts["history-interval"]),
+            retention=float(self.opts["history-retention"]))
+        slo.configure(str(self.opts["slo-rules"]))
+        history.arm()
 
     def _restart_dump_task(self) -> None:
         """Cancel + respawn the periodic profile dump so a live

@@ -160,21 +160,32 @@ async def _amain_supervisor(args) -> None:
 
 
 async def _amain(args) -> None:
+    from ..core import history
+    from ..core.metrics import register_build_info
+
     if args.eventsd:
         gf_events.configure(args.eventsd)
     if args.worker_fd >= 0:
         flight.set_role("gateway-worker")
+        register_build_info("gateway-worker")
+        history.arm()
         await _amain_worker(args)
     elif args.workers > 0:
         # the supervisor mounts no volfile, so the diagnostics.* keys
         # never reach it through io-stats — its capture arm is argv
-        # (worker-respawn auto-capture writes the pool's bundle here)
+        # (worker-respawn auto-capture writes the pool's bundle here;
+        # its history ring samples its own registry, while the
+        # aggregated /metrics/history.json merges the WORKER rings)
         flight.set_role("gateway-supervisor")
+        register_build_info("gateway-supervisor")
+        history.arm()
         if args.incident_dir:
             flight.configure_capture(incident_dir=args.incident_dir)
         await _amain_supervisor(args)
     else:
         flight.set_role("gateway")
+        register_build_info("gateway")
+        history.arm()
         await _amain_single(args)
 
 

@@ -36,9 +36,12 @@ worker carrying JSON messages (fd in ancillary data for ``conn``):
 
     parent -> worker   {"op": "conn"} + fd          (fd-pass mode)
     parent -> worker   {"op": "snapshot", "id": n}
+    parent -> worker   {"op": "history", "id": n, "window": w|null}
+    parent -> worker   {"op": "alerts", "id": n}
     worker -> parent   {"op": "ready", "port": p}
     worker -> parent   {"op": "snapshot", "id": n, "registry": ...,
                         "gateway": ...}
+    worker -> parent   {"op": "history"|"alerts", "id": n, ...}
 
 Channel EOF means the peer died: the worker exits (orphan guard), the
 parent respawns.
@@ -227,7 +230,7 @@ class _Worker:
                     self.port = int(msg.get("port", 0))
                     if not self.ready.done():
                         self.ready.set_result(True)
-                elif msg.get("op") == "snapshot":
+                elif "id" in msg:  # snapshot / history / alerts reply
                     fut = self._waiters.pop(msg.get("id"), None)
                     if fut is not None and not fut.done():
                         fut.set_result(msg)
@@ -241,16 +244,22 @@ class _Worker:
                     fut.set_result(None)
             self._waiters.clear()
 
-    async def snapshot(self, loop, req_id: int) -> dict | None:
+    async def call(self, loop, req_id: int, op: str,
+                   **extra) -> dict | None:
+        """One request/reply round on the control channel (snapshot /
+        history / alerts all share the waiter plumbing)."""
         fut = loop.create_future()
         self._waiters[req_id] = fut
         try:
             await send_msg(loop, self.chan,
-                           {"op": "snapshot", "id": req_id})
+                           {"op": op, "id": req_id, **extra})
             return await asyncio.wait_for(fut, _SNAPSHOT_TIMEOUT_S)
         except (OSError, asyncio.TimeoutError):
             self._waiters.pop(req_id, None)
             return None
+
+    async def snapshot(self, loop, req_id: int) -> dict | None:
+        return await self.call(loop, req_id, "snapshot")
 
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -357,11 +366,23 @@ class GatewaySupervisor:
                                    default=repr).encode(),
                         b"application/json")
 
+            async def history_json():
+                return (json.dumps(await self.history(),
+                                   default=repr).encode(),
+                        b"application/json")
+
+            async def alerts_json():
+                return (json.dumps(await self.alerts(),
+                                   default=repr).encode(),
+                        b"application/json")
+
             self._metrics_srv = await asyncio.start_server(
                 http_route_handler({"/metrics": text, "/": text,
                                     "/metrics.json": structured,
                                     "/workers.json": per_worker,
-                                    "/incident.json": incident_json}),
+                                    "/incident.json": incident_json,
+                                    "/metrics/history.json": history_json,
+                                    "/alerts.json": alerts_json}),
                 self.host, self.metrics_port)
         if self.portfile:
             tmp = self.portfile + ".tmp"
@@ -471,7 +492,66 @@ class GatewaySupervisor:
             "type": "counter",
             "help": "gateway workers respawned after a crash",
             "samples": [[{}, self.respawns]]}
+        # the supervisor's own identity rides the merged scrape next to
+        # the workers' (whose role="gateway-worker" samples SUM to the
+        # live-shard count — an honest process census for an info gauge)
+        from .. import OP_VERSION, __version__
+        bi = merged.setdefault("gftpu_build_info", {
+            "type": "gauge",
+            "help": "build/version identity of this process "
+                    "(value is always 1)",
+            "samples": []})
+        bi["samples"].append([{"version": __version__,
+                               "op_version": str(OP_VERSION),
+                               "role": "gateway-supervisor"}, 1])
         return merged
+
+    async def history(self, window: float | None = None) -> dict:
+        """Merged per-worker history rings (``/metrics/history.json``
+        on the aggregated endpoint): the same counters-sum /
+        quantiles-max semantics as the snapshot merge, applied per grid
+        timestamp by :func:`core.history.merge_series`."""
+        from ..core import history as _history
+
+        loop = asyncio.get_running_loop()
+        reqs = []
+        for w in list(self._workers.values()):
+            if w.alive():
+                self._snap_seq += 1
+                reqs.append(w.call(loop, self._snap_seq, "history",
+                                   window=window))
+        replies = await asyncio.gather(*reqs) if reqs else []
+        dumps = [r["history"] for r in replies
+                 if r and isinstance(r.get("history"), dict)]
+        merged = _history.merge_series(dumps)
+        merged["mode"] = self.mode
+        merged["offline"] = len(reqs) - len(dumps)
+        return merged
+
+    async def alerts(self) -> dict:
+        """Per-worker SLO engine status union (``/alerts.json``): the
+        active set is the union across shards (rank-tagged), a dead
+        worker is NAMED offline — the volume-status partial contract."""
+        loop = asyncio.get_running_loop()
+        out: dict = {"role": "gateway-supervisor", "active": [],
+                     "history": [], "offline": []}
+        for w in sorted(self._workers.values(), key=lambda x: x.rank):
+            if not w.alive():
+                out["offline"].append(w.rank)
+                continue
+            self._snap_seq += 1
+            r = await w.call(loop, self._snap_seq, "alerts")
+            st = (r or {}).get("alerts")
+            if not isinstance(st, dict):
+                out["offline"].append(w.rank)
+                continue
+            for a in st.get("active", []):
+                out["active"].append({"rank": w.rank, **a})
+            for t in st.get("history", []):
+                out["history"].append({"rank": w.rank, **t})
+        out["active"].sort(key=lambda a: a.get("since", 0.0))
+        out["history"].sort(key=lambda t: t.get("ts", 0.0))
+        return out
 
     async def incident(self) -> dict:
         """The pool's incident bundle: the supervisor's own flight
@@ -673,6 +753,42 @@ async def worker_serve(gw, ctl_fd: int, rank: int,
                     except OSError:
                         stop.set()
                         return
+            elif op == "history":
+                from ..core import history as _history
+
+                for fd in fds:
+                    os.close(fd)
+                win = msg.get("window")
+                dump = _history.HISTORY.dump(
+                    window=float(win) if win else None)
+                try:
+                    await send_msg(loop, chan, {
+                        "op": "history", "id": msg.get("id"),
+                        "history": dump})
+                except OSError:
+                    # a ring outgrowing the channel cap degrades to the
+                    # bounded tail — a history scrape must never kill a
+                    # worker (the snapshot EMSGSIZE contract)
+                    try:
+                        await send_msg(loop, chan, {
+                            "op": "history", "id": msg.get("id"),
+                            "history": _history.HISTORY.dump(
+                                max_samples=30)})
+                    except OSError:
+                        stop.set()
+                        return
+            elif op == "alerts":
+                from ..core import slo as _slo
+
+                for fd in fds:
+                    os.close(fd)
+                try:
+                    await send_msg(loop, chan, {
+                        "op": "alerts", "id": msg.get("id"),
+                        "alerts": _slo.ENGINE.status()})
+                except OSError:
+                    stop.set()
+                    return
             else:
                 for fd in fds:
                     os.close(fd)

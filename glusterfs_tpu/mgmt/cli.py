@@ -16,6 +16,7 @@ Command surface kept (cli-cmd-volume.c vocabulary):
     gftpu volume metrics NAME
     gftpu volume gateway NAME start|stop|status
     gftpu volume incident NAME capture|list|show [BUNDLE]
+    gftpu volume alerts NAME list|history|rules
     gftpu peer probe HOST:PORT | peer status
 
 Talks to glusterd over the mgmt wire RPC (--server host:port, default
@@ -521,6 +522,19 @@ async def _run(args) -> Any:
                                         name=args.name, bundle=bundle)
                 return await c.call(f"volume-incident-{action}",
                                     name=args.name)
+        if sub == "alerts":
+            # volume alerts NAME list|history|rules — the SLO plane:
+            # list unions every process's currently-raised alerts,
+            # history shows recent RAISED/CLEARED transition edges,
+            # rules echoes the configured diagnostics.slo-rules set
+            # (with validation errors)
+            action = args.args[0] if args.args else "list"
+            if action not in ("list", "history", "rules"):
+                raise SystemExit("usage: volume alerts NAME "
+                                 "list|history|rules")
+            async with MgmtClient(host, port) as c:
+                return await c.call("volume-alerts", name=args.name,
+                                    action=action)
     raise SystemExit(f"unknown command {args.cmd} {args.sub}")
 
 
@@ -629,7 +643,7 @@ def main(argv=None) -> int:
                                      "quota", "bitrot", "add-brick",
                                      "remove-brick", "replace-brick",
                                      "top", "gateway", "clear-locks",
-                                     "incident"])
+                                     "incident", "alerts"])
     vol.add_argument("name", nargs="?", default="")
     vol.add_argument("args", nargs="*")
 

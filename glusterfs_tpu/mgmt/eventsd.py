@@ -44,6 +44,20 @@ _metrics.REGISTRY.register_objects(
                for url, st in d.webhooks.items()
                for k, v in st.items()],
     live=_LIVE_EVENTSD)
+_metrics.REGISTRY.register_objects(
+    "gftpu_events_webhook_retries_total", "counter",
+    "webhook delivery attempts retried after a connect failure or 5xx",
+    lambda d: [({"url": url}, n)
+               for url, n in sorted(d.webhook_retries.items())],
+    live=_LIVE_EVENTSD)
+
+#: bounded retry: one retry (2 attempts total) with a short backoff —
+#: enough to ride out a webhook restart, bounded enough that a dead
+#: webhook can never queue-explode the delivery tasks (the reference's
+#: glustereventsd never retries at all; one bounded retry keeps the
+#: no-explosion property while surviving the common blip)
+_WEBHOOK_ATTEMPTS = 2
+_WEBHOOK_BACKOFF_CAP_S = 1.0
 
 
 class _UdpSink(asyncio.DatagramProtocol):
@@ -65,6 +79,7 @@ class EventsDaemon:
         self.udp_port = udp_port
         self.ctl_port = ctl_port
         self.webhooks: dict[str, dict] = {}  # url -> delivery stats
+        self.webhook_retries: dict[str, int] = {}  # url -> retry count
         self.recent: deque = deque(maxlen=history)
         self.received = 0
         self._transport = None
@@ -109,28 +124,51 @@ class EventsDaemon:
         stats = self.webhooks.get(url)
         if stats is None:
             return
+        for attempt in range(_WEBHOOK_ATTEMPTS):
+            outcome = await self._post(url, event)
+            if outcome == "ok":
+                stats["delivered"] += 1
+                return
+            # a 4xx is the webhook REJECTING the event — retrying it
+            # re-sends the same rejected payload; only transport blips
+            # (connect failure / timeout) and 5xx earn the retry
+            if outcome == "fatal" or attempt == _WEBHOOK_ATTEMPTS - 1:
+                break
+            self.webhook_retries[url] = \
+                self.webhook_retries.get(url, 0) + 1
+            await asyncio.sleep(min(_WEBHOOK_BACKOFF_CAP_S,
+                                    0.25 * (2 ** attempt)))
+        stats["failed"] += 1
+
+    async def _post(self, url: str, event: dict) -> str:
+        """One delivery attempt: ``ok`` (2xx), ``retryable`` (connect
+        failure / timeout / 5xx) or ``fatal`` (any other status)."""
+        u = urlparse(url)
+        body = json.dumps(event).encode()
         try:
-            u = urlparse(url)
-            body = json.dumps(event).encode()
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(u.hostname, u.port or 80), 5)
-            try:
-                req = (f"POST {u.path or '/'} HTTP/1.1\r\n"
-                       f"Host: {u.hostname}\r\n"
-                       f"Content-Type: application/json\r\n"
-                       f"Content-Length: {len(body)}\r\n"
-                       f"Connection: close\r\n\r\n").encode() + body
-                writer.write(req)
-                await writer.drain()
-                status = await asyncio.wait_for(reader.readline(), 5)
-                if b" 2" in status:
-                    stats["delivered"] += 1
-                else:
-                    stats["failed"] += 1
-            finally:
-                writer.close()
         except Exception:
-            stats["failed"] += 1
+            return "retryable"
+        try:
+            req = (f"POST {u.path or '/'} HTTP/1.1\r\n"
+                   f"Host: {u.hostname}\r\n"
+                   f"Content-Type: application/json\r\n"
+                   f"Content-Length: {len(body)}\r\n"
+                   f"Connection: close\r\n\r\n").encode() + body
+            writer.write(req)
+            await writer.drain()
+            status = await asyncio.wait_for(reader.readline(), 5)
+            if b" 2" in status:
+                return "ok"
+            return "retryable" if b" 5" in status else "fatal"
+        except Exception:
+            return "retryable"
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
 
     # -- control port ------------------------------------------------------
 
@@ -170,6 +208,7 @@ class EventsDaemon:
             return {"ok": True, "webhooks": sorted(self.webhooks)}
         if method == "webhook-del":
             self.webhooks.pop(kwargs["url"], None)
+            self.webhook_retries.pop(kwargs["url"], None)
             return {"ok": True, "webhooks": sorted(self.webhooks)}
         if method == "status":
             return {"received": self.received,
@@ -182,6 +221,12 @@ class EventsDaemon:
 
 
 async def _amain(args) -> None:
+    from ..core import flight, history
+    from ..core.metrics import register_build_info
+
+    flight.set_role("eventsd")
+    register_build_info("eventsd")
+    history.arm()
     d = EventsDaemon(args.host, args.udp_port, args.ctl_port)
     await d.start()
     metrics_srv = None

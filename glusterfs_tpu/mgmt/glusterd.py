@@ -1109,7 +1109,33 @@ class Glusterd:
         tasks = self._volume_tasks(vol)
         if tasks:
             out["tasks"] = tasks
+        alerts = self._volume_alerts_block(vol)
+        if alerts is not None:
+            out["alerts"] = alerts
         return out
+
+    def _volume_alerts_block(self, vol: dict) -> dict | None:
+        """The status "alerts" section: rule-set shape from volume
+        config (validation errors surface HERE, where the operator who
+        just volume-set a bad rule is looking) plus the most recent
+        ``volume alerts`` fan-out's active set.  Status stays a sync
+        local op, so the live set is as-of the last fan-out — ``gftpu
+        volume alerts`` is the fresh view."""
+        rules_text = str(vol.get("options", {}).get(
+            "diagnostics.slo-rules", "") or "")
+        if not rules_text.strip():
+            return None
+        from ..core import slo
+
+        rules, errors = slo.parse_rules(rules_text)
+        block: dict[str, Any] = {"rules": len(rules)}
+        if errors:
+            block["rule_errors"] = errors
+        cached = getattr(self, "_alerts_cache", {}).get(vol["name"])
+        if cached:
+            block["active"] = cached["active"]
+            block["as_of"] = cached["ts"]
+        return block
 
     @staticmethod
     def _volume_tasks(vol: dict) -> list[dict]:
@@ -1680,6 +1706,143 @@ class Glusterd:
         except ValueError as e:
             raise MgmtError(f"bundle {base} is not valid JSON: "
                             f"{e}") from e
+
+    # -- alerts plane (SLO engine fan-out, ISSUE 20) -----------------------
+
+    _ALERT_ACTIONS = ("list", "history", "rules")
+
+    async def op_volume_alerts(self, name: str,
+                               action: str = "list") -> dict:
+        """``gftpu volume alerts <v> [list|history|rules]`` — the
+        cluster view of the SLO plane: every process evaluates rules
+        against its OWN history ring (core/slo.py); this op gathers
+        and merges their engine state per node, tagging each row with
+        the process it came from.  ``rules`` answers from volume
+        config alone (validation errors included) — no fan-out."""
+        if action not in self._ALERT_ACTIONS:
+            raise MgmtError(f"unknown alerts action {action!r} "
+                            f"(one of {', '.join(self._ALERT_ACTIONS)})")
+        vol = self._vol(name)
+        rules_text = str(vol.get("options", {}).get(
+            "diagnostics.slo-rules", "") or "")
+        if action == "rules":
+            from ..core import slo
+
+            rules, errors = slo.parse_rules(rules_text)
+            return {"volume": name, "rules": rules,
+                    "rule_errors": errors}
+        if vol["status"] != "started":
+            raise MgmtError(f"volume {name} not started")
+        procs, partial = await self._gather_bricks(
+            "volume-alerts-local", nodes=self._vol_nodes(vol),
+            name=name)
+        active: list[dict] = []
+        transitions: list[dict] = []
+        rule_errors: list[str] = []
+        for proc_name, st in sorted(procs.items()):
+            if not isinstance(st, dict):
+                continue
+            for a in st.get("active", []):
+                active.append({"process": proc_name, **a})
+            for t in st.get("history", []):
+                transitions.append({"process": proc_name, **t})
+            for e in st.get("rule_errors", []):
+                if e not in rule_errors:
+                    rule_errors.append(e)
+        active.sort(key=lambda a: a.get("since", 0.0))
+        transitions.sort(key=lambda t: t.get("ts", 0.0))
+        out = {"volume": name, "active": active,
+               "processes": sorted(procs)}
+        if rule_errors:
+            out["rule_errors"] = rule_errors
+        if action == "history":
+            out["history"] = transitions
+        # volume status surfaces this summary without re-fanning-out
+        self._alerts_cache = getattr(self, "_alerts_cache", {})
+        self._alerts_cache[name] = {"ts": round(time.time(), 3),
+                                    "active": active}
+        return self._merge_partial(out, partial)
+
+    async def op_volume_alerts_local(self, name: str) -> dict:
+        """One node's share of volume-alerts: each local brick's
+        ``__alerts__`` door, the gateway's ``/alerts.json`` (the
+        supervisor unions its workers there), and shd's tick-mirrored
+        ``<statefile>.alerts`` file — the incident-local trio, minus
+        daemons that mount no io-stats graph."""
+        vol = self._vol(name)
+        out: dict[str, Any] = {}
+        for b in vol["bricks"]:
+            if b["node"] != self.uuid:
+                continue
+            port = self.ports.get(b["name"])
+            proc = self.bricks.get(b["name"])
+            if not port or proc is None or proc.poll() is not None:
+                out[b["name"]] = {"offline": True}
+                continue
+            try:
+                payload = await self._brick_call(
+                    vol, port, "__alerts__", [],
+                    subvol=b["name"] + "-server")
+            except Exception as e:
+                out[b["name"]] = {"offline": True,
+                                  "error": repr(e)[:200]}
+                continue
+            out[b["name"]] = payload if payload is not None \
+                else {"error": "__alerts__ refused "
+                               "(older brick build?)"}
+        gw = await self._gateway_json(vol, "/alerts.json")
+        if gw is not None:
+            out[f"gateway:{self.host}"] = gw
+        shd_st = self._read_alerts_file(
+            self.shd.get(vol["name"]),
+            os.path.join(self.workdir,
+                         f"shd-{vol['name']}.json.alerts"))
+        if shd_st is not None:
+            out[f"shd:{self.host}"] = shd_st
+        return {"bricks": out}
+
+    async def _gateway_json(self, vol: dict, path: str) -> dict | None:
+        """GET one JSON document off this node's gateway metrics
+        endpoint (single-process daemon and worker-pool supervisor
+        both serve it); None when no gateway runs here."""
+        proc = self.gateway.get(vol["name"])
+        if proc is None or proc.poll() is not None:
+            return {"offline": True} if proc is not None else None
+        mport = int(vol.get("options", {}).get("gateway.metrics-port",
+                                               0) or 0)
+        if not mport:
+            return None
+        host = str(vol.get("options", {}).get("gateway.listen-host",
+                                              "127.0.0.1"))
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, mport), 3)
+            try:
+                writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+                await writer.drain()
+                raw = await asyncio.wait_for(reader.read(-1), 5)
+            finally:
+                writer.close()
+            return json.loads(raw.split(b"\r\n\r\n", 1)[1].decode())
+        except Exception as e:  # noqa: BLE001 - one process of many
+            return {"offline": True, "error": repr(e)[:200]}
+
+    @staticmethod
+    def _read_alerts_file(proc, path: str) -> dict | None:
+        """shd's alerts door: the daemon mirrors its engine status
+        beside the statefile on every sampler tick (mgmt/shd.py), so
+        reading it is passive — no signal round-trip.  None = no such
+        daemon on this node or no rules configured (the mirror is only
+        written once rules exist)."""
+        if proc is None:
+            return None
+        if proc.poll() is not None:
+            return {"offline": True}
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
 
     async def op_volume_top(self, name: str, metric: str = "open",
                             count: int = 10) -> dict:
@@ -3792,6 +3955,12 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     async def run():
+        from ..core import flight, history
+        from ..core.metrics import register_build_info
+
+        flight.set_role("glusterd")
+        register_build_info("glusterd")
+        history.arm()
         d = Glusterd(args.workdir, args.host, args.listen)
         await d.start()
         if args.portfile:

@@ -588,10 +588,13 @@ def _write_statusfile(path: str, info: dict) -> None:
 
 
 async def _amain(args) -> int:
-    from ..core import flight
+    from ..core import flight, history
+    from ..core.metrics import register_build_info
     from .glusterd import mount_volume
 
     flight.set_role("rebalance")
+    register_build_info("rebalance")
+    history.arm()
     if args.statusfile:
         # incident capture door (no inbound RPC surface): SIGUSR2
         # writes the flight bundle beside the statusfile, where the

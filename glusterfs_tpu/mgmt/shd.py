@@ -307,10 +307,13 @@ class SelfHealDaemon:
 
 
 async def _amain(args) -> None:
-    from ..core import flight
+    from ..core import flight, history, slo
+    from ..core.metrics import register_build_info
     from .glusterd import mount_volume
 
     flight.set_role("shd")
+    register_build_info("shd")
+    history.arm()
     host, _, port = args.glusterd.rpartition(":")
     client = None
     while client is None:
@@ -327,6 +330,23 @@ async def _amain(args) -> None:
         # SIGUSR2 writes the flight bundle beside the statefile, where
         # glusterd's incident fan-out polls for it
         flight.arm_signal_capture(args.statefile + ".incident")
+        # alerts door, same shape: the local SLO engine's status is
+        # mirrored beside the statefile on every sampler tick (only
+        # once rules are configured), where glusterd's volume-alerts
+        # fan-out reads it
+        alerts_path = args.statefile + ".alerts"
+
+        def _mirror_alerts() -> None:
+            if not slo.ENGINE.rules:
+                return
+            try:
+                with open(alerts_path + ".tmp", "w") as f:
+                    json.dump(slo.ENGINE.status(), f, default=repr)
+                os.replace(alerts_path + ".tmp", alerts_path)
+            except OSError:
+                pass
+
+        history.add_tick_hook(_mirror_alerts)
     shd = SelfHealDaemon(client, args.interval,
                          args.max_heals, args.wait_qlength)
     stop = asyncio.Event()

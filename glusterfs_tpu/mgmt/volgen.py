@@ -73,6 +73,14 @@ OPTION_MAP = {
     "diagnostics.flight-ring-size": ("debug/io-stats",
                                      "flight-ring-size"),
     "diagnostics.access-log": ("debug/io-stats", "access-log"),
+    # history + SLO plane (op-version 19): io-stats pushes these
+    # process-wide too — every process that mounts the volume samples
+    # its registry into the history ring and evaluates the same rules
+    "diagnostics.history-interval": ("debug/io-stats",
+                                     "history-interval"),
+    "diagnostics.history-retention": ("debug/io-stats",
+                                      "history-retention"),
+    "diagnostics.slo-rules": ("debug/io-stats", "slo-rules"),
     "client.strict-locks": ("protocol/client", "strict-locks"),
     # failure containment (ISSUE 9): per-brick circuit breaking, the
     # idempotent-retry knobs, the call-timeout transport bail, and
@@ -855,6 +863,17 @@ _V18_KEYS = (
     "diagnostics.access-log",
 )
 OPTION_MIN_OPVERSION.update({k: 18 for k in _V18_KEYS})
+
+# round-20 additions ship at op-version 19: the history/SLO plane — a
+# v18 io-stats stores these keys without pushing them (no sampler to
+# retune, no engine to install), so a mixed cluster would silently
+# diverge on what "the volume's alert rules" even are
+_V19_KEYS = (
+    "diagnostics.history-interval",
+    "diagnostics.history-retention",
+    "diagnostics.slo-rules",
+)
+OPTION_MIN_OPVERSION.update({k: 19 for k in _V19_KEYS})
 
 # default client-side performance stack, bottom -> top (volgen's
 # perfxl_option_handlers order); each gated by its enable key

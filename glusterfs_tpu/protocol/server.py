@@ -1528,6 +1528,21 @@ class BrickServer:
                 except Exception as e:  # noqa: BLE001 - best-effort extra
                     bundle["clients"] = {"error": repr(e)[:200]}
                 return wire.MT_REPLY, _jsonable(bundle)
+            if fop_name == "__history__":
+                # history fan-out brick half (ISSUE 20): this process's
+                # sampled metrics ring, windowed by the caller
+                from ..core import history
+
+                window = float(args[0]) if args and args[0] else None
+                return wire.MT_REPLY, _jsonable(
+                    history.HISTORY.dump(window=window))
+            if fop_name == "__alerts__":
+                # alerts fan-out brick half (glusterd
+                # op_volume_alerts_local): rules as configured, the
+                # active set and recent RAISED/CLEARED transitions
+                from ..core import slo
+
+                return wire.MT_REPLY, _jsonable(slo.ENGINE.status())
             if fop_name == "__statedump__":
                 # full-graph dump (has "layers") when the daemon handed
                 # us the graph; bare top-layer dump otherwise

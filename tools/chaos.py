@@ -65,8 +65,14 @@ Every scenario is wall-clock bounded (a hang IS a failure), and the
 run reports leaked threads/tasks against a warmed baseline — the
 containment plane must not pay for failure handling with leaks.
 
+``--baseline FILE`` loads a previous ``--json`` report and judges this
+run's timing rows against it at the documented 2-core swing band
+(ISSUE 20) — machine-readable ``regressions: [...]`` rows land in the
+report, the mirror of bench.py's throughput gate.
+
 Usage:
     python tools/chaos.py [--scenario NAME ...] [--json] [--with-fuse]
+                          [--baseline FILE]
 Exit 0 iff every selected scenario passed and nothing leaked.
 """
 
@@ -1118,6 +1124,37 @@ async def amain(opts) -> dict:
     return report
 
 
+def compare_reports(now: dict, prev: dict) -> list[dict]:
+    """Baseline-compare (ISSUE 20): judge this run's timing rows
+    against a previous ``--json`` report.  Chaos rows are WALL-CLOCK
+    TIMES, so the gate is the mirror of bench.py's throughput gate: a
+    regression is a time that GREW beyond the documented 2-core swing
+    band (bench.SWING_BAND_WIRE — identical-config full-stack rows
+    swing 4.65x on the shared host; docs/observability.md).  Only
+    scenarios that PASSED in both runs are comparable; every flag is
+    machine-readable: {"row", "prev", "now", "grow_pct", "band"}."""
+    import bench
+
+    band = bench.SWING_BAND_WIRE
+    flags: list[dict] = []
+
+    def check(name: str, new, old) -> None:
+        if isinstance(new, (int, float)) and isinstance(old, (int, float)) \
+                and old > 0 and new > old * band:
+            flags.append({"row": name, "prev": old, "now": new,
+                          "grow_pct": round(100 * (new / old - 1), 1),
+                          "band": round(band, 2)})
+
+    for name, d in (now.get("scenarios") or {}).items():
+        pd = (prev.get("scenarios") or {}).get(name)
+        if not isinstance(pd, dict) or not (d.get("ok") and pd.get("ok")):
+            continue  # a failed run's timings are not a baseline
+        for k, v in d.items():
+            if k.endswith("_s"):
+                check(f"{name}.{k}", v, pd.get(k))
+    return flags
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--scenario", action="append",
@@ -1126,8 +1163,19 @@ def main() -> int:
     p.add_argument("--json", action="store_true")
     p.add_argument("--with-fuse", action="store_true",
                    help="include the kernel-mount scenario")
+    p.add_argument("--baseline",
+                   help="previous --json report to judge this run's "
+                        "timing rows against (2-core swing band)")
     opts = p.parse_args()
     report = asyncio.run(amain(opts))
+    if opts.baseline:
+        try:
+            with open(opts.baseline) as f:
+                report["regressions"] = compare_reports(report,
+                                                        json.load(f))
+        except (OSError, ValueError) as e:
+            report["regressions"] = [{"row": "baseline-unreadable",
+                                      "error": repr(e)[:200]}]
     if opts.json:
         print(json.dumps(report, indent=1, default=repr))
     else:
@@ -1135,6 +1183,8 @@ def main() -> int:
             print(f"{name}: {'ok' if d.get('ok') else 'FAIL'}  {d}")
         print(f"leaked_threads={report['leaked_threads']} "
               f"leaked_tasks={len(report['leaked_tasks'])}")
+        for r in report.get("regressions", []):
+            print(f"regression: {r}")
         print("chaos:", "GREEN" if report["ok"] else "RED")
     return 0 if report["ok"] else 1
 

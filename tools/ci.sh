@@ -79,6 +79,15 @@
 #                               SIGKILL auto-captures a local bundle,
 #                               `volume incident list` shows it,
 #                               `show` round-trips the JSON (ISSUE 19)
+#  15. alert smoke              managed volume with a v19 error-ratio
+#                               SLO rule: an error-gen readv storm
+#                               raises the alert in `volume alerts`,
+#                               ALERT_RAISED rides real UDP eventsd and
+#                               auto-captures an incident bundle whose
+#                               history section shows the error ramp;
+#                               healthy traffic clears it and the
+#                               CLEARED edge lands in alert history
+#                               (ISSUE 20)
 #
 # Usage:  tools/ci.sh [extra pytest args for the tier-1 runs...]
 # Exit: first failing stage's code; 0 = mergeable.
@@ -1246,6 +1255,124 @@ if [ $inc_rc -ne 0 ]; then
     exit $inc_rc
 fi
 
+echo "== ci: alert smoke (v19 slo-rules, error-gen storm raises, UDP"
+echo "       event + auto-captured bundle, clears on healthy traffic) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python - <<'EOF'
+import asyncio, json, os, shutil, tempfile
+
+async def main():
+    from glusterfs_tpu.core import events as gf_events
+    from glusterfs_tpu.core.fops import FopError
+    from glusterfs_tpu.mgmt.eventsd import EventsDaemon
+    from glusterfs_tpu.mgmt.glusterd import (Glusterd, MgmtClient,
+                                             mount_volume)
+
+    base = tempfile.mkdtemp(prefix="ci-alert")
+    inc = os.path.join(base, "incidents")
+    rules = json.dumps([{
+        "name": "readv-errors", "kind": "error-ratio",
+        "errors": "gftpu_fop_errors_total",
+        "total": "gftpu_fops_total",
+        "labels": {"op": "readv"},
+        "target": 0.05, "window": 4,
+    }], separators=(",", ":"))
+    ev = EventsDaemon()
+    udp, _ctl = await ev.start()
+    os.environ["GFTPU_EVENTSD"] = f"127.0.0.1:{udp}"
+    gf_events.configure(f"127.0.0.1:{udp}")
+    d = Glusterd(os.path.join(base, "gd"))
+    try:
+        await d.start()
+        async with MgmtClient(d.host, d.port) as c:
+            await c.call("volume-create", name="av",
+                         vtype="distribute",
+                         bricks=[{"path": os.path.join(base, "b0")}])
+            await c.call("volume-start", name="av")
+            for k, v in (("diagnostics.history-interval", "0.25"),
+                         ("diagnostics.slo-rules", rules),
+                         ("diagnostics.incident-dir", inc),
+                         ("diagnostics.incident-min-interval", "0")):
+                await c.call("volume-set", name="av", key=k, value=v)
+        m = await mount_volume(d.host, d.port, "av")
+        try:
+            await m.write_file("/f", b"x" * 8192)
+            assert bytes(await m.read_file("/f")) == b"x" * 8192
+            # ARM THE STORM: every readv on the brick fails
+            async with MgmtClient(d.host, d.port) as c:
+                await c.call("volume-set", name="av",
+                             key="debug.error-gen", value="on")
+                await c.call("volume-set", name="av",
+                             key="debug.error-fops", value="readv")
+                await c.call("volume-set", name="av",
+                             key="debug.error-failure", value="100")
+            deadline = asyncio.get_event_loop().time() + 60
+            active = []
+            while asyncio.get_event_loop().time() < deadline:
+                try:
+                    await m.read_file("/f")
+                except FopError:
+                    pass
+                out = await d.op_volume_alerts("av")
+                active = [a for a in out["active"]
+                          if a["rule"] == "readv-errors"]
+                if active:
+                    break
+                await asyncio.sleep(0.3)
+            assert active, "storm never raised the alert"
+            assert active[0]["observed"] > 0.05, active[0]
+            raised = [e for e in ev.recent
+                      if e.get("event") == "ALERT_RAISED"]
+            assert raised, "ALERT_RAISED never reached eventsd"
+            caps = []
+            deadline = asyncio.get_event_loop().time() + 20
+            while asyncio.get_event_loop().time() < deadline:
+                caps = [f for f in (os.listdir(inc)
+                                    if os.path.isdir(inc) else [])
+                        if "ALERT_RAISED" in f]
+                if caps:
+                    break
+                await asyncio.sleep(0.3)
+            assert caps, "alert auto-captured no incident bundle"
+            with open(os.path.join(inc, caps[0])) as f:
+                bundle = json.load(f)
+            ramp = [pts for k, pts in bundle["history"]["series"].items()
+                    if k.startswith("gftpu_fop_errors_total")]
+            assert ramp and any(p[-1][1] > p[0][1] for p in ramp), \
+                "bundle history shows no error ramp"
+            # clear by shifting traffic to writes (only readv storms);
+            # no volume-set, so the raising process keeps its history
+            deadline = asyncio.get_event_loop().time() + 60
+            while asyncio.get_event_loop().time() < deadline:
+                await m.write_file("/f", b"y" * 4096)
+                out = await d.op_volume_alerts("av")
+                if not out["active"]:
+                    break
+                await asyncio.sleep(0.3)
+            assert out["active"] == [], "alert never cleared"
+            hist = await d.op_volume_alerts("av", "history")
+            edges = [t["edge"] for t in hist["history"]
+                     if t["rule"] == "readv-errors"]
+            assert "RAISED" in edges and "CLEARED" in edges, edges
+        finally:
+            await m.unmount()
+    finally:
+        await d.stop()
+        os.environ.pop("GFTPU_EVENTSD", None)
+        gf_events.configure(None)
+        await ev.stop()
+        shutil.rmtree(base, ignore_errors=True)
+    print("alert smoke: error-gen storm raised the error-ratio alert "
+          "(UDP event + auto-captured bundle with the error ramp), "
+          "healthy traffic cleared it, both edges in alert history")
+
+asyncio.run(main())
+EOF
+alert_rc=$?
+if [ $alert_rc -ne 0 ]; then
+    echo "ci: alert smoke failed — not mergeable"
+    exit $alert_rc
+fi
+
 if [ $gate_rc -eq 2 ]; then
     echo "ci: green, but flaky tests were seen (flake gate exit 2)"
     exit 2
@@ -1254,5 +1381,5 @@ echo "ci: mergeable (two identical green tier-1 runs + bench contract"
 echo "    + metrics smoke + gateway smoke + concurrency smoke"
 echo "    + mesh smoke + chaos smoke + delta-write smoke"
 echo "    + rebalance smoke + process-plane smoke + lease smoke"
-echo "    + qos smoke + shm smoke + incident smoke)"
+echo "    + qos smoke + shm smoke + incident smoke + alert smoke)"
 exit 0
